@@ -1,0 +1,26 @@
+"""celestia_app_tpu — a TPU-native data-availability framework.
+
+A brand-new framework with the capabilities of celestia-app (the Celestia DA
+chain's state machine): block-square construction, 2D Reed-Solomon erasure
+extension, Namespaced-Merkle-Tree commitments, DataAvailabilityHeader
+generation, blob share commitments, inclusion proofs, and the surrounding
+state machine (PayForBlobs, mint, signal, minfee...) — redesigned TPU-first
+on JAX/XLA/Pallas.
+
+Layer map (mirrors SURVEY.md §1, re-architected):
+
+    ops/        GF(2^8)/GF(2^16) arithmetic, bitsliced RS-as-matmul, batched
+                SHA-256, NMT forest kernels, RFC6962 merkle  (JAX + numpy golden)
+    shares/     share format: namespaces, info byte, compact/sparse splitting
+    square/     deterministic square layout builder (Build/Construct)
+    da/         ExtendedDataSquare + DataAvailabilityHeader (+ repair)
+    inclusion/  blob share commitments (subtree-root merkle mountain range)
+    proof/      NMT range proofs, share/row inclusion proofs
+    models/     the flagship jitted "square engine" pipelines (per square size)
+    parallel/   shard_map multi-chip sharding of the square pipeline
+    state/      state-machine modules (blob, mint, signal, minfee, bank, auth)
+    app/        ABCI-style application: PrepareProposal / ProcessProposal / CheckTx
+    client/     tx client + txsim-style load generator
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,76 @@
+"""Protocol constants (parity with reference pkg/appconsts).
+
+These cannot change during the lifetime of a network.  Sources (reference,
+for parity checking only): pkg/appconsts/global_consts.go:15-78,
+pkg/appconsts/v1/app_consts.go:3-7, pkg/appconsts/v2/app_consts.go,
+pkg/appconsts/initial_consts.go, pkg/appconsts/consensus_consts.go.
+"""
+
+# --- share geometry (global_consts.go) ---
+NAMESPACE_VERSION_SIZE = 1
+NAMESPACE_ID_SIZE = 28
+NAMESPACE_SIZE = NAMESPACE_VERSION_SIZE + NAMESPACE_ID_SIZE  # 29
+SHARE_SIZE = 512
+SHARE_INFO_BYTES = 1
+SEQUENCE_LEN_BYTES = 4
+SHARE_VERSION_ZERO = 0
+DEFAULT_SHARE_VERSION = SHARE_VERSION_ZERO
+MAX_SHARE_VERSION = 127
+COMPACT_SHARE_RESERVED_BYTES = 4
+
+FIRST_COMPACT_SHARE_CONTENT_SIZE = (
+    SHARE_SIZE - NAMESPACE_SIZE - SHARE_INFO_BYTES - SEQUENCE_LEN_BYTES - COMPACT_SHARE_RESERVED_BYTES
+)  # 474
+CONTINUATION_COMPACT_SHARE_CONTENT_SIZE = (
+    SHARE_SIZE - NAMESPACE_SIZE - SHARE_INFO_BYTES - COMPACT_SHARE_RESERVED_BYTES
+)  # 478
+FIRST_SPARSE_SHARE_CONTENT_SIZE = (
+    SHARE_SIZE - NAMESPACE_SIZE - SHARE_INFO_BYTES - SEQUENCE_LEN_BYTES
+)  # 478
+CONTINUATION_SPARSE_SHARE_CONTENT_SIZE = SHARE_SIZE - NAMESPACE_SIZE - SHARE_INFO_BYTES  # 482
+
+MIN_SQUARE_SIZE = 1
+MIN_SHARE_COUNT = MIN_SQUARE_SIZE * MIN_SQUARE_SIZE
+
+# --- hashing ---
+HASH_LENGTH = 32  # SHA-256
+NMT_NODE_SIZE = 2 * NAMESPACE_SIZE + HASH_LENGTH  # 90: minNs || maxNs || digest
+
+# --- versioned consts (v1/app_consts.go, v2/app_consts.go; constant across v1/v2) ---
+V1_VERSION = 1
+V2_VERSION = 2
+LATEST_VERSION = V2_VERSION
+SQUARE_SIZE_UPPER_BOUND = 128
+SUBTREE_ROOT_THRESHOLD = 64
+NETWORK_MIN_GAS_PRICE = 0.000001  # utia (v2+, x/minfee)
+
+
+def subtree_root_threshold(_app_version: int = LATEST_VERSION) -> int:
+    return SUBTREE_ROOT_THRESHOLD
+
+
+def square_size_upper_bound(_app_version: int = LATEST_VERSION) -> int:
+    return SQUARE_SIZE_UPPER_BOUND
+
+
+# --- initial (governance-modifiable) params (initial_consts.go) ---
+DEFAULT_GOV_MAX_SQUARE_SIZE = 64
+DEFAULT_MAX_BYTES = (
+    DEFAULT_GOV_MAX_SQUARE_SIZE * DEFAULT_GOV_MAX_SQUARE_SIZE * CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
+)
+DEFAULT_GAS_PER_BLOB_BYTE = 8
+DEFAULT_MIN_GAS_PRICE = 0.002  # utia
+DEFAULT_UNBONDING_TIME_SECONDS = 3 * 7 * 24 * 3600
+BOND_DENOM = "utia"
+
+# --- consensus timing (consensus_consts.go) ---
+TIMEOUT_PROPOSE_SECONDS = 10
+TIMEOUT_COMMIT_SECONDS = 11
+GOAL_BLOCK_TIME_SECONDS = 15
+
+# --- PFB gas (x/blob/types/payforblob.go) ---
+PFB_GAS_FIXED_COST = 75_000
+BYTES_PER_BLOB_INFO = 70
+
+# Square sizes the framework precompiles kernels for (powers of two).
+SUPPORTED_SQUARE_SIZES = tuple(1 << i for i in range(10))  # 1..512

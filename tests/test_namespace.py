@@ -1,0 +1,53 @@
+import pytest
+
+from celestia_app_tpu.shares.namespace import (
+    Namespace,
+    PARITY_SHARE_NAMESPACE,
+    PAY_FOR_BLOB_NAMESPACE,
+    PRIMARY_RESERVED_PADDING_NAMESPACE,
+    TAIL_PADDING_NAMESPACE,
+    TRANSACTION_NAMESPACE,
+)
+
+
+def test_reserved_namespace_values():
+    # Exact byte values from specs/src/specs/namespace.md "Reserved Namespaces".
+    assert TRANSACTION_NAMESPACE.to_bytes().hex() == "00" * 28 + "01"
+    assert PAY_FOR_BLOB_NAMESPACE.to_bytes().hex() == "00" * 28 + "04"
+    assert PRIMARY_RESERVED_PADDING_NAMESPACE.to_bytes().hex() == "00" * 28 + "ff"
+    assert TAIL_PADDING_NAMESPACE.to_bytes().hex() == "ff" * 28 + "fe"
+    assert PARITY_SHARE_NAMESPACE.to_bytes().hex() == "ff" * 29
+
+
+def test_namespace_roundtrip_and_ordering():
+    a = Namespace.v0(b"\x01" * 10)
+    b = Namespace.v0(b"\x02" * 10)
+    assert a < b < PARITY_SHARE_NAMESPACE
+    assert TRANSACTION_NAMESPACE < PAY_FOR_BLOB_NAMESPACE
+    assert Namespace.from_bytes(a.to_bytes()) == a
+    assert len(a.to_bytes()) == 29
+
+
+def test_v0_validation():
+    ns = Namespace.v0(b"valid10byt")
+    ns.validate_for_blob()
+    assert ns.is_supported_user_namespace()
+    # Reserved namespaces are not valid blob namespaces.
+    with pytest.raises(ValueError):
+        TRANSACTION_NAMESPACE.validate_for_blob()
+    with pytest.raises(ValueError):
+        PARITY_SHARE_NAMESPACE.validate_for_blob()
+    # Non-zero bytes in the 18-byte prefix are invalid for v0.
+    bad = Namespace(0, b"\x01" + bytes(27))
+    assert not bad.is_supported_user_namespace()
+    with pytest.raises(ValueError):
+        Namespace.v0(b"x" * 11)
+
+
+def test_classification():
+    assert TRANSACTION_NAMESPACE.is_primary_reserved()
+    assert PAY_FOR_BLOB_NAMESPACE.is_primary_reserved()
+    assert TAIL_PADDING_NAMESPACE.is_secondary_reserved()
+    assert PARITY_SHARE_NAMESPACE.is_parity()
+    user = Namespace.v0(b"\xaa" * 10)
+    assert not user.is_reserved()

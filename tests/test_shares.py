@@ -1,0 +1,146 @@
+import random
+
+import pytest
+
+from celestia_app_tpu.constants import (
+    CONTINUATION_SPARSE_SHARE_CONTENT_SIZE,
+    FIRST_COMPACT_SHARE_CONTENT_SIZE,
+    FIRST_SPARSE_SHARE_CONTENT_SIZE,
+    SHARE_SIZE,
+)
+from celestia_app_tpu.shares import (
+    Blob,
+    Namespace,
+    Share,
+    TRANSACTION_NAMESPACE,
+    compact_shares_needed,
+    make_info_byte,
+    padding_share,
+    parse_compact_shares,
+    parse_info_byte,
+    parse_sparse_shares,
+    sparse_shares_needed,
+    split_blob,
+    split_txs,
+    tail_padding_shares,
+    tx_sequence_len,
+)
+
+NS = Namespace.v0(b"\x01" * 10)
+
+
+def test_info_byte():
+    assert make_info_byte(0, True) == 1
+    assert make_info_byte(0, False) == 0
+    assert make_info_byte(1, True) == 3
+    assert parse_info_byte(3) == (1, True)
+    assert parse_info_byte(0) == (0, False)
+
+
+def test_content_sizes():
+    assert FIRST_SPARSE_SHARE_CONTENT_SIZE == 478
+    assert CONTINUATION_SPARSE_SHARE_CONTENT_SIZE == 482
+    assert FIRST_COMPACT_SHARE_CONTENT_SIZE == 474
+
+
+def test_sparse_shares_needed():
+    assert sparse_shares_needed(1) == 1
+    assert sparse_shares_needed(478) == 1
+    assert sparse_shares_needed(479) == 2
+    assert sparse_shares_needed(478 + 482) == 2
+    assert sparse_shares_needed(478 + 482 + 1) == 3
+
+
+def test_split_blob_layout():
+    blob = Blob(NS, b"\xab" * 600)
+    shares = split_blob(blob)
+    assert len(shares) == 2
+    first, cont = shares
+    assert first.namespace() == NS and cont.namespace() == NS
+    assert first.is_sequence_start() and not cont.is_sequence_start()
+    assert first.sequence_len() == 600
+    assert len(first.raw) == SHARE_SIZE
+    assert first.data() == b"\xab" * 478
+    assert cont.data()[: 600 - 478] == b"\xab" * (600 - 478)
+    assert cont.data()[600 - 478 :] == bytes(482 - (600 - 478))  # zero padding
+
+
+@pytest.mark.parametrize("size", [1, 477, 478, 479, 960, 5000, 100_000])
+def test_sparse_roundtrip(size):
+    rng = random.Random(size)
+    blob = Blob(NS, rng.randbytes(size))
+    shares = split_blob(blob)
+    assert len(shares) == sparse_shares_needed(size)
+    [parsed] = parse_sparse_shares(shares)
+    assert parsed.data == blob.data
+    assert parsed.namespace == NS
+
+
+def test_multi_blob_roundtrip_with_padding():
+    rng = random.Random(7)
+    blobs = [Blob(NS, rng.randbytes(100)), Blob(NS, rng.randbytes(1000))]
+    shares = split_blob(blobs[0]) + [padding_share(NS)] * 3 + split_blob(blobs[1])
+    parsed = parse_sparse_shares(shares)
+    assert [b.data for b in parsed] == [b.data for b in blobs]
+
+
+def test_padding_share_format():
+    p = padding_share(NS)
+    assert p.is_sequence_start()
+    assert p.sequence_len() == 0
+    assert p.is_padding()
+    assert p.data() == bytes(478)
+    t = tail_padding_shares(2)
+    assert all(s.namespace().is_tail_padding() for s in t)
+
+
+def test_compact_roundtrip_and_reserved_bytes():
+    rng = random.Random(3)
+    txs = [rng.randbytes(n) for n in [10, 400, 100, 2000, 1]]
+    shares = split_txs(txs, TRANSACTION_NAMESPACE)
+    assert shares[0].is_sequence_start()
+    assert shares[0].sequence_len() == tx_sequence_len(txs)
+    assert len(shares) == compact_shares_needed(tx_sequence_len(txs))
+    # First unit starts right after the prefix: namespace+info+seqlen+reserved = 38.
+    assert shares[0].reserved_bytes() == 38
+    assert parse_compact_shares(shares) == txs
+
+
+def test_compact_reserved_bytes_mid_share():
+    # One tx spanning beyond share 1; second tx starts inside share 2.
+    txs = [bytes(500), bytes(10)]
+    shares = split_txs(txs, TRANSACTION_NAMESPACE)
+    assert len(shares) == 2
+    # Unit 2 starts at sequence offset len(varint(500))+500 = 502; share 2
+    # covers [474, ...) at data offset 34 => reserved = 34 + (502-474) = 62.
+    assert shares[1].reserved_bytes() == 62
+    assert parse_compact_shares(shares) == txs
+
+
+def test_compact_no_unit_start_in_share():
+    # Single huge tx: continuation shares contain no unit start => reserved 0.
+    txs = [bytes(3000)]
+    shares = split_txs(txs, TRANSACTION_NAMESPACE)
+    assert len(shares) > 2
+    assert all(s.reserved_bytes() == 0 for s in shares[1:])
+    assert parse_compact_shares(shares) == txs
+
+
+def test_compact_truncated_run_rejected():
+    # A tx boundary landing exactly at the end of share 1 must not silently
+    # drop the txs in the missing continuation shares.
+    txs = [bytes(472), bytes(100)]
+    shares = split_txs(txs, TRANSACTION_NAMESPACE)
+    assert len(shares) == 2
+    with pytest.raises(ValueError, match="truncated"):
+        parse_compact_shares(shares[:1])
+    # A mid-run share with the sequence-start bit set is rejected, not misparsed.
+    with pytest.raises(ValueError, match="sequence start"):
+        parse_compact_shares([shares[0], shares[0]])
+
+
+def test_share_validation():
+    with pytest.raises(ValueError):
+        Share(b"\x00" * 100)
+    s = padding_share(NS)
+    s.validate()
