@@ -6,6 +6,8 @@ pkg/appconsts/v1/app_consts.go:3-7, pkg/appconsts/v2/app_consts.go,
 pkg/appconsts/initial_consts.go, pkg/appconsts/consensus_consts.go.
 """
 
+from fractions import Fraction
+
 # --- share geometry (global_consts.go) ---
 NAMESPACE_VERSION_SIZE = 1
 NAMESPACE_ID_SIZE = 28
@@ -42,7 +44,9 @@ V2_VERSION = 2
 LATEST_VERSION = V2_VERSION
 SQUARE_SIZE_UPPER_BOUND = 128
 SUBTREE_ROOT_THRESHOLD = 64
-NETWORK_MIN_GAS_PRICE = 0.000001  # utia (v2+, x/minfee)
+# Exact decimal (consensus-critical): binary floats would diverge from peers
+# doing exact-decimal arithmetic on fee boundaries.
+NETWORK_MIN_GAS_PRICE = Fraction(1, 10**6)  # utia per gas (v2+, x/minfee)
 
 
 def subtree_root_threshold(_app_version: int = LATEST_VERSION) -> int:
@@ -59,7 +63,7 @@ DEFAULT_MAX_BYTES = (
     DEFAULT_GOV_MAX_SQUARE_SIZE * DEFAULT_GOV_MAX_SQUARE_SIZE * CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
 )
 DEFAULT_GAS_PER_BLOB_BYTE = 8
-DEFAULT_MIN_GAS_PRICE = 0.002  # utia
+DEFAULT_MIN_GAS_PRICE = Fraction(2, 1000)  # utia per gas (node-local default)
 DEFAULT_UNBONDING_TIME_SECONDS = 3 * 7 * 24 * 3600
 BOND_DENOM = "utia"
 

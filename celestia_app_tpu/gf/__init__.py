@@ -1,0 +1,17 @@
+"""Finite-field arithmetic and Reed-Solomon codecs for the data square.
+
+Replaces the reference's `rsmt2d` + klauspost/reedsolomon leopard codec
+(selected at reference pkg/appconsts/global_consts.go:92) with a TPU-first
+design: the systematic RS encode is a constant generator matrix over
+GF(2^8) (codewords <= 256 symbols wide, i.e. square size k <= 128) or
+GF(2^16) (k in {256, 512}), applied as a *binary* bit-matmul on the MXU.
+
+Layout of this package:
+  field.py  - GF(2^m) table arithmetic + linear algebra (numpy, host side)
+  rs.py     - systematic RS codec: generator matrices, encode/decode oracle
+"""
+
+from celestia_app_tpu.gf.field import GF, GF8, GF16
+from celestia_app_tpu.gf.rs import RSCodec, codec_for_width
+
+__all__ = ["GF", "GF8", "GF16", "RSCodec", "codec_for_width"]

@@ -1,0 +1,6 @@
+"""Device (JAX/XLA/Pallas) kernels: the TPU compute path of the framework.
+
+  rs.py      - Reed-Solomon extension as binary bit-matmuls on the MXU
+  sha256.py  - batched fixed-shape SHA-256 over uint32 lanes
+  nmt.py     - batched Namespaced-Merkle-Tree level reduction
+"""

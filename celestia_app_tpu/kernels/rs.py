@@ -1,0 +1,138 @@
+"""Reed-Solomon square extension as MXU bit-matmuls.
+
+TPU-first lowering of the rsmt2d encode (reference
+pkg/da/data_availability_header.go:74 -> rsmt2d.ComputeExtendedDataSquare):
+GF(2^m) arithmetic never reaches the device as table lookups.  Multiplication
+by a field constant is GF(2)-linear on the symbol's bit vector, so the whole
+systematic generator G (gf/rs.py) bit-expands to a constant 0/1 matrix G_bits
+of shape (k*m, k*m), and
+
+    parity_bits = (G_bits @ data_bits) mod 2
+
+is one dense matmul per axis phase - exactly the shape the MXU wants.  The
+mod-2 is a final `& 1` on the int32 accumulator (max k*m = 8192 partial
+products, far below 2^31).
+
+Data layout: a square is (rows, cols, SHARE_SIZE) uint8.  Bit-planes put the
+contraction axis (share-index x bit) first and batch (row x symbol) columns
+into one wide matmul.  The column phase extends all 2k columns of the
+row-extended top half in a single matmul, yielding Q2 and Q3 at once - valid
+because row/col encodes commute (EDS = [[Q0, Q0 G^T], [G Q0, G Q0 G^T]]).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from celestia_app_tpu.gf.rs import codec_for_width
+
+# int8 feeds the MXU's integer path on TPU; float32 is an exact fallback
+# (0/1 products, sums <= 8192 << 2^24).
+_DOT_DTYPE = jnp.int8
+
+
+def _bits_from_bytes(shares: jnp.ndarray, m: int) -> jnp.ndarray:
+    """(R, n, S) uint8 -> (R, n*m, n_symbols) bit-planes in {0,1}.
+
+    Bit t of a symbol (t in [0,m)) lives at byte t//8 (little-endian within
+    the symbol) bit t%8 - matching gf.field.GF.mul_bit_matrix's convention.
+    """
+    R, n, S = shares.shape
+    bps = m // 8  # bytes per symbol
+    nsym = S // bps
+    x = shares.reshape(R, n, nsym, bps)
+    bits = (x[..., None] >> jnp.arange(8, dtype=jnp.uint8)) & jnp.uint8(1)
+    bits = bits.reshape(R, n, nsym, m)
+    return bits.transpose(0, 1, 3, 2).reshape(R, n * m, nsym)
+
+
+def _bytes_from_bits(bits: jnp.ndarray, m: int) -> jnp.ndarray:
+    """Inverse of _bits_from_bytes: (R, n*m, nsym) -> (R, n, S)."""
+    R, nm, nsym = bits.shape
+    n = nm // m
+    bps = m // 8
+    b = bits.reshape(R, n, m, nsym).transpose(0, 1, 3, 2)
+    b = b.reshape(R, n, nsym, bps, 8).astype(jnp.uint8)
+    weights = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8)).astype(jnp.uint8)
+    by = (b * weights).sum(axis=-1, dtype=jnp.uint32).astype(jnp.uint8)
+    return by.reshape(R, n, nsym * bps)
+
+
+def _mod2_matmul(G_bits: jnp.ndarray, bits: jnp.ndarray) -> jnp.ndarray:
+    """(P, Q) x (R, Q, nsym) -> (R, P, nsym), all in {0,1}.
+
+    Collapses the (R, nsym) batch into matmul columns so the device sees one
+    large dense dot per phase.
+    """
+    R, Q, nsym = bits.shape
+    x = bits.transpose(1, 0, 2).reshape(Q, R * nsym)
+    acc = jax.lax.dot_general(
+        G_bits.astype(_DOT_DTYPE),
+        x.astype(_DOT_DTYPE),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    out = (acc & 1).astype(jnp.uint8)
+    return out.reshape(-1, R, nsym).transpose(1, 0, 2)
+
+
+def encode_axis(data: jnp.ndarray, G_bits: jnp.ndarray, m: int) -> jnp.ndarray:
+    """Batched systematic encode along axis 1: (R, k, S) -> (R, k, S) parity."""
+    return _bytes_from_bits(_mod2_matmul(G_bits, _bits_from_bytes(data, m)), m)
+
+
+def extend_square_fn(k: int):
+    """Returns eds = f(ods) for a fixed square size k.
+
+    ods: (k, k, SHARE_SIZE) uint8 -> eds: (2k, 2k, SHARE_SIZE) uint8 with
+    quadrants [[Q0, Q1], [Q2, Q3]] (row-parity right, column-parity below),
+    matching rsmt2d's quadrant layout.
+    """
+    codec = codec_for_width(k)
+    m = codec.field.m
+    G_bits = jnp.asarray(codec.generator_bits())
+
+    def extend(ods: jnp.ndarray) -> jnp.ndarray:
+        # Row phase: each of the k rows is a codeword batch along cols.
+        q1 = encode_axis(ods, G_bits, m)  # (k, k, S)
+        top = jnp.concatenate([ods, q1], axis=1)  # (k, 2k, S)
+        # Column phase: extend all 2k columns of the top half at once.
+        cols = top.transpose(1, 0, 2)  # (2k, k, S)
+        bottom_cols = encode_axis(cols, G_bits, m)  # (2k, k, S)
+        bottom = bottom_cols.transpose(1, 0, 2)  # (k, 2k, S)
+        return jnp.concatenate([top, bottom], axis=0)  # (2k, 2k, S)
+
+    return extend
+
+
+@lru_cache(maxsize=None)
+def jit_extend_square(k: int):
+    """Cached jitted extension for square size k (one compile per k)."""
+    return jax.jit(extend_square_fn(k))
+
+
+def extend_square(ods: np.ndarray) -> np.ndarray:
+    """Host convenience: numpy ODS (k, k, S) -> numpy EDS (2k, 2k, S)."""
+    k = ods.shape[0]
+    assert ods.shape[1] == k, ods.shape
+    return np.asarray(jit_extend_square(k)(jnp.asarray(ods, dtype=jnp.uint8)))
+
+
+def decode_axis_fn(k: int):
+    """Erasure decode along an axis as a constant matmul.
+
+    Returns f(shares, R_bits) where shares is (R, k, S) holding the k known
+    shares (already gathered) and R_bits the bit-expanded (2k*m, k*m) recovery
+    matrix from RSCodec.recover_matrix - output is the full (R, 2k, S).
+    """
+    codec = codec_for_width(k)
+    m = codec.field.m
+
+    def decode(known: jnp.ndarray, R_bits: jnp.ndarray) -> jnp.ndarray:
+        return _bytes_from_bits(_mod2_matmul(R_bits, _bits_from_bytes(known, m)), m)
+
+    return jax.jit(decode)

@@ -131,7 +131,14 @@ def shares_needed(total_bytes: int, first_content_size: int, cont_content_size: 
 
 
 def padding_share(namespace: Namespace, share_version: int = SHARE_VERSION_ZERO) -> Share:
-    """A padding share: sequence start, sequence length 0, zero data."""
+    """A padding share: sequence start, sequence length 0, zero data.
+
+    Only sparse (non-compact) namespaces are valid: padding never occurs
+    inside the compact tx/PFB runs, and a compact-namespace share without
+    reserved bytes would be malformed.
+    """
+    if namespace.is_tx() or namespace.is_pay_for_blob():
+        raise ValueError(f"padding shares cannot use compact namespace {namespace}")
     buf = _build_prefix(namespace, share_version, True, 0)
     buf += bytes(SHARE_SIZE - len(buf))
     return Share(bytes(buf))
