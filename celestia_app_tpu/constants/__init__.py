@@ -43,6 +43,11 @@ V1_VERSION = 1
 V2_VERSION = 2
 LATEST_VERSION = V2_VERSION
 SQUARE_SIZE_UPPER_BOUND = 128
+# Codec capability bound: the largest ODS the DA pipeline kernels support.
+# Wider than the versioned protocol cap (128) because the reference's own
+# e2e benchmarks push 512-class squares; app-level validation still enforces
+# square_size_upper_bound() per app version.
+MAX_CODEC_SQUARE_SIZE = 512
 SUBTREE_ROOT_THRESHOLD = 64
 # Exact decimal (consensus-critical): binary floats would diverge from peers
 # doing exact-decimal arithmetic on fee boundaries.

@@ -1,0 +1,20 @@
+"""Data-availability layer: extended data squares + DA headers.
+
+TPU-native replacement of reference pkg/da (ExtendShares,
+NewDataAvailabilityHeader, data_availability_header.go:44-108) and the
+rsmt2d/nmt composition behind it: one fused jitted pipeline takes the ODS and
+returns the EDS, all row/column NMT roots, and the data root.
+"""
+
+from celestia_app_tpu.da.eds import ExtendedDataSquare, extend_shares
+from celestia_app_tpu.da.dah import (
+    DataAvailabilityHeader,
+    min_data_availability_header,
+)
+
+__all__ = [
+    "ExtendedDataSquare",
+    "extend_shares",
+    "DataAvailabilityHeader",
+    "min_data_availability_header",
+]

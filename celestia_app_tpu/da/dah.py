@@ -1,0 +1,68 @@
+"""DataAvailabilityHeader: row/col NMT roots + the data root.
+
+Parity with reference pkg/da/data_availability_header.go:
+  NewDataAvailabilityHeader :44-63, Hash :92-108 (merkle over rowRoots ||
+  colRoots), ValidateBasic :134, MinDataAvailabilityHeader :179.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from celestia_app_tpu.constants import (
+    MAX_CODEC_SQUARE_SIZE,
+    NMT_NODE_SIZE,
+    SHARE_SIZE,
+)
+from celestia_app_tpu import merkle
+from celestia_app_tpu.da.eds import ExtendedDataSquare, extend_shares
+from celestia_app_tpu.shares.share import padding_share
+from celestia_app_tpu.shares.namespace import TAIL_PADDING_NAMESPACE
+
+_MIN_EDS_WIDTH = 2
+_MAX_EDS_WIDTH = 2 * MAX_CODEC_SQUARE_SIZE
+
+
+@dataclass
+class DataAvailabilityHeader:
+    row_roots: list[bytes] = field(default_factory=list)
+    column_roots: list[bytes] = field(default_factory=list)
+
+    @classmethod
+    def from_eds(cls, eds: ExtendedDataSquare) -> "DataAvailabilityHeader":
+        return cls(row_roots=eds.row_roots(), column_roots=eds.col_roots())
+
+    def hash(self) -> bytes:
+        """Data root: merkle root over row roots then column roots."""
+        return merkle.hash_from_byte_slices(self.row_roots + self.column_roots)
+
+    def validate_basic(self) -> None:
+        nr, nc = len(self.row_roots), len(self.column_roots)
+        if nr != nc:
+            raise ValueError(f"row/col root count mismatch: {nr} vs {nc}")
+        if nr < _MIN_EDS_WIDTH:
+            raise ValueError(f"too few roots: {nr} < {_MIN_EDS_WIDTH}")
+        if nr > _MAX_EDS_WIDTH:
+            raise ValueError(f"too many roots: {nr} > {_MAX_EDS_WIDTH}")
+        for r in self.row_roots + self.column_roots:
+            if len(r) != NMT_NODE_SIZE:
+                raise ValueError(f"malformed root length {len(r)}")
+
+    def square_size(self) -> int:
+        """ODS width implied by this header."""
+        return len(self.row_roots) // 2
+
+    def equals(self, other: "DataAvailabilityHeader") -> bool:
+        return (
+            self.row_roots == other.row_roots
+            and self.column_roots == other.column_roots
+        )
+
+
+def min_data_availability_header() -> DataAvailabilityHeader:
+    """DAH of the minimal (1x1 tail-padding) square - the empty block's root
+    (reference pkg/da/data_availability_header.go:179)."""
+    share = padding_share(TAIL_PADDING_NAMESPACE).raw
+    assert len(share) == SHARE_SIZE
+    eds = extend_shares([share])
+    return DataAvailabilityHeader.from_eds(eds)

@@ -1,0 +1,132 @@
+"""Extended data square: the 2k x 2k erasure-coded share matrix.
+
+Replaces rsmt2d.ExtendedDataSquare as consumed by the reference
+(pkg/da/data_availability_header.go:65-75): construction fuses the RS
+extension and all 4k NMT roots into one jitted device program per square
+size; accessors mirror the rsmt2d surface (Row, Col, FlattenedODS, quadrant
+namespace rules from pkg/wrapper/nmt_wrapper.go:93-114).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from celestia_app_tpu.constants import (
+    MAX_CODEC_SQUARE_SIZE,
+    NAMESPACE_SIZE,
+    SHARE_SIZE,
+)
+from celestia_app_tpu.kernels.merkle import merkle_root_pow2
+from celestia_app_tpu.kernels.nmt import tree_roots
+from celestia_app_tpu.kernels.rs import extend_square_fn
+
+
+def leaf_namespaces(eds: jnp.ndarray, k: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-leaf namespaces for row trees and column trees.
+
+    Q0 leaves carry the share's own namespace; every parity leaf (row >= k or
+    col >= k) carries the parity namespace 0xFF^29.
+    Returns (row_ns, col_ns): (2k, 2k, 29) each, row-tree-major and
+    col-tree-major respectively.
+    """
+    n = eds.shape[0]
+    share_ns = eds[..., :NAMESPACE_SIZE]  # (2k, 2k, 29)
+    idx = jnp.arange(n)
+    q0 = (idx[:, None] < k) & (idx[None, :] < k)  # (2k, 2k)
+    parity = jnp.full((NAMESPACE_SIZE,), 0xFF, dtype=jnp.uint8)
+    row_ns = jnp.where(q0[..., None], share_ns, parity)
+    col_ns = row_ns.transpose(1, 0, 2)
+    return row_ns, col_ns
+
+
+def _pipeline(k: int):
+    """ods (k,k,512) -> (eds, row_roots (2k,90), col_roots (2k,90), droot (32,))."""
+    extend = extend_square_fn(k)
+
+    def run(ods: jnp.ndarray):
+        eds = extend(ods)
+        row_ns, col_ns = leaf_namespaces(eds, k)
+        row_roots = tree_roots(row_ns, eds)  # (2k, 90)
+        col_roots = tree_roots(col_ns, eds.transpose(1, 0, 2))
+        droot = merkle_root_pow2(jnp.concatenate([row_roots, col_roots], axis=0))
+        return eds, row_roots, col_roots, droot
+
+    return run
+
+
+@lru_cache(maxsize=None)
+def jit_pipeline(k: int):
+    return jax.jit(_pipeline(k))
+
+
+class ExtendedDataSquare:
+    """Host handle to a device-computed EDS with its NMT roots."""
+
+    def __init__(self, eds, row_roots, col_roots, data_root, k: int):
+        self._eds = eds
+        self._row_roots = row_roots
+        self._col_roots = col_roots
+        self._data_root = data_root
+        self.k = k  # ODS width (original square size)
+
+    @property
+    def width(self) -> int:
+        """EDS width (2k), matching rsmt2d.ExtendedDataSquare.Width()."""
+        return 2 * self.k
+
+    @classmethod
+    def compute(cls, ods: np.ndarray) -> "ExtendedDataSquare":
+        k = ods.shape[0]
+        if k & (k - 1) or not 1 <= k <= MAX_CODEC_SQUARE_SIZE:
+            raise ValueError(f"invalid square size {k}")
+        assert ods.shape == (k, k, SHARE_SIZE), ods.shape
+        eds, rr, cr, droot = jit_pipeline(k)(jnp.asarray(ods, dtype=jnp.uint8))
+        return cls(eds, rr, cr, droot, k)
+
+    # --- rsmt2d-surface accessors (host copies) ---------------------------
+    def squared(self) -> np.ndarray:
+        return np.asarray(self._eds)
+
+    def row(self, i: int) -> np.ndarray:
+        return np.asarray(self._eds[i])
+
+    def col(self, j: int) -> np.ndarray:
+        return np.asarray(self._eds[:, j])
+
+    def flattened_ods(self) -> list[bytes]:
+        q0 = np.asarray(self._eds[: self.k, : self.k])
+        return [q0[i, j].tobytes() for i in range(self.k) for j in range(self.k)]
+
+    def row_roots(self) -> list[bytes]:
+        rr = np.asarray(self._row_roots)
+        return [rr[i].tobytes() for i in range(rr.shape[0])]
+
+    def col_roots(self) -> list[bytes]:
+        cr = np.asarray(self._col_roots)
+        return [cr[i].tobytes() for i in range(cr.shape[0])]
+
+    def data_root(self) -> bytes:
+        return np.asarray(self._data_root).tobytes()
+
+
+def extend_shares(shares: list[bytes]) -> ExtendedDataSquare:
+    """Reference pkg/da/data_availability_header.go:65 ExtendShares parity.
+
+    shares: row-major flattened ODS; length must be a square of a power of
+    two within bounds.
+    """
+    n = len(shares)
+    k = int(round(n ** 0.5))
+    if k * k != n:
+        raise ValueError(f"share count {n} is not a perfect square")
+    if k & (k - 1) or k > MAX_CODEC_SQUARE_SIZE:
+        raise ValueError(f"invalid square size {k}")
+    for i, s in enumerate(shares):
+        if len(s) != SHARE_SIZE:
+            raise ValueError(f"share {i} has length {len(s)}, want {SHARE_SIZE}")
+    ods = np.frombuffer(b"".join(shares), dtype=np.uint8).reshape(k, k, SHARE_SIZE)
+    return ExtendedDataSquare.compute(ods)

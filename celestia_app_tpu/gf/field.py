@@ -57,7 +57,8 @@ class GF:
         """Elementwise GF multiply (broadcasting)."""
         a = np.asarray(a, dtype=np.uint32)
         b = np.asarray(b, dtype=np.uint32)
-        out = self.exp[(self.log[a] + self.log[b]) % (self.order - 1)]
+        # log sums stay below 2*(order-1): the doubled exp table needs no mod
+        out = self.exp[self.log[a] + self.log[b]]
         out = np.where((a == 0) | (b == 0), 0, out)
         return out.astype(self.dtype)
 
@@ -86,7 +87,7 @@ class GF:
         logB = self.log[B]  # (k, p)
         for i in range(k):  # XOR-accumulate one rank-1 GF outer product at a time
             col = A[:, i]  # (n,)
-            prod = self.exp[(self.log[col][:, None] + logB[i][None, :]) % (self.order - 1)]
+            prod = self.exp[self.log[col][:, None] + logB[i][None, :]]
             prod = np.where((col[:, None] == 0) | (B[i][None, :] == 0), 0, prod)
             out ^= prod
         return out.astype(self.dtype)

@@ -1,0 +1,27 @@
+"""Device twin of merkle/: binary Merkle root for power-of-two leaf counts.
+
+Used for the DAH data root (4k row+col roots, always a power of two since k
+is).  For power-of-two n the RFC-6962 split rule halves exactly, so the tree
+is a plain level reduction of batched SHA-256 calls.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from celestia_app_tpu.kernels.sha256 import sha256
+
+
+def merkle_root_pow2(leaves: jnp.ndarray) -> jnp.ndarray:
+    """(N, L) uint8 leaves, N a power of two -> (32,) uint8 root."""
+    n = leaves.shape[0]
+    assert n & (n - 1) == 0 and n > 0, f"leaf count must be a power of two, got {n}"
+    prefix = jnp.zeros((n, 1), dtype=jnp.uint8)
+    level = sha256(jnp.concatenate([prefix, leaves], axis=1))  # (N, 32)
+    while level.shape[0] > 1:
+        m = level.shape[0] // 2
+        msgs = jnp.concatenate(
+            [jnp.ones((m, 1), dtype=jnp.uint8), level[0::2], level[1::2]], axis=1
+        )
+        level = sha256(msgs)
+    return level[0]

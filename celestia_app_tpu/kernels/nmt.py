@@ -1,0 +1,80 @@
+"""Batched NMT construction on device.
+
+Builds all 4k row/column trees of an extended data square in lock-step: one
+fused level-by-level reduction where each level is a single batched SHA-256
+call plus `where`-lane namespace bookkeeping (SURVEY hard part 3).  Digest
+semantics match nmt/hasher.py (pinned against reference
+test/util/malicious/hasher.go:186-310):
+
+    leaf:  ns || ns || sha256(0x00 || ns || data)
+    node:  min || max || sha256(0x01 || left || right)
+    ignore-max rule: right.min == 0xFF^29  =>  parent.max = left.max
+
+Namespace assignment by quadrant (reference pkg/wrapper/nmt_wrapper.go:93-114)
+is done by the caller (da/), which passes the per-leaf namespace array.
+
+Trees are power-of-two sized (2k leaves), so every level halves exactly and
+the loop unrolls at trace time (log2(2k) <= 10 levels).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from celestia_app_tpu.constants import NAMESPACE_SIZE
+from celestia_app_tpu.kernels.sha256 import sha256
+
+_MAX_NS = np.full(NAMESPACE_SIZE, 0xFF, dtype=np.uint8)
+
+
+def leaf_digests(ns: jnp.ndarray, data: jnp.ndarray):
+    """Hash T x L leaves.
+
+    ns: (T, L, 29) uint8, data: (T, L, D) uint8 (the raw shares).
+    Returns (mins, maxs, hashes): (T, L, 29), (T, L, 29), (T, L, 32).
+    """
+    t, l, d = data.shape
+    prefix = jnp.zeros((t * l, 1), dtype=jnp.uint8)
+    msgs = jnp.concatenate(
+        [prefix, ns.reshape(t * l, NAMESPACE_SIZE), data.reshape(t * l, d)], axis=1
+    )
+    hashes = sha256(msgs).reshape(t, l, 32)
+    return ns, ns, hashes
+
+
+def reduce_level(mins, maxs, hashes):
+    """One tree level: (T, L, .) -> (T, L/2, .) for all trees at once."""
+    t, l, _ = hashes.shape
+    lm, ln, lh = mins[:, 0::2], maxs[:, 0::2], hashes[:, 0::2]
+    rm, rn, rh = mins[:, 1::2], maxs[:, 1::2], hashes[:, 1::2]
+    left = jnp.concatenate([lm, ln, lh], axis=2)  # (T, L/2, 90)
+    right = jnp.concatenate([rm, rn, rh], axis=2)
+    prefix = jnp.ones((t * (l // 2), 1), dtype=jnp.uint8)
+    msgs = jnp.concatenate(
+        [prefix, left.reshape(-1, 90), right.reshape(-1, 90)], axis=1
+    )
+    ph = sha256(msgs).reshape(t, l // 2, 32)
+    right_is_parity = jnp.all(rm == jnp.asarray(_MAX_NS), axis=2, keepdims=True)
+    pmax = jnp.where(right_is_parity, ln, rn)
+    return lm, pmax, ph
+
+
+def tree_levels(ns: jnp.ndarray, data: jnp.ndarray):
+    """All digest levels for T trees of L leaves (L a power of two).
+
+    Returns a list of (mins, maxs, hashes) tuples, leaf level first; the last
+    entry has L=1 (the roots).  This is the device-side replacement for the
+    reference's per-row subtree-root cache (pkg/inclusion/nmt_caching.go:80):
+    commitments and proofs index into these arrays instead of locking a map.
+    """
+    levels = [leaf_digests(ns, data)]
+    while levels[-1][2].shape[1] > 1:
+        levels.append(reduce_level(*levels[-1]))
+    return levels
+
+
+def tree_roots(ns: jnp.ndarray, data: jnp.ndarray) -> jnp.ndarray:
+    """(T, L, 29) x (T, L, D) -> (T, 90) namespaced roots."""
+    mins, maxs, hashes = tree_levels(ns, data)[-1]
+    return jnp.concatenate([mins[:, 0], maxs[:, 0], hashes[:, 0]], axis=1)

@@ -1,0 +1,141 @@
+"""Batched fixed-shape SHA-256 on the VPU.
+
+The DA pipeline's hash workload (reference hot loop (2), SURVEY 3.2: 4k NMT
+builds x 2k leaves at k=512 ~ 4.2M compressions per block) is thousands of
+*independent* fixed-length messages - ideal for lane-parallel execution: one
+uint32 lane per message, rounds unrolled, message lengths static so padding
+is a compile-time constant concat.
+
+Replaces Go's crypto/sha256 assembly behind appconsts.NewBaseHashFunc
+(reference pkg/appconsts/global_consts.go:86).  All message shapes used by
+the square pipeline are fixed:
+
+    NMT leaf   0x00 || ns(29) || share(512)        = 542 B -> 9 blocks
+    NMT node   0x01 || left(90) || right(90)       = 181 B -> 3 blocks
+    merkle leaf 0x00 || row-or-col root(90)        =  91 B -> 2 blocks
+    merkle node 0x01 || h(32) || h(32)             =  65 B -> 2 blocks
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_K = np.array(
+    [
+        0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1,
+        0x923F82A4, 0xAB1C5ED5, 0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+        0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174, 0xE49B69C1, 0xEFBE4786,
+        0x0FC19DC6, 0x240CA1CC, 0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+        0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7, 0xC6E00BF3, 0xD5A79147,
+        0x06CA6351, 0x14292967, 0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+        0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85, 0xA2BFE8A1, 0xA81A664B,
+        0xC24B8B70, 0xC76C51A3, 0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+        0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5, 0x391C0CB3, 0x4ED8AA4A,
+        0x5B9CCA4F, 0x682E6FF3, 0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+        0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+    ],
+    dtype=np.uint32,
+)
+
+_H0 = np.array(
+    [0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+     0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19],
+    dtype=np.uint32,
+)
+
+
+def _rotr(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _compress(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
+    """One SHA-256 compression. state: (N, 8) uint32; block: (N, 16) uint32.
+
+    Graph-size-conscious: a fori_loop over 4 chunks of 16 rounds each.
+    Within a chunk every schedule index is static (round r uses w[r]), so the
+    VPU sees straight-line vector code; across chunks the 16-word schedule
+    window is recomputed in place.  ~16x smaller HLO than full unrolling,
+    which keeps AOT warmup of all square sizes off the critical path
+    (SURVEY hard part 4).
+    """
+    k_chunks = jnp.asarray(_K.reshape(4, 16))
+
+    def chunk(c, carry):
+        a, b, cc, d, e, f, g, h, w = carry  # w: (N, 16)
+        kc = k_chunks[c]  # (16,) uint32
+        for r in range(16):
+            s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+            ch = (e & f) ^ (~e & g)
+            t1 = h + s1 + ch + kc[r] + w[:, r]
+            s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+            maj = (a & b) ^ (a & cc) ^ (b & cc)
+            t2 = s0 + maj
+            h, g, f, e, d, cc, b, a = g, f, e, d + t1, cc, b, a, t1 + t2
+        # next 16 schedule words: w'[r] = w[r] + s0(w[r+1]) + w[r+9] + s1(w[r+14])
+        # (indices >= 16 refer to already-updated entries, handled by ordering)
+        ws = [w[:, r] for r in range(16)]
+        for r in range(16):
+            x15 = ws[(r + 1) % 16]
+            x2 = ws[(r + 14) % 16]
+            s0 = _rotr(x15, 7) ^ _rotr(x15, 18) ^ (x15 >> np.uint32(3))
+            s1 = _rotr(x2, 17) ^ _rotr(x2, 19) ^ (x2 >> np.uint32(10))
+            ws[r] = ws[r] + s0 + ws[(r + 9) % 16] + s1
+        return (a, b, cc, d, e, f, g, h, jnp.stack(ws, axis=1))
+
+    n = state.shape[0]
+    init = tuple(state[:, i] for i in range(8)) + (block,)
+    out = jax.lax.fori_loop(0, 4, chunk, init)
+    return state + jnp.stack(out[:8], axis=1)
+
+
+def _pad_tail(length: int) -> np.ndarray:
+    """The constant SHA-256 padding appended to every length-`length` message."""
+    padded = ((length + 9 + 63) // 64) * 64
+    tail = np.zeros(padded - length, dtype=np.uint8)
+    tail[0] = 0x80
+    tail[-8:] = np.frombuffer((length * 8).to_bytes(8, "big"), dtype=np.uint8)
+    return tail
+
+
+def sha256(msgs: jnp.ndarray) -> jnp.ndarray:
+    """Batched SHA-256 over same-length messages: (N, L) uint8 -> (N, 32) uint8.
+
+    L is static (trace-time constant), so padding is a constant-tail concat
+    and the block loop fully unrolls.
+    """
+    n, length = msgs.shape
+    tail = _pad_tail(length)
+    full = jnp.concatenate(
+        [msgs, jnp.broadcast_to(jnp.asarray(tail), (n, len(tail)))], axis=1
+    )
+    nblocks = full.shape[1] // 64
+    # big-endian uint32 words
+    words = full.reshape(n, nblocks, 16, 4).astype(jnp.uint32)
+    words = (
+        (words[..., 0] << np.uint32(24))
+        | (words[..., 1] << np.uint32(16))
+        | (words[..., 2] << np.uint32(8))
+        | words[..., 3]
+    )  # (N, nblocks, 16)
+    state = jnp.broadcast_to(jnp.asarray(_H0), (n, 8))
+    if nblocks == 1:
+        out = _compress(state, words[:, 0])
+    else:
+        # scan over blocks: graph size independent of message length
+        out, _ = jax.lax.scan(
+            lambda s, blk: (_compress(s, blk), None),
+            state,
+            words.transpose(1, 0, 2),
+        )
+    # back to big-endian bytes
+    shifts = np.uint32(8) * np.arange(3, -1, -1, dtype=np.uint32)
+    by = (out[..., None] >> shifts) & np.uint32(0xFF)
+    return by.astype(jnp.uint8).reshape(n, 32)
+
+
+def sha256_bytes(data: bytes) -> bytes:
+    """Single-message host convenience (used by tests/tools, not hot paths)."""
+    out = sha256(jnp.frombuffer(data, dtype=jnp.uint8).reshape(1, -1))
+    return bytes(np.asarray(out)[0])

@@ -1,0 +1,83 @@
+"""Plain binary Merkle tree (RFC-6962 style), host side.
+
+Parity with the reference's go-square/merkle (used for the DAH data root,
+pkg/da/data_availability_header.go:100-107, and tx share commitments):
+
+    empty root = sha256("")
+    leaf       = sha256(0x00 || data)
+    inner      = sha256(0x01 || left || right)
+    split point = largest power of two strictly less than n
+
+The device twin for power-of-two leaf counts lives in kernels/merkle.py.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+LEAF_PREFIX = b"\x00"
+INNER_PREFIX = b"\x01"
+
+
+def leaf_hash(data: bytes) -> bytes:
+    return hashlib.sha256(LEAF_PREFIX + data).digest()
+
+
+def inner_hash(left: bytes, right: bytes) -> bytes:
+    return hashlib.sha256(INNER_PREFIX + left + right).digest()
+
+
+def split_point(n: int) -> int:
+    """Largest power of two strictly less than n (n >= 2)."""
+    p = 1 << (n - 1).bit_length() - 1
+    return p if p < n else p // 2
+
+
+def hash_from_byte_slices(items: list[bytes]) -> bytes:
+    """Merkle root of a list of byte slices."""
+    n = len(items)
+    if n == 0:
+        return hashlib.sha256(b"").digest()
+    if n == 1:
+        return leaf_hash(items[0])
+    k = split_point(n)
+    return inner_hash(hash_from_byte_slices(items[:k]), hash_from_byte_slices(items[k:]))
+
+
+def proof(items: list[bytes], index: int) -> list[bytes]:
+    """Audit path (sibling hashes, leaf-to-root) for items[index]."""
+    n = len(items)
+    if not 0 <= index < n:
+        raise IndexError(index)
+    if n == 1:
+        return []
+    k = split_point(n)
+    if index < k:
+        return proof(items[:k], index) + [hash_from_byte_slices(items[k:])]
+    return proof(items[k:], index - k) + [hash_from_byte_slices(items[:k])]
+
+
+def compute_root_from_path(index: int, total: int, leaf_h: bytes, path: list[bytes]) -> bytes:
+    """Root implied by a leaf hash and its audit path (leaf-to-root order)."""
+    if total <= 0 or not 0 <= index < total:
+        raise ValueError(f"bad index {index} / total {total}")
+    if total == 1:
+        if path:
+            raise ValueError("path too long")
+        return leaf_h
+    if not path:
+        raise ValueError("path too short")
+    k = split_point(total)
+    if index < k:
+        left = compute_root_from_path(index, k, leaf_h, path[:-1])
+        return inner_hash(left, path[-1])
+    right = compute_root_from_path(index - k, total - k, leaf_h, path[:-1])
+    return inner_hash(path[-1], right)
+
+
+def verify_proof(root: bytes, leaf: bytes, index: int, total: int, path: list[bytes]) -> bool:
+    """Verify an audit path produced by `proof`."""
+    try:
+        return compute_root_from_path(index, total, leaf_hash(leaf), path) == root
+    except ValueError:
+        return False

@@ -1,0 +1,13 @@
+"""Namespaced Merkle Trees.
+
+Host-side reference implementation (hasher semantics matching the reference's
+nmt dep, pinned by reference test/util/malicious/hasher.go:186-310) plus the
+batched device kernel in kernels/nmt.py.  The host tree is the oracle and the
+proof engine; the device kernel produces the same digests for 4k trees at
+once.
+"""
+
+from celestia_app_tpu.nmt.hasher import NmtHasher, MAX_NAMESPACE
+from celestia_app_tpu.nmt.tree import NamespacedMerkleTree
+
+__all__ = ["NmtHasher", "NamespacedMerkleTree", "MAX_NAMESPACE"]

@@ -92,6 +92,10 @@ class Namespace:
     def is_pay_for_blob(self) -> bool:
         return self == PAY_FOR_BLOB_NAMESPACE
 
+    def is_compact(self) -> bool:
+        """Compact (tx/PFB) namespaces carry reserved bytes in their shares."""
+        return self.is_tx() or self.is_pay_for_blob()
+
     def is_tx(self) -> bool:
         return self == TRANSACTION_NAMESPACE
 

@@ -73,7 +73,7 @@ class Share:
 
     def is_compact(self) -> bool:
         ns = self.namespace()
-        return ns.is_tx() or ns.is_pay_for_blob()
+        return ns.is_compact()
 
     def reserved_bytes(self) -> int:
         """Big-endian uint32 index of the first unit starting in this (compact) share."""
@@ -137,7 +137,7 @@ def padding_share(namespace: Namespace, share_version: int = SHARE_VERSION_ZERO)
     inside the compact tx/PFB runs, and a compact-namespace share without
     reserved bytes would be malformed.
     """
-    if namespace.is_tx() or namespace.is_pay_for_blob():
+    if namespace.is_compact():
         raise ValueError(f"padding shares cannot use compact namespace {namespace}")
     buf = _build_prefix(namespace, share_version, True, 0)
     buf += bytes(SHARE_SIZE - len(buf))

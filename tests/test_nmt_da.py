@@ -1,0 +1,151 @@
+"""NMT, merkle, and DA-layer tests: device kernels vs host oracles."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu import merkle
+from celestia_app_tpu.constants import NAMESPACE_SIZE, SHARE_SIZE
+from celestia_app_tpu.da import (
+    DataAvailabilityHeader,
+    ExtendedDataSquare,
+    extend_shares,
+    min_data_availability_header,
+)
+from celestia_app_tpu.gf import codec_for_width
+from celestia_app_tpu.nmt import MAX_NAMESPACE, NamespacedMerkleTree, NmtHasher
+
+RNG = np.random.default_rng(99)
+
+
+def random_square(k: int) -> np.ndarray:
+    """A namespace-ordered random ODS (k, k, SHARE_SIZE)."""
+    n = k * k
+    # sorted non-parity namespaces, then random share bodies
+    ns = np.sort(RNG.integers(0, 200, n).astype(np.uint8))
+    ods = RNG.integers(0, 256, (n, SHARE_SIZE), dtype=np.uint8)
+    ods[:, :NAMESPACE_SIZE] = 0
+    ods[:, NAMESPACE_SIZE - 1] = ns  # 29-byte ns: zeros + 1 varying byte
+    return ods.reshape(k, k, SHARE_SIZE)
+
+
+class TestMerkle:
+    def test_empty_and_single(self):
+        assert merkle.hash_from_byte_slices([]) == hashlib.sha256(b"").digest()
+        leaf = b"hello"
+        assert merkle.hash_from_byte_slices([leaf]) == hashlib.sha256(b"\x00" + leaf).digest()
+
+    def test_split_point(self):
+        assert [merkle.split_point(n) for n in (2, 3, 4, 5, 8, 9)] == [1, 2, 2, 4, 4, 8]
+
+    @pytest.mark.parametrize("n", [1, 2, 3, 5, 8, 13, 16])
+    def test_proofs_roundtrip(self, n):
+        items = [RNG.integers(0, 256, 90, dtype=np.uint8).tobytes() for _ in range(n)]
+        root = merkle.hash_from_byte_slices(items)
+        for i in range(n):
+            path = merkle.proof(items, i)
+            assert merkle.verify_proof(root, items[i], i, n, path)
+            if n > 1:
+                assert not merkle.verify_proof(root, b"wrong", i, n, path)
+                assert not merkle.verify_proof(root, items[i], (i + 1) % n, n, path)
+
+
+class TestNmtHost:
+    def test_leaf_digest_shape_and_ns(self):
+        ndata = b"\x07" * NAMESPACE_SIZE + b"payload"
+        d = NmtHasher.hash_leaf(ndata)
+        assert len(d) == 90
+        assert NmtHasher.min_namespace(d) == NmtHasher.max_namespace(d) == b"\x07" * 29
+        assert d[58:] == hashlib.sha256(b"\x00" + ndata).digest()
+
+    def test_node_ignore_max_namespace(self):
+        l = NmtHasher.hash_leaf(b"\x01" * 29 + b"a")
+        r_parity = NmtHasher.hash_leaf(MAX_NAMESPACE + b"b")
+        node = NmtHasher.hash_node(l, r_parity)
+        assert NmtHasher.min_namespace(node) == b"\x01" * 29
+        assert NmtHasher.max_namespace(node) == b"\x01" * 29  # parity ignored
+        r_normal = NmtHasher.hash_leaf(b"\x02" * 29 + b"b")
+        node2 = NmtHasher.hash_node(l, r_normal)
+        assert NmtHasher.max_namespace(node2) == b"\x02" * 29
+
+    def test_node_rejects_unordered(self):
+        l = NmtHasher.hash_leaf(b"\x05" * 29 + b"a")
+        r = NmtHasher.hash_leaf(b"\x01" * 29 + b"b")
+        with pytest.raises(ValueError):
+            NmtHasher.hash_node(l, r)
+
+    def test_tree_push_order_enforced(self):
+        t = NamespacedMerkleTree()
+        t.push(b"\x03" * 29 + b"x")
+        with pytest.raises(ValueError):
+            t.push(b"\x01" * 29 + b"y")
+
+    def test_subtree_root_alignment(self):
+        t = NamespacedMerkleTree()
+        for i in range(8):
+            t.push(bytes([0] * 28 + [i]) + b"data")
+        lv = t.levels()
+        assert len(lv) == 4 and len(lv[-1]) == 1
+        assert t.subtree_root(0, 8) == t.root()
+        assert t.subtree_root(2, 4) == lv[1][1]
+        with pytest.raises(ValueError):
+            t.subtree_root(1, 3)
+
+
+@pytest.mark.parametrize("k", [1, 2, 8, 16], ids=lambda k: f"k{k}")
+class TestEdsPipeline:
+    def test_roots_match_host_oracle(self, k):
+        ods = random_square(k)
+        eds = ExtendedDataSquare.compute(ods)
+        sq = eds.squared()
+        codec = codec_for_width(k)
+        parity_ns = MAX_NAMESPACE
+
+        # host oracle: build each row/col tree with the reference hasher
+        for i in range(2 * k):
+            t = NamespacedMerkleTree()
+            for j in range(2 * k):
+                share = sq[i, j].tobytes()
+                ns = share[:NAMESPACE_SIZE] if (i < k and j < k) else parity_ns
+                t.push(ns + share)
+            assert eds.row_roots()[i] == t.root(), f"row {i}"
+        for j in range(2 * k):
+            t = NamespacedMerkleTree()
+            for i in range(2 * k):
+                share = sq[i, j].tobytes()
+                ns = share[:NAMESPACE_SIZE] if (i < k and j < k) else parity_ns
+                t.push(ns + share)
+            assert eds.col_roots()[j] == t.root(), f"col {j}"
+
+        # data root matches the host merkle over roots
+        dah = DataAvailabilityHeader.from_eds(eds)
+        assert dah.hash() == eds.data_root()
+        dah.validate_basic()
+        assert dah.square_size() == k
+
+        # RS extension consistent with the codec oracle
+        assert np.array_equal(sq[0], codec.extend(ods[0]))
+
+    def test_extend_shares_roundtrip(self, k):
+        ods = random_square(k)
+        shares = [ods.reshape(-1, SHARE_SIZE)[i].tobytes() for i in range(k * k)]
+        eds = extend_shares(shares)
+        assert eds.flattened_ods() == shares
+        assert eds.width == 2 * k
+
+
+def test_min_dah_deterministic():
+    a = min_data_availability_header()
+    b = min_data_availability_header()
+    assert a.equals(b)
+    assert len(a.hash()) == 32
+    a.validate_basic()
+
+
+def test_extend_shares_rejects_bad_counts():
+    share = bytes(SHARE_SIZE)
+    with pytest.raises(ValueError):
+        extend_shares([share] * 3)  # not a perfect square
+    with pytest.raises(ValueError):
+        extend_shares([share] * 9)  # 3x3: not a power of two
