@@ -34,6 +34,12 @@ CONTINUATION_SPARSE_SHARE_CONTENT_SIZE = SHARE_SIZE - NAMESPACE_SIZE - SHARE_INF
 MIN_SQUARE_SIZE = 1
 MIN_SHARE_COUNT = MIN_SQUARE_SIZE * MIN_SQUARE_SIZE
 
+# The parity-share namespace (29 x 0xFF): assigned to every erasure-coded
+# leaf outside Q0 and the trigger for the NMT ignore-max rule.  Single
+# source of truth — shares.PARITY_SHARE_NAMESPACE and all device kernels
+# derive from this.
+PARITY_NAMESPACE_BYTES = bytes([0xFF]) * NAMESPACE_SIZE
+
 # --- hashing ---
 HASH_LENGTH = 32  # SHA-256
 NMT_NODE_SIZE = 2 * NAMESPACE_SIZE + HASH_LENGTH  # 90: minNs || maxNs || digest

@@ -18,10 +18,11 @@ import numpy as np
 from celestia_app_tpu.constants import (
     MAX_CODEC_SQUARE_SIZE,
     NAMESPACE_SIZE,
+    PARITY_NAMESPACE_BYTES,
     SHARE_SIZE,
 )
 from celestia_app_tpu.kernels.merkle import merkle_root_pow2
-from celestia_app_tpu.kernels.nmt import tree_roots
+from celestia_app_tpu.kernels.nmt import leaf_digests, tree_roots_from_digests
 from celestia_app_tpu.kernels.rs import extend_square_fn
 
 
@@ -37,7 +38,7 @@ def leaf_namespaces(eds: jnp.ndarray, k: int) -> tuple[jnp.ndarray, jnp.ndarray]
     share_ns = eds[..., :NAMESPACE_SIZE]  # (2k, 2k, 29)
     idx = jnp.arange(n)
     q0 = (idx[:, None] < k) & (idx[None, :] < k)  # (2k, 2k)
-    parity = jnp.full((NAMESPACE_SIZE,), 0xFF, dtype=jnp.uint8)
+    parity = jnp.frombuffer(PARITY_NAMESPACE_BYTES, dtype=jnp.uint8)
     row_ns = jnp.where(q0[..., None], share_ns, parity)
     col_ns = row_ns.transpose(1, 0, 2)
     return row_ns, col_ns
@@ -49,9 +50,18 @@ def _pipeline(k: int):
 
     def run(ods: jnp.ndarray):
         eds = extend(ods)
-        row_ns, col_ns = leaf_namespaces(eds, k)
-        row_roots = tree_roots(row_ns, eds)  # (2k, 90)
-        col_roots = tree_roots(col_ns, eds.transpose(1, 0, 2))
+        row_ns, _ = leaf_namespaces(eds, k)
+        # The leaf digest at (i, j) is identical for the row-i tree and the
+        # col-j tree (same namespace, same share), so hash the (2k, 2k) leaf
+        # grid once and feed the column reduction its transpose.  Leaf hashes
+        # are 9 SHA-256 blocks each vs 3 for inner nodes — this halves the
+        # dominant hash cost.
+        mins, maxs, hashes = leaf_digests(row_ns, eds)
+        row_roots = tree_roots_from_digests(mins, maxs, hashes)  # (2k, 90)
+        col_roots = tree_roots_from_digests(
+            mins.transpose(1, 0, 2), maxs.transpose(1, 0, 2),
+            hashes.transpose(1, 0, 2),
+        )
         droot = merkle_root_pow2(jnp.concatenate([row_roots, col_roots], axis=0))
         return eds, row_roots, col_roots, droot
 

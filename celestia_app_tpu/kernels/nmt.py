@@ -22,10 +22,10 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
-from celestia_app_tpu.constants import NAMESPACE_SIZE
+from celestia_app_tpu.constants import NAMESPACE_SIZE, PARITY_NAMESPACE_BYTES
 from celestia_app_tpu.kernels.sha256 import sha256
 
-_MAX_NS = np.full(NAMESPACE_SIZE, 0xFF, dtype=np.uint8)
+_MAX_NS = np.frombuffer(PARITY_NAMESPACE_BYTES, dtype=np.uint8)
 
 
 def leaf_digests(ns: jnp.ndarray, data: jnp.ndarray):
@@ -60,21 +60,36 @@ def reduce_level(mins, maxs, hashes):
     return lm, pmax, ph
 
 
-def tree_levels(ns: jnp.ndarray, data: jnp.ndarray):
-    """All digest levels for T trees of L leaves (L a power of two).
+def tree_levels_from_digests(mins, maxs, hashes):
+    """Reduce T trees level-by-level starting from precomputed leaf digests.
 
     Returns a list of (mins, maxs, hashes) tuples, leaf level first; the last
     entry has L=1 (the roots).  This is the device-side replacement for the
     reference's per-row subtree-root cache (pkg/inclusion/nmt_caching.go:80):
     commitments and proofs index into these arrays instead of locking a map.
     """
-    levels = [leaf_digests(ns, data)]
+    levels = [(mins, maxs, hashes)]
     while levels[-1][2].shape[1] > 1:
         levels.append(reduce_level(*levels[-1]))
     return levels
 
 
+def tree_levels(ns: jnp.ndarray, data: jnp.ndarray):
+    """All digest levels for T trees of L leaves (L a power of two)."""
+    return tree_levels_from_digests(*leaf_digests(ns, data))
+
+
+def roots_from_levels(levels) -> jnp.ndarray:
+    """Last level (L=1) -> (T, 90) namespaced roots."""
+    mins, maxs, hashes = levels[-1]
+    return jnp.concatenate([mins[:, 0], maxs[:, 0], hashes[:, 0]], axis=1)
+
+
+def tree_roots_from_digests(mins, maxs, hashes) -> jnp.ndarray:
+    """(T, L, 29)^2 x (T, L, 32) leaf digests -> (T, 90) namespaced roots."""
+    return roots_from_levels(tree_levels_from_digests(mins, maxs, hashes))
+
+
 def tree_roots(ns: jnp.ndarray, data: jnp.ndarray) -> jnp.ndarray:
     """(T, L, 29) x (T, L, D) -> (T, 90) namespaced roots."""
-    mins, maxs, hashes = tree_levels(ns, data)[-1]
-    return jnp.concatenate([mins[:, 0], maxs[:, 0], hashes[:, 0]], axis=1)
+    return roots_from_levels(tree_levels(ns, data))

@@ -17,11 +17,11 @@ from __future__ import annotations
 
 import hashlib
 
-from celestia_app_tpu.constants import NAMESPACE_SIZE, NMT_NODE_SIZE
+from celestia_app_tpu.constants import PARITY_NAMESPACE_BYTES, NAMESPACE_SIZE, NMT_NODE_SIZE
 
 LEAF_PREFIX = b"\x00"
 NODE_PREFIX = b"\x01"
-MAX_NAMESPACE = b"\xff" * NAMESPACE_SIZE
+MAX_NAMESPACE = PARITY_NAMESPACE_BYTES
 
 
 class NmtHasher:

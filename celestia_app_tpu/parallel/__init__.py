@@ -1,0 +1,9 @@
+"""Multi-chip parallelism: sharded EDS construction over a device mesh."""
+
+from celestia_app_tpu.parallel.sharded_eds import (
+    default_mesh,
+    make_sharded_pipeline,
+    sharded_extend_and_dah,
+)
+
+__all__ = ["default_mesh", "make_sharded_pipeline", "sharded_extend_and_dah"]

@@ -12,6 +12,7 @@ from celestia_app_tpu.constants import (
     NAMESPACE_ID_SIZE,
     NAMESPACE_SIZE,
     NAMESPACE_VERSION_SIZE,
+    PARITY_NAMESPACE_BYTES,
 )
 
 NAMESPACE_VERSION_ZERO = 0
@@ -134,4 +135,5 @@ MIN_SECONDARY_RESERVED_NAMESPACE = _secondary(0x00)
 TAIL_PADDING_NAMESPACE = _secondary(0xFE)
 PARITY_SHARE_NAMESPACE = _secondary(0xFF)
 
-PARITY_NS_BYTES = PARITY_SHARE_NAMESPACE.to_bytes()  # 29 x 0xFF
+PARITY_NS_BYTES = PARITY_SHARE_NAMESPACE.to_bytes()
+assert PARITY_NS_BYTES == PARITY_NAMESPACE_BYTES

@@ -3,12 +3,20 @@
 Real-TPU execution is exercised by bench.py / the driver; unit and sharding
 tests run everywhere on the host platform with 8 virtual devices so that
 multi-chip code paths (shard_map over a Mesh) are tested without hardware.
-Must run before the first `import jax` anywhere in the test session.
+
+The environment may pre-register an accelerator platform (JAX_PLATFORMS set
+by a sitecustomize before pytest starts), so a setdefault is not enough: we
+overwrite the env var AND pin the live config before any backend client is
+created.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
