@@ -1,0 +1,117 @@
+"""Benchmark: MB/s erasure-extended + DAH-hashed per chip (BASELINE.json metric).
+
+Measures the fused device pipeline (RS 2D extension + 4k NMT roots + DAH data
+root; reference hot path app/prepare_proposal.go:61-71) end to end — host
+ODS in, data root back on host — and compares against the straightforward
+host-CPU path (numpy GF Reed-Solomon + hashlib SHA-256 NMTs), the in-image
+proxy for the reference's Go leopard + crypto/sha256 implementation.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": MB/s, "unit": "MB/s", "vs_baseline": x}
+
+Env knobs: BENCH_K (square size, default 128), BENCH_ITERS (default 5),
+BENCH_BASELINE_S (skip the CPU run, use the given seconds/block).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def _random_ods(k: int, seed: int = 3) -> np.ndarray:
+    from celestia_app_tpu.constants import NAMESPACE_SIZE, SHARE_SIZE
+
+    rng = np.random.default_rng(seed)
+    n = k * k
+    ns = np.sort(rng.integers(0, 200, n).astype(np.uint8))
+    ods = rng.integers(0, 256, (n, SHARE_SIZE), dtype=np.uint8)
+    ods[:, :NAMESPACE_SIZE] = 0
+    ods[:, NAMESPACE_SIZE - 1] = ns
+    return ods.reshape(k, k, SHARE_SIZE)
+
+
+def _device_seconds_per_block(ods: np.ndarray, iters: int) -> float:
+    """Full offload round trip: host ODS -> device pipeline -> host data root."""
+    import jax
+
+    from celestia_app_tpu.da.eds import ExtendedDataSquare
+
+    ExtendedDataSquare.compute(ods).data_root()  # warmup / compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        eds = ExtendedDataSquare.compute(ods)
+        eds.data_root()
+    jax.effects_barrier()
+    return (time.perf_counter() - t0) / iters
+
+
+def _host_seconds_per_block(ods: np.ndarray) -> float:
+    """CPU reference path: numpy GF RS extension + hashlib SHA-256 NMT trees."""
+    from celestia_app_tpu.constants import NAMESPACE_SIZE, PARITY_NAMESPACE_BYTES
+    from celestia_app_tpu.gf import codec_for_width
+    from celestia_app_tpu.merkle import hash_from_byte_slices
+    from celestia_app_tpu.nmt.hasher import NmtHasher
+
+    k = ods.shape[0]
+    codec = codec_for_width(k)
+    t0 = time.perf_counter()
+    row_parity = np.stack([codec.encode(ods[i]) for i in range(k)])
+    top = np.concatenate([ods, row_parity], axis=1)  # (k, 2k, S)
+    col_parity = np.stack([codec.encode(top[:, j]) for j in range(2 * k)], axis=1)
+    eds = np.concatenate([top, col_parity], axis=0)  # (2k, 2k, S)
+
+    parity = PARITY_NAMESPACE_BYTES
+
+    def axis_roots(mat: np.ndarray) -> list[bytes]:
+        roots = []
+        for i in range(2 * k):
+            digests = []
+            for j in range(2 * k):
+                share = mat[i, j].tobytes()
+                in_q0 = i < k and j < k
+                ns = share[:NAMESPACE_SIZE] if in_q0 else parity
+                digests.append(NmtHasher.hash_leaf(ns + share))
+            while len(digests) > 1:
+                digests = [
+                    NmtHasher.hash_node(digests[t], digests[t + 1])
+                    for t in range(0, len(digests), 2)
+                ]
+            roots.append(digests[0])
+        return roots
+
+    row_roots = axis_roots(eds)
+    col_roots = axis_roots(eds.transpose(1, 0, 2))
+    hash_from_byte_slices(row_roots + col_roots)
+    return time.perf_counter() - t0
+
+
+def main() -> None:
+    k = int(os.environ.get("BENCH_K", "128"))
+    iters = int(os.environ.get("BENCH_ITERS", "5"))
+    ods = _random_ods(k)
+    ods_mb = ods.nbytes / 1e6
+
+    dev_s = _device_seconds_per_block(ods, iters)
+    base_env = os.environ.get("BENCH_BASELINE_S")
+    host_s = float(base_env) if base_env else _host_seconds_per_block(ods)
+
+    value = ods_mb / dev_s
+    baseline = ods_mb / host_s
+    print(
+        json.dumps(
+            {
+                "metric": f"ODS MB/s erasure-extended + DAH-hashed per chip (k={k})",
+                "value": round(value, 3),
+                "unit": "MB/s",
+                "vs_baseline": round(value / baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

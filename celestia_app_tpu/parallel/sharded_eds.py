@@ -104,18 +104,17 @@ def make_sharded_pipeline(k: int, mesh: Mesh, axis: str = "data"):
         col_roots_local = tree_roots_from_digests(lmins, lmins, lhash)
 
         # P4 again: back to row sharding for the row trees and the output.
-        rows_blk = lax.all_to_all(
-            full_cols.transpose(1, 0, 2), axis, split_axis=0, concat_axis=1,
-            tiled=True,
-        )  # (2k/n, 2k, S) — this device's EDS row block.
-
-        leaf_pack = jnp.concatenate([lmins, lhash], axis=2)  # (2k/n, 2k, 61)
+        # Shares and leaf digests ride one fused all_to_all: concatenate the
+        # 61-byte (ns, digest) packs onto the 512-byte shares so the reshard
+        # is a single ICI collective instead of two.
+        leaf_pack = jnp.concatenate([full_cols, lmins, lhash], axis=2)
         row_pack = lax.all_to_all(
             leaf_pack.transpose(1, 0, 2), axis, split_axis=0, concat_axis=1,
             tiled=True,
-        )  # (2k/n, 2k, 61) — leaf digests of this device's rows.
-        rmins = row_pack[..., :NAMESPACE_SIZE]
-        rhash = row_pack[..., NAMESPACE_SIZE:]
+        )  # (2k/n, 2k, S+61) — this device's EDS row block + leaf digests.
+        rows_blk = row_pack[..., :SHARE_SIZE]
+        rmins = row_pack[..., SHARE_SIZE : SHARE_SIZE + NAMESPACE_SIZE]
+        rhash = row_pack[..., SHARE_SIZE + NAMESPACE_SIZE :]
         row_roots_local = tree_roots_from_digests(rmins, rmins, rhash)
 
         return rows_blk, row_roots_local, col_roots_local

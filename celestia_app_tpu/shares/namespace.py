@@ -136,4 +136,5 @@ TAIL_PADDING_NAMESPACE = _secondary(0xFE)
 PARITY_SHARE_NAMESPACE = _secondary(0xFF)
 
 PARITY_NS_BYTES = PARITY_SHARE_NAMESPACE.to_bytes()
-assert PARITY_NS_BYTES == PARITY_NAMESPACE_BYTES
+if PARITY_NS_BYTES != PARITY_NAMESPACE_BYTES:
+    raise AssertionError("PARITY_SHARE_NAMESPACE diverged from constants.PARITY_NAMESPACE_BYTES")

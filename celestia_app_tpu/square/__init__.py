@@ -1,0 +1,27 @@
+from celestia_app_tpu.square.builder import (
+    BlobPlacement,
+    Builder,
+    Square,
+    SquareOverflow,
+    build,
+    construct,
+)
+from celestia_app_tpu.square.layout import (
+    blob_min_square_size,
+    next_share_index,
+    round_up_power_of_two,
+    subtree_width,
+)
+
+__all__ = [
+    "BlobPlacement",
+    "Builder",
+    "Square",
+    "SquareOverflow",
+    "build",
+    "construct",
+    "blob_min_square_size",
+    "next_share_index",
+    "round_up_power_of_two",
+    "subtree_width",
+]

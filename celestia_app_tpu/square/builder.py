@@ -1,0 +1,305 @@
+"""Deterministic square construction: Build (proposer) / Construct (validator).
+
+Behavioral parity with the go-square Builder as driven by the reference app
+(square.Build at app/prepare_proposal.go:50, square.Construct at
+app/process_proposal.go:122 and app/extend_block.go:16):
+
+  * the square holds, in order: normal txs (compact shares, TRANSACTION
+    namespace), PFB txs wrapped as IndexWrappers (compact shares,
+    PAY_FOR_BLOB namespace), primary-reserved padding, blobs sorted by
+    namespace (stable in PFB order within a namespace) at subtree-aligned
+    start indexes, namespace padding between blobs, tail padding to k*k;
+  * blob start alignment follows the non-interactive default rules
+    (layout.next_share_index), independent of the square size;
+  * the square size is the smallest power of two that fits.
+
+The one place this construction is self-referential: blob start indexes are
+written into the PFB IndexWrappers, whose byte length changes the compact
+share count, which moves the blob starts.  We resolve the fixpoint by
+seeding every index at its upper bound and iterating; sizes only shrink, so
+the iteration converges and both Build and Construct land on the identical
+layout.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from celestia_app_tpu.constants import SUBTREE_ROOT_THRESHOLD
+from celestia_app_tpu.shares.compact import (
+    compact_shares_needed,
+    split_txs,
+    tx_sequence_len,
+)
+from celestia_app_tpu.shares.namespace import (
+    PAY_FOR_BLOB_NAMESPACE,
+    TRANSACTION_NAMESPACE,
+)
+from celestia_app_tpu.shares.share import (
+    Share,
+    reserved_padding_shares,
+    tail_padding_shares,
+)
+from celestia_app_tpu.shares.sparse import SparseShareSplitter
+from celestia_app_tpu.square.layout import next_share_index, round_up_power_of_two
+from celestia_app_tpu.tx.envelopes import (
+    BlobTx,
+    IndexWrapper,
+    unmarshal_blob_tx,
+)
+
+
+@dataclass(frozen=True)
+class BlobPlacement:
+    """Where one blob landed in the square."""
+
+    pfb_index: int  # index into the builder's blob-tx list
+    blob_index: int  # index within that blob tx
+    start: int  # first share index (row-major)
+    share_count: int
+
+
+@dataclass(frozen=True)
+class _Layout:
+    size: int  # square size k
+    tx_share_count: int
+    pfb_share_count: int
+    wrapped_pfbs: tuple[bytes, ...]
+    placements: tuple[BlobPlacement, ...]
+    end: int  # share index one past the last non-tail-padding share
+
+
+class SquareOverflow(ValueError):
+    """The content does not fit in the maximum square size."""
+
+
+class Square:
+    """An immutable k x k square of shares plus its layout metadata."""
+
+    def __init__(self, shares: list[Share], layout: _Layout):
+        self.shares = shares
+        self.size = layout.size
+        self._layout = layout
+
+    def __len__(self) -> int:
+        return len(self.shares)
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, Square)
+            and self.size == other.size
+            and [s.raw for s in self.shares] == [s.raw for s in other.shares]
+        )
+
+    def share_bytes(self) -> list[bytes]:
+        return [s.raw for s in self.shares]
+
+    def is_empty(self) -> bool:
+        return self._layout.end == 0
+
+    @property
+    def tx_share_range(self) -> tuple[int, int]:
+        return (0, self._layout.tx_share_count)
+
+    @property
+    def pfb_share_range(self) -> tuple[int, int]:
+        lo = self._layout.tx_share_count
+        return (lo, lo + self._layout.pfb_share_count)
+
+    @property
+    def placements(self) -> tuple[BlobPlacement, ...]:
+        return self._layout.placements
+
+    def blob_share_range(self, pfb_index: int, blob_index: int) -> tuple[int, int]:
+        for p in self._layout.placements:
+            if p.pfb_index == pfb_index and p.blob_index == blob_index:
+                return (p.start, p.start + p.share_count)
+        raise KeyError(f"no blob ({pfb_index}, {blob_index}) in square")
+
+    def wrapped_pfb_txs(self) -> tuple[bytes, ...]:
+        """The IndexWrapper bytes committed in the PAY_FOR_BLOB shares."""
+        return self._layout.wrapped_pfbs
+
+
+class Builder:
+    """Accumulates txs and blob txs; exports the deterministic square."""
+
+    def __init__(
+        self,
+        max_square_size: int,
+        subtree_root_threshold: int = SUBTREE_ROOT_THRESHOLD,
+    ):
+        if max_square_size < 1 or max_square_size & (max_square_size - 1):
+            raise ValueError(f"max square size must be a power of two: {max_square_size}")
+        self.max_square_size = max_square_size
+        self.subtree_root_threshold = subtree_root_threshold
+        self._txs: list[bytes] = []
+        self._blob_txs: list[BlobTx] = []
+
+    # --- append (greedy fit checks) ---------------------------------------
+    def append_tx(self, tx: bytes) -> bool:
+        self._txs.append(tx)
+        if self._fits():
+            return True
+        self._txs.pop()
+        return False
+
+    def append_blob_tx(self, btx: BlobTx) -> bool:
+        self._blob_txs.append(btx)
+        if self._fits():
+            return True
+        self._blob_txs.pop()
+        return False
+
+    def _fits(self) -> bool:
+        try:
+            self._solve()
+            return True
+        except SquareOverflow:
+            return False
+
+    # --- layout -----------------------------------------------------------
+    def _solve(self) -> _Layout:
+        tx_shares = compact_shares_needed(tx_sequence_len(self._txs))
+
+        # All blobs in placement order: sorted by namespace, stable in
+        # (pfb, blob) order (priority within a namespace is submission order;
+        # spec data_square_layout.md "Ordering").
+        indexed_blobs = [
+            (ti, bi, blob)
+            for ti, btx in enumerate(self._blob_txs)
+            for bi, blob in enumerate(btx.blobs)
+        ]
+        order = sorted(
+            range(len(indexed_blobs)),
+            key=lambda i: indexed_blobs[i][2].namespace.to_bytes(),
+        )
+
+        # Fixpoint: seed every share index at its upper bound so wrapper
+        # sizes start maximal and only shrink.
+        bound = self.max_square_size * self.max_square_size
+        starts: dict[tuple[int, int], int] = {
+            (ti, bi): bound for ti, bi, _ in indexed_blobs
+        }
+        for _ in range(32):
+            wrapped = tuple(
+                IndexWrapper(
+                    btx.tx,
+                    tuple(starts[(ti, bi)] for bi in range(len(btx.blobs))),
+                ).marshal()
+                for ti, btx in enumerate(self._blob_txs)
+            )
+            pfb_shares = compact_shares_needed(tx_sequence_len(list(wrapped)))
+            cursor = tx_shares + pfb_shares
+            new_starts: dict[tuple[int, int], int] = {}
+            placements: list[BlobPlacement] = []
+            for oi in order:
+                ti, bi, blob = indexed_blobs[oi]
+                count = blob.share_count()
+                start = next_share_index(cursor, count, self.subtree_root_threshold)
+                new_starts[(ti, bi)] = start
+                placements.append(BlobPlacement(ti, bi, start, count))
+                cursor = start + count
+            if new_starts == starts:
+                break
+            starts = new_starts
+        else:  # pragma: no cover - the monotone iteration always converges
+            raise RuntimeError("square layout fixpoint did not converge")
+
+        end = cursor
+        size = max(1, round_up_power_of_two(math.isqrt(max(end - 1, 0)) + 1))
+        if size > self.max_square_size:
+            raise SquareOverflow(
+                f"content needs square size {size} > max {self.max_square_size}"
+            )
+        return _Layout(
+            size=size,
+            tx_share_count=tx_shares,
+            pfb_share_count=pfb_shares,
+            wrapped_pfbs=wrapped,
+            placements=tuple(placements),
+            end=end,
+        )
+
+    def export(self) -> Square:
+        layout = self._solve()
+        shares: list[Share] = []
+        shares += split_txs(self._txs, TRANSACTION_NAMESPACE)
+        shares += split_txs(list(layout.wrapped_pfbs), PAY_FOR_BLOB_NAMESPACE)
+        assert len(shares) == layout.tx_share_count + layout.pfb_share_count
+
+        if layout.placements:
+            first_start = layout.placements[0].start
+            shares += reserved_padding_shares(first_start - len(shares))
+            sparse = SparseShareSplitter()
+            cursor = first_start
+            for p in layout.placements:
+                if p.start > cursor:
+                    sparse.write_namespace_padding(p.start - cursor)
+                    cursor = p.start
+                blob = self._blob_txs[p.pfb_index].blobs[p.blob_index]
+                sparse.write(blob)
+                cursor += p.share_count
+            shares += sparse.export()
+
+        total = layout.size * layout.size
+        shares += tail_padding_shares(total - len(shares))
+        return Square(shares, layout)
+
+    # --- introspection ----------------------------------------------------
+    def current_size(self) -> int:
+        return self._solve().size
+
+    @property
+    def txs(self) -> list[bytes]:
+        return list(self._txs)
+
+    @property
+    def blob_txs(self) -> list[BlobTx]:
+        return list(self._blob_txs)
+
+
+def _classify(raw_txs: list[bytes]) -> list[tuple[bytes, BlobTx | None]]:
+    return [(raw, unmarshal_blob_tx(raw)) for raw in raw_txs]
+
+
+def build(
+    raw_txs: list[bytes],
+    max_square_size: int,
+    subtree_root_threshold: int = SUBTREE_ROOT_THRESHOLD,
+) -> tuple[Square, list[bytes]]:
+    """Proposer path (reference square.Build, app/prepare_proposal.go:50).
+
+    Greedily packs as many txs as fit — normal txs first, then blob txs —
+    dropping the rest.  Returns (square, kept_txs) where kept_txs are the
+    original bytes in block order (normal txs, then BlobTxs).
+    """
+    builder = Builder(max_square_size, subtree_root_threshold)
+    kept_normal: list[bytes] = []
+    kept_blob: list[bytes] = []
+    for raw, btx in _classify(raw_txs):
+        if btx is None:
+            if builder.append_tx(raw):
+                kept_normal.append(raw)
+        else:
+            if builder.append_blob_tx(btx):
+                kept_blob.append(raw)
+    return builder.export(), kept_normal + kept_blob
+
+
+def construct(
+    raw_txs: list[bytes],
+    max_square_size: int,
+    subtree_root_threshold: int = SUBTREE_ROOT_THRESHOLD,
+) -> Square:
+    """Validator path (reference square.Construct, app/process_proposal.go:122).
+
+    Every tx must fit; raises SquareOverflow otherwise.
+    """
+    builder = Builder(max_square_size, subtree_root_threshold)
+    for raw, btx in _classify(raw_txs):
+        ok = builder.append_tx(raw) if btx is None else builder.append_blob_tx(btx)
+        if not ok:
+            raise SquareOverflow("proposal txs overflow the maximum square size")
+    return builder.export()

@@ -1,0 +1,201 @@
+"""Square builder tests: layout math, envelopes, Build/Construct parity."""
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.shares.compact import parse_compact_shares
+from celestia_app_tpu.shares.namespace import (
+    Namespace,
+    PAY_FOR_BLOB_NAMESPACE,
+    PRIMARY_RESERVED_PADDING_NAMESPACE,
+    TAIL_PADDING_NAMESPACE,
+    TRANSACTION_NAMESPACE,
+)
+from celestia_app_tpu.shares.sparse import Blob, parse_sparse_shares
+from celestia_app_tpu.square import (
+    Builder,
+    SquareOverflow,
+    blob_min_square_size,
+    build,
+    construct,
+    next_share_index,
+    subtree_width,
+)
+from celestia_app_tpu.tx.envelopes import (
+    BlobTx,
+    IndexWrapper,
+    unmarshal_blob_tx,
+    unmarshal_index_wrapper,
+)
+
+RNG = np.random.default_rng(42)
+
+
+def rand_bytes(n: int) -> bytes:
+    return RNG.integers(0, 256, n, dtype=np.uint8).tobytes()
+
+
+def user_ns(tag: int) -> Namespace:
+    return Namespace.v0(bytes([tag]) * 10)
+
+
+def make_blob_tx(ns_tags: list[int], sizes: list[int]) -> bytes:
+    blobs = tuple(Blob(user_ns(t), rand_bytes(s)) for t, s in zip(ns_tags, sizes))
+    return BlobTx(rand_bytes(64), blobs).marshal()
+
+
+class TestLayoutMath:
+    def test_blob_min_square_size(self):
+        assert [blob_min_square_size(n) for n in (1, 2, 4, 5, 15, 16, 17)] == [
+            1, 2, 2, 4, 4, 4, 8,
+        ]
+
+    def test_subtree_width_spec_example(self):
+        # Spec: blob of 172 shares, SRT=64 -> width 4.
+        assert subtree_width(172, 64) == 4
+
+    def test_subtree_width_capped_by_min_square(self):
+        # 15 shares / SRT 1 -> ceil=15 -> pow2 16, capped at min square 4.
+        assert subtree_width(15, 1) == 4
+
+    def test_next_share_index(self):
+        assert next_share_index(0, 172, 64) == 0
+        assert next_share_index(1, 172, 64) == 4
+        assert next_share_index(5, 1, 64) == 5  # width-1 blobs never pad
+
+
+class TestEnvelopes:
+    def test_blob_tx_roundtrip(self):
+        raw = make_blob_tx([3, 5], [100, 2000])
+        btx = unmarshal_blob_tx(raw)
+        assert btx is not None
+        assert len(btx.blobs) == 2
+        assert btx.blobs[0].namespace == user_ns(3)
+        assert btx.marshal() == raw
+
+    def test_not_a_blob_tx(self):
+        assert unmarshal_blob_tx(b"\x00\x01junk") is None
+        assert unmarshal_blob_tx(rand_bytes(50)) is None
+        # A valid proto but wrong type_id is not a BlobTx.
+        iw = IndexWrapper(b"tx", (1, 2)).marshal()
+        assert unmarshal_blob_tx(iw) is None
+
+    def test_index_wrapper_roundtrip(self):
+        iw = IndexWrapper(rand_bytes(80), (0, 7, 300))
+        out = unmarshal_index_wrapper(iw.marshal())
+        assert out == iw
+        assert unmarshal_index_wrapper(rand_bytes(33)) is None
+
+
+class TestBuilder:
+    def test_empty_square(self):
+        sq, kept = build([], 64)
+        assert sq.size == 1 and kept == []
+        assert sq.is_empty()
+        assert sq.shares[0].namespace() == TAIL_PADDING_NAMESPACE
+
+    def test_txs_only(self):
+        txs = [rand_bytes(300) for _ in range(5)]
+        sq, kept = build(txs, 64)
+        assert kept == txs
+        lo, hi = sq.tx_share_range
+        assert parse_compact_shares(sq.shares[lo:hi]) == txs
+        # Remaining shares are tail padding.
+        assert all(
+            s.namespace() == TAIL_PADDING_NAMESPACE for s in sq.shares[hi:]
+        )
+
+    def test_single_blob_tx_layout(self):
+        raw = make_blob_tx([9], [1500])
+        sq, kept = build([raw], 64)
+        assert kept == [raw]
+        # PFB compact run decodes to an IndexWrapper pointing at the blob.
+        lo, hi = sq.pfb_share_range
+        [wrapped] = parse_compact_shares(sq.shares[lo:hi])
+        iw = unmarshal_index_wrapper(wrapped)
+        assert iw is not None
+        (start,) = iw.share_indexes
+        blo, bhi = sq.blob_share_range(0, 0)
+        assert blo == start
+        blobs = parse_sparse_shares(sq.shares[blo:bhi])
+        assert blobs == [unmarshal_blob_tx(raw).blobs[0]]
+
+    def test_namespace_ordering_and_padding(self):
+        # Two PFBs with inverted namespace order; square must sort blobs.
+        raw_hi = make_blob_tx([200], [600])
+        raw_lo = make_blob_tx([100], [5000])
+        txs = [rand_bytes(120)]
+        sq, kept = build(txs + [raw_hi, raw_lo], 64)
+        assert kept == txs + [raw_hi, raw_lo]
+        lo0, _ = sq.blob_share_range(1, 0)  # ns 100 (second blob tx)
+        lo1, _ = sq.blob_share_range(0, 0)  # ns 200
+        assert lo0 < lo1
+        # Namespaces never decrease across the square.
+        ns_seq = [s.raw[:29] for s in sq.shares]
+        assert ns_seq == sorted(ns_seq)
+        # Padding classes: reserved padding before first blob, none after tail.
+        _, pfb_hi = sq.pfb_share_range
+        pad = sq.shares[pfb_hi:lo0]
+        assert all(s.namespace() == PRIMARY_RESERVED_PADDING_NAMESPACE for s in pad)
+
+    def test_blob_alignment(self):
+        # A large blob must start at a multiple of its subtree width.
+        raw = make_blob_tx([50], [478 * 170])  # ~170 shares -> width 4
+        filler = make_blob_tx([40], [100])
+        sq, _ = build([filler, raw], 64)
+        start, _ = sq.blob_share_range(1, 0)
+        assert start % subtree_width(170, 64) == 0
+
+    def test_build_drops_construct_raises(self):
+        huge = [make_blob_tx([7], [400_000]) for _ in range(3)]
+        sq, kept = build(huge, 4)  # 4x4 = 16 shares: none fit
+        assert kept == [] and sq.is_empty()
+        with pytest.raises(SquareOverflow):
+            construct(huge, 4)
+
+    def test_build_construct_agree(self):
+        txs = [rand_bytes(RNG.integers(50, 600)) for _ in range(8)]
+        btxs = [
+            make_blob_tx([int(t)], [int(s)])
+            for t, s in zip(RNG.integers(30, 250, 6), RNG.integers(50, 60_000, 6))
+        ]
+        sq1, kept = build(txs + btxs, 128)
+        sq2 = construct(kept, 128)
+        assert sq1 == sq2
+
+    def test_construct_is_deterministic_in_tx_classes(self):
+        # Same txs, same square regardless of interleaving of the input list
+        # (normal txs and blob txs are placed in separate regions).
+        txs = [rand_bytes(100), rand_bytes(200)]
+        btx = make_blob_tx([60], [900])
+        sq1 = construct(txs + [btx], 64)
+        sq2 = construct([txs[0], btx, txs[1]], 64)
+        assert sq1 == sq2
+
+    def test_share_count_is_square(self):
+        for n_txs, n_btx in [(0, 1), (3, 0), (5, 4)]:
+            txs = [rand_bytes(150) for _ in range(n_txs)]
+            btxs = [make_blob_tx([30 + i], [700 * (i + 1)]) for i in range(n_btx)]
+            sq, _ = build(txs + btxs, 64)
+            assert len(sq.shares) == sq.size**2
+
+    def test_compact_namespaces(self):
+        txs = [rand_bytes(100)]
+        btx = make_blob_tx([90], [50])
+        sq, _ = build(txs + [btx], 64)
+        tlo, thi = sq.tx_share_range
+        plo, phi = sq.pfb_share_range
+        assert all(s.namespace() == TRANSACTION_NAMESPACE for s in sq.shares[tlo:thi])
+        assert all(s.namespace() == PAY_FOR_BLOB_NAMESPACE for s in sq.shares[plo:phi])
+
+    def test_interblob_padding_uses_previous_namespace(self):
+        # Force padding between two blobs in different namespaces.
+        a = make_blob_tx([10], [478 * 170])  # aligned width 4
+        b = make_blob_tx([20], [478 * 170])
+        sq, _ = build([a, b], 64)
+        _, a_hi = sq.blob_share_range(0, 0)
+        b_lo, _ = sq.blob_share_range(1, 0)
+        if b_lo > a_hi:  # padding exists
+            for s in sq.shares[a_hi:b_lo]:
+                assert s.namespace() == user_ns(10)
+                assert s.is_padding()
