@@ -1,0 +1,15 @@
+from celestia_app_tpu.inclusion.commitment import (
+    commitment_from_row_trees,
+    create_commitment,
+    create_commitments,
+    merkle_mountain_range_sizes,
+    subtree_root_coordinates,
+)
+
+__all__ = [
+    "commitment_from_row_trees",
+    "create_commitment",
+    "create_commitments",
+    "merkle_mountain_range_sizes",
+    "subtree_root_coordinates",
+]

@@ -31,6 +31,7 @@ from celestia_app_tpu.shares.compact import (
     compact_shares_needed,
     split_txs,
     tx_sequence_len,
+    write_uvarint,
 )
 from celestia_app_tpu.shares.namespace import (
     PAY_FOR_BLOB_NAMESPACE,
@@ -65,6 +66,7 @@ class _Layout:
     size: int  # square size k
     tx_share_count: int
     pfb_share_count: int
+    txs: tuple[bytes, ...]  # normal txs, block order
     wrapped_pfbs: tuple[bytes, ...]
     placements: tuple[BlobPlacement, ...]
     end: int  # share index one past the last non-tail-padding share
@@ -72,6 +74,18 @@ class _Layout:
 
 class SquareOverflow(ValueError):
     """The content does not fit in the maximum square size."""
+
+
+def _compact_share_index(byte_offset: int) -> int:
+    """Index of the compact share containing sequence byte `byte_offset`."""
+    from celestia_app_tpu.constants import (
+        CONTINUATION_COMPACT_SHARE_CONTENT_SIZE as CONT,
+        FIRST_COMPACT_SHARE_CONTENT_SIZE as FIRST,
+    )
+
+    if byte_offset < FIRST:
+        return 0
+    return 1 + (byte_offset - FIRST) // CONT
 
 
 class Square:
@@ -120,6 +134,30 @@ class Square:
     def wrapped_pfb_txs(self) -> tuple[bytes, ...]:
         """The IndexWrapper bytes committed in the PAY_FOR_BLOB shares."""
         return self._layout.wrapped_pfbs
+
+    def find_tx_share_range(self, tx_index: int) -> tuple[int, int]:
+        """Share span [lo, hi) of block tx `tx_index`.
+
+        Block tx order is normal txs then blob txs (reference go-square
+        square.FindTxShareRange via pkg/proof/proof.go:28-42); for a blob tx
+        the span covers its IndexWrapper bytes in the PFB compact run.
+        """
+        n_tx = len(self._layout.txs)
+        if tx_index < n_tx:
+            units, region_start = list(self._layout.txs), 0
+            unit = tx_index
+        else:
+            unit = tx_index - n_tx
+            if unit >= len(self._layout.wrapped_pfbs):
+                raise IndexError(f"tx index {tx_index} out of range")
+            units = list(self._layout.wrapped_pfbs)
+            region_start = self._layout.tx_share_count
+        offset = sum(len(write_uvarint(len(u))) + len(u) for u in units[:unit])
+        length = len(write_uvarint(len(units[unit]))) + len(units[unit])
+        return (
+            region_start + _compact_share_index(offset),
+            region_start + _compact_share_index(offset + length - 1) + 1,
+        )
 
 
 class Builder:
@@ -217,6 +255,7 @@ class Builder:
             size=size,
             tx_share_count=tx_shares,
             pfb_share_count=pfb_shares,
+            txs=tuple(self._txs),
             wrapped_pfbs=wrapped,
             placements=tuple(placements),
             end=end,

@@ -1,0 +1,89 @@
+"""Commitment tests: MMR sizes, blob-local vs square-derived equality."""
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.constants import PARITY_NAMESPACE_BYTES
+from celestia_app_tpu.gf import codec_for_width
+from celestia_app_tpu.inclusion import (
+    create_commitment,
+    commitment_from_row_trees,
+    merkle_mountain_range_sizes,
+    subtree_root_coordinates,
+)
+from celestia_app_tpu.nmt.tree import NamespacedMerkleTree
+from celestia_app_tpu.shares.namespace import Namespace
+from celestia_app_tpu.shares.sparse import Blob
+from celestia_app_tpu.square import build
+from celestia_app_tpu.tx.envelopes import BlobTx
+
+RNG = np.random.default_rng(7)
+
+
+def rand_bytes(n: int) -> bytes:
+    return RNG.integers(0, 256, n, dtype=np.uint8).tobytes()
+
+
+def user_ns(tag: int) -> Namespace:
+    return Namespace.v0(bytes([tag]) * 10)
+
+
+class TestMmr:
+    def test_sizes(self):
+        assert merkle_mountain_range_sizes(11, 4) == [4, 4, 2, 1]
+        assert merkle_mountain_range_sizes(2, 64) == [2]
+        assert merkle_mountain_range_sizes(64, 8) == [8] * 8
+        assert merkle_mountain_range_sizes(0, 8) == []
+
+    def test_chunks_stay_aligned(self):
+        # Every chunk must start at a multiple of its own size.
+        for total in (1, 3, 11, 170, 513):
+            cursor = 0
+            for s in merkle_mountain_range_sizes(total, 16):
+                assert cursor % s == 0
+                cursor += s
+            assert cursor == total
+
+
+def row_trees_for_square(square) -> dict[int, NamespacedMerkleTree]:
+    """Host oracle: extended row NMTs of a built square."""
+    k = square.size
+    codec = codec_for_width(k)
+    shares = np.frombuffer(
+        b"".join(s.raw for s in square.shares), dtype=np.uint8
+    ).reshape(k, k, -1)
+    trees: dict[int, NamespacedMerkleTree] = {}
+    for r in range(k):
+        extended = codec.extend(shares[r])  # (2k, S)
+        t = NamespacedMerkleTree()
+        for c in range(2 * k):
+            raw = extended[c].tobytes()
+            ns = raw[:29] if c < k else PARITY_NAMESPACE_BYTES
+            t.push(ns + raw)
+        trees[r] = t
+    return trees
+
+
+class TestCommitmentFromSquare:
+    @pytest.mark.parametrize(
+        "blob_sizes", [[100], [3000, 40_000], [478 * 70, 600, 478 * 3]]
+    )
+    def test_blob_local_equals_square_derived(self, blob_sizes):
+        blobs = [Blob(user_ns(10 + i), rand_bytes(s)) for i, s in enumerate(blob_sizes)]
+        raws = [BlobTx(rand_bytes(60), (b,)).marshal() for b in blobs]
+        square, _ = build(raws, 64)
+        trees = row_trees_for_square(square)
+        for i, blob in enumerate(blobs):
+            lo, hi = square.blob_share_range(i, 0)
+            got = commitment_from_row_trees(trees, lo, hi - lo, square.size)
+            assert got == create_commitment(blob)
+
+    def test_coordinates_respect_rows(self):
+        coords = subtree_root_coordinates(0, 170, 64, 64)
+        # width = 4 -> 42 chunks of 4 + 1 of 2 (168+2=170)
+        assert [1 << h for _, h, _ in coords] == [4] * 42 + [2]
+
+    def test_commitment_changes_with_data(self):
+        b1 = Blob(user_ns(1), b"x" * 1000)
+        b2 = Blob(user_ns(1), b"x" * 999 + b"y")
+        assert create_commitment(b1) != create_commitment(b2)

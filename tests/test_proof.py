@@ -1,0 +1,113 @@
+"""NMT range proofs and share/tx inclusion proofs."""
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.da import extend_shares
+from celestia_app_tpu.nmt.proof import prove_range, verify_range
+from celestia_app_tpu.nmt.tree import NamespacedMerkleTree
+from celestia_app_tpu.proof import new_share_inclusion_proof, new_tx_inclusion_proof
+from celestia_app_tpu.shares.namespace import Namespace
+from celestia_app_tpu.shares.sparse import Blob
+from celestia_app_tpu.square import build
+from celestia_app_tpu.tx.envelopes import BlobTx
+
+RNG = np.random.default_rng(123)
+
+
+def rand_bytes(n: int) -> bytes:
+    return RNG.integers(0, 256, n, dtype=np.uint8).tobytes()
+
+
+def user_ns(tag: int) -> Namespace:
+    return Namespace.v0(bytes([tag]) * 10)
+
+
+class TestNmtRangeProof:
+    @pytest.mark.parametrize("n", [1, 2, 4, 8, 16])
+    def test_all_ranges_roundtrip(self, n):
+        leaves = [
+            bytes([0, *([i // 2] * 28)]) + rand_bytes(20) for i in range(n)
+        ]
+        tree = NamespacedMerkleTree()
+        for l in leaves:
+            tree.push(l)
+        root = tree.root()
+        for start in range(n):
+            for end in range(start + 1, n + 1):
+                p = prove_range(tree, start, end)
+                assert verify_range(root, p, leaves[start:end])
+
+    def test_rejects_tampering(self):
+        leaves = [bytes(29) + bytes([i]) for i in range(8)]
+        tree = NamespacedMerkleTree()
+        for l in leaves:
+            tree.push(l)
+        root = tree.root()
+        p = prove_range(tree, 2, 5)
+        assert not verify_range(root, p, leaves[2:4])  # wrong count
+        bad = leaves[2:5]
+        bad[1] = bytes(29) + b"evil"
+        assert not verify_range(root, p, bad)
+        assert not verify_range(rand_bytes(90), p, leaves[2:5])
+        # Proof for a different range does not verify this one.
+        q = prove_range(tree, 1, 4)
+        assert not verify_range(root, q, leaves[2:5])
+
+
+@pytest.fixture(scope="module")
+def square_and_eds():
+    txs = [rand_bytes(200) for _ in range(3)]
+    btxs = [
+        BlobTx(rand_bytes(64), (Blob(user_ns(30 + i), rand_bytes(sz)),)).marshal()
+        for i, sz in enumerate([900, 15_000])
+    ]
+    square, kept = build(txs + btxs, 32)
+    eds = extend_shares(square.share_bytes())
+    return square, eds, kept
+
+
+class TestShareProof:
+    def test_blob_ranges_verify(self, square_and_eds):
+        square, eds, _ = square_and_eds
+        droot = eds.data_root()
+        for i in range(2):
+            lo, hi = square.blob_share_range(i, 0)
+            proof = new_share_inclusion_proof(eds, lo, hi)
+            assert proof.verify(droot)
+
+    def test_wrong_root_fails(self, square_and_eds):
+        square, eds, _ = square_and_eds
+        lo, hi = square.blob_share_range(0, 0)
+        proof = new_share_inclusion_proof(eds, lo, hi)
+        assert not proof.verify(rand_bytes(32))
+
+    def test_tampered_share_fails(self, square_and_eds):
+        square, eds, _ = square_and_eds
+        lo, hi = square.blob_share_range(1, 0)
+        proof = new_share_inclusion_proof(eds, lo, hi)
+        data = list(proof.data)
+        data[0] = data[0][:100] + b"\x5a" + data[0][101:]
+        from dataclasses import replace
+
+        assert not replace(proof, data=tuple(data)).verify(eds.data_root())
+
+    def test_tx_inclusion_all_txs(self, square_and_eds):
+        square, eds, kept = square_and_eds
+        droot = eds.data_root()
+        for i in range(len(kept)):
+            proof = new_tx_inclusion_proof(square, eds, i)
+            assert proof.verify(droot)
+
+    def test_multirow_blob_proof(self):
+        # Blob spanning several rows of a small square.
+        btx = BlobTx(
+            rand_bytes(64), (Blob(user_ns(9), rand_bytes(478 * 40)),)
+        ).marshal()
+        square, _ = build([btx], 16)
+        eds = extend_shares(square.share_bytes())
+        lo, hi = square.blob_share_range(0, 0)
+        assert hi - lo >= 40
+        proof = new_share_inclusion_proof(eds, lo, hi)
+        assert len(proof.share_proofs) >= 3
+        assert proof.verify(eds.data_root())
