@@ -11,10 +11,14 @@ from celestia_app_tpu.da.dah import (
     DataAvailabilityHeader,
     min_data_availability_header,
 )
+from celestia_app_tpu.da.repair import IrrecoverableSquare, RootMismatch, repair
 
 __all__ = [
     "ExtendedDataSquare",
     "extend_shares",
     "DataAvailabilityHeader",
     "min_data_availability_header",
+    "IrrecoverableSquare",
+    "RootMismatch",
+    "repair",
 ]

@@ -1,0 +1,121 @@
+"""Erasure repair: reconstruct a full EDS from >= 25% of its shares.
+
+Capability parity with rsmt2d.ExtendedDataSquare.Repair (SURVEY §2.2 —
+celestia-app itself never calls Repair, but it is part of the rsmt2d surface
+this framework replaces; BASELINE config 4 benchmarks a quadrant erasure).
+
+TPU-first shape: rows (then columns) sharing one erasure pattern are decoded
+together — the recover matrix R depends only on which positions survive, so
+each pattern group is ONE bit-matmul `full = R_bits @ known_bits` on the
+MXU (kernels/rs.py decode_axis_fn).  A quadrant loss therefore repairs in a
+single batched matmul per axis instead of 2k independent codec calls.
+Verification recomputes all 4k NMT roots with the fused pipeline and
+compares against the DAH.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from celestia_app_tpu.constants import SHARE_SIZE
+from celestia_app_tpu.da.dah import DataAvailabilityHeader
+from celestia_app_tpu.da.eds import ExtendedDataSquare, jit_pipeline
+from celestia_app_tpu.gf import codec_for_width
+from celestia_app_tpu.kernels.rs import decode_axis_fn
+
+
+class IrrecoverableSquare(ValueError):
+    """Not enough shares to reconstruct the square."""
+
+
+class RootMismatch(ValueError):
+    """Repaired square does not match the DataAvailabilityHeader."""
+
+
+def _decode_axis_groups(
+    data: np.ndarray, present: np.ndarray, codec, decode
+) -> tuple[np.ndarray, np.ndarray, bool]:
+    """Decode every axis line (row of `data`) with >= k surviving shares.
+
+    data: (L, 2k, S); present: (L, 2k) bool.  Returns (data, present,
+    progressed) with repaired lines filled in and marked present.
+    """
+    n = data.shape[1]
+    k = n // 2
+    incomplete = ~present.all(axis=1)
+    counts = present.sum(axis=1)
+    solvable = incomplete & (counts >= k)
+    if not solvable.any():
+        return data, present, False
+
+    # Group solvable lines by erasure pattern: one recover matrix (and one
+    # batched device matmul) per pattern.
+    patterns: dict[bytes, list[int]] = {}
+    for i in np.nonzero(solvable)[0]:
+        patterns.setdefault(present[i].tobytes(), []).append(int(i))
+    for pat, lines in patterns.items():
+        mask = np.frombuffer(pat, dtype=bool)
+        known_pos = np.nonzero(mask)[0][:k]
+        R = codec.recover_matrix(known_pos)
+        R_bits = jnp.asarray(codec.field.expand_bit_matrix(R))
+        known = jnp.asarray(data[lines][:, known_pos], dtype=jnp.uint8)
+        full = np.asarray(decode(known, R_bits))  # (len(lines), 2k, S)
+        # Fill only the missing positions: surviving shares stay authoritative
+        # so the final consistency check can reject inconsistent survivor sets.
+        sub = data[lines]
+        sub[:, ~mask] = full[:, ~mask]
+        data[lines] = sub
+        present[lines] = True
+    return data, present, True
+
+
+def repair(
+    shares: np.ndarray,
+    present: np.ndarray,
+    dah: DataAvailabilityHeader | None = None,
+) -> ExtendedDataSquare:
+    """Reconstruct the full EDS.
+
+    shares: (2k, 2k, SHARE_SIZE) uint8 with arbitrary bytes at missing
+    positions; present: (2k, 2k) bool availability mask.  If `dah` is given,
+    the repaired square's roots must match it (the Repair contract: a light
+    node verifies what it reconstructs).
+    """
+    data = np.array(shares, dtype=np.uint8, copy=True)
+    present = np.array(present, dtype=bool, copy=True)
+    n = data.shape[0]
+    if data.shape != (n, n, SHARE_SIZE) or n % 2:
+        raise ValueError(f"bad EDS shape {data.shape}")
+    k = n // 2
+    codec = codec_for_width(k)
+    decode = decode_axis_fn(k)
+
+    # Alternate row/column sweeps until complete: a line solved along one
+    # axis contributes shares to crossing lines of the other axis (same
+    # iterative strategy as rsmt2d's solveCrossword).
+    while not present.all():
+        data, present, row_prog = _decode_axis_groups(data, present, codec, decode)
+        data_t = np.ascontiguousarray(data.transpose(1, 0, 2))
+        present_t = np.ascontiguousarray(present.T)
+        data_t, present_t, col_prog = _decode_axis_groups(
+            data_t, present_t, codec, decode
+        )
+        data = np.ascontiguousarray(data_t.transpose(1, 0, 2))
+        present = present_t.T
+        if not (row_prog or col_prog):
+            raise IrrecoverableSquare(
+                f"stuck with {int((~present).sum())} missing shares"
+            )
+
+    # Re-run the fused extension+roots pipeline on the recovered ODS: this
+    # both re-derives parity (rejecting inconsistent survivor sets) and
+    # yields the roots for DAH verification.
+    eds = ExtendedDataSquare.compute(data[:k, :k])
+    if not np.array_equal(eds.squared(), data):
+        raise RootMismatch("recovered shares are not a consistent codeword")
+    if dah is not None:
+        got = DataAvailabilityHeader.from_eds(eds)
+        if not got.equals(dah):
+            raise RootMismatch("repaired square does not match the DAH")
+    return eds
