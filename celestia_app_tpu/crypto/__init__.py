@@ -1,0 +1,8 @@
+from celestia_app_tpu.crypto.keys import (
+    ACCOUNT_HRP,
+    PrivateKey,
+    PublicKey,
+    validate_address,
+)
+
+__all__ = ["ACCOUNT_HRP", "PrivateKey", "PublicKey", "validate_address"]

@@ -1,0 +1,109 @@
+"""Account keys: secp256k1 (cosmos account scheme) with compact signatures.
+
+Parity with the reference's account cryptography (cosmos-sdk secp256k1,
+spec specs/src/specs/public_key_cryptography.md): 33-byte compressed
+pubkeys, 64-byte r||s signatures over sha256(msg) with low-S normalization,
+addresses = ripemd160(sha256(pubkey)) in bech32 ("celestia" HRP).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    Prehashed,
+    decode_dss_signature,
+    encode_dss_signature,
+)
+from cryptography.hazmat.primitives import hashes, serialization
+
+from celestia_app_tpu.crypto import bech32
+
+ACCOUNT_HRP = "celestia"
+_ORDER = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+
+
+def _sha256(b: bytes) -> bytes:
+    return hashlib.sha256(b).digest()
+
+
+class PrivateKey:
+    """A secp256k1 signing key."""
+
+    def __init__(self, key: ec.EllipticCurvePrivateKey):
+        self._key = key
+
+    @classmethod
+    def generate(cls) -> "PrivateKey":
+        return cls(ec.generate_private_key(ec.SECP256K1()))
+
+    @classmethod
+    def from_seed(cls, seed: bytes) -> "PrivateKey":
+        """Deterministic key from a seed (testing/txsim reproducibility)."""
+        d = int.from_bytes(_sha256(b"celestia_app_tpu-key" + seed), "big") % (_ORDER - 1) + 1
+        return cls(ec.derive_private_key(d, ec.SECP256K1()))
+
+    def public_key(self) -> "PublicKey":
+        return PublicKey.from_cryptography(self._key.public_key())
+
+    def sign(self, msg: bytes) -> bytes:
+        """64-byte r||s signature over sha256(msg), low-S normalized."""
+        der = self._key.sign(_sha256(msg), ec.ECDSA(Prehashed(hashes.SHA256())))
+        r, s = decode_dss_signature(der)
+        if s > _ORDER // 2:
+            s = _ORDER - s
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+
+class PublicKey:
+    """A 33-byte compressed secp256k1 public key."""
+
+    def __init__(self, compressed: bytes):
+        if len(compressed) != 33:
+            raise ValueError(f"compressed pubkey must be 33 bytes, got {len(compressed)}")
+        self.bytes = compressed
+
+    @classmethod
+    def from_cryptography(cls, pub: ec.EllipticCurvePublicKey) -> "PublicKey":
+        return cls(
+            pub.public_bytes(
+                serialization.Encoding.X962, serialization.PublicFormat.CompressedPoint
+            )
+        )
+
+    def _to_cryptography(self) -> ec.EllipticCurvePublicKey:
+        return ec.EllipticCurvePublicKey.from_encoded_point(ec.SECP256K1(), self.bytes)
+
+    def verify(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != 64:
+            return False
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        if not 0 < r < _ORDER or not 0 < s <= _ORDER // 2:
+            return False
+        try:
+            self._to_cryptography().verify(
+                encode_dss_signature(r, s),
+                _sha256(msg),
+                ec.ECDSA(Prehashed(hashes.SHA256())),
+            )
+            return True
+        except Exception:
+            return False
+
+    def address_bytes(self) -> bytes:
+        return hashlib.new("ripemd160", _sha256(self.bytes)).digest()
+
+    def address(self) -> str:
+        return bech32.encode(ACCOUNT_HRP, self.address_bytes())
+
+
+def validate_address(addr: str) -> bytes:
+    """Decode a bech32 account address; raises ValueError if invalid."""
+    hrp, payload = bech32.decode(addr)
+    if hrp != ACCOUNT_HRP:
+        raise ValueError(f"wrong address prefix {hrp!r}")
+    if len(payload) != 20:
+        raise ValueError(f"address payload must be 20 bytes, got {len(payload)}")
+    return payload

@@ -1,0 +1,220 @@
+"""sdk.Msg types and the Any envelope.
+
+Wire parity with the reference protos: MsgPayForBlobs
+(proto/celestia/blob/v1/tx.proto), bank MsgSend (cosmos bank.v1beta1), and
+google.protobuf.Any {type_url=1, value=2}.  Each message knows its type URL;
+the registry maps URLs back to decoders (the InterfaceRegistry analog,
+app/encoding/encoding.go:26).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from celestia_app_tpu.encoding.proto import (
+    WIRE_LEN,
+    WIRE_VARINT,
+    decode_fields,
+    decode_packed_uint32,
+    encode_bytes_field,
+    encode_packed_uint32_field,
+    encode_varint_field,
+)
+
+URL_MSG_PAY_FOR_BLOBS = "/celestia.blob.v1.MsgPayForBlobs"
+URL_MSG_SEND = "/cosmos.bank.v1beta1.MsgSend"
+URL_MSG_SIGNAL_VERSION = "/celestia.signal.v1.MsgSignalVersion"
+URL_MSG_TRY_UPGRADE = "/celestia.signal.v1.MsgTryUpgrade"
+
+
+@dataclass(frozen=True)
+class Any:
+    type_url: str
+    value: bytes
+
+    def marshal(self) -> bytes:
+        return encode_bytes_field(1, self.type_url.encode()) + encode_bytes_field(
+            2, self.value
+        )
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "Any":
+        url, value = "", b""
+        for num, wt, val in decode_fields(raw):
+            if num == 1 and wt == WIRE_LEN:
+                url = val.decode()
+            elif num == 2 and wt == WIRE_LEN:
+                value = val
+        return cls(url, value)
+
+
+@dataclass(frozen=True)
+class Coin:
+    denom: str
+    amount: int
+
+    def marshal(self) -> bytes:
+        # cosmos Coin.amount is a decimal string on the wire.
+        return encode_bytes_field(1, self.denom.encode()) + encode_bytes_field(
+            2, str(self.amount).encode()
+        )
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "Coin":
+        denom, amount = "", 0
+        for num, wt, val in decode_fields(raw):
+            if num == 1 and wt == WIRE_LEN:
+                denom = val.decode()
+            elif num == 2 and wt == WIRE_LEN:
+                amount = int(val.decode())
+        return cls(denom, amount)
+
+
+@dataclass(frozen=True)
+class MsgPayForBlobs:
+    """Pays for blob inclusion (reference x/blob/types/payforblob.go:48)."""
+
+    signer: str
+    namespaces: tuple[bytes, ...]  # 29-byte encoded namespaces
+    blob_sizes: tuple[int, ...]
+    share_commitments: tuple[bytes, ...]
+    share_versions: tuple[int, ...]
+
+    TYPE_URL = URL_MSG_PAY_FOR_BLOBS
+
+    def marshal(self) -> bytes:
+        out = encode_bytes_field(1, self.signer.encode())
+        for ns in self.namespaces:
+            out += encode_bytes_field(2, ns)
+        out += encode_packed_uint32_field(3, list(self.blob_sizes))
+        for c in self.share_commitments:
+            out += encode_bytes_field(4, c)
+        out += encode_packed_uint32_field(8, list(self.share_versions))
+        return out
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgPayForBlobs":
+        signer = ""
+        namespaces: list[bytes] = []
+        sizes: list[int] = []
+        commitments: list[bytes] = []
+        versions: list[int] = []
+        for num, wt, val in decode_fields(raw):
+            if num == 1 and wt == WIRE_LEN:
+                signer = val.decode()
+            elif num == 2 and wt == WIRE_LEN:
+                namespaces.append(val)
+            elif num == 3 and wt == WIRE_LEN:
+                sizes.extend(decode_packed_uint32(val))
+            elif num == 3 and wt == WIRE_VARINT:
+                sizes.append(val)
+            elif num == 4 and wt == WIRE_LEN:
+                commitments.append(val)
+            elif num == 8 and wt == WIRE_LEN:
+                versions.extend(decode_packed_uint32(val))
+            elif num == 8 and wt == WIRE_VARINT:
+                versions.append(val)
+        return cls(
+            signer, tuple(namespaces), tuple(sizes), tuple(commitments), tuple(versions)
+        )
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+
+@dataclass(frozen=True)
+class MsgSend:
+    from_address: str
+    to_address: str
+    amount: tuple[Coin, ...]
+
+    TYPE_URL = URL_MSG_SEND
+
+    def marshal(self) -> bytes:
+        out = encode_bytes_field(1, self.from_address.encode())
+        out += encode_bytes_field(2, self.to_address.encode())
+        for c in self.amount:
+            out += encode_bytes_field(3, c.marshal())
+        return out
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgSend":
+        f, t = "", ""
+        coins: list[Coin] = []
+        for num, wt, val in decode_fields(raw):
+            if num == 1 and wt == WIRE_LEN:
+                f = val.decode()
+            elif num == 2 and wt == WIRE_LEN:
+                t = val.decode()
+            elif num == 3 and wt == WIRE_LEN:
+                coins.append(Coin.unmarshal(val))
+        return cls(f, t, tuple(coins))
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+
+@dataclass(frozen=True)
+class MsgSignalVersion:
+    """Validator signals readiness for an app version (x/signal)."""
+
+    validator_address: str
+    version: int
+
+    TYPE_URL = URL_MSG_SIGNAL_VERSION
+
+    def marshal(self) -> bytes:
+        return encode_bytes_field(1, self.validator_address.encode()) + encode_varint_field(
+            2, self.version
+        )
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgSignalVersion":
+        addr, version = "", 0
+        for num, wt, val in decode_fields(raw):
+            if num == 1 and wt == WIRE_LEN:
+                addr = val.decode()
+            elif num == 2 and wt == WIRE_VARINT:
+                version = val
+        return cls(addr, version)
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+
+@dataclass(frozen=True)
+class MsgTryUpgrade:
+    """Triggers the upgrade tally (x/signal keeper.TryUpgrade)."""
+
+    signer: str
+
+    TYPE_URL = URL_MSG_TRY_UPGRADE
+
+    def marshal(self) -> bytes:
+        return encode_bytes_field(1, self.signer.encode())
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgTryUpgrade":
+        signer = ""
+        for num, wt, val in decode_fields(raw):
+            if num == 1 and wt == WIRE_LEN:
+                signer = val.decode()
+        return cls(signer)
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+
+MSG_DECODERS = {
+    URL_MSG_PAY_FOR_BLOBS: MsgPayForBlobs.unmarshal,
+    URL_MSG_SEND: MsgSend.unmarshal,
+    URL_MSG_SIGNAL_VERSION: MsgSignalVersion.unmarshal,
+    URL_MSG_TRY_UPGRADE: MsgTryUpgrade.unmarshal,
+}
+
+
+def decode_msg(any_msg: Any):
+    dec = MSG_DECODERS.get(any_msg.type_url)
+    if dec is None:
+        raise ValueError(f"unknown message type {any_msg.type_url}")
+    return dec(any_msg.value)

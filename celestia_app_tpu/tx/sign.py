@@ -1,0 +1,222 @@
+"""Transaction envelope + SIGN_MODE_DIRECT signing.
+
+Wire parity with cosmos tx.proto as the reference consumes it through
+pkg/user (Signer, pkg/user/signer.go:23-36): TxBody / AuthInfo / SignDoc /
+TxRaw with the standard field numbers, secp256k1 pubkeys wrapped in Any.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from celestia_app_tpu.crypto.keys import PrivateKey, PublicKey
+from celestia_app_tpu.encoding.proto import (
+    WIRE_LEN,
+    WIRE_VARINT,
+    decode_fields,
+    encode_bytes_field,
+    encode_varint_field,
+)
+from celestia_app_tpu.tx.messages import Any, Coin, decode_msg
+
+URL_SECP256K1_PUBKEY = "/cosmos.crypto.secp256k1.PubKey"
+SIGN_MODE_DIRECT = 1
+
+
+@dataclass(frozen=True)
+class Fee:
+    amount: tuple[Coin, ...]
+    gas_limit: int
+
+    def marshal(self) -> bytes:
+        out = b""
+        for c in self.amount:
+            out += encode_bytes_field(1, c.marshal())
+        out += encode_varint_field(2, self.gas_limit)
+        return out
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "Fee":
+        coins: list[Coin] = []
+        gas = 0
+        for num, wt, val in decode_fields(raw):
+            if num == 1 and wt == WIRE_LEN:
+                coins.append(Coin.unmarshal(val))
+            elif num == 2 and wt == WIRE_VARINT:
+                gas = val
+        return cls(tuple(coins), gas)
+
+
+def _marshal_pubkey(pk: PublicKey) -> bytes:
+    return Any(URL_SECP256K1_PUBKEY, encode_bytes_field(1, pk.bytes)).marshal()
+
+
+def _unmarshal_pubkey(raw: bytes) -> PublicKey:
+    a = Any.unmarshal(raw)
+    if a.type_url != URL_SECP256K1_PUBKEY:
+        raise ValueError(f"unsupported pubkey type {a.type_url}")
+    for num, wt, val in decode_fields(a.value):
+        if num == 1 and wt == WIRE_LEN:
+            return PublicKey(val)
+    raise ValueError("pubkey Any missing key bytes")
+
+
+def _marshal_mode_info_single(mode: int) -> bytes:
+    return encode_bytes_field(1, encode_varint_field(1, mode))
+
+
+@dataclass(frozen=True)
+class SignerInfo:
+    public_key: PublicKey
+    sequence: int
+
+    def marshal(self) -> bytes:
+        return (
+            encode_bytes_field(1, _marshal_pubkey(self.public_key))
+            + encode_bytes_field(2, _marshal_mode_info_single(SIGN_MODE_DIRECT))
+            + encode_varint_field(3, self.sequence)
+        )
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "SignerInfo":
+        pk = None
+        seq = 0
+        for num, wt, val in decode_fields(raw):
+            if num == 1 and wt == WIRE_LEN:
+                pk = _unmarshal_pubkey(val)
+            elif num == 3 and wt == WIRE_VARINT:
+                seq = val
+        if pk is None:
+            raise ValueError("signer info missing public key")
+        return cls(pk, seq)
+
+
+@dataclass(frozen=True)
+class TxBody:
+    messages: tuple[Any, ...]
+    memo: str = ""
+
+    def marshal(self) -> bytes:
+        out = b""
+        for m in self.messages:
+            out += encode_bytes_field(1, m.marshal())
+        if self.memo:
+            out += encode_bytes_field(2, self.memo.encode())
+        return out
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "TxBody":
+        msgs: list[Any] = []
+        memo = ""
+        for num, wt, val in decode_fields(raw):
+            if num == 1 and wt == WIRE_LEN:
+                msgs.append(Any.unmarshal(val))
+            elif num == 2 and wt == WIRE_LEN:
+                memo = val.decode()
+        return cls(tuple(msgs), memo)
+
+
+@dataclass(frozen=True)
+class AuthInfo:
+    signer_infos: tuple[SignerInfo, ...]
+    fee: Fee
+
+    def marshal(self) -> bytes:
+        out = b""
+        for s in self.signer_infos:
+            out += encode_bytes_field(1, s.marshal())
+        out += encode_bytes_field(2, self.fee.marshal())
+        return out
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "AuthInfo":
+        infos: list[SignerInfo] = []
+        fee = Fee((), 0)
+        for num, wt, val in decode_fields(raw):
+            if num == 1 and wt == WIRE_LEN:
+                infos.append(SignerInfo.unmarshal(val))
+            elif num == 2 and wt == WIRE_LEN:
+                fee = Fee.unmarshal(val)
+        return cls(tuple(infos), fee)
+
+
+def sign_doc_bytes(
+    body_bytes: bytes, auth_info_bytes: bytes, chain_id: str, account_number: int
+) -> bytes:
+    return (
+        encode_bytes_field(1, body_bytes)
+        + encode_bytes_field(2, auth_info_bytes)
+        + encode_bytes_field(3, chain_id.encode())
+        + encode_varint_field(4, account_number)
+    )
+
+
+@dataclass(frozen=True)
+class Tx:
+    """A decoded transaction (TxRaw contents)."""
+
+    body_bytes: bytes
+    auth_info_bytes: bytes
+    signatures: tuple[bytes, ...]
+
+    def marshal(self) -> bytes:
+        out = encode_bytes_field(1, self.body_bytes) + encode_bytes_field(
+            2, self.auth_info_bytes
+        )
+        for s in self.signatures:
+            out += encode_bytes_field(3, s)
+        return out
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "Tx":
+        body, auth = b"", b""
+        sigs: list[bytes] = []
+        for num, wt, val in decode_fields(raw):
+            if num == 1 and wt == WIRE_LEN:
+                body = val
+            elif num == 2 and wt == WIRE_LEN:
+                auth = val
+            elif num == 3 and wt == WIRE_LEN:
+                sigs.append(val)
+        if not body or not auth:
+            raise ValueError("tx missing body or auth info")
+        return cls(body, auth, tuple(sigs))
+
+    @property
+    def body(self) -> TxBody:
+        return TxBody.unmarshal(self.body_bytes)
+
+    @property
+    def auth_info(self) -> AuthInfo:
+        return AuthInfo.unmarshal(self.auth_info_bytes)
+
+    def msgs(self) -> list:
+        return [decode_msg(m) for m in self.body.messages]
+
+    def verify_signature(self, chain_id: str, account_number: int) -> bool:
+        """Verify the (single) signer's SIGN_MODE_DIRECT signature."""
+        info = self.auth_info
+        if len(info.signer_infos) != 1 or len(self.signatures) != 1:
+            return False
+        doc = sign_doc_bytes(
+            self.body_bytes, self.auth_info_bytes, chain_id, account_number
+        )
+        return info.signer_infos[0].public_key.verify(doc, self.signatures[0])
+
+
+def build_and_sign(
+    msgs: list,
+    key: PrivateKey,
+    chain_id: str,
+    account_number: int,
+    sequence: int,
+    fee: Fee,
+    memo: str = "",
+) -> bytes:
+    """Construct and sign a tx; returns the TxRaw bytes."""
+    body = TxBody(tuple(m.to_any() for m in msgs), memo)
+    auth = AuthInfo((SignerInfo(key.public_key(), sequence),), fee)
+    body_bytes = body.marshal()
+    auth_bytes = auth.marshal()
+    doc = sign_doc_bytes(body_bytes, auth_bytes, chain_id, account_number)
+    return Tx(body_bytes, auth_bytes, (key.sign(doc),)).marshal()

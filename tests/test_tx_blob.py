@@ -1,0 +1,140 @@
+"""Crypto, tx signing, and x/blob PFB validation tests."""
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.crypto import PrivateKey, validate_address
+from celestia_app_tpu.crypto import bech32
+from celestia_app_tpu.modules.blob.types import (
+    BlobTxError,
+    estimate_gas,
+    gas_to_consume,
+    new_msg_pay_for_blobs,
+    validate_blob_tx,
+)
+from celestia_app_tpu.shares.namespace import Namespace
+from celestia_app_tpu.shares.sparse import Blob
+from celestia_app_tpu.tx.envelopes import BlobTx
+from celestia_app_tpu.tx.messages import Coin, MsgPayForBlobs, MsgSend
+from celestia_app_tpu.tx.sign import Fee, Tx, build_and_sign
+
+RNG = np.random.default_rng(5)
+
+
+def rand_bytes(n: int) -> bytes:
+    return RNG.integers(0, 256, n, dtype=np.uint8).tobytes()
+
+
+def user_ns(tag: int) -> Namespace:
+    return Namespace.v0(bytes([tag]) * 10)
+
+
+KEY = PrivateKey.from_seed(b"alice")
+ADDR = KEY.public_key().address()
+CHAIN_ID = "test-chain"
+FEE = Fee((Coin("utia", 2000),), 100_000)
+
+
+def signed_pfb_blob_tx(blobs, key=KEY, seq=0) -> bytes:
+    msg = new_msg_pay_for_blobs(key.public_key().address(), list(blobs))
+    raw_tx = build_and_sign([msg], key, CHAIN_ID, 1, seq, FEE)
+    return BlobTx(raw_tx, tuple(blobs)).marshal()
+
+
+class TestCrypto:
+    def test_bech32_roundtrip(self):
+        payload = rand_bytes(20)
+        addr = bech32.encode("celestia", payload)
+        hrp, out = bech32.decode(addr)
+        assert (hrp, out) == ("celestia", payload)
+
+    def test_address_valid(self):
+        assert len(validate_address(ADDR)) == 20
+        with pytest.raises(ValueError):
+            validate_address("cosmos1qqqsyqcyq5rqwzqfpg9scrgwpugpzysnrujsuw")
+        with pytest.raises(ValueError):
+            validate_address(ADDR[:-1] + ("q" if ADDR[-1] != "q" else "p"))
+
+    def test_sign_verify(self):
+        sig = KEY.sign(b"msg")
+        assert KEY.public_key().verify(b"msg", sig)
+        assert not KEY.public_key().verify(b"other", sig)
+        assert not KEY.public_key().verify(b"msg", sig[:-1] + bytes([sig[-1] ^ 1]))
+
+    def test_from_seed_deterministic(self):
+        assert PrivateKey.from_seed(b"x").public_key().bytes == PrivateKey.from_seed(
+            b"x"
+        ).public_key().bytes
+
+
+class TestTxSigning:
+    def test_roundtrip_and_verify(self):
+        msg = MsgSend(ADDR, PrivateKey.from_seed(b"bob").public_key().address(),
+                      (Coin("utia", 42),))
+        raw = build_and_sign([msg], KEY, CHAIN_ID, 7, 3, FEE, memo="hi")
+        tx = Tx.unmarshal(raw)
+        assert tx.verify_signature(CHAIN_ID, 7)
+        assert not tx.verify_signature(CHAIN_ID, 8)
+        assert not tx.verify_signature("other-chain", 7)
+        [decoded] = tx.msgs()
+        assert decoded == msg
+        assert tx.body.memo == "hi"
+        assert tx.auth_info.fee == FEE
+        assert tx.auth_info.signer_infos[0].sequence == 3
+
+    def test_tampered_body_fails(self):
+        msg = MsgSend(ADDR, ADDR, (Coin("utia", 1),))
+        raw = build_and_sign([msg], KEY, CHAIN_ID, 0, 0, FEE)
+        tx = Tx.unmarshal(raw)
+        evil = Tx(tx.body_bytes + b"\x22\x00", tx.auth_info_bytes, tx.signatures)
+        assert not evil.verify_signature(CHAIN_ID, 0)
+
+
+class TestValidateBlobTx:
+    def test_valid(self):
+        blobs = (Blob(user_ns(1), rand_bytes(1000)), Blob(user_ns(2), rand_bytes(30)))
+        from celestia_app_tpu.tx.envelopes import unmarshal_blob_tx
+
+        btx = unmarshal_blob_tx(signed_pfb_blob_tx(blobs))
+        msg = validate_blob_tx(btx)
+        assert msg.signer == ADDR
+        assert msg.blob_sizes == (1000, 30)
+
+    @pytest.mark.parametrize(
+        "mutate",
+        [
+            lambda b: Blob(b.namespace, b.data[:-1] + b"\x01"),  # data change
+            lambda b: Blob(user_ns(9), b.data),  # namespace change
+        ],
+    )
+    def test_mutated_blob_rejected(self, mutate):
+        from celestia_app_tpu.tx.envelopes import unmarshal_blob_tx
+
+        blob = Blob(user_ns(1), rand_bytes(500))
+        btx = unmarshal_blob_tx(signed_pfb_blob_tx((blob,)))
+        bad = BlobTx(btx.tx, (mutate(blob),))
+        with pytest.raises(BlobTxError):
+            validate_blob_tx(bad)
+
+    def test_reserved_namespace_rejected(self):
+        from celestia_app_tpu.shares.namespace import TRANSACTION_NAMESPACE
+
+        with pytest.raises(ValueError):
+            new_msg_pay_for_blobs(ADDR, [Blob(TRANSACTION_NAMESPACE, b"x")])
+
+    def test_msgsend_inner_tx_rejected(self):
+        blob = Blob(user_ns(1), rand_bytes(100))
+        raw_tx = build_and_sign(
+            [MsgSend(ADDR, ADDR, (Coin("utia", 1),))], KEY, CHAIN_ID, 1, 0, FEE
+        )
+        with pytest.raises(BlobTxError):
+            validate_blob_tx(BlobTx(raw_tx, (blob,)))
+
+
+class TestGas:
+    def test_gas_model(self):
+        # 1 share blob: 512 * 8 = 4096 gas + fixed.
+        assert gas_to_consume((1,), 8) == 4096
+        assert estimate_gas([1]) == 4096 + 75_000
+        # Spot check linearity.
+        assert gas_to_consume((478 * 10,), 8) == 10 * 512 * 8
