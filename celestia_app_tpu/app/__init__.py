@@ -1,0 +1,18 @@
+from celestia_app_tpu.app.app import (
+    App,
+    BlockData,
+    Genesis,
+    GenesisAccount,
+    TxResult,
+)
+from celestia_app_tpu.app.ante import AnteError, run_ante
+
+__all__ = [
+    "App",
+    "BlockData",
+    "Genesis",
+    "GenesisAccount",
+    "TxResult",
+    "AnteError",
+    "run_ante",
+]

@@ -1,0 +1,184 @@
+"""The ante handler chain: every admission check a tx passes before execution.
+
+Behavioral parity with reference app/ante/ante.go:15-82 (the 17-decorator
+chain), collapsed to the decorators with observable behavior in this
+framework:
+
+  * panic containment (HandlePanicDecorator, app/ante/panic.go)
+  * message-version gating (MsgVersioningGateKeeper, app/ante/msg_gatekeeper.go)
+  * fee validation: gas price >= max(node min [CheckTx only], network min),
+    priority = gas price x 1e6 (ValidateTxFee, app/ante/fee_checker.go:31-60)
+  * signature + account checks: pubkey, account number, sequence, DIRECT
+    mode verification (sdk SigVerificationDecorator analog)
+  * fee deduction to the fee collector
+  * x/blob ante: MinGasPFBDecorator + BlobShareDecorator
+    (x/blob/ante/ante.go:25, blob_share_decorator.go:27)
+  * sequence increment
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from celestia_app_tpu.constants import CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
+from celestia_app_tpu.shares.sparse import sparse_shares_needed
+from celestia_app_tpu.state.accounts import FEE_COLLECTOR
+from celestia_app_tpu.state.dec import Dec
+from celestia_app_tpu.tx.messages import (
+    MsgPayForBlobs,
+    MsgSend,
+    MsgSignalVersion,
+    MsgTryUpgrade,
+)
+from celestia_app_tpu.tx.sign import Tx
+
+PRIORITY_SCALING_FACTOR = 1_000_000  # fee_checker.go:17
+
+
+class AnteError(ValueError):
+    """Tx rejected by the ante chain."""
+
+
+# appVersion -> allowed msg types (MsgVersioningGateKeeper,
+# app/ante/msg_gatekeeper.go:18-42: signal msgs are v2+).
+_V1_MSGS = {MsgSend, MsgPayForBlobs}
+_V2_MSGS = _V1_MSGS | {MsgSignalVersion, MsgTryUpgrade}
+
+
+def allowed_msg_types(app_version: int) -> set[type]:
+    return _V1_MSGS if app_version <= 1 else _V2_MSGS
+
+
+@dataclass
+class AnteResult:
+    priority: int = 0
+    gas_wanted: int = 0
+    signer: str = ""
+    events: list = field(default_factory=list)
+
+
+def run_ante(
+    app,
+    ctx,
+    tx: Tx,
+    *,
+    is_check_tx: bool,
+    simulate: bool = False,
+) -> AnteResult:
+    """Run the full chain against `ctx` (a branched state view).
+
+    Raises AnteError on any rejection; mutates ctx state (sequence bump,
+    fee deduction) on success, exactly like the reference chain.
+    """
+    try:
+        return _run(app, ctx, tx, is_check_tx=is_check_tx, simulate=simulate)
+    except AnteError:
+        raise
+    except Exception as e:  # HandlePanicDecorator: panic -> reject, not crash
+        raise AnteError(f"internal error in ante chain: {e!r}") from e
+
+
+def _run(app, ctx, tx: Tx, *, is_check_tx: bool, simulate: bool) -> AnteResult:
+    msgs = tx.msgs()  # raises on unknown type: unregistered msgs are rejected
+    if not msgs:
+        raise AnteError("tx has no messages")
+
+    # --- msg version gating ----------------------------------------------
+    allowed = allowed_msg_types(ctx.app_version)
+    for m in msgs:
+        if type(m) not in allowed:
+            raise AnteError(
+                f"message {type(m).__name__} not allowed at app version {ctx.app_version}"
+            )
+
+    # --- fee checks (ValidateTxFee) ---------------------------------------
+    auth = tx.auth_info
+    fee = auth.fee
+    if fee.gas_limit == 0:
+        raise AnteError("gas limit must be positive")
+    fee_utia = sum(c.amount for c in fee.amount if c.denom == "utia")
+    gas_price = Dec.from_fraction(fee_utia, fee.gas_limit)
+    net_min = app.minfee.network_min_gas_price()
+    if gas_price < net_min and not simulate:
+        raise AnteError(
+            f"gas price {gas_price} below network min {net_min}"
+        )
+    if is_check_tx and not simulate:
+        node_min = app.node_min_gas_price
+        if gas_price < node_min:
+            raise AnteError(
+                f"insufficient minimum gas price for this node; "
+                f"got: {gas_price} required: {node_min}"
+            )
+    priority = gas_price.mul_int(PRIORITY_SCALING_FACTOR).truncate_int()
+
+    # --- x/blob ante -------------------------------------------------------
+    for m in msgs:
+        if isinstance(m, MsgPayForBlobs):
+            _check_pfb_gas(m, fee.gas_limit, app.gas_per_blob_byte)
+            _check_blob_shares(m, app.gov_max_square_size, ctx.app_version)
+
+    # --- account + signature -----------------------------------------------
+    if len(auth.signer_infos) != 1 or len(tx.signatures) != 1:
+        raise AnteError("exactly one signer required")
+    info = auth.signer_infos[0]
+    signer_addr = info.public_key.address()
+    acc = ctx.auth.get_account(signer_addr)
+    if acc is None:
+        raise AnteError(f"account {signer_addr} not found")
+    if info.sequence != acc.sequence:
+        raise AnteError(
+            f"account sequence mismatch, expected {acc.sequence}, got {info.sequence}"
+        )
+    for m in msgs:
+        expected = getattr(m, "signer", None) or getattr(m, "from_address", None) or getattr(
+            m, "validator_address", None
+        )
+        if expected and expected != signer_addr:
+            raise AnteError(f"message signer {expected} != tx signer {signer_addr}")
+    if not simulate and not tx.verify_signature(app.chain_id, acc.account_number):
+        raise AnteError("signature verification failed")
+
+    # --- fee deduction + sequence increment --------------------------------
+    if fee_utia:
+        try:
+            ctx.bank.send(signer_addr, FEE_COLLECTOR, fee_utia)
+        except ValueError as e:
+            raise AnteError(str(e)) from e
+    if acc.pubkey == b"":
+        acc.pubkey = info.public_key.bytes
+    acc.sequence += 1
+    ctx.auth.set_account(acc)
+
+    return AnteResult(priority=priority, gas_wanted=fee.gas_limit, signer=signer_addr)
+
+
+def _check_pfb_gas(msg: MsgPayForBlobs, gas_limit: int, gas_per_blob_byte: int) -> None:
+    """MinGasPFBDecorator: the gas limit must cover the blob gas."""
+    from celestia_app_tpu.modules.blob.types import gas_to_consume
+
+    needed = gas_to_consume(msg.blob_sizes, gas_per_blob_byte)
+    if gas_limit < needed:
+        raise AnteError(
+            f"gas limit {gas_limit} insufficient for blobs needing {needed}"
+        )
+
+
+def _check_blob_shares(
+    msg: MsgPayForBlobs, gov_max_square_size: int, app_version: int
+) -> None:
+    """BlobShareDecorator (v2) / MaxTotalBlobSize (v1): blobs must be able to
+    fit a square at all."""
+    cap = gov_max_square_size * gov_max_square_size
+    if app_version <= 1:
+        # v1: bound total blob *bytes* by the square capacity
+        # (x/blob/ante/max_total_blob_size_ante.go:25).
+        max_bytes = cap * CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
+        if sum(msg.blob_sizes) > max_bytes:
+            raise AnteError(f"total blob size exceeds {max_bytes} bytes")
+    else:
+        shares = sum(sparse_shares_needed(s) for s in msg.blob_sizes)
+        if shares > cap:
+            raise AnteError(
+                f"blobs need {shares} shares > square capacity {cap}"
+            )

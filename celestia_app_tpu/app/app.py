@@ -1,0 +1,341 @@
+"""The ABCI application: proposal construction, validation, and execution.
+
+Behavioral parity with the reference app package:
+
+  PrepareProposal  app/prepare_proposal.go:22-91   filter -> build square ->
+                                                   RS-extend -> DAH -> root
+  ProcessProposal  app/process_proposal.go:24-158  decode/validate every tx,
+                                                   reconstruct, compare root
+  CheckTx          app/check_tx.go:16-54           BlobTx unwrap + ante
+  Finalize/Commit  app/app.go:446-480              mint BeginBlock, tx
+                                                   execution, signal-driven
+                                                   upgrades, state commit
+
+The square pipeline below FilterTxs runs on the TPU via the fused
+extend+NMT+DAH program (da/eds.py) — the offload target of SURVEY §3.2.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from celestia_app_tpu.constants import (
+    DEFAULT_GAS_PER_BLOB_BYTE,
+    DEFAULT_GOV_MAX_SQUARE_SIZE,
+    LATEST_VERSION,
+    SQUARE_SIZE_UPPER_BOUND,
+)
+from celestia_app_tpu.app.ante import AnteError, run_ante
+from celestia_app_tpu.da import DataAvailabilityHeader, extend_shares, min_data_availability_header
+from celestia_app_tpu.modules.blob.types import BlobTxError, gas_to_consume, validate_blob_tx
+from celestia_app_tpu.modules.minfee import MinFeeKeeper
+from celestia_app_tpu.modules.mint.minter import Minter
+from celestia_app_tpu.modules.signal.keeper import SignalError, SignalKeeper
+from celestia_app_tpu.square import SquareOverflow
+from celestia_app_tpu.square import builder as square
+from celestia_app_tpu.state.accounts import AuthKeeper, BankKeeper, FEE_COLLECTOR
+from celestia_app_tpu.state.dec import Dec
+from celestia_app_tpu.state.staking import StakingKeeper, Validator
+from celestia_app_tpu.state.store import CommitStore, KVStore
+from celestia_app_tpu.tx.envelopes import unmarshal_blob_tx
+from celestia_app_tpu.tx.messages import (
+    MsgPayForBlobs,
+    MsgSend,
+    MsgSignalVersion,
+    MsgTryUpgrade,
+)
+from celestia_app_tpu.tx.sign import Tx
+
+
+@dataclass(frozen=True)
+class GenesisAccount:
+    address: str
+    balance: int  # utia
+    pubkey: bytes = b""
+
+
+@dataclass(frozen=True)
+class Genesis:
+    chain_id: str
+    genesis_time_ns: int
+    accounts: tuple[GenesisAccount, ...] = ()
+    validators: tuple[Validator, ...] = ()
+    app_version: int = LATEST_VERSION
+    gov_max_square_size: int = DEFAULT_GOV_MAX_SQUARE_SIZE
+
+
+@dataclass(frozen=True)
+class BlockData:
+    """PrepareProposal response payload (celestia-core BlockData fork fields,
+    app/prepare_proposal.go:84-90)."""
+
+    txs: tuple[bytes, ...]
+    square_size: int
+    hash: bytes  # the DAH data root
+
+
+@dataclass
+class TxResult:
+    code: int  # 0 = ok
+    log: str = ""
+    gas_wanted: int = 0
+    gas_used: int = 0
+    events: list = field(default_factory=list)
+
+
+class Ctx:
+    """A branched state view for one proposal / tx / block."""
+
+    def __init__(self, store: KVStore, height: int, time_ns: int, app_version: int):
+        self.store = store
+        self.height = height
+        self.time_ns = time_ns
+        self.app_version = app_version
+        self.auth = AuthKeeper(store)
+        self.bank = BankKeeper(store)
+        self.staking = StakingKeeper(store)
+
+    def branch(self) -> "Ctx":
+        return Ctx(self.store.branch(), self.height, self.time_ns, self.app_version)
+
+
+class App:
+    """The celestia state machine with a TPU square pipeline."""
+
+    def __init__(self, node_min_gas_price: Dec | None = None):
+        self.cms = CommitStore()
+        self.chain_id = ""
+        self.app_version = LATEST_VERSION
+        self.height = 0
+        self.genesis_time_ns = 0
+        self.last_block_time_ns = 0
+        self.gov_max_square_size = DEFAULT_GOV_MAX_SQUARE_SIZE
+        self.gas_per_blob_byte = DEFAULT_GAS_PER_BLOB_BYTE
+        self.node_min_gas_price = node_min_gas_price or Dec.from_str("0.002")
+        self.minter = Minter.default()
+        self._check_state: KVStore | None = None
+
+    # --- keeper views over committed state ---------------------------------
+    @property
+    def minfee(self) -> MinFeeKeeper:
+        return MinFeeKeeper(self.cms.working)
+
+    @property
+    def signal(self) -> SignalKeeper:
+        return SignalKeeper(self.cms.working, StakingKeeper(self.cms.working))
+
+    def max_effective_square_size(self) -> int:
+        """min(gov, hard cap) — reference app/square_size.go:9-23."""
+        return min(self.gov_max_square_size, SQUARE_SIZE_UPPER_BOUND)
+
+    # --- genesis ------------------------------------------------------------
+    def init_chain(self, genesis: Genesis) -> None:
+        if self.height != 0:
+            raise RuntimeError("chain already initialized")
+        self.chain_id = genesis.chain_id
+        self.app_version = genesis.app_version
+        self.genesis_time_ns = genesis.genesis_time_ns
+        self.last_block_time_ns = genesis.genesis_time_ns
+        self.gov_max_square_size = genesis.gov_max_square_size
+        ctx = Ctx(self.cms.working, 0, genesis.genesis_time_ns, self.app_version)
+        for acc in genesis.accounts:
+            a = ctx.auth.create_account(acc.address, acc.pubkey)
+            ctx.auth.set_account(a)
+            if acc.balance:
+                ctx.bank.mint(acc.address, acc.balance)
+        for v in genesis.validators:
+            ctx.staking.set_validator(v)
+        self.cms.commit(0)
+        self._check_state = None
+
+    # --- CheckTx (mempool admission, app/check_tx.go:16-54) ----------------
+    def check_tx(self, raw: bytes) -> TxResult:
+        if self._check_state is None:
+            self._check_state = self.cms.working.branch()
+        ctx = Ctx(
+            self._check_state, self.height + 1, self.last_block_time_ns, self.app_version
+        )
+        btx = unmarshal_blob_tx(raw)
+        inner = raw
+        if btx is not None:
+            try:
+                validate_blob_tx(btx)
+            except BlobTxError as e:
+                return TxResult(code=11, log=str(e))
+            inner = btx.tx
+        try:
+            tx = Tx.unmarshal(inner)
+            res = run_ante(self, ctx, tx, is_check_tx=True)
+        except (AnteError, ValueError) as e:
+            return TxResult(code=1, log=str(e))
+        return TxResult(code=0, gas_wanted=res.gas_wanted, events=[("priority", res.priority)])
+
+    # --- PrepareProposal (app/prepare_proposal.go:22-91) --------------------
+    def prepare_proposal(self, raw_txs: list[bytes]) -> BlockData:
+        filtered = self._filter_txs(raw_txs)
+        sq, kept = square.build(filtered, self.max_effective_square_size())
+        if sq.is_empty():
+            dah = min_data_availability_header()
+            return BlockData(tuple(kept), 1, dah.hash())
+        eds = extend_shares(sq.share_bytes())
+        dah = DataAvailabilityHeader.from_eds(eds)
+        return BlockData(tuple(kept), sq.size, dah.hash())
+
+    def _filter_txs(self, raw_txs: list[bytes]) -> list[bytes]:
+        """FilterTxs (app/validate_txs.go:32): ante-validate on a branched
+        state, drop failures, normal txs before blob txs."""
+        ctx = Ctx(
+            self.cms.working.branch(),
+            self.height + 1,
+            self.last_block_time_ns,
+            self.app_version,
+        )
+        normal: list[bytes] = []
+        blob: list[bytes] = []
+        for raw in raw_txs:
+            btx = unmarshal_blob_tx(raw)
+            if btx is None:
+                try:
+                    tx = Tx.unmarshal(raw)
+                    if any(isinstance(m, MsgPayForBlobs) for m in tx.msgs()):
+                        continue  # PFB outside a BlobTx is invalid
+                    run_ante(self, ctx, tx, is_check_tx=False)
+                    normal.append(raw)
+                except (AnteError, ValueError):
+                    continue
+            else:
+                try:
+                    validate_blob_tx(btx)
+                    run_ante(self, ctx, Tx.unmarshal(btx.tx), is_check_tx=False)
+                    blob.append(raw)
+                except (AnteError, BlobTxError, ValueError):
+                    continue
+        return normal + blob
+
+    # --- ProcessProposal (app/process_proposal.go:24-158) -------------------
+    def process_proposal(self, data: BlockData) -> bool:
+        try:
+            return self._process_proposal(data)
+        except Exception:
+            # recover() -> reject (process_proposal.go:29-35)
+            return False
+
+    def _process_proposal(self, data: BlockData) -> bool:
+        ctx = Ctx(
+            self.cms.working.branch(),
+            self.height + 1,
+            self.last_block_time_ns,
+            self.app_version,
+        )
+        for raw in data.txs:
+            btx = unmarshal_blob_tx(raw)
+            if btx is None:
+                tx = Tx.unmarshal(raw)
+                if any(isinstance(m, MsgPayForBlobs) for m in tx.msgs()):
+                    return False  # PFB must ride in a BlobTx (:77-88)
+                run_ante(self, ctx, tx, is_check_tx=False)
+            else:
+                validate_blob_tx(btx)
+                run_ante(self, ctx, Tx.unmarshal(btx.tx), is_check_tx=False)
+
+        sq = square.construct(list(data.txs), self.max_effective_square_size())
+        if sq.size != data.square_size:
+            return False  # square-size equality (:133)
+        if sq.is_empty():
+            return min_data_availability_header().hash() == data.hash
+        eds = extend_shares(sq.share_bytes())
+        dah = DataAvailabilityHeader.from_eds(eds)
+        return dah.hash() == data.hash  # root equality (:152)
+
+    # --- block execution ----------------------------------------------------
+    def finalize_block(self, time_ns: int, txs: list[bytes]) -> list[TxResult]:
+        height = self.height + 1
+        block_store = self.cms.working.branch()
+        ctx = Ctx(block_store, height, time_ns, self.app_version)
+
+        self._begin_block(ctx, time_ns)
+        results = [self._deliver_tx(ctx, raw) for raw in txs]
+        self._end_block(ctx, height)
+
+        self.cms.working.write_back(block_store)
+        self.height = height
+        self.last_block_time_ns = time_ns
+        return results
+
+    def commit(self) -> bytes:
+        app_hash = self.cms.commit(self.height)
+        self._check_state = None  # reset mempool check state each block
+        return app_hash
+
+    def _begin_block(self, ctx: Ctx, time_ns: int) -> None:
+        """x/mint BeginBlocker (x/mint/abci.go:14-20)."""
+        supply = ctx.bank.supply()
+        self.minter.update(self.genesis_time_ns, time_ns, supply)
+        prev = (
+            self.minter.previous_block_time_ns
+            if self.minter.previous_block_time_ns is not None
+            else self.last_block_time_ns
+        )
+        provision = self.minter.calculate_block_provision(time_ns, prev)
+        if provision > 0:
+            ctx.bank.mint(FEE_COLLECTOR, provision)
+        self.minter.previous_block_time_ns = time_ns
+
+    def _deliver_tx(self, block_ctx: Ctx, raw: bytes) -> TxResult:
+        btx = unmarshal_blob_tx(raw)
+        inner = btx.tx if btx is not None else raw
+        tx_ctx = block_ctx.branch()
+        try:
+            tx = Tx.unmarshal(inner)
+            ante_res = run_ante(self, tx_ctx, tx, is_check_tx=False)
+        except (AnteError, ValueError) as e:
+            return TxResult(code=1, log=str(e))
+
+        gas_used = 0
+        events: list = []
+        try:
+            for msg in tx.msgs():
+                used, evts = self._handle_msg(tx_ctx, msg, ante_res.gas_wanted - gas_used)
+                gas_used += used
+                events.extend(evts)
+        except Exception as e:
+            return TxResult(
+                code=2, log=str(e), gas_wanted=ante_res.gas_wanted, gas_used=gas_used
+            )
+        block_ctx.store.write_back(tx_ctx.store)
+        return TxResult(
+            code=0, gas_wanted=ante_res.gas_wanted, gas_used=gas_used, events=events
+        )
+
+    def _handle_msg(self, ctx: Ctx, msg, gas_remaining: int):
+        if isinstance(msg, MsgSend):
+            total = sum(c.amount for c in msg.amount if c.denom == "utia")
+            ctx.bank.send(msg.from_address, msg.to_address, total)
+            return 0, [("transfer", msg.from_address, msg.to_address, total)]
+        if isinstance(msg, MsgPayForBlobs):
+            # keeper.PayForBlobs (x/blob/keeper/keeper.go:43-57): consume
+            # shares x 512 x gasPerBlobByte, emit the event.
+            gas = gas_to_consume(msg.blob_sizes, self.gas_per_blob_byte)
+            if gas > gas_remaining:
+                raise ValueError(
+                    f"out of gas: blob gas {gas} > remaining {gas_remaining}"
+                )
+            return gas, [("celestia.blob.v1.EventPayForBlobs", msg.signer, msg.blob_sizes)]
+        if isinstance(msg, MsgSignalVersion):
+            keeper = SignalKeeper(ctx.store, ctx.staking)
+            keeper.signal_version(msg.validator_address, msg.version, self.app_version)
+            return 0, []
+        if isinstance(msg, MsgTryUpgrade):
+            keeper = SignalKeeper(ctx.store, ctx.staking)
+            keeper.try_upgrade(ctx.height, self.app_version)
+            return 0, []
+        raise ValueError(f"no handler for {type(msg).__name__}")
+
+    def _end_block(self, ctx: Ctx, height: int) -> None:
+        """Signal-based upgrade check (app/app.go:472-477)."""
+        if self.app_version >= 2:
+            keeper = SignalKeeper(ctx.store, ctx.staking)
+            up = keeper.should_upgrade(height)
+            if up is not None:
+                self.app_version = up.app_version
+                keeper.reset_tally()

@@ -1,0 +1,125 @@
+"""Auth + bank state keepers over the KV store.
+
+The minimal stateful substrate the reference app needs from cosmos-sdk
+auth/bank for its tx flow: account numbers/sequences/pubkeys for signature
+checks (ante), balances for fees and sends, module accounts for fee
+collection and minting.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from celestia_app_tpu.constants import BOND_DENOM
+from celestia_app_tpu.encoding.proto import (
+    WIRE_LEN,
+    WIRE_VARINT,
+    decode_fields,
+    encode_bytes_field,
+    encode_varint_field,
+)
+from celestia_app_tpu.state.store import KVStore
+
+FEE_COLLECTOR = "fee_collector"
+MINT_MODULE = "mint"
+
+_ACC_PREFIX = b"auth/acc/"
+_BAL_PREFIX = b"bank/bal/"
+_SUPPLY_KEY = b"bank/supply/"
+_GLOBAL_ACC_NUM = b"auth/global_account_number"
+
+
+@dataclass
+class Account:
+    address: str
+    pubkey: bytes  # 33-byte compressed secp256k1, b"" until first known
+    account_number: int
+    sequence: int
+
+    def marshal(self) -> bytes:
+        return (
+            encode_bytes_field(1, self.address.encode())
+            + encode_bytes_field(2, self.pubkey)
+            + encode_varint_field(3, self.account_number)
+            + encode_varint_field(4, self.sequence)
+        )
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "Account":
+        addr, pk, num, seq = "", b"", 0, 0
+        for fnum, wt, val in decode_fields(raw):
+            if fnum == 1 and wt == WIRE_LEN:
+                addr = val.decode()
+            elif fnum == 2 and wt == WIRE_LEN:
+                pk = val
+            elif fnum == 3 and wt == WIRE_VARINT:
+                num = val
+            elif fnum == 4 and wt == WIRE_VARINT:
+                seq = val
+        return cls(addr, pk, num, seq)
+
+
+class AuthKeeper:
+    def __init__(self, store: KVStore):
+        self.store = store
+
+    def get_account(self, address: str) -> Account | None:
+        raw = self.store.get(_ACC_PREFIX + address.encode())
+        return Account.unmarshal(raw) if raw is not None else None
+
+    def set_account(self, acc: Account) -> None:
+        self.store.set(_ACC_PREFIX + acc.address.encode(), acc.marshal())
+
+    def create_account(self, address: str, pubkey: bytes = b"") -> Account:
+        n = int.from_bytes(self.store.get(_GLOBAL_ACC_NUM) or b"\x00", "big")
+        self.store.set(_GLOBAL_ACC_NUM, (n + 1).to_bytes(8, "big"))
+        acc = Account(address, pubkey, n, 0)
+        self.set_account(acc)
+        return acc
+
+    def get_or_create(self, address: str) -> Account:
+        return self.get_account(address) or self.create_account(address)
+
+
+class BankKeeper:
+    def __init__(self, store: KVStore):
+        self.store = store
+
+    def _key(self, address: str, denom: str) -> bytes:
+        return _BAL_PREFIX + address.encode() + b"/" + denom.encode()
+
+    def balance(self, address: str, denom: str = BOND_DENOM) -> int:
+        raw = self.store.get(self._key(address, denom))
+        return int.from_bytes(raw, "big") if raw else 0
+
+    def _set_balance(self, address: str, denom: str, amount: int) -> None:
+        if amount < 0:
+            raise ValueError("negative balance")
+        self.store.set(self._key(address, denom), amount.to_bytes(16, "big"))
+
+    def send(self, sender: str, recipient: str, amount: int, denom: str = BOND_DENOM) -> None:
+        bal = self.balance(sender, denom)
+        if bal < amount:
+            raise ValueError(
+                f"insufficient funds: {sender} has {bal}{denom}, needs {amount}"
+            )
+        self._set_balance(sender, denom, bal - amount)
+        self._set_balance(recipient, denom, self.balance(recipient, denom) + amount)
+
+    def mint(self, recipient: str, amount: int, denom: str = BOND_DENOM) -> None:
+        self._set_balance(recipient, denom, self.balance(recipient, denom) + amount)
+        self._set_supply(denom, self.supply(denom) + amount)
+
+    def burn(self, holder: str, amount: int, denom: str = BOND_DENOM) -> None:
+        bal = self.balance(holder, denom)
+        if bal < amount:
+            raise ValueError("burn exceeds balance")
+        self._set_balance(holder, denom, bal - amount)
+        self._set_supply(denom, self.supply(denom) - amount)
+
+    def supply(self, denom: str = BOND_DENOM) -> int:
+        raw = self.store.get(_SUPPLY_KEY + denom.encode())
+        return int.from_bytes(raw, "big") if raw else 0
+
+    def _set_supply(self, denom: str, amount: int) -> None:
+        self.store.set(_SUPPLY_KEY + denom.encode(), amount.to_bytes(16, "big"))

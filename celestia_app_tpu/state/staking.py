@@ -1,0 +1,77 @@
+"""Staking-lite: the validator-set state the app's own modules consume.
+
+The reference delegates staking to cosmos-sdk x/staking; the in-repo modules
+only read it (x/signal tallies power, x/blobstream snapshots valsets).  This
+keeper stores validators (operator address, consensus pubkey, power) with
+deterministic iteration — enough surface for those consumers and for the
+test harness's deterministic validator sets (test/util/test_app.go:214).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from celestia_app_tpu.encoding.proto import (
+    WIRE_LEN,
+    WIRE_VARINT,
+    decode_fields,
+    encode_bytes_field,
+    encode_varint_field,
+)
+from celestia_app_tpu.state.store import KVStore
+
+_VAL_PREFIX = b"staking/val/"
+
+
+@dataclass(frozen=True)
+class Validator:
+    address: str  # operator address (bech32)
+    pubkey: bytes
+    power: int
+
+    def marshal(self) -> bytes:
+        return (
+            encode_bytes_field(1, self.address.encode())
+            + encode_bytes_field(2, self.pubkey)
+            + encode_varint_field(3, self.power)
+        )
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "Validator":
+        addr, pk, power = "", b"", 0
+        for num, wt, val in decode_fields(raw):
+            if num == 1 and wt == WIRE_LEN:
+                addr = val.decode()
+            elif num == 2 and wt == WIRE_LEN:
+                pk = val
+            elif num == 3 and wt == WIRE_VARINT:
+                power = val
+        return cls(addr, pk, power)
+
+
+class StakingKeeper:
+    def __init__(self, store: KVStore):
+        self.store = store
+
+    def set_validator(self, v: Validator) -> None:
+        self.store.set(_VAL_PREFIX + v.address.encode(), v.marshal())
+
+    def remove_validator(self, address: str) -> None:
+        self.store.delete(_VAL_PREFIX + address.encode())
+
+    def get_validator(self, address: str) -> Validator | None:
+        raw = self.store.get(_VAL_PREFIX + address.encode())
+        return Validator.unmarshal(raw) if raw else None
+
+    def has_validator(self, address: str) -> bool:
+        return self.get_validator(address) is not None
+
+    def get_power(self, address: str) -> int:
+        v = self.get_validator(address)
+        return v.power if v else 0
+
+    def validators(self) -> list[Validator]:
+        return [Validator.unmarshal(v) for _, v in self.store.iterate(_VAL_PREFIX)]
+
+    def total_power(self) -> int:
+        return sum(v.power for v in self.validators())

@@ -1,0 +1,113 @@
+"""Deterministic versioned KV store (the multistore analog).
+
+Replaces the reference's IAVL-backed CommitMultiStore (app/app.go:435,
+LoadHeight :592) with the simplest structure that preserves the contracts
+the app actually relies on:
+
+  * deterministic app hash over committed state (consensus determinism,
+    pinned by the reference's TestConsistentAppHash,
+    app/test/consistent_apphash_test.go:47);
+  * branch/write-back semantics (CacheContext) for proposal handling and
+    per-tx atomicity;
+  * per-height committed versions for restart/rollback/export
+    (checkpoint/resume, SURVEY §5).
+
+Not a merkle store: state proofs against the app hash are out of scope for
+the DA-focused framework (the reference's light clients prove against the
+*data* root, which is fully supported in proof/).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+
+class KVStore:
+    """A mutable string->bytes map with branch/commit semantics."""
+
+    def __init__(self, data: dict[bytes, bytes] | None = None):
+        self._data: dict[bytes, bytes] = dict(data) if data else {}
+
+    def get(self, key: bytes) -> bytes | None:
+        return self._data.get(key)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        if not isinstance(value, bytes):
+            raise TypeError("store values must be bytes")
+        self._data[key] = value
+
+    def delete(self, key: bytes) -> None:
+        self._data.pop(key, None)
+
+    def has(self, key: bytes) -> bool:
+        return key in self._data
+
+    def iterate(self, prefix: bytes) -> list[tuple[bytes, bytes]]:
+        """Deterministic (sorted) iteration over a key prefix."""
+        return sorted(
+            (k, v) for k, v in self._data.items() if k.startswith(prefix)
+        )
+
+    def branch(self) -> "KVStore":
+        """An isolated copy; apply back with `write_back`."""
+        return KVStore(self._data)
+
+    def write_back(self, branch: "KVStore") -> None:
+        self._data = dict(branch._data)
+
+    def snapshot(self) -> dict[bytes, bytes]:
+        return dict(self._data)
+
+    def hash(self) -> bytes:
+        """Deterministic digest of the full contents."""
+        h = hashlib.sha256()
+        for k, v in sorted(self._data.items()):
+            h.update(len(k).to_bytes(4, "big"))
+            h.update(k)
+            h.update(len(v).to_bytes(4, "big"))
+            h.update(v)
+        return h.digest()
+
+
+class CommitStore:
+    """Height-versioned commits of a KVStore (restart / rollback / export)."""
+
+    def __init__(self):
+        self.working = KVStore()
+        self._committed: dict[int, dict[bytes, bytes]] = {}
+        self.last_height = 0
+        self.last_app_hash = b"\x00" * 32
+
+    def commit(self, height: int) -> bytes:
+        self._committed[height] = self.working.snapshot()
+        self.last_height = height
+        self.last_app_hash = self.working.hash()
+        return self.last_app_hash
+
+    def load_height(self, height: int) -> None:
+        if height == 0:
+            self.working = KVStore()
+        else:
+            if height not in self._committed:
+                raise KeyError(f"no committed state at height {height}")
+            self.working = KVStore(self._committed[height])
+        self.last_height = height
+        self.last_app_hash = self.working.hash() if height else b"\x00" * 32
+
+    def rollback(self) -> int:
+        """Drop the latest committed height (server rollback command)."""
+        if self.last_height == 0:
+            raise ValueError("nothing to roll back")
+        self._committed.pop(self.last_height, None)
+        self.load_height(self.last_height - 1) if self.last_height > 1 else self.load_height(0)
+        return self.last_height
+
+    def prune(self, keep_recent: int) -> None:
+        cutoff = self.last_height - keep_recent
+        for h in [h for h in self._committed if h < cutoff]:
+            del self._committed[h]
+
+    def export(self, height: int | None = None) -> dict[bytes, bytes]:
+        if height is None:
+            height = self.last_height
+        return dict(self._committed[height])

@@ -1,0 +1,7 @@
+from celestia_app_tpu.testutil.testnode import (
+    TestNode,
+    deterministic_genesis,
+    funded_keys,
+)
+
+__all__ = ["TestNode", "deterministic_genesis", "funded_keys"]

@@ -1,0 +1,90 @@
+"""In-process single-node chain harness.
+
+The workhorse harness tier of the reference test strategy (SURVEY §4:
+test/util/testnode NewNetwork) without a consensus engine: TestNode drives
+the real App through the full block lifecycle — CheckTx admission,
+PrepareProposal, ProcessProposal self-validation, Finalize, Commit — exactly
+as the proposer's node would, with deterministic keys and genesis
+(test/util/test_app.go:63 SetupTestAppWithGenesisValSet analog).
+"""
+
+from __future__ import annotations
+
+from celestia_app_tpu.app import App, BlockData, Genesis, GenesisAccount, TxResult
+from celestia_app_tpu.crypto import PrivateKey
+from celestia_app_tpu.state.dec import Dec
+from celestia_app_tpu.state.staking import Validator
+
+GENESIS_TIME_NS = 1_700_000_000 * 10**9
+BLOCK_INTERVAL_NS = 15 * 10**9  # GoalBlockTime
+DEFAULT_BALANCE = 10**12  # 1M TIA in utia
+
+
+def funded_keys(n: int) -> list[PrivateKey]:
+    return [PrivateKey.from_seed(f"account-{i}".encode()) for i in range(n)]
+
+
+def deterministic_genesis(
+    keys: list[PrivateKey],
+    chain_id: str = "tpu-test-chain",
+    app_version: int = 2,
+    n_validators: int = 3,
+    gov_max_square_size: int = 64,
+) -> Genesis:
+    accounts = tuple(
+        GenesisAccount(k.public_key().address(), DEFAULT_BALANCE, k.public_key().bytes)
+        for k in keys
+    )
+    validators = tuple(
+        Validator(
+            PrivateKey.from_seed(f"validator-{i}".encode()).public_key().address(),
+            PrivateKey.from_seed(f"validator-{i}".encode()).public_key().bytes,
+            power=100,
+        )
+        for i in range(n_validators)
+    )
+    return Genesis(
+        chain_id=chain_id,
+        genesis_time_ns=GENESIS_TIME_NS,
+        accounts=accounts,
+        validators=validators,
+        app_version=app_version,
+        gov_max_square_size=gov_max_square_size,
+    )
+
+
+class TestNode:
+    """A single-process chain: mempool + proposer + validator in one."""
+
+    __test__ = False  # not a pytest class
+
+    def __init__(self, genesis: Genesis | None = None, keys: list[PrivateKey] | None = None):
+        self.keys = keys if keys is not None else funded_keys(4)
+        self.app = App(node_min_gas_price=Dec.from_str("0.000001"))
+        self.app.init_chain(genesis or deterministic_genesis(self.keys))
+        self.mempool: list[bytes] = []
+        self.blocks: list[BlockData] = []
+
+    @property
+    def chain_id(self) -> str:
+        return self.app.chain_id
+
+    def broadcast(self, raw_tx: bytes) -> TxResult:
+        res = self.app.check_tx(raw_tx)
+        if res.code == 0:
+            self.mempool.append(raw_tx)
+        return res
+
+    def produce_block(self) -> tuple[BlockData, list[TxResult]]:
+        """One full consensus round against the app itself."""
+        time_ns = (
+            self.app.last_block_time_ns + BLOCK_INTERVAL_NS
+        )
+        data = self.app.prepare_proposal(self.mempool)
+        if not self.app.process_proposal(data):
+            raise AssertionError("node rejected its own proposal")
+        results = self.app.finalize_block(time_ns, list(data.txs))
+        self.app.commit()
+        self.mempool = []
+        self.blocks.append(data)
+        return data, results

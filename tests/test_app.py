@@ -1,0 +1,207 @@
+"""App-layer tests: the real state machine driven over its ABCI surface.
+
+Tier-2 of the reference test strategy (SURVEY §4: app/test/*): a real App on
+an in-memory store, no consensus, ABCI methods called directly.
+"""
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.app import App, BlockData
+from celestia_app_tpu.constants import PFB_GAS_FIXED_COST
+from celestia_app_tpu.crypto import PrivateKey
+from celestia_app_tpu.modules.blob.types import estimate_gas, new_msg_pay_for_blobs
+from celestia_app_tpu.shares.namespace import Namespace
+from celestia_app_tpu.shares.sparse import Blob
+from celestia_app_tpu.state.dec import Dec
+from celestia_app_tpu.testutil import TestNode, deterministic_genesis, funded_keys
+from celestia_app_tpu.tx.envelopes import BlobTx
+from celestia_app_tpu.tx.messages import Coin, MsgSend
+from celestia_app_tpu.tx.sign import Fee, build_and_sign
+
+RNG = np.random.default_rng(31)
+
+
+def rand_bytes(n: int) -> bytes:
+    return RNG.integers(0, 256, n, dtype=np.uint8).tobytes()
+
+
+def user_ns(tag: int) -> Namespace:
+    return Namespace.v0(bytes([tag]) * 10)
+
+
+@pytest.fixture()
+def node() -> TestNode:
+    return TestNode()
+
+
+def pfb_tx(node: TestNode, key: PrivateKey, blobs, seq: int, gas=None, fee_utia=None):
+    addr = key.public_key().address()
+    msg = new_msg_pay_for_blobs(addr, list(blobs))
+    gas = gas or estimate_gas([len(b.data) for b in blobs])
+    fee = Fee((Coin("utia", fee_utia if fee_utia is not None else gas),), gas)
+    acct = _account(node, addr)
+    raw_tx = build_and_sign([msg], key, node.chain_id, acct.account_number, seq, fee)
+    return BlobTx(raw_tx, tuple(blobs)).marshal()
+
+
+def send_tx(node: TestNode, key: PrivateKey, to: str, amount: int, seq: int):
+    addr = key.public_key().address()
+    msg = MsgSend(addr, to, (Coin("utia", amount),))
+    fee = Fee((Coin("utia", 20_000),), 100_000)
+    acct = _account(node, addr)
+    return build_and_sign([msg], key, node.chain_id, acct.account_number, seq, fee)
+
+
+def _account(node: TestNode, addr: str):
+    from celestia_app_tpu.state.accounts import AuthKeeper
+
+    return AuthKeeper(node.app.cms.working).get_account(addr)
+
+
+class TestLifecycle:
+    def test_empty_block(self, node):
+        data, results = node.produce_block()
+        assert data.square_size == 1
+        assert results == []
+        assert node.app.height == 1
+
+    def test_pfb_end_to_end(self, node):
+        key = node.keys[0]
+        blobs = (Blob(user_ns(7), rand_bytes(20_000)),)
+        res = node.broadcast(pfb_tx(node, key, blobs, seq=0))
+        assert res.code == 0, res.log
+        data, results = node.produce_block()
+        assert len(data.txs) == 1
+        assert data.square_size > 1
+        [r] = results
+        assert r.code == 0, r.log
+        assert r.gas_used > 0
+        assert any(e[0].endswith("EventPayForBlobs") for e in r.events)
+
+    def test_send_and_balances(self, node):
+        a, b = node.keys[0], node.keys[1]
+        from celestia_app_tpu.state.accounts import BankKeeper
+
+        addr_b = b.public_key().address()
+        before = BankKeeper(node.app.cms.working).balance(addr_b)
+        node.broadcast(send_tx(node, a, addr_b, 5000, seq=0))
+        _, results = node.produce_block()
+        assert results[0].code == 0, results[0].log
+        after = BankKeeper(node.app.cms.working).balance(addr_b)
+        assert after - before == 5000
+
+    def test_multiple_txs_same_signer(self, node):
+        key = node.keys[0]
+        to = node.keys[1].public_key().address()
+        node.broadcast(send_tx(node, key, to, 100, seq=0))
+        node.broadcast(send_tx(node, key, to, 200, seq=1))
+        _, results = node.produce_block()
+        assert [r.code for r in results] == [0, 0]
+
+    def test_app_hash_deterministic(self):
+        hashes = []
+        for _ in range(2):
+            node = TestNode()
+            key = node.keys[0]
+            blobs = (Blob(user_ns(3), b"\x42" * 5000),)
+            node.broadcast(pfb_tx(node, key, blobs, seq=0))
+            node.produce_block()
+            hashes.append(node.app.cms.last_app_hash)
+        assert hashes[0] == hashes[1]
+
+    def test_fee_deducted(self, node):
+        from celestia_app_tpu.state.accounts import BankKeeper, FEE_COLLECTOR
+
+        key = node.keys[0]
+        addr = key.public_key().address()
+        bank = BankKeeper(node.app.cms.working)
+        before = bank.balance(addr)
+        blobs = (Blob(user_ns(1), rand_bytes(100)),)
+        gas = estimate_gas([100])
+        node.broadcast(pfb_tx(node, key, blobs, seq=0, gas=gas, fee_utia=gas))
+        node.produce_block()
+        bank2 = BankKeeper(node.app.cms.working)
+        assert bank2.balance(addr) == before - gas
+        assert bank2.balance(FEE_COLLECTOR) >= gas
+
+
+class TestCheckTx:
+    def test_rejects_bad_sequence(self, node):
+        key = node.keys[0]
+        to = node.keys[1].public_key().address()
+        assert node.broadcast(send_tx(node, key, to, 1, seq=5)).code != 0
+
+    def test_rejects_low_fee(self, node):
+        key = node.keys[0]
+        blobs = (Blob(user_ns(1), rand_bytes(100)),)
+        res = node.broadcast(pfb_tx(node, key, blobs, seq=0, fee_utia=0))
+        assert res.code != 0
+
+    def test_rejects_insufficient_pfb_gas(self, node):
+        key = node.keys[0]
+        blobs = (Blob(user_ns(1), rand_bytes(100_000)),)
+        res = node.broadcast(pfb_tx(node, key, blobs, seq=0, gas=80_000, fee_utia=80_000))
+        assert res.code != 0
+
+    def test_rejects_tampered_blob(self, node):
+        key = node.keys[0]
+        blob = Blob(user_ns(1), rand_bytes(500))
+        raw = pfb_tx(node, key, (blob,), seq=0)
+        from celestia_app_tpu.tx.envelopes import unmarshal_blob_tx
+
+        btx = unmarshal_blob_tx(raw)
+        evil = BlobTx(btx.tx, (Blob(blob.namespace, blob.data[:-1] + b"\x00"),)).marshal()
+        assert node.broadcast(evil).code != 0
+
+
+class TestProcessProposal:
+    def _valid_proposal(self, node):
+        key = node.keys[0]
+        blobs = (Blob(user_ns(5), rand_bytes(3000)),)
+        node.broadcast(pfb_tx(node, key, blobs, seq=0))
+        return node.app.prepare_proposal(node.mempool)
+
+    def test_accepts_own_proposal(self, node):
+        data = self._valid_proposal(node)
+        assert node.app.process_proposal(data)
+
+    def test_rejects_wrong_data_hash(self, node):
+        data = self._valid_proposal(node)
+        bad = BlockData(data.txs, data.square_size, bytes(32))
+        assert not node.app.process_proposal(bad)
+
+    def test_rejects_wrong_square_size(self, node):
+        data = self._valid_proposal(node)
+        bad = BlockData(data.txs, data.square_size * 2, data.hash)
+        assert not node.app.process_proposal(bad)
+
+    def test_rejects_tampered_blob(self, node):
+        from celestia_app_tpu.tx.envelopes import unmarshal_blob_tx
+
+        data = self._valid_proposal(node)
+        btx = unmarshal_blob_tx(data.txs[0])
+        evil_blob = Blob(btx.blobs[0].namespace, btx.blobs[0].data[:-1] + b"\x99")
+        evil = BlobTx(btx.tx, (evil_blob,)).marshal()
+        bad = BlockData((evil,), data.square_size, data.hash)
+        assert not node.app.process_proposal(bad)
+
+    def test_rejects_unsigned_injected_tx(self, node):
+        data = self._valid_proposal(node)
+        other = PrivateKey.from_seed(b"mallory")
+        msg = MsgSend(
+            other.public_key().address(), other.public_key().address(), (Coin("utia", 1),)
+        )
+        fake = build_and_sign([msg], other, node.chain_id, 99, 0, Fee((Coin("utia", 9000),), 90_000))
+        bad = BlockData((fake,) + data.txs, data.square_size, data.hash)
+        assert not node.app.process_proposal(bad)
+
+
+class TestFilterTxs:
+    def test_drops_invalid_keeps_valid(self, node):
+        key = node.keys[0]
+        to = node.keys[1].public_key().address()
+        good = send_tx(node, key, to, 100, seq=0)
+        bad_sig = good[:-10] + rand_bytes(10)
+        data = node.app.prepare_proposal([bad_sig, good, rand_bytes(80)])
+        assert data.txs == (good,)

@@ -1,0 +1,149 @@
+"""State-module tests: store, dec, mint schedule, signal, minfee, paramfilter."""
+
+import pytest
+
+from celestia_app_tpu.modules.minfee import DEFAULT_NETWORK_MIN_GAS_PRICE, MinFeeKeeper
+from celestia_app_tpu.modules.mint.minter import (
+    Minter,
+    NANOSECONDS_PER_YEAR,
+    calculate_inflation_rate,
+)
+from celestia_app_tpu.modules.paramfilter import ForbiddenParamError, validate_param_changes
+from celestia_app_tpu.modules.signal.keeper import (
+    DEFAULT_UPGRADE_HEIGHT_DELAY,
+    SignalError,
+    SignalKeeper,
+)
+from celestia_app_tpu.state.dec import Dec
+from celestia_app_tpu.state.staking import StakingKeeper, Validator
+from celestia_app_tpu.state.store import CommitStore, KVStore
+
+GENESIS = 1_700_000_000 * 10**9
+
+
+class TestStore:
+    def test_branch_isolation(self):
+        s = KVStore()
+        s.set(b"a", b"1")
+        b = s.branch()
+        b.set(b"a", b"2")
+        assert s.get(b"a") == b"1"
+        s.write_back(b)
+        assert s.get(b"a") == b"2"
+
+    def test_hash_independent_of_insertion_order(self):
+        s1, s2 = KVStore(), KVStore()
+        s1.set(b"x", b"1"); s1.set(b"y", b"2")
+        s2.set(b"y", b"2"); s2.set(b"x", b"1")
+        assert s1.hash() == s2.hash()
+
+    def test_commit_load_rollback(self):
+        cs = CommitStore()
+        cs.working.set(b"k", b"v1")
+        h1 = cs.commit(1)
+        cs.working.set(b"k", b"v2")
+        cs.commit(2)
+        cs.load_height(1)
+        assert cs.working.get(b"k") == b"v1"
+        assert cs.last_app_hash == h1
+
+
+class TestDec:
+    def test_str_roundtrip(self):
+        assert str(Dec.from_str("0.08")) == "0.080000000000000000"
+        assert Dec.from_str("1.5").truncate_int() == 1
+
+    def test_power(self):
+        # 0.9^2 = 0.81 exactly at 18 decimals.
+        assert Dec.from_str("0.9").power(2).raw == Dec.from_str("0.81").raw
+
+    def test_fraction(self):
+        assert Dec.from_fraction(1, 3).mul_int(3).truncate_int() in (0, 1)
+
+
+class TestMint:
+    def test_inflation_schedule(self):
+        # Year 0: 8%; year 1: 7.2%; year 10: 8*0.9^10 = 2.79%; floor at 1.5%.
+        assert str(calculate_inflation_rate(GENESIS, GENESIS)) == "0.080000000000000000"
+        y1 = GENESIS + NANOSECONDS_PER_YEAR
+        assert str(calculate_inflation_rate(GENESIS, y1)) == "0.072000000000000000"
+        y40 = GENESIS + 40 * NANOSECONDS_PER_YEAR
+        assert str(calculate_inflation_rate(GENESIS, y40)) == "0.015000000000000000"
+
+    def test_block_provision(self):
+        m = Minter.default()
+        m.update(GENESIS, GENESIS, total_supply=10**15)
+        # One 15s block of an 8%/yr schedule on 1e15 supply.
+        fifteen_s = 15 * 10**9
+        got = m.calculate_block_provision(GENESIS + fifteen_s, GENESIS)
+        expected = int(10**15 * 0.08 * fifteen_s / NANOSECONDS_PER_YEAR)
+        assert abs(got - expected) <= 1
+
+    def test_provision_sums_to_annual(self):
+        m = Minter.default()
+        m.update(GENESIS, GENESIS, total_supply=10**12)
+        step = NANOSECONDS_PER_YEAR // 1000
+        total = sum(
+            m.calculate_block_provision(GENESIS + (i + 1) * step, GENESIS + i * step)
+            for i in range(1000)
+        )
+        annual = m.annual_provisions.truncate_int()
+        assert abs(total - annual) < 1000  # truncation dust only
+
+
+def _staking_with(powers: dict[str, int]) -> StakingKeeper:
+    sk = StakingKeeper(KVStore())
+    for addr, p in powers.items():
+        sk.set_validator(Validator(addr, b"", p))
+    return sk
+
+
+class TestSignal:
+    def test_quorum_and_upgrade(self):
+        sk = _staking_with({"v1": 50, "v2": 30, "v3": 20})
+        keeper = SignalKeeper(KVStore(), sk)
+        keeper.signal_version("v1", 3, current_version=2)
+        keeper.signal_version("v2", 3, current_version=2)
+        assert keeper.try_upgrade(height=10, current_version=2) is None  # 80 < 83.33
+        keeper.signal_version("v3", 3, current_version=2)
+        up = keeper.try_upgrade(height=10, current_version=2)
+        assert up.app_version == 3
+        assert up.upgrade_height == 10 + DEFAULT_UPGRADE_HEIGHT_DELAY
+        assert keeper.should_upgrade(up.upgrade_height - 1) is None
+        assert keeper.should_upgrade(up.upgrade_height) == up
+
+    def test_signal_rules(self):
+        sk = _staking_with({"v1": 100})
+        keeper = SignalKeeper(KVStore(), sk)
+        with pytest.raises(SignalError):
+            keeper.signal_version("v1", 1, current_version=2)  # downgrade
+        with pytest.raises(SignalError):
+            keeper.signal_version("ghost", 3, current_version=2)  # not a validator
+        keeper.signal_version("v1", 3, current_version=2)
+        keeper.try_upgrade(height=1, current_version=2)
+        with pytest.raises(SignalError):
+            keeper.signal_version("v1", 4, current_version=2)  # pending upgrade
+
+    def test_reset_tally(self):
+        sk = _staking_with({"v1": 100})
+        keeper = SignalKeeper(KVStore(), sk)
+        keeper.signal_version("v1", 3, current_version=2)
+        keeper.try_upgrade(height=1, current_version=2)
+        keeper.reset_tally()
+        assert keeper.pending_upgrade() is None
+        assert keeper.tally() == (False, 0)
+
+
+class TestMinFee:
+    def test_default_and_set(self):
+        k = MinFeeKeeper(KVStore())
+        assert k.network_min_gas_price().raw == DEFAULT_NETWORK_MIN_GAS_PRICE.raw
+        k.set_network_min_gas_price(Dec.from_str("0.5"))
+        assert str(k.network_min_gas_price()) == "0.500000000000000000"
+
+
+class TestParamFilter:
+    def test_blocked(self):
+        with pytest.raises(ForbiddenParamError):
+            validate_param_changes([("staking", "BondDenom", "ufoo")])
+        validate_param_changes([("blob", "GovMaxSquareSize", "128")])
