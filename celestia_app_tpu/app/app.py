@@ -332,7 +332,11 @@ class App:
         raise ValueError(f"no handler for {type(msg).__name__}")
 
     def _end_block(self, ctx: Ctx, height: int) -> None:
-        """Signal-based upgrade check (app/app.go:472-477)."""
+        """Blobstream (v1 only) + signal-based upgrades (app/app.go:458-477)."""
+        if self.app_version == 1:
+            from celestia_app_tpu.modules.blobstream.keeper import BlobstreamKeeper
+
+            BlobstreamKeeper(ctx.store, ctx.staking).end_blocker(height, ctx.time_ns)
         if self.app_version >= 2:
             keeper = SignalKeeper(ctx.store, ctx.staking)
             up = keeper.should_upgrade(height)

@@ -1,0 +1,237 @@
+"""x/blobstream: Ethereum-bridge attestations (v1 only; off in v2+).
+
+Behavioral parity with reference x/blobstream (abci.go:28 EndBlocker,
+keeper_valset.go, keeper_data_commitment.go): every block, (a) snapshot the
+validator set when it first appears or when normalized power shifts by more
+than 5%, (b) emit a DataCommitment attestation for every elapsed
+DataCommitmentWindow of blocks (catching up in a loop), (c) prune
+attestations older than the 3-week expiry.  Attestations carry a global
+monotonically increasing nonce consumed by the BlobstreamX relayer.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from fractions import Fraction
+
+from celestia_app_tpu import merkle
+from celestia_app_tpu.encoding.proto import (
+    WIRE_LEN,
+    WIRE_VARINT,
+    decode_fields,
+    encode_bytes_field,
+    encode_varint_field,
+)
+from celestia_app_tpu.state.staking import StakingKeeper
+from celestia_app_tpu.state.store import KVStore
+
+DEFAULT_DATA_COMMITMENT_WINDOW = 400  # types/genesis.go:29
+SIGNIFICANT_POWER_DIFF = Fraction(5, 100)  # abci.go:26
+ATTESTATION_EXPIRY_NS = 3 * 7 * 24 * 3600 * 10**9  # 3 weeks
+
+_NONCE_KEY = b"blobstream/latest_nonce"
+_ATT_PREFIX = b"blobstream/att/"
+_EVM_PREFIX = b"blobstream/evm/"
+
+
+@dataclass(frozen=True)
+class BridgeValidator:
+    address: str
+    power: int
+
+
+@dataclass(frozen=True)
+class Valset:
+    nonce: int
+    height: int
+    time_ns: int
+    members: tuple[BridgeValidator, ...]
+
+    KIND = 1
+
+    def marshal(self) -> bytes:
+        out = (
+            encode_varint_field(1, self.KIND)
+            + encode_varint_field(2, self.nonce)
+            + encode_varint_field(3, self.height)
+            + encode_varint_field(4, self.time_ns)
+        )
+        for m in self.members:
+            out += encode_bytes_field(
+                5, encode_bytes_field(1, m.address.encode()) + encode_varint_field(2, m.power)
+            )
+        return out
+
+
+@dataclass(frozen=True)
+class DataCommitment:
+    nonce: int
+    begin_block: int  # inclusive
+    end_block: int  # exclusive (matches reference window semantics)
+    height: int
+    time_ns: int
+
+    KIND = 2
+
+    def marshal(self) -> bytes:
+        return (
+            encode_varint_field(1, self.KIND)
+            + encode_varint_field(2, self.nonce)
+            + encode_varint_field(3, self.begin_block)
+            + encode_varint_field(4, self.end_block)
+            + encode_varint_field(5, self.height)
+            + encode_varint_field(6, self.time_ns)
+        )
+
+
+def _unmarshal_attestation(raw: bytes):
+    fields = {num: val for num, wt, val in decode_fields(raw) if wt == WIRE_VARINT}
+    kind = fields.get(1)
+    if kind == Valset.KIND:
+        members = []
+        for num, wt, val in decode_fields(raw):
+            if num == 5 and wt == WIRE_LEN:
+                addr, power = "", 0
+                for mn, mwt, mval in decode_fields(val):
+                    if mn == 1 and mwt == WIRE_LEN:
+                        addr = mval.decode()
+                    elif mn == 2 and mwt == WIRE_VARINT:
+                        power = mval
+                members.append(BridgeValidator(addr, power))
+        return Valset(
+            fields.get(2, 0), fields.get(3, 0), fields.get(4, 0), tuple(members)
+        )
+    if kind == DataCommitment.KIND:
+        return DataCommitment(
+            fields.get(2, 0), fields.get(3, 0), fields.get(4, 0),
+            fields.get(5, 0), fields.get(6, 0),
+        )
+    raise ValueError(f"unknown attestation kind {kind}")
+
+
+def data_commitment_root(data_roots: list[tuple[int, bytes]]) -> bytes:
+    """Merkle root over (height, data_root) tuples for a commitment window.
+
+    The relayer-facing commitment the reference obtains from celestia-core's
+    DataCommitment RPC: a binary merkle over DataRootTuple(height, dataRoot)
+    leaves, encoded here as height(8B BE) || root.
+    """
+    leaves = [h.to_bytes(8, "big") + root for h, root in data_roots]
+    return merkle.hash_from_byte_slices(leaves)
+
+
+def _normalized_power_diff(
+    curr: list[BridgeValidator], last: list[BridgeValidator]
+) -> Fraction:
+    """Sum of |Δ normalized power| (Gravity PowerDiff semantics)."""
+    pc = sum(m.power for m in curr) or 1
+    pl = sum(m.power for m in last) or 1
+    addrs = {m.address for m in curr} | {m.address for m in last}
+    cm = {m.address: m.power for m in curr}
+    lm = {m.address: m.power for m in last}
+    return sum(
+        abs(Fraction(cm.get(a, 0), pc) - Fraction(lm.get(a, 0), pl)) for a in addrs
+    )
+
+
+class BlobstreamKeeper:
+    def __init__(
+        self,
+        store: KVStore,
+        staking: StakingKeeper,
+        data_commitment_window: int = DEFAULT_DATA_COMMITMENT_WINDOW,
+    ):
+        self.store = store
+        self.staking = staking
+        self.window = data_commitment_window
+
+    # --- nonces / storage --------------------------------------------------
+    def latest_nonce(self) -> int:
+        raw = self.store.get(_NONCE_KEY)
+        return int.from_bytes(raw, "big") if raw else 0
+
+    def _next_nonce(self) -> int:
+        n = self.latest_nonce() + 1
+        self.store.set(_NONCE_KEY, n.to_bytes(8, "big"))
+        return n
+
+    def _set_attestation(self, att) -> None:
+        self.store.set(_ATT_PREFIX + att.nonce.to_bytes(8, "big"), att.marshal())
+
+    def get_attestation(self, nonce: int):
+        raw = self.store.get(_ATT_PREFIX + nonce.to_bytes(8, "big"))
+        return _unmarshal_attestation(raw) if raw else None
+
+    def attestations(self) -> list:
+        return [_unmarshal_attestation(v) for _, v in self.store.iterate(_ATT_PREFIX)]
+
+    # --- EVM address registration (keeper/msg_server.go) -------------------
+    def register_evm_address(self, validator: str, evm_address: str) -> None:
+        if not self.staking.has_validator(validator):
+            raise ValueError(f"no validator {validator}")
+        if not (evm_address.startswith("0x") and len(evm_address) == 42):
+            raise ValueError(f"invalid EVM address {evm_address}")
+        self.store.set(_EVM_PREFIX + validator.encode(), evm_address.encode())
+
+    def evm_address(self, validator: str) -> str | None:
+        raw = self.store.get(_EVM_PREFIX + validator.encode())
+        return raw.decode() if raw else None
+
+    # --- EndBlocker --------------------------------------------------------
+    def end_blocker(self, height: int, time_ns: int) -> list:
+        created: list = []
+        created += self._handle_valset_request(height, time_ns)
+        created += self._handle_data_commitments(height, time_ns)
+        self._prune(time_ns)
+        return created
+
+    def _current_members(self) -> tuple[BridgeValidator, ...]:
+        return tuple(
+            BridgeValidator(v.address, v.power) for v in self.staking.validators()
+        )
+
+    def _latest_valset(self) -> Valset | None:
+        for att in reversed(self.attestations()):
+            if isinstance(att, Valset):
+                return att
+        return None
+
+    def _handle_valset_request(self, height: int, time_ns: int) -> list:
+        members = self._current_members()
+        if not members:
+            return []
+        latest = self._latest_valset()
+        need = latest is None or _normalized_power_diff(
+            list(members), list(latest.members)
+        ) > SIGNIFICANT_POWER_DIFF
+        if not need:
+            return []
+        vs = Valset(self._next_nonce(), height, time_ns, members)
+        self._set_attestation(vs)
+        return [vs]
+
+    def _latest_data_commitment(self) -> DataCommitment | None:
+        for att in reversed(self.attestations()):
+            if isinstance(att, DataCommitment):
+                return att
+        return None
+
+    def _handle_data_commitments(self, height: int, time_ns: int) -> list:
+        created: list = []
+        while True:
+            latest = self._latest_data_commitment()
+            begin = latest.end_block if latest else 0
+            if height - begin < self.window:
+                return created
+            dc = DataCommitment(
+                self._next_nonce(), begin, begin + self.window, height, time_ns
+            )
+            self._set_attestation(dc)
+            created.append(dc)
+
+    def _prune(self, time_ns: int) -> None:
+        for key, raw in self.store.iterate(_ATT_PREFIX):
+            att = _unmarshal_attestation(raw)
+            if time_ns - att.time_ns > ATTESTATION_EXPIRY_NS:
+                self.store.delete(key)

@@ -1,0 +1,93 @@
+"""x/blobstream attestation lifecycle and x/tokenfilter middleware tests."""
+
+import hashlib
+
+import pytest
+
+from celestia_app_tpu.modules.blobstream.keeper import (
+    BlobstreamKeeper,
+    DataCommitment,
+    Valset,
+    data_commitment_root,
+)
+from celestia_app_tpu.modules.tokenfilter import on_recv_packet
+from celestia_app_tpu.state.staking import StakingKeeper, Validator
+from celestia_app_tpu.state.store import KVStore
+
+T0 = 1_700_000_000 * 10**9
+
+
+def make_keeper(powers: dict[str, int], window=400) -> BlobstreamKeeper:
+    staking = StakingKeeper(KVStore())
+    for a, p in powers.items():
+        staking.set_validator(Validator(a, b"", p))
+    return BlobstreamKeeper(KVStore(), staking, data_commitment_window=window)
+
+
+class TestBlobstream:
+    def test_first_block_creates_valset(self):
+        k = make_keeper({"v1": 60, "v2": 40})
+        created = k.end_blocker(height=1, time_ns=T0)
+        assert len(created) == 1 and isinstance(created[0], Valset)
+        assert created[0].nonce == 1
+        # No change -> no new valset.
+        assert k.end_blocker(height=2, time_ns=T0) == []
+
+    def test_power_shift_triggers_valset(self):
+        k = make_keeper({"v1": 60, "v2": 40})
+        k.end_blocker(height=1, time_ns=T0)
+        # 4% shift: below the 5% threshold.
+        k.staking.set_validator(Validator("v1", b"", 56))
+        assert k.end_blocker(height=2, time_ns=T0) == []
+        # Now a big shift.
+        k.staking.set_validator(Validator("v1", b"", 20))
+        created = k.end_blocker(height=3, time_ns=T0)
+        assert len(created) == 1 and isinstance(created[0], Valset)
+
+    def test_data_commitment_windows_catch_up(self):
+        k = make_keeper({"v1": 100}, window=10)
+        created = k.end_blocker(height=35, time_ns=T0)
+        dcs = [a for a in created if isinstance(a, DataCommitment)]
+        assert [(d.begin_block, d.end_block) for d in dcs] == [(0, 10), (10, 20), (20, 30)]
+        # Nonces are globally monotonic across kinds.
+        assert [a.nonce for a in k.attestations()] == [1, 2, 3, 4]
+
+    def test_evm_registration(self):
+        k = make_keeper({"v1": 100})
+        k.register_evm_address("v1", "0x" + "ab" * 20)
+        assert k.evm_address("v1") == "0x" + "ab" * 20
+        with pytest.raises(ValueError):
+            k.register_evm_address("ghost", "0x" + "ab" * 20)
+        with pytest.raises(ValueError):
+            k.register_evm_address("v1", "bogus")
+
+    def test_pruning(self):
+        k = make_keeper({"v1": 100}, window=10)
+        k.end_blocker(height=15, time_ns=T0)
+        three_weeks = 3 * 7 * 24 * 3600 * 10**9
+        k.end_blocker(height=16, time_ns=T0 + three_weeks + 10**9)
+        kinds = [type(a).__name__ for a in k.attestations()]
+        assert all(a.time_ns > T0 for a in k.attestations()), kinds
+
+    def test_commitment_root_deterministic(self):
+        roots = [(h, hashlib.sha256(bytes([h])).digest()) for h in range(1, 5)]
+        assert data_commitment_root(roots) == data_commitment_root(list(roots))
+        assert data_commitment_root(roots) != data_commitment_root(roots[:3])
+
+
+class TestTokenFilter:
+    def test_native_token_returning_home_accepted(self):
+        data = b'{"denom": "transfer/channel-0/utia", "amount": "5", "sender": "a", "receiver": "b"}'
+        assert on_recv_packet("transfer", "channel-0", data).success
+
+    def test_foreign_token_rejected(self):
+        data = b'{"denom": "uatom", "amount": "5", "sender": "a", "receiver": "b"}'
+        ack = on_recv_packet("transfer", "channel-0", data)
+        assert not ack.success and "uatom" in ack.error
+
+    def test_multihop_foreign_rejected(self):
+        data = b'{"denom": "transfer/channel-9/uosmo", "amount": "1", "sender": "a", "receiver": "b"}'
+        assert not on_recv_packet("transfer", "channel-0", data).success
+
+    def test_non_transfer_packet_passes_through(self):
+        assert on_recv_packet("transfer", "channel-0", b"\x01\x02not-json").success
