@@ -98,17 +98,20 @@ def _run(app, ctx, tx: Tx, *, is_check_tx: bool, simulate: bool) -> AnteResult:
         raise AnteError("gas limit must be positive")
     fee_utia = sum(c.amount for c in fee.amount if c.denom == "utia")
     gas_price = Dec.from_fraction(fee_utia, fee.gas_limit)
+    # Error strings follow the sdk wording so clients can parse the required
+    # fee and retry (app/errors/insufficient_gas_price.go:23).
     net_min = app.minfee.network_min_gas_price()
     if gas_price < net_min and not simulate:
+        required = net_min.mul_int(fee.gas_limit).ceil_int()
         raise AnteError(
-            f"gas price {gas_price} below network min {net_min}"
+            f"insufficient fees; got: {fee_utia}utia required: {required}utia"
         )
     if is_check_tx and not simulate:
         node_min = app.node_min_gas_price
         if gas_price < node_min:
+            required = node_min.mul_int(fee.gas_limit).ceil_int()
             raise AnteError(
-                f"insufficient minimum gas price for this node; "
-                f"got: {gas_price} required: {node_min}"
+                f"insufficient fees; got: {fee_utia}utia required: {required}utia"
             )
     priority = gas_price.mul_int(PRIORITY_SCALING_FACTOR).truncate_int()
 

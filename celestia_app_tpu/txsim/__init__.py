@@ -1,0 +1,3 @@
+from celestia_app_tpu.txsim.run import BlobSequence, SendSequence, run
+
+__all__ = ["BlobSequence", "SendSequence", "run"]

@@ -1,0 +1,84 @@
+"""txsim: the deterministic load generator.
+
+Parity with reference test/txsim (run.go:37-124, blob.go, send.go):
+composable sequences submit txs through a TxClient against a node; a master
+seed makes the whole load pattern reproducible.  Each sequence owns one
+account (the reference's AccountManager funds subaccounts; here keys map to
+genesis accounts from the harness).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from celestia_app_tpu.shares.namespace import Namespace
+from celestia_app_tpu.shares.sparse import Blob
+from celestia_app_tpu.tx.messages import Coin, MsgSend
+from celestia_app_tpu.user import TxClient
+
+
+class BlobSequence:
+    """Submits PFBs with random namespaces/sizes (test/txsim/blob.go)."""
+
+    def __init__(
+        self,
+        blobs_per_pfb: tuple[int, int] = (1, 3),
+        blob_size: tuple[int, int] = (100, 10_000),
+    ):
+        self.blobs_per_pfb = blobs_per_pfb
+        self.blob_size = blob_size
+        self.address: str | None = None
+
+    def next(self, rng: np.random.Generator, client: TxClient):
+        count = int(rng.integers(self.blobs_per_pfb[0], self.blobs_per_pfb[1] + 1))
+        blobs = []
+        for _ in range(count):
+            ns = Namespace.v0(rng.integers(1, 256, 10, dtype=np.uint8).tobytes())
+            size = int(rng.integers(self.blob_size[0], self.blob_size[1] + 1))
+            blobs.append(Blob(ns, rng.integers(0, 256, size, dtype=np.uint8).tobytes()))
+        # Namespaces within one PFB must be sorted for deterministic blob order.
+        blobs.sort(key=lambda b: b.namespace.to_bytes())
+        return ("pfb", blobs)
+
+
+class SendSequence:
+    """Round-robin MsgSends between the client's accounts (send.go)."""
+
+    def __init__(self, amount: tuple[int, int] = (1, 1000)):
+        self.amount = amount
+        self.address: str | None = None
+
+    def next(self, rng: np.random.Generator, client: TxClient):
+        addrs = client.signer.addresses()
+        to = addrs[int(rng.integers(0, len(addrs)))]
+        amount = int(rng.integers(self.amount[0], self.amount[1] + 1))
+        return ("send", to, amount)
+
+
+def run(node, keys, sequences, blocks: int, seed: int = 42) -> dict:
+    """Drive `sequences` for `blocks` blocks; returns submission stats."""
+    rng = np.random.default_rng(seed)
+    client = TxClient(node, keys)
+    addrs = client.signer.addresses()
+    for i, seq in enumerate(sequences):
+        seq.address = addrs[i % len(addrs)]
+
+    stats = {"submitted": 0, "failed": 0, "blocks": 0}
+    for _ in range(blocks):
+        for seq in sequences:
+            op = seq.next(rng, client)
+            try:
+                if op[0] == "pfb":
+                    with client._lock:
+                        client._broadcast_pfb(op[1], seq.address)
+                else:
+                    _, to, amount = op
+                    msg = MsgSend(seq.address, to, (Coin("utia", amount),))
+                    with client._lock:
+                        client._broadcast_msgs([msg], seq.address, gas=200_000)
+                stats["submitted"] += 1
+            except Exception:
+                stats["failed"] += 1
+        node.produce_block()
+        stats["blocks"] += 1
+    return stats

@@ -1,0 +1,133 @@
+"""TxClient: the high-level thread-safe submission client.
+
+Parity with reference pkg/user/tx_client.go:90-455: build/sign/broadcast
+message txs and BlobTxs against a node, estimate gas, bump the gas price and
+retry on parseable fee rejections, resync sequences on nonce mismatch, and
+confirm inclusion.  The node here is anything with the TestNode surface
+(broadcast / produce_block / app) — in production the same calls ride gRPC.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from fractions import Fraction
+
+from celestia_app_tpu.crypto import PrivateKey
+from celestia_app_tpu.modules.blob.types import estimate_gas
+from celestia_app_tpu.shares.sparse import Blob
+from celestia_app_tpu.state.accounts import AuthKeeper
+from celestia_app_tpu.user.errors import (
+    parse_insufficient_min_gas_price,
+    parse_nonce_mismatch,
+)
+from celestia_app_tpu.user.signer import Signer
+
+DEFAULT_GAS_PRICE = Fraction(2, 1000)  # matches appconsts.DefaultMinGasPrice
+DEFAULT_GAS_MULTIPLIER = Fraction(11, 10)
+MAX_RETRIES = 5
+
+
+class TxSubmissionError(RuntimeError):
+    def __init__(self, code: int, log: str):
+        super().__init__(f"tx rejected (code {code}): {log}")
+        self.code = code
+        self.log = log
+
+
+@dataclass
+class TxResponse:
+    height: int
+    code: int
+    log: str = ""
+    gas_wanted: int = 0
+
+
+class TxClient:
+    """Mutex-serialized client bound to one node and a set of local keys."""
+
+    def __init__(
+        self,
+        node,
+        keys: list[PrivateKey],
+        gas_price: Fraction = DEFAULT_GAS_PRICE,
+        gas_multiplier: Fraction = DEFAULT_GAS_MULTIPLIER,
+    ):
+        self._node = node
+        self._lock = threading.Lock()
+        self.gas_price = gas_price
+        self.gas_multiplier = gas_multiplier
+        self.signer = Signer(node.chain_id)
+        auth = AuthKeeper(node.app.cms.working)
+        for k in keys:
+            addr = k.public_key().address()
+            acc = auth.get_account(addr)
+            if acc is None:
+                raise ValueError(f"account {addr} not found on chain")
+            self.signer.add_account(k, acc.account_number, acc.sequence)
+        self.default_address = self.signer.addresses()[0]
+
+    # --- public API --------------------------------------------------------
+    def submit_pay_for_blob(self, blobs: list[Blob], address: str | None = None) -> TxResponse:
+        """Broadcast a PFB and wait for inclusion (SubmitPayForBlob :202)."""
+        with self._lock:
+            resp = self._broadcast_pfb(blobs, address or self.default_address)
+        return self._confirm(resp)
+
+    def submit_tx(self, msgs: list, address: str | None = None, gas: int = 200_000) -> TxResponse:
+        with self._lock:
+            resp = self._broadcast_msgs(msgs, address or self.default_address, gas)
+        return self._confirm(resp)
+
+    def estimate_gas(self, blobs: list[Blob]) -> int:
+        return estimate_gas([len(b.data) for b in blobs])
+
+    # --- internals ---------------------------------------------------------
+    def _fee_for(self, gas: int, price: Fraction) -> int:
+        return -(-(gas * price.numerator) // price.denominator)  # ceil
+
+    def _broadcast_pfb(self, blobs, address: str) -> TxResponse:
+        gas = self.estimate_gas(blobs)
+        build = lambda price: self.signer.create_pay_for_blobs(
+            address, blobs, gas, self._fee_for(gas, price)
+        )
+        return self._broadcast_with_retry(build, address, gas)
+
+    def _broadcast_msgs(self, msgs, address: str, gas: int) -> TxResponse:
+        build = lambda price: self.signer.create_tx(
+            address, msgs, gas, self._fee_for(gas, price)
+        )
+        return self._broadcast_with_retry(build, address, gas)
+
+    def _broadcast_with_retry(self, build, address: str, gas: int) -> TxResponse:
+        """broadcastTx + retryBroadcastingTx (:320-410): on a parseable
+        fee rejection adopt the implied price; on nonce mismatch resync."""
+        price = self.gas_price
+        last = None
+        for _ in range(MAX_RETRIES):
+            raw = build(price)
+            res = self._node.broadcast(raw)
+            if res.code == 0:
+                self.signer.increment_sequence(address)
+                return TxResponse(height=0, code=0, gas_wanted=gas)
+            last = res
+            implied = parse_insufficient_min_gas_price(res.log, gas)
+            if implied is not None:
+                price = max(implied, price * self.gas_multiplier)
+                continue
+            nonce = parse_nonce_mismatch(res.log)
+            if nonce is not None:
+                self.signer.set_sequence(address, nonce[0])
+                continue
+            break
+        raise TxSubmissionError(last.code, last.log)
+
+    def _confirm(self, resp: TxResponse) -> TxResponse:
+        """ConfirmTx (:412): drive a block and report inclusion height."""
+        _, results = self._node.produce_block()
+        for r in results:
+            if r.code != 0:
+                raise TxSubmissionError(r.code, r.log)
+        return TxResponse(
+            height=self._node.app.height, code=0, gas_wanted=resp.gas_wanted
+        )

@@ -1,0 +1,103 @@
+"""TxClient + txsim tests against the in-process node."""
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.shares.namespace import Namespace
+from celestia_app_tpu.shares.sparse import Blob
+from celestia_app_tpu.state.dec import Dec
+from celestia_app_tpu.testutil import TestNode, deterministic_genesis, funded_keys
+from celestia_app_tpu.tx.messages import Coin, MsgSend
+from celestia_app_tpu.txsim import BlobSequence, SendSequence, run
+from celestia_app_tpu.user import (
+    TxClient,
+    TxSubmissionError,
+    parse_insufficient_min_gas_price,
+    parse_nonce_mismatch,
+)
+
+RNG = np.random.default_rng(77)
+
+
+def user_ns(tag: int) -> Namespace:
+    return Namespace.v0(bytes([tag]) * 10)
+
+
+@pytest.fixture()
+def node():
+    return TestNode()
+
+
+class TestErrorParsing:
+    def test_min_gas_price(self):
+        log = "insufficient fees; got: 10utia required: 2000utia"
+        assert parse_insufficient_min_gas_price(log, 100_000) is not None
+        assert parse_insufficient_min_gas_price("some other error", 100_000) is None
+
+    def test_nonce_mismatch(self):
+        log = "account sequence mismatch, expected 4, got 2"
+        assert parse_nonce_mismatch(log) == (4, 2)
+
+
+class TestTxClient:
+    def test_submit_pay_for_blob(self, node):
+        client = TxClient(node, node.keys[:2])
+        blobs = [Blob(user_ns(8), RNG.integers(0, 256, 4000, dtype=np.uint8).tobytes())]
+        resp = client.submit_pay_for_blob(blobs)
+        assert resp.code == 0 and resp.height == 1
+
+    def test_submit_send(self, node):
+        client = TxClient(node, node.keys[:2])
+        to = node.keys[1].public_key().address()
+        resp = client.submit_tx(
+            [MsgSend(client.default_address, to, (Coin("utia", 123),))]
+        )
+        assert resp.code == 0
+
+    def test_sequences_advance(self, node):
+        client = TxClient(node, node.keys[:1])
+        blobs = [Blob(user_ns(2), b"x" * 500)]
+        for expected_height in (1, 2, 3):
+            resp = client.submit_pay_for_blob(blobs)
+            assert resp.height == expected_height
+
+    def test_gas_price_retry(self):
+        # A node demanding a higher min gas price than the client default:
+        # the client must parse the rejection and bump its price.
+        keys = funded_keys(2)
+        node = TestNode(deterministic_genesis(keys), keys)
+        node.app.node_min_gas_price = Dec.from_str("0.02")  # 10x client default
+        client = TxClient(node, keys)
+        blobs = [Blob(user_ns(3), b"y" * 1000)]
+        resp = client.submit_pay_for_blob(blobs)
+        assert resp.code == 0
+
+    def test_unknown_account_rejected(self, node):
+        from celestia_app_tpu.crypto import PrivateKey
+
+        with pytest.raises(ValueError):
+            TxClient(node, [PrivateKey.from_seed(b"stranger")])
+
+
+class TestTxSim:
+    def test_deterministic_load(self):
+        keys = funded_keys(3)
+        stats = run(
+            TestNode(deterministic_genesis(keys), keys),
+            keys,
+            [BlobSequence(blob_size=(100, 2000)), SendSequence()],
+            blocks=3,
+            seed=7,
+        )
+        assert stats["blocks"] == 3
+        assert stats["submitted"] >= 5
+        assert stats["failed"] == 0
+
+    def test_reproducible(self):
+        def once():
+            keys = funded_keys(2)
+            node = TestNode(deterministic_genesis(keys), keys)
+            run(node, keys, [BlobSequence(blob_size=(100, 1000))], blocks=2, seed=9)
+            return node.app.cms.last_app_hash
+
+        assert once() == once()
