@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/celestia_square_bridge.dir/celestia_square_bridge.cpp.o"
+  "CMakeFiles/celestia_square_bridge.dir/celestia_square_bridge.cpp.o.d"
+  "libcelestia_square_bridge.pdb"
+  "libcelestia_square_bridge.so"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/celestia_square_bridge.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,2 @@
+# Empty compiler generated dependencies file for celestia_square_bridge.
+# This may be replaced when dependencies are built.

@@ -1,0 +1,2 @@
+# Empty dependencies file for celestia_square_bridge.
+# This may be replaced when dependencies are built.

@@ -1,0 +1,219 @@
+/* Persistent-worker bridge implementation.
+ *
+ * Design (SURVEY §2.3 item 3, §7 phase 6): the consensus daemon must never
+ * block on interpreter startup or kernel compilation, so the library forks
+ * ONE long-lived worker hosting the XLA runtime and multiplexes requests
+ * over its stdio with a length-prefixed binary protocol.  All calls are
+ * serialized by a mutex (the square pipeline is one-block-at-a-time on the
+ * consensus path anyway); any protocol/worker failure poisons the client
+ * and surfaces as a nonzero status so the caller falls back to its CPU
+ * codec.
+ *
+ * Protocol (little-endian):
+ *   request:  magic "CSQ1" | op u32 | k u32 | payload_len u64 | payload
+ *   response: magic "CSQR" | status u32 | payload_len u64 | payload
+ *   ops: 1 = extend_and_dah (payload = ODS bytes; response payload =
+ *        EDS || row_roots || col_roots || data_root), 2 = ping,
+ *        3 = warmup (payload = none; k = square size), 4 = shutdown.
+ */
+
+#include "celestia_square_bridge.h"
+
+#include <errno.h>
+#include <mutex>
+#include <signal.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kReqMagic = 0x31515343;   // "CSQ1"
+constexpr uint32_t kRespMagic = 0x52515343;  // "CSQR"
+constexpr uint32_t kOpExtend = 1;
+constexpr uint32_t kOpPing = 2;
+constexpr uint32_t kOpWarmup = 3;
+constexpr uint32_t kOpShutdown = 4;
+constexpr size_t kShareSize = 512;
+constexpr size_t kNmtRootSize = 90;
+
+bool write_all(int fd, const void *buf, size_t n) {
+  const char *p = static_cast<const char *>(buf);
+  while (n > 0) {
+    ssize_t w = write(fd, p, n);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool read_all(int fd, void *buf, size_t n) {
+  char *p = static_cast<char *>(buf);
+  while (n > 0) {
+    ssize_t r = read(fd, p, n);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    if (r == 0) return false;  // worker died
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+}  // namespace
+
+struct cstpu_client {
+  pid_t worker_pid = -1;
+  int to_worker = -1;    // write end
+  int from_worker = -1;  // read end
+  bool poisoned = false;
+  std::mutex mu;
+
+  ~cstpu_client() {
+    if (to_worker >= 0) close(to_worker);
+    if (from_worker >= 0) close(from_worker);
+    if (worker_pid > 0) {
+      kill(worker_pid, SIGTERM);
+      waitpid(worker_pid, nullptr, 0);
+    }
+  }
+
+  bool request(uint32_t op, uint32_t k, const uint8_t *payload,
+               uint64_t payload_len, uint8_t *resp, uint64_t resp_cap,
+               uint64_t *resp_len) {
+    if (poisoned) return false;
+    uint8_t header[20];
+    memcpy(header, &kReqMagic, 4);
+    memcpy(header + 4, &op, 4);
+    memcpy(header + 8, &k, 4);
+    memcpy(header + 12, &payload_len, 8);
+    if (!write_all(to_worker, header, sizeof(header)) ||
+        (payload_len && !write_all(to_worker, payload, payload_len))) {
+      poisoned = true;
+      return false;
+    }
+    uint8_t rhead[16];
+    if (!read_all(from_worker, rhead, sizeof(rhead))) {
+      poisoned = true;
+      return false;
+    }
+    uint32_t magic, status;
+    uint64_t rlen;
+    memcpy(&magic, rhead, 4);
+    memcpy(&status, rhead + 4, 4);
+    memcpy(&rlen, rhead + 8, 8);
+    if (magic != kRespMagic || rlen > resp_cap) {
+      poisoned = true;
+      return false;
+    }
+    if (rlen && !read_all(from_worker, resp, rlen)) {
+      poisoned = true;
+      return false;
+    }
+    if (resp_len) *resp_len = rlen;
+    return status == 0;
+  }
+};
+
+extern "C" {
+
+cstpu_client *cstpu_init(const char *const *worker_argv,
+                         const uint32_t *warmup_ks, size_t n_warmup) {
+  if (!worker_argv || !worker_argv[0]) return nullptr;
+  int in_pipe[2];   // parent -> child
+  int out_pipe[2];  // child -> parent
+  if (pipe(in_pipe) != 0) return nullptr;
+  if (pipe(out_pipe) != 0) {
+    close(in_pipe[0]);
+    close(in_pipe[1]);
+    return nullptr;
+  }
+  pid_t pid = fork();
+  if (pid < 0) {
+    close(in_pipe[0]); close(in_pipe[1]);
+    close(out_pipe[0]); close(out_pipe[1]);
+    return nullptr;
+  }
+  if (pid == 0) {
+    dup2(in_pipe[0], STDIN_FILENO);
+    dup2(out_pipe[1], STDOUT_FILENO);
+    close(in_pipe[0]); close(in_pipe[1]);
+    close(out_pipe[0]); close(out_pipe[1]);
+    execvp(worker_argv[0], const_cast<char *const *>(worker_argv));
+    _exit(127);
+  }
+  close(in_pipe[0]);
+  close(out_pipe[1]);
+
+  cstpu_client *c = new cstpu_client();
+  c->worker_pid = pid;
+  c->to_worker = in_pipe[1];
+  c->from_worker = out_pipe[0];
+
+  if (cstpu_ping(c) != 0) {
+    delete c;
+    return nullptr;
+  }
+  for (size_t i = 0; i < n_warmup; i++) {
+    std::lock_guard<std::mutex> lock(c->mu);
+    if (!c->request(kOpWarmup, warmup_ks[i], nullptr, 0, nullptr, 0, nullptr)) {
+      delete c;
+      return nullptr;
+    }
+  }
+  return c;
+}
+
+int cstpu_ping(cstpu_client *c) {
+  if (!c) return -1;
+  std::lock_guard<std::mutex> lock(c->mu);
+  return c->request(kOpPing, 0, nullptr, 0, nullptr, 0, nullptr) ? 0 : -1;
+}
+
+int cstpu_extend_and_dah(cstpu_client *c, const uint8_t *ods, uint32_t k,
+                         uint8_t *eds_out, uint8_t *row_roots,
+                         uint8_t *col_roots, uint8_t *data_root) {
+  if (!c || !ods || !k || !row_roots || !col_roots || !data_root) return -1;
+  const uint64_t ods_len = static_cast<uint64_t>(k) * k * kShareSize;
+  const uint64_t eds_len = 4 * ods_len;
+  const uint64_t roots_len = static_cast<uint64_t>(2) * k * kNmtRootSize;
+  const uint64_t resp_len_expect = eds_len + 2 * roots_len + 32;
+
+  uint8_t *resp = static_cast<uint8_t *>(malloc(resp_len_expect));
+  if (!resp) return -1;
+  uint64_t resp_len = 0;
+  bool ok;
+  {
+    std::lock_guard<std::mutex> lock(c->mu);
+    ok = c->request(kOpExtend, k, ods, ods_len, resp, resp_len_expect, &resp_len);
+  }
+  if (!ok || resp_len != resp_len_expect) {
+    free(resp);
+    return -1;
+  }
+  if (eds_out) memcpy(eds_out, resp, eds_len);
+  memcpy(row_roots, resp + eds_len, roots_len);
+  memcpy(col_roots, resp + eds_len + roots_len, roots_len);
+  memcpy(data_root, resp + eds_len + 2 * roots_len, 32);
+  free(resp);
+  return 0;
+}
+
+void cstpu_shutdown(cstpu_client *c) {
+  if (!c) return;
+  {
+    std::lock_guard<std::mutex> lock(c->mu);
+    c->request(kOpShutdown, 0, nullptr, 0, nullptr, 0, nullptr);
+  }
+  delete c;
+}
+
+}  // extern "C"

@@ -1,0 +1,55 @@
+/* C ABI for the TPU square pipeline — the host-language integration seam.
+ *
+ * This is the native bridge SURVEY §2.3 calls for: a consensus node written
+ * in another language (the reference is Go) loads this library and routes
+ * rsmt2d.Codec / wrapper.Constructor calls through it instead of its CPU
+ * codec, keeping PrepareProposal/ProcessProposal byte-identical while the
+ * RS extension + NMT forest + DAH run on the accelerator.
+ *
+ * The library owns a persistent worker process hosting the XLA runtime
+ * (celestia_app_tpu.bridge.worker) and speaks a length-prefixed binary
+ * protocol over its stdio; kernels are compiled once at init (AOT warmup)
+ * so no compilation ever sits on the block-production critical path.
+ */
+
+#ifndef CELESTIA_SQUARE_BRIDGE_H
+#define CELESTIA_SQUARE_BRIDGE_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct cstpu_client cstpu_client;
+
+/* Spawn the persistent runtime worker.  `worker_argv` is a NULL-terminated
+ * argv (e.g. {"python3", "-m", "celestia_app_tpu.bridge.worker", NULL}).
+ * `warmup_ks` lists square sizes to AOT-compile (may be NULL / n = 0).
+ * Returns NULL on failure. */
+cstpu_client *cstpu_init(const char *const *worker_argv,
+                         const uint32_t *warmup_ks, size_t n_warmup);
+
+/* Liveness probe (watchdog hook).  Returns 0 when healthy. */
+int cstpu_ping(cstpu_client *c);
+
+/* Extend a k x k ODS and compute all commitments in one device program.
+ *   ods:        k*k*512 bytes, row-major
+ *   eds_out:    2k*2k*512 bytes (may be NULL if only roots are needed)
+ *   row_roots:  2k*90 bytes    col_roots: 2k*90 bytes
+ *   data_root:  32 bytes
+ * Returns 0 on success; any nonzero status means the caller must fall back
+ * to its CPU path (the fallback contract of SURVEY §7 phase 6). */
+int cstpu_extend_and_dah(cstpu_client *c, const uint8_t *ods, uint32_t k,
+                         uint8_t *eds_out, uint8_t *row_roots,
+                         uint8_t *col_roots, uint8_t *data_root);
+
+/* Terminate the worker and free the client. */
+void cstpu_shutdown(cstpu_client *c);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* CELESTIA_SQUARE_BRIDGE_H */

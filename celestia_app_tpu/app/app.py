@@ -44,6 +44,7 @@ from celestia_app_tpu.tx.messages import (
     MsgSignalVersion,
     MsgTryUpgrade,
 )
+from celestia_app_tpu.trace import traced
 from celestia_app_tpu.tx.sign import Tx
 
 
@@ -172,53 +173,65 @@ class App:
 
     # --- PrepareProposal (app/prepare_proposal.go:22-91) --------------------
     def prepare_proposal(self, raw_txs: list[bytes]) -> BlockData:
-        filtered = self._filter_txs(raw_txs)
-        sq, kept = square.build(filtered, self.max_effective_square_size())
-        if sq.is_empty():
-            dah = min_data_availability_header()
-            return BlockData(tuple(kept), 1, dah.hash())
-        eds = extend_shares(sq.share_bytes())
-        dah = DataAvailabilityHeader.from_eds(eds)
-        return BlockData(tuple(kept), sq.size, dah.hash())
+        # telemetry.MeasureSince parity (prepare_proposal.go:23).
+        with traced().span("prepare_proposal", height=self.height + 1, n_txs=len(raw_txs)):
+            filtered = self._filter_txs(raw_txs)
+            sq, kept = square.build(filtered, self.max_effective_square_size())
+            if sq.is_empty():
+                dah = min_data_availability_header()
+                return BlockData(tuple(kept), 1, dah.hash())
+            with traced().span("square_pipeline", k=sq.size, phase="prepare"):
+                eds = extend_shares(sq.share_bytes())
+                dah = DataAvailabilityHeader.from_eds(eds)
+            return BlockData(tuple(kept), sq.size, dah.hash())
 
     def _filter_txs(self, raw_txs: list[bytes]) -> list[bytes]:
-        """FilterTxs (app/validate_txs.go:32): ante-validate on a branched
-        state, drop failures, normal txs before blob txs."""
+        """FilterTxs (app/validate_txs.go:32): separate tx classes, then
+        ante-validate in BLOCK order (normal txs before blob txs,
+        validate_txs.go:14,31-36) on one branched state, dropping failures.
+        Validating in block order matters: a signer's sequence must advance
+        in the order txs execute, not the order they arrived."""
         ctx = Ctx(
             self.cms.working.branch(),
             self.height + 1,
             self.last_block_time_ns,
             self.app_version,
         )
+        classified = [(raw, unmarshal_blob_tx(raw)) for raw in raw_txs]
         normal: list[bytes] = []
         blob: list[bytes] = []
-        for raw in raw_txs:
-            btx = unmarshal_blob_tx(raw)
+        for raw, btx in classified:
+            if btx is not None:
+                continue
+            try:
+                tx = Tx.unmarshal(raw)
+                if any(isinstance(m, MsgPayForBlobs) for m in tx.msgs()):
+                    continue  # PFB outside a BlobTx is invalid
+                run_ante(self, ctx, tx, is_check_tx=False)
+                normal.append(raw)
+            except (AnteError, ValueError):
+                continue
+        for raw, btx in classified:
             if btx is None:
-                try:
-                    tx = Tx.unmarshal(raw)
-                    if any(isinstance(m, MsgPayForBlobs) for m in tx.msgs()):
-                        continue  # PFB outside a BlobTx is invalid
-                    run_ante(self, ctx, tx, is_check_tx=False)
-                    normal.append(raw)
-                except (AnteError, ValueError):
-                    continue
-            else:
-                try:
-                    validate_blob_tx(btx)
-                    run_ante(self, ctx, Tx.unmarshal(btx.tx), is_check_tx=False)
-                    blob.append(raw)
-                except (AnteError, BlobTxError, ValueError):
-                    continue
+                continue
+            try:
+                validate_blob_tx(btx)
+                run_ante(self, ctx, Tx.unmarshal(btx.tx), is_check_tx=False)
+                blob.append(raw)
+            except (AnteError, BlobTxError, ValueError):
+                continue
         return normal + blob
 
     # --- ProcessProposal (app/process_proposal.go:24-158) -------------------
     def process_proposal(self, data: BlockData) -> bool:
-        try:
-            return self._process_proposal(data)
-        except Exception:
-            # recover() -> reject (process_proposal.go:29-35)
-            return False
+        with traced().span("process_proposal", height=self.height + 1, n_txs=len(data.txs)):
+            try:
+                return self._process_proposal(data)
+            except Exception:
+                # recover() -> reject (process_proposal.go:29-35); counted like
+                # the reference's rejection telemetry (process_proposal.go:32).
+                traced().write("process_proposal_rejections", height=self.height + 1)
+                return False
 
     def _process_proposal(self, data: BlockData) -> bool:
         ctx = Ctx(
@@ -341,5 +354,8 @@ class App:
             keeper = SignalKeeper(ctx.store, ctx.staking)
             up = keeper.should_upgrade(height)
             if up is not None:
+                from celestia_app_tpu.app.module_manager import ModuleManager
+
+                ModuleManager().run_migrations(ctx, self.app_version, up.app_version)
                 self.app_version = up.app_version
                 keeper.reset_tally()

@@ -1,0 +1,29 @@
+"""Library entry turning block data into an EDS (reference app/extend_block.go).
+
+Used by the consensus layer (the reference's celestia-core fork calls
+ExtendBlock on every committed block) and by availability tooling: rebuild
+the square from the block's txs and erasure-extend it on the device.
+"""
+
+from __future__ import annotations
+
+from celestia_app_tpu.constants import SQUARE_SIZE_UPPER_BOUND
+from celestia_app_tpu.da import ExtendedDataSquare, extend_shares
+from celestia_app_tpu.square import builder as square
+
+
+def extend_block(
+    raw_txs: list[bytes], gov_max_square_size: int = SQUARE_SIZE_UPPER_BOUND
+) -> ExtendedDataSquare | None:
+    """coretypes.Data -> EDS (extend_block.go:14-26); None for empty blocks."""
+    if is_empty_block(raw_txs):
+        return None
+    sq = square.construct(
+        raw_txs, min(gov_max_square_size, SQUARE_SIZE_UPPER_BOUND)
+    )
+    return extend_shares(sq.share_bytes())
+
+
+def is_empty_block(raw_txs: list[bytes]) -> bool:
+    """extend_block.go:30 IsEmptyBlock: no txs means the minimal square."""
+    return len(raw_txs) == 0

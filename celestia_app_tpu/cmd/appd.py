@@ -1,0 +1,233 @@
+"""celestia-appd-tpu: the CLI daemon.
+
+Parity with the reference cmd/celestia-appd surface (root.go:44-130):
+`init` writes a home directory with genesis, `start` runs the single-process
+node loop (produce -> self-validate -> finalize -> commit, persisting state
+each block), `export` dumps app state, `rollback` drops the last height,
+`status` prints chain info.  Env prefix CELESTIA_ (root.go:33); state
+survives restarts via the commit-store snapshot (LoadHeight analog).
+
+Usage:  python -m celestia_app_tpu.cmd.appd <command> [--home DIR] ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from celestia_app_tpu.app import App, Genesis, GenesisAccount
+from celestia_app_tpu.crypto import PrivateKey
+from celestia_app_tpu.state.dec import Dec
+from celestia_app_tpu.state.staking import Validator
+from celestia_app_tpu.state.store import CommitStore
+
+DEFAULT_HOME = os.path.expanduser(
+    os.environ.get("CELESTIA_HOME", "~/.celestia-app-tpu")
+)
+
+
+def _genesis_path(home: str) -> str:
+    return os.path.join(home, "config", "genesis.json")
+
+
+def _state_path(home: str) -> str:
+    return os.path.join(home, "data", "state.json")
+
+
+def _meta_path(home: str) -> str:
+    return os.path.join(home, "data", "app_meta.json")
+
+
+def cmd_init(args) -> int:
+    home = args.home
+    os.makedirs(os.path.join(home, "config"), exist_ok=True)
+    os.makedirs(os.path.join(home, "data"), exist_ok=True)
+    keys = [PrivateKey.from_seed(f"{args.chain_id}-account-{i}".encode()) for i in range(args.accounts)]
+    genesis = {
+        "chain_id": args.chain_id,
+        "genesis_time_ns": time.time_ns(),
+        "app_version": 2,
+        "gov_max_square_size": args.gov_max_square_size,
+        "accounts": [
+            {
+                "address": k.public_key().address(),
+                "balance": 10**12,
+                "pubkey": k.public_key().bytes.hex(),
+            }
+            for k in keys
+        ],
+        "validators": [
+            {
+                "address": PrivateKey.from_seed(f"{args.chain_id}-val-{i}".encode())
+                .public_key()
+                .address(),
+                "pubkey": PrivateKey.from_seed(f"{args.chain_id}-val-{i}".encode())
+                .public_key()
+                .bytes.hex(),
+                "power": 100,
+            }
+            for i in range(args.validators)
+        ],
+    }
+    with open(_genesis_path(home), "w") as f:
+        json.dump(genesis, f, indent=2)
+    print(f"initialized chain {args.chain_id!r} at {home}")
+    return 0
+
+
+def _load_genesis(home: str) -> Genesis:
+    with open(_genesis_path(home)) as f:
+        g = json.load(f)
+    return Genesis(
+        chain_id=g["chain_id"],
+        genesis_time_ns=g["genesis_time_ns"],
+        app_version=g.get("app_version", 2),
+        gov_max_square_size=g.get("gov_max_square_size", 64),
+        accounts=tuple(
+            GenesisAccount(a["address"], a["balance"], bytes.fromhex(a.get("pubkey", "")))
+            for a in g.get("accounts", [])
+        ),
+        validators=tuple(
+            Validator(v["address"], bytes.fromhex(v.get("pubkey", "")), v["power"])
+            for v in g.get("validators", [])
+        ),
+    )
+
+
+def load_app(home: str) -> App:
+    """Construct the App from a home dir, resuming committed state if any."""
+    genesis = _load_genesis(home)
+    app = App(node_min_gas_price=Dec.from_str("0.000001"))
+    if os.path.exists(_state_path(home)):
+        app.cms = CommitStore.load(_state_path(home))
+        with open(_meta_path(home)) as f:
+            meta = json.load(f)
+        app.chain_id = meta["chain_id"]
+        app.height = meta["height"]
+        app.app_version = meta["app_version"]
+        app.genesis_time_ns = meta["genesis_time_ns"]
+        app.last_block_time_ns = meta["last_block_time_ns"]
+        app.gov_max_square_size = meta["gov_max_square_size"]
+    else:
+        app.init_chain(genesis)
+        save_app(home, app)
+    return app
+
+
+def save_app(home: str, app: App) -> None:
+    app.cms.save(_state_path(home))
+    with open(_meta_path(home), "w") as f:
+        json.dump(
+            {
+                "chain_id": app.chain_id,
+                "height": app.height,
+                "app_version": app.app_version,
+                "genesis_time_ns": app.genesis_time_ns,
+                "last_block_time_ns": app.last_block_time_ns,
+                "gov_max_square_size": app.gov_max_square_size,
+            },
+            f,
+        )
+
+
+def cmd_start(args) -> int:
+    app = load_app(args.home)
+    print(f"chain {app.chain_id} at height {app.height}, producing blocks...")
+    interval_ns = args.block_interval * 10**9
+    produced = 0
+    while args.blocks == 0 or produced < args.blocks:
+        time_ns = max(time.time_ns(), app.last_block_time_ns + 1)
+        data = app.prepare_proposal([])
+        if not app.process_proposal(data):
+            print("FATAL: node rejected its own proposal", file=sys.stderr)
+            return 1
+        app.finalize_block(time_ns, list(data.txs))
+        app.commit()
+        save_app(args.home, app)
+        produced += 1
+        print(
+            f"height={app.height} square={data.square_size} "
+            f"data_root={data.hash.hex()[:16]}... app_hash={app.cms.last_app_hash.hex()[:16]}..."
+        )
+        if args.blocks == 0 or produced < args.blocks:
+            time.sleep(args.block_interval if not args.no_sleep else 0)
+    return 0
+
+
+def cmd_status(args) -> int:
+    app = load_app(args.home)
+    print(
+        json.dumps(
+            {
+                "chain_id": app.chain_id,
+                "height": app.height,
+                "app_version": app.app_version,
+                "app_hash": app.cms.last_app_hash.hex(),
+            },
+            indent=2,
+        )
+    )
+    return 0
+
+
+def cmd_export(args) -> int:
+    app = load_app(args.home)
+    state = {k.hex(): v.hex() for k, v in app.cms.export().items()}
+    json.dump(
+        {"height": app.height, "chain_id": app.chain_id, "state": state},
+        sys.stdout,
+        indent=2,
+    )
+    print()
+    return 0
+
+
+def cmd_rollback(args) -> int:
+    app = load_app(args.home)
+    if app.height == 0:
+        print("nothing to roll back", file=sys.stderr)
+        return 1
+    # Reference rollback (cmd root.go:129 via sdk server): drop last height.
+    app.cms.rollback()
+    app.height = app.cms.last_height
+    save_app(args.home, app)
+    print(f"rolled back to height {app.height}")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="celestia-appd-tpu", description=__doc__)
+    parser.add_argument("--home", default=DEFAULT_HOME)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("init", help="initialize a home dir + genesis")
+    p.add_argument("chain_id")
+    p.add_argument("--accounts", type=int, default=4)
+    p.add_argument("--validators", type=int, default=3)
+    p.add_argument("--gov-max-square-size", type=int, default=64)
+    p.set_defaults(fn=cmd_init)
+
+    p = sub.add_parser("start", help="run the node loop")
+    p.add_argument("--blocks", type=int, default=0, help="0 = forever")
+    p.add_argument("--block-interval", type=float, default=15.0)
+    p.add_argument("--no-sleep", action="store_true")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("status", help="print chain status")
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("export", help="export app state as JSON")
+    p.set_defaults(fn=cmd_export)
+
+    p = sub.add_parser("rollback", help="drop the latest committed height")
+    p.set_defaults(fn=cmd_rollback)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -111,3 +111,45 @@ class CommitStore:
         if height is None:
             height = self.last_height
         return dict(self._committed[height])
+
+    # --- disk persistence (restart/resume, reference LoadHeight app/app.go:592)
+    def save(self, path: str, keep_recent: int = 2) -> None:
+        """Write the most recent committed heights to disk.
+
+        Two heights are kept so one `rollback` still works after a restart
+        (the sdk server's rollback command rolls back exactly one height).
+        """
+        import json
+        import os
+        import tempfile
+
+        heights = sorted(self._committed)[-keep_recent:]
+        state = {
+            "height": self.last_height,
+            "versions": [
+                {
+                    "height": h,
+                    "kv": {k.hex(): v.hex() for k, v in self._committed[h].items()},
+                }
+                for h in heights
+            ],
+        }
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".")
+        with os.fdopen(fd, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, path)  # atomic: a crash never corrupts the snapshot
+
+    @classmethod
+    def load(cls, path: str) -> "CommitStore":
+        import json
+
+        with open(path) as f:
+            state = json.load(f)
+        cs = cls()
+        for version in state["versions"]:
+            cs._committed[version["height"]] = {
+                bytes.fromhex(k): bytes.fromhex(v) for k, v in version["kv"].items()
+            }
+        cs.load_height(state["height"])
+        return cs

@@ -1,0 +1,3 @@
+from celestia_app_tpu.trace.tracer import Tracer, traced
+
+__all__ = ["Tracer", "traced"]

@@ -1,0 +1,66 @@
+"""Columnar event tracing (the pkg/trace + telemetry analog).
+
+Parity with the reference's two tracing mechanisms (SURVEY §5): sdk
+telemetry.MeasureSince around the ABCI hot methods
+(app/prepare_proposal.go:23, app/process_proposal.go:25) and celestia-core
+pkg/trace's columnar event tables written node-side and pulled for analysis.
+
+Here both collapse into one in-process Tracer: named event tables holding
+homogeneous dict rows, with a `span` context manager for wall-time
+measurements (device kernel timings from jax block_until_ready land in the
+same tables).  Export is JSONL per table, the same shape the reference's
+table puller consumes (test/e2e/testnet/node.go:52-74).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+
+class Tracer:
+    def __init__(self, buffer_size: int = 10_000):
+        self.buffer_size = buffer_size
+        self._tables: dict[str, list[dict]] = defaultdict(list)
+        self.enabled = True
+
+    def write(self, table: str, **row) -> None:
+        if not self.enabled:
+            return
+        rows = self._tables[table]
+        rows.append({"ts_ns": time.time_ns(), **row})
+        if len(rows) > self.buffer_size:
+            del rows[: len(rows) - self.buffer_size]
+
+    @contextmanager
+    def span(self, table: str, **attrs):
+        """Measure a wall-time span into `table` (MeasureSince analog)."""
+        start = time.perf_counter_ns()
+        try:
+            yield
+        finally:
+            self.write(
+                table, duration_ms=(time.perf_counter_ns() - start) / 1e6, **attrs
+            )
+
+    def table(self, name: str) -> list[dict]:
+        return list(self._tables.get(name, []))
+
+    def tables(self) -> list[str]:
+        return sorted(self._tables)
+
+    def export_jsonl(self, name: str) -> str:
+        return "\n".join(json.dumps(r) for r in self._tables.get(name, []))
+
+    def clear(self) -> None:
+        self._tables.clear()
+
+
+# Process-wide default tracer (the node wires its own when needed).
+_default = Tracer()
+
+
+def traced() -> Tracer:
+    return _default

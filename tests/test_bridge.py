@@ -1,0 +1,76 @@
+"""C bridge end-to-end: build the .so, spawn the worker, match the direct path."""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.bridge.client import BridgeClient
+from celestia_app_tpu.constants import NAMESPACE_SIZE, SHARE_SIZE
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUILD_DIR = os.path.join(REPO, "bridge", "build")
+
+
+@pytest.fixture(scope="module")
+def bridge_lib() -> str:
+    subprocess.run(
+        ["cmake", "-S", os.path.join(REPO, "bridge"), "-B", BUILD_DIR],
+        check=True,
+        capture_output=True,
+    )
+    subprocess.run(
+        ["cmake", "--build", BUILD_DIR], check=True, capture_output=True
+    )
+    return os.path.join(BUILD_DIR, "libcelestia_square_bridge.so")
+
+
+@pytest.fixture(scope="module")
+def client(bridge_lib):
+    # The worker inherits this test env (JAX_PLATFORMS=cpu via conftest).
+    c = BridgeClient(bridge_lib, warmup_ks=[4])
+    yield c
+    c.shutdown()
+
+
+def random_ods(k: int, seed=11) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    n = k * k
+    ns = np.sort(rng.integers(0, 200, n).astype(np.uint8))
+    ods = rng.integers(0, 256, (n, SHARE_SIZE), dtype=np.uint8)
+    ods[:, :NAMESPACE_SIZE] = 0
+    ods[:, NAMESPACE_SIZE - 1] = ns
+    return ods.reshape(k, k, SHARE_SIZE)
+
+
+def test_ping(client):
+    assert client.ping()
+
+
+def test_bridge_matches_direct_pipeline(client):
+    from celestia_app_tpu.da.eds import ExtendedDataSquare
+
+    ods = random_ods(4)
+    eds_b, row_b, col_b, droot_b = client.extend_and_dah(ods)
+    direct = ExtendedDataSquare.compute(ods)
+    assert np.array_equal(eds_b, direct.squared())
+    assert b"".join(direct.row_roots()) == row_b.tobytes()
+    assert b"".join(direct.col_roots()) == col_b.tobytes()
+    assert droot_b == direct.data_root()
+
+
+def test_bridge_multiple_sizes(client):
+    from celestia_app_tpu.da.eds import ExtendedDataSquare
+
+    for k in (2, 8):
+        ods = random_ods(k, seed=k)
+        _, _, _, droot = client.extend_and_dah(ods)
+        assert droot == ExtendedDataSquare.compute(ods).data_root()
+
+
+def test_bridge_survives_many_calls(client):
+    for i in range(5):
+        ods = random_ods(2, seed=i)
+        client.extend_and_dah(ods)
+    assert client.ping()

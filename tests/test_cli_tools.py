@@ -1,0 +1,99 @@
+"""CLI daemon, tools, tracing, and querier tests."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.proof.querier import handle_query
+from celestia_app_tpu.shares.namespace import Namespace
+from celestia_app_tpu.shares.sparse import Blob
+from celestia_app_tpu.testutil import TestNode
+from celestia_app_tpu.tools.blockscan import scan_block
+from celestia_app_tpu.tools.blocktime import interval_stats
+from celestia_app_tpu.trace import Tracer, traced
+from celestia_app_tpu.user import TxClient
+
+RNG = np.random.default_rng(19)
+
+
+def _appd(home, *args):
+    env = {"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin", "PYTHONPATH": "/root/repo"}
+    return subprocess.run(
+        [sys.executable, "-m", "celestia_app_tpu.cmd.appd", "--home", str(home), *args],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=300,
+    )
+
+
+class TestAppd:
+    def test_init_start_status_resume_rollback(self, tmp_path):
+        home = tmp_path / "node"
+        r = _appd(home, "init", "tpu-devnet-1")
+        assert r.returncode == 0, r.stderr
+
+        r = _appd(home, "start", "--blocks", "2", "--no-sleep")
+        assert r.returncode == 0, r.stderr
+        assert "height=2" in r.stdout
+
+        r = _appd(home, "status")
+        assert json.loads(r.stdout)["height"] == 2
+
+        # Restart resumes from committed state (checkpoint/resume).
+        r = _appd(home, "start", "--blocks", "1", "--no-sleep")
+        assert "height=3" in r.stdout, r.stdout
+
+        r = _appd(home, "rollback")
+        assert "rolled back to height 2" in r.stdout
+        r = _appd(home, "status")
+        assert json.loads(r.stdout)["height"] == 2
+
+        r = _appd(home, "export")
+        exported = json.loads(r.stdout)
+        assert exported["height"] == 2 and exported["state"]
+
+
+class TestToolsAndTrace:
+    def test_blockscan_blocktime_trace(self):
+        node = TestNode()
+        client = TxClient(node, node.keys[:1])
+        blob = Blob(Namespace.v0(b"\x09" * 10), RNG.integers(0, 256, 2000, dtype=np.uint8).tobytes())
+        client.submit_pay_for_blob([blob])
+        node.produce_block()
+
+        info = scan_block(node.blocks[0])
+        assert info["n_blobs"] == 1 and info["blob_bytes"] == 2000
+        assert info["txs"][0]["kind"] == "blob"
+        assert info["txs"][0]["msgs"] == ["MsgPayForBlobs"]
+
+        t0 = 1_700_000_000 * 10**9
+        stats = interval_stats([t0, t0 + 15 * 10**9, t0 + 30 * 10**9])
+        assert stats["mean_s"] == pytest.approx(15.0)
+
+        tables = traced().tables()
+        assert "prepare_proposal" in tables and "process_proposal" in tables
+        row = traced().table("square_pipeline")[-1]
+        assert row["duration_ms"] > 0
+
+    def test_tracer_span_and_export(self):
+        t = Tracer()
+        with t.span("work", kind="test"):
+            pass
+        out = t.export_jsonl("work")
+        assert json.loads(out)["kind"] == "test"
+
+
+class TestQuerier:
+    def test_tx_inclusion_query(self):
+        node = TestNode()
+        client = TxClient(node, node.keys[:1])
+        blob = Blob(Namespace.v0(b"\x07" * 10), b"z" * 900)
+        client.submit_pay_for_blob([blob])
+        data = node.blocks[0]
+        payload = json.dumps({"txs": [t.hex() for t in data.txs]}).encode()
+        proof = handle_query(node.app, "custom/txInclusionProof/0", payload)
+        assert proof.verify(data.hash)

@@ -1,0 +1,51 @@
+"""ExtendBlock entry + versioned module manager tests."""
+
+import pytest
+
+from celestia_app_tpu.app.extend_block import extend_block, is_empty_block
+from celestia_app_tpu.app.module_manager import ModuleManager, VersionedModule
+from celestia_app_tpu.da import DataAvailabilityHeader
+from celestia_app_tpu.shares.namespace import Namespace
+from celestia_app_tpu.shares.sparse import Blob
+from celestia_app_tpu.tx.envelopes import BlobTx
+
+
+def test_extend_block_roundtrip():
+    btx = BlobTx(b"\x01" * 40, (Blob(Namespace.v0(b"\x05" * 10), b"d" * 3000),)).marshal()
+    eds = extend_block([btx])
+    assert eds is not None
+    dah = DataAvailabilityHeader.from_eds(eds)
+    assert len(dah.hash()) == 32
+
+
+def test_empty_block():
+    assert is_empty_block([])
+    assert extend_block([]) is None
+
+
+class TestModuleManager:
+    def test_active_sets_by_version(self):
+        mm = ModuleManager()
+        v1 = set(mm.active(1))
+        v2 = set(mm.active(2))
+        assert "blobstream" in v1 and "blobstream" not in v2
+        assert "signal" not in v1 and "signal" in v2
+        assert "minfee" not in v1 and "minfee" in v2
+        assert {"auth", "bank", "mint", "blob"} <= (v1 & v2)
+
+    def test_migrations_run_for_newly_active(self):
+        from celestia_app_tpu.state.store import KVStore
+
+        class Ctx:
+            store = KVStore()
+
+        mm = ModuleManager()
+        migrated = mm.run_migrations(Ctx(), 1, 2)
+        assert set(migrated) == {"signal", "minfee"}
+        assert mm.run_migrations(Ctx(), 2, 2) == []
+
+    def test_rejects_bad_ranges(self):
+        with pytest.raises(ValueError):
+            ModuleManager((VersionedModule("x", 3, 1),))
+        with pytest.raises(ValueError):
+            ModuleManager((VersionedModule("x", 1, 2), VersionedModule("x", 1, 2)))

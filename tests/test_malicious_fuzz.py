@@ -1,0 +1,88 @@
+"""Malicious-proposer rejection + randomized Prepare->Process consistency.
+
+Parity with TestMaliciousTestNode (test/util/malicious/app_test.go:66) and
+TestPrepareProposalConsistency (app/test/fuzz_abci_test.go:26).
+"""
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.shares.namespace import Namespace
+from celestia_app_tpu.shares.sparse import Blob
+from celestia_app_tpu.testutil import TestNode, deterministic_genesis, funded_keys
+from celestia_app_tpu.testutil.malicious import (
+    OUT_OF_ORDER,
+    WRONG_ROOT,
+    MaliciousApp,
+)
+from celestia_app_tpu.tx.messages import Coin, MsgSend
+from celestia_app_tpu.user import Signer
+from celestia_app_tpu.state.accounts import AuthKeeper
+
+
+def make_signer(node) -> Signer:
+    signer = Signer(node.chain_id)
+    auth = AuthKeeper(node.app.cms.working)
+    for k in node.keys:
+        acc = auth.get_account(k.public_key().address())
+        signer.add_account(k, acc.account_number, acc.sequence)
+    return signer
+
+
+def pfb(node, signer, tag: int, size: int, rng) -> bytes:
+    from celestia_app_tpu.modules.blob.types import estimate_gas
+
+    addr = signer.addresses()[0]
+    blobs = [Blob(Namespace.v0(bytes([tag]) * 10), rng.integers(0, 256, size, dtype=np.uint8).tobytes())]
+    gas = estimate_gas([size])
+    raw = signer.create_pay_for_blobs(addr, blobs, gas, gas)
+    signer.increment_sequence(addr)
+    return raw
+
+
+@pytest.mark.parametrize("behavior", [OUT_OF_ORDER, WRONG_ROOT])
+def test_honest_validator_rejects_malicious_proposal(behavior):
+    rng = np.random.default_rng(3)
+    keys = funded_keys(2)
+    genesis = deterministic_genesis(keys)
+
+    evil_node = TestNode(genesis, keys)
+    evil_node.app = MaliciousApp(behavior=behavior, node_min_gas_price=evil_node.app.node_min_gas_price)
+    evil_node.app.init_chain(genesis)
+    honest_node = TestNode(genesis, keys)
+
+    signer = make_signer(evil_node)
+    txs = [pfb(evil_node, signer, 10, 2000, rng), pfb(evil_node, signer, 20, 3000, rng)]
+    proposal = evil_node.app.prepare_proposal(txs)
+
+    assert not honest_node.app.process_proposal(proposal)
+    # Sanity: an honest proposal from the same txs is accepted.
+    good = honest_node.app.prepare_proposal(txs)
+    assert honest_node.app.process_proposal(good)
+
+
+def test_prepare_process_consistency_fuzz():
+    """Random tx mixes round-trip Prepare -> Process across many cases."""
+    rng = np.random.default_rng(1234)
+    keys = funded_keys(3)
+    for trial in range(5):
+        node = TestNode(deterministic_genesis(keys), keys)
+        signer = make_signer(node)
+        txs: list[bytes] = []
+        n = int(rng.integers(1, 7))
+        for i in range(n):
+            kind = rng.integers(0, 3)
+            if kind < 2:
+                txs.append(pfb(node, signer, int(rng.integers(1, 200)), int(rng.integers(1, 40_000)), rng))
+            else:
+                addr = signer.addresses()[0]
+                msg = MsgSend(addr, signer.addresses()[1], (Coin("utia", int(rng.integers(1, 1000))),))
+                txs.append(signer.create_tx(addr, [msg], 200_000, 20_000))
+                signer.increment_sequence(addr)
+        # Garbage txs must never break the pipeline.
+        txs.append(rng.integers(0, 256, 150, dtype=np.uint8).tobytes())
+        data = node.app.prepare_proposal(txs)
+        assert node.app.process_proposal(data), f"trial {trial} rejected own proposal"
+        results = node.app.finalize_block(node.app.last_block_time_ns + 1, list(data.txs))
+        assert all(r.code == 0 for r in results)
+        node.app.commit()
