@@ -13,11 +13,17 @@ is one dense matmul per axis phase - exactly the shape the MXU wants.  The
 mod-2 is a final `& 1` on the int32 accumulator (max k*m = 8192 partial
 products, far below 2^31).
 
-Data layout: a square is (rows, cols, SHARE_SIZE) uint8.  Bit-planes put the
-contraction axis (share-index x bit) first and batch (row x symbol) columns
-into one wide matmul.  The column phase extends all 2k columns of the
-row-extended top half in a single matmul, yielding Q2 and Q3 at once - valid
-because row/col encodes commute (EDS = [[Q0, Q0 G^T], [G Q0, G Q0 G^T]]).
+Layout discipline (measured on v5e: uint8 relayouts are ~50x the matmul
+cost, so they decide everything):
+
+  * all transposes happen on BYTE arrays, never on the 8x larger bit
+    planes;
+  * bit unpack/pack keep the huge batch axis (R*nsym) as the trailing
+    lane dimension and put the 8-wide bit axis in the middle;
+  * `encode_axis` contracts over a caller-chosen axis, so the column
+    phase of the square extension consumes the row-extended top half
+    with NO transpose at all - its parity lands directly as the bottom
+    rows.
 """
 
 from __future__ import annotations
@@ -27,62 +33,58 @@ from functools import lru_cache
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from celestia_app_tpu.gf.rs import codec_for_width
 
-# int8 feeds the MXU's integer path on TPU; float32 is an exact fallback
-# (0/1 products, sums <= 8192 << 2^24).
+# int8 feeds the MXU's integer path on TPU; exactness: 0/1 products with
+# <= 8192-term sums, far inside int32.
 _DOT_DTYPE = jnp.int8
 
 
-def _bits_from_bytes(shares: jnp.ndarray, m: int) -> jnp.ndarray:
-    """(R, n, S) uint8 -> (R, n*m, n_symbols) bit-planes in {0,1}.
+def _mod2_matmul_planes(G_bits: jnp.ndarray, x: jnp.ndarray, m: int) -> jnp.ndarray:
+    """Core bit-sliced product: bytes (n, bps, cols) -> bytes (P, bps, cols).
 
-    Bit t of a symbol (t in [0,m)) lives at byte t//8 (little-endian within
-    the symbol) bit t%8 - matching gf.field.GF.mul_bit_matrix's convention.
+    `x` holds the contraction-axis shares as byte planes: x[j, b, c] is
+    byte b of symbol-column c of share j.  Unpacks to {0,1} int8 with the
+    bit axis in the middle, runs ONE dense (P*m, n*m) x (n*m, cols) int8
+    matmul, and repacks.  cols is the flattened (batch x symbol) axis and
+    stays the innermost lane dimension throughout.
     """
-    R, n, S = shares.shape
-    bps = m // 8  # bytes per symbol
-    nsym = S // bps
-    x = shares.reshape(R, n, nsym, bps)
-    bits = (x[..., None] >> jnp.arange(8, dtype=jnp.uint8)) & jnp.uint8(1)
-    bits = bits.reshape(R, n, nsym, m)
-    return bits.transpose(0, 1, 3, 2).reshape(R, n * m, nsym)
-
-
-def _bytes_from_bits(bits: jnp.ndarray, m: int) -> jnp.ndarray:
-    """Inverse of _bits_from_bytes: (R, n*m, nsym) -> (R, n, S)."""
-    R, nm, nsym = bits.shape
-    n = nm // m
-    bps = m // 8
-    b = bits.reshape(R, n, m, nsym).transpose(0, 1, 3, 2)
-    b = b.reshape(R, n, nsym, bps, 8).astype(jnp.uint8)
-    weights = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8)).astype(jnp.uint8)
-    by = (b * weights).sum(axis=-1, dtype=jnp.uint32).astype(jnp.uint8)
-    return by.reshape(R, n, nsym * bps)
-
-
-def _mod2_matmul(G_bits: jnp.ndarray, bits: jnp.ndarray) -> jnp.ndarray:
-    """(P, Q) x (R, Q, nsym) -> (R, P, nsym), all in {0,1}.
-
-    Collapses the (R, nsym) batch into matmul columns so the device sees one
-    large dense dot per phase.
-    """
-    R, Q, nsym = bits.shape
-    x = bits.transpose(1, 0, 2).reshape(Q, R * nsym)
-    acc = jax.lax.dot_general(
+    n, bps, cols = x.shape
+    bits = (x[:, :, None, :] >> jnp.arange(8, dtype=jnp.uint8)[None, None, :, None]) & 1
+    B = bits.reshape(n * m, cols).astype(_DOT_DTYPE)
+    acc = lax.dot_general(
         G_bits.astype(_DOT_DTYPE),
-        x.astype(_DOT_DTYPE),
+        B,
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32,
-    )
-    out = (acc & 1).astype(jnp.uint8)
-    return out.reshape(-1, R, nsym).transpose(1, 0, 2)
+    )  # (P*m, cols)
+    P = acc.shape[0] // m
+    pb = (acc & 1).astype(jnp.uint32).reshape(P, bps, 8, cols)
+    weights = (jnp.uint32(1) << jnp.arange(8, dtype=jnp.uint32))[None, None, :, None]
+    return (pb * weights).sum(axis=2).astype(jnp.uint8)  # (P, bps, cols)
 
 
-def encode_axis(data: jnp.ndarray, G_bits: jnp.ndarray, m: int) -> jnp.ndarray:
-    """Batched systematic encode along axis 1: (R, k, S) -> (R, k, S) parity."""
-    return _bytes_from_bits(_mod2_matmul(G_bits, _bits_from_bytes(data, m)), m)
+def encode_axis(
+    data: jnp.ndarray, G_bits: jnp.ndarray, m: int, contract_axis: int = 1
+) -> jnp.ndarray:
+    """Systematic encode contracting over `contract_axis` of (A, B, S) bytes.
+
+    Returns parity with the contracted axis replaced by P = G rows / m at
+    the same position; the other two axes are untouched.  contract_axis=0
+    runs with zero byte transposes (the square extension's column phase).
+    """
+    bps = m // 8
+    x = jnp.moveaxis(data, contract_axis, 0)  # (n, batch, S)
+    n, batch, S = x.shape
+    nsym = S // bps
+    cols = batch * nsym
+    planes = jnp.moveaxis(x.reshape(n, batch, nsym, bps), 3, 1)  # (n, bps, batch, nsym)
+    out = _mod2_matmul_planes(G_bits, planes.reshape(n, bps, cols), m)
+    P = out.shape[0]
+    by = jnp.moveaxis(out.reshape(P, bps, batch, nsym), 1, 3)  # (P, batch, nsym, bps)
+    return jnp.moveaxis(by.reshape(P, batch, S), 0, contract_axis)
 
 
 def extend_square_fn(k: int):
@@ -98,12 +100,12 @@ def extend_square_fn(k: int):
 
     def extend(ods: jnp.ndarray) -> jnp.ndarray:
         # Row phase: each of the k rows is a codeword batch along cols.
-        q1 = encode_axis(ods, G_bits, m)  # (k, k, S)
+        q1 = encode_axis(ods, G_bits, m, contract_axis=1)  # (k, k, S)
         top = jnp.concatenate([ods, q1], axis=1)  # (k, 2k, S)
-        # Column phase: extend all 2k columns of the top half at once.
-        cols = top.transpose(1, 0, 2)  # (2k, k, S)
-        bottom_cols = encode_axis(cols, G_bits, m)  # (2k, k, S)
-        bottom = bottom_cols.transpose(1, 0, 2)  # (k, 2k, S)
+        # Column phase: contract over the row axis directly - Q2 and Q3
+        # arrive as the bottom rows with no transpose (row/col encodes
+        # commute: EDS = [[Q0, Q0 G^T], [G Q0, G Q0 G^T]]).
+        bottom = encode_axis(top, G_bits, m, contract_axis=0)  # (k, 2k, S)
         return jnp.concatenate([top, bottom], axis=0)  # (2k, 2k, S)
 
     return extend
@@ -133,6 +135,6 @@ def decode_axis_fn(k: int):
     m = codec.field.m
 
     def decode(known: jnp.ndarray, R_bits: jnp.ndarray) -> jnp.ndarray:
-        return _bytes_from_bits(_mod2_matmul(R_bits, _bits_from_bytes(known, m)), m)
+        return encode_axis(known, R_bits, m, contract_axis=1)
 
     return jax.jit(decode)

@@ -70,6 +70,10 @@ def make_sharded_pipeline(k: int, mesh: Mesh, axis: str = "data"):
         # Row phase: extend local rows. (k/n, k, S) -> (k/n, 2k, S)
         q1 = encode_axis(ods_local, G_bits, m)
         top_local = jnp.concatenate([ods_local, q1], axis=1)
+        # Materialize before the collective: XLA otherwise forwards the two
+        # concat operands into a tuple all-to-all with mismatched layouts
+        # (rejected by the HLO verifier on the CPU backend).
+        top_local = lax.optimization_barrier(top_local)
 
         # P4: re-shard column-wise. Device j ends up with all k top rows of
         # its 2k/n-column block.

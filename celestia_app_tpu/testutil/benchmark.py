@@ -1,0 +1,105 @@
+"""Throughput benchmark harness (reference test/e2e/benchmark).
+
+The reference's headline e2e criterion: sustain blocks carrying >= 90% of
+MaxBlockBytes over the run (test/e2e/benchmark/throughput.go:110-128,
+benchmark.go:172-189).  This harness drives the in-process node with
+saturating PFB load and evaluates the same criterion; block sizes, fill
+ratios, and wall times land in the trace tables for inspection.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from celestia_app_tpu.constants import CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
+from celestia_app_tpu.modules.blob.types import estimate_gas
+from celestia_app_tpu.shares.namespace import Namespace
+from celestia_app_tpu.shares.sparse import Blob
+from celestia_app_tpu.trace import traced
+from celestia_app_tpu.user import Signer
+from celestia_app_tpu.state.accounts import AuthKeeper
+
+
+@dataclass
+class ThroughputResult:
+    blocks: int
+    passing_blocks: int  # blocks at >= target fill
+    mean_fill: float
+    mean_block_bytes: float
+    mean_block_seconds: float
+
+    def sustained(self, min_ratio: float = 0.9) -> bool:
+        """throughput.go:124 pass criterion over the whole run."""
+        return self.blocks > 0 and self.passing_blocks == self.blocks
+
+
+def max_block_bytes(gov_max_square_size: int) -> int:
+    """DefaultMaxBytes shape: square capacity x usable share bytes
+    (pkg/appconsts/initial_consts.go:10-14)."""
+    return (
+        gov_max_square_size
+        * gov_max_square_size
+        * CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
+    )
+
+
+def run_throughput(
+    node,
+    blocks: int = 5,
+    blob_size: int = 50_000,
+    target_fill: float = 0.9,
+    seed: int = 7,
+) -> ThroughputResult:
+    """Saturate every block with PFBs, produce, and score fill ratios."""
+    rng = np.random.default_rng(seed)
+    app = node.app
+    signer = Signer(node.chain_id)
+    auth = AuthKeeper(app.cms.working)
+    for k in node.keys:
+        acc = auth.get_account(k.public_key().address())
+        signer.add_account(k, acc.account_number, acc.sequence)
+    addr = signer.addresses()[0]
+
+    cap_bytes = max_block_bytes(app.gov_max_square_size)
+    per_block = max(1, int(cap_bytes / blob_size))
+
+    fills: list[float] = []
+    sizes: list[int] = []
+    times: list[float] = []
+    for _ in range(blocks):
+        txs = []
+        for _ in range(per_block):
+            ns = Namespace.v0(rng.integers(1, 256, 10, dtype=np.uint8).tobytes())
+            blob = Blob(ns, rng.integers(0, 256, blob_size, dtype=np.uint8).tobytes())
+            gas = estimate_gas([blob_size])
+            txs.append(signer.create_pay_for_blobs(addr, [blob], gas, gas))
+            signer.increment_sequence(addr)
+        t0 = time.perf_counter()
+        data = app.prepare_proposal(txs)
+        assert app.process_proposal(data)
+        app.finalize_block(app.last_block_time_ns + 10**9, list(data.txs))
+        app.commit()
+        dt = time.perf_counter() - t0
+        block_bytes = sum(len(t) for t in data.txs)
+        fill = block_bytes / cap_bytes
+        fills.append(fill)
+        sizes.append(block_bytes)
+        times.append(dt)
+        traced().write(
+            "throughput", height=app.height, block_bytes=block_bytes,
+            fill=fill, seconds=dt,
+        )
+        # Re-sync sequences: txs dropped by the square cap would desync.
+        acc = AuthKeeper(app.cms.working).get_account(addr)
+        signer.set_sequence(addr, acc.sequence)
+
+    return ThroughputResult(
+        blocks=blocks,
+        passing_blocks=sum(f >= target_fill for f in fills),
+        mean_fill=sum(fills) / len(fills),
+        mean_block_bytes=sum(sizes) / len(sizes),
+        mean_block_seconds=sum(times) / len(times),
+    )

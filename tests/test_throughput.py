@@ -1,0 +1,22 @@
+"""Throughput harness test (small-square version of the e2e criterion)."""
+
+from celestia_app_tpu.testutil import TestNode, deterministic_genesis, funded_keys
+from celestia_app_tpu.testutil.benchmark import max_block_bytes, run_throughput
+
+
+def test_sustained_fill_small_square():
+    keys = funded_keys(2)
+    # Give the saturator enough funds for several full blocks of fees.
+    node = TestNode(deterministic_genesis(keys, gov_max_square_size=16), keys)
+    res = run_throughput(node, blocks=3, blob_size=30_000, target_fill=0.5)
+    assert res.blocks == 3
+    assert res.mean_fill >= 0.5, res
+    assert res.mean_block_bytes <= max_block_bytes(16)
+
+
+def test_fill_ratio_sane():
+    keys = funded_keys(2)
+    node = TestNode(deterministic_genesis(keys, gov_max_square_size=16), keys)
+    res = run_throughput(node, blocks=2, blob_size=120_000, target_fill=0.5)
+    # Blobs near the square cap still land and fills stay in (0, 1].
+    assert 0 < res.mean_fill <= 1.0
