@@ -20,7 +20,6 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from celestia_app_tpu.constants import (
-    DEFAULT_GAS_PER_BLOB_BYTE,
     DEFAULT_GOV_MAX_SQUARE_SIZE,
     LATEST_VERSION,
     SQUARE_SIZE_UPPER_BOUND,
@@ -110,8 +109,6 @@ class App:
         self.height = 0
         self.genesis_time_ns = 0
         self.last_block_time_ns = 0
-        self.gov_max_square_size = DEFAULT_GOV_MAX_SQUARE_SIZE
-        self.gas_per_blob_byte = DEFAULT_GAS_PER_BLOB_BYTE
         self.node_min_gas_price = node_min_gas_price or Dec.from_str("0.002")
         self.minter = Minter.default()
         self._check_state: KVStore | None = None
@@ -120,6 +117,19 @@ class App:
     @property
     def minfee(self) -> MinFeeKeeper:
         return MinFeeKeeper(self.cms.working)
+
+    @property
+    def gov_max_square_size(self) -> int:
+        """On-chain x/blob param (read at square_size.go:20-22)."""
+        from celestia_app_tpu.modules.blob.params import BlobParamsKeeper
+
+        return BlobParamsKeeper(self.cms.working).gov_max_square_size()
+
+    @property
+    def gas_per_blob_byte(self) -> int:
+        from celestia_app_tpu.modules.blob.params import BlobParamsKeeper
+
+        return BlobParamsKeeper(self.cms.working).gas_per_blob_byte()
 
     @property
     def signal(self) -> SignalKeeper:
@@ -137,7 +147,11 @@ class App:
         self.app_version = genesis.app_version
         self.genesis_time_ns = genesis.genesis_time_ns
         self.last_block_time_ns = genesis.genesis_time_ns
-        self.gov_max_square_size = genesis.gov_max_square_size
+        from celestia_app_tpu.modules.blob.params import BlobParamsKeeper
+
+        BlobParamsKeeper(self.cms.working).set_gov_max_square_size(
+            genesis.gov_max_square_size
+        )
         ctx = Ctx(self.cms.working, 0, genesis.genesis_time_ns, self.app_version)
         for acc in genesis.accounts:
             a = ctx.auth.create_account(acc.address, acc.pubkey)

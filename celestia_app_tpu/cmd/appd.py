@@ -110,7 +110,6 @@ def load_app(home: str) -> App:
         app.app_version = meta["app_version"]
         app.genesis_time_ns = meta["genesis_time_ns"]
         app.last_block_time_ns = meta["last_block_time_ns"]
-        app.gov_max_square_size = meta["gov_max_square_size"]
     else:
         app.init_chain(genesis)
         save_app(home, app)
@@ -127,7 +126,6 @@ def save_app(home: str, app: App) -> None:
                 "app_version": app.app_version,
                 "genesis_time_ns": app.genesis_time_ns,
                 "last_block_time_ns": app.last_block_time_ns,
-                "gov_max_square_size": app.gov_max_square_size,
             },
             f,
         )

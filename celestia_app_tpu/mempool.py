@@ -1,0 +1,101 @@
+"""Prioritized mempool (celestia-core mempool v1 semantics).
+
+Parity with the reference node defaults (app/default_overrides.go:258-284):
+version "v1" prioritized mempool, TTL of 5 blocks, MaxTxBytes cap sized to
+the biggest square (128^2 x 478).  Admission runs CheckTx first (the app
+sets the priority = gas price x 1e6, app/ante/fee_checker.go:17); reaping
+returns txs in priority order under a byte budget, the order PrepareProposal
+receives them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+DEFAULT_TTL_NUM_BLOCKS = 5
+DEFAULT_MAX_TX_BYTES = 128 * 128 * 478  # ~7.8 MB
+DEFAULT_MAX_POOL_BYTES = 4 * DEFAULT_MAX_TX_BYTES
+
+
+@dataclass
+class _Entry:
+    tx: bytes
+    priority: int
+    height: int  # admission height (for TTL)
+    seq: int  # FIFO tiebreak
+
+
+class PriorityMempool:
+    def __init__(
+        self,
+        ttl_num_blocks: int = DEFAULT_TTL_NUM_BLOCKS,
+        max_tx_bytes: int = DEFAULT_MAX_TX_BYTES,
+        max_pool_bytes: int = DEFAULT_MAX_POOL_BYTES,
+    ):
+        self.ttl = ttl_num_blocks
+        self.max_tx_bytes = max_tx_bytes
+        self.max_pool_bytes = max_pool_bytes
+        self._entries: dict[bytes, _Entry] = {}
+        self._seq = 0
+        self._bytes = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def size_bytes(self) -> int:
+        return self._bytes
+
+    @staticmethod
+    def tx_key(tx: bytes) -> bytes:
+        return hashlib.sha256(tx).digest()
+
+    def insert(self, tx: bytes, priority: int, height: int) -> bool:
+        """Admit a checked tx; False if duplicate, oversized, or the pool is
+        full of higher-priority txs."""
+        if len(tx) > self.max_tx_bytes:
+            return False
+        key = self.tx_key(tx)
+        if key in self._entries:
+            return False
+        # Evict lowest-priority entries to make room (prioritized admission).
+        while self._bytes + len(tx) > self.max_pool_bytes and self._entries:
+            victim_key, victim = min(
+                self._entries.items(), key=lambda kv: (kv[1].priority, -kv[1].seq)
+            )
+            if victim.priority >= priority:
+                return False  # everything resident outranks the newcomer
+            self._remove(victim_key)
+        self._entries[key] = _Entry(tx, priority, height, self._seq)
+        self._seq += 1
+        self._bytes += len(tx)
+        return True
+
+    def _remove(self, key: bytes) -> None:
+        e = self._entries.pop(key, None)
+        if e is not None:
+            self._bytes -= len(e.tx)
+
+    def reap(self, max_bytes: int | None = None) -> list[bytes]:
+        """Txs by (priority desc, FIFO) under a byte budget."""
+        ordered = sorted(
+            self._entries.values(), key=lambda e: (-e.priority, e.seq)
+        )
+        out: list[bytes] = []
+        total = 0
+        for e in ordered:
+            if max_bytes is not None and total + len(e.tx) > max_bytes:
+                continue
+            out.append(e.tx)
+            total += len(e.tx)
+        return out
+
+    def update(self, height: int, committed_txs: list[bytes]) -> None:
+        """Post-commit maintenance: drop included txs, expire TTLs."""
+        for tx in committed_txs:
+            self._remove(self.tx_key(tx))
+        expired = [
+            k for k, e in self._entries.items() if height - e.height >= self.ttl
+        ]
+        for k in expired:
+            self._remove(k)

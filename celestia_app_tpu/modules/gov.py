@@ -1,0 +1,110 @@
+"""Governance-lite: validator-voted parameter changes.
+
+The reference runs full cosmos-sdk x/gov with celestia's paramfilter wrapped
+around the param-change handler (x/paramfilter/gov_handler.go:36, blocklist
+wired at app/app.go:739-750).  This module keeps the governance surface that
+matters to the framework — propose a parameter change, vote by validator
+power, execute on majority — with the paramfilter gate enforced at both
+submission and execution.  Deposit/period machinery from the SDK is
+intentionally out: proposals here tally when asked.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+from celestia_app_tpu.modules.paramfilter import validate_param_changes
+from celestia_app_tpu.state.dec import Dec
+from celestia_app_tpu.state.staking import StakingKeeper
+from celestia_app_tpu.state.store import KVStore
+
+
+@dataclass(frozen=True)
+class ParamChange:
+    subspace: str
+    key: str
+    value: str
+
+
+class GovError(ValueError):
+    pass
+
+
+def default_param_setters(store: KVStore) -> dict[tuple[str, str], Callable[[str], None]]:
+    """The governance-settable parameter registry."""
+    from celestia_app_tpu.modules.blob.params import BlobParamsKeeper
+    from celestia_app_tpu.modules.minfee import MinFeeKeeper
+
+    blob = BlobParamsKeeper(store)
+    minfee = MinFeeKeeper(store)
+    return {
+        ("blob", "GasPerBlobByte"): lambda v: blob.set_gas_per_blob_byte(int(v)),
+        ("blob", "GovMaxSquareSize"): lambda v: blob.set_gov_max_square_size(int(v)),
+        ("minfee", "NetworkMinGasPrice"): lambda v: minfee.set_network_min_gas_price(
+            Dec.from_str(v)
+        ),
+    }
+
+
+class GovKeeper:
+    def __init__(self, store: KVStore, staking: StakingKeeper):
+        self.store = store
+        self.staking = staking
+        self._setters = default_param_setters(store)
+
+    # --- proposals ---------------------------------------------------------
+    def _next_id(self) -> int:
+        raw = self.store.get(b"gov/next_id")
+        n = int.from_bytes(raw, "big") if raw else 1
+        self.store.set(b"gov/next_id", (n + 1).to_bytes(8, "big"))
+        return n
+
+    def submit_param_change(self, proposer: str, changes: list[ParamChange]) -> int:
+        if not changes:
+            raise GovError("empty proposal")
+        validate_param_changes([(c.subspace, c.key, c.value) for c in changes])
+        for c in changes:
+            if (c.subspace, c.key) not in self._setters:
+                raise GovError(f"unknown parameter {c.subspace}/{c.key}")
+        pid = self._next_id()
+        payload = "\x1e".join(f"{c.subspace}\x1f{c.key}\x1f{c.value}" for c in changes)
+        self.store.set(f"gov/prop/{pid}".encode(), payload.encode())
+        return pid
+
+    def _changes(self, proposal_id: int) -> list[ParamChange]:
+        raw = self.store.get(f"gov/prop/{proposal_id}".encode())
+        if raw is None:
+            raise GovError(f"no proposal {proposal_id}")
+        out = []
+        for rec in raw.decode().split("\x1e"):
+            subspace, key, value = rec.split("\x1f")
+            out.append(ParamChange(subspace, key, value))
+        return out
+
+    # --- voting ------------------------------------------------------------
+    def vote(self, proposal_id: int, validator: str, approve: bool) -> None:
+        self._changes(proposal_id)  # existence check
+        if not self.staking.has_validator(validator):
+            raise GovError(f"no validator {validator}")
+        self.store.set(
+            f"gov/vote/{proposal_id}/{validator}".encode(),
+            b"\x01" if approve else b"\x00",
+        )
+
+    def tally_and_execute(self, proposal_id: int) -> bool:
+        """Execute the change set iff yes-power > half the total power."""
+        changes = self._changes(proposal_id)
+        yes = 0
+        prefix = f"gov/vote/{proposal_id}/".encode()
+        for key, val in self.store.iterate(prefix):
+            if val == b"\x01":
+                yes += self.staking.get_power(key[len(prefix) :].decode())
+        if 2 * yes <= self.staking.total_power():
+            return False
+        # Re-check the filter at execution (the blocklist is consensus law).
+        validate_param_changes([(c.subspace, c.key, c.value) for c in changes])
+        for c in changes:
+            self._setters[(c.subspace, c.key)](c.value)
+        self.store.delete(f"gov/prop/{proposal_id}".encode())
+        return True

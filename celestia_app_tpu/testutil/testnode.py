@@ -59,10 +59,12 @@ class TestNode:
     __test__ = False  # not a pytest class
 
     def __init__(self, genesis: Genesis | None = None, keys: list[PrivateKey] | None = None):
+        from celestia_app_tpu.mempool import PriorityMempool
+
         self.keys = keys if keys is not None else funded_keys(4)
         self.app = App(node_min_gas_price=Dec.from_str("0.000001"))
         self.app.init_chain(genesis or deterministic_genesis(self.keys))
-        self.mempool: list[bytes] = []
+        self.mempool = PriorityMempool()
         self.blocks: list[BlockData] = []
 
     @property
@@ -72,7 +74,10 @@ class TestNode:
     def broadcast(self, raw_tx: bytes) -> TxResult:
         res = self.app.check_tx(raw_tx)
         if res.code == 0:
-            self.mempool.append(raw_tx)
+            priority = next(
+                (e[1] for e in res.events if e[0] == "priority"), 0
+            )
+            self.mempool.insert(raw_tx, priority, self.app.height)
         return res
 
     def produce_block(self) -> tuple[BlockData, list[TxResult]]:
@@ -80,11 +85,11 @@ class TestNode:
         time_ns = (
             self.app.last_block_time_ns + BLOCK_INTERVAL_NS
         )
-        data = self.app.prepare_proposal(self.mempool)
+        data = self.app.prepare_proposal(self.mempool.reap())
         if not self.app.process_proposal(data):
             raise AssertionError("node rejected its own proposal")
         results = self.app.finalize_block(time_ns, list(data.txs))
         self.app.commit()
-        self.mempool = []
+        self.mempool.update(self.app.height, list(data.txs))
         self.blocks.append(data)
         return data, results

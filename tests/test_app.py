@@ -160,7 +160,7 @@ class TestProcessProposal:
         key = node.keys[0]
         blobs = (Blob(user_ns(5), rand_bytes(3000)),)
         node.broadcast(pfb_tx(node, key, blobs, seq=0))
-        return node.app.prepare_proposal(node.mempool)
+        return node.app.prepare_proposal(node.mempool.reap())
 
     def test_accepts_own_proposal(self, node):
         data = self._valid_proposal(node)

@@ -1,0 +1,77 @@
+"""Governance-lite + on-chain blob params tests."""
+
+import pytest
+
+from celestia_app_tpu.modules.blob.params import BlobParamsKeeper
+from celestia_app_tpu.modules.gov import GovError, GovKeeper, ParamChange
+from celestia_app_tpu.modules.minfee import MinFeeKeeper
+from celestia_app_tpu.modules.paramfilter import ForbiddenParamError
+from celestia_app_tpu.state.staking import StakingKeeper, Validator
+from celestia_app_tpu.state.store import KVStore
+from celestia_app_tpu.testutil import TestNode
+
+
+def make_gov(powers: dict[str, int]):
+    store = KVStore()
+    staking = StakingKeeper(store)
+    for a, p in powers.items():
+        staking.set_validator(Validator(a, b"", p))
+    return GovKeeper(store, staking), store
+
+
+class TestGov:
+    def test_minority_does_not_execute(self):
+        gov, store = make_gov({"v1": 60, "v2": 40})
+        pid = gov.submit_param_change(
+            "v2", [ParamChange("blob", "GasPerBlobByte", "16")]
+        )
+        gov.vote(pid, "v2", True)  # 40%: not a majority
+        gov.vote(pid, "v1", False)
+        assert not gov.tally_and_execute(pid)
+        assert BlobParamsKeeper(store).gas_per_blob_byte() == 8
+
+    def test_majority_executes(self):
+        gov, store = make_gov({"v1": 60, "v2": 40})
+        pid = gov.submit_param_change(
+            "v1",
+            [
+                ParamChange("blob", "GovMaxSquareSize", "128"),
+                ParamChange("minfee", "NetworkMinGasPrice", "0.00001"),
+            ],
+        )
+        gov.vote(pid, "v1", True)
+        assert gov.tally_and_execute(pid)
+        assert BlobParamsKeeper(store).gov_max_square_size() == 128
+        assert str(MinFeeKeeper(store).network_min_gas_price()).startswith("0.00001")
+        # Executed proposals are gone.
+        with pytest.raises(GovError):
+            gov.tally_and_execute(pid)
+
+    def test_blocklist_enforced(self):
+        gov, _ = make_gov({"v1": 100})
+        with pytest.raises(ForbiddenParamError):
+            gov.submit_param_change(
+                "v1", [ParamChange("staking", "BondDenom", "ufake")]
+            )
+
+    def test_unknown_param_rejected(self):
+        gov, _ = make_gov({"v1": 100})
+        with pytest.raises(GovError):
+            gov.submit_param_change("v1", [ParamChange("blob", "Nope", "1")])
+
+    def test_invalid_value_rejected_at_execution(self):
+        gov, _ = make_gov({"v1": 100})
+        pid = gov.submit_param_change(
+            "v1", [ParamChange("blob", "GovMaxSquareSize", "100")]  # not pow2
+        )
+        gov.vote(pid, "v1", True)
+        with pytest.raises(ValueError):
+            gov.tally_and_execute(pid)
+
+
+class TestOnChainParams:
+    def test_app_reads_params_from_state(self):
+        node = TestNode()
+        assert node.app.gov_max_square_size == 64
+        BlobParamsKeeper(node.app.cms.working).set_gov_max_square_size(32)
+        assert node.app.max_effective_square_size() == 32

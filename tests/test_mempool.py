@@ -1,0 +1,54 @@
+"""Prioritized mempool tests (mempool v1 semantics)."""
+
+from celestia_app_tpu.mempool import PriorityMempool
+
+
+def tx(n: int, size: int = 100) -> bytes:
+    return bytes([n]) * size
+
+
+class TestPriorityMempool:
+    def test_priority_order_with_fifo_tiebreak(self):
+        mp = PriorityMempool()
+        mp.insert(tx(1), priority=10, height=0)
+        mp.insert(tx(2), priority=30, height=0)
+        mp.insert(tx(3), priority=30, height=0)
+        mp.insert(tx(4), priority=20, height=0)
+        assert mp.reap() == [tx(2), tx(3), tx(4), tx(1)]
+
+    def test_dedup_and_oversize(self):
+        mp = PriorityMempool(max_tx_bytes=150)
+        assert mp.insert(tx(1), 1, 0)
+        assert not mp.insert(tx(1), 1, 0)  # duplicate
+        assert not mp.insert(tx(2, size=200), 99, 0)  # oversized
+
+    def test_ttl_eviction(self):
+        mp = PriorityMempool(ttl_num_blocks=2)
+        mp.insert(tx(1), 1, height=5)
+        mp.update(height=6, committed_txs=[])
+        assert len(mp) == 1
+        mp.update(height=7, committed_txs=[])
+        assert len(mp) == 0
+
+    def test_committed_removed(self):
+        mp = PriorityMempool()
+        mp.insert(tx(1), 1, 0)
+        mp.insert(tx(2), 2, 0)
+        mp.update(height=1, committed_txs=[tx(2)])
+        assert mp.reap() == [tx(1)]
+
+    def test_byte_budget_reap(self):
+        mp = PriorityMempool()
+        mp.insert(tx(1, 100), 5, 0)
+        mp.insert(tx(2, 100), 3, 0)
+        assert mp.reap(max_bytes=150) == [tx(1, 100)]
+
+    def test_eviction_under_pressure(self):
+        mp = PriorityMempool(max_pool_bytes=250)
+        mp.insert(tx(1, 100), priority=1, height=0)
+        mp.insert(tx(2, 100), priority=2, height=0)
+        # Higher-priority newcomer evicts the lowest-priority resident.
+        assert mp.insert(tx(3, 100), priority=5, height=0)
+        assert tx(1, 100) not in mp.reap()
+        # Lower-priority newcomer is refused when the pool outranks it.
+        assert not mp.insert(tx(4, 100), priority=0, height=0)
