@@ -18,6 +18,14 @@ import os
 import sys
 import time
 
+# Pin the JAX platform from the environment BEFORE any backend client can
+# be created: site hooks may pre-register an accelerator platform that
+# ignores a later env change (same guard as tests/conftest.py).
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 from celestia_app_tpu.app import App, Genesis, GenesisAccount
 from celestia_app_tpu.crypto import PrivateKey
 from celestia_app_tpu.state.dec import Dec
@@ -131,10 +139,37 @@ def save_app(home: str, app: App) -> None:
         )
 
 
+def _snapshot_dir(home: str) -> str:
+    return os.path.join(home, "data", "snapshots")
+
+
+def _write_snapshot(home: str, app: App, keep: int = 2) -> str:
+    """State-sync snapshot artifact (reference: every 1500 blocks, keep 2,
+    app/default_overrides.go:293-297 + snapshot.Cmd at root.go:125)."""
+    os.makedirs(_snapshot_dir(home), exist_ok=True)
+    path = os.path.join(_snapshot_dir(home), f"{app.height}.json")
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "height": app.height,
+                "chain_id": app.chain_id,
+                "app_version": app.app_version,
+                "app_hash": app.cms.last_app_hash.hex(),
+                "state": {k.hex(): v.hex() for k, v in app.cms.export().items()},
+            },
+            f,
+        )
+    existing = sorted(
+        (int(p.split(".")[0]) for p in os.listdir(_snapshot_dir(home))), reverse=True
+    )
+    for h in existing[keep:]:
+        os.remove(os.path.join(_snapshot_dir(home), f"{h}.json"))
+    return path
+
+
 def cmd_start(args) -> int:
     app = load_app(args.home)
     print(f"chain {app.chain_id} at height {app.height}, producing blocks...")
-    interval_ns = args.block_interval * 10**9
     produced = 0
     while args.blocks == 0 or produced < args.blocks:
         time_ns = max(time.time_ns(), app.last_block_time_ns + 1)
@@ -145,6 +180,8 @@ def cmd_start(args) -> int:
         app.finalize_block(time_ns, list(data.txs))
         app.commit()
         save_app(args.home, app)
+        if args.snapshot_interval and app.height % args.snapshot_interval == 0:
+            _write_snapshot(args.home, app)
         produced += 1
         print(
             f"height={app.height} square={data.square_size} "
@@ -152,6 +189,33 @@ def cmd_start(args) -> int:
         )
         if args.blocks == 0 or produced < args.blocks:
             time.sleep(args.block_interval if not args.no_sleep else 0)
+    return 0
+
+
+def cmd_snapshot(args) -> int:
+    if args.action == "create":
+        app = load_app(args.home)
+        print(f"wrote {_write_snapshot(args.home, app)}")
+        return 0
+    if args.action == "list":
+        d = _snapshot_dir(args.home)
+        for p in sorted(os.listdir(d)) if os.path.isdir(d) else []:
+            print(p)
+        return 0
+    # restore: load a snapshot as the working state (state-sync join).
+    path = os.path.join(_snapshot_dir(args.home), f"{args.height}.json")
+    with open(path) as f:
+        snap = json.load(f)
+    app = load_app(args.home)
+    app.cms = CommitStore()
+    app.cms._committed[snap["height"]] = {
+        bytes.fromhex(k): bytes.fromhex(v) for k, v in snap["state"].items()
+    }
+    app.cms.load_height(snap["height"])
+    app.height = snap["height"]
+    app.app_version = snap["app_version"]
+    save_app(args.home, app)
+    print(f"restored height {app.height} (app_hash {app.cms.last_app_hash.hex()[:16]}...)")
     return 0
 
 
@@ -212,7 +276,13 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--blocks", type=int, default=0, help="0 = forever")
     p.add_argument("--block-interval", type=float, default=15.0)
     p.add_argument("--no-sleep", action="store_true")
+    p.add_argument("--snapshot-interval", type=int, default=1500)
     p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("snapshot", help="state-sync snapshots")
+    p.add_argument("action", choices=["create", "list", "restore"])
+    p.add_argument("--height", type=int, default=0)
+    p.set_defaults(fn=cmd_snapshot)
 
     p = sub.add_parser("status", help="print chain status")
     p.set_defaults(fn=cmd_status)

@@ -1,0 +1,38 @@
+"""Multi-validator replicated-state-machine tests."""
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.shares.namespace import Namespace
+from celestia_app_tpu.shares.sparse import Blob
+from celestia_app_tpu.testutil.network import ConsensusFailure, Network
+from celestia_app_tpu.user import TxClient
+
+RNG = np.random.default_rng(55)
+
+
+def test_three_validators_agree_over_blocks():
+    net = Network(n_validators=3)
+    client = TxClient(net, net.keys[:2])
+    for i in range(3):
+        blob = Blob(
+            Namespace.v0(bytes([10 + i]) * 10),
+            RNG.integers(0, 256, 5000 * (i + 1), dtype=np.uint8).tobytes(),
+        )
+        resp = client.submit_pay_for_blob([blob])
+        assert resp.code == 0
+    assert len(net.blocks) == 3
+    heights = {n.height for n in net.nodes}
+    hashes = {n.cms.last_app_hash for n in net.nodes}
+    assert heights == {3} and len(hashes) == 1
+
+
+def test_divergent_validator_detected():
+    net = Network(n_validators=2)
+    client = TxClient(net, net.keys[:1])
+    blob = Blob(Namespace.v0(b"\x05" * 10), b"x" * 2000)
+    client.submit_pay_for_blob([blob])
+    # Corrupt one replica's state out-of-band: consensus must notice.
+    net.nodes[1].cms.working.set(b"bank/bal/evil/utia", (10**9).to_bytes(16, "big"))
+    with pytest.raises(ConsensusFailure):
+        client.submit_pay_for_blob([blob])
