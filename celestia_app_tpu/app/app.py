@@ -26,7 +26,12 @@ from celestia_app_tpu.constants import (
 )
 from celestia_app_tpu.app.ante import AnteError, run_ante
 from celestia_app_tpu.da import DataAvailabilityHeader, extend_shares, min_data_availability_header
-from celestia_app_tpu.modules.blob.types import BlobTxError, gas_to_consume, validate_blob_tx
+from celestia_app_tpu.modules.blob.types import (
+    BlobTxError,
+    gas_to_consume,
+    validate_blob_tx,
+    validate_blob_txs_batched,
+)
 from celestia_app_tpu.modules.minfee import MinFeeKeeper
 from celestia_app_tpu.modules.mint.minter import Minter
 from celestia_app_tpu.modules.signal.keeper import SignalError, SignalKeeper
@@ -102,10 +107,17 @@ class Ctx:
 class App:
     """The celestia state machine with a TPU square pipeline."""
 
-    def __init__(self, node_min_gas_price: Dec | None = None):
+    def __init__(
+        self,
+        node_min_gas_price: Dec | None = None,
+        v2_upgrade_height: int | None = None,
+    ):
         self.cms = CommitStore()
         self.chain_id = ""
         self.app_version = LATEST_VERSION
+        # Height-based v1->v2 upgrade (reference --v2-upgrade-height,
+        # cmd/celestia-appd/cmd/root.go:40,142 consumed at app/app.go:458-470).
+        self.v2_upgrade_height = v2_upgrade_height
         self.height = 0
         self.genesis_time_ns = 0
         self.last_block_time_ns = 0
@@ -225,14 +237,15 @@ class App:
                 normal.append(raw)
             except (AnteError, ValueError):
                 continue
-        for raw, btx in classified:
-            if btx is None:
+        blob_entries = [(raw, btx) for raw, btx in classified if btx is not None]
+        validated = validate_blob_txs_batched([b for _, b in blob_entries])
+        for (raw, btx), v in zip(blob_entries, validated):
+            if isinstance(v, BlobTxError):
                 continue
             try:
-                validate_blob_tx(btx)
                 run_ante(self, ctx, Tx.unmarshal(btx.tx), is_check_tx=False)
                 blob.append(raw)
-            except (AnteError, BlobTxError, ValueError):
+            except (AnteError, ValueError):
                 continue
         return normal + blob
 
@@ -254,15 +267,21 @@ class App:
             self.last_block_time_ns,
             self.app_version,
         )
-        for raw in data.txs:
-            btx = unmarshal_blob_tx(raw)
+        classified = [(raw, unmarshal_blob_tx(raw)) for raw in data.txs]
+        # Hot loop (3): every blob's commitment recomputed, batched on device.
+        validated = iter(
+            validate_blob_txs_batched([b for _, b in classified if b is not None])
+        )
+        for raw, btx in classified:
             if btx is None:
                 tx = Tx.unmarshal(raw)
                 if any(isinstance(m, MsgPayForBlobs) for m in tx.msgs()):
                     return False  # PFB must ride in a BlobTx (:77-88)
                 run_ante(self, ctx, tx, is_check_tx=False)
             else:
-                validate_blob_tx(btx)
+                v = next(validated)
+                if isinstance(v, BlobTxError):
+                    raise v
                 run_ante(self, ctx, Tx.unmarshal(btx.tx), is_check_tx=False)
 
         sq = square.construct(list(data.txs), self.max_effective_square_size())
@@ -359,11 +378,21 @@ class App:
         raise ValueError(f"no handler for {type(msg).__name__}")
 
     def _end_block(self, ctx: Ctx, height: int) -> None:
-        """Blobstream (v1 only) + signal-based upgrades (app/app.go:458-477)."""
+        """Blobstream (v1 only) + height/signal upgrades (app/app.go:458-477)."""
         if self.app_version == 1:
             from celestia_app_tpu.modules.blobstream.keeper import BlobstreamKeeper
 
             BlobstreamKeeper(ctx.store, ctx.staking).end_blocker(height, ctx.time_ns)
+        if (
+            self.app_version == 1
+            and self.v2_upgrade_height is not None
+            and height >= self.v2_upgrade_height
+        ):
+            from celestia_app_tpu.app.module_manager import ModuleManager
+
+            ModuleManager().run_migrations(ctx, 1, 2)
+            self.app_version = 2
+            return
         if self.app_version >= 2:
             keeper = SignalKeeper(ctx.store, ctx.staking)
             up = keeper.should_upgrade(height)

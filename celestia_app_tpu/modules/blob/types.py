@@ -69,16 +69,8 @@ def validate_msg_pay_for_blobs(msg: MsgPayForBlobs) -> None:
             raise BlobTxError(f"share commitment must be 32 bytes, got {len(c)}")
 
 
-def validate_blob_tx(
-    btx: BlobTx, subtree_root_threshold: int = SUBTREE_ROOT_THRESHOLD
-) -> MsgPayForBlobs:
-    """Full stateless BlobTx validation (blob_tx.go:37-108).
-
-    Decodes the inner tx, requires exactly one MsgPayForBlobs, and checks
-    every blob against the message: namespace match, size match, share
-    version match, and commitment equality (the expensive recompute).
-    Returns the validated message.
-    """
+def _structural_checks(btx: BlobTx) -> MsgPayForBlobs:
+    """Everything in ValidateBlobTx except the commitment recompute."""
     try:
         tx = Tx.unmarshal(btx.tx)
         msgs = tx.msgs()
@@ -100,9 +92,62 @@ def validate_blob_tx(
             raise BlobTxError(f"blob {i} size differs from PFB")
         if blob.share_version != msg.share_versions[i]:
             raise BlobTxError(f"blob {i} share version differs from PFB")
+    return msg
+
+
+def validate_blob_tx(
+    btx: BlobTx, subtree_root_threshold: int = SUBTREE_ROOT_THRESHOLD
+) -> MsgPayForBlobs:
+    """Full stateless BlobTx validation (blob_tx.go:37-108).
+
+    Decodes the inner tx, requires exactly one MsgPayForBlobs, and checks
+    every blob against the message: namespace match, size match, share
+    version match, and commitment equality (the expensive recompute).
+    Returns the validated message.
+    """
+    msg = _structural_checks(btx)
+    for i, blob in enumerate(btx.blobs):
         if create_commitment(blob, subtree_root_threshold) != msg.share_commitments[i]:
             raise BlobTxError(f"blob {i} share commitment mismatch")
     return msg
+
+
+def validate_blob_txs_batched(
+    btxs: list[BlobTx], subtree_root_threshold: int = SUBTREE_ROOT_THRESHOLD
+) -> list[MsgPayForBlobs | BlobTxError]:
+    """ValidateBlobTx over many txs with ALL commitment hashing batched on
+    the device (hot loop (3) of ProcessProposal, SURVEY §3.3).
+
+    Returns, per tx, the validated MsgPayForBlobs or the BlobTxError that
+    rejected it — callers drop (Prepare) or reject (Process) as they
+    choose.  Equivalent to [validate_blob_tx(b) for b in btxs].
+    """
+    from celestia_app_tpu.inclusion.batched import create_commitments_batched
+
+    results: list[MsgPayForBlobs | BlobTxError] = []
+    todo: list[tuple[int, MsgPayForBlobs]] = []
+    all_blobs = []
+    for btx in btxs:
+        try:
+            msg = _structural_checks(btx)
+        except BlobTxError as e:
+            results.append(e)
+            continue
+        todo.append((len(results), msg))
+        results.append(msg)
+        all_blobs.extend(btx.blobs)
+
+    commitments = create_commitments_batched(all_blobs, subtree_root_threshold)
+    pos = 0
+    for idx, msg in todo:
+        n = len(msg.share_commitments)
+        got = commitments[pos : pos + n]
+        pos += n
+        for i, c in enumerate(got):
+            if c != msg.share_commitments[i]:
+                results[idx] = BlobTxError(f"blob {i} share commitment mismatch")
+                break
+    return results
 
 
 def gas_to_consume(blob_sizes: tuple[int, ...], gas_per_blob_byte: int) -> int:

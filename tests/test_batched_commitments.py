@@ -1,0 +1,48 @@
+"""Device-batched commitments must match the host path bit-for-bit."""
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.inclusion import create_commitment
+from celestia_app_tpu.inclusion.batched import create_commitments_batched
+from celestia_app_tpu.modules.blob.types import (
+    BlobTxError,
+    validate_blob_txs_batched,
+)
+from celestia_app_tpu.shares.namespace import Namespace
+from celestia_app_tpu.shares.sparse import Blob
+from celestia_app_tpu.tx.envelopes import BlobTx, unmarshal_blob_tx
+
+RNG = np.random.default_rng(66)
+
+
+def user_ns(tag: int) -> Namespace:
+    return Namespace.v0(bytes([tag]) * 10)
+
+
+def rand_blob(tag: int, size: int) -> Blob:
+    return Blob(user_ns(tag), RNG.integers(0, 256, size, dtype=np.uint8).tobytes())
+
+
+class TestBatchedCommitments:
+    def test_matches_host_path(self):
+        blobs = [
+            rand_blob(1, 100),        # 1 share
+            rand_blob(2, 478 * 3),    # 3 shares -> chunks [2, 1]
+            rand_blob(3, 478 * 170),  # 170 shares -> 42x4 + 2
+            rand_blob(4, 5000),
+        ]
+        batched = create_commitments_batched(blobs)
+        assert batched == [create_commitment(b) for b in blobs]
+
+    def test_empty(self):
+        assert create_commitments_batched([]) == []
+
+    def test_validate_batched_mixed(self):
+        from tests.test_tx_blob import signed_pfb_blob_tx
+
+        good = unmarshal_blob_tx(signed_pfb_blob_tx((rand_blob(5, 900),)))
+        tampered = BlobTx(good.tx, (rand_blob(5, 900),))  # new random data
+        out = validate_blob_txs_batched([good, tampered])
+        assert not isinstance(out[0], BlobTxError)
+        assert isinstance(out[1], BlobTxError)
