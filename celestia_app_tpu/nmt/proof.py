@@ -57,15 +57,13 @@ def prove_range(tree: NamespacedMerkleTree, start: int, end: int) -> NmtRangePro
     return NmtRangeProof(start, end, tuple(nodes), n)
 
 
-def verify_range(
-    root: bytes, proof: NmtRangeProof, leaf_ndata: list[bytes]
+def _verify_digests(
+    root: bytes, proof: NmtRangeProof, leaf_digests: list[bytes]
 ) -> bool:
-    """Verify leaves (ns-prefixed raw data, in order) against a 90-byte root."""
-    if len(leaf_ndata) != proof.end - proof.start:
+    if len(leaf_digests) != proof.end - proof.start:
         return False
     if not 0 <= proof.start < proof.end <= proof.total:
         return False
-    leaf_digests = [NmtHasher.hash_leaf(nd) for nd in leaf_ndata]
     it = iter(proof.nodes)
 
     def walk(lo: int, hi: int) -> bytes:
@@ -86,3 +84,100 @@ def verify_range(
     if next(it, None) is not None:
         return False  # unconsumed proof nodes
     return computed == root
+
+
+def verify_range(
+    root: bytes, proof: NmtRangeProof, leaf_ndata: list[bytes]
+) -> bool:
+    """Verify leaves (ns-prefixed raw data, in order) against a 90-byte root."""
+    return _verify_digests(
+        root, proof, [NmtHasher.hash_leaf(nd) for nd in leaf_ndata]
+    )
+
+
+# --- namespace proofs (nmt ProveNamespace / VerifyNamespace parity) --------
+
+
+def prove_namespace(
+    tree: NamespacedMerkleTree, namespace: bytes
+) -> tuple[NmtRangeProof, list[bytes]]:
+    """Prove all leaves of `namespace` (inclusion), or its absence.
+
+    Returns (proof, leaf_ndata).  Empty leaf list = absence proof: the proof
+    covers the single leaf at the namespace's would-be position (verified by
+    digest), mirroring the nmt library's absence proofs.
+    """
+    ns_list = [l[: len(namespace)] for l in tree._leaves]
+    n = len(ns_list)
+    start = next((i for i, ns in enumerate(ns_list) if ns >= namespace), n)
+    end = next((i for i, ns in enumerate(ns_list) if ns > namespace), n)
+    if start < end:  # present
+        return prove_range(tree, start, end), list(tree._leaves[start:end])
+    # Absent: prove the leaf at the insertion position (clamped for
+    # beyond-the-last-namespace queries).
+    pos = min(start, n - 1)
+    return prove_range(tree, pos, pos + 1), []
+
+
+def verify_namespace(
+    root: bytes,
+    proof: NmtRangeProof,
+    namespace: bytes,
+    leaf_ndata: list[bytes],
+    absence_leaf_digest: bytes | None = None,
+) -> bool:
+    """Verify a namespace proof: inclusion completeness or absence.
+
+    Inclusion: every proven leaf carries `namespace`, and the proof's
+    sibling nodes show nothing with that namespace exists outside the range
+    (left siblings' max < ns, right siblings' min > ns).  Absence: the
+    single covered leaf digest has a different namespace and the same
+    completeness bounds hold.
+    """
+    size = len(namespace)
+    if leaf_ndata:
+        if any(l[:size] != namespace for l in leaf_ndata):
+            return False
+        if not verify_range(root, proof, leaf_ndata):
+            return False
+    else:
+        if absence_leaf_digest is None:
+            return False
+        if proof.end - proof.start != 1:
+            return False
+        leaf_min = NmtHasher.min_namespace(absence_leaf_digest)[:size]
+        if leaf_min == namespace:
+            return False  # the leaf IS the namespace: not an absence proof
+        if not _verify_digests(root, proof, [absence_leaf_digest]):
+            return False
+        # For an interior absence the covered leaf must sit past the
+        # namespace; a leaf below it only proves absence if it is the last
+        # leaf of the tree.
+        if leaf_min < namespace and proof.end != proof.total:
+            return False
+
+    # Completeness: no leaf with `namespace` hidden inside a sibling node.
+    it = iter(proof.nodes)
+
+    def walk(lo: int, hi: int) -> None:
+        if hi <= proof.start:
+            node = next(it)
+            if NmtHasher.max_namespace(node)[:size] >= namespace:
+                raise ValueError("namespace leaks left of the proven range")
+            return
+        if lo >= proof.end:
+            node = next(it)
+            if NmtHasher.min_namespace(node)[:size] <= namespace:
+                raise ValueError("namespace leaks right of the proven range")
+            return
+        if hi - lo == 1:
+            return
+        sp = split_point(hi - lo)
+        walk(lo, lo + sp)
+        walk(lo + sp, hi)
+
+    try:
+        walk(0, proof.total)
+    except (ValueError, StopIteration):
+        return False
+    return True

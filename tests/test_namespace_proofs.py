@@ -1,0 +1,63 @@
+"""NMT namespace inclusion/absence proof tests."""
+
+import numpy as np
+
+from celestia_app_tpu.nmt.proof import prove_namespace, verify_namespace
+from celestia_app_tpu.nmt.tree import NamespacedMerkleTree
+from celestia_app_tpu.nmt.hasher import NmtHasher
+
+RNG = np.random.default_rng(21)
+
+
+def ns(tag: int) -> bytes:
+    return bytes(28) + bytes([tag])
+
+
+def build_tree(tags):
+    t = NamespacedMerkleTree()
+    for tag in tags:
+        t.push(ns(tag) + RNG.integers(0, 256, 30, dtype=np.uint8).tobytes())
+    return t
+
+
+class TestNamespaceProofs:
+    def test_inclusion_complete(self):
+        t = build_tree([1, 1, 3, 3, 3, 7, 9, 9])
+        root = t.root()
+        for tag, count in [(1, 2), (3, 3), (7, 1), (9, 2)]:
+            proof, leaves = prove_namespace(t, ns(tag))
+            assert len(leaves) == count
+            assert verify_namespace(root, proof, ns(tag), leaves)
+
+    def test_absence_interior(self):
+        t = build_tree([1, 1, 3, 3, 7, 9, 9, 12])
+        root = t.root()
+        proof, leaves = prove_namespace(t, ns(5))
+        assert leaves == []
+        digest = t.leaf_digests()[proof.start]
+        assert verify_namespace(root, proof, ns(5), [], digest)
+        # The same absence proof must not verify for a present namespace.
+        assert not verify_namespace(root, proof, ns(7), [], digest)
+
+    def test_absence_past_the_end(self):
+        t = build_tree([1, 2, 3, 4])
+        proof, leaves = prove_namespace(t, ns(200))
+        digest = t.leaf_digests()[proof.start]
+        assert leaves == []
+        assert verify_namespace(t.root(), proof, ns(200), [], digest)
+
+    def test_incomplete_inclusion_rejected(self):
+        t = build_tree([5, 5, 5, 5])
+        root = t.root()
+        # A range proof over only part of the namespace must fail
+        # completeness checks.
+        from celestia_app_tpu.nmt.proof import prove_range
+
+        partial = prove_range(t, 0, 2)
+        leaves = list(t._leaves[0:2])
+        assert not verify_namespace(root, partial, ns(5), leaves)
+
+    def test_wrong_namespace_leaves_rejected(self):
+        t = build_tree([1, 2, 3, 4])
+        proof, leaves = prove_namespace(t, ns(2))
+        assert not verify_namespace(t.root(), proof, ns(3), leaves)
