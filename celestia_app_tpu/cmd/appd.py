@@ -260,6 +260,68 @@ def cmd_rollback(args) -> int:
     return 0
 
 
+def cmd_tx_pfb(args) -> int:
+    """Single-node devnet PFB submission (BASELINE config 1; reference CLI
+    x/blob/client/cli/payforblob.go:43): build, sign with a genesis dev key,
+    run one block, verify inclusion, persist."""
+    from celestia_app_tpu.crypto import PrivateKey
+    from celestia_app_tpu.modules.blob.types import estimate_gas
+    from celestia_app_tpu.shares.namespace import Namespace
+    from celestia_app_tpu.shares.sparse import Blob
+    from celestia_app_tpu.state.accounts import AuthKeeper
+    from celestia_app_tpu.user.signer import Signer
+
+    app = load_app(args.home)
+    with open(_genesis_path(args.home)) as f:
+        chain_id = json.load(f)["chain_id"]
+    key = PrivateKey.from_seed(f"{chain_id}-account-{args.account}".encode())
+    addr = key.public_key().address()
+    acc = AuthKeeper(app.cms.working).get_account(addr)
+    if acc is None:
+        print(f"dev account {addr} not in genesis", file=sys.stderr)
+        return 1
+
+    data = open(args.file, "rb").read() if args.file else os.urandom(args.random_bytes)
+    ns = Namespace.v0(bytes.fromhex(args.namespace))
+    blob = Blob(ns, data)
+    gas = estimate_gas([len(data)])
+    signer = Signer(chain_id)
+    signer.add_account(key, acc.account_number, acc.sequence)
+    raw = signer.create_pay_for_blobs(addr, [blob], gas, gas)
+
+    check = app.check_tx(raw)
+    if check.code != 0:
+        print(f"CheckTx rejected: {check.log}", file=sys.stderr)
+        return 1
+    block = app.prepare_proposal([raw])
+    if not app.process_proposal(block):
+        print("proposal rejected", file=sys.stderr)
+        return 1
+    results = app.finalize_block(max(time.time_ns(), app.last_block_time_ns + 1), list(block.txs))
+    app.commit()
+    save_app(args.home, app)
+    print(
+        json.dumps(
+            {
+                "height": app.height,
+                "code": results[0].code if results else 1,
+                "gas_used": results[0].gas_used if results else 0,
+                "square_size": block.square_size,
+                "data_root": block.hash.hex(),
+            }
+        )
+    )
+    return 0
+
+
+def cmd_query_balance(args) -> int:
+    from celestia_app_tpu.state.accounts import BankKeeper
+
+    app = load_app(args.home)
+    print(json.dumps({"address": args.address, "balance": BankKeeper(app.cms.working).balance(args.address)}))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="celestia-appd-tpu", description=__doc__)
     parser.add_argument("--home", default=DEFAULT_HOME)
@@ -283,6 +345,17 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("action", choices=["create", "list", "restore"])
     p.add_argument("--height", type=int, default=0)
     p.set_defaults(fn=cmd_snapshot)
+
+    p = sub.add_parser("tx-pay-for-blob", help="submit a PFB on the local devnet")
+    p.add_argument("--namespace", default="deadbeef")
+    p.add_argument("--file", default=None)
+    p.add_argument("--random-bytes", type=int, default=10_000)
+    p.add_argument("--account", type=int, default=0)
+    p.set_defaults(fn=cmd_tx_pfb)
+
+    p = sub.add_parser("query-balance", help="query an account balance")
+    p.add_argument("address")
+    p.set_defaults(fn=cmd_query_balance)
 
     p = sub.add_parser("status", help="print chain status")
     p.set_defaults(fn=cmd_status)

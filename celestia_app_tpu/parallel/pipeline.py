@@ -1,0 +1,77 @@
+"""Pipelined block streaming (SURVEY §2.4 P5, BASELINE config 5).
+
+The reference processes blocks serially per height; the mainnet-replay
+benchmark config instead streams consecutive blocks through the device.
+JAX dispatch is asynchronous, so overlap falls out of NOT synchronizing:
+`submit` enqueues transfer + the fused extend/NMT/DAH program and returns
+immediately; the host builds the next square while the device crunches.
+`BlockPipeline` bounds the number of in-flight blocks (double buffering by
+default) so HBM holds at most `depth` extended squares.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from celestia_app_tpu.da.eds import ExtendedDataSquare, jit_pipeline
+from celestia_app_tpu.trace import traced
+
+
+@dataclass
+class _InFlight:
+    tag: object
+    outputs: tuple  # (eds, row_roots, col_roots, droot) device arrays
+    k: int
+
+
+class BlockPipeline:
+    """Bounded-depth asynchronous square pipeline."""
+
+    def __init__(self, k: int, depth: int = 2):
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        self.k = k
+        self.depth = depth
+        self._pipe = jit_pipeline(k)
+        self._queue: deque[_InFlight] = deque()
+
+    def submit(self, ods: np.ndarray, tag: object = None) -> None:
+        """Enqueue one block; blocks the host only when `depth` squares are
+        already in flight (back-pressure)."""
+        while len(self._queue) >= self.depth:
+            self._drain_one()
+        out = self._pipe(jnp.asarray(ods, dtype=jnp.uint8))
+        self._queue.append(_InFlight(tag, out, self.k))
+
+    def _drain_one(self) -> tuple[object, ExtendedDataSquare]:
+        inflight = self._queue.popleft()
+        eds, rr, cr, droot = inflight.outputs
+        jax.block_until_ready(droot)
+        result = ExtendedDataSquare(eds, rr, cr, droot, inflight.k)
+        traced().write("block_pipeline", k=inflight.k, tag=str(inflight.tag))
+        return inflight.tag, result
+
+    def drain(self):
+        """Yield (tag, ExtendedDataSquare) for every remaining block, in order."""
+        while self._queue:
+            yield self._drain_one()
+
+
+def stream_blocks(ods_iter, k: int, depth: int = 2):
+    """Stream squares through the device with `depth`-deep overlap.
+
+    Yields (tag, ExtendedDataSquare) in submission order; with depth=2 the
+    device computes block i+1 while the caller consumes block i (the
+    v5e-4 double-buffering shape of BASELINE config 5).
+    """
+    pipe = BlockPipeline(k, depth)
+    for tag, ods in ods_iter:
+        while len(pipe._queue) >= pipe.depth:
+            yield pipe._drain_one()
+        pipe.submit(ods, tag)
+    yield from pipe.drain()
