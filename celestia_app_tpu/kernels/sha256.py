@@ -59,33 +59,38 @@ def _compress(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
     window is recomputed in place.  ~16x smaller HLO than full unrolling,
     which keeps AOT warmup of all square sizes off the critical path
     (SURVEY hard part 4).
+
+    Layout: the 16 schedule words ride the carry as SEPARATE (N,) vectors —
+    the batch axis N is the only array axis anywhere in the loop, so every
+    op is a full-lane VPU op with no strided (N, 16) column slicing.
     """
     k_chunks = jnp.asarray(_K.reshape(4, 16))
 
     def chunk(c, carry):
-        a, b, cc, d, e, f, g, h, w = carry  # w: (N, 16)
+        a, b, cc, d, e, f, g, h = carry[:8]
+        ws = list(carry[8:])  # 16 x (N,)
         kc = k_chunks[c]  # (16,) uint32
         for r in range(16):
             s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
             ch = (e & f) ^ (~e & g)
-            t1 = h + s1 + ch + kc[r] + w[:, r]
+            t1 = h + s1 + ch + kc[r] + ws[r]
             s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
             maj = (a & b) ^ (a & cc) ^ (b & cc)
             t2 = s0 + maj
             h, g, f, e, d, cc, b, a = g, f, e, d + t1, cc, b, a, t1 + t2
         # next 16 schedule words: w'[r] = w[r] + s0(w[r+1]) + w[r+9] + s1(w[r+14])
         # (indices >= 16 refer to already-updated entries, handled by ordering)
-        ws = [w[:, r] for r in range(16)]
         for r in range(16):
             x15 = ws[(r + 1) % 16]
             x2 = ws[(r + 14) % 16]
             s0 = _rotr(x15, 7) ^ _rotr(x15, 18) ^ (x15 >> np.uint32(3))
             s1 = _rotr(x2, 17) ^ _rotr(x2, 19) ^ (x2 >> np.uint32(10))
             ws[r] = ws[r] + s0 + ws[(r + 9) % 16] + s1
-        return (a, b, cc, d, e, f, g, h, jnp.stack(ws, axis=1))
+        return (a, b, cc, d, e, f, g, h, *ws)
 
-    n = state.shape[0]
-    init = tuple(state[:, i] for i in range(8)) + (block,)
+    init = tuple(state[:, i] for i in range(8)) + tuple(
+        block[:, r] for r in range(16)
+    )
     out = jax.lax.fori_loop(0, 4, chunk, init)
     return state + jnp.stack(out[:8], axis=1)
 
