@@ -28,27 +28,105 @@ import numpy as np
 _PRIM_POLY = {8: 0x11D, 16: 0x1100B}
 
 
-class GF:
-    """GF(2^m) with exp/log tables, m in {8, 16}. Elements are numpy uints."""
+def _clmul_mod(a: int, b: int, m: int, poly: int) -> int:
+    """Carry-less multiply mod poly — table-free bootstrap multiply."""
+    prod = 0
+    while b:
+        if b & 1:
+            prod ^= a
+        a <<= 1
+        b >>= 1
+    for bit in range(2 * m - 2, m - 1, -1):
+        if prod >> bit & 1:
+            prod ^= poly << (bit - m)
+    return prod
 
-    def __init__(self, m: int):
-        if m not in _PRIM_POLY:
+
+def _pow_mod(a: int, e: int, m: int, poly: int) -> int:
+    out = 1
+    while e:
+        if e & 1:
+            out = _clmul_mod(out, a, m, poly)
+        a = _clmul_mod(a, a, m, poly)
+        e >>= 1
+    return out
+
+
+def _prime_factors(n: int) -> list[int]:
+    out, d = [], 2
+    while d * d <= n:
+        if n % d == 0:
+            out.append(d)
+            while n % d == 0:
+                n //= d
+        d += 1
+    if n > 1:
+        out.append(n)
+    return out
+
+
+def _poly_gcd(a: int, b: int) -> int:
+    """GCD of GF(2)[x] polynomials (bitmask representation)."""
+    while b:
+        while a.bit_length() >= b.bit_length() and a:
+            a ^= b << (a.bit_length() - b.bit_length())
+        a, b = b, a
+    return a
+
+
+def _is_irreducible(poly: int, m: int) -> bool:
+    """Degree-m poly irreducible over GF(2): x^(2^m) == x mod poly AND
+    gcd(x^(2^(m/p)) + x, poly) == 1 for every prime p | m (the Frobenius
+    condition alone also accepts squarefree products of smaller factors)."""
+    t = 2
+    for _ in range(m):
+        t = _clmul_mod(t, t, m, poly)
+    if t != 2:
+        return False
+    for p in _prime_factors(m):
+        t = 2
+        for _ in range(m // p):
+            t = _clmul_mod(t, t, m, poly)
+        if _poly_gcd(t ^ 2, poly) != 1:
+            return False
+    return True
+
+
+class GF:
+    """GF(2^m) with exp/log tables, m in {8, 16}. Elements are numpy uints.
+
+    `poly` defaults to this repo's codec polynomials; pass another
+    irreducible polynomial (e.g. leopard ff16's) to get that field. The
+    exp/log tables are built on the smallest generator element, so
+    non-primitive polynomials whose `x` is not a generator still work.
+    """
+
+    def __init__(self, m: int, poly: int | None = None):
+        if m not in (8, 16):
             raise ValueError(f"unsupported field GF(2^{m})")
         self.m = m
         self.order = 1 << m
-        self.poly = _PRIM_POLY[m]
+        self.poly = poly if poly is not None else _PRIM_POLY[m]
         self.dtype = np.uint8 if m == 8 else np.uint16
+        if not _is_irreducible(self.poly, m):
+            raise ValueError(f"0x{self.poly:x} is not irreducible over GF(2)")
+        # Smallest generator: order test against the prime factors of 2^m-1.
+        n1 = self.order - 1
+        factors = _prime_factors(n1)
+        for g in range(2, self.order):
+            if all(_pow_mod(g, n1 // p, m, self.poly) != 1 for p in factors):
+                break
+        else:  # unreachable for a field: its unit group is cyclic
+            raise ValueError(f"no generator in GF(2^{m})/0x{self.poly:x}")
         # exp table of length 2*(order-1) so exp[log a + log b] needs no mod.
         exp = np.zeros(2 * (self.order - 1), dtype=np.uint32)
         log = np.zeros(self.order, dtype=np.uint32)
         x = 1
-        for i in range(self.order - 1):
+        for i in range(n1):
             exp[i] = x
             log[x] = i
-            x <<= 1
-            if x & self.order:
-                x ^= self.poly
-        exp[self.order - 1 :] = exp[: self.order - 1]
+            x = _clmul_mod(x, g, m, self.poly)
+        exp[n1:] = exp[:n1]
         self.exp = exp
         self.log = log
 
@@ -160,8 +238,8 @@ class GF:
 
 
 @lru_cache(maxsize=None)
-def _field(m: int) -> GF:
-    return GF(m)
+def _field(m: int, poly: int | None = None) -> GF:
+    return GF(m, poly)
 
 
 GF8 = _field(8)

@@ -1,0 +1,115 @@
+"""Leopard-construction systematic RS: the reference-parity codec attempt.
+
+The reference pins `rsmt2d.NewLeoRSCodec` (pkg/appconsts/global_consts.go:92),
+the leopard additive-FFT Reed-Solomon code (klauspost/reedsolomon leopard8/
+leopard16). Structurally, leopard's systematic encode with k data and k
+parity shards is:
+
+  * fix the additive-FFT evaluation grid  omega[i] = XOR of basis[j] over
+    the set bits j of i,  where `basis` is a Cantor basis of GF(2^m);
+  * the data shards are the values of the unique degree-<k polynomial at
+    the HIGH half of the grid (omega[k..2k)) — the IFFT step interpolates
+    them there;
+  * parity shards are that polynomial's values at the LOW half
+    (omega[0..k)) — the FFT step evaluates there.
+
+That mapping (interpolate-high, evaluate-low) makes the code a plain GF
+matrix seam: G = V[low] @ inv(V[high]) over the omega grid, which this
+module derives exactly (Vandermonde + Gaussian inverse — no butterflies
+needed; the FFT is only leopard's *fast algorithm* for the same linear
+map). The device kernel consumes G as data, so the construction slots into
+kernels/rs.py with zero structural change.
+
+What is pinned vs unverifiable IN THIS IMAGE (no Go toolchain, no leopard
+source anywhere on disk — see PARITY.md "Leopard parity" for the full
+audit):
+
+  pinned (high confidence):
+    * the interpolate-high/evaluate-low systematic layout and the
+      omega-grid enumeration by binary index;
+    * GF(2^8) polynomial 0x11D (shared by leopard8 and this repo's field);
+    * MDS-ness, systematic-ness, and the constant-share degeneracy that
+      the reference golden DAH vectors exercise (tests).
+  unverifiable in-image (flagged, overridable via module constants):
+    * the exact Cantor basis constants leopard hardcodes (we derive a
+      canonical basis deterministically instead — recurrence
+      b_{j+1}^2 + b_{j+1} = b_j from b_0 = 1, smallest root each step,
+      which is *a* Cantor basis but not provably *leopard's*);
+    * GF(2^16) polynomial (0x1002D believed, not confirmable here);
+    * the bit-order of the index -> basis-element map.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import numpy as np
+
+from celestia_app_tpu.gf.field import GF, _field
+
+# Field polynomials for the leopard construction. ff8's 0x11D is shared
+# with this repo's default GF(2^8). ff16's is believed to be 0x1002D
+# (x^16+x^5+x^3+x^2+1) — unverifiable in-image; override here if the true
+# constant is ever confirmed to differ.
+LEOPARD_POLY = {8: 0x11D, 16: 0x1002D}
+
+# Set to a tuple of ints to force the exact basis (e.g. once leopard's
+# hardcoded kCantorBasis constants can be confirmed); None derives the
+# canonical basis below.
+FORCED_CANTOR_BASIS: dict[int, tuple[int, ...] | None] = {8: None, 16: None}
+
+
+def leopard_field(m: int) -> GF:
+    return _field(m, LEOPARD_POLY[m])
+
+
+def _solve_artin_schreier(f: GF, c: int) -> int:
+    """Smallest x with x^2 + x == c, or -1 if none (Tr(c) == 1)."""
+    xs = np.arange(f.order, dtype=np.uint32)
+    sq = f.mul(xs, xs).astype(np.uint32) ^ xs
+    hits = np.where(sq == c)[0]
+    return int(hits[0]) if hits.size else -1
+
+
+@lru_cache(maxsize=None)
+def cantor_basis(m: int) -> tuple[int, ...]:
+    """A canonical Cantor basis of GF(2^m): b_0 = 1, and b_{j+1} is the
+    smallest solution of x^2 + x = b_j. Valid for m a power of two (trace
+    conditions hold down the chain); each step has two roots (x, x+1) —
+    'smallest' is this module's deterministic tie-break.
+    """
+    forced = FORCED_CANTOR_BASIS.get(m)
+    if forced is not None:
+        return forced
+    f = leopard_field(m)
+    basis = [1]
+    for _ in range(m - 1):
+        nxt = _solve_artin_schreier(f, basis[-1])
+        if nxt < 0:
+            raise ValueError(f"Cantor chain broke at {basis[-1]:#x} in GF(2^{m})")
+        basis.append(nxt)
+    return tuple(basis)
+
+
+def eval_grid(m: int, n: int) -> np.ndarray:
+    """omega[0..n): omega[i] = XOR of basis[j] for each set bit j of i."""
+    basis = cantor_basis(m)
+    r = max(1, (n - 1).bit_length())
+    if r > len(basis):
+        raise ValueError(f"grid of {n} points needs {r} basis elements in GF(2^{m})")
+    idx = np.arange(n, dtype=np.uint32)
+    omega = np.zeros(n, dtype=np.uint32)
+    for j in range(r):
+        omega ^= np.where((idx >> j) & 1, basis[j], 0).astype(np.uint32)
+    return omega
+
+
+def leopard_points(k: int, field: GF) -> np.ndarray:
+    """Evaluation points for RSCodec's share layout under the leopard map.
+
+    RSCodec indexes shares data-first (0..k-1 data, k..2k-1 parity);
+    leopard places data on the grid's high half and parity on the low half,
+    so share i < k maps to omega[k+i] and parity share p to omega[p].
+    """
+    omega = eval_grid(field.m, 2 * k)
+    return np.concatenate([omega[k:], omega[:k]]).astype(field.dtype)

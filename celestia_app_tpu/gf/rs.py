@@ -22,6 +22,7 @@ invertible, so any k surviving shares determine the codeword.
 
 from __future__ import annotations
 
+import os
 from functools import lru_cache
 
 import numpy as np
@@ -39,15 +40,35 @@ def field_for_width(codeword_width: int) -> GF:
 
 
 class RSCodec:
-    """Systematic RS codec for a fixed number of data shares k."""
+    """Systematic RS codec for a fixed number of data shares k.
 
-    def __init__(self, k: int):
+    `construction` selects the evaluation-point layout (and field poly):
+      * "vandermonde" — this repo's fully-specified default: data at points
+        0..k-1, parity at k..2k-1, repo field polynomials;
+      * "leopard" — the reference-parity attempt (gf/leopard.py): the
+        additive-FFT omega grid with data on its high half, leopard field
+        polynomials. Same MDS/systematic surface, different parity bytes.
+    Both constructions share every code path below — only `points` and
+    `field` differ, and the device kernel consumes the resulting generator
+    as data.
+    """
+
+    def __init__(self, k: int, construction: str = "vandermonde"):
         if k < 1 or k & (k - 1):
             raise ValueError(f"k must be a power of two, got {k}")
         self.k = k
-        self.field = field_for_width(2 * k)
+        self.construction = construction
+        if construction == "leopard":
+            from celestia_app_tpu.gf.leopard import leopard_field, leopard_points
+
+            self.field = leopard_field(8 if 2 * k <= 256 else 16)
+            points = leopard_points(k, self.field)
+        elif construction == "vandermonde":
+            self.field = field_for_width(2 * k)
+            points = np.arange(2 * k, dtype=np.uint32).astype(self.field.dtype)
+        else:
+            raise ValueError(f"unknown RS construction {construction!r}")
         f = self.field
-        points = np.arange(2 * k, dtype=np.uint32).astype(f.dtype)
         V = f.vandermonde(points, k)  # (2k, k)
         self._v_all = V
         self.generator = f.matmul(V[k:], f.inv_matrix(V[:k]))  # (k, k)
@@ -123,6 +144,18 @@ class RSCodec:
 
 
 @lru_cache(maxsize=None)
-def codec_for_width(k: int) -> RSCodec:
-    """Cached codec for square size k (codewords are 2k wide)."""
-    return RSCodec(k)
+def _codec_cached(k: int, construction: str) -> RSCodec:
+    return RSCodec(k, construction)
+
+
+def codec_for_width(k: int, construction: str | None = None) -> RSCodec:
+    """Cached codec for square size k (codewords are 2k wide).
+
+    `construction` defaults to $CELESTIA_RS_CONSTRUCTION (or "vandermonde").
+    Note device pipelines (da/eds.py jit_pipeline, parallel/sharded_eds.py)
+    bake the generator in at first compile, so the env knob must be set
+    before the first square of a given size is extended in a process.
+    """
+    if construction is None:
+        construction = os.environ.get("CELESTIA_RS_CONSTRUCTION", "vandermonde")
+    return _codec_cached(k, construction)

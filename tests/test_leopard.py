@@ -1,0 +1,147 @@
+"""Leopard-construction codec: algebraic self-tests + golden compatibility.
+
+What these tests CAN pin in-image (no Go toolchain, no leopard source on
+disk — see PARITY.md): the construction is a systematic MDS RS code on the
+additive-FFT grid, its basis really is a Cantor basis, the generator-matrix
+seam matches direct polynomial evaluation, decode inverts encode from any
+k-subset, and the reference golden DAH vectors (which use constant shares)
+are construction-invariant. What they CANNOT pin: leopard's exact hardcoded
+basis constants, i.e. exact parity bytes vs klauspost on non-degenerate data.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.gf.field import _field
+from celestia_app_tpu.gf.leopard import (
+    LEOPARD_POLY,
+    cantor_basis,
+    eval_grid,
+    leopard_field,
+)
+from celestia_app_tpu.gf.rs import RSCodec
+
+
+def test_leopard_ff16_poly_is_irreducible():
+    # GF() construction fails (no generator cycles through all elements)
+    # unless the polynomial is irreducible.
+    f = leopard_field(16)
+    assert f.poly == LEOPARD_POLY[16]
+    assert sorted(np.asarray(f.exp[: f.order - 1])) == sorted(range(1, f.order))
+
+
+@pytest.mark.parametrize("m", [8, 16])
+def test_cantor_basis_recurrence(m):
+    f = leopard_field(m)
+    basis = cantor_basis(m)
+    assert len(basis) == m and basis[0] == 1
+    # Artin-Schreier chain: b_{j+1}^2 + b_{j+1} = b_j.
+    for j in range(m - 1):
+        b = np.uint32(basis[j + 1])
+        assert int(f.mul(b, b)) ^ int(b) == basis[j]
+    # A basis: all 2^m XOR-combinations distinct.
+    assert len(set(int(x) for x in eval_grid(m, 1 << min(m, 12)))) == 1 << min(m, 12)
+
+
+@pytest.mark.parametrize("k", [2, 8, 32])
+def test_leopard_systematic_and_matches_polynomial_eval(k):
+    """G rows really are 'evaluate the data-interpolant on the low grid'."""
+    f = leopard_field(8 if 2 * k <= 256 else 16)
+    codec = RSCodec(k, construction="leopard")
+    assert codec.field is f
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, (k, 16), dtype=np.uint8)
+
+    parity = codec.encode(data)
+    # Direct check: interpolate through (omega[k+i], data_i) by solving the
+    # Vandermonde system, then evaluate at omega[j].
+    omega = eval_grid(f.m, 2 * k)
+    V_hi = f.vandermonde(omega[k:], k)
+    coeffs = f.matmul(f.inv_matrix(V_hi), data.astype(f.dtype))
+    V_lo = f.vandermonde(omega[:k], k)
+    expect = f.matmul(V_lo, coeffs)
+    np.testing.assert_array_equal(parity, expect.astype(np.uint8))
+
+
+@pytest.mark.parametrize("k", [2, 8, 32])
+def test_leopard_mds_random_minors(k):
+    """Any k of the 2k shares determine the rest (random position subsets)."""
+    codec = RSCodec(k, construction="leopard")
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, (k, 8), dtype=np.uint8)
+    full = codec.extend(data)
+    for trial in range(5):
+        keep = rng.permutation(2 * k)[:k]
+        present = np.zeros(2 * k, dtype=bool)
+        present[keep] = True
+        damaged = np.where(present[:, None], full, 0).astype(np.uint8)
+        recovered = codec.decode(damaged, present)
+        np.testing.assert_array_equal(recovered, full)
+
+
+def test_leopard_constant_share_degeneracy():
+    """Constant data shares => all parity shares equal the same constant.
+
+    This is why the reference golden DAH vectors (identical shares,
+    data_availability_header_test.go:45-55) hold for leopard and for the
+    vandermonde construction alike — and why they can't discriminate them.
+    """
+    for k in (2, 16):
+        codec = RSCodec(k, construction="leopard")
+        share = np.full((k, 32), 0xAB, dtype=np.uint8)
+        np.testing.assert_array_equal(codec.encode(share), share)
+
+
+def test_leopard_ff16_field_boundary():
+    """k=256 crosses into GF(2^16) exactly like leopard16 (>256 shards)."""
+    c128 = RSCodec(128, construction="leopard")
+    assert c128.field.m == 8
+    c256 = RSCodec(256, construction="leopard")
+    assert c256.field.m == 16
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 256, (256, 8), dtype=np.uint8)
+    full = c256.extend(data)
+    present = np.zeros(512, dtype=bool)
+    present[::2] = True  # keep alternating halves across data/parity
+    damaged = np.where(present[:, None], full, 0).astype(np.uint8)
+    np.testing.assert_array_equal(c256.decode(damaged, present), full)
+
+
+def test_constructions_differ_on_nonconstant_data():
+    """Sanity: the two constructions are genuinely different codes."""
+    k = 4
+    a = RSCodec(k, construction="vandermonde")
+    b = RSCodec(k, construction="leopard")
+    data = np.arange(4 * 8, dtype=np.uint8).reshape(4, 8)
+    assert not np.array_equal(a.encode(data), b.encode(data))
+
+
+def test_device_pipeline_with_leopard_codec(monkeypatch):
+    """The generator-as-data seam: device extension matches the host oracle
+    under the leopard construction (kernels/rs.py consumes codec bits;
+    extend_square_fn reads codec_for_width at build time)."""
+    import jax
+    import jax.numpy as jnp
+
+    from celestia_app_tpu.kernels.rs import extend_square_fn
+
+    k = 8
+    codec = RSCodec(k, construction="leopard")
+    rng = np.random.default_rng(3)
+    ods = rng.integers(0, 256, (k, k, 64), dtype=np.uint8)
+
+    # Host oracle: rows then columns.
+    top = np.concatenate(
+        [ods, np.stack([codec.encode(ods[i]) for i in range(k)], axis=0)], axis=1
+    )
+    host_eds = np.concatenate(
+        [top, np.stack([codec.encode(top[:, j]) for j in range(2 * k)], axis=1)],
+        axis=0,
+    )
+
+    monkeypatch.setenv("CELESTIA_RS_CONSTRUCTION", "leopard")
+    dev_fn = extend_square_fn(k)
+    dev_eds = np.asarray(jax.jit(dev_fn)(jnp.asarray(ods)))
+    np.testing.assert_array_equal(dev_eds, host_eds)
