@@ -2,29 +2,51 @@
 
 Measures the fused device pipeline (RS 2D extension + 4k NMT roots + DAH data
 root; reference hot path app/prepare_proposal.go:61-71) end to end — host
-ODS in, data root back on host — and compares against the straightforward
-host-CPU path (numpy GF Reed-Solomon + hashlib SHA-256 NMTs), the in-image
-proxy for the reference's Go leopard + crypto/sha256 implementation.
+ODS in, data root back on host — at k=128/256/512 plus the repair and
+streamed modes, and compares against the in-image host path.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": MB/s, "unit": "MB/s", "vs_baseline": x}
+  {"metric": ..., "value": MB/s, "unit": "MB/s", "vs_baseline": x, ...}
+extra keys: "platform", "results" (all completed stages), "baseline_note",
+"errors".
+
+Robustness (round-1 failure was an unusable accelerator tunnel):
+  * the parent process never imports jax; it probes the default backend in a
+    subprocess with a hard timeout (SIGTERM, never SIGKILL — killing a
+    wedged TPU client can leak the relay's session grant);
+  * on probe failure the measurement falls back to a scrubbed CPU env;
+  * the measurement child appends one JSON line per completed stage to a
+    results file, so even a mid-run hang leaves the earlier numbers intact
+    and the parent still emits an honest summary line.
 
 Env knobs:
-  BENCH_K          square size (default 128)
-  BENCH_ITERS      timed iterations (default 5)
-  BENCH_BASELINE_S skip the CPU run, use the given seconds/block
-  BENCH_MODE       extend (default) | repair (BASELINE config 4: quadrant
-                   erasure decode) | stream (config 5: pipelined blocks,
-                   dispatch overlapped with host work)
+  BENCH_K            run only this square size (default: 128, 256, 512)
+  BENCH_MODE         run only this mode: extend | repair | stream
+  BENCH_ITERS        timed iterations (default 5; 2 at k>=256)
+  BENCH_BASELINE_S   skip the host-baseline run, use the given seconds/block
+  BENCH_TOTAL_BUDGET wall-clock budget in seconds (default 1500)
+  BENCH_PROBE_TIMEOUT backend probe timeout in seconds (default 120)
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
+import tempfile
 import time
 
 import numpy as np
+
+_REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+
+BASELINE_NOTE = (
+    "host baseline is the in-image single-core numpy-GF + hashlib-SHA256 "
+    "path at k=128; the reference's Go leopard SIMD + SHA-NI codec is not "
+    "runnable in this image (no Go toolchain), so vs_baseline overstates "
+    "the margin vs the real reference CPU path"
+)
 
 
 def _random_ods(k: int, seed: int = 3) -> np.ndarray:
@@ -39,7 +61,12 @@ def _random_ods(k: int, seed: int = 3) -> np.ndarray:
     return ods.reshape(k, k, SHARE_SIZE)
 
 
-def _device_seconds_per_block(ods: np.ndarray, iters: int) -> float:
+# --------------------------------------------------------------------------
+# measurement stages (run inside the child process only)
+# --------------------------------------------------------------------------
+
+
+def _extend_seconds(ods: np.ndarray, iters: int) -> float:
     """Full offload round trip: host ODS -> device pipeline -> host data root."""
     import jax
 
@@ -55,7 +82,11 @@ def _device_seconds_per_block(ods: np.ndarray, iters: int) -> float:
 
 
 def _host_seconds_per_block(ods: np.ndarray) -> float:
-    """CPU reference path: numpy GF RS extension + hashlib SHA-256 NMT trees."""
+    """Host path: numpy GF RS extension + hashlib SHA-256 NMT trees.
+
+    Single core (this image has one); stands in for the reference's Go
+    leopard + crypto/sha256 path, which is faster — see BASELINE_NOTE.
+    """
     from celestia_app_tpu.constants import NAMESPACE_SIZE, PARITY_NAMESPACE_BYTES
     from celestia_app_tpu.gf import codec_for_width
     from celestia_app_tpu.merkle import hash_from_byte_slices
@@ -116,12 +147,7 @@ def _repair_seconds(ods: np.ndarray, iters: int) -> float:
 
 
 def _stream_seconds(ods: np.ndarray, iters: int) -> float:
-    """BASELINE config 5: pipelined block stream.
-
-    Dispatch is async: block i+1's transfer+compute overlaps with
-    retrieving block i's data root, the production overlap shape of the
-    mainnet-replay config.
-    """
+    """BASELINE config 5: pipelined block stream (async dispatch overlap)."""
     import jax
     import jax.numpy as jnp
 
@@ -145,41 +171,257 @@ def _stream_seconds(ods: np.ndarray, iters: int) -> float:
     return (time.perf_counter() - t0) / n
 
 
-def main() -> None:
-    k = int(os.environ.get("BENCH_K", "128"))
-    iters = int(os.environ.get("BENCH_ITERS", "5"))
-    mode = os.environ.get("BENCH_MODE", "extend")
-    ods = _random_ods(k)
-    ods_mb = ods.nbytes / 1e6
+# --------------------------------------------------------------------------
+# child: run stages, append a JSON line per completed stage
+# --------------------------------------------------------------------------
 
-    if mode == "repair":
-        dev_s = _repair_seconds(ods, iters)
-        metric = f"EDS MB/s quadrant-repaired + root-verified per chip (k={k})"
-        mb = 4 * ods_mb
-    elif mode == "stream":
-        dev_s = _stream_seconds(ods, iters)
-        metric = f"ODS MB/s pipelined extend+DAH per chip (k={k}, streamed)"
-        mb = ods_mb
-    else:
-        dev_s = _device_seconds_per_block(ods, iters)
-        metric = f"ODS MB/s erasure-extended + DAH-hashed per chip (k={k})"
-        mb = ods_mb
+
+def _stage_plan() -> list[dict]:
+    only_k = os.environ.get("BENCH_K")
+    only_mode = os.environ.get("BENCH_MODE")
+    if only_k or only_mode:
+        k = int(only_k or "128")
+        mode = only_mode or "extend"
+        plan = [{"mode": mode, "k": k}]
+        if not os.environ.get("BENCH_BASELINE_S"):
+            plan.append({"mode": "host", "k": min(k, 128)})
+        return plan
+    plan = [
+        {"mode": "extend", "k": 128},
+        {"mode": "host", "k": 128},
+        {"mode": "extend", "k": 256},
+        {"mode": "extend", "k": 512},
+        {"mode": "repair", "k": 128},
+        {"mode": "stream", "k": 128},
+    ]
+    if os.environ.get("BENCH_BASELINE_S"):
+        plan = [s for s in plan if s["mode"] != "host"]
+    return plan
+
+
+def _run_child() -> None:
+    results_path = os.environ["BENCH_RESULTS_FILE"]
+    deadline = float(os.environ["BENCH_DEADLINE"])
+
+    def emit(rec: dict) -> None:
+        with open(results_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    emit({"stage": "probe", "platform": platform, "n_devices": len(jax.devices())})
+
+    for stage in _stage_plan():
+        mode, k = stage["mode"], stage["k"]
+        remaining = deadline - time.monotonic()
+        # Rough floor: big squares need compile + transfer headroom.
+        need = 120 if (k >= 256 or mode == "host") else 60
+        if remaining < need:
+            emit({"stage": f"{mode}@{k}", "skipped": "budget",
+                  "remaining_s": round(remaining, 1)})
+            continue
+        iters = int(os.environ.get("BENCH_ITERS", "2" if k >= 256 else "5"))
+        t_start = time.monotonic()
+        try:
+            ods = _random_ods(k)
+            ods_mb = ods.nbytes / 1e6
+            if mode == "host":
+                secs = _host_seconds_per_block(ods)
+                mb = ods_mb
+            elif mode == "repair":
+                secs = _repair_seconds(ods, iters)
+                mb = 4 * ods_mb
+            elif mode == "stream":
+                secs = _stream_seconds(ods, iters)
+                mb = ods_mb
+            else:
+                secs = _extend_seconds(ods, iters)
+                mb = ods_mb
+            emit({
+                "stage": f"{mode}@{k}", "mode": mode, "k": k,
+                "seconds_per_block": secs, "mb": mb,
+                "mb_per_s": round(mb / secs, 3),
+                "wall_s": round(time.monotonic() - t_start, 1),
+                "platform": platform,
+            })
+        except Exception as e:  # noqa: BLE001 — record and move on
+            emit({"stage": f"{mode}@{k}", "error": f"{type(e).__name__}: {e}"[:500]})
+    emit({"stage": "done"})
+
+
+# --------------------------------------------------------------------------
+# parent: probe, spawn child, assemble the single JSON line
+# --------------------------------------------------------------------------
+
+
+def _scrubbed_cpu_env(env: dict) -> dict:
+    env = dict(env)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _terminate_gently(proc: subprocess.Popen, grace: float = 30.0) -> str:
+    """SIGTERM + wait. Never SIGKILL: a killed TPU client can leak the
+    accelerator relay's session grant and wedge every later client."""
+    proc.terminate()
+    try:
+        proc.wait(timeout=grace)
+        return "terminated"
+    except subprocess.TimeoutExpired:
+        print("bench: child ignored SIGTERM; abandoning it (no SIGKILL — "
+              "see tpu relay grant-leak hazard)", file=sys.stderr)
+        return "abandoned"
+
+
+def _probe_backend(timeout: float) -> str | None:
+    """Return the default env's platform name, or None if unusable."""
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "import jax; d = jax.devices(); print('PLATFORM=' + d[0].platform)"],
+        cwd=_REPO_DIR,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        _terminate_gently(proc, grace=15.0)
+        print(f"bench: backend probe hung >{timeout:.0f}s (wedged tunnel?)",
+              file=sys.stderr)
+        return None
+    if proc.returncode != 0:
+        tail = (out or "").strip().splitlines()[-1:] or [""]
+        print(f"bench: backend probe failed: {tail[0][:200]}", file=sys.stderr)
+        return None
+    for line in (out or "").splitlines():
+        if line.startswith("PLATFORM="):
+            return line.split("=", 1)[1].strip()
+    return None
+
+
+def _run_measurement(env: dict, budget: float, results_path: str) -> None:
+    env = dict(env)
+    env["BENCH_RESULTS_FILE"] = results_path
+    env["BENCH_DEADLINE"] = str(time.monotonic() + budget)
+    env["_BENCH_CHILD"] = "1"
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/celestia_jax_cache")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(_REPO_DIR, "bench.py")],
+        cwd=_REPO_DIR, env=env,
+        stdout=sys.stderr, stderr=sys.stderr,
+    )
+    try:
+        proc.wait(timeout=budget + 120)
+    except subprocess.TimeoutExpired:
+        _terminate_gently(proc)
+
+
+def _read_results(path: str) -> list[dict]:
+    recs = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        recs.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        pass
+    except FileNotFoundError:
+        pass
+    return recs
+
+
+def main() -> None:
+    if os.environ.get("_BENCH_CHILD") == "1":
+        _run_child()
+        return
+
+    budget = float(os.environ.get("BENCH_TOTAL_BUDGET", "1500"))
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
+    t0 = time.monotonic()
+
+    errors: list[str] = []
+    platform = _probe_backend(probe_timeout)
+    env = dict(os.environ)
+    if platform is None:
+        errors.append("default backend unusable; fell back to scrubbed CPU env")
+        env = _scrubbed_cpu_env(env)
+        platform = "cpu"
+
+    fd, results_path = tempfile.mkstemp(prefix="bench_results_", suffix=".jsonl")
+    os.close(fd)
+    try:
+        _run_measurement(env, budget - (time.monotonic() - t0), results_path)
+        recs = _read_results(results_path)
+
+        # The child's own backend init may still have failed — retry on CPU.
+        measured = [r for r in recs if "mb_per_s" in r]
+        if not measured and platform != "cpu":
+            errors.append("measurement child produced no results on the "
+                          "default backend; retrying on scrubbed CPU env")
+            platform = "cpu"
+            open(results_path, "w").close()  # drop the failed run's records
+            _run_measurement(_scrubbed_cpu_env(env),
+                             budget - (time.monotonic() - t0), results_path)
+            recs = _read_results(results_path)
+            measured = [r for r in recs if "mb_per_s" in r]
+    finally:
+        try:
+            os.unlink(results_path)
+        except OSError:
+            pass
+
+    probe = next((r for r in recs if r.get("stage") == "probe"), None)
+    if probe:
+        platform = probe.get("platform", platform)
+    errors.extend(r["error"] for r in recs if "error" in r)
+
+    device = [r for r in measured if r["mode"] != "host"]
+    host = next((r for r in measured if r["mode"] == "host"), None)
 
     base_env = os.environ.get("BENCH_BASELINE_S")
-    host_s = float(base_env) if base_env else _host_seconds_per_block(ods)
+    if base_env:
+        from celestia_app_tpu.constants import SHARE_SIZE
 
-    value = mb / dev_s
-    baseline = ods_mb / host_s
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(value, 3),
-                "unit": "MB/s",
-                "vs_baseline": round(value / baseline, 3),
-            }
-        )
-    )
+        host_rate = 128 * 128 * SHARE_SIZE / 1e6 / float(base_env)
+    elif host:
+        host_rate = host["mb_per_s"]
+    else:
+        host_rate = None
+
+    if not device:
+        print(json.dumps({
+            "metric": "ODS MB/s erasure-extended + DAH-hashed per chip",
+            "value": 0, "unit": "MB/s", "vs_baseline": 0,
+            "platform": platform,
+            "error": "; ".join(errors) or "no stage completed",
+        }))
+        return
+
+    primary = next((r for r in device if r["mode"] == "extend" and r["k"] == 128),
+                   device[0])
+    out = {
+        "metric": (f"ODS MB/s erasure-extended + DAH-hashed per chip "
+                   f"(k={primary['k']}, {primary['mode']}, {platform})"),
+        "value": primary["mb_per_s"],
+        "unit": "MB/s",
+        "vs_baseline": (round(primary["mb_per_s"] / host_rate, 3)
+                        if host_rate else 0),
+        "platform": platform,
+        "results": [
+            {"mode": r["mode"], "k": r["k"], "mb_per_s": r["mb_per_s"],
+             "seconds_per_block": round(r["seconds_per_block"], 4)}
+            for r in measured
+        ],
+        "baseline_note": BASELINE_NOTE,
+    }
+    if errors:
+        out["errors"] = errors
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
