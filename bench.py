@@ -183,7 +183,7 @@ def _stage_plan() -> list[dict]:
         k = int(only_k or "128")
         mode = only_mode or "extend"
         plan = [{"mode": mode, "k": k}]
-        if not os.environ.get("BENCH_BASELINE_S"):
+        if mode != "host" and not os.environ.get("BENCH_BASELINE_S"):
             plan.append({"mode": "host", "k": min(k, 128)})
         return plan
     plan = [
@@ -383,17 +383,7 @@ def main() -> None:
     device = [r for r in measured if r["mode"] != "host"]
     host = next((r for r in measured if r["mode"] == "host"), None)
 
-    base_env = os.environ.get("BENCH_BASELINE_S")
-    if base_env:
-        from celestia_app_tpu.constants import SHARE_SIZE
-
-        host_rate = 128 * 128 * SHARE_SIZE / 1e6 / float(base_env)
-    elif host:
-        host_rate = host["mb_per_s"]
-    else:
-        host_rate = None
-
-    if not device:
+    if not device and not host:
         print(json.dumps({
             "metric": "ODS MB/s erasure-extended + DAH-hashed per chip",
             "value": 0, "unit": "MB/s", "vs_baseline": 0,
@@ -403,7 +393,18 @@ def main() -> None:
         return
 
     primary = next((r for r in device if r["mode"] == "extend" and r["k"] == 128),
-                   device[0])
+                   device[0] if device else host)
+
+    base_env = os.environ.get("BENCH_BASELINE_S")
+    if base_env:
+        # BENCH_BASELINE_S is seconds per block at the PRIMARY stage's k.
+        from celestia_app_tpu.constants import SHARE_SIZE
+
+        host_rate = primary["k"] ** 2 * SHARE_SIZE / 1e6 / float(base_env)
+    elif host:
+        host_rate = host["mb_per_s"]
+    else:
+        host_rate = None
     out = {
         "metric": (f"ODS MB/s erasure-extended + DAH-hashed per chip "
                    f"(k={primary['k']}, {primary['mode']}, {platform})"),

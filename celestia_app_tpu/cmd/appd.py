@@ -13,6 +13,7 @@ Usage:  python -m celestia_app_tpu.cmd.appd <command> [--home DIR] ...
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import sys
@@ -169,19 +170,38 @@ def _write_snapshot(home: str, app: App, keep: int = 2) -> str:
 
 def cmd_start(args) -> int:
     app = load_app(args.home)
-    print(f"chain {app.chain_id} at height {app.height}, producing blocks...")
+    node = None
+    if getattr(args, "serve", False):
+        from celestia_app_tpu.rpc.server import ServingNode, serve as rpc_serve
+
+        node = ServingNode(app=app)
+        server = rpc_serve(node, port=args.rpc_port, block_interval_s=None)
+        print(f"RPC serving on {server.url}", flush=True)
+    print(f"chain {app.chain_id} at height {app.height}, producing blocks...",
+          flush=True)
     produced = 0
     while args.blocks == 0 or produced < args.blocks:
         time_ns = max(time.time_ns(), app.last_block_time_ns + 1)
-        data = app.prepare_proposal([])
-        if not app.process_proposal(data):
-            print("FATAL: node rejected its own proposal", file=sys.stderr)
-            return 1
-        app.finalize_block(time_ns, list(data.txs))
-        app.commit()
-        save_app(args.home, app)
-        if args.snapshot_interval and app.height % args.snapshot_interval == 0:
-            _write_snapshot(args.home, app)
+        if node is not None:
+            # Served mode: production goes through the node so mempool txs
+            # from RPC broadcasts are included and indexed for tx queries
+            # (produce_block runs the full propose/validate/commit round).
+            # Same wall-clock block time as the manual path below — chain
+            # time must not depend on the serving mode.
+            data, _ = node.produce_block(time_ns=time_ns)
+        else:
+            data = app.prepare_proposal([])
+            if not app.process_proposal(data):
+                print("FATAL: node rejected its own proposal", file=sys.stderr)
+                return 1
+            app.finalize_block(time_ns, list(data.txs))
+            app.commit()
+        # Under --serve, RPC handler threads can also commit blocks; hold
+        # the node lock so the on-disk snapshot is never torn mid-commit.
+        with node.lock if node is not None else contextlib.nullcontext():
+            save_app(args.home, app)
+            if args.snapshot_interval and app.height % args.snapshot_interval == 0:
+                _write_snapshot(args.home, app)
         produced += 1
         print(
             f"height={app.height} square={data.square_size} "
@@ -339,6 +359,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--block-interval", type=float, default=15.0)
     p.add_argument("--no-sleep", action="store_true")
     p.add_argument("--snapshot-interval", type=int, default=1500)
+    p.add_argument("--serve", action="store_true",
+                   help="serve the JSON-RPC endpoint (broadcast/query/proofs)")
+    p.add_argument("--rpc-port", type=int, default=26657)
     p.set_defaults(fn=cmd_start)
 
     p = sub.add_parser("snapshot", help="state-sync snapshots")

@@ -1,0 +1,19 @@
+"""The serving plane: JSON-RPC over HTTP (sockets, multi-process).
+
+The reference runs gRPC/API/RPC servers around the app even in tests
+(app/app.go:712-735, test/util/testnode/network.go:38-43); its RPC plane is
+JSON-RPC over HTTP. This package is that wire for the TPU framework:
+
+  * `rpc.server.ServingNode` — a node (App + mempool + proposer loop) that
+    serves broadcast/query/proof endpoints and replicates blocks to peer
+    validators over sockets;
+  * `rpc.client.RemoteNode` — the client-side handle presenting the same
+    node surface TxClient/txsim consume in-process, but over HTTP;
+  * `rpc.devnet` — a multi-process devnet: N validator processes with a
+    rotating proposer exchanging proposals over the wire.
+"""
+
+from celestia_app_tpu.rpc.client import RemoteNode
+from celestia_app_tpu.rpc.server import ServingNode, serve
+
+__all__ = ["RemoteNode", "ServingNode", "serve"]

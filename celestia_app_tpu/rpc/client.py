@@ -1,0 +1,146 @@
+"""RemoteNode: the wire-side node handle (reference pkg/user's gRPC conn).
+
+Presents the same duck-typed node surface TxClient and txsim consume from
+an in-process TestNode — chain_id / broadcast / query_account / tx_status /
+produce_block — but every call is an HTTP JSON-RPC round trip to a served
+node this process did not construct (and need not have imported).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import time
+from dataclasses import dataclass
+from urllib.parse import urlparse
+
+
+class RPCError(RuntimeError):
+    pass
+
+
+@dataclass
+class RemoteAccount:
+    account_number: int
+    sequence: int
+
+
+@dataclass
+class RemoteTxResult:
+    code: int
+    log: str = ""
+    gas_wanted: int = 0
+    gas_used: int = 0
+    events: tuple = ()
+
+
+class RemoteNode:
+    """A client handle to a ServingNode's JSON-RPC endpoint."""
+
+    def __init__(self, url: str, timeout: float = 30.0, defer_status: bool = False):
+        self.url = url
+        parsed = urlparse(url)
+        self._host = parsed.hostname
+        self._port = parsed.port
+        self._timeout = timeout
+        self._chain_id: str | None = None
+        if not defer_status:
+            self._chain_id = self.status()["chain_id"]
+
+    # --- transport ----------------------------------------------------------
+    def call(self, method: str, **params):
+        conn = http.client.HTTPConnection(self._host, self._port, timeout=self._timeout)
+        try:
+            payload = json.dumps(
+                {"jsonrpc": "2.0", "id": 1, "method": method, "params": params}
+            )
+            conn.request("POST", "/", body=payload,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+        finally:
+            conn.close()
+        if "error" in body:
+            raise RPCError(body["error"]["message"])
+        return body["result"]
+
+    # --- node surface ---------------------------------------------------------
+    @property
+    def chain_id(self) -> str:
+        if self._chain_id is None:
+            self._chain_id = self.status()["chain_id"]
+        return self._chain_id
+
+    def status(self) -> dict:
+        return self.call("status")
+
+    def broadcast(self, raw_tx: bytes, relay: bool = True) -> RemoteTxResult:
+        res = self.call("broadcast_tx", tx=raw_tx.hex(), relay=relay)
+        return RemoteTxResult(code=res["code"], log=res["log"])
+
+    def query_account(self, address: str) -> RemoteAccount | None:
+        res = self.call("account", address=address)
+        if res is None:
+            return None
+        return RemoteAccount(res["account_number"], res["sequence"])
+
+    def tx_status(self, tx_hash: bytes) -> tuple[int, int, str] | None:
+        res = self.call("tx_status", hash=tx_hash.hex())
+        if res is None:
+            return None
+        return (res["height"], res["code"], res["log"])
+
+    def produce_block(self):
+        """Trigger one block on the served node (dev/test surface); returns
+        (block-info dict, results) shaped like TestNode.produce_block."""
+        res = self.call("produce_block")
+        results = [
+            RemoteTxResult(code=r["code"], log=r["log"],
+                           gas_wanted=r["gas_wanted"], gas_used=r["gas_used"])
+            for r in res["results"]
+        ]
+        return res, results
+
+    def block(self, height: int) -> dict:
+        return self.call("block", height=height)
+
+    def validators(self) -> list[dict]:
+        return self.call("validators")
+
+    def apply_block(self, height: int, time_ns: int, data) -> dict:
+        return self.call(
+            "apply_block",
+            height=height,
+            time_ns=time_ns,
+            data_hash=data.hash.hex(),
+            square_size=data.square_size,
+            txs=[t.hex() for t in data.txs],
+        )
+
+    # --- proof queries (verify client-side against the fetched roots) --------
+    def tx_inclusion_proof(self, height: int, tx_index: int):
+        from celestia_app_tpu.rpc.codec import share_proof_from_json
+
+        res = self.call("tx_inclusion_proof", height=height, tx_index=tx_index)
+        return share_proof_from_json(res["proof"]), bytes.fromhex(res["data_root"])
+
+    def share_inclusion_proof(self, height: int, start: int, end: int):
+        from celestia_app_tpu.rpc.codec import share_proof_from_json
+
+        res = self.call("share_inclusion_proof", height=height, start=start, end=end)
+        return share_proof_from_json(res["proof"]), bytes.fromhex(res["data_root"])
+
+    def state_proof(self, key: bytes):
+        from celestia_app_tpu.rpc.codec import state_proof_from_json
+
+        res = self.call("state_proof", key=key.hex())
+        return state_proof_from_json(res["proof"]), bytes.fromhex(res["app_hash"])
+
+    def wait_for_height(self, height: int, timeout_s: float = 30.0) -> dict:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            st = self.status()
+            if st["height"] >= height:
+                return st
+            time.sleep(0.05)
+        raise TimeoutError(f"node did not reach height {height}")

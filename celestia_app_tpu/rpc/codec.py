@@ -1,0 +1,69 @@
+"""JSON wire codec for RPC payloads: dataclasses <-> JSON-safe dicts.
+
+Bytes travel as hex strings; nested dataclasses/tuples recurse. The proof
+reconstructors rebuild the exact dataclass types so `verify()` runs
+client-side on wire-fetched proofs (the light-client contract).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+def to_jsonable(obj: Any) -> Any:
+    if isinstance(obj, bytes):
+        return obj.hex()
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {
+            f.name: to_jsonable(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)
+        }
+    if isinstance(obj, (list, tuple)):
+        return [to_jsonable(x) for x in obj]
+    if isinstance(obj, dict):
+        return {k: to_jsonable(v) for k, v in obj.items()}
+    return obj
+
+
+def share_proof_from_json(d: dict):
+    from celestia_app_tpu.nmt.proof import NmtRangeProof
+    from celestia_app_tpu.proof.share_proof import RowProof, ShareProof
+
+    rp = d["row_proof"]
+    row_proof = RowProof(
+        row_roots=tuple(bytes.fromhex(r) for r in rp["row_roots"]),
+        proofs=tuple(
+            tuple(bytes.fromhex(h) for h in path) for path in rp["proofs"]
+        ),
+        start_row=rp["start_row"],
+        end_row=rp["end_row"],
+        total=rp["total"],
+    )
+    share_proofs = tuple(
+        NmtRangeProof(
+            start=p["start"],
+            end=p["end"],
+            nodes=tuple(bytes.fromhex(n) for n in p["nodes"]),
+            total=p["total"],
+        )
+        for p in d["share_proofs"]
+    )
+    return ShareProof(
+        data=tuple(bytes.fromhex(s) for s in d["data"]),
+        share_proofs=share_proofs,
+        namespace=bytes.fromhex(d["namespace"]),
+        row_proof=row_proof,
+    )
+
+
+def state_proof_from_json(d: dict):
+    from celestia_app_tpu.state.smt import StateProof
+
+    return StateProof(
+        key=bytes.fromhex(d["key"]),
+        value=None if d["value"] is None else bytes.fromhex(d["value"]),
+        path=[(bit, bytes.fromhex(sib)) for bit, sib in d["path"]],
+        leaf_kh=None if d["leaf_kh"] is None else bytes.fromhex(d["leaf_kh"]),
+        leaf_vh=None if d["leaf_vh"] is None else bytes.fromhex(d["leaf_vh"]),
+    )

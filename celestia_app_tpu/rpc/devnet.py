@@ -1,0 +1,158 @@
+"""Multi-process devnet: N validators exchanging proposals over sockets.
+
+The reference's testnode Network starts real nodes with RPC/gRPC servers on
+random ports (test/util/testnode/network.go:20-43); its multi-validator
+tier runs containers. This devnet is the socket tier for this framework:
+each validator is its OWN PROCESS serving JSON-RPC, block production
+rotates by height, and every block is replicated over HTTP with app-hash /
+data-root equality enforced (ReplicationDivergence otherwise).
+
+Run one validator:   python -m celestia_app_tpu.rpc.devnet --index 0 --n 3 \
+                        --base-port 26800 [--block-interval-ms 300]
+Spawn a whole devnet in-code (tests): `spawn_devnet(n=3)`.
+
+All validators derive the identical deterministic genesis from the shared
+seed set (testutil.testnode.deterministic_genesis), so chain state agrees
+from height 0 without any genesis-distribution step.
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+import time
+
+from celestia_app_tpu.rpc.client import RemoteNode
+from celestia_app_tpu.rpc.server import ServingNode, serve
+from celestia_app_tpu.testutil.testnode import deterministic_genesis, funded_keys
+
+
+def _url(base_port: int, i: int) -> str:
+    return f"http://127.0.0.1:{base_port + i}"
+
+
+def run_validator(
+    index: int,
+    n: int,
+    base_port: int,
+    block_interval_ms: int = 300,
+    n_accounts: int = 4,
+) -> None:
+    """Serve validator `index` of `n`; blocks until killed."""
+    keys = funded_keys(n_accounts)
+    node = ServingNode(
+        genesis=deterministic_genesis(keys, n_validators=n),
+        keys=keys,
+        validator_index=index,
+        n_validators=n,
+        peers=[_url(base_port, j) for j in range(n) if j != index],
+    )
+    server = serve(
+        node, port=base_port + index, block_interval_s=None
+    )
+    print(f"validator {index}/{n} serving on {server.url}", flush=True)
+
+    # Startup barrier: wait for every peer to serve before proposing.
+    for peer_url in node.peer_urls:
+        peer = RemoteNode(peer_url, defer_status=True, timeout=2.0)
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                peer.status()
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"peer {peer_url} never came up")
+                time.sleep(0.1)
+    print(f"validator {index} peers up", flush=True)
+
+    interval = block_interval_ms / 1000.0
+    while True:
+        time.sleep(interval)
+        try:
+            if node.is_proposer(node.app.height + 1):
+                node.produce_block()
+        except Exception as e:  # noqa: BLE001 — keep serving; surface the fault
+            print(f"validator {index} produce error: {e}", file=sys.stderr, flush=True)
+
+
+class Devnet:
+    """Handle to spawned validator processes."""
+
+    def __init__(self, procs: list[subprocess.Popen], urls: list[str]):
+        self.procs = procs
+        self.urls = urls
+
+    def client(self, i: int = 0) -> RemoteNode:
+        return RemoteNode(self.urls[i])
+
+    def stop(self) -> None:
+        for p in self.procs:
+            p.terminate()
+        for p in self.procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def spawn_devnet(
+    n: int = 3,
+    base_port: int = 26800,
+    block_interval_ms: int = 300,
+    wait_s: float = 120.0,
+    env: dict | None = None,
+) -> Devnet:
+    """Launch n validator processes; returns once all serve their RPC."""
+    import os
+
+    procs = []
+    child_env = dict(os.environ if env is None else env)
+    for i in range(n):
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable, "-m", "celestia_app_tpu.rpc.devnet",
+                    "--index", str(i), "--n", str(n),
+                    "--base-port", str(base_port),
+                    "--block-interval-ms", str(block_interval_ms),
+                ],
+                env=child_env,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+        )
+    urls = [_url(base_port, i) for i in range(n)]
+    net = Devnet(procs, urls)
+    deadline = time.monotonic() + wait_s
+    try:
+        for u in urls:
+            peer = RemoteNode(u, defer_status=True, timeout=2.0)
+            while True:
+                try:
+                    peer.status()
+                    break
+                except Exception:
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(f"validator at {u} never served")
+                    time.sleep(0.2)
+    except Exception:
+        net.stop()
+        raise
+    return net
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description="celestia-tpu devnet validator")
+    ap.add_argument("--index", type=int, required=True)
+    ap.add_argument("--n", type=int, required=True)
+    ap.add_argument("--base-port", type=int, default=26800)
+    ap.add_argument("--block-interval-ms", type=int, default=300)
+    args = ap.parse_args(argv)
+    run_validator(args.index, args.n, args.base_port, args.block_interval_ms)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

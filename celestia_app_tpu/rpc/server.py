@@ -1,0 +1,350 @@
+"""The served node: HTTP JSON-RPC around the app + proposer/replication.
+
+Parity surface (reference):
+  * gRPC/API/RPC servers wrapping the app — app/app.go:712-735,
+    test/util/testnode/network.go:38-43. Here one JSON-RPC-over-HTTP
+    endpoint (Tendermint RPC's own transport) serves broadcast, account,
+    tx-status, block, proof, and state-proof queries.
+  * Block replication over sockets: a rotating proposer sends each
+    finalized proposal to its peer validators (`apply_block`), who
+    process_proposal + finalize + commit independently and must land on
+    identical app hashes and data roots — the multi-process analog of the
+    round-1 in-process Network, now with a real wire between validators.
+
+Threading model: one RLock per node guards all app/mempool access; the
+HTTP server is threading (one handler thread per request) and the proposer
+loop is a daemon thread. All node methods take/return JSON-safe values at
+the HTTP boundary (rpc/codec.py).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from celestia_app_tpu.app import BlockData
+from celestia_app_tpu.tx import tx_hash
+from celestia_app_tpu.rpc.codec import to_jsonable
+from celestia_app_tpu.testutil.testnode import BLOCK_INTERVAL_NS, TestNode
+
+
+class ReplicationDivergence(RuntimeError):
+    """A peer committed a different app hash / data root for the same block."""
+
+
+class ServingNode(TestNode):
+    """TestNode + locking + tx gossip + proposal replication to peers."""
+
+    def __init__(
+        self,
+        genesis=None,
+        keys=None,
+        app=None,
+        validator_index: int = 0,
+        n_validators: int = 1,
+        peers: list[str] | None = None,
+    ):
+        super().__init__(genesis, keys, app=app)
+        # (BlockData, time_ns) by height: survives serving a restarted
+        # chain (list index != height) and feeds peer catch-up.
+        self._blocks_by_height: dict[int, tuple[BlockData, int]] = {}
+        self.lock = threading.RLock()
+        # Serializes whole produce+replicate rounds so replicated heights
+        # reach peers in order even with concurrent produce callers.
+        self._produce_lock = threading.Lock()
+        self.validator_index = validator_index
+        self.n_validators = max(1, n_validators)
+        self.peer_urls = list(peers or [])
+        self._peers: list = []  # RemoteNode handles, built lazily
+
+    # --- peers --------------------------------------------------------------
+    def peers(self):
+        if len(self._peers) != len(self.peer_urls):
+            from celestia_app_tpu.rpc.client import RemoteNode
+
+            self._peers = [RemoteNode(u, defer_status=True) for u in self.peer_urls]
+        return self._peers
+
+    def is_proposer(self, height: int) -> bool:
+        return (height - 1) % self.n_validators == self.validator_index
+
+    # --- tx admission + gossip ----------------------------------------------
+    def broadcast(self, raw_tx: bytes, relay: bool = True):
+        with self.lock:
+            res = super().broadcast(raw_tx)
+        if res.code == 0 and relay:
+            for peer in self.peers():
+                try:
+                    peer.broadcast(raw_tx, relay=False)
+                except Exception:
+                    pass  # mempool gossip is best-effort; consensus is not
+        return res
+
+    # --- block production + replication --------------------------------------
+    def produce_block(self, time_ns: int | None = None):
+        with self._produce_lock:
+            return self._produce_and_replicate(time_ns)
+
+    def _produce_and_replicate(self, produce_time_ns: int | None = None):
+        with self.lock:
+            data, results = super().produce_block(produce_time_ns)
+            height = self.app.height
+            time_ns = self.app.last_block_time_ns
+            own_app_hash = self.app.cms.last_app_hash
+            self._blocks_by_height[height] = (data, time_ns)
+        for peer in self.peers():
+            reply = peer.apply_block(height, time_ns, data)
+            if (
+                bytes.fromhex(reply["app_hash"]) != own_app_hash
+                or bytes.fromhex(reply["data_hash"]) != data.hash
+            ):
+                raise ReplicationDivergence(
+                    f"peer {peer.url} diverged at height {height}: "
+                    f"{reply['app_hash'][:16]} != {own_app_hash.hex()[:16]}"
+                )
+        return data, results
+
+    def apply_block(self, height: int, time_ns: int, data: BlockData) -> dict:
+        """Peer endpoint: validate + execute a replicated proposal.
+
+        A peer that missed blocks (e.g. it was still starting when the
+        proposer advanced) first catches up from whoever serves them, so a
+        transient replication failure cannot wedge the devnet permanently.
+        """
+        with self.lock:
+            behind = height > self.app.height + 1
+        if behind:
+            self._catch_up(height - 1)
+        with self.lock:
+            if height != self.app.height + 1:
+                raise ValueError(
+                    f"out-of-order block {height}, at {self.app.height}"
+                )
+            if not self.app.process_proposal(data):
+                raise ValueError(f"proposal rejected at height {height}")
+            results = self.app.finalize_block(time_ns, list(data.txs))
+            self.app.commit()
+            self.mempool.update(self.app.height, list(data.txs))
+            self.blocks.append(data)
+            self._blocks_by_height[height] = (data, time_ns)
+            self.index_block(height, list(data.txs), results)
+            return {
+                "app_hash": self.app.cms.last_app_hash.hex(),
+                "data_hash": data.hash.hex(),
+            }
+
+    def _catch_up(self, upto: int) -> None:
+        """Fetch + apply committed blocks up to `upto` from any peer."""
+        while True:
+            with self.lock:
+                h = self.app.height + 1
+            if h > upto:
+                return
+            for peer in self.peers():
+                try:
+                    b = peer.block(h)
+                except Exception:
+                    continue
+                data = BlockData(
+                    txs=tuple(bytes.fromhex(t) for t in b["txs"]),
+                    square_size=b["square_size"],
+                    hash=bytes.fromhex(b["data_hash"]),
+                )
+                self.apply_block(h, b["time_ns"], data)
+                break
+            else:
+                raise ValueError(f"cannot catch up: no peer serves block {h}")
+
+    # --- JSON-safe RPC methods (the HTTP surface) -----------------------------
+    def rpc_status(self) -> dict:
+        with self.lock:
+            return {
+                "chain_id": self.chain_id,
+                "height": self.app.height,
+                "app_hash": self.app.cms.last_app_hash.hex(),
+                "app_version": self.app.app_version,
+                "validator_index": self.validator_index,
+                "n_validators": self.n_validators,
+            }
+
+    def rpc_broadcast_tx(self, tx: str, relay: bool = True) -> dict:
+        res = self.broadcast(bytes.fromhex(tx), relay=relay)
+        return {"code": res.code, "log": res.log,
+                "hash": tx_hash(bytes.fromhex(tx)).hex()}
+
+    def rpc_tx_status(self, hash: str) -> dict | None:
+        with self.lock:
+            st = self.tx_status(bytes.fromhex(hash))
+        if st is None:
+            return None
+        return {"height": st[0], "code": st[1], "log": st[2]}
+
+    def rpc_account(self, address: str) -> dict | None:
+        with self.lock:
+            acc = self.query_account(address)
+        if acc is None:
+            return None
+        return {"account_number": acc.account_number, "sequence": acc.sequence}
+
+    def rpc_block(self, height: int) -> dict:
+        with self.lock:
+            entry = self._blocks_by_height.get(height)
+            if entry is None:
+                raise ValueError(f"no block at height {height}")
+            data, time_ns = entry
+        return {
+            "height": height,
+            "time_ns": time_ns,
+            "data_hash": data.hash.hex(),
+            "square_size": data.square_size,
+            "txs": [t.hex() for t in data.txs],
+        }
+
+    def rpc_produce_block(self) -> dict:
+        data, results = self.produce_block()
+        return {
+            "height": self.app.height,
+            "data_hash": data.hash.hex(),
+            "square_size": data.square_size,
+            "results": [
+                {"code": r.code, "log": r.log, "gas_wanted": r.gas_wanted,
+                 "gas_used": r.gas_used}
+                for r in results
+            ],
+        }
+
+    def rpc_apply_block(
+        self, height: int, time_ns: int, data_hash: str, square_size: int,
+        txs: list[str],
+    ) -> dict:
+        data = BlockData(
+            txs=tuple(bytes.fromhex(t) for t in txs),
+            square_size=square_size,
+            hash=bytes.fromhex(data_hash),
+        )
+        return self.apply_block(height, time_ns, data)
+
+    def rpc_tx_inclusion_proof(self, height: int, tx_index: int) -> dict:
+        from celestia_app_tpu.proof.querier import query_tx_inclusion_proof
+
+        with self.lock:
+            block = self.rpc_block(height)
+            max_k = self.app.max_effective_square_size()
+        proof = query_tx_inclusion_proof(
+            [bytes.fromhex(t) for t in block["txs"]], tx_index, max_k
+        )
+        return {"proof": to_jsonable(proof), "data_root": block["data_hash"]}
+
+    def rpc_share_inclusion_proof(self, height: int, start: int, end: int) -> dict:
+        from celestia_app_tpu.proof.querier import query_share_inclusion_proof
+
+        with self.lock:
+            block = self.rpc_block(height)
+            max_k = self.app.max_effective_square_size()
+        proof = query_share_inclusion_proof(
+            [bytes.fromhex(t) for t in block["txs"]], start, end, max_k
+        )
+        return {"proof": to_jsonable(proof), "data_root": block["data_hash"]}
+
+    def rpc_state_proof(self, key: str) -> dict:
+        with self.lock:
+            proof = self.app.cms.proof(bytes.fromhex(key))
+            app_hash = self.app.cms.last_app_hash
+        return {"proof": to_jsonable(proof), "app_hash": app_hash.hex()}
+
+    def rpc_validators(self) -> list[dict]:
+        from celestia_app_tpu.state.staking import StakingKeeper
+
+        with self.lock:
+            vals = StakingKeeper(self.app.cms.working).validators()
+        return [{"address": v.address, "power": v.power} for v in vals]
+
+
+def _method_table(node: ServingNode) -> dict:
+    return {
+        name[len("rpc_"):]: getattr(node, name)
+        for name in dir(node)
+        if name.startswith("rpc_")
+    }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    methods: dict = {}
+
+    def log_message(self, fmt, *args):  # quiet: tests parse stdout
+        pass
+
+    def do_POST(self):
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            req = json.loads(self.rfile.read(length))
+            method = self.methods.get(req.get("method", ""))
+            if method is None:
+                raise ValueError(f"unknown method {req.get('method')!r}")
+            result = method(**req.get("params", {}))
+            body = {"jsonrpc": "2.0", "id": req.get("id"), "result": result}
+            status = 200
+        except Exception as e:  # noqa: BLE001 — every fault becomes an RPC error
+            body = {
+                "jsonrpc": "2.0",
+                "id": None,
+                "error": {"code": -32000, "message": f"{type(e).__name__}: {e}"},
+            }
+            status = 500
+        payload = json.dumps(body).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+
+class NodeServer:
+    """Owns the HTTP server + optional proposer-loop thread."""
+
+    def __init__(self, node: ServingNode, host: str = "127.0.0.1", port: int = 0):
+        handler = type("BoundHandler", (_Handler,), {"methods": _method_table(node)})
+        self.node = node
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    def start(self, block_interval_s: float | None = None):
+        t = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        t.start()
+        self._threads.append(t)
+        if block_interval_s is not None:
+            p = threading.Thread(
+                target=self._proposer_loop, args=(block_interval_s,), daemon=True
+            )
+            p.start()
+            self._threads.append(p)
+        return self
+
+    def _proposer_loop(self, interval_s: float):
+        while not self._stop.wait(interval_s):
+            try:
+                if self.node.is_proposer(self.node.app.height + 1):
+                    self.node.produce_block()
+            except Exception as e:  # noqa: BLE001
+                import sys
+
+                print(f"proposer loop error: {e}", file=sys.stderr)
+
+    def stop(self):
+        self._stop.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def serve(
+    node: ServingNode,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    block_interval_s: float | None = 0.2,
+) -> NodeServer:
+    """Start serving `node`; returns the running NodeServer (daemon threads)."""
+    return NodeServer(node, host, port).start(block_interval_s)

@@ -1,72 +1,146 @@
 """Deterministic versioned KV store (the multistore analog).
 
 Replaces the reference's IAVL-backed CommitMultiStore (app/app.go:435,
-LoadHeight :592) with the simplest structure that preserves the contracts
-the app actually relies on:
+LoadHeight :592) with a dict-backed store whose commitment is a merkleized
+trie (state/smt.py), preserving the contracts the app relies on:
 
   * deterministic app hash over committed state (consensus determinism,
     pinned by the reference's TestConsistentAppHash,
-    app/test/consistent_apphash_test.go:47);
+    app/test/consistent_apphash_test.go:47) — here the root of a
+    path-compressed merkle trie, maintained incrementally: a commit
+    re-hashes O(delta * log n) nodes, never the whole state;
+  * key existence / non-existence proofs against the committed app hash
+    (`CommitStore.proof`, verified by `state.smt.verify`);
   * branch/write-back semantics (CacheContext) for proposal handling and
-    per-tx atomicity;
+    per-tx atomicity — branches are copy-on-write overlays, so taking one
+    per tx costs O(writes in the tx), not O(state);
   * per-height committed versions for restart/rollback/export
-    (checkpoint/resume, SURVEY §5).
-
-Not a merkle store: state proofs against the app hash are out of scope for
-the DA-focused framework (the reference's light clients prove against the
-*data* root, which is fully supported in proof/).
+    (checkpoint/resume, SURVEY §5). The per-height snapshot is one shallow
+    dict copy per *block* (off the per-tx path).
 """
 
 from __future__ import annotations
 
-import hashlib
+from celestia_app_tpu.state import smt
+
+_TOMBSTONE = None  # overlay marker for deletes
 
 
 class KVStore:
-    """A mutable string->bytes map with branch/commit semantics."""
+    """A string->bytes map with copy-on-write branches and a merkle root.
 
-    def __init__(self, data: dict[bytes, bytes] | None = None):
-        self._data: dict[bytes, bytes] = dict(data) if data else {}
+    A root store owns the data dict and an incrementally-maintained merkle
+    trie; `branch()` returns an overlay recording only its own writes.
+    """
 
+    def __init__(self, data: dict[bytes, bytes] | None = None, parent: "KVStore | None" = None):
+        self._parent = parent
+        if parent is None:
+            self._data: dict[bytes, bytes] = dict(data) if data else {}
+            self._trie = None
+            self._dirty: set[bytes] = set(self._data)
+            self._root_cache: bytes | None = None
+        else:
+            assert data is None
+            self._writes: dict[bytes, bytes | None] = {}
+
+    # --- reads ------------------------------------------------------------
     def get(self, key: bytes) -> bytes | None:
-        return self._data.get(key)
-
-    def set(self, key: bytes, value: bytes) -> None:
-        if not isinstance(value, bytes):
-            raise TypeError("store values must be bytes")
-        self._data[key] = value
-
-    def delete(self, key: bytes) -> None:
-        self._data.pop(key, None)
+        node = self
+        while node._parent is not None:
+            if key in node._writes:
+                return node._writes[key]
+            node = node._parent
+        return node._data.get(key)
 
     def has(self, key: bytes) -> bool:
-        return key in self._data
+        return self.get(key) is not None
 
     def iterate(self, prefix: bytes) -> list[tuple[bytes, bytes]]:
         """Deterministic (sorted) iteration over a key prefix."""
-        return sorted(
-            (k, v) for k, v in self._data.items() if k.startswith(prefix)
-        )
+        merged: dict[bytes, bytes | None] = {}
+        chain = []
+        node = self
+        while node._parent is not None:
+            chain.append(node)
+            node = node._parent
+        for k, v in node._data.items():
+            if k.startswith(prefix):
+                merged[k] = v
+        for overlay in reversed(chain):  # oldest overlay first, self last
+            for k, v in overlay._writes.items():
+                if k.startswith(prefix):
+                    merged[k] = v
+        return sorted((k, v) for k, v in merged.items() if v is not _TOMBSTONE)
 
+    # --- writes -----------------------------------------------------------
+    def set(self, key: bytes, value: bytes) -> None:
+        if not isinstance(value, bytes):
+            raise TypeError("store values must be bytes")
+        if self._parent is not None:
+            self._writes[key] = value
+        else:
+            self._data[key] = value
+            self._dirty.add(key)
+            self._root_cache = None
+
+    def delete(self, key: bytes) -> None:
+        if self._parent is not None:
+            self._writes[key] = _TOMBSTONE
+        else:
+            self._data.pop(key, None)
+            self._dirty.add(key)
+            self._root_cache = None
+
+    # --- branching --------------------------------------------------------
     def branch(self) -> "KVStore":
-        """An isolated copy; apply back with `write_back`."""
-        return KVStore(self._data)
+        """A copy-on-write overlay; apply back with `write_back`."""
+        return KVStore(parent=self)
 
     def write_back(self, branch: "KVStore") -> None:
-        self._data = dict(branch._data)
+        """Apply an overlay's writes to this store (its direct parent)."""
+        assert branch._parent is self, "write_back target must be the branch's parent"
+        for k, v in branch._writes.items():
+            if v is _TOMBSTONE:
+                self.delete(k)
+            else:
+                self.set(k, v)
+        branch._writes = {}
 
     def snapshot(self) -> dict[bytes, bytes]:
-        return dict(self._data)
+        if self._parent is None:
+            return dict(self._data)
+        snap = self._parent.snapshot()
+        for k, v in self._writes.items():
+            if v is _TOMBSTONE:
+                snap.pop(k, None)
+            else:
+                snap[k] = v
+        return snap
 
+    # --- commitment -------------------------------------------------------
     def hash(self) -> bytes:
-        """Deterministic digest of the full contents."""
-        h = hashlib.sha256()
-        for k, v in sorted(self._data.items()):
-            h.update(len(k).to_bytes(4, "big"))
-            h.update(k)
-            h.update(len(v).to_bytes(4, "big"))
-            h.update(v)
-        return h.digest()
+        """Merkle root of the contents (incremental on a root store)."""
+        if self._parent is not None:
+            return KVStore(self.snapshot()).hash()
+        if self._root_cache is None:
+            for k in self._dirty:
+                v = self._data.get(k)
+                kh = smt.key_hash(k)
+                if v is None:
+                    self._trie = smt.delete(self._trie, kh)
+                else:
+                    self._trie = smt.insert(self._trie, kh, smt.value_hash(v))
+            self._dirty.clear()
+            self._root_cache = smt.root_hash(self._trie)
+        return self._root_cache
+
+    def proof(self, key: bytes) -> smt.StateProof:
+        """Existence/non-existence proof against this store's `hash()`."""
+        if self._parent is not None:
+            raise ValueError("proofs are served by root stores only")
+        self.hash()  # flush dirty keys into the trie
+        return smt.prove(self._trie, key, self._data.get(key))
 
 
 class CommitStore:
@@ -83,6 +157,10 @@ class CommitStore:
         self.last_height = height
         self.last_app_hash = self.working.hash()
         return self.last_app_hash
+
+    def proof(self, key: bytes) -> smt.StateProof:
+        """State proof for `key` against `last_app_hash` (call post-commit)."""
+        return self.working.proof(key)
 
     def load_height(self, height: int) -> None:
         if height == 0:

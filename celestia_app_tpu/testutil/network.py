@@ -48,6 +48,12 @@ class Network:
         """Primary node view (the TxClient/testnode surface)."""
         return self.nodes[0]
 
+    def query_account(self, address: str):
+        """Auth query against the primary node (TxClient surface)."""
+        from celestia_app_tpu.state.accounts import AuthKeeper
+
+        return AuthKeeper(self.nodes[0].cms.working).get_account(address)
+
     def broadcast(self, raw_tx: bytes):
         """CheckTx against the primary node (gossip: one mempool)."""
         res = self.nodes[0].check_tx(raw_tx)

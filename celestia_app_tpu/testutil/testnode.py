@@ -58,14 +58,27 @@ class TestNode:
 
     __test__ = False  # not a pytest class
 
-    def __init__(self, genesis: Genesis | None = None, keys: list[PrivateKey] | None = None):
+    def __init__(
+        self,
+        genesis: Genesis | None = None,
+        keys: list[PrivateKey] | None = None,
+        app: App | None = None,
+    ):
         from celestia_app_tpu.mempool import PriorityMempool
 
-        self.keys = keys if keys is not None else funded_keys(4)
-        self.app = App(node_min_gas_price=Dec.from_str("0.000001"))
-        self.app.init_chain(genesis or deterministic_genesis(self.keys))
+        if app is not None:
+            # Wrap an existing (e.g. disk-loaded) app: serving a restarted
+            # chain (cmd/appd start --serve).
+            self.keys = keys or []
+            self.app = app
+        else:
+            self.keys = keys if keys is not None else funded_keys(4)
+            self.app = App(node_min_gas_price=Dec.from_str("0.000001"))
+            self.app.init_chain(genesis or deterministic_genesis(self.keys))
         self.mempool = PriorityMempool()
         self.blocks: list[BlockData] = []
+        # tx hash -> (height, code, log): the RPC `tx` query's index.
+        self.tx_index: dict[bytes, tuple[int, int, str]] = {}
 
     @property
     def chain_id(self) -> str:
@@ -80,11 +93,16 @@ class TestNode:
             self.mempool.insert(raw_tx, priority, self.app.height)
         return res
 
-    def produce_block(self) -> tuple[BlockData, list[TxResult]]:
-        """One full consensus round against the app itself."""
-        time_ns = (
-            self.app.last_block_time_ns + BLOCK_INTERVAL_NS
-        )
+    def produce_block(self, time_ns: int | None = None) -> tuple[BlockData, list[TxResult]]:
+        """One full consensus round against the app itself.
+
+        `time_ns` defaults to deterministic logical time (last + 15s, the
+        GoalBlockTime) for reproducible tests; serving daemons pass wall
+        clock so on-chain time tracks reality (x/mint provisions depend on
+        it).
+        """
+        if time_ns is None:
+            time_ns = self.app.last_block_time_ns + BLOCK_INTERVAL_NS
         data = self.app.prepare_proposal(self.mempool.reap())
         if not self.app.process_proposal(data):
             raise AssertionError("node rejected its own proposal")
@@ -92,4 +110,22 @@ class TestNode:
         self.app.commit()
         self.mempool.update(self.app.height, list(data.txs))
         self.blocks.append(data)
+        self.index_block(self.app.height, list(data.txs), results)
         return data, results
+
+    # --- query surface shared with the RPC plane ---------------------------
+    def index_block(self, height: int, txs: list[bytes], results: list[TxResult]) -> None:
+        from celestia_app_tpu.tx import tx_hash
+
+        for raw, res in zip(txs, results):
+            self.tx_index[tx_hash(raw)] = (height, res.code, res.log)
+
+    def query_account(self, address: str):
+        """(account_number, sequence, pubkey) or None — the auth query."""
+        from celestia_app_tpu.state.accounts import AuthKeeper
+
+        return AuthKeeper(self.app.cms.working).get_account(address)
+
+    def tx_status(self, tx_hash: bytes) -> tuple[int, int, str] | None:
+        """(height, code, log) for a committed tx, None if unknown."""
+        return self.tx_index.get(tx_hash)

@@ -5,6 +5,7 @@ from celestia_app_tpu.tx.envelopes import (
     IndexWrapper,
     marshal_blob,
     unmarshal_blob,
+    tx_hash,
     unmarshal_blob_tx,
     unmarshal_index_wrapper,
 )

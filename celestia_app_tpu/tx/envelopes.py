@@ -17,6 +17,7 @@ each blob it pays for.
 
 from __future__ import annotations
 
+import hashlib
 from dataclasses import dataclass
 
 from celestia_app_tpu.encoding.proto import (
@@ -152,3 +153,10 @@ def unmarshal_index_wrapper(raw: bytes) -> IndexWrapper | None:
 
 def uvarint_size(n: int) -> int:
     return len(encode_uvarint(n))
+
+
+def tx_hash(raw_tx: bytes) -> bytes:
+    """Canonical tx hash: sha256 over the full broadcast bytes (BlobTx
+    envelope included). The single join key between client confirmation
+    polling, the node's tx index, and the RPC tx-status query."""
+    return hashlib.sha256(raw_tx).digest()

@@ -10,17 +10,18 @@ confirm inclusion.  The node here is anything with the TestNode surface
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass
 from fractions import Fraction
 
 from celestia_app_tpu.crypto import PrivateKey
 from celestia_app_tpu.modules.blob.types import estimate_gas
 from celestia_app_tpu.shares.sparse import Blob
-from celestia_app_tpu.state.accounts import AuthKeeper
 from celestia_app_tpu.user.errors import (
     parse_insufficient_min_gas_price,
     parse_nonce_mismatch,
 )
+from celestia_app_tpu.tx import tx_hash
 from celestia_app_tpu.user.signer import Signer
 
 DEFAULT_GAS_PRICE = Fraction(2, 1000)  # matches appconsts.DefaultMinGasPrice
@@ -41,6 +42,7 @@ class TxResponse:
     code: int
     log: str = ""
     gas_wanted: int = 0
+    tx_hash: bytes = b""
 
 
 class TxClient:
@@ -58,10 +60,9 @@ class TxClient:
         self.gas_price = gas_price
         self.gas_multiplier = gas_multiplier
         self.signer = Signer(node.chain_id)
-        auth = AuthKeeper(node.app.cms.working)
         for k in keys:
             addr = k.public_key().address()
-            acc = auth.get_account(addr)
+            acc = node.query_account(addr)
             if acc is None:
                 raise ValueError(f"account {addr} not found on chain")
             self.signer.add_account(k, acc.account_number, acc.sequence)
@@ -109,7 +110,10 @@ class TxClient:
             res = self._node.broadcast(raw)
             if res.code == 0:
                 self.signer.increment_sequence(address)
-                return TxResponse(height=0, code=0, gas_wanted=gas)
+                return TxResponse(
+                    height=0, code=0, gas_wanted=gas,
+                    tx_hash=tx_hash(raw),
+                )
             last = res
             implied = parse_insufficient_min_gas_price(res.log, gas)
             if implied is not None:
@@ -122,12 +126,29 @@ class TxClient:
             break
         raise TxSubmissionError(last.code, last.log)
 
-    def _confirm(self, resp: TxResponse) -> TxResponse:
-        """ConfirmTx (:412): drive a block and report inclusion height."""
-        _, results = self._node.produce_block()
-        for r in results:
-            if r.code != 0:
-                raise TxSubmissionError(r.code, r.log)
-        return TxResponse(
-            height=self._node.app.height, code=0, gas_wanted=resp.gas_wanted
-        )
+    def _confirm(self, resp: TxResponse, timeout_s: float = 30.0) -> TxResponse:
+        """ConfirmTx (:412): wait for inclusion and report its height.
+
+        Against an in-process node (TestNode surface) this drives a block
+        directly; against a served node (no produce_block, e.g. the RPC
+        client) it polls the tx index until the server's proposer loop
+        commits the tx — the reference's poll-by-hash behavior.
+        """
+        if hasattr(self._node, "app"):  # in-process node: drive a block
+            _, results = self._node.produce_block()
+            for r in results:
+                if r.code != 0:
+                    raise TxSubmissionError(r.code, r.log)
+            return TxResponse(
+                height=self._node.app.height, code=0, gas_wanted=resp.gas_wanted
+            )
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            status = self._node.tx_status(resp.tx_hash)
+            if status is not None:
+                height, code, log = status
+                if code != 0:
+                    raise TxSubmissionError(code, log)
+                return TxResponse(height=height, code=0, gas_wanted=resp.gas_wanted)
+            time.sleep(0.05)
+        raise TxSubmissionError(-1, "timed out waiting for tx inclusion")

@@ -17,6 +17,15 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # no accelerator plugin in tests
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/celestia_jax_cache")
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process / long-running integration tests"
+    )
