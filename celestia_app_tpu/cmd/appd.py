@@ -170,6 +170,15 @@ def _write_snapshot(home: str, app: App, keep: int = 2) -> str:
 
 def cmd_start(args) -> int:
     app = load_app(args.home)
+    if args.warmup != "none":
+        from celestia_app_tpu.da.eds import warmup
+
+        upto = app.max_effective_square_size()
+        sizes = [1, upto] if args.warmup == "minimal" else None
+        t0 = time.time()
+        warmed = warmup(square_sizes=sizes, upto=None if sizes else upto)
+        print(f"warmed square sizes {warmed} in {time.time() - t0:.1f}s",
+              flush=True)
     node = None
     if getattr(args, "serve", False):
         from celestia_app_tpu.rpc.server import ServingNode, serve as rpc_serve
@@ -362,6 +371,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--serve", action="store_true",
                    help="serve the JSON-RPC endpoint (broadcast/query/proofs)")
     p.add_argument("--rpc-port", type=int, default=26657)
+    p.add_argument("--warmup", choices=["none", "minimal", "all"],
+                   default="minimal",
+                   help="AOT-compile square pipelines at startup: minimal "
+                        "(k=1 + max), all (every power of two up to max)")
     p.set_defaults(fn=cmd_start)
 
     p = sub.add_parser("snapshot", help="state-sync snapshots")

@@ -73,6 +73,26 @@ def jit_pipeline(k: int):
     return jax.jit(_pipeline(k))
 
 
+def warmup(square_sizes: list[int] | None = None, upto: int | None = None) -> list[int]:
+    """AOT-compile the fused pipeline for the given square sizes.
+
+    Servers call this at startup so no block ever pays a compile on the
+    critical path (SURVEY §7 hard part 4: recompilation must never sit on
+    block production; reference TimeoutPropose is 10s). Pass either an
+    explicit list or `upto` for every power of two 1..upto. Returns the
+    warmed sizes.
+    """
+    if square_sizes is None:
+        assert upto is not None, "pass square_sizes or upto"
+        square_sizes = [1 << i for i in range((upto).bit_length())]
+        square_sizes = [k for k in square_sizes if k <= upto]
+    for k in square_sizes:
+        ods = np.zeros((k, k, SHARE_SIZE), dtype=np.uint8)
+        out = jit_pipeline(k)(jnp.asarray(ods))
+        jax.block_until_ready(out)
+    return list(square_sizes)
+
+
 class ExtendedDataSquare:
     """Host handle to a device-computed EDS with its NMT roots."""
 

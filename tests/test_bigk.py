@@ -1,0 +1,90 @@
+"""Big squares for real: GF(2^16) full-share k=256 end to end.
+
+VERDICT #7 / SURVEY §7 hard part 4: the GF(2^16) path (k in {256, 512},
+codewords wider than 256 symbols — leopard16's regime) must be exercised on
+full 512-byte shares, not 8-byte toys. k=512 is covered by bench.py (it is
+too slow for the CPU suite); this file pins k=256:
+
+  * device extension of a full 256x256 ODS (33.5 MB) on the fused pipeline;
+  * RS parity spot-checked against the host GF(2^16) codec oracle on
+    random rows AND columns (both axis phases);
+  * NMT row/col roots spot-checked against the host hasher;
+  * AOT warmup helper compiles a size list without touching block paths.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.constants import NAMESPACE_SIZE, PARITY_NAMESPACE_BYTES, SHARE_SIZE
+from celestia_app_tpu.da.dah import DataAvailabilityHeader
+from celestia_app_tpu.da.eds import ExtendedDataSquare, warmup
+from celestia_app_tpu.gf import codec_for_width
+from celestia_app_tpu.nmt.hasher import NmtHasher
+
+
+def _host_row_root(row: np.ndarray, row_index: int, k: int) -> bytes:
+    """NMT root of one EDS row via the host hasher (oracle)."""
+    digests = []
+    for j in range(2 * k):
+        share = row[j].tobytes()
+        in_q0 = row_index < k and j < k
+        ns = share[:NAMESPACE_SIZE] if in_q0 else PARITY_NAMESPACE_BYTES
+        digests.append(NmtHasher.hash_leaf(ns + share))
+    while len(digests) > 1:
+        digests = [
+            NmtHasher.hash_node(digests[t], digests[t + 1])
+            for t in range(0, len(digests), 2)
+        ]
+    return digests[0]
+
+
+@pytest.mark.slow
+def test_k256_full_share_extension_and_roots():
+    k = 256
+    rng = np.random.default_rng(17)
+    n = k * k
+    ns = np.sort(rng.integers(0, 200, n).astype(np.uint8))
+    ods = rng.integers(0, 256, (n, SHARE_SIZE), dtype=np.uint8)
+    ods[:, :NAMESPACE_SIZE] = 0
+    ods[:, NAMESPACE_SIZE - 1] = ns
+    ods = ods.reshape(k, k, SHARE_SIZE)
+
+    eds = ExtendedDataSquare.compute(ods)
+    full = eds.squared()
+    assert full.shape == (2 * k, 2 * k, SHARE_SIZE)
+    np.testing.assert_array_equal(full[:k, :k], ods)
+
+    codec = codec_for_width(k)
+    assert codec.field.m == 16  # the leopard16 regime
+
+    # Both axis phases against the host GF(2^16) oracle on random lines.
+    for i in rng.choice(k, 3, replace=False):
+        np.testing.assert_array_equal(
+            full[i, k:], codec.encode(full[i, :k]), err_msg=f"row {i} parity"
+        )
+    for j in rng.choice(2 * k, 3, replace=False):
+        np.testing.assert_array_equal(
+            full[k:, j], codec.encode(full[:k, j]), err_msg=f"col {j} parity"
+        )
+
+    # Roots: spot-check one data row, one parity row, one column.
+    dah = DataAvailabilityHeader.from_eds(eds)
+    dah.validate_basic()
+    assert dah.square_size() == k
+    row_roots = eds.row_roots()
+    for i in (int(rng.integers(0, k)), int(rng.integers(k, 2 * k))):
+        assert row_roots[i] == _host_row_root(full[i], i, k), f"row root {i}"
+    # The column-j tree's Q0 condition at leaf i is (i < k and j < k) —
+    # the row oracle computes exactly that when handed the column as a
+    # "row" with row_index = j.
+    j = int(rng.integers(0, 2 * k))
+    assert eds.col_roots()[j] == _host_row_root(full[:, j], j, k), f"col root {j}"
+
+
+def test_warmup_compiles_requested_sizes():
+    warmed = warmup(upto=4)
+    assert warmed == [1, 2, 4]
+    warmed = warmup(square_sizes=[8])
+    assert warmed == [8]
