@@ -110,15 +110,42 @@ def _unmarshal_attestation(raw: bytes):
     raise ValueError(f"unknown attestation kind {kind}")
 
 
+def encode_data_root_tuple(height: int, data_root: bytes) -> bytes:
+    """abi.encode(DataRootTuple{uint256 height, bytes32 dataRoot}) — 64 bytes.
+
+    The exact leaf the Blobstream contract hashes when verifying a
+    data-root inclusion proof (x/blobstream/client/verify.go:336-344
+    builds this tuple; the solidity type is pinned in
+    x/blobstream/types/abi_consts.go): 32-byte big-endian height followed
+    by the 32-byte data root.
+    """
+    if len(data_root) != 32:
+        raise ValueError(f"data root must be 32 bytes, got {len(data_root)}")
+    return height.to_bytes(32, "big") + data_root
+
+
 def data_commitment_root(data_roots: list[tuple[int, bytes]]) -> bytes:
     """Merkle root over (height, data_root) tuples for a commitment window.
 
     The relayer-facing commitment the reference obtains from celestia-core's
-    DataCommitment RPC: a binary merkle over DataRootTuple(height, dataRoot)
-    leaves, encoded here as height(8B BE) || root.
-    """
-    leaves = [h.to_bytes(8, "big") + root for h, root in data_roots]
+    DataCommitment RPC: an RFC-6962 binary merkle over 64-byte
+    abi-encoded DataRootTuple leaves (encode_data_root_tuple)."""
+    leaves = [encode_data_root_tuple(h, root) for h, root in data_roots]
     return merkle.hash_from_byte_slices(leaves)
+
+
+def data_root_inclusion_proof(
+    data_roots: list[tuple[int, bytes]], height: int
+) -> tuple[int, int, list[bytes]]:
+    """(index, total, audit_path) of `height`'s tuple within the window.
+
+    The core-RPC DataRootInclusionProof the relayer feeds to the contract
+    (x/blobstream/client/verify.go:288,310-344).
+    """
+    heights = [h for h, _ in data_roots]
+    index = heights.index(height)
+    leaves = [encode_data_root_tuple(h, root) for h, root in data_roots]
+    return index, len(leaves), merkle.proof(leaves, index)
 
 
 def _normalized_power_diff(
@@ -217,13 +244,70 @@ class BlobstreamKeeper:
                 return att
         return None
 
+    # --- query surface (what the BlobstreamX relayer consumes) -------------
+    # keeper/query_data_commitment.go, query_valset.go, query_attestation.go
+    def latest_data_commitment(self) -> DataCommitment:
+        """GetLatestDataCommitment (keeper_data_commitment.go:98-123)."""
+        dc = self._latest_data_commitment()
+        if dc is None:
+            raise KeyError("no data commitment yet")
+        return dc
+
+    def data_commitment_for_height(self, height: int) -> DataCommitment:
+        """Attestation whose [begin, end) window contains `height`
+        (keeper_data_commitment.go:54-96: begin <= h < end, newest first)."""
+        latest = self.latest_data_commitment()
+        # <= (not the reference's <): end_block is exclusive, so a height
+        # equal to it belongs to the *next* window — the reference misreports
+        # that boundary as "not found or pruned" instead of "not yet
+        # generated"; this keeps the retry-later signal accurate.
+        if latest.end_block <= height:
+            raise KeyError(
+                f"data commitment for height {height} not yet generated "
+                f"(latest end {latest.end_block})"
+            )
+        for att in reversed(self.attestations()):
+            if (
+                isinstance(att, DataCommitment)
+                and att.begin_block <= height < att.end_block
+            ):
+                return att
+        raise KeyError(f"data commitment for height {height} not found or pruned")
+
+    def earliest_available_nonce(self) -> int:
+        """Earliest attestation nonce still in store (post-pruning)."""
+        atts = self.attestations()
+        if not atts:
+            raise KeyError("no attestations yet")
+        return atts[0].nonce
+
+    def latest_valset_before_nonce(self, nonce: int) -> Valset:
+        """Newest valset with nonce <= the given nonce
+        (keeper_valset.go GetLatestValsetBeforeNonce via query_valset.go)."""
+        for att in reversed(self.attestations()):
+            if isinstance(att, Valset) and att.nonce <= nonce:
+                return att
+        raise KeyError(f"no valset at or before nonce {nonce}")
+
     def _handle_data_commitments(self, height: int, time_ns: int) -> list:
+        """Catch-up loop (abci.go:37-81): for window 400 the ranges are
+        [1,401), [401,801), … — the first commitment fires at height 400
+        (`height >= window`, abci.go:73) and every later one at
+        end_block + window (`height - end >= window`, abci.go:63): 400,
+        801, 1201, … — the reference's own cadence, deliberately mirrored
+        (the second window is complete at height 800 but the reference
+        does not emit it until 801)."""
         created: list = []
         while True:
             latest = self._latest_data_commitment()
-            begin = latest.end_block if latest else 0
-            if height - begin < self.window:
-                return created
+            if latest is None:
+                if height < self.window:
+                    return created
+                begin = 1
+            else:
+                if height - latest.end_block < self.window:
+                    return created
+                begin = latest.end_block
             dc = DataCommitment(
                 self._next_nonce(), begin, begin + self.window, height, time_ns
             )

@@ -136,6 +136,33 @@ class RemoteNode:
         res = self.call("state_proof", key=key.hex())
         return state_proof_from_json(res["proof"]), bytes.fromhex(res["app_hash"])
 
+    # --- blobstream relayer surface -----------------------------------------
+    def blobstream_attestation(self, nonce: int) -> dict | None:
+        return self.call("blobstream_attestation", nonce=nonce)
+
+    def blobstream_nonces(self) -> dict:
+        return self.call("blobstream_nonces")
+
+    def data_commitment_range(self, height: int) -> dict:
+        return self.call("data_commitment_range", height=height)
+
+    def latest_data_commitment(self) -> dict | None:
+        return self.call("latest_data_commitment")
+
+    def latest_valset_before(self, nonce: int) -> dict:
+        return self.call("latest_valset_before", nonce=nonce)
+
+    def data_commitment(self, begin: int, end: int) -> bytes:
+        return bytes.fromhex(self.call("data_commitment", begin=begin, end=end))
+
+    def data_root_inclusion_proof(
+        self, height: int, begin: int, end: int
+    ) -> tuple[int, int, list[bytes]]:
+        res = self.call(
+            "data_root_inclusion_proof", height=height, begin=begin, end=end
+        )
+        return res["index"], res["total"], [bytes.fromhex(p) for p in res["path"]]
+
     def wait_for_height(self, height: int, timeout_s: float = 30.0) -> dict:
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:

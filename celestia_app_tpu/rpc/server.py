@@ -49,6 +49,10 @@ class ServingNode(TestNode):
         # (BlockData, time_ns) by height: survives serving a restarted
         # chain (list index != height) and feeds peer catch-up.
         self._blocks_by_height: dict[int, tuple[BlockData, int]] = {}
+        # App version per height (the block header's Version.App in the
+        # reference): clients reconstructing historical squares need the
+        # hard cap in force then, not the current gov param.
+        self._version_by_height: dict[int, int] = {}
         self.lock = threading.RLock()
         # Serializes whole produce+replicate rounds so replicated heights
         # reach peers in order even with concurrent produce callers.
@@ -88,11 +92,13 @@ class ServingNode(TestNode):
 
     def _produce_and_replicate(self, produce_time_ns: int | None = None):
         with self.lock:
+            proposal_version = self.app.app_version  # pre-end-block upgrades
             data, results = super().produce_block(produce_time_ns)
             height = self.app.height
             time_ns = self.app.last_block_time_ns
             own_app_hash = self.app.cms.last_app_hash
             self._blocks_by_height[height] = (data, time_ns)
+            self._version_by_height[height] = proposal_version
         for peer in self.peers():
             reply = peer.apply_block(height, time_ns, data)
             if (
@@ -121,6 +127,7 @@ class ServingNode(TestNode):
                 raise ValueError(
                     f"out-of-order block {height}, at {self.app.height}"
                 )
+            proposal_version = self.app.app_version  # pre-end-block upgrades
             if not self.app.process_proposal(data):
                 raise ValueError(f"proposal rejected at height {height}")
             results = self.app.finalize_block(time_ns, list(data.txs))
@@ -128,6 +135,7 @@ class ServingNode(TestNode):
             self.mempool.update(self.app.height, list(data.txs))
             self.blocks.append(data)
             self._blocks_by_height[height] = (data, time_ns)
+            self._version_by_height[height] = proposal_version
             self.index_block(height, list(data.txs), results)
             return {
                 "app_hash": self.app.cms.last_app_hash.hex(),
@@ -166,6 +174,7 @@ class ServingNode(TestNode):
                 "app_version": self.app.app_version,
                 "validator_index": self.validator_index,
                 "n_validators": self.n_validators,
+                "max_square_size": self.app.max_effective_square_size(),
             }
 
     def rpc_broadcast_tx(self, tx: str, relay: bool = True) -> dict:
@@ -198,6 +207,7 @@ class ServingNode(TestNode):
             "time_ns": time_ns,
             "data_hash": data.hash.hex(),
             "square_size": data.square_size,
+            "app_version": self._version_by_height.get(height, self.app.app_version),
             "txs": [t.hex() for t in data.txs],
         }
 
@@ -259,6 +269,115 @@ class ServingNode(TestNode):
         with self.lock:
             vals = StakingKeeper(self.app.cms.working).validators()
         return [{"address": v.address, "power": v.power} for v in vals]
+
+    # --- blobstream relayer surface -----------------------------------------
+    # The query endpoints a BlobstreamX relayer consumes (reference
+    # x/blobstream/keeper/query_*.go served over gRPC, plus celestia-core's
+    # DataCommitment / DataRootInclusionProof RPCs used by client/verify.go).
+    def _blobstream_keeper(self):
+        from celestia_app_tpu.modules.blobstream.keeper import BlobstreamKeeper
+        from celestia_app_tpu.state.staking import StakingKeeper
+
+        store = self.app.cms.working
+        return BlobstreamKeeper(store, StakingKeeper(store))
+
+    @staticmethod
+    def _attestation_dict(att) -> dict:
+        from celestia_app_tpu.modules.blobstream.keeper import DataCommitment, Valset
+
+        if isinstance(att, Valset):
+            return {
+                "kind": "valset",
+                "nonce": att.nonce,
+                "height": att.height,
+                "time_ns": att.time_ns,
+                "members": [
+                    {"address": m.address, "power": m.power} for m in att.members
+                ],
+            }
+        assert isinstance(att, DataCommitment)
+        return {
+            "kind": "data_commitment",
+            "nonce": att.nonce,
+            "begin_block": att.begin_block,
+            "end_block": att.end_block,
+            "height": att.height,
+            "time_ns": att.time_ns,
+        }
+
+    def rpc_blobstream_attestation(self, nonce: int) -> dict | None:
+        """QueryAttestationRequestByNonce."""
+        with self.lock:
+            att = self._blobstream_keeper().get_attestation(nonce)
+        return None if att is None else self._attestation_dict(att)
+
+    def rpc_blobstream_nonces(self) -> dict:
+        """LatestAttestationNonce + EarliestAttestationNonce."""
+        with self.lock:
+            k = self._blobstream_keeper()
+            latest = k.latest_nonce()
+            try:
+                earliest = k.earliest_available_nonce()
+            except KeyError:
+                earliest = 0
+        return {"latest": latest, "earliest": earliest}
+
+    def rpc_data_commitment_range(self, height: int) -> dict:
+        """DataCommitmentRangeForHeight (query_data_commitment.go:10-19)."""
+        with self.lock:
+            att = self._blobstream_keeper().data_commitment_for_height(height)
+        return self._attestation_dict(att)
+
+    def rpc_latest_data_commitment(self) -> dict | None:
+        """LatestDataCommitment (query_data_commitment.go:21-32)."""
+        with self.lock:
+            try:
+                att = self._blobstream_keeper().latest_data_commitment()
+            except KeyError:
+                return None
+        return self._attestation_dict(att)
+
+    def rpc_latest_valset_before(self, nonce: int) -> dict:
+        """LatestValsetRequestBeforeNonce (query_valset.go:12-22)."""
+        with self.lock:
+            vs = self._blobstream_keeper().latest_valset_before_nonce(nonce)
+        return self._attestation_dict(vs)
+
+    def _window_data_roots(self, begin: int, end: int) -> list[tuple[int, bytes]]:
+        """(height, data_root) for each height in [begin, end)."""
+        out = []
+        for h in range(begin, end):
+            entry = self._blocks_by_height.get(h)
+            if entry is None:
+                raise ValueError(f"no block at height {h} (window [{begin},{end}))")
+            out.append((h, entry[0].hash))
+        return out
+
+    def rpc_data_commitment(self, begin: int, end: int) -> str:
+        """Tuple root over [begin, end) — celestia-core's DataCommitment RPC,
+        the root the relayer submits to the Blobstream contract."""
+        from celestia_app_tpu.modules.blobstream.keeper import data_commitment_root
+
+        with self.lock:
+            roots = self._window_data_roots(begin, end)
+        return data_commitment_root(roots).hex()
+
+    def rpc_data_root_inclusion_proof(self, height: int, begin: int, end: int) -> dict:
+        """Binary-merkle proof of (height, dataRoot) inside the window's
+        tuple root — celestia-core's DataRootInclusionProof RPC
+        (consumed at client/verify.go:288)."""
+        from celestia_app_tpu.modules.blobstream.keeper import (
+            data_root_inclusion_proof,
+        )
+
+        with self.lock:
+            roots = self._window_data_roots(begin, end)
+        index, total, path = data_root_inclusion_proof(roots, height)
+        return {
+            "index": index,
+            "total": total,
+            "path": [p.hex() for p in path],
+        }
 
 
 def _method_table(node: ServingNode) -> dict:

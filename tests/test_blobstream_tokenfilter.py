@@ -48,7 +48,8 @@ class TestBlobstream:
         k = make_keeper({"v1": 100}, window=10)
         created = k.end_blocker(height=35, time_ns=T0)
         dcs = [a for a in created if isinstance(a, DataCommitment)]
-        assert [(d.begin_block, d.end_block) for d in dcs] == [(0, 10), (10, 20), (20, 30)]
+        # Reference ranges (keeper_data_commitment.go:26): [1,11), [11,21), [21,31).
+        assert [(d.begin_block, d.end_block) for d in dcs] == [(1, 11), (11, 21), (21, 31)]
         # Nonces are globally monotonic across kinds.
         assert [a.nonce for a in k.attestations()] == [1, 2, 3, 4]
 
