@@ -1,34 +1,57 @@
 """The ante handler chain: every admission check a tx passes before execution.
 
-Behavioral parity with reference app/ante/ante.go:15-82 (the 17-decorator
-chain), collapsed to the decorators with observable behavior in this
-framework:
+Behavioral parity with reference app/ante/ante.go:15-82, decorator by
+decorator and in the reference's order (the per-decorator map with its
+rejection tests lives in PARITY.md §ante):
 
-  * panic containment (HandlePanicDecorator, app/ante/panic.go)
-  * message-version gating (MsgVersioningGateKeeper, app/ante/msg_gatekeeper.go)
-  * fee validation: gas price >= max(node min [CheckTx only], network min),
-    priority = gas price x 1e6 (ValidateTxFee, app/ante/fee_checker.go:31-60)
-  * signature + account checks: pubkey, account number, sequence, DIRECT
-    mode verification (sdk SigVerificationDecorator analog)
-  * fee deduction to the fee collector
-  * x/blob ante: MinGasPFBDecorator + BlobShareDecorator
-    (x/blob/ante/ante.go:25, blob_share_decorator.go:27)
-  * sequence increment
+   1 HandlePanicDecorator        -> run_ante's catch-all reject
+   2 MsgVersioningGateKeeper     -> allowed_msg_types version gate
+   3 SetUpContextDecorator       -> GasMeter(fee.gas_limit)
+   4 ExtensionOptionsDecorator   -> reject critical extension options
+   5 ValidateBasicDecorator      -> per-msg validate_basic + sig presence
+   6 TxTimeoutHeightDecorator    -> reject past-timeout txs
+   7 ValidateMemoDecorator       -> memo <= 256 chars
+   8 ConsumeGasForTxSizeDecorator-> 10 gas per tx byte
+   9 DeductFeeDecorator          -> ValidateTxFee (network+node min gas
+                                    price, priority = gas price x 1e6,
+                                    fee_checker.go:17,31-60) + deduction
+  10 SetPubKeyDecorator          -> stores the pubkey on first use
+  11 ValidateSigCountDecorator   -> single-signer rule (see PARITY: the
+                                    sdk allows up to 7 multisig keys; this
+                                    framework pins exactly one signer)
+  12 SigGasConsumeDecorator      -> 1000 gas per secp256k1 signature
+  13 SigVerificationDecorator    -> sequence match + DIRECT verification
+  14 MinGasPFBDecorator          -> gas limit covers blob gas
+  15 MaxTotalBlobSizeDecorator   -> v1 blob byte cap
+  16 BlobShareDecorator          -> v2 blob share cap
+  17 GovProposalDecorator        -> MsgSubmitProposal needs >= 1 message
+  18 IncrementSequenceDecorator  -> sequence bump
+  19 RedundantRelayDecorator     -> IBC relay dedup (modules/ibc)
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from celestia_app_tpu.app.gas import (
+    GasMeter,
+    MAX_MEMO_CHARACTERS,
+    OutOfGas,
+    SIG_VERIFY_COST_SECP256K1,
+    TX_SIZE_COST_PER_BYTE,
+)
 from celestia_app_tpu.constants import CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
 from celestia_app_tpu.shares.sparse import sparse_shares_needed
 from celestia_app_tpu.state.accounts import FEE_COLLECTOR
 from celestia_app_tpu.state.dec import Dec
 from celestia_app_tpu.tx.messages import (
+    MsgDeposit,
     MsgPayForBlobs,
     MsgSend,
     MsgSignalVersion,
+    MsgSubmitProposal,
     MsgTryUpgrade,
+    MsgVote,
 )
 from celestia_app_tpu.tx.sign import Tx
 
@@ -40,8 +63,9 @@ class AnteError(ValueError):
 
 
 # appVersion -> allowed msg types (MsgVersioningGateKeeper,
-# app/ante/msg_gatekeeper.go:18-42: signal msgs are v2+).
-_V1_MSGS = {MsgSend, MsgPayForBlobs}
+# app/ante/msg_gatekeeper.go:18-42: signal msgs are v2+; gov msgs exist in
+# every version, as x/gov is wired for v1 and v2 in app/modules.go).
+_V1_MSGS = {MsgSend, MsgPayForBlobs, MsgSubmitProposal, MsgVote, MsgDeposit}
 _V2_MSGS = _V1_MSGS | {MsgSignalVersion, MsgTryUpgrade}
 
 
@@ -53,6 +77,7 @@ def allowed_msg_types(app_version: int) -> set[type]:
 class AnteResult:
     priority: int = 0
     gas_wanted: int = 0
+    gas_consumed: int = 0  # meter reading after the chain (tx size + sig gas)
     signer: str = ""
     events: list = field(default_factory=list)
 
@@ -64,26 +89,48 @@ def run_ante(
     *,
     is_check_tx: bool,
     simulate: bool = False,
+    tx_bytes: bytes | None = None,
 ) -> AnteResult:
     """Run the full chain against `ctx` (a branched state view).
 
     Raises AnteError on any rejection; mutates ctx state (sequence bump,
     fee deduction) on success, exactly like the reference chain.
+    `tx_bytes` is the delivered tx encoding (the inner tx for a BlobTx),
+    metered by ConsumeGasForTxSizeDecorator; None skips size gas (some
+    internal callers have no wire encoding).
+
+    The chain runs on a per-tx branch of `ctx` that is written back only on
+    success (baseapp runTx's cacheTxContext around the ante handler): a
+    rejection after fee deduction must not leave the fee deducted in a
+    shared check/filter state.
     """
+    tx_ctx = ctx.branch()
     try:
-        return _run(app, ctx, tx, is_check_tx=is_check_tx, simulate=simulate)
+        res = _run(
+            app, tx_ctx, tx, is_check_tx=is_check_tx, simulate=simulate,
+            tx_bytes=tx_bytes,
+        )
     except AnteError:
         raise
+    except OutOfGas as e:  # SetUpContextDecorator's recovery: out of gas -> reject
+        raise AnteError(str(e)) from e
     except Exception as e:  # HandlePanicDecorator: panic -> reject, not crash
         raise AnteError(f"internal error in ante chain: {e!r}") from e
+    ctx.store.write_back(tx_ctx.store)
+    return res
 
 
-def _run(app, ctx, tx: Tx, *, is_check_tx: bool, simulate: bool) -> AnteResult:
-    msgs = tx.msgs()  # raises on unknown type: unregistered msgs are rejected
+def _run(
+    app, ctx, tx: Tx, *, is_check_tx: bool, simulate: bool, tx_bytes: bytes | None
+) -> AnteResult:
+    from celestia_app_tpu.tx.messages import decode_msg
+
+    body = tx.body  # parsed once; msgs() would re-unmarshal the body
+    msgs = [decode_msg(m) for m in body.messages]  # raises on unknown type
     if not msgs:
         raise AnteError("tx has no messages")
 
-    # --- msg version gating ----------------------------------------------
+    # --- 2: msg version gating ---------------------------------------------
     allowed = allowed_msg_types(ctx.app_version)
     for m in msgs:
         if type(m) not in allowed:
@@ -91,11 +138,47 @@ def _run(app, ctx, tx: Tx, *, is_check_tx: bool, simulate: bool) -> AnteResult:
                 f"message {type(m).__name__} not allowed at app version {ctx.app_version}"
             )
 
-    # --- fee checks (ValidateTxFee) ---------------------------------------
+    # --- 3: gas meter setup (SetUpContextDecorator) --------------------------
     auth = tx.auth_info
     fee = auth.fee
     if fee.gas_limit == 0:
         raise AnteError("gas limit must be positive")
+    meter = GasMeter(None if simulate else fee.gas_limit)
+
+    # --- 4: extension options (RejectExtensionOptionsDecorator: any critical
+    # extension option rejects; non-critical ones pass by definition) ---------
+    if body.extension_options:
+        raise AnteError("unknown extension options")
+
+    # --- 5: ValidateBasic --------------------------------------------------
+    if not tx.signatures or any(not s for s in tx.signatures):
+        raise AnteError("tx must contain signatures")
+    for m in msgs:
+        vb = getattr(m, "validate_basic", None)
+        if vb is not None:
+            try:
+                vb()
+            except ValueError as e:
+                raise AnteError(str(e)) from e
+
+    # --- 6: timeout height ---------------------------------------------------
+    if body.timeout_height and ctx.height > body.timeout_height:
+        raise AnteError(
+            f"tx timeout height {body.timeout_height} exceeded, block height {ctx.height}"
+        )
+
+    # --- 7: memo length ------------------------------------------------------
+    if len(body.memo) > MAX_MEMO_CHARACTERS:
+        raise AnteError(
+            f"maximum number of characters is {MAX_MEMO_CHARACTERS} "
+            f"but received {len(body.memo)}"
+        )
+
+    # --- 8: tx size gas ------------------------------------------------------
+    if tx_bytes is not None:
+        meter.consume(len(tx_bytes) * TX_SIZE_COST_PER_BYTE, "txSize")
+
+    # --- 9: fee checks (ValidateTxFee) + deduction ---------------------------
     fee_utia = sum(c.amount for c in fee.amount if c.denom == "utia")
     gas_price = Dec.from_fraction(fee_utia, fee.gas_limit)
     # Error strings follow the sdk wording so clients can parse the required
@@ -115,13 +198,8 @@ def _run(app, ctx, tx: Tx, *, is_check_tx: bool, simulate: bool) -> AnteResult:
             )
     priority = gas_price.mul_int(PRIORITY_SCALING_FACTOR).truncate_int()
 
-    # --- x/blob ante -------------------------------------------------------
-    for m in msgs:
-        if isinstance(m, MsgPayForBlobs):
-            _check_pfb_gas(m, fee.gas_limit, app.gas_per_blob_byte)
-            _check_blob_shares(m, app.gov_max_square_size, ctx.app_version)
-
-    # --- account + signature -----------------------------------------------
+    # Resolve the signer before moving money (DeductFee needs the fee payer —
+    # the first signer, pkg/user single-signer rule).
     if len(auth.signer_infos) != 1 or len(tx.signatures) != 1:
         raise AnteError("exactly one signer required")
     info = auth.signer_infos[0]
@@ -129,31 +207,60 @@ def _run(app, ctx, tx: Tx, *, is_check_tx: bool, simulate: bool) -> AnteResult:
     acc = ctx.auth.get_account(signer_addr)
     if acc is None:
         raise AnteError(f"account {signer_addr} not found")
-    if info.sequence != acc.sequence:
-        raise AnteError(
-            f"account sequence mismatch, expected {acc.sequence}, got {info.sequence}"
-        )
+    # Fee deduction precedes signature verification in the reference chain
+    # (DeductFeeDecorator at ante.go:46-49 vs SigVerification at :60-63), so
+    # an underfunded fee payer surfaces as insufficient funds even when the
+    # signature is also bad.  The branch is discarded on rejection.
+    if fee_utia:
+        try:
+            ctx.bank.send(signer_addr, FEE_COLLECTOR, fee_utia)
+        except ValueError as e:
+            raise AnteError(str(e)) from e
+
+    # --- 10-13: pubkey, sig count, sig gas, sig verification -----------------
     for m in msgs:
         expected = getattr(m, "signer", None) or getattr(m, "from_address", None) or getattr(
             m, "validator_address", None
         )
         if expected and expected != signer_addr:
             raise AnteError(f"message signer {expected} != tx signer {signer_addr}")
+    meter.consume(SIG_VERIFY_COST_SECP256K1, "ante verify: secp256k1")
+    if info.sequence != acc.sequence:
+        raise AnteError(
+            f"account sequence mismatch, expected {acc.sequence}, got {info.sequence}"
+        )
     if not simulate and not tx.verify_signature(app.chain_id, acc.account_number):
         raise AnteError("signature verification failed")
 
-    # --- fee deduction + sequence increment --------------------------------
-    if fee_utia:
-        try:
-            ctx.bank.send(signer_addr, FEE_COLLECTOR, fee_utia)
-        except ValueError as e:
-            raise AnteError(str(e)) from e
+    # --- 14-16: x/blob ante --------------------------------------------------
+    for m in msgs:
+        if isinstance(m, MsgPayForBlobs):
+            _check_pfb_gas(m, fee.gas_limit, app.gas_per_blob_byte)
+            _check_blob_shares(m, app.gov_max_square_size, ctx.app_version)
+
+    # --- 17: gov proposals ---------------------------------------------------
+    _check_gov_proposals(msgs)
+
+    # --- 18: sequence increment + pubkey persistence -------------------------
     if acc.pubkey == b"":
         acc.pubkey = info.public_key.bytes
     acc.sequence += 1
     ctx.auth.set_account(acc)
 
-    return AnteResult(priority=priority, gas_wanted=fee.gas_limit, signer=signer_addr)
+    return AnteResult(
+        priority=priority,
+        gas_wanted=fee.gas_limit,
+        gas_consumed=meter.consumed,
+        signer=signer_addr,
+    )
+
+
+def _check_gov_proposals(msgs: list) -> None:
+    """GovProposalDecorator (app/ante/gov.go): a MsgSubmitProposal with no
+    inner messages is rejected before it can reach the gov keeper."""
+    for m in msgs:
+        if isinstance(m, MsgSubmitProposal) and not m.changes:
+            raise AnteError("proposal must contain at least one message")
 
 
 def _check_pfb_gas(msg: MsgPayForBlobs, gas_limit: int, gas_per_blob_byte: int) -> None:

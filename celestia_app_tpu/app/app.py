@@ -43,10 +43,13 @@ from celestia_app_tpu.state.staking import StakingKeeper, Validator
 from celestia_app_tpu.state.store import CommitStore, KVStore
 from celestia_app_tpu.tx.envelopes import unmarshal_blob_tx
 from celestia_app_tpu.tx.messages import (
+    MsgDeposit,
     MsgPayForBlobs,
     MsgSend,
     MsgSignalVersion,
+    MsgSubmitProposal,
     MsgTryUpgrade,
+    MsgVote,
 )
 from celestia_app_tpu.trace import traced
 from celestia_app_tpu.tx.sign import Tx
@@ -67,6 +70,8 @@ class Genesis:
     validators: tuple[Validator, ...] = ()
     app_version: int = LATEST_VERSION
     gov_max_square_size: int = DEFAULT_GOV_MAX_SQUARE_SIZE
+    # x/blobstream DataCommitmentWindow (types/genesis.go:29); 0 = default 400.
+    data_commitment_window: int = 0
 
 
 @dataclass(frozen=True)
@@ -164,6 +169,14 @@ class App:
         BlobParamsKeeper(self.cms.working).set_gov_max_square_size(
             genesis.gov_max_square_size
         )
+        if genesis.data_commitment_window:
+            from celestia_app_tpu.modules.blobstream.keeper import (
+                set_data_commitment_window,
+            )
+
+            set_data_commitment_window(
+                self.cms.working, genesis.data_commitment_window
+            )
         ctx = Ctx(self.cms.working, 0, genesis.genesis_time_ns, self.app_version)
         for acc in genesis.accounts:
             a = ctx.auth.create_account(acc.address, acc.pubkey)
@@ -192,7 +205,7 @@ class App:
             inner = btx.tx
         try:
             tx = Tx.unmarshal(inner)
-            res = run_ante(self, ctx, tx, is_check_tx=True)
+            res = run_ante(self, ctx, tx, is_check_tx=True, tx_bytes=inner)
         except (AnteError, ValueError) as e:
             return TxResult(code=1, log=str(e))
         return TxResult(code=0, gas_wanted=res.gas_wanted, events=[("priority", res.priority)])
@@ -233,7 +246,7 @@ class App:
                 tx = Tx.unmarshal(raw)
                 if any(isinstance(m, MsgPayForBlobs) for m in tx.msgs()):
                     continue  # PFB outside a BlobTx is invalid
-                run_ante(self, ctx, tx, is_check_tx=False)
+                run_ante(self, ctx, tx, is_check_tx=False, tx_bytes=raw)
                 normal.append(raw)
             except (AnteError, ValueError):
                 continue
@@ -243,7 +256,9 @@ class App:
             if isinstance(v, BlobTxError):
                 continue
             try:
-                run_ante(self, ctx, Tx.unmarshal(btx.tx), is_check_tx=False)
+                run_ante(
+                    self, ctx, Tx.unmarshal(btx.tx), is_check_tx=False, tx_bytes=btx.tx
+                )
                 blob.append(raw)
             except (AnteError, ValueError):
                 continue
@@ -277,12 +292,14 @@ class App:
                 tx = Tx.unmarshal(raw)
                 if any(isinstance(m, MsgPayForBlobs) for m in tx.msgs()):
                     return False  # PFB must ride in a BlobTx (:77-88)
-                run_ante(self, ctx, tx, is_check_tx=False)
+                run_ante(self, ctx, tx, is_check_tx=False, tx_bytes=raw)
             else:
                 v = next(validated)
                 if isinstance(v, BlobTxError):
                     raise v
-                run_ante(self, ctx, Tx.unmarshal(btx.tx), is_check_tx=False)
+                run_ante(
+                    self, ctx, Tx.unmarshal(btx.tx), is_check_tx=False, tx_bytes=btx.tx
+                )
 
         sq = square.construct(list(data.txs), self.max_effective_square_size())
         if sq.size != data.square_size:
@@ -333,21 +350,30 @@ class App:
         tx_ctx = block_ctx.branch()
         try:
             tx = Tx.unmarshal(inner)
-            ante_res = run_ante(self, tx_ctx, tx, is_check_tx=False)
+            ante_res = run_ante(self, tx_ctx, tx, is_check_tx=False, tx_bytes=inner)
         except (AnteError, ValueError) as e:
             return TxResult(code=1, log=str(e))
 
-        gas_used = 0
+        # The ante chain's meter reading (tx-size + sig gas) carries into
+        # execution, as with the sdk's single per-tx gas meter.
+        gas_used = ante_res.gas_consumed
         events: list = []
+        # Messages run on their own branch (baseapp runMsgs' cache): a failed
+        # execution rolls back msg effects ONLY — the ante effects (fee
+        # deduction, sequence bump) stay committed, so a failed tx still pays
+        # its fee and cannot be replayed (msCache.Write() precedes runMsgs).
+        msg_ctx = tx_ctx.branch()
         try:
             for msg in tx.msgs():
-                used, evts = self._handle_msg(tx_ctx, msg, ante_res.gas_wanted - gas_used)
+                used, evts = self._handle_msg(msg_ctx, msg, ante_res.gas_wanted - gas_used)
                 gas_used += used
                 events.extend(evts)
         except Exception as e:
+            block_ctx.store.write_back(tx_ctx.store)  # ante effects persist
             return TxResult(
                 code=2, log=str(e), gas_wanted=ante_res.gas_wanted, gas_used=gas_used
             )
+        tx_ctx.store.write_back(msg_ctx.store)
         block_ctx.store.write_back(tx_ctx.store)
         return TxResult(
             code=0, gas_wanted=ante_res.gas_wanted, gas_used=gas_used, events=events
@@ -375,10 +401,33 @@ class App:
             keeper = SignalKeeper(ctx.store, ctx.staking)
             keeper.try_upgrade(ctx.height, self.app_version)
             return 0, []
+        if isinstance(msg, (MsgSubmitProposal, MsgVote, MsgDeposit)):
+            from celestia_app_tpu.modules.gov import GovKeeper, ParamChange
+
+            gov = GovKeeper(ctx.store, ctx.staking, ctx.bank)
+            if isinstance(msg, MsgSubmitProposal):
+                deposit = sum(c.amount for c in msg.initial_deposit if c.denom == "utia")
+                pid = gov.submit(
+                    msg.proposer,
+                    [ParamChange(c.subspace, c.key, c.value) for c in msg.changes],
+                    deposit,
+                    ctx.time_ns,
+                )
+                return 0, [("cosmos.gov.v1beta1.EventSubmitProposal", pid)]
+            if isinstance(msg, MsgVote):
+                gov.vote(msg.proposal_id, msg.voter, msg.option, ctx.time_ns)
+                return 0, [("cosmos.gov.v1beta1.EventVote", msg.proposal_id, msg.voter)]
+            deposit = sum(c.amount for c in msg.amount if c.denom == "utia")
+            gov.deposit(msg.proposal_id, msg.depositor, deposit, ctx.time_ns)
+            return 0, [("cosmos.gov.v1beta1.EventDeposit", msg.proposal_id, deposit)]
         raise ValueError(f"no handler for {type(msg).__name__}")
 
     def _end_block(self, ctx: Ctx, height: int) -> None:
-        """Blobstream (v1 only) + height/signal upgrades (app/app.go:458-477)."""
+        """Gov clocks + blobstream (v1 only) + height/signal upgrades
+        (app/app.go:458-477)."""
+        from celestia_app_tpu.modules.gov import GovKeeper
+
+        GovKeeper(ctx.store, ctx.staking, ctx.bank).end_blocker(ctx.time_ns)
         if self.app_version == 1:
             from celestia_app_tpu.modules.blobstream.keeper import BlobstreamKeeper
 

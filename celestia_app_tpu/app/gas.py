@@ -1,0 +1,47 @@
+"""Tx gas metering (the SDK gas meter the ante chain sets up).
+
+Reference: ante.NewSetUpContextDecorator installs a sdk.GasMeter limited to
+the tx's gas limit (app/ante/ante.go:33-34); ConsumeGasForTxSizeDecorator
+charges TxSizeCostPerByte per tx byte and SigGasConsumeDecorator charges
+the secp256k1 verification cost (ante.go:43-45,55-57), both against that
+meter, with overflow surfacing as an out-of-gas rejection.  Constants are
+the cosmos-sdk x/auth defaults the reference chain runs with.
+"""
+
+from __future__ import annotations
+
+# x/auth defaults (sdk auth/types/params.go), unchanged by celestia-app.
+TX_SIZE_COST_PER_BYTE = 10
+SIG_VERIFY_COST_SECP256K1 = 1000
+MAX_MEMO_CHARACTERS = 256
+TX_SIG_LIMIT = 7
+
+
+class OutOfGas(Exception):
+    """Gas consumption exceeded the meter's limit."""
+
+    def __init__(self, descriptor: str, limit: int):
+        super().__init__(f"out of gas in location: {descriptor}; gasLimit: {limit}")
+        self.descriptor = descriptor
+        self.limit = limit
+
+
+class GasMeter:
+    """Monotonic counter with a hard limit (sdk store/types/gas.go).
+
+    A `limit` of None gives an infinite meter (simulation mode).
+    """
+
+    def __init__(self, limit: int | None):
+        self.limit = limit
+        self.consumed = 0
+
+    def consume(self, amount: int, descriptor: str) -> None:
+        if amount < 0:
+            raise ValueError(f"negative gas amount for {descriptor}")
+        self.consumed += amount
+        if self.limit is not None and self.consumed > self.limit:
+            raise OutOfGas(descriptor, self.limit)
+
+    def remaining(self) -> int | None:
+        return None if self.limit is None else max(0, self.limit - self.consumed)

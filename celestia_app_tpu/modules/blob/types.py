@@ -161,5 +161,13 @@ def estimate_gas(
     gas_per_blob_byte: int = DEFAULT_GAS_PER_BLOB_BYTE,
     fixed_cost: int = PFB_GAS_FIXED_COST,
 ) -> int:
-    """payforblob.go:171 linear PFB gas model (fit R^2 ~ 0.996)."""
-    return gas_to_consume(tuple(blob_sizes), gas_per_blob_byte) + fixed_cost
+    """payforblob.go:171 linear PFB gas model (fit R^2 ~ 0.996):
+    blob gas + txSizeCost x BytesPerBlobInfo per blob + fixed cost."""
+    from celestia_app_tpu.app.gas import TX_SIZE_COST_PER_BYTE
+    from celestia_app_tpu.constants import BYTES_PER_BLOB_INFO
+
+    return (
+        gas_to_consume(tuple(blob_sizes), gas_per_blob_byte)
+        + TX_SIZE_COST_PER_BYTE * BYTES_PER_BLOB_INFO * len(blob_sizes)
+        + fixed_cost
+    )

@@ -33,6 +33,20 @@ ATTESTATION_EXPIRY_NS = 3 * 7 * 24 * 3600 * 10**9  # 3 weeks
 _NONCE_KEY = b"blobstream/latest_nonce"
 _ATT_PREFIX = b"blobstream/att/"
 _EVM_PREFIX = b"blobstream/evm/"
+_WINDOW_KEY = b"blobstream/params/data_commitment_window"
+
+
+def set_data_commitment_window(store: KVStore, window: int) -> None:
+    """On-chain DataCommitmentWindow param (genesis/gov-settable, as the
+    reference keeper reads it via GetDataCommitmentWindowParam)."""
+    if window <= 0:
+        raise ValueError("data commitment window must be positive")
+    store.set(_WINDOW_KEY, window.to_bytes(8, "big"))
+
+
+def get_data_commitment_window(store: KVStore) -> int:
+    raw = store.get(_WINDOW_KEY)
+    return int.from_bytes(raw, "big") if raw else DEFAULT_DATA_COMMITMENT_WINDOW
 
 
 @dataclass(frozen=True)
@@ -167,11 +181,17 @@ class BlobstreamKeeper:
         self,
         store: KVStore,
         staking: StakingKeeper,
-        data_commitment_window: int = DEFAULT_DATA_COMMITMENT_WINDOW,
+        data_commitment_window: int | None = None,
     ):
         self.store = store
         self.staking = staking
-        self.window = data_commitment_window
+        # None -> the on-chain param (keeper_data_commitment.go:44-50);
+        # an explicit argument pins it (unit tests).
+        self.window = (
+            data_commitment_window
+            if data_commitment_window is not None
+            else get_data_commitment_window(store)
+        )
 
     # --- nonces / storage --------------------------------------------------
     def latest_nonce(self) -> int:

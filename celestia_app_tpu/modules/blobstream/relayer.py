@@ -170,18 +170,36 @@ class Orchestrator:
 
 
 def relay_pending(remote, contract: BlobstreamContract, orchestrators) -> int:
-    """Relayer main loop body: walk un-relayed attestations in nonce order,
-    compute each window's tuple root from the chain, gather orchestrator
-    signatures, and submit.  Returns the number of commitments relayed."""
-    latest = remote.latest_data_commitment()
-    if latest is None:
-        return 0
+    """Relayer main loop body: walk un-relayed attestations in nonce order —
+    valset updates first registered in the contract (signed by the *old*
+    set), data commitments submitted against the set current at their
+    nonce, as the reference relayer sequences updateValidatorSet /
+    submitDataRootTupleRoot.  Returns the number of commitments relayed."""
+    nonces = remote.blobstream_nonces()
+    by_validator = {o.validator: o for o in orchestrators}
     relayed = 0
-    for nonce in range(1, latest["nonce"] + 1):
-        if nonce in contract.tuple_roots:
-            continue
+    for nonce in range(1, nonces["latest"] + 1):
         att = remote.blobstream_attestation(nonce)
-        if att is None or att.get("kind") != "data_commitment":
+        if att is None:
+            continue
+        if att["kind"] == "valset":
+            if att["nonce"] <= contract.valset_nonce:
+                continue  # genesis valset already registered
+            members = tuple(
+                BridgeValidator(m["address"], m["power"]) for m in att["members"]
+            )
+            # The relayer knows each orchestrator's key; the contract needs
+            # the new members' verification keys alongside the old set's
+            # signatures over the checkpoint.
+            new_pubkeys = {
+                m.address: by_validator[m.address].key.public_key()
+                for m in members
+                if m.address in by_validator
+            }
+            sigs = [o.sign_valset(att["nonce"], members) for o in orchestrators]
+            contract.update_valset(att["nonce"], members, new_pubkeys, sigs)
+            continue
+        if nonce in contract.tuple_roots:
             continue
         root = remote.data_commitment(att["begin_block"], att["end_block"])
         sigs = [o.sign_data_commitment(nonce, root) for o in orchestrators]
@@ -207,7 +225,8 @@ def verify_shares(
 
 
 def _locate_tx(remote, tx_hash: bytes):
-    """(height, tx_index, reconstructed square) for a committed tx, or None.
+    """(height, tx_index, n_txs, reconstructed square) for a committed tx,
+    or None.
 
     The square is rebuilt with the *hard cap of the app version the block
     was produced under* — verify.go:86-89 uses
@@ -229,7 +248,7 @@ def _locate_tx(remote, tx_hash: bytes):
     if tx_index is None:
         return None
     sq = square.construct(txs, square_size_upper_bound(block["app_version"]))
-    return height, tx_index, sq
+    return height, tx_index, len(txs), sq
 
 
 def verify_tx(remote, contract: BlobstreamContract, tx_hash: bytes) -> bool:
@@ -237,7 +256,7 @@ def verify_tx(remote, contract: BlobstreamContract, tx_hash: bytes) -> bool:
     located = _locate_tx(remote, tx_hash)
     if located is None:
         return False
-    height, tx_index, sq = located
+    height, tx_index, _n_txs, sq = located
     start, end = sq.find_tx_share_range(tx_index)
     return verify_shares(remote, contract, height, start, end)
 
@@ -249,10 +268,14 @@ def verify_blob(
     located = _locate_tx(remote, tx_hash)
     if located is None:
         return False
-    height, tx_index, sq = located
+    height, tx_index, n_txs, sq = located
     # pfb_index = position among the square's blob txs (block order keeps
     # normal txs first, then blob txs — square/builder.py find_tx_share_range).
-    n_txs = len(remote.block(height)["txs"])
     n_normal = n_txs - len(sq.wrapped_pfb_txs())
-    start, end = sq.blob_share_range(tx_index - n_normal, blob_index)
+    if tx_index < n_normal:
+        return False  # a committed tx, but not a blob tx: nothing to prove
+    try:
+        start, end = sq.blob_share_range(tx_index - n_normal, blob_index)
+    except KeyError:
+        return False  # blob_index out of range for this PFB
     return verify_shares(remote, contract, height, start, end)

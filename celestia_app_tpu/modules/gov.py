@@ -1,23 +1,71 @@
-"""Governance-lite: validator-voted parameter changes.
+"""Governance: the proposal lifecycle with celestia's paramfilter gate.
 
-The reference runs full cosmos-sdk x/gov with celestia's paramfilter wrapped
-around the param-change handler (x/paramfilter/gov_handler.go:36, blocklist
-wired at app/app.go:739-750).  This module keeps the governance surface that
-matters to the framework — propose a parameter change, vote by validator
-power, execute on majority — with the paramfilter gate enforced at both
-submission and execution.  Deposit/period machinery from the SDK is
-intentionally out: proposals here tally when asked.
+The reference runs cosmos-sdk x/gov v1 with celestia's overrides
+(app/default_overrides.go:192-199: MinDeposit 10,000 TIA, MaxDepositPeriod
+and VotingPeriod one week) and the paramfilter wrapped around the
+param-change handler (x/paramfilter/gov_handler.go:36, blocklist wired at
+app/app.go:739-750).  This module implements that lifecycle:
+
+  submit (escrow initial deposit) -> DEPOSIT_PERIOD
+    -> min deposit reached -> VOTING_PERIOD (one-week clock)
+    -> end blocker tallies at voting end: quorum 33.4%, threshold 50% of
+       non-abstain, veto 33.4% (sdk v1 tally defaults); deposits burned on
+       quorum failure / veto / deposit-period expiry, refunded otherwise
+       (sdk gov keeper/tally.go + abci.go semantics)
+    -> PASSED proposals execute their param changes through the registry,
+       re-checking the paramfilter blocklist at execution.
+
+Voting power is validator power (staking), matching how celestia governance
+is decided in practice; delegator-level votes are out of scope (PARITY.md).
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
+from enum import IntEnum
+from fractions import Fraction
 from typing import Callable
 
+from celestia_app_tpu.encoding.proto import (
+    WIRE_LEN,
+    WIRE_VARINT,
+    decode_fields,
+    encode_bytes_field,
+    encode_varint_field,
+)
 from celestia_app_tpu.modules.paramfilter import validate_param_changes
+from celestia_app_tpu.state.accounts import BankKeeper
 from celestia_app_tpu.state.dec import Dec
 from celestia_app_tpu.state.staking import StakingKeeper
 from celestia_app_tpu.state.store import KVStore
+
+# Celestia genesis overrides (default_overrides.go:197-199).
+DEFAULT_MIN_DEPOSIT = 10_000_000_000  # 10,000 TIA in utia
+WEEK_NS = 7 * 24 * 3600 * 10**9
+DEFAULT_MAX_DEPOSIT_PERIOD_NS = WEEK_NS
+DEFAULT_VOTING_PERIOD_NS = WEEK_NS
+
+# sdk x/gov v1 tally defaults (unchanged by celestia).
+QUORUM = Fraction(334, 1000)
+THRESHOLD = Fraction(1, 2)
+VETO_THRESHOLD = Fraction(334, 1000)
+
+GOV_MODULE = "gov"  # escrow account for deposits
+
+
+class ProposalStatus(IntEnum):
+    DEPOSIT_PERIOD = 1
+    VOTING_PERIOD = 2
+    PASSED = 3
+    REJECTED = 4
+    FAILED = 5  # passed the vote but the handler errored
+
+
+class VoteOption(IntEnum):
+    YES = 1
+    ABSTAIN = 2
+    NO = 3
+    NO_WITH_VETO = 4
 
 
 @dataclass(frozen=True)
@@ -27,6 +75,19 @@ class ParamChange:
     value: str
 
 
+@dataclass(frozen=True)
+class Proposal:
+    pid: int
+    proposer: str
+    changes: tuple[ParamChange, ...]
+    status: ProposalStatus
+    submit_time_ns: int
+    deposit_end_ns: int
+    voting_start_ns: int  # 0 until activated
+    voting_end_ns: int  # 0 until activated
+    total_deposit: int
+
+
 class GovError(ValueError):
     pass
 
@@ -34,6 +95,7 @@ class GovError(ValueError):
 def default_param_setters(store: KVStore) -> dict[tuple[str, str], Callable[[str], None]]:
     """The governance-settable parameter registry."""
     from celestia_app_tpu.modules.blob.params import BlobParamsKeeper
+    from celestia_app_tpu.modules.blobstream.keeper import set_data_commitment_window
     from celestia_app_tpu.modules.minfee import MinFeeKeeper
 
     blob = BlobParamsKeeper(store)
@@ -44,67 +106,292 @@ def default_param_setters(store: KVStore) -> dict[tuple[str, str], Callable[[str
         ("minfee", "NetworkMinGasPrice"): lambda v: minfee.set_network_min_gas_price(
             Dec.from_str(v)
         ),
+        ("blobstream", "DataCommitmentWindow"): lambda v: set_data_commitment_window(
+            store, int(v)
+        ),
     }
 
 
 class GovKeeper:
-    def __init__(self, store: KVStore, staking: StakingKeeper):
+    def __init__(
+        self,
+        store: KVStore,
+        staking: StakingKeeper,
+        bank: BankKeeper | None = None,
+        min_deposit: int = DEFAULT_MIN_DEPOSIT,
+        max_deposit_period_ns: int = DEFAULT_MAX_DEPOSIT_PERIOD_NS,
+        voting_period_ns: int = DEFAULT_VOTING_PERIOD_NS,
+    ):
         self.store = store
         self.staking = staking
+        self.bank = bank  # None = deposits tracked but not escrowed (unit tests)
+        self.min_deposit = min_deposit
+        self.max_deposit_period_ns = max_deposit_period_ns
+        self.voting_period_ns = voting_period_ns
         self._setters = default_param_setters(store)
 
-    # --- proposals ---------------------------------------------------------
+    # --- storage ------------------------------------------------------------
     def _next_id(self) -> int:
         raw = self.store.get(b"gov/next_id")
         n = int.from_bytes(raw, "big") if raw else 1
         self.store.set(b"gov/next_id", (n + 1).to_bytes(8, "big"))
         return n
 
-    def submit_param_change(self, proposer: str, changes: list[ParamChange]) -> int:
+    def _save(self, p: Proposal) -> None:
+        """Binary-safe proto-style record: user strings (proposer, param
+        values) are length-delimited, so no byte sequence in them can
+        corrupt the record (a \\x1e in a value halted the chain under the
+        earlier text format)."""
+        out = (
+            encode_varint_field(1, p.pid)
+            + encode_bytes_field(2, p.proposer.encode())
+            + encode_varint_field(3, int(p.status))
+            + encode_varint_field(4, p.submit_time_ns)
+            + encode_varint_field(5, p.deposit_end_ns)
+            + encode_varint_field(6, p.voting_start_ns)
+            + encode_varint_field(7, p.voting_end_ns)
+            + encode_varint_field(8, p.total_deposit)
+        )
+        for c in p.changes:
+            out += encode_bytes_field(
+                9,
+                encode_bytes_field(1, c.subspace.encode())
+                + encode_bytes_field(2, c.key.encode())
+                + encode_bytes_field(3, c.value.encode()),
+            )
+        self.store.set(f"gov/prop/{p.pid:016d}".encode(), out)
+        # Active index: end_blocker scans only live proposals (the sdk's
+        # Active/InactiveProposalQueue analog).
+        active_key = f"gov/active/{p.pid:016d}".encode()
+        if p.status in (ProposalStatus.DEPOSIT_PERIOD, ProposalStatus.VOTING_PERIOD):
+            self.store.set(active_key, b"\x01")
+        else:
+            self.store.delete(active_key)
+
+    def get_proposal(self, pid: int) -> Proposal:
+        raw = self.store.get(f"gov/prop/{pid:016d}".encode())
+        if raw is None:
+            raise GovError(f"no proposal {pid}")
+        ints = {num: val for num, wt, val in decode_fields(raw) if wt == WIRE_VARINT}
+        proposer = ""
+        changes: list[ParamChange] = []
+        for num, wt, val in decode_fields(raw):
+            if num == 2 and wt == WIRE_LEN:
+                proposer = val.decode()
+            elif num == 9 and wt == WIRE_LEN:
+                f = {cn: cv for cn, cwt, cv in decode_fields(val) if cwt == WIRE_LEN}
+                changes.append(
+                    ParamChange(
+                        f.get(1, b"").decode(), f.get(2, b"").decode(),
+                        f.get(3, b"").decode(),
+                    )
+                )
+        return Proposal(
+            ints.get(1, 0), proposer, tuple(changes),
+            ProposalStatus(ints.get(3, 1)), ints.get(4, 0), ints.get(5, 0),
+            ints.get(6, 0), ints.get(7, 0), ints.get(8, 0),
+        )
+
+    def proposals(self) -> list[Proposal]:
+        out = []
+        for key, _ in self.store.iterate(b"gov/prop/"):
+            out.append(self.get_proposal(int(key.rsplit(b"/", 1)[-1])))
+        return out
+
+    def active_proposals(self) -> list[Proposal]:
+        out = []
+        for key, _ in self.store.iterate(b"gov/active/"):
+            out.append(self.get_proposal(int(key.rsplit(b"/", 1)[-1])))
+        return out
+
+    def _delete_votes(self, pid: int) -> None:
+        prefix = f"gov/vote/{pid}/".encode()
+        for key, _ in self.store.iterate(prefix):
+            self.store.delete(key)
+
+    def _delete(self, pid: int) -> None:
+        self.store.delete(f"gov/prop/{pid:016d}".encode())
+        self.store.delete(f"gov/active/{pid:016d}".encode())
+        self._delete_votes(pid)
+        dep_prefix = f"gov/dep/{pid}/".encode()
+        for key, _ in self.store.iterate(dep_prefix):
+            self.store.delete(key)
+
+    # --- lifecycle ----------------------------------------------------------
+    def submit(
+        self,
+        proposer: str,
+        changes: list[ParamChange],
+        initial_deposit: int,
+        time_ns: int,
+    ) -> int:
+        """MsgSubmitProposal: validates against the paramfilter + registry,
+        escrows the initial deposit, and opens the deposit period (or goes
+        straight to voting when the deposit already meets the minimum)."""
         if not changes:
-            raise GovError("empty proposal")
+            raise GovError("proposal must contain at least one message")
         validate_param_changes([(c.subspace, c.key, c.value) for c in changes])
         for c in changes:
             if (c.subspace, c.key) not in self._setters:
                 raise GovError(f"unknown parameter {c.subspace}/{c.key}")
+        if initial_deposit < 0:
+            raise GovError("negative deposit")
         pid = self._next_id()
-        payload = "\x1e".join(f"{c.subspace}\x1f{c.key}\x1f{c.value}" for c in changes)
-        self.store.set(f"gov/prop/{pid}".encode(), payload.encode())
+        p = Proposal(
+            pid, proposer, tuple(changes), ProposalStatus.DEPOSIT_PERIOD,
+            time_ns, time_ns + self.max_deposit_period_ns, 0, 0, 0,
+        )
+        self._save(p)
+        if initial_deposit:
+            self._add_deposit(p, proposer, initial_deposit, time_ns)
         return pid
 
-    def _changes(self, proposal_id: int) -> list[ParamChange]:
-        raw = self.store.get(f"gov/prop/{proposal_id}".encode())
-        if raw is None:
-            raise GovError(f"no proposal {proposal_id}")
-        out = []
-        for rec in raw.decode().split("\x1e"):
-            subspace, key, value = rec.split("\x1f")
-            out.append(ParamChange(subspace, key, value))
-        return out
+    def _add_deposit(self, p: Proposal, depositor: str, amount: int, time_ns: int) -> None:
+        if self.bank is not None:
+            try:
+                self.bank.send(depositor, GOV_MODULE, amount)
+            except ValueError as e:
+                raise GovError(str(e)) from e
+        key = f"gov/dep/{p.pid}/{depositor}".encode()
+        prev = self.store.get(key)
+        total = (int.from_bytes(prev, "big") if prev else 0) + amount
+        self.store.set(key, total.to_bytes(16, "big"))
+        p = replace(p, total_deposit=p.total_deposit + amount)
+        if (
+            p.status == ProposalStatus.DEPOSIT_PERIOD
+            and p.total_deposit >= self.min_deposit
+        ):
+            p = replace(
+                p,
+                status=ProposalStatus.VOTING_PERIOD,
+                voting_start_ns=time_ns,
+                voting_end_ns=time_ns + self.voting_period_ns,
+            )
+        self._save(p)
 
-    # --- voting ------------------------------------------------------------
-    def vote(self, proposal_id: int, validator: str, approve: bool) -> None:
-        self._changes(proposal_id)  # existence check
+    def deposit(self, pid: int, depositor: str, amount: int, time_ns: int) -> None:
+        """MsgDeposit: only while the proposal is still collecting."""
+        p = self.get_proposal(pid)
+        if p.status not in (ProposalStatus.DEPOSIT_PERIOD, ProposalStatus.VOTING_PERIOD):
+            raise GovError(f"proposal {pid} no longer accepts deposits")
+        if amount <= 0:
+            raise GovError("deposit must be positive")
+        self._add_deposit(p, depositor, amount, time_ns)
+
+    def vote(self, pid: int, validator: str, option, time_ns: int | None = None) -> None:
+        """MsgVote: validator-power voting during the voting period.
+
+        `option` accepts a VoteOption or a bool (True=YES / False=NO, the
+        round-1 API kept for the expedited test path)."""
+        if isinstance(option, bool):
+            option = VoteOption.YES if option else VoteOption.NO
+        p = self.get_proposal(pid)
+        if p.status != ProposalStatus.VOTING_PERIOD:
+            raise GovError(f"proposal {pid} is not in its voting period")
+        if time_ns is not None and time_ns >= p.voting_end_ns:
+            raise GovError(f"voting period for proposal {pid} has ended")
         if not self.staking.has_validator(validator):
             raise GovError(f"no validator {validator}")
         self.store.set(
-            f"gov/vote/{proposal_id}/{validator}".encode(),
-            b"\x01" if approve else b"\x00",
+            f"gov/vote/{pid}/{validator}".encode(), bytes([int(option)])
         )
 
-    def tally_and_execute(self, proposal_id: int) -> bool:
-        """Execute the change set iff yes-power > half the total power."""
-        changes = self._changes(proposal_id)
-        yes = 0
-        prefix = f"gov/vote/{proposal_id}/".encode()
+    def _tally(self, pid: int) -> tuple[bool, bool]:
+        """(passes, burn_deposits) — sdk gov keeper/tally.go semantics:
+        no quorum -> fail+burn; veto > 1/3 of votes -> fail+burn;
+        yes <= 1/2 of non-abstain -> fail+refund; else pass+refund."""
+        power: dict[VoteOption, int] = {o: 0 for o in VoteOption}
+        prefix = f"gov/vote/{pid}/".encode()
         for key, val in self.store.iterate(prefix):
-            if val == b"\x01":
-                yes += self.staking.get_power(key[len(prefix) :].decode())
-        if 2 * yes <= self.staking.total_power():
+            addr = key[len(prefix):].decode()
+            power[VoteOption(val[0])] += self.staking.get_power(addr)
+        total_bonded = self.staking.total_power()
+        voted = sum(power.values())
+        if total_bonded == 0 or Fraction(voted, total_bonded) < QUORUM:
+            return False, True
+        if voted and Fraction(power[VoteOption.NO_WITH_VETO], voted) > VETO_THRESHOLD:
+            return False, True
+        non_abstain = voted - power[VoteOption.ABSTAIN]
+        if non_abstain == 0 or Fraction(power[VoteOption.YES], non_abstain) <= THRESHOLD:
+            return False, False
+        return True, False
+
+    def _settle_deposits(self, pid: int, burn: bool) -> None:
+        prefix = f"gov/dep/{pid}/".encode()
+        for key, val in self.store.iterate(prefix):
+            depositor = key[len(prefix):].decode()
+            amount = int.from_bytes(val, "big")
+            if self.bank is not None and amount:
+                if burn:
+                    self.bank.burn(GOV_MODULE, amount)
+                else:
+                    self.bank.send(GOV_MODULE, depositor, amount)
+            self.store.delete(key)
+
+    def _execute(self, p: Proposal) -> ProposalStatus:
+        try:
+            # Re-check the filter at execution (the blocklist is consensus law).
+            validate_param_changes(
+                [(c.subspace, c.key, c.value) for c in p.changes]
+            )
+            for c in p.changes:
+                self._setters[(c.subspace, c.key)](c.value)
+        except ValueError:
+            return ProposalStatus.FAILED
+        return ProposalStatus.PASSED
+
+    def end_blocker(self, time_ns: int) -> list[tuple]:
+        """gov abci.go: expire deposit periods (burn), tally ended voting
+        periods, execute passed proposals.  Returns lifecycle events."""
+        events: list[tuple] = []
+        for p in self.active_proposals():
+            if (
+                p.status == ProposalStatus.DEPOSIT_PERIOD
+                and time_ns > p.deposit_end_ns
+            ):
+                self._settle_deposits(p.pid, burn=True)
+                self._delete(p.pid)
+                events.append(("gov.proposal_dropped", p.pid))
+            elif (
+                p.status == ProposalStatus.VOTING_PERIOD
+                and time_ns >= p.voting_end_ns
+            ):
+                passes, burn = self._tally(p.pid)
+                self._settle_deposits(p.pid, burn=burn)
+                status = self._execute(p) if passes else ProposalStatus.REJECTED
+                self._save(replace(p, status=status))  # drops the active key
+                self._delete_votes(p.pid)
+                events.append((f"gov.proposal_{status.name.lower()}", p.pid))
+        return events
+
+    # --- round-1 expedited API (kept: unit tests drive tallies directly) ----
+    def submit_param_change(self, proposer: str, changes: list[ParamChange]) -> int:
+        """Submit with the minimum deposit pre-met: voting opens at t=0."""
+        pid = self.submit(proposer, changes, 0, 0)
+        p = self.get_proposal(pid)
+        self._save(
+            replace(
+                p,
+                status=ProposalStatus.VOTING_PERIOD,
+                voting_start_ns=0,
+                voting_end_ns=self.voting_period_ns,
+            )
+        )
+        return pid
+
+    def tally_and_execute(self, pid: int) -> bool:
+        """Force an immediate tally (test convenience; production goes
+        through end_blocker's clocks)."""
+        p = self.get_proposal(pid)
+        if p.status != ProposalStatus.VOTING_PERIOD:
+            raise GovError(f"proposal {pid} is not in its voting period")
+        passes, burn = self._tally(p.pid)
+        self._settle_deposits(p.pid, burn=burn)
+        if not passes:
+            self._save(replace(p, status=ProposalStatus.REJECTED))
             return False
-        # Re-check the filter at execution (the blocklist is consensus law).
-        validate_param_changes([(c.subspace, c.key, c.value) for c in changes])
-        for c in changes:
-            self._setters[(c.subspace, c.key)](c.value)
-        self.store.delete(f"gov/prop/{proposal_id}".encode())
+        status = self._execute(p)
+        if status == ProposalStatus.FAILED:
+            raise GovError(f"proposal {pid} execution failed")
+        self._delete(pid)
         return True

@@ -30,6 +30,7 @@ def deterministic_genesis(
     app_version: int = 2,
     n_validators: int = 3,
     gov_max_square_size: int = 64,
+    data_commitment_window: int = 0,
 ) -> Genesis:
     accounts = tuple(
         GenesisAccount(k.public_key().address(), DEFAULT_BALANCE, k.public_key().bytes)
@@ -50,6 +51,7 @@ def deterministic_genesis(
         validators=validators,
         app_version=app_version,
         gov_max_square_size=gov_max_square_size,
+        data_commitment_window=data_commitment_window,
     )
 
 

@@ -25,6 +25,10 @@ URL_MSG_PAY_FOR_BLOBS = "/celestia.blob.v1.MsgPayForBlobs"
 URL_MSG_SEND = "/cosmos.bank.v1beta1.MsgSend"
 URL_MSG_SIGNAL_VERSION = "/celestia.signal.v1.MsgSignalVersion"
 URL_MSG_TRY_UPGRADE = "/celestia.signal.v1.MsgTryUpgrade"
+URL_MSG_SUBMIT_PROPOSAL = "/cosmos.gov.v1beta1.MsgSubmitProposal"
+URL_MSG_VOTE = "/cosmos.gov.v1beta1.MsgVote"
+URL_MSG_DEPOSIT = "/cosmos.gov.v1beta1.MsgDeposit"
+URL_PARAM_CHANGE_PROPOSAL = "/cosmos.params.v1beta1.ParameterChangeProposal"
 
 
 @dataclass(frozen=True)
@@ -121,6 +125,12 @@ class MsgPayForBlobs:
     def to_any(self) -> Any:
         return Any(self.TYPE_URL, self.marshal())
 
+    def validate_basic(self) -> None:
+        """Stateless checks (x/blob/types/payforblob.go ValidateBasic)."""
+        from celestia_app_tpu.modules.blob.types import validate_msg_pay_for_blobs
+
+        validate_msg_pay_for_blobs(self)
+
 
 @dataclass(frozen=True)
 class MsgSend:
@@ -153,6 +163,18 @@ class MsgSend:
     def to_any(self) -> Any:
         return Any(self.TYPE_URL, self.marshal())
 
+    def validate_basic(self) -> None:
+        """Stateless checks (sdk bank MsgSend.ValidateBasic)."""
+        from celestia_app_tpu.crypto.keys import validate_address
+
+        validate_address(self.from_address)
+        validate_address(self.to_address)
+        if not self.amount:
+            raise ValueError("send amount must not be empty")
+        for c in self.amount:
+            if c.amount <= 0:
+                raise ValueError(f"send amount must be positive, got {c.amount}")
+
 
 @dataclass(frozen=True)
 class MsgSignalVersion:
@@ -181,6 +203,10 @@ class MsgSignalVersion:
     def to_any(self) -> Any:
         return Any(self.TYPE_URL, self.marshal())
 
+    def validate_basic(self) -> None:
+        if not self.validator_address:
+            raise ValueError("validator address must not be empty")
+
 
 @dataclass(frozen=True)
 class MsgTryUpgrade:
@@ -204,12 +230,201 @@ class MsgTryUpgrade:
     def to_any(self) -> Any:
         return Any(self.TYPE_URL, self.marshal())
 
+    def validate_basic(self) -> None:
+        from celestia_app_tpu.crypto.keys import validate_address
+
+        validate_address(self.signer)
+
+
+@dataclass(frozen=True)
+class ProposalParamChange:
+    """cosmos.params.v1beta1.ParamChange {subspace=1, key=2, value=3}."""
+
+    subspace: str
+    key: str
+    value: str
+
+    def marshal(self) -> bytes:
+        return (
+            encode_bytes_field(1, self.subspace.encode())
+            + encode_bytes_field(2, self.key.encode())
+            + encode_bytes_field(3, self.value.encode())
+        )
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "ProposalParamChange":
+        f = {num: val for num, wt, val in decode_fields(raw) if wt == WIRE_LEN}
+        return cls(
+            f.get(1, b"").decode(), f.get(2, b"").decode(), f.get(3, b"").decode()
+        )
+
+
+@dataclass(frozen=True)
+class MsgSubmitProposal:
+    """cosmos.gov.v1beta1.MsgSubmitProposal {content=1 (Any wrapping a
+    ParameterChangeProposal {title=1, description=2, changes=3}),
+    initial_deposit=2, proposer=3}."""
+
+    title: str
+    description: str
+    changes: tuple[ProposalParamChange, ...]
+    initial_deposit: tuple[Coin, ...]
+    proposer: str
+
+    TYPE_URL = URL_MSG_SUBMIT_PROPOSAL
+
+    def _content(self) -> Any:
+        body = encode_bytes_field(1, self.title.encode()) + encode_bytes_field(
+            2, self.description.encode()
+        )
+        for c in self.changes:
+            body += encode_bytes_field(3, c.marshal())
+        return Any(URL_PARAM_CHANGE_PROPOSAL, body)
+
+    def marshal(self) -> bytes:
+        out = encode_bytes_field(1, self._content().marshal())
+        for c in self.initial_deposit:
+            out += encode_bytes_field(2, c.marshal())
+        out += encode_bytes_field(3, self.proposer.encode())
+        return out
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgSubmitProposal":
+        title, description = "", ""
+        changes: list[ProposalParamChange] = []
+        deposit: list[Coin] = []
+        proposer = ""
+        for num, wt, val in decode_fields(raw):
+            if num == 1 and wt == WIRE_LEN:
+                content = Any.unmarshal(val)
+                if content.type_url != URL_PARAM_CHANGE_PROPOSAL:
+                    raise ValueError(
+                        f"unsupported proposal content {content.type_url}"
+                    )
+                for cn, cwt, cval in decode_fields(content.value):
+                    if cn == 1 and cwt == WIRE_LEN:
+                        title = cval.decode()
+                    elif cn == 2 and cwt == WIRE_LEN:
+                        description = cval.decode()
+                    elif cn == 3 and cwt == WIRE_LEN:
+                        changes.append(ProposalParamChange.unmarshal(cval))
+            elif num == 2 and wt == WIRE_LEN:
+                deposit.append(Coin.unmarshal(val))
+            elif num == 3 and wt == WIRE_LEN:
+                proposer = val.decode()
+        return cls(title, description, tuple(changes), tuple(deposit), proposer)
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+    @property
+    def signer(self) -> str:
+        return self.proposer
+
+    def validate_basic(self) -> None:
+        from celestia_app_tpu.crypto.keys import validate_address
+
+        validate_address(self.proposer)
+        for c in self.initial_deposit:
+            if c.amount < 0:
+                raise ValueError("negative deposit")
+
+
+@dataclass(frozen=True)
+class MsgVote:
+    """cosmos.gov.v1beta1.MsgVote {proposal_id=1, voter=2, option=3}."""
+
+    proposal_id: int
+    voter: str
+    option: int  # VoteOption numbering (1=yes 2=abstain 3=no 4=veto)
+
+    TYPE_URL = URL_MSG_VOTE
+
+    def marshal(self) -> bytes:
+        return (
+            encode_varint_field(1, self.proposal_id)
+            + encode_bytes_field(2, self.voter.encode())
+            + encode_varint_field(3, self.option)
+        )
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgVote":
+        pid, voter, option = 0, "", 0
+        for num, wt, val in decode_fields(raw):
+            if num == 1 and wt == WIRE_VARINT:
+                pid = val
+            elif num == 2 and wt == WIRE_LEN:
+                voter = val.decode()
+            elif num == 3 and wt == WIRE_VARINT:
+                option = val
+        return cls(pid, voter, option)
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+    @property
+    def signer(self) -> str:
+        return self.voter
+
+    def validate_basic(self) -> None:
+        if self.proposal_id <= 0:
+            raise ValueError("invalid proposal id")
+        if self.option not in (1, 2, 3, 4):
+            raise ValueError(f"invalid vote option {self.option}")
+
+
+@dataclass(frozen=True)
+class MsgDeposit:
+    """cosmos.gov.v1beta1.MsgDeposit {proposal_id=1, depositor=2, amount=3}."""
+
+    proposal_id: int
+    depositor: str
+    amount: tuple[Coin, ...]
+
+    TYPE_URL = URL_MSG_DEPOSIT
+
+    def marshal(self) -> bytes:
+        out = encode_varint_field(1, self.proposal_id)
+        out += encode_bytes_field(2, self.depositor.encode())
+        for c in self.amount:
+            out += encode_bytes_field(3, c.marshal())
+        return out
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgDeposit":
+        pid, depositor = 0, ""
+        coins: list[Coin] = []
+        for num, wt, val in decode_fields(raw):
+            if num == 1 and wt == WIRE_VARINT:
+                pid = val
+            elif num == 2 and wt == WIRE_LEN:
+                depositor = val.decode()
+            elif num == 3 and wt == WIRE_LEN:
+                coins.append(Coin.unmarshal(val))
+        return cls(pid, depositor, tuple(coins))
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+    @property
+    def signer(self) -> str:
+        return self.depositor
+
+    def validate_basic(self) -> None:
+        if self.proposal_id <= 0:
+            raise ValueError("invalid proposal id")
+        if not self.amount or any(c.amount <= 0 for c in self.amount):
+            raise ValueError("deposit must be positive")
+
 
 MSG_DECODERS = {
     URL_MSG_PAY_FOR_BLOBS: MsgPayForBlobs.unmarshal,
     URL_MSG_SEND: MsgSend.unmarshal,
     URL_MSG_SIGNAL_VERSION: MsgSignalVersion.unmarshal,
     URL_MSG_TRY_UPGRADE: MsgTryUpgrade.unmarshal,
+    URL_MSG_SUBMIT_PROPOSAL: MsgSubmitProposal.unmarshal,
+    URL_MSG_VOTE: MsgVote.unmarshal,
+    URL_MSG_DEPOSIT: MsgDeposit.unmarshal,
 }
 
 

@@ -93,8 +93,14 @@ class SignerInfo:
 
 @dataclass(frozen=True)
 class TxBody:
+    """cosmos tx.proto TxBody: messages=1, memo=2, timeout_height=3,
+    extension_options=1023, non_critical_extension_options=2047."""
+
     messages: tuple[Any, ...]
     memo: str = ""
+    timeout_height: int = 0
+    extension_options: tuple[Any, ...] = ()
+    non_critical_extension_options: tuple[Any, ...] = ()
 
     def marshal(self) -> bytes:
         out = b""
@@ -102,18 +108,33 @@ class TxBody:
             out += encode_bytes_field(1, m.marshal())
         if self.memo:
             out += encode_bytes_field(2, self.memo.encode())
+        if self.timeout_height:
+            out += encode_varint_field(3, self.timeout_height)
+        for e in self.extension_options:
+            out += encode_bytes_field(1023, e.marshal())
+        for e in self.non_critical_extension_options:
+            out += encode_bytes_field(2047, e.marshal())
         return out
 
     @classmethod
     def unmarshal(cls, raw: bytes) -> "TxBody":
         msgs: list[Any] = []
         memo = ""
+        timeout_height = 0
+        ext: list[Any] = []
+        non_critical: list[Any] = []
         for num, wt, val in decode_fields(raw):
             if num == 1 and wt == WIRE_LEN:
                 msgs.append(Any.unmarshal(val))
             elif num == 2 and wt == WIRE_LEN:
                 memo = val.decode()
-        return cls(tuple(msgs), memo)
+            elif num == 3 and wt == WIRE_VARINT:
+                timeout_height = val
+            elif num == 1023 and wt == WIRE_LEN:
+                ext.append(Any.unmarshal(val))
+            elif num == 2047 and wt == WIRE_LEN:
+                non_critical.append(Any.unmarshal(val))
+        return cls(tuple(msgs), memo, timeout_height, tuple(ext), tuple(non_critical))
 
 
 @dataclass(frozen=True)
@@ -212,9 +233,10 @@ def build_and_sign(
     sequence: int,
     fee: Fee,
     memo: str = "",
+    timeout_height: int = 0,
 ) -> bytes:
     """Construct and sign a tx; returns the TxRaw bytes."""
-    body = TxBody(tuple(m.to_any() for m in msgs), memo)
+    body = TxBody(tuple(m.to_any() for m in msgs), memo, timeout_height)
     auth = AuthInfo((SignerInfo(key.public_key(), sequence),), fee)
     body_bytes = body.marshal()
     auth_bytes = auth.marshal()

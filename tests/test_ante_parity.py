@@ -1,0 +1,376 @@
+"""Per-decorator ante parity: one rejection test per reference decorator.
+
+Reference chain: app/ante/ante.go:15-82, 19 decorators.  The PARITY.md
+§ante table maps each row to the behavior exercised here.  Every test
+submits through the real CheckTx/deliver surface so the rejection travels
+the same path a reference node's would.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.app import App
+from celestia_app_tpu.app.ante import AnteError, run_ante
+from celestia_app_tpu.app.app import Ctx
+from celestia_app_tpu.app.gas import (
+    GasMeter,
+    OutOfGas,
+    SIG_VERIFY_COST_SECP256K1,
+    TX_SIZE_COST_PER_BYTE,
+)
+from celestia_app_tpu.crypto import PrivateKey
+from celestia_app_tpu.modules.blob.types import estimate_gas, new_msg_pay_for_blobs
+from celestia_app_tpu.shares.namespace import Namespace
+from celestia_app_tpu.shares.sparse import Blob
+from celestia_app_tpu.state.dec import Dec
+from celestia_app_tpu.testutil import TestNode, deterministic_genesis, funded_keys
+from celestia_app_tpu.tx.envelopes import BlobTx
+from celestia_app_tpu.tx.messages import Any, Coin, MsgSend, MsgSignalVersion
+from celestia_app_tpu.tx.sign import (
+    AuthInfo,
+    Fee,
+    SignerInfo,
+    Tx,
+    TxBody,
+    build_and_sign,
+    sign_doc_bytes,
+)
+
+RNG = np.random.default_rng(7)
+
+
+@pytest.fixture()
+def node() -> TestNode:
+    return TestNode()
+
+
+def _account(node, addr):
+    from celestia_app_tpu.state.accounts import AuthKeeper
+
+    return AuthKeeper(node.app.cms.working).get_account(addr)
+
+
+def _sign_body(node, key, body: TxBody, fee: Fee, seq: int) -> bytes:
+    """Sign an arbitrary TxBody (lets tests inject memo/timeout/extensions)."""
+    acct = _account(node, key.public_key().address())
+    auth = AuthInfo((SignerInfo(key.public_key(), seq),), fee)
+    body_bytes, auth_bytes = body.marshal(), auth.marshal()
+    doc = sign_doc_bytes(body_bytes, auth_bytes, node.chain_id, acct.account_number)
+    return Tx(body_bytes, auth_bytes, (key.sign(doc),)).marshal()
+
+
+def _send_body(node, key, **kw) -> TxBody:
+    addr = key.public_key().address()
+    msg = MsgSend(addr, node.keys[1].public_key().address(), (Coin("utia", 5),))
+    return TxBody((msg.to_any(),), **kw)
+
+
+FEE = Fee((Coin("utia", 20_000),), 100_000)
+
+
+class TestDecoratorRejections:
+    # 1 — HandlePanicDecorator: internal faults reject, never crash.
+    def test_1_panic_contained(self, node):
+        class Boom:
+            def msgs(self):
+                raise RuntimeError("kernel exploded")
+
+        ctx = Ctx(node.app.cms.working.branch(), 1, 0, node.app.app_version)
+        with pytest.raises(AnteError, match="internal error"):
+            run_ante(node.app, ctx, Boom(), is_check_tx=True)
+
+    # 2 — MsgVersioningGateKeeper: signal msgs rejected at app version 1.
+    def test_2_version_gate(self):
+        keys = funded_keys(2)
+        v1node = TestNode(deterministic_genesis(keys, app_version=1), keys)
+        msg = MsgSignalVersion(keys[0].public_key().address(), 2)
+        acct = _account(v1node, keys[0].public_key().address())
+        raw = build_and_sign([msg], keys[0], v1node.chain_id, acct.account_number, 0, FEE)
+        res = v1node.app.check_tx(raw)
+        assert res.code != 0 and "not allowed at app version 1" in res.log
+
+    # 3 — SetUpContextDecorator: gas meter installed; overflow rejects.
+    def test_3_out_of_gas(self, node):
+        key = node.keys[0]
+        # Gas limit below even the tx-size charge.
+        tiny = Fee((Coin("utia", 20_000),), 60)
+        raw = _sign_body(node, key, _send_body(node, key), tiny, 0)
+        res = node.app.check_tx(raw)
+        assert res.code != 0 and "out of gas" in res.log
+
+    def test_3b_meter_arithmetic(self):
+        m = GasMeter(100)
+        m.consume(60, "a")
+        assert m.remaining() == 40
+        with pytest.raises(OutOfGas):
+            m.consume(41, "b")
+
+    # 4 — ExtensionOptionsDecorator: critical extension options reject.
+    def test_4_extension_options(self, node):
+        key = node.keys[0]
+        body = _send_body(node, key, extension_options=(Any("/test.Ext", b"x"),))
+        raw = _sign_body(node, key, body, FEE, 0)
+        res = node.app.check_tx(raw)
+        assert res.code != 0 and "extension options" in res.log
+
+    def test_4b_non_critical_pass(self, node):
+        key = node.keys[0]
+        body = _send_body(
+            node, key, non_critical_extension_options=(Any("/test.Nce", b"x"),)
+        )
+        raw = _sign_body(node, key, body, FEE, 0)
+        assert node.app.check_tx(raw).code == 0
+
+    # 5 — ValidateBasicDecorator: stateless msg validation.
+    def test_5_validate_basic(self, node):
+        key = node.keys[0]
+        bad = MsgSend(key.public_key().address(), "not-an-address", (Coin("utia", 5),))
+        acct = _account(node, key.public_key().address())
+        raw = build_and_sign([bad], key, node.chain_id, acct.account_number, 0, FEE)
+        res = node.app.check_tx(raw)
+        assert res.code != 0
+
+        zero = MsgSend(
+            key.public_key().address(),
+            node.keys[1].public_key().address(),
+            (Coin("utia", 0),),
+        )
+        raw = build_and_sign([zero], key, node.chain_id, acct.account_number, 0, FEE)
+        res = node.app.check_tx(raw)
+        assert res.code != 0 and "positive" in res.log
+
+    # 6 — TxTimeoutHeightDecorator.
+    def test_6_timeout_height(self, node):
+        key = node.keys[0]
+        node.produce_block()
+        node.produce_block()  # height 2; next tx evaluated at height 3
+        body = _send_body(node, key, timeout_height=1)
+        raw = _sign_body(node, key, body, FEE, 0)
+        res = node.app.check_tx(raw)
+        assert res.code != 0 and "timeout height" in res.log
+
+    # 7 — ValidateMemoDecorator: memo over 256 chars.
+    def test_7_memo_too_long(self, node):
+        key = node.keys[0]
+        body = _send_body(node, key, memo="m" * 257)
+        raw = _sign_body(node, key, body, FEE, 0)
+        res = node.app.check_tx(raw)
+        assert res.code != 0 and "256" in res.log
+
+    # 8 — ConsumeGasForTxSizeDecorator: size gas lands in gas_used.
+    def test_8_tx_size_gas_metered(self, node):
+        key = node.keys[0]
+        raw = _sign_body(node, key, _send_body(node, key), FEE, 0)
+        assert node.broadcast(raw).code == 0
+        _, results = node.produce_block()
+        assert len(results) == 1 and results[0].code == 0
+        expected = len(raw) * TX_SIZE_COST_PER_BYTE + SIG_VERIFY_COST_SECP256K1
+        assert results[0].gas_used == expected
+
+    # 9 — DeductFeeDecorator / ValidateTxFee: network min gas price.
+    def test_9_network_min_gas_price(self, node):
+        key = node.keys[0]
+        free = Fee((), 100_000)  # zero fee < network min 0.000001
+        raw = _sign_body(node, key, _send_body(node, key), free, 0)
+        res = node.app.check_tx(raw)
+        assert res.code != 0 and "insufficient fees" in res.log
+
+    def test_9b_fee_precedes_sig_errors(self, node):
+        """DeductFee (ante.go:46-49) runs before SigVerification (:60-63):
+        an underfunded fee payer reports insufficient funds even when the
+        sequence is also wrong."""
+        key = node.keys[0]
+        huge = Fee((Coin("utia", 10**18),), 100_000)
+        body = _send_body(node, key)
+        raw = _sign_body(node, key, body, huge, 5)  # wrong seq AND unpayable fee
+        res = node.app.check_tx(raw)
+        assert res.code != 0 and "insufficient" in res.log.lower()
+
+    # 10 — SetPubKeyDecorator: pubkey persisted on first use.
+    def test_10_pubkey_persisted(self):
+        keys = funded_keys(2)
+        genesis = deterministic_genesis(keys)
+        # Strip genesis pubkeys so the ante must set one.
+        from dataclasses import replace
+
+        genesis = replace(
+            genesis,
+            accounts=tuple(replace(a, pubkey=b"") for a in genesis.accounts),
+        )
+        n = TestNode(genesis, keys)
+        assert _account(n, keys[0].public_key().address()).pubkey == b""
+        raw = _sign_body(n, keys[0], _send_body(n, keys[0]), FEE, 0)
+        assert n.broadcast(raw).code == 0
+        n.produce_block()
+        assert (
+            _account(n, keys[0].public_key().address()).pubkey
+            == keys[0].public_key().bytes
+        )
+
+    # 11 — ValidateSigCountDecorator (single-signer rule here).
+    def test_11_multi_signer_rejected(self, node):
+        key, key2 = node.keys[0], node.keys[1]
+        body = _send_body(node, key)
+        acct = _account(node, key.public_key().address())
+        auth = AuthInfo(
+            (SignerInfo(key.public_key(), 0), SignerInfo(key2.public_key(), 0)), FEE
+        )
+        body_bytes, auth_bytes = body.marshal(), auth.marshal()
+        doc = sign_doc_bytes(body_bytes, auth_bytes, node.chain_id, acct.account_number)
+        raw = Tx(body_bytes, auth_bytes, (key.sign(doc), key2.sign(doc))).marshal()
+        res = node.app.check_tx(raw)
+        assert res.code != 0 and "one signer" in res.log
+
+    # 12 — SigGasConsumeDecorator: covered by test_8's exact arithmetic
+    # (SIG_VERIFY_COST_SECP256K1 included); here: gas limit that covers tx
+    # size but not sig gas still rejects.
+    def test_12_sig_gas(self, node):
+        key = node.keys[0]
+        body = _send_body(node, key)
+        probe = _sign_body(node, key, body, FEE, 0)
+        limit = len(probe) * TX_SIZE_COST_PER_BYTE + SIG_VERIFY_COST_SECP256K1 - 1
+        raw = _sign_body(node, key, body, Fee((Coin("utia", 20_000),), limit), 0)
+        # Re-signing with a different fee changes the tx length a hair; the
+        # limit is recomputed against the actual bytes to stay just short.
+        limit = len(raw) * TX_SIZE_COST_PER_BYTE + SIG_VERIFY_COST_SECP256K1 - 1
+        raw = _sign_body(node, key, body, Fee((Coin("utia", 20_000),), limit), 0)
+        res = node.app.check_tx(raw)
+        assert res.code != 0 and "out of gas" in res.log
+
+    # 13 — SigVerificationDecorator: bad signature, bad sequence.
+    def test_13_bad_signature(self, node):
+        key, other = node.keys[0], node.keys[1]
+        body = _send_body(node, key)
+        acct = _account(node, key.public_key().address())
+        auth = AuthInfo((SignerInfo(key.public_key(), 0),), FEE)
+        body_bytes, auth_bytes = body.marshal(), auth.marshal()
+        doc = sign_doc_bytes(body_bytes, auth_bytes, node.chain_id, acct.account_number)
+        raw = Tx(body_bytes, auth_bytes, (other.sign(doc),)).marshal()
+        res = node.app.check_tx(raw)
+        assert res.code != 0 and "signature verification failed" in res.log
+
+    def test_13b_sequence_mismatch(self, node):
+        key = node.keys[0]
+        raw = _sign_body(node, key, _send_body(node, key), FEE, 3)
+        res = node.app.check_tx(raw)
+        assert res.code != 0 and "sequence mismatch" in res.log
+
+    # 14 — MinGasPFBDecorator: gas limit below blob gas.
+    def test_14_min_gas_pfb(self, node):
+        key = node.keys[0]
+        blob = Blob(Namespace.v0(b"\x07" * 10), b"z" * 5000)
+        addr = key.public_key().address()
+        msg = new_msg_pay_for_blobs(addr, [blob])
+        acct = _account(node, addr)
+        fee = Fee((Coin("utia", 30_000),), 30_000)  # < blob gas for 5000B
+        raw_tx = build_and_sign([msg], key, node.chain_id, acct.account_number, 0, fee)
+        res = node.app.check_tx(BlobTx(raw_tx, (blob,)).marshal())
+        assert res.code != 0 and "insufficient for blobs" in res.log
+
+    # 15 — MaxTotalBlobSizeDecorator (v1 byte cap).
+    def test_15_v1_total_blob_size(self):
+        keys = funded_keys(2)
+        n = TestNode(
+            deterministic_genesis(keys, app_version=1, gov_max_square_size=4), keys
+        )
+        blob = Blob(Namespace.v0(b"\x08" * 10), b"x" * 60_000)  # >> 4x4 square bytes
+        addr = keys[0].public_key().address()
+        msg = new_msg_pay_for_blobs(addr, [blob])
+        acct = _account(n, addr)
+        gas = estimate_gas([len(blob.data)])
+        raw_tx = build_and_sign([msg], keys[0], n.chain_id, acct.account_number, 0,
+                                Fee((Coin("utia", gas),), gas))
+        res = n.app.check_tx(BlobTx(raw_tx, (blob,)).marshal())
+        assert res.code != 0 and "total blob size" in res.log
+
+    # 16 — BlobShareDecorator (v2 share cap).
+    def test_16_v2_blob_shares(self):
+        keys = funded_keys(2)
+        n = TestNode(deterministic_genesis(keys, gov_max_square_size=4), keys)
+        blob = Blob(Namespace.v0(b"\x09" * 10), b"x" * 60_000)
+        addr = keys[0].public_key().address()
+        msg = new_msg_pay_for_blobs(addr, [blob])
+        acct = _account(n, addr)
+        gas = estimate_gas([len(blob.data)])
+        raw_tx = build_and_sign([msg], keys[0], n.chain_id, acct.account_number, 0,
+                                Fee((Coin("utia", gas),), gas))
+        res = n.app.check_tx(BlobTx(raw_tx, (blob,)).marshal())
+        assert res.code != 0 and "shares" in res.log
+
+    # 17 — GovProposalDecorator: an empty MsgSubmitProposal dies in the
+    # ante chain, over the real CheckTx surface.
+    def test_17_empty_proposal_rejected(self, node):
+        from celestia_app_tpu.tx.messages import MsgSubmitProposal
+
+        key = node.keys[0]
+        msg = MsgSubmitProposal(
+            "t", "d", (), (Coin("utia", 100),), key.public_key().address()
+        )
+        acct = _account(node, key.public_key().address())
+        raw = build_and_sign([msg], key, node.chain_id, acct.account_number, 0, FEE)
+        res = node.app.check_tx(raw)
+        assert res.code != 0 and "at least one message" in res.log
+
+    # 18 — IncrementSequenceDecorator: replay of the same tx rejects.
+    def test_18_sequence_incremented(self, node):
+        key = node.keys[0]
+        raw = _sign_body(node, key, _send_body(node, key), FEE, 0)
+        assert node.app.check_tx(raw).code == 0
+        res = node.app.check_tx(raw)  # same sequence again, same check state
+        assert res.code != 0 and "sequence mismatch" in res.log
+
+    # 19 — RedundantRelayDecorator: covered in the IBC module tests
+    # (tests/test_ibc.py) where relay msgs exist.
+
+
+class TestFailedDelivery:
+    def test_failed_msg_still_pays_fee_and_bumps_sequence(self, node):
+        """baseapp parity: ante effects commit before runMsgs (msCache.Write),
+        so a tx whose message fails still pays its fee and consumes the
+        sequence — it cannot be replayed for free."""
+        key = node.keys[0]
+        addr = key.public_key().address()
+        from celestia_app_tpu.state.accounts import BankKeeper
+
+        bal0 = BankKeeper(node.app.cms.working).balance(addr)
+        # Send far more than the balance: ante passes (fee covered), the
+        # bank transfer itself fails at delivery.
+        msg = MsgSend(addr, node.keys[1].public_key().address(),
+                      (Coin("utia", bal0 * 10),))
+        body = TxBody((msg.to_any(),))
+        raw = _sign_body(node, key, body, FEE, 0)
+        assert node.broadcast(raw).code == 0  # admission can't see the future
+        _, results = node.produce_block()
+        assert len(results) == 1 and results[0].code == 2
+        bal1 = BankKeeper(node.app.cms.working).balance(addr)
+        assert bal1 == bal0 - 20_000  # fee charged despite failure
+        assert _account(node, addr).sequence == 1  # sequence consumed
+        # Replaying the identical bytes now fails on sequence.
+        res = node.app.check_tx(raw)
+        assert res.code != 0 and "sequence mismatch" in res.log
+
+
+class TestGasAccounting:
+    def test_pfb_gas_used_includes_ante_and_blob_gas(self, node):
+        from celestia_app_tpu.modules.blob.types import gas_to_consume
+
+        key = node.keys[0]
+        blob = Blob(Namespace.v0(b"\x0a" * 10), b"q" * 2000)
+        addr = key.public_key().address()
+        msg = new_msg_pay_for_blobs(addr, [blob])
+        acct = _account(node, addr)
+        gas = estimate_gas([len(blob.data)])
+        raw_tx = build_and_sign([msg], key, node.chain_id, acct.account_number, 0,
+                                Fee((Coin("utia", gas),), gas))
+        assert node.broadcast(BlobTx(raw_tx, (blob,)).marshal()).code == 0
+        _, results = node.produce_block()
+        ok = [r for r in results if r.code == 0]
+        assert len(ok) == 1
+        blob_gas = gas_to_consume((len(blob.data),), node.app.gas_per_blob_byte)
+        expected = (
+            len(raw_tx) * TX_SIZE_COST_PER_BYTE + SIG_VERIFY_COST_SECP256K1 + blob_gas
+        )
+        assert ok[0].gas_used == expected
+        assert ok[0].gas_used <= ok[0].gas_wanted

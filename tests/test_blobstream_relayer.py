@@ -166,6 +166,94 @@ class TestContract:
         )
 
 
+def _genesis_contract(remote):
+    """Contract registered with the chain's genesis valset; orchestrator keys
+    are the deterministic validator seeds."""
+    vs = remote.latest_valset_before(remote.blobstream_nonces()["latest"])
+    members = tuple(BridgeValidator(m["address"], m["power"]) for m in vs["members"])
+    seeds = {
+        PrivateKey.from_seed(f"validator-{i}".encode())
+        .public_key()
+        .address(): PrivateKey.from_seed(f"validator-{i}".encode())
+        for i in range(3)
+    }
+    pubs = {addr: k.public_key() for addr, k in seeds.items()}
+    contract = BlobstreamContract(vs["nonce"], members, pubs)
+    orchestrators = [Orchestrator(addr, k) for addr, k in seeds.items()]
+    return contract, orchestrators
+
+
+class TestValsetRotation:
+    """A validator-set change mid-chain must be registered in the contract
+    before later data commitments verify (the reference relayer sequences
+    updateValidatorSet before submitDataRootTupleRoot)."""
+
+    def test_valset_update_relayed_in_nonce_order(self):
+        keys = funded_keys(2)
+        genesis = deterministic_genesis(
+            keys, app_version=1, n_validators=3, data_commitment_window=5
+        )
+        node = ServingNode(genesis=genesis, keys=keys)
+        server = serve(node, port=0, block_interval_s=None)
+        try:
+            remote = RemoteNode(server.url)
+            for _ in range(5):
+                node.produce_block()  # valset nonce 1 + DC nonce 2 [1,6)
+            contract, orchestrators = _genesis_contract(remote)
+            assert relay_pending(remote, contract, orchestrators) == 1
+
+            # >5% normalized power shift -> new valset next block.
+            v0 = PrivateKey.from_seed(b"validator-0").public_key()
+            sk = StakingKeeper(node.app.cms.working)
+            sk.set_validator(Validator(v0.address(), v0.bytes, power=400))
+            node.produce_block()  # valset nonce 3
+            for _ in range(5):
+                node.produce_block()  # DC nonce 4 [6,11) at height 11
+
+            assert relay_pending(remote, contract, orchestrators) == 1
+            assert contract.valset_nonce == 3  # rotated before DC 4
+            assert {m.power for m in contract.members} == {400, 100}
+            assert 4 in contract.tuple_roots
+
+            # Shares from the second window verify against the rotated set.
+            assert verify_shares(remote, contract, 7, 0, 1)
+        finally:
+            server.stop()
+
+    def test_verify_blob_of_non_blob_tx_is_false(self):
+        keys = funded_keys(2)
+        genesis = deterministic_genesis(
+            keys, app_version=1, n_validators=3, data_commitment_window=5
+        )
+        node = ServingNode(genesis=genesis, keys=keys)
+        server = serve(node, port=0, block_interval_s=None)
+        try:
+            remote = RemoteNode(server.url)
+            from celestia_app_tpu.state.accounts import AuthKeeper
+            from celestia_app_tpu.tx.messages import Coin, MsgSend
+            from celestia_app_tpu.tx.sign import Fee, build_and_sign
+            from celestia_app_tpu.user import Signer
+
+            addr = keys[0].public_key().address()
+            acc = AuthKeeper(node.app.cms.working).get_account(addr)
+            raw = build_and_sign(
+                [MsgSend(addr, keys[1].public_key().address(), (Coin("utia", 5),))],
+                keys[0], node.chain_id, acc.account_number, acc.sequence,
+                Fee((Coin("utia", 20_000),), 100_000),
+            )
+            assert node.broadcast(raw).code == 0
+            node.produce_block()
+            for _ in range(5):
+                node.produce_block()
+            contract, orchestrators = _genesis_contract(remote)
+            relay_pending(remote, contract, orchestrators)
+            # A committed MsgSend is a tx, not a blob: False, not a crash.
+            assert not verify_blob(remote, contract, tx_hash(raw), 0)
+            assert verify_tx(remote, contract, tx_hash(raw))
+        finally:
+            server.stop()
+
+
 @pytest.mark.slow
 class TestRelayerEndToEnd:
     """A blob proven inside a 400-block window, fully over the wire."""
@@ -204,22 +292,6 @@ class TestRelayerEndToEnd:
         yield node, remote, tx_hash(raw), blob_height
         server.stop()
 
-    def _contract_for(self, node, remote):
-        """Contract registered with the chain's genesis valset; orchestrator
-        keys are the deterministic validator seeds of the genesis."""
-        vs = remote.latest_valset_before(remote.blobstream_nonces()["latest"])
-        members = tuple(BridgeValidator(m["address"], m["power"]) for m in vs["members"])
-        seeds = {
-            PrivateKey.from_seed(f"validator-{i}".encode())
-            .public_key()
-            .address(): PrivateKey.from_seed(f"validator-{i}".encode())
-            for i in range(3)
-        }
-        pubs = {addr: k.public_key() for addr, k in seeds.items()}
-        contract = BlobstreamContract(vs["nonce"], members, pubs)
-        orchestrators = [Orchestrator(addr, k) for addr, k in seeds.items()]
-        return contract, orchestrators
-
     def test_attestations_served(self, chain):
         _, remote, _, _ = chain
         nonces = remote.blobstream_nonces()
@@ -232,7 +304,7 @@ class TestRelayerEndToEnd:
 
     def test_blob_proven_in_400_block_window(self, chain):
         node, remote, blob_tx_hash, _ = chain
-        contract, orchestrators = self._contract_for(node, remote)
+        contract, orchestrators = _genesis_contract(remote)
         assert relay_pending(remote, contract, orchestrators) == 1
 
         # The reference's `verify blob` / `verify tx` flows, over the wire.
@@ -241,7 +313,7 @@ class TestRelayerEndToEnd:
 
     def test_tampered_proof_rejected(self, chain):
         node, remote, blob_tx_hash, blob_height = chain
-        contract, orchestrators = self._contract_for(node, remote)
+        contract, orchestrators = _genesis_contract(remote)
         relay_pending(remote, contract, orchestrators)
 
         dc = remote.data_commitment_range(blob_height)
@@ -259,7 +331,7 @@ class TestRelayerEndToEnd:
 
     def test_shares_range_verifies(self, chain):
         node, remote, _, blob_height = chain
-        contract, orchestrators = self._contract_for(node, remote)
+        contract, orchestrators = _genesis_contract(remote)
         relay_pending(remote, contract, orchestrators)
         block = remote.block(blob_height)
         assert verify_shares(remote, contract, blob_height, 0, 1)

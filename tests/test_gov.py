@@ -1,11 +1,27 @@
-"""Governance-lite + on-chain blob params tests."""
+"""Governance: proposal lifecycle (deposits, voting periods, tally) +
+on-chain blob params.
+
+Reference: cosmos-sdk x/gov v1 with celestia overrides
+(app/default_overrides.go:192-199) and the paramfilter gate
+(x/paramfilter/gov_handler.go:36).
+"""
 
 import pytest
 
 from celestia_app_tpu.modules.blob.params import BlobParamsKeeper
-from celestia_app_tpu.modules.gov import GovError, GovKeeper, ParamChange
+from celestia_app_tpu.modules.gov import (
+    DEFAULT_MIN_DEPOSIT,
+    GOV_MODULE,
+    GovError,
+    GovKeeper,
+    ParamChange,
+    ProposalStatus,
+    VoteOption,
+    WEEK_NS,
+)
 from celestia_app_tpu.modules.minfee import MinFeeKeeper
 from celestia_app_tpu.modules.paramfilter import ForbiddenParamError
+from celestia_app_tpu.state.accounts import BankKeeper
 from celestia_app_tpu.state.staking import StakingKeeper, Validator
 from celestia_app_tpu.state.store import KVStore
 from celestia_app_tpu.testutil import TestNode
@@ -17,6 +33,143 @@ def make_gov(powers: dict[str, int]):
     for a, p in powers.items():
         staking.set_validator(Validator(a, b"", p))
     return GovKeeper(store, staking), store
+
+
+def make_gov_with_bank(powers: dict[str, int], balances: dict[str, int]):
+    store = KVStore()
+    staking = StakingKeeper(store)
+    for a, p in powers.items():
+        staking.set_validator(Validator(a, b"", p))
+    bank = BankKeeper(store)
+    for a, amt in balances.items():
+        bank.mint(a, amt)
+    return GovKeeper(store, staking, bank), store, bank
+
+
+CHANGE = ParamChange("blob", "GasPerBlobByte", "16")
+
+
+class TestLifecycle:
+    def test_deposit_period_then_voting(self):
+        gov, store, bank = make_gov_with_bank(
+            {"v1": 100}, {"alice": 20_000_000_000, "bob": 20_000_000_000}
+        )
+        pid = gov.submit("alice", [CHANGE], 4_000_000_000, time_ns=0)
+        p = gov.get_proposal(pid)
+        assert p.status == ProposalStatus.DEPOSIT_PERIOD
+        assert p.deposit_end_ns == WEEK_NS
+        assert bank.balance("alice") == 16_000_000_000  # escrowed
+        assert bank.balance(GOV_MODULE) == 4_000_000_000
+
+        # Top-up from a second depositor crosses the 10,000 TIA minimum.
+        gov.deposit(pid, "bob", 6_000_000_000, time_ns=1_000)
+        p = gov.get_proposal(pid)
+        assert p.status == ProposalStatus.VOTING_PERIOD
+        assert p.total_deposit == DEFAULT_MIN_DEPOSIT
+        assert p.voting_end_ns == 1_000 + WEEK_NS
+
+    def test_deposit_period_expiry_burns(self):
+        gov, store, bank = make_gov_with_bank({"v1": 100}, {"alice": 20_000_000_000})
+        supply0 = bank.supply()
+        pid = gov.submit("alice", [CHANGE], 1_000_000_000, time_ns=0)
+        events = gov.end_blocker(time_ns=WEEK_NS + 1)
+        assert events == [("gov.proposal_dropped", pid)]
+        with pytest.raises(GovError):
+            gov.get_proposal(pid)
+        assert bank.balance("alice") == 19_000_000_000  # deposit NOT refunded
+        assert bank.supply() == supply0 - 1_000_000_000  # burned
+
+    def test_full_pass_refunds_and_executes(self):
+        gov, store, bank = make_gov_with_bank(
+            {"v1": 60, "v2": 40}, {"alice": 20_000_000_000}
+        )
+        pid = gov.submit("alice", [CHANGE], DEFAULT_MIN_DEPOSIT, time_ns=0)
+        gov.vote(pid, "v1", VoteOption.YES, time_ns=5)
+        gov.vote(pid, "v2", VoteOption.ABSTAIN, time_ns=6)
+        assert gov.end_blocker(time_ns=100) == []  # voting clock still running
+        events = gov.end_blocker(time_ns=WEEK_NS + 100)
+        assert events == [("gov.proposal_passed", pid)]
+        assert BlobParamsKeeper(store).gas_per_blob_byte() == 16
+        assert bank.balance("alice") == 20_000_000_000  # refunded
+        assert gov.get_proposal(pid).status == ProposalStatus.PASSED
+
+    def test_quorum_failure_burns(self):
+        gov, store, bank = make_gov_with_bank(
+            {"v1": 10, "v2": 90}, {"alice": 20_000_000_000}
+        )
+        pid = gov.submit("alice", [CHANGE], DEFAULT_MIN_DEPOSIT, time_ns=0)
+        gov.vote(pid, "v1", VoteOption.YES, time_ns=5)  # 10% turnout < 33.4%
+        events = gov.end_blocker(time_ns=WEEK_NS + 1)
+        assert events == [("gov.proposal_rejected", pid)]
+        assert bank.balance("alice") == 10_000_000_000  # burned
+        assert BlobParamsKeeper(store).gas_per_blob_byte() == 8
+
+    def test_veto_burns(self):
+        gov, store, bank = make_gov_with_bank(
+            {"v1": 60, "v2": 40}, {"alice": 20_000_000_000}
+        )
+        pid = gov.submit("alice", [CHANGE], DEFAULT_MIN_DEPOSIT, time_ns=0)
+        gov.vote(pid, "v1", VoteOption.YES, time_ns=5)
+        gov.vote(pid, "v2", VoteOption.NO_WITH_VETO, time_ns=6)  # 40% > 33.4%
+        events = gov.end_blocker(time_ns=WEEK_NS + 1)
+        assert events == [("gov.proposal_rejected", pid)]
+        assert bank.balance("alice") == 10_000_000_000
+
+    def test_threshold_failure_refunds(self):
+        gov, store, bank = make_gov_with_bank(
+            {"v1": 40, "v2": 60}, {"alice": 20_000_000_000}
+        )
+        pid = gov.submit("alice", [CHANGE], DEFAULT_MIN_DEPOSIT, time_ns=0)
+        gov.vote(pid, "v1", VoteOption.YES, time_ns=5)
+        gov.vote(pid, "v2", VoteOption.NO, time_ns=6)
+        events = gov.end_blocker(time_ns=WEEK_NS + 1)
+        assert events == [("gov.proposal_rejected", pid)]
+        assert bank.balance("alice") == 20_000_000_000  # refunded
+
+    def test_vote_outside_period_rejected(self):
+        gov, store, bank = make_gov_with_bank({"v1": 100}, {"alice": 20_000_000_000})
+        pid = gov.submit("alice", [CHANGE], 100, time_ns=0)  # deposit period
+        with pytest.raises(GovError, match="not in its voting period"):
+            gov.vote(pid, "v1", VoteOption.YES, time_ns=5)
+        gov.deposit(pid, "alice", DEFAULT_MIN_DEPOSIT, time_ns=10)
+        with pytest.raises(GovError, match="has ended"):
+            gov.vote(pid, "v1", VoteOption.YES, time_ns=10 + WEEK_NS)
+
+    def test_insufficient_balance_for_deposit(self):
+        gov, store, bank = make_gov_with_bank({"v1": 100}, {"poor": 50})
+        with pytest.raises(GovError):
+            gov.submit("poor", [CHANGE], 1_000_000, time_ns=0)
+
+    def test_hostile_bytes_in_values_cannot_corrupt_records(self):
+        """Regression: a param value full of control bytes must round-trip
+        (the old separator-text record format let one \\x1e halt the chain)."""
+        gov, store, bank = make_gov_with_bank({"v1": 100}, {"alice": 20_000_000_000})
+        evil = "16\x1eboom\x1f\x1d\x00stuff"
+        pid = gov.submit(
+            "alice",
+            [ParamChange("blob", "GasPerBlobByte", evil)],
+            DEFAULT_MIN_DEPOSIT,
+            time_ns=0,
+        )
+        p = gov.get_proposal(pid)
+        assert p.changes[0].value == evil
+        # end_blocker survives (the execution fails cleanly, deposits refund).
+        gov.vote(pid, "v1", VoteOption.YES, time_ns=5)
+        events = gov.end_blocker(time_ns=WEEK_NS + 1)
+        assert events == [("gov.proposal_failed", pid)]
+        assert gov.end_blocker(time_ns=WEEK_NS + 2) == []  # terminal: not rescanned
+
+    def test_finished_proposals_leave_no_active_residue(self):
+        gov, store, bank = make_gov_with_bank(
+            {"v1": 100}, {"alice": 20_000_000_000}
+        )
+        pid = gov.submit("alice", [CHANGE], DEFAULT_MIN_DEPOSIT, time_ns=0)
+        gov.vote(pid, "v1", VoteOption.YES, time_ns=5)
+        gov.end_blocker(time_ns=WEEK_NS + 1)
+        assert gov.active_proposals() == []
+        assert list(store.iterate(f"gov/vote/{pid}/".encode())) == []
+        # The record itself survives for queries.
+        assert gov.get_proposal(pid).status == ProposalStatus.PASSED
 
 
 class TestGov:
@@ -67,6 +220,134 @@ class TestGov:
         gov.vote(pid, "v1", True)
         with pytest.raises(ValueError):
             gov.tally_and_execute(pid)
+
+
+class TestGovOverTheWire:
+    """MsgSubmitProposal / MsgDeposit / MsgVote as signed txs through real
+    blocks, with the end-blocker clocks doing the tally."""
+
+    def _chain(self):
+        from celestia_app_tpu.app import Genesis, GenesisAccount
+        from celestia_app_tpu.testutil.testnode import GENESIS_TIME_NS, funded_keys
+
+        keys = funded_keys(3)
+        # Validators ARE the funded accounts, so they can sign vote txs.
+        accounts = tuple(
+            GenesisAccount(k.public_key().address(), 50_000_000_000, k.public_key().bytes)
+            for k in keys
+        )
+        validators = tuple(
+            Validator(k.public_key().address(), k.public_key().bytes, power=100)
+            for k in keys
+        )
+        genesis = Genesis(
+            chain_id="gov-chain",
+            genesis_time_ns=GENESIS_TIME_NS,
+            accounts=accounts,
+            validators=validators,
+        )
+        return TestNode(genesis, keys), keys
+
+    def _submit(self, node, key, msg, seq):
+        from celestia_app_tpu.state.accounts import AuthKeeper
+        from celestia_app_tpu.tx.messages import Coin
+        from celestia_app_tpu.tx.sign import Fee, build_and_sign
+
+        acct = AuthKeeper(node.app.cms.working).get_account(key.public_key().address())
+        raw = build_and_sign(
+            [msg], key, node.chain_id, acct.account_number, seq,
+            Fee((Coin("utia", 20_000),), 200_000),
+        )
+        res = node.broadcast(raw)
+        assert res.code == 0, res.log
+        return node.produce_block()
+
+    def test_proposal_lifecycle_over_blocks(self):
+        from celestia_app_tpu.tx.messages import (
+            Coin,
+            MsgDeposit,
+            MsgSubmitProposal,
+            MsgVote,
+            ProposalParamChange,
+        )
+
+        node, keys = self._chain()
+        addr = [k.public_key().address() for k in keys]
+        change = ProposalParamChange("blob", "GasPerBlobByte", "32")
+        _, results = self._submit(
+            node, keys[0],
+            MsgSubmitProposal(
+                "raise gas", "per-byte gas to 32", (change,),
+                (Coin("utia", 4_000_000_000),), addr[0],
+            ),
+            seq=0,
+        )
+        assert results[0].code == 0, results[0].log
+        pid = next(e[1] for e in results[0].events if e[0].endswith("SubmitProposal"))
+
+        gov = GovKeeper(
+            node.app.cms.working, StakingKeeper(node.app.cms.working),
+            BankKeeper(node.app.cms.working),
+        )
+        assert gov.get_proposal(pid).status == ProposalStatus.DEPOSIT_PERIOD
+
+        _, results = self._submit(
+            node, keys[1],
+            MsgDeposit(pid, addr[1], (Coin("utia", 6_000_000_000),)), seq=0,
+        )
+        assert results[0].code == 0, results[0].log
+        assert gov.get_proposal(pid).status == ProposalStatus.VOTING_PERIOD
+
+        for i, key in enumerate(keys):
+            _, results = self._submit(
+                node, key, MsgVote(pid, addr[i], int(VoteOption.YES)),
+                seq=1 if i < 2 else 0,
+            )
+            assert results[0].code == 0, results[0].log
+
+        # Blocks advance 15s each; jump the chain clock past the voting end.
+        end_ns = gov.get_proposal(pid).voting_end_ns
+        node.produce_block(time_ns=end_ns + 1)
+        p = gov.get_proposal(pid)
+        assert p.status == ProposalStatus.PASSED
+        assert BlobParamsKeeper(node.app.cms.working).gas_per_blob_byte() == 32
+        # Deposits refunded to both depositors.
+        bank = BankKeeper(node.app.cms.working)
+        assert bank.balance(GOV_MODULE) == 0
+
+    def test_empty_proposal_rejected_at_checktx(self):
+        from celestia_app_tpu.state.accounts import AuthKeeper
+        from celestia_app_tpu.tx.messages import Coin, MsgSubmitProposal
+        from celestia_app_tpu.tx.sign import Fee, build_and_sign
+
+        node, keys = self._chain()
+        acct = AuthKeeper(node.app.cms.working).get_account(
+            keys[0].public_key().address()
+        )
+        raw = build_and_sign(
+            [MsgSubmitProposal("t", "d", (), (), keys[0].public_key().address())],
+            keys[0], node.chain_id, acct.account_number, 0,
+            Fee((Coin("utia", 20_000),), 200_000),
+        )
+        res = node.app.check_tx(raw)
+        assert res.code != 0 and "at least one message" in res.log
+
+    def test_forbidden_param_rejected_at_delivery(self):
+        from celestia_app_tpu.tx.messages import (
+            Coin,
+            MsgSubmitProposal,
+            ProposalParamChange,
+        )
+
+        node, keys = self._chain()
+        addr = keys[0].public_key().address()
+        msg = MsgSubmitProposal(
+            "sneaky", "change the bond denom",
+            (ProposalParamChange("staking", "BondDenom", "ufake"),),
+            (Coin("utia", 100),), addr,
+        )
+        _, results = self._submit(node, keys[0], msg, seq=0)
+        assert results[0].code == 2  # paramfilter blocklist (consensus law)
 
 
 class TestOnChainParams:

@@ -133,8 +133,9 @@ class TestValidateBlobTx:
 
 class TestGas:
     def test_gas_model(self):
-        # 1 share blob: 512 * 8 = 4096 gas + fixed.
+        # 1 share blob: 512 * 8 = 4096 gas + per-blob info bytes + fixed
+        # (payforblob.go:171 EstimateGas: txSizeCost 10 x BytesPerBlobInfo 70).
         assert gas_to_consume((1,), 8) == 4096
-        assert estimate_gas([1]) == 4096 + 75_000
+        assert estimate_gas([1]) == 4096 + 10 * 70 + 75_000
         # Spot check linearity.
         assert gas_to_consume((478 * 10,), 8) == 10 * 512 * 8
