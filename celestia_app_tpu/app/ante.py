@@ -45,11 +45,15 @@ from celestia_app_tpu.shares.sparse import sparse_shares_needed
 from celestia_app_tpu.state.accounts import FEE_COLLECTOR
 from celestia_app_tpu.state.dec import Dec
 from celestia_app_tpu.tx.messages import (
+    MsgAcknowledgement,
     MsgDeposit,
     MsgPayForBlobs,
+    MsgRecvPacket,
     MsgSend,
     MsgSignalVersion,
     MsgSubmitProposal,
+    MsgTimeout,
+    MsgTransfer,
     MsgTryUpgrade,
     MsgVote,
 )
@@ -63,9 +67,13 @@ class AnteError(ValueError):
 
 
 # appVersion -> allowed msg types (MsgVersioningGateKeeper,
-# app/ante/msg_gatekeeper.go:18-42: signal msgs are v2+; gov msgs exist in
-# every version, as x/gov is wired for v1 and v2 in app/modules.go).
-_V1_MSGS = {MsgSend, MsgPayForBlobs, MsgSubmitProposal, MsgVote, MsgDeposit}
+# app/ante/msg_gatekeeper.go:18-42: signal msgs are v2+; gov and IBC msgs
+# exist in every version, as x/gov and ibc are wired for v1 and v2 in
+# app/modules.go:96-189).
+_V1_MSGS = {
+    MsgSend, MsgPayForBlobs, MsgSubmitProposal, MsgVote, MsgDeposit,
+    MsgTransfer, MsgRecvPacket, MsgAcknowledgement, MsgTimeout,
+}
 _V2_MSGS = _V1_MSGS | {MsgSignalVersion, MsgTryUpgrade}
 
 
@@ -241,6 +249,12 @@ def _run(
     # --- 17: gov proposals ---------------------------------------------------
     _check_gov_proposals(msgs)
 
+    # --- 19: redundant IBC relays (CheckTx only, as the reference's
+    # RedundantRelayDecorator protects the mempool without affecting
+    # consensus) ---------------------------------------------------------------
+    if is_check_tx:
+        _check_redundant_relays(ctx, msgs)
+
     # --- 18: sequence increment + pubkey persistence -------------------------
     if acc.pubkey == b"":
         acc.pubkey = info.public_key.bytes
@@ -261,6 +275,32 @@ def _check_gov_proposals(msgs: list) -> None:
     for m in msgs:
         if isinstance(m, MsgSubmitProposal) and not m.changes:
             raise AnteError("proposal must contain at least one message")
+
+
+def _check_redundant_relays(ctx, msgs: list) -> None:
+    """RedundantRelayDecorator (ibc-go core/ante): a CheckTx-only guard —
+    if the tx carries relay messages and EVERY one of them is a no-op
+    (packet already received / already acked or timed out), reject so
+    redundant relays never occupy the mempool."""
+    from celestia_app_tpu.modules.ibc.core import ChannelKeeper
+
+    relay_msgs = [
+        m for m in msgs if isinstance(m, (MsgRecvPacket, MsgAcknowledgement, MsgTimeout))
+    ]
+    if not relay_msgs:
+        return
+    channels = ChannelKeeper(ctx.store)
+    for m in relay_msgs:
+        packet = m.packet()
+        if isinstance(m, MsgRecvPacket):
+            if not channels.has_receipt(packet):
+                return  # at least one effective message
+        else:  # ack / timeout: effective iff the commitment still exists
+            if channels.packet_commitment(
+                packet.source_port, packet.source_channel, packet.sequence
+            ) is not None:
+                return
+    raise AnteError("tx contains only redundant IBC relay messages")
 
 
 def _check_pfb_gas(msg: MsgPayForBlobs, gas_limit: int, gas_per_blob_byte: int) -> None:

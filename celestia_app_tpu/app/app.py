@@ -43,11 +43,15 @@ from celestia_app_tpu.state.staking import StakingKeeper, Validator
 from celestia_app_tpu.state.store import CommitStore, KVStore
 from celestia_app_tpu.tx.envelopes import unmarshal_blob_tx
 from celestia_app_tpu.tx.messages import (
+    MsgAcknowledgement,
     MsgDeposit,
     MsgPayForBlobs,
+    MsgRecvPacket,
     MsgSend,
     MsgSignalVersion,
     MsgSubmitProposal,
+    MsgTimeout,
+    MsgTransfer,
     MsgTryUpgrade,
     MsgVote,
 )
@@ -116,6 +120,7 @@ class App:
         self,
         node_min_gas_price: Dec | None = None,
         v2_upgrade_height: int | None = None,
+        ibc_token_filter: bool = True,
     ):
         self.cms = CommitStore()
         self.chain_id = ""
@@ -128,6 +133,9 @@ class App:
         self.last_block_time_ns = 0
         self.node_min_gas_price = node_min_gas_price or Dec.from_str("0.002")
         self.minter = Minter.default()
+        # False models a non-celestia counterparty chain (the reference's
+        # test/pfm/simapp.go) in IBC tests; celestia itself always filters.
+        self.ibc_token_filter = ibc_token_filter
         self._check_state: KVStore | None = None
 
     # --- keeper views over committed state ---------------------------------
@@ -401,6 +409,8 @@ class App:
             keeper = SignalKeeper(ctx.store, ctx.staking)
             keeper.try_upgrade(ctx.height, self.app_version)
             return 0, []
+        if isinstance(msg, (MsgTransfer, MsgRecvPacket, MsgAcknowledgement, MsgTimeout)):
+            return self._handle_ibc_msg(ctx, msg)
         if isinstance(msg, (MsgSubmitProposal, MsgVote, MsgDeposit)):
             from celestia_app_tpu.modules.gov import GovKeeper, ParamChange
 
@@ -421,6 +431,86 @@ class App:
             gov.deposit(msg.proposal_id, msg.depositor, deposit, ctx.time_ns)
             return 0, [("cosmos.gov.v1beta1.EventDeposit", msg.proposal_id, deposit)]
         raise ValueError(f"no handler for {type(msg).__name__}")
+
+    def _handle_ibc_msg(self, ctx: Ctx, msg):
+        """Transfer sends + the three relay callbacks through the versioned
+        middleware stack (tokenfilter > PFM [v2] > transfer,
+        app/app.go:329-346)."""
+        from celestia_app_tpu.modules.ibc import (
+            ChannelKeeper,
+            Height,
+            TransferKeeper,
+            build_transfer_stack,
+        )
+
+        from celestia_app_tpu.modules.ibc.transfer import ack_is_error
+
+        channels = ChannelKeeper(ctx.store)
+        if isinstance(msg, MsgTransfer):
+            keeper = TransferKeeper(channels, ctx.bank)
+            packet = keeper.send_transfer(
+                source_channel=msg.source_channel,
+                sender=msg.sender,
+                receiver=msg.receiver,
+                denom=msg.token.denom,
+                amount=msg.token.amount,
+                timeout_height=Height(
+                    msg.timeout_revision_number, msg.timeout_revision_height
+                ),
+                timeout_timestamp_ns=msg.timeout_timestamp_ns,
+                memo=msg.memo,
+                source_port=msg.source_port,
+            )
+            return 0, [("ibc.send_packet", packet.marshal().hex())]
+        if isinstance(msg, MsgRecvPacket):
+            packet = msg.packet()
+            # Redundant relays are no-op successes in DeliverTx (ibc-go
+            # ErrNoOpMsg), so a racing relayer's batched siblings survive.
+            if channels.has_receipt(packet):
+                return 0, [("ibc.noop", "recv", packet.sequence)]
+            channels.recv_packet(packet, ctx.height, ctx.time_ns)
+            # The app callback runs on a cache; its state lands only when
+            # the ack is a success (ibc-go msg_server.go RecvPacket's
+            # cacheCtx) — an error ack must not leave minted vouchers or
+            # half-done forwards behind.
+            recv_ctx = ctx.branch()
+            recv_keeper = TransferKeeper(ChannelKeeper(recv_ctx.store), recv_ctx.bank)
+            stack = build_transfer_stack(
+                self.app_version, recv_keeper, token_filter=self.ibc_token_filter
+            )
+            ack = stack.on_recv_packet(recv_ctx, packet)
+            events = [("ibc.write_acknowledgement", packet.marshal().hex(), ack.hex())]
+            if not ack_is_error(ack):
+                ctx.store.write_back(recv_ctx.store)
+                # Middleware (PFM) may have sent onward packets during recv.
+                events += [
+                    ("ibc.send_packet", p.marshal().hex()) for p in recv_keeper.sent
+                ]
+            channels.write_acknowledgement(packet, ack)
+            return 0, events
+        keeper = TransferKeeper(channels, ctx.bank)
+        stack = build_transfer_stack(
+            self.app_version, keeper, token_filter=self.ibc_token_filter
+        )
+        if isinstance(msg, MsgAcknowledgement):
+            packet = msg.packet()
+            if channels.packet_commitment(
+                packet.source_port, packet.source_channel, packet.sequence
+            ) is None:
+                return 0, [("ibc.noop", "ack", packet.sequence)]
+            channels.acknowledge_packet(packet)
+            stack.on_acknowledgement_packet(ctx, packet, msg.acknowledgement)
+            return 0, [("ibc.acknowledge_packet", packet.sequence)]
+        packet = msg.packet()  # MsgTimeout
+        if channels.packet_commitment(
+            packet.source_port, packet.source_channel, packet.sequence
+        ) is None:
+            return 0, [("ibc.noop", "timeout", packet.sequence)]
+        # The relayer's proof height stands in for the counterparty view;
+        # the timestamp check uses this chain's clock (IBC-lite trust note).
+        channels.timeout_packet(packet, msg.proof_height, ctx.time_ns)
+        stack.on_timeout_packet(ctx, packet)
+        return 0, [("ibc.timeout_packet", packet.sequence)]
 
     def _end_block(self, ctx: Ctx, height: int) -> None:
         """Gov clocks + blobstream (v1 only) + height/signal upgrades

@@ -29,6 +29,10 @@ URL_MSG_SUBMIT_PROPOSAL = "/cosmos.gov.v1beta1.MsgSubmitProposal"
 URL_MSG_VOTE = "/cosmos.gov.v1beta1.MsgVote"
 URL_MSG_DEPOSIT = "/cosmos.gov.v1beta1.MsgDeposit"
 URL_PARAM_CHANGE_PROPOSAL = "/cosmos.params.v1beta1.ParameterChangeProposal"
+URL_MSG_TRANSFER = "/ibc.applications.transfer.v1.MsgTransfer"
+URL_MSG_RECV_PACKET = "/ibc.core.channel.v1.MsgRecvPacket"
+URL_MSG_ACKNOWLEDGEMENT = "/ibc.core.channel.v1.MsgAcknowledgement"
+URL_MSG_TIMEOUT = "/ibc.core.channel.v1.MsgTimeout"
 
 
 @dataclass(frozen=True)
@@ -417,6 +421,149 @@ class MsgDeposit:
             raise ValueError("deposit must be positive")
 
 
+@dataclass(frozen=True)
+class MsgTransfer:
+    """ibc.applications.transfer.v1.MsgTransfer {source_port=1,
+    source_channel=2, token=3, sender=4, receiver=5, timeout_height=6
+    {revision_number=1, revision_height=2}, timeout_timestamp=7, memo=8}."""
+
+    source_port: str
+    source_channel: str
+    token: Coin
+    sender: str
+    receiver: str
+    timeout_revision_number: int = 0
+    timeout_revision_height: int = 0
+    timeout_timestamp_ns: int = 0
+    memo: str = ""
+
+    TYPE_URL = URL_MSG_TRANSFER
+
+    def marshal(self) -> bytes:
+        out = encode_bytes_field(1, self.source_port.encode())
+        out += encode_bytes_field(2, self.source_channel.encode())
+        out += encode_bytes_field(3, self.token.marshal())
+        out += encode_bytes_field(4, self.sender.encode())
+        out += encode_bytes_field(5, self.receiver.encode())
+        if self.timeout_revision_number or self.timeout_revision_height:
+            out += encode_bytes_field(
+                6,
+                encode_varint_field(1, self.timeout_revision_number)
+                + encode_varint_field(2, self.timeout_revision_height),
+            )
+        if self.timeout_timestamp_ns:
+            out += encode_varint_field(7, self.timeout_timestamp_ns)
+        if self.memo:
+            out += encode_bytes_field(8, self.memo.encode())
+        return out
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgTransfer":
+        strs: dict[int, bytes] = {}
+        ints: dict[int, int] = {}
+        for num, wt, val in decode_fields(raw):
+            if wt == WIRE_LEN:
+                strs[num] = val
+            elif wt == WIRE_VARINT:
+                ints[num] = val
+        rev_num = rev_h = 0
+        if 6 in strs:
+            hf = {n: v for n, wt, v in decode_fields(strs[6]) if wt == WIRE_VARINT}
+            rev_num, rev_h = hf.get(1, 0), hf.get(2, 0)
+        return cls(
+            strs.get(1, b"").decode(), strs.get(2, b"").decode(),
+            Coin.unmarshal(strs.get(3, b"")), strs.get(4, b"").decode(),
+            strs.get(5, b"").decode(), rev_num, rev_h, ints.get(7, 0),
+            strs.get(8, b"").decode(),
+        )
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+    @property
+    def signer(self) -> str:
+        return self.sender
+
+    def validate_basic(self) -> None:
+        from celestia_app_tpu.crypto.keys import validate_address
+
+        validate_address(self.sender)
+        if not self.receiver:
+            raise ValueError("receiver must not be empty")
+        if self.token.amount <= 0:
+            raise ValueError("transfer amount must be positive")
+        if not self.source_channel:
+            raise ValueError("source channel must not be empty")
+
+
+def _relay_msg(url: str, signer_field: int, ack_field: int | None = None,
+               height_field: int | None = None):
+    """MsgRecvPacket / MsgAcknowledgement / MsgTimeout share one shape:
+    a packet, optional ack bytes / proof height, and the relayer signer.
+    Field numbers follow ibc.core.channel.v1 (MsgRecvPacket signer=4;
+    MsgAcknowledgement acknowledgement=2, signer=5; MsgTimeout
+    proof_height=3, signer=5; proof fields omitted — verification is
+    delegated per the IBC-lite scope note in modules/ibc)."""
+
+    @dataclass(frozen=True)
+    class RelayMsg:
+        packet_bytes: bytes
+        signer: str
+        acknowledgement: bytes = b""
+        proof_height: int = 0
+
+        TYPE_URL = url
+        _SIGNER_FIELD = signer_field
+        _ACK_FIELD = ack_field
+        _HEIGHT_FIELD = height_field
+
+        def marshal(self) -> bytes:
+            out = encode_bytes_field(1, self.packet_bytes)
+            if self._ACK_FIELD is not None and self.acknowledgement:
+                out += encode_bytes_field(self._ACK_FIELD, self.acknowledgement)
+            if self._HEIGHT_FIELD is not None and self.proof_height:
+                out += encode_bytes_field(
+                    self._HEIGHT_FIELD, encode_varint_field(2, self.proof_height)
+                )
+            out += encode_bytes_field(self._SIGNER_FIELD, self.signer.encode())
+            return out
+
+        @classmethod
+        def unmarshal(cls, raw: bytes):
+            packet, signer, ack, ph = b"", "", b"", 0
+            for num, wt, val in decode_fields(raw):
+                if num == 1 and wt == WIRE_LEN:
+                    packet = val
+                elif num == cls._ACK_FIELD and wt == WIRE_LEN:
+                    ack = val
+                elif num == cls._HEIGHT_FIELD and wt == WIRE_LEN:
+                    hf = {n: v for n, wt2, v in decode_fields(val) if wt2 == WIRE_VARINT}
+                    ph = hf.get(2, 0)
+                elif num == cls._SIGNER_FIELD and wt == WIRE_LEN:
+                    signer = val.decode()
+            return cls(packet, signer, ack, ph)
+
+        def to_any(self) -> Any:
+            return Any(self.TYPE_URL, self.marshal())
+
+        def packet(self):
+            from celestia_app_tpu.modules.ibc.core import Packet
+
+            return Packet.unmarshal(self.packet_bytes)
+
+        def validate_basic(self) -> None:
+            if not self.packet_bytes:
+                raise ValueError("relay msg missing packet")
+
+    RelayMsg.__name__ = RelayMsg.__qualname__ = url.rsplit(".", 1)[-1]
+    return RelayMsg
+
+
+MsgRecvPacket = _relay_msg(URL_MSG_RECV_PACKET, signer_field=4)
+MsgAcknowledgement = _relay_msg(URL_MSG_ACKNOWLEDGEMENT, signer_field=5, ack_field=2)
+MsgTimeout = _relay_msg(URL_MSG_TIMEOUT, signer_field=5, height_field=3)
+
+
 MSG_DECODERS = {
     URL_MSG_PAY_FOR_BLOBS: MsgPayForBlobs.unmarshal,
     URL_MSG_SEND: MsgSend.unmarshal,
@@ -425,6 +572,10 @@ MSG_DECODERS = {
     URL_MSG_SUBMIT_PROPOSAL: MsgSubmitProposal.unmarshal,
     URL_MSG_VOTE: MsgVote.unmarshal,
     URL_MSG_DEPOSIT: MsgDeposit.unmarshal,
+    URL_MSG_TRANSFER: MsgTransfer.unmarshal,
+    URL_MSG_RECV_PACKET: MsgRecvPacket.unmarshal,
+    URL_MSG_ACKNOWLEDGEMENT: MsgAcknowledgement.unmarshal,
+    URL_MSG_TIMEOUT: MsgTimeout.unmarshal,
 }
 
 

@@ -1,0 +1,349 @@
+"""IBC-lite: ICS-20 transfers, tokenfilter mounted in a real stack, PFM,
+timeouts, and relay dedup — over two in-process chains and real blocks.
+
+Reference parity targets: x/tokenfilter/ibc_middleware.go (middleware
+mounted first, app/app.go:329-346), ibc-go transfer escrow/voucher
+semantics, test/pfm (forward middleware with a non-filtering counterparty
+simapp), and ibc-go's RedundantRelayDecorator (ante #19).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from celestia_app_tpu.modules.ibc import (
+    Channel,
+    ChannelKeeper,
+    Height,
+    IBCError,
+    Packet,
+    TransferKeeper,
+    voucher_denom,
+)
+from celestia_app_tpu.modules.ibc.transfer import (
+    SUCCESS_ACK,
+    ack_is_error,
+    escrow_address,
+)
+from celestia_app_tpu.state.accounts import BankKeeper
+from celestia_app_tpu.state.store import KVStore
+from celestia_app_tpu.testutil.ibc import TRANSFER_PORT, ConnectedChains
+
+
+class TestCore:
+    def _keeper(self):
+        store = KVStore()
+        ck = ChannelKeeper(store)
+        ck.create_channel(Channel("transfer", "channel-0", "transfer", "channel-7"))
+        return ck
+
+    def test_packet_roundtrip_and_commitment(self):
+        ck = self._keeper()
+        p = ck.send_packet("transfer", "channel-0", b'{"x":1}', Height(0, 99), 12345)
+        assert p.sequence == 1 and p.destination_channel == "channel-7"
+        assert Packet.unmarshal(p.marshal()) == p
+        assert ck.packet_commitment("transfer", "channel-0", 1) == p.commitment()
+        p2 = ck.send_packet("transfer", "channel-0", b"y")
+        assert p2.sequence == 2
+
+    def test_recv_is_replay_guarded(self):
+        ck = self._keeper()
+        incoming = Packet(1, "transfer", "channel-7", "transfer", "channel-0", b"d")
+        ck.recv_packet(incoming, height=5, time_ns=0)
+        assert ck.has_receipt(incoming)
+        with pytest.raises(IBCError, match="already received"):
+            ck.recv_packet(incoming, height=5, time_ns=0)
+
+    def test_recv_rejects_wrong_route_and_timeout(self):
+        ck = self._keeper()
+        wrong = Packet(1, "transfer", "channel-9", "transfer", "channel-0", b"d")
+        with pytest.raises(IBCError, match="wrong channel"):
+            ck.recv_packet(wrong, height=5, time_ns=0)
+        expired = Packet(
+            2, "transfer", "channel-7", "transfer", "channel-0", b"d",
+            timeout_height=Height(0, 4),
+        )
+        with pytest.raises(IBCError, match="timeout height"):
+            ck.recv_packet(expired, height=5, time_ns=0)
+
+    def test_ack_deletes_commitment_once(self):
+        ck = self._keeper()
+        p = ck.send_packet("transfer", "channel-0", b"d")
+        ck.acknowledge_packet(p)
+        assert ck.packet_commitment("transfer", "channel-0", p.sequence) is None
+        with pytest.raises(IBCError, match="no commitment"):
+            ck.acknowledge_packet(p)
+
+    def test_timeout_requires_elapsed(self):
+        ck = self._keeper()
+        p = ck.send_packet("transfer", "channel-0", b"d", Height(0, 100))
+        with pytest.raises(IBCError, match="not timed out"):
+            ck.timeout_packet(p, proof_height=99, proof_time_ns=0)
+        ck.timeout_packet(p, proof_height=100, proof_time_ns=0)
+        assert ck.packet_commitment("transfer", "channel-0", p.sequence) is None
+
+
+class TestICS20Wire:
+    def test_packet_data_is_counterparty_compatible_json(self):
+        """The bytes on the wire are exactly what ibc-go's ModuleCdc emits."""
+        store = KVStore()
+        bank = BankKeeper(store)
+        bank.mint("celestia1sender", 100)
+        ck = ChannelKeeper(store)
+        ck.create_channel(Channel("transfer", "channel-0", "transfer", "channel-1"))
+        tk = TransferKeeper(ck, bank)
+        p = tk.send_transfer(
+            "channel-0", "celestia1sender", "cosmos1receiver", "utia", 75
+        )
+        assert p.data == (
+            b'{"denom":"utia","amount":"75",'
+            b'"sender":"celestia1sender","receiver":"cosmos1receiver"}'
+        )
+        assert json.loads(p.data)["amount"] == "75"  # string amount, per ICS-20
+
+
+@pytest.fixture(scope="module")
+def chains() -> ConnectedChains:
+    return ConnectedChains(app_version=2)
+
+
+class TestTransferAcrossChains:
+    def test_native_out_voucher_minted_and_returns_home(self, chains):
+        a, b = chains.a, chains.b
+        alice = a.keys[0]
+        bob_addr = b.keys[0].public_key().address()
+        alice_addr = alice.public_key().address()
+        escrow = escrow_address(TRANSFER_PORT, a.channel_id)
+        bal0 = a.balance(alice_addr)
+
+        packet, result = chains.transfer(a, b, alice, bob_addr, "utia", 1_000)
+        assert result.code == 0 and packet is not None
+        assert a.balance(escrow) == 1_000  # escrowed, not burned
+        ack = chains.relay(packet, src=a, dst=b)
+        assert ack == SUCCESS_ACK
+        voucher = voucher_denom(TRANSFER_PORT, b.channel_id, "utia")
+        assert b.balance(bob_addr, denom=voucher) == 1_000
+        # Commitment cleared on A after the ack.
+        ck = ChannelKeeper(a.node.app.cms.working)
+        assert ck.packet_commitment(TRANSFER_PORT, a.channel_id, packet.sequence) is None
+
+        # --- and back home: voucher burned on B, escrow released on A.
+        bob = b.keys[0]
+        packet2, result2 = chains.transfer(
+            b, a, bob, alice_addr, voucher, 400
+        )
+        assert result2.code == 0, result2.log
+        assert b.balance(bob_addr, denom=voucher) == 600  # burned on send
+        ack2 = chains.relay(packet2, src=b, dst=a)
+        assert ack2 == SUCCESS_ACK  # tokenfilter passes TIA returning home
+        assert a.balance(escrow) == 600
+        assert a.balance(alice_addr) == bal0 - 1_000 + 400 - 20_000  # one tx fee
+
+    def test_foreign_token_rejected_by_tokenfilter_and_refunded(self, chains):
+        """B's native token inbound to celestia: the mounted tokenfilter
+        returns an error ack and B refunds the sender (the full reference
+        circuit, not just the decision function)."""
+        a, b = chains.a, chains.b
+        bob = b.keys[1]
+        bob_addr = bob.public_key().address()
+        alice_addr = a.keys[0].public_key().address()
+        bal0 = b.balance(bob_addr)
+
+        packet, result = chains.transfer(b, a, bob, alice_addr, "utia", 500)
+        assert result.code == 0  # send succeeds on B (escrowed there)
+        assert b.balance(bob_addr) == bal0 - 500 - 20_000
+        ack = chains.relay(packet, src=b, dst=a)
+        assert ack_is_error(ack)
+        assert b"only native denom transfers accepted" in ack
+        # The error ack refunded bob on B (he paid only his own tx fee; the
+        # relayer paid for the relay legs).
+        assert b.balance(bob_addr) == bal0 - 20_000
+        # And nothing was minted on A.
+        foreign = voucher_denom(TRANSFER_PORT, a.channel_id, "utia")
+        assert a.balance(alice_addr, denom=foreign) == 0
+
+
+class TestTimeout:
+    def test_timeout_refunds_escrow(self):
+        chains = ConnectedChains(app_version=2)
+        a = chains.a
+        alice = a.keys[0]
+        alice_addr = alice.public_key().address()
+        bal0 = a.balance(alice_addr)
+        packet, result = chains.transfer(
+            a, chains.b, alice, "beta1receiver", "utia", 700, timeout_height=3
+        )
+        assert result.code == 0
+        # Never relayed; the counterparty advanced past height 3.
+        result, _ = chains.timeout(packet, src=a, proof_height=3)
+        assert result.code == 0, result.log
+        assert a.balance(alice_addr) == bal0 - 20_000  # only alice's tx fee
+        assert a.balance(escrow_address(TRANSFER_PORT, a.channel_id)) == 0
+        # A second timeout relay is redundant: rejected at CheckTx.
+        res, _ = chains.timeout(packet, src=a, proof_height=3)
+        assert res.code != 0 and "redundant" in res.log
+
+    def test_receiver_rejects_expired_packet(self):
+        chains = ConnectedChains(app_version=2)
+        a, b = chains.a, chains.b
+        packet, _ = chains.transfer(
+            a, b, a.keys[0], "beta1x", "utia", 10, timeout_height=1
+        )
+        from celestia_app_tpu.tx.messages import MsgRecvPacket
+
+        # B is already past height 1 after its first block.
+        b.node.produce_block()
+        result, _ = b.submit(
+            b.relayer, MsgRecvPacket(packet.marshal(), b.relayer.public_key().address())
+        )
+        assert result.code != 0  # timeout elapsed on receiver
+
+
+class TestRedundantRelay:
+    def test_second_recv_rejected_at_checktx(self, chains):
+        a, b = chains.a, chains.b
+        packet, _ = chains.transfer(
+            a, b, a.keys[2], b.keys[2].public_key().address(), "utia", 5
+        )
+        chains.relay(packet, src=a, dst=b)
+        from celestia_app_tpu.tx.messages import MsgRecvPacket
+
+        result, _ = b.submit(
+            b.relayer, MsgRecvPacket(packet.marshal(), b.relayer.public_key().address())
+        )
+        assert result.code != 0 and "redundant" in result.log
+
+
+class TestPacketForward:
+    def test_forward_through_counterparty_back_home(self):
+        """A -> B with a forward directive pointing back to A: B's PFM
+        mints to the hop receiver, immediately sends onward, and A
+        releases escrow to the final receiver (one-hop PFM, test/pfm)."""
+        chains = ConnectedChains(app_version=2)
+        a, b = chains.a, chains.b
+        alice = a.keys[0]
+        final_addr = a.keys[1].public_key().address()
+        hop_addr = b.keys[0].public_key().address()
+        final_bal0 = a.balance(final_addr)
+
+        memo = json.dumps(
+            {"forward": {"receiver": final_addr, "channel": b.channel_id}}
+        )
+        packet, result = chains.transfer(
+            a, b, alice, hop_addr, "utia", 250, memo=memo
+        )
+        assert result.code == 0, result.log
+        # Relay A->B: B mints to hop, then PFM burns the voucher and emits
+        # the onward packet in the same tx.
+        relayer = b.relayer
+        from celestia_app_tpu.tx.messages import MsgRecvPacket
+
+        res, results = b.submit(
+            relayer, MsgRecvPacket(packet.marshal(), relayer.public_key().address())
+        )
+        assert res.code == 0, res.log
+        onward = chains._sent_packet(results)
+        assert onward is not None, "PFM emitted no onward packet"
+        voucher = voucher_denom(TRANSFER_PORT, b.channel_id, "utia")
+        assert b.balance(hop_addr, denom=voucher) == 0  # forwarded, not kept
+
+        ack = chains.relay(onward, src=b, dst=a)
+        assert ack == SUCCESS_ACK
+        assert a.balance(final_addr) == final_bal0 + 250
+
+    def test_forward_failure_reverts_delivery_and_refunds(self):
+        """Forward to a nonexistent channel: the error ack must revert the
+        hop mint on B (ibc-go's recv cacheCtx) so A's refund isn't backed
+        by stranded vouchers."""
+        chains = ConnectedChains(app_version=2)
+        a, b = chains.a, chains.b
+        alice = a.keys[0]
+        alice_addr = alice.public_key().address()
+        hop_addr = b.keys[0].public_key().address()
+        bal0 = a.balance(alice_addr)
+        memo = json.dumps({"forward": {"receiver": "x", "channel": "channel-99"}})
+        packet, _ = chains.transfer(a, b, alice, hop_addr, "utia", 1_000, memo=memo)
+        ack = chains.relay(packet, src=a, dst=b)
+        assert ack_is_error(ack) and b"forward failed" in ack
+        # Nothing minted or stranded on B...
+        voucher = voucher_denom(TRANSFER_PORT, b.channel_id, "utia")
+        assert b.balance(hop_addr, denom=voucher) == 0
+        assert b.balance(escrow_address(TRANSFER_PORT, "channel-99"), denom=voucher) == 0
+        # ...and A refunded the full amount (escrow empty again).
+        assert a.balance(alice_addr) == bal0 - 20_000
+        assert a.balance(escrow_address(TRANSFER_PORT, a.channel_id)) == 0
+
+    def test_malformed_forward_packet_gets_error_ack(self):
+        """A forward memo without a receiver field in the packet data must
+        produce an error ack, not a failed tx that strands the packet."""
+        from celestia_app_tpu.modules.ibc.stack import PacketForwardMiddleware
+        from celestia_app_tpu.modules.ibc.transfer import TransferKeeper, TransferModule
+
+        store = KVStore()
+        ck = ChannelKeeper(store)
+        ck.create_channel(Channel("transfer", "channel-0", "transfer", "channel-1"))
+        keeper = TransferKeeper(ck, BankKeeper(store))
+        pfm = PacketForwardMiddleware(TransferModule(keeper), keeper)
+        data = json.dumps(
+            {"denom": "utia", "amount": "5",
+             "memo": json.dumps({"forward": {"receiver": "r", "channel": "channel-0"}})}
+        ).encode()  # no top-level receiver
+        packet = Packet(1, "transfer", "channel-1", "transfer", "channel-0", data)
+        ack = pfm.on_recv_packet(None, packet)
+        assert ack_is_error(ack) and b"invalid packet data" in ack
+
+    def test_racing_recv_is_noop_success_at_delivery(self):
+        """Two relayers land MsgRecvPacket for the same packet in one
+        block: the second is a no-op success (ibc-go ErrNoOpMsg), not a
+        failed tx."""
+        chains = ConnectedChains(app_version=2)
+        a, b = chains.a, chains.b
+        packet, _ = chains.transfer(
+            a, b, a.keys[0], b.keys[0].public_key().address(), "utia", 5
+        )
+        from celestia_app_tpu.state.accounts import AuthKeeper
+        from celestia_app_tpu.tx.messages import Coin, MsgRecvPacket
+        from celestia_app_tpu.tx.sign import Fee, build_and_sign
+
+        # Two distinct relayer accounts broadcast the same recv.
+        raws = []
+        for key in (b.relayer, b.keys[2]):
+            addr = key.public_key().address()
+            acct = AuthKeeper(b.node.app.cms.working).get_account(addr)
+            raws.append(
+                build_and_sign(
+                    [MsgRecvPacket(packet.marshal(), addr)], key, b.node.chain_id,
+                    acct.account_number, acct.sequence,
+                    Fee((Coin("utia", 20_000),), 400_000),
+                )
+            )
+        assert b.node.broadcast(raws[0]).code == 0
+        assert b.node.broadcast(raws[1]).code == 0  # receipt not yet written
+        _, results = b.node.produce_block()
+        codes = [r.code for r in results]
+        assert codes == [0, 0], [r.log for r in results]
+        noop = [e for r in results for e in r.events if e[0] == "ibc.noop"]
+        assert len(noop) == 1  # exactly one of the two was the no-op
+
+    def test_no_forward_at_v1(self):
+        """The versioned stack mounts PFM only at v2 (app/app.go:336-344)."""
+        chains = ConnectedChains(app_version=1)
+        a, b = chains.a, chains.b
+        memo = json.dumps(
+            {"forward": {"receiver": "whoever", "channel": b.channel_id}}
+        )
+        packet, result = chains.transfer(
+            a, b, a.keys[0], b.keys[0].public_key().address(), "utia", 9, memo=memo
+        )
+        assert result.code == 0
+        from celestia_app_tpu.tx.messages import MsgRecvPacket
+
+        res, results = b.submit(
+            b.relayer, MsgRecvPacket(packet.marshal(), b.relayer.public_key().address())
+        )
+        assert res.code == 0
+        assert chains._sent_packet(results) is None  # delivered, not forwarded
+        voucher = voucher_denom(TRANSFER_PORT, b.channel_id, "utia")
+        assert b.balance(b.keys[0].public_key().address(), denom=voucher) == 9
