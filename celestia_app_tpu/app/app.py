@@ -76,6 +76,10 @@ class Genesis:
     gov_max_square_size: int = DEFAULT_GOV_MAX_SQUARE_SIZE
     # x/blobstream DataCommitmentWindow (types/genesis.go:29); 0 = default 400.
     data_commitment_window: int = 0
+    # Consensus Block.MaxBytes; 0 derives gov_max_square_size^2 x 478 (the
+    # reference's DefaultMaxBytes formula, initial_consts.go:10-14 — its
+    # big-block e2e manifests raise this alongside the square cap).
+    block_max_bytes: int = 0
 
 
 @dataclass(frozen=True)
@@ -185,6 +189,13 @@ class App:
             set_data_commitment_window(
                 self.cms.working, genesis.data_commitment_window
             )
+        from celestia_app_tpu.constants import CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
+        from celestia_app_tpu.modules.consensus_params import ConsensusParamsKeeper
+
+        ConsensusParamsKeeper(self.cms.working).set_block_max_bytes(
+            genesis.block_max_bytes
+            or genesis.gov_max_square_size**2 * CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
+        )
         ctx = Ctx(self.cms.working, 0, genesis.genesis_time_ns, self.app_version)
         for acc in genesis.accounts:
             a = ctx.auth.create_account(acc.address, acc.pubkey)
@@ -203,25 +214,34 @@ class App:
         ctx = Ctx(
             self._check_state, self.height + 1, self.last_block_time_ns, self.app_version
         )
+        from celestia_app_tpu.trace.metrics import registry
+
+        checked = registry().counter(
+            "celestia_checktx_total", "CheckTx admissions by result"
+        )
         btx = unmarshal_blob_tx(raw)
         inner = raw
         if btx is not None:
             try:
                 validate_blob_tx(btx)
             except BlobTxError as e:
+                checked.inc(result="rejected")
                 return TxResult(code=11, log=str(e))
             inner = btx.tx
         try:
             tx = Tx.unmarshal(inner)
             res = run_ante(self, ctx, tx, is_check_tx=True, tx_bytes=inner)
         except (AnteError, ValueError) as e:
+            checked.inc(result="rejected")
             return TxResult(code=1, log=str(e))
+        checked.inc(result="accepted")
         return TxResult(code=0, gas_wanted=res.gas_wanted, events=[("priority", res.priority)])
 
     # --- PrepareProposal (app/prepare_proposal.go:22-91) --------------------
     def prepare_proposal(self, raw_txs: list[bytes]) -> BlockData:
         # telemetry.MeasureSince parity (prepare_proposal.go:23).
         with traced().span("prepare_proposal", height=self.height + 1, n_txs=len(raw_txs)):
+            raw_txs = self._cap_block_bytes(raw_txs)
             filtered = self._filter_txs(raw_txs)
             sq, kept = square.build(filtered, self.max_effective_square_size())
             if sq.is_empty():
@@ -231,6 +251,22 @@ class App:
                 eds = extend_shares(sq.share_bytes())
                 dah = DataAvailabilityHeader.from_eds(eds)
             return BlockData(tuple(kept), sq.size, dah.hash())
+
+    def _cap_block_bytes(self, raw_txs: list[bytes]) -> list[bytes]:
+        """Keep the prefix of candidate txs fitting the on-chain
+        Block.MaxBytes consensus param (the reference's celestia-core reaps
+        the mempool under this cap before PrepareProposal sees it)."""
+        from celestia_app_tpu.modules.consensus_params import ConsensusParamsKeeper
+
+        max_bytes = ConsensusParamsKeeper(self.cms.working).block_max_bytes()
+        kept, total = [], 0
+        for raw in raw_txs:
+            if total + len(raw) > max_bytes:
+                break  # prefix semantics: a later small tx must not jump
+                # an earlier large one (sequence gaps would drop it anyway)
+            total += len(raw)
+            kept.append(raw)
+        return kept
 
     def _filter_txs(self, raw_txs: list[bytes]) -> list[bytes]:
         """FilterTxs (app/validate_txs.go:32): separate tx classes, then
@@ -274,16 +310,33 @@ class App:
 
     # --- ProcessProposal (app/process_proposal.go:24-158) -------------------
     def process_proposal(self, data: BlockData) -> bool:
+        from celestia_app_tpu.trace.metrics import registry
+
+        outcomes = registry().counter(
+            "celestia_process_proposal_total", "ProcessProposal verdicts"
+        )
         with traced().span("process_proposal", height=self.height + 1, n_txs=len(data.txs)):
             try:
-                return self._process_proposal(data)
+                ok = self._process_proposal(data)
             except Exception:
                 # recover() -> reject (process_proposal.go:29-35); counted like
                 # the reference's rejection telemetry (process_proposal.go:32).
                 traced().write("process_proposal_rejections", height=self.height + 1)
+                outcomes.inc(result="panic_reject")
                 return False
+            outcomes.inc(result="accepted" if ok else "rejected")
+            return ok
 
     def _process_proposal(self, data: BlockData) -> bool:
+        # Block.MaxBytes is consensus law, not proposer advice: an oversize
+        # block is rejected validator-side (celestia-core enforces this
+        # around the reference app; here the app is the enforcement point).
+        from celestia_app_tpu.modules.consensus_params import ConsensusParamsKeeper
+
+        if sum(len(t) for t in data.txs) > ConsensusParamsKeeper(
+            self.cms.working
+        ).block_max_bytes():
+            return False
         ctx = Ctx(
             self.cms.working.branch(),
             self.height + 1,
@@ -327,6 +380,13 @@ class App:
         self._begin_block(ctx, time_ns)
         results = [self._deliver_tx(ctx, raw) for raw in txs]
         self._end_block(ctx, height)
+        from celestia_app_tpu.trace.metrics import registry
+
+        delivered = registry().counter(
+            "celestia_txs_delivered_total", "delivered txs by result code"
+        )
+        for r in results:
+            delivered.inc(code=str(r.code))
 
         self.cms.working.write_back(block_store)
         self.height = height
@@ -336,6 +396,11 @@ class App:
     def commit(self) -> bytes:
         app_hash = self.cms.commit(self.height)
         self._check_state = None  # reset mempool check state each block
+        from celestia_app_tpu.trace.metrics import registry
+
+        registry().gauge("celestia_block_height", "last committed height").set(
+            self.height
+        )
         return app_hash
 
     def _begin_block(self, ctx: Ctx, time_ns: int) -> None:

@@ -83,7 +83,11 @@ def cmd_init(args) -> int:
     }
     with open(_genesis_path(home), "w") as f:
         json.dump(genesis, f, indent=2)
+    from celestia_app_tpu.cmd.config import write_default_configs
+
+    cfg_path, app_cfg_path = write_default_configs(home)
     print(f"initialized chain {args.chain_id!r} at {home}")
+    print(f"wrote {cfg_path} and {app_cfg_path}")
     return 0
 
 
@@ -106,10 +110,10 @@ def _load_genesis(home: str) -> Genesis:
     )
 
 
-def load_app(home: str) -> App:
+def load_app(home: str, node_min_gas_price: Dec | None = None) -> App:
     """Construct the App from a home dir, resuming committed state if any."""
     genesis = _load_genesis(home)
-    app = App(node_min_gas_price=Dec.from_str("0.000001"))
+    app = App(node_min_gas_price=node_min_gas_price or Dec.from_str("0.000001"))
     if os.path.exists(_state_path(home)):
         app.cms = CommitStore.load(_state_path(home))
         with open(_meta_path(home)) as f:
@@ -169,7 +173,33 @@ def _write_snapshot(home: str, app: App, keep: int = 2) -> str:
 
 
 def cmd_start(args) -> int:
-    app = load_app(args.home)
+    # Tier 2 (files) + tier 1 (CLI/env) resolution, viper-style precedence
+    # (cmd/celestia-appd/cmd/root.go:33,55,72-80).
+    from celestia_app_tpu.cmd.config import (
+        load_configs,
+        min_gas_price_from_config,
+        resolve_option,
+    )
+
+    consensus_cfg, app_cfg = load_configs(args.home)
+    args.snapshot_interval = resolve_option(
+        args.snapshot_interval, "SNAPSHOT_INTERVAL",
+        app_cfg.statesync.snapshot_interval, 1500, cast=int,
+    )
+    args.block_interval = resolve_option(
+        args.block_interval, "BLOCK_INTERVAL", None, 15.0, cast=float
+    )
+    # Min gas price resolves lazily tier by tier: a malformed app.toml must
+    # not block a start that overrides it from the CLI or environment.
+    cli_price = getattr(args, "min_gas_price", None)
+    env_price = os.environ.get("CELESTIA_MIN_GAS_PRICE")
+    if cli_price is not None:
+        min_gas = Dec.from_str(cli_price)
+    elif env_price is not None:
+        min_gas = Dec.from_str(env_price)
+    else:
+        min_gas = min_gas_price_from_config(app_cfg)
+    app = load_app(args.home, node_min_gas_price=min_gas)
     if args.warmup != "none":
         from celestia_app_tpu.da.eds import warmup
 
@@ -365,9 +395,13 @@ def main(argv: list[str] | None = None) -> int:
 
     p = sub.add_parser("start", help="run the node loop")
     p.add_argument("--blocks", type=int, default=0, help="0 = forever")
-    p.add_argument("--block-interval", type=float, default=15.0)
+    # None = unset: the 3-tier resolution in cmd_start falls back to env
+    # CELESTIA_* then config.toml/app.toml then the built-in defaults.
+    p.add_argument("--block-interval", type=float, default=None)
     p.add_argument("--no-sleep", action="store_true")
-    p.add_argument("--snapshot-interval", type=int, default=1500)
+    p.add_argument("--snapshot-interval", type=int, default=None)
+    p.add_argument("--min-gas-price", default=None,
+                   help="node min gas price in utia (tier-1 override)")
     p.add_argument("--serve", action="store_true",
                    help="serve the JSON-RPC endpoint (broadcast/query/proofs)")
     p.add_argument("--rpc-port", type=int, default=26657)

@@ -98,8 +98,11 @@ def default_param_setters(store: KVStore) -> dict[tuple[str, str], Callable[[str
     from celestia_app_tpu.modules.blobstream.keeper import set_data_commitment_window
     from celestia_app_tpu.modules.minfee import MinFeeKeeper
 
+    from celestia_app_tpu.modules.consensus_params import ConsensusParamsKeeper
+
     blob = BlobParamsKeeper(store)
     minfee = MinFeeKeeper(store)
+    consensus = ConsensusParamsKeeper(store)
     return {
         ("blob", "GasPerBlobByte"): lambda v: blob.set_gas_per_blob_byte(int(v)),
         ("blob", "GovMaxSquareSize"): lambda v: blob.set_gov_max_square_size(int(v)),
@@ -109,6 +112,10 @@ def default_param_setters(store: KVStore) -> dict[tuple[str, str], Callable[[str
         ("blobstream", "DataCommitmentWindow"): lambda v: set_data_commitment_window(
             store, int(v)
         ),
+        # baseapp BlockParams (gov-settable in the reference — the big-block
+        # e2e raises MaxBytes through governance).
+        ("baseapp", "BlockMaxBytes"): lambda v: consensus.set_block_max_bytes(int(v)),
+        ("baseapp", "BlockMaxGas"): lambda v: consensus.set_block_max_gas(int(v)),
     }
 
 
@@ -336,7 +343,10 @@ class GovKeeper:
             )
             for c in p.changes:
                 self._setters[(c.subspace, c.key)](c.value)
-        except ValueError:
+        except (ValueError, OverflowError):
+            # OverflowError included: a passed proposal with an absurd value
+            # (e.g. BlockMaxBytes >= 2^64) must FAIL cleanly, not halt the
+            # chain out of the end blocker.
             return ProposalStatus.FAILED
         return ProposalStatus.PASSED
 

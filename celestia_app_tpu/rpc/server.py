@@ -394,6 +394,22 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet: tests parse stdout
         pass
 
+    def do_GET(self):
+        """GET /metrics: Prometheus text exposition (the Tendermint
+        instrumentation analog, test/e2e/testnet/setup.go:24)."""
+        if self.path.rstrip("/") != "/metrics":
+            self.send_response(404)
+            self.end_headers()
+            return
+        from celestia_app_tpu.trace.metrics import registry
+
+        payload = registry().render().encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
     def do_POST(self):
         try:
             length = int(self.headers.get("Content-Length", "0"))

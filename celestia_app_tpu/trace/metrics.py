@@ -1,0 +1,133 @@
+"""Prometheus-style metrics: registry + text exposition.
+
+The reference exposes node metrics through Tendermint's Prometheus
+instrumentation (test/e2e/testnet/setup.go:24, node.go:125) and counts
+app-level events via sdk telemetry (rejected txs/panics,
+app/validate_txs.go:61,91, process_proposal.go:32).  This module carries
+the same role: counters/gauges/histograms incremented at those points,
+rendered in the Prometheus text exposition format on the serving plane's
+GET /metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_text: str):
+        self.name = name
+        self.help = help_text
+        self._values: dict[tuple, float] = defaultdict(float)
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        with self._lock:
+            self._values[tuple(sorted(labels.items()))] += amount
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            items = list(self._values.items()) or [((), 0.0)]
+        for key, val in items:
+            out.append(f"{self.name}{_fmt_labels(dict(key))} {val:g}")
+        return out
+
+
+class Gauge(Counter):
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[tuple(sorted(labels.items()))] = value
+
+    def render(self) -> list[str]:
+        return [
+            line.replace(" counter", " gauge", 1) if line.startswith("# TYPE") else line
+            for line in super().render()
+        ]
+
+
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus semantics)."""
+
+    def __init__(self, name: str, help_text: str, buckets: tuple[float, ...]):
+        self.name = name
+        self.help = help_text
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)  # +Inf tail
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._sum += value
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self._counts[i] += 1
+                    break
+            else:
+                self._counts[-1] += 1
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:
+            cumulative = 0
+            for b, c in zip(self.buckets, self._counts):
+                cumulative += c
+                out.append(f'{self.name}_bucket{{le="{b:g}"}} {cumulative}')
+            cumulative += self._counts[-1]
+            out.append(f'{self.name}_bucket{{le="+Inf"}} {cumulative}')
+            out.append(f"{self.name}_sum {self._sum:g}")
+            out.append(f"{self.name}_count {cumulative}")
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, Counter | Gauge | Histogram] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_text: str = "") -> Counter:
+        return self._get_or_make(name, lambda: Counter(name, help_text), Counter)
+
+    def gauge(self, name: str, help_text: str = "") -> Gauge:
+        return self._get_or_make(name, lambda: Gauge(name, help_text), Gauge)
+
+    def histogram(
+        self, name: str, help_text: str = "",
+        buckets: tuple[float, ...] = (0.005, 0.025, 0.1, 0.5, 2.5, 10.0),
+    ) -> Histogram:
+        return self._get_or_make(
+            name, lambda: Histogram(name, help_text, buckets), Histogram
+        )
+
+    def _get_or_make(self, name, factory, kind):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = factory()
+            elif type(m) is not kind:
+                raise TypeError(f"metric {name} already registered as {type(m).__name__}")
+            return m
+
+    def render(self) -> str:
+        lines: list[str] = []
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        for m in metrics:
+            lines += m.render()
+        return "\n".join(lines) + "\n"
+
+
+_REGISTRY = Registry()
+
+
+def registry() -> Registry:
+    return _REGISTRY

@@ -36,14 +36,21 @@ class Tracer:
 
     @contextmanager
     def span(self, table: str, **attrs):
-        """Measure a wall-time span into `table` (MeasureSince analog)."""
+        """Measure a wall-time span into `table` (MeasureSince analog);
+        the same measurement lands in the Prometheus histogram
+        celestia_<table>_seconds for the /metrics exposition."""
         start = time.perf_counter_ns()
         try:
             yield
         finally:
-            self.write(
-                table, duration_ms=(time.perf_counter_ns() - start) / 1e6, **attrs
-            )
+            elapsed_ns = time.perf_counter_ns() - start
+            self.write(table, duration_ms=elapsed_ns / 1e6, **attrs)
+            if self.enabled:
+                from celestia_app_tpu.trace.metrics import registry
+
+                registry().histogram(
+                    f"celestia_{table}_seconds", f"wall time of {table}"
+                ).observe(elapsed_ns / 1e9)
 
     def table(self, name: str) -> list[dict]:
         return list(self._tables.get(name, []))

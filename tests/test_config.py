@@ -1,0 +1,178 @@
+"""Three-tier config system + on-chain consensus params (SURVEY §5)."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from celestia_app_tpu.cmd.config import (
+    AppTomlConfig,
+    ConsensusConfig,
+    load_configs,
+    min_gas_price_from_config,
+    resolve_option,
+    write_default_configs,
+)
+from celestia_app_tpu.modules.consensus_params import (
+    DEFAULT_BLOCK_MAX_BYTES,
+    ConsensusParamsKeeper,
+)
+from celestia_app_tpu.constants import CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
+from celestia_app_tpu.state.store import KVStore
+from celestia_app_tpu.testutil import TestNode, deterministic_genesis, funded_keys
+
+
+class TestFileTier:
+    def test_init_writes_and_loads_defaults(self, tmp_path):
+        home = str(tmp_path)
+        cfg_path, app_path = write_default_configs(home)
+        assert os.path.exists(cfg_path) and os.path.exists(app_path)
+        consensus, app = load_configs(home)
+        # celestia-tuned values (default_overrides.go:258-301).
+        assert consensus.mempool.version == "v1"
+        assert consensus.mempool.ttl_num_blocks == 5
+        assert consensus.rpc.max_body_bytes == 8 * 1024 * 1024
+        assert consensus.consensus.timeout_propose_s == 10
+        assert app.statesync.snapshot_interval == 1500
+        assert app.statesync.snapshot_keep_recent == 2
+        assert app.min_gas_prices == "0.002utia"
+
+    def test_edited_file_wins_over_default(self, tmp_path):
+        home = str(tmp_path)
+        write_default_configs(home)
+        path = os.path.join(home, "config", "app.toml")
+        text = open(path).read().replace("snapshot_interval = 1500",
+                                         "snapshot_interval = 77")
+        open(path, "w").write(text)
+        _, app = load_configs(home)
+        assert app.statesync.snapshot_interval == 77
+
+    def test_existing_files_not_clobbered(self, tmp_path):
+        home = str(tmp_path)
+        write_default_configs(home)
+        path = os.path.join(home, "config", "config.toml")
+        open(path, "w").write('[mempool]\nversion = "v0"\n')
+        write_default_configs(home)  # second init must not overwrite
+        consensus, _ = load_configs(home)
+        assert consensus.mempool.version == "v0"
+
+    def test_missing_files_fall_back_to_defaults(self, tmp_path):
+        consensus, app = load_configs(str(tmp_path))
+        assert consensus.mempool.ttl_num_blocks == 5
+        assert str(min_gas_price_from_config(app)) .startswith("0.002")
+
+
+class TestPrecedence:
+    def test_cli_beats_env_beats_file(self, monkeypatch):
+        monkeypatch.setenv("CELESTIA_SNAPSHOT_INTERVAL", "200")
+        assert resolve_option(99, "SNAPSHOT_INTERVAL", 300, 1500, cast=int) == 99
+        assert resolve_option(None, "SNAPSHOT_INTERVAL", 300, 1500, cast=int) == 200
+        monkeypatch.delenv("CELESTIA_SNAPSHOT_INTERVAL")
+        assert resolve_option(None, "SNAPSHOT_INTERVAL", 300, 1500, cast=int) == 300
+        assert resolve_option(None, "SNAPSHOT_INTERVAL", None, 1500, cast=int) == 1500
+
+
+class TestOnChainConsensusParams:
+    def test_defaults_and_genesis_derivation(self):
+        k = ConsensusParamsKeeper(KVStore())
+        assert k.block_max_bytes() == DEFAULT_BLOCK_MAX_BYTES == 64 * 64 * CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
+        assert k.block_max_gas() == -1
+
+        node = TestNode()  # gov square 64
+        assert (
+            ConsensusParamsKeeper(node.app.cms.working).block_max_bytes()
+            == 64 * 64 * CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
+        )
+        keys = funded_keys(2)
+        big = TestNode(deterministic_genesis(keys, gov_max_square_size=128), keys)
+        assert (
+            ConsensusParamsKeeper(big.app.cms.working).block_max_bytes()
+            == 128 * 128 * CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
+        )
+
+    def test_gov_can_raise_max_bytes(self):
+        from celestia_app_tpu.modules.gov import GovKeeper, ParamChange
+        from celestia_app_tpu.state.staking import StakingKeeper, Validator
+
+        store = KVStore()
+        staking = StakingKeeper(store)
+        staking.set_validator(Validator("v1", b"", 100))
+        gov = GovKeeper(store, staking)
+        pid = gov.submit_param_change(
+            "v1", [ParamChange("baseapp", "BlockMaxBytes", str(8 * 1024 * 1024))]
+        )
+        gov.vote(pid, "v1", True)
+        assert gov.tally_and_execute(pid)
+        assert ConsensusParamsKeeper(store).block_max_bytes() == 8 * 1024 * 1024
+
+    def test_absurd_gov_value_fails_cleanly(self):
+        """A passed proposal with BlockMaxBytes >= 2^64 must FAIL the
+        proposal, not crash the end blocker (OverflowError containment)."""
+        from celestia_app_tpu.modules.gov import (
+            DEFAULT_MIN_DEPOSIT,
+            GovKeeper,
+            ParamChange,
+            ProposalStatus,
+            VoteOption,
+            WEEK_NS,
+        )
+        from celestia_app_tpu.state.staking import StakingKeeper, Validator
+
+        store = KVStore()
+        staking = StakingKeeper(store)
+        staking.set_validator(Validator("v1", b"", 100))
+        gov = GovKeeper(store, staking)
+        pid = gov.submit(
+            "v1", [ParamChange("baseapp", "BlockMaxBytes", str(2**64))],
+            DEFAULT_MIN_DEPOSIT, time_ns=0,
+        )
+        gov.vote(pid, "v1", VoteOption.YES, time_ns=1)
+        events = gov.end_blocker(time_ns=WEEK_NS + 1)  # must not raise
+        assert events == [("gov.proposal_failed", pid)]
+        assert ConsensusParamsKeeper(store).block_max_bytes() == DEFAULT_BLOCK_MAX_BYTES
+
+    def test_oversize_block_rejected_validator_side(self):
+        """MaxBytes is consensus law: a hand-built oversize proposal is
+        rejected by ProcessProposal, not just avoided by the proposer."""
+        from celestia_app_tpu.app.app import BlockData
+
+        keys = funded_keys(2)
+        node = TestNode(deterministic_genesis(keys, gov_max_square_size=16), keys)
+        cap = ConsensusParamsKeeper(node.app.cms.working).block_max_bytes()
+        fat = BlockData((b"\x00" * (cap + 1),), 1, b"\x11" * 32)
+        assert not node.app.process_proposal(fat)
+
+    def test_min_gas_price_parser(self):
+        cfg = AppTomlConfig(min_gas_prices="0.002utia,0.001uatom")
+        assert str(min_gas_price_from_config(cfg)).startswith("0.002")
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            min_gas_price_from_config(AppTomlConfig(min_gas_prices="1e-6utia"))
+        with _pytest.raises(ValueError):
+            min_gas_price_from_config(AppTomlConfig(min_gas_prices="0.01uatom"))
+
+    def test_cap_is_prefix_not_filter(self):
+        """_cap_block_bytes keeps the PREFIX under the cap: a later small
+        tx must not jump past an earlier large one (sequence order)."""
+        keys = funded_keys(2)
+        node = TestNode(deterministic_genesis(keys, gov_max_square_size=16), keys)
+        cap = ConsensusParamsKeeper(node.app.cms.working).block_max_bytes()
+        txs = [b"\x01" * (cap - 10), b"\x02" * 100, b"\x03" * 5]
+        kept = node.app._cap_block_bytes(txs)
+        assert kept == [txs[0]]  # stops at the first overflow
+
+    def test_prepare_respects_max_bytes(self):
+        """A proposer packs only txs fitting the on-chain cap."""
+        keys = funded_keys(2)
+        node = TestNode(
+            deterministic_genesis(keys, gov_max_square_size=16), keys
+        )
+        cap = ConsensusParamsKeeper(node.app.cms.working).block_max_bytes()
+        assert cap == 16 * 16 * CONTINUATION_SPARSE_SHARE_CONTENT_SIZE  # 123,392
+        # A candidate list that exceeds the cap gets pruned to fit.
+        fat = [b"\x01" * 100_000, b"\x02" * 30_000]  # 130k > the cap
+        kept = node.app._cap_block_bytes(fat)
+        assert kept == [b"\x01" * 100_000]  # second tx would overflow
+        assert sum(map(len, kept)) <= cap

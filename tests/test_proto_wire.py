@@ -1,0 +1,284 @@
+"""Wire compatibility: the hand-rolled codecs vs real protobuf.
+
+Round 1 flagged that encoding/ + tx/ codecs were only roundtrip-tested
+against themselves.  Here the proto definitions under proto/ are compiled
+with protoc and every implemented message is serialized both ways — the
+hand codec's bytes must equal google.protobuf's exactly, and each side
+must parse the other's output.  That is the same guarantee a Go
+counterparty gives us, since Go protobuf emits canonical field-ordered
+bytes for these message shapes.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module")
+def pb(tmp_path_factory):
+    """Compile proto/ with protoc and import the generated modules."""
+    out = tmp_path_factory.mktemp("protogen")
+    protos = sorted(str(p) for p in (REPO / "proto").rglob("*.proto"))
+    subprocess.run(
+        ["protoc", f"--proto_path={REPO / 'proto'}", f"--python_out={out}", *protos],
+        check=True,
+    )
+    sys.path.insert(0, str(out))
+    try:
+        import importlib
+
+        mods = {
+            "blob": importlib.import_module("celestia.core.v1.blob.blob_pb2"),
+            "pfb": importlib.import_module("celestia.blob.v1.tx_pb2"),
+            "iw": importlib.import_module("celestia.core.v1.tx.tx_pb2"),
+            "da": importlib.import_module(
+                "celestia.core.v1.da.data_availability_header_pb2"
+            ),
+            "tx": importlib.import_module("cosmos.tx.v1beta1.tx_pb2"),
+            "bank": importlib.import_module("cosmos.bank.v1beta1.tx_pb2"),
+            "coin": importlib.import_module("cosmos.bank.v1beta1.coin_pb2"),
+            "gov": importlib.import_module("cosmos.gov.v1beta1.tx_pb2"),
+            "chan": importlib.import_module("ibc.core.channel.v1.tx_pb2"),
+            "transfer": importlib.import_module(
+                "ibc.applications.transfer.v1.tx_pb2"
+            ),
+        }
+        yield mods
+    finally:
+        sys.path.remove(str(out))
+
+
+class TestBlobWire:
+    def test_blob_and_blobtx(self, pb):
+        from celestia_app_tpu.shares.namespace import Namespace
+        from celestia_app_tpu.shares.sparse import Blob
+        from celestia_app_tpu.tx.envelopes import BlobTx, marshal_blob
+
+        ns = Namespace.v0(b"wire-test!")
+        blob = Blob(ns, b"some blob payload" * 9)
+        ref = pb["blob"].Blob(
+            namespace_id=ns.id, data=blob.data,
+            share_version=0, namespace_version=0,
+        )
+        assert marshal_blob(blob) == ref.SerializeToString()
+
+        btx = BlobTx(b"\x0a\x05inner", (blob,))
+        ref_btx = pb["blob"].BlobTx(tx=b"\x0a\x05inner", blobs=[ref], type_id="BLOB")
+        assert btx.marshal() == ref_btx.SerializeToString()
+        # And our decoder accepts protobuf's bytes.
+        from celestia_app_tpu.tx.envelopes import unmarshal_blob_tx
+
+        decoded = unmarshal_blob_tx(ref_btx.SerializeToString())
+        assert decoded is not None and decoded.blobs[0].data == blob.data
+
+    def test_index_wrapper(self, pb):
+        from celestia_app_tpu.tx.envelopes import IndexWrapper
+
+        iw = IndexWrapper(b"wrapped-tx", (5, 17))
+        ref = pb["iw"].IndexWrapper(
+            tx=b"wrapped-tx", share_indexes=[5, 17], type_id="INDX"
+        )
+        assert iw.marshal() == ref.SerializeToString()
+
+    def test_msg_pay_for_blobs(self, pb):
+        from celestia_app_tpu.tx.messages import MsgPayForBlobs
+
+        msg = MsgPayForBlobs(
+            "celestia1signer", (b"\x00" * 29,), (1234,), (b"\x11" * 32,), (0,)
+        )
+        ref = pb["pfb"].MsgPayForBlobs(
+            signer="celestia1signer", namespaces=[b"\x00" * 29],
+            blob_sizes=[1234], share_commitments=[b"\x11" * 32],
+            share_versions=[0],
+        )
+        assert msg.marshal() == ref.SerializeToString()
+        assert MsgPayForBlobs.unmarshal(ref.SerializeToString()) == msg
+
+    def test_dah(self, pb):
+        from celestia_app_tpu.da.dah import DataAvailabilityHeader
+
+        dah = DataAvailabilityHeader((b"\x01" * 90, b"\x02" * 90), (b"\x03" * 90,))
+        ref = pb["da"].DataAvailabilityHeader(
+            row_roots=[b"\x01" * 90, b"\x02" * 90], column_roots=[b"\x03" * 90]
+        )
+        assert dah.marshal() == ref.SerializeToString()
+
+
+class TestTxEnvelopeWire:
+    def _tx_parts(self):
+        from celestia_app_tpu.crypto.keys import PrivateKey
+        from celestia_app_tpu.tx.messages import Coin, MsgSend
+        from celestia_app_tpu.tx.sign import AuthInfo, Fee, SignerInfo, TxBody
+
+        key = PrivateKey.from_seed(b"wire")
+        msg = MsgSend("celestia1from", "celestia1to", (Coin("utia", 42),))
+        body = TxBody((msg.to_any(),), memo="hello", timeout_height=99)
+        auth = AuthInfo(
+            (SignerInfo(key.public_key(), 7),), Fee((Coin("utia", 2000),), 100_000)
+        )
+        return key, msg, body, auth
+
+    def test_body_and_auth_info(self, pb):
+        from google.protobuf import any_pb2
+
+        key, msg, body, auth = self._tx_parts()
+        ref_msg = pb["bank"].MsgSend(
+            from_address="celestia1from", to_address="celestia1to",
+            amount=[pb["coin"].Coin(denom="utia", amount="42")],
+        )
+        assert msg.marshal() == ref_msg.SerializeToString()
+
+        ref_any = any_pb2.Any(
+            type_url="/cosmos.bank.v1beta1.MsgSend", value=ref_msg.SerializeToString()
+        )
+        ref_body = pb["tx"].TxBody(messages=[ref_any], memo="hello", timeout_height=99)
+        assert body.marshal() == ref_body.SerializeToString()
+
+        ref_pub = any_pb2.Any(
+            type_url="/cosmos.crypto.secp256k1.PubKey",
+            value=pb["tx"].PubKeySecp256k1(key=key.public_key().bytes).SerializeToString(),
+        )
+        ref_auth = pb["tx"].AuthInfo(
+            signer_infos=[
+                pb["tx"].SignerInfo(
+                    public_key=ref_pub,
+                    mode_info=pb["tx"].ModeInfo(single=pb["tx"].ModeInfo.Single(mode=1)),
+                    sequence=7,
+                )
+            ],
+            fee=pb["tx"].Fee(
+                amount=[pb["coin"].Coin(denom="utia", amount="2000")], gas_limit=100_000
+            ),
+        )
+        assert auth.marshal() == ref_auth.SerializeToString()
+
+    def test_txraw_and_signdoc(self, pb):
+        from celestia_app_tpu.tx.sign import Tx, sign_doc_bytes
+
+        key, msg, body, auth = self._tx_parts()
+        body_b, auth_b = body.marshal(), auth.marshal()
+        tx = Tx(body_b, auth_b, (b"\x99" * 64,))
+        ref = pb["tx"].TxRaw(
+            body_bytes=body_b, auth_info_bytes=auth_b, signatures=[b"\x99" * 64]
+        )
+        assert tx.marshal() == ref.SerializeToString()
+
+        doc = sign_doc_bytes(body_b, auth_b, "wire-chain", 12)
+        ref_doc = pb["tx"].SignDoc(
+            body_bytes=body_b, auth_info_bytes=auth_b,
+            chain_id="wire-chain", account_number=12,
+        )
+        assert doc == ref_doc.SerializeToString()
+
+    def test_protobuf_encoded_tx_passes_our_decoder(self, pb):
+        """A tx assembled entirely by google.protobuf decodes and verifies
+        through our stack (what a foreign cosmos client would send)."""
+        from celestia_app_tpu.tx.sign import Tx
+
+        key, msg, body, auth = self._tx_parts()
+        ref_tx = pb["tx"].TxRaw(
+            body_bytes=body.marshal(), auth_info_bytes=auth.marshal(),
+            signatures=[b"\x01"],
+        )
+        ours = Tx.unmarshal(ref_tx.SerializeToString())
+        msgs = ours.msgs()
+        assert len(msgs) == 1 and msgs[0].to_address == "celestia1to"
+        assert ours.auth_info.fee.gas_limit == 100_000
+
+
+class TestGovAndIBCWire:
+    def test_gov_msgs(self, pb):
+        from google.protobuf import any_pb2
+
+        from celestia_app_tpu.tx.messages import (
+            Coin,
+            MsgDeposit,
+            MsgSubmitProposal,
+            MsgVote,
+            ProposalParamChange,
+        )
+
+        msg = MsgSubmitProposal(
+            "t", "d", (ProposalParamChange("blob", "GasPerBlobByte", "16"),),
+            (Coin("utia", 100),), "celestia1prop",
+        )
+        ref_content = pb["gov"].ParameterChangeProposal(
+            title="t", description="d",
+            changes=[pb["gov"].ParamChange(subspace="blob", key="GasPerBlobByte", value="16")],
+        )
+        ref = pb["gov"].MsgSubmitProposal(
+            content=any_pb2.Any(
+                type_url="/cosmos.params.v1beta1.ParameterChangeProposal",
+                value=ref_content.SerializeToString(),
+            ),
+            initial_deposit=[pb["coin"].Coin(denom="utia", amount="100")],
+            proposer="celestia1prop",
+        )
+        assert msg.marshal() == ref.SerializeToString()
+
+        vote = MsgVote(3, "celestia1v", 1)
+        assert vote.marshal() == pb["gov"].MsgVote(
+            proposal_id=3, voter="celestia1v", option=1
+        ).SerializeToString()
+        dep = MsgDeposit(3, "celestia1d", (Coin("utia", 5),))
+        assert dep.marshal() == pb["gov"].MsgDeposit(
+            proposal_id=3, depositor="celestia1d",
+            amount=[pb["coin"].Coin(denom="utia", amount="5")],
+        ).SerializeToString()
+
+    def test_ibc_packet_and_relay_msgs(self, pb):
+        from celestia_app_tpu.modules.ibc.core import Height, Packet
+        from celestia_app_tpu.tx.messages import (
+            Coin,
+            MsgAcknowledgement,
+            MsgRecvPacket,
+            MsgTimeout,
+            MsgTransfer,
+        )
+
+        packet = Packet(
+            9, "transfer", "channel-0", "transfer", "channel-1",
+            b'{"denom":"utia"}', Height(1, 500), 123456789,
+        )
+        ref_packet = pb["chan"].Packet(
+            sequence=9, source_port="transfer", source_channel="channel-0",
+            destination_port="transfer", destination_channel="channel-1",
+            data=b'{"denom":"utia"}',
+            timeout_height=pb["chan"].Height(revision_number=1, revision_height=500),
+            timeout_timestamp=123456789,
+        )
+        assert packet.marshal() == ref_packet.SerializeToString()
+        assert Packet.unmarshal(ref_packet.SerializeToString()) == packet
+
+        recv = MsgRecvPacket(packet.marshal(), "celestia1relayer")
+        assert recv.marshal() == pb["chan"].MsgRecvPacket(
+            packet=ref_packet, signer="celestia1relayer"
+        ).SerializeToString()
+        ack = MsgAcknowledgement(packet.marshal(), "celestia1relayer", b"ACK")
+        assert ack.marshal() == pb["chan"].MsgAcknowledgement(
+            packet=ref_packet, acknowledgement=b"ACK", signer="celestia1relayer"
+        ).SerializeToString()
+        to = MsgTimeout(packet.marshal(), "celestia1relayer", proof_height=77)
+        assert to.marshal() == pb["chan"].MsgTimeout(
+            packet=ref_packet, proof_height=pb["chan"].Height(revision_height=77),
+            signer="celestia1relayer",
+        ).SerializeToString()
+
+        xfer = MsgTransfer(
+            "transfer", "channel-0", Coin("utia", 55), "celestia1s", "cosmos1r",
+            timeout_revision_height=400, timeout_timestamp_ns=999, memo="m",
+        )
+        ref_xfer = pb["transfer"].MsgTransfer(
+            source_port="transfer", source_channel="channel-0",
+            token=pb["coin"].Coin(denom="utia", amount="55"),
+            sender="celestia1s", receiver="cosmos1r",
+            timeout_height=pb["chan"].Height(revision_height=400),
+            timeout_timestamp=999, memo="m",
+        )
+        assert xfer.marshal() == ref_xfer.SerializeToString()

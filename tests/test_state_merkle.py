@@ -171,7 +171,10 @@ class TestConsistentAppHash:
     deliberate state-machine change, a consensus-breaking change slipped in;
     if deliberate, update the pin in the same commit."""
 
-    PINNED = "ed29988818711a2970fe585fc5901c27b07cd0289dce8acafa9ef6db97d57c8d"
+    # Re-pinned deliberately: genesis now writes the on-chain consensus
+    # params key (Block.MaxBytes derived from the gov square) into state —
+    # a consensus-breaking state-layout change.
+    PINNED = "9a1f84d4144f76aebcce41945185b5dcad0c0e65cae034becd9d1d5f856486d3"
 
     @staticmethod
     def _run_chain() -> str:
