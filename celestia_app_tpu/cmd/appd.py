@@ -21,8 +21,14 @@ import time
 
 # Pin the JAX platform from the environment BEFORE any backend client can
 # be created: site hooks may pre-register an accelerator platform that
-# ignores a later env change (same guard as tests/conftest.py).
+# ignores a later env change (same guard as tests/conftest.py).  When the
+# operator explicitly excludes the accelerator, also drop the plugin's
+# pool env — a wedged tunnel otherwise stalls even CPU-pinned runs at
+# first compile (the plugin initializes regardless of the selected
+# platform).
 if os.environ.get("JAX_PLATFORMS"):
+    if "axon" not in os.environ["JAX_PLATFORMS"]:
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     import jax
 
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])

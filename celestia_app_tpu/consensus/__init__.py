@@ -1,0 +1,19 @@
+from celestia_app_tpu.consensus.votes import (
+    PRECOMMIT,
+    PREVOTE,
+    Commit,
+    ConsensusError,
+    Vote,
+    VoteSet,
+    verify_commit,
+)
+
+__all__ = [
+    "Commit",
+    "ConsensusError",
+    "PRECOMMIT",
+    "PREVOTE",
+    "Vote",
+    "VoteSet",
+    "verify_commit",
+]

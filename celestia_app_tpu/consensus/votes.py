@@ -1,0 +1,182 @@
+"""BFT votes: signed prevotes/precommits with +2/3 power aggregation.
+
+The reference's consensus (celestia-core, Tendermint v0.34) gossips votes
+over p2p; a block commits only with >2/3 of validator power precommitting
+its block id, and the resulting Commit is what light clients verify.  This
+module carries that vote layer for the serving plane's replication
+(rpc/server.py): one voting round per height — proposal -> prevotes ->
+commit -> precommits -> queryable Commit record — with Tendermint's
+>2/3-power rule and per-vote secp256k1 signatures over domain-separated
+sign bytes.
+
+Honest scope (PARITY.md): single round per height, no round changes, nil
+votes, locking, or evidence; the proposer drives the round rather than a
+gossip mesh.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from celestia_app_tpu.crypto.keys import PrivateKey, PublicKey
+from celestia_app_tpu.encoding.proto import (
+    WIRE_LEN,
+    WIRE_VARINT,
+    decode_fields,
+    encode_bytes_field,
+    encode_varint_field,
+)
+
+PREVOTE = 1
+PRECOMMIT = 2
+_TYPE_NAMES = {PREVOTE: "prevote", PRECOMMIT: "precommit"}
+
+
+class ConsensusError(RuntimeError):
+    pass
+
+
+def vote_sign_bytes(chain_id: str, height: int, vote_type: int, block_hash: bytes) -> bytes:
+    """Canonical vote sign bytes (the CanonicalVote analog): chain-id
+    domain separation so votes can never be replayed across chains."""
+    return (
+        encode_bytes_field(1, b"celestia-tpu/vote")
+        + encode_bytes_field(2, chain_id.encode())
+        + encode_varint_field(3, height)
+        + encode_varint_field(4, vote_type)
+        + encode_bytes_field(5, block_hash)
+    )
+
+
+@dataclass(frozen=True)
+class Vote:
+    height: int
+    vote_type: int  # PREVOTE | PRECOMMIT
+    block_hash: bytes
+    validator: str  # operator address
+    signature: bytes
+
+    @classmethod
+    def sign(
+        cls, key: PrivateKey, chain_id: str, height: int, vote_type: int,
+        block_hash: bytes,
+    ) -> "Vote":
+        return cls(
+            height, vote_type, block_hash, key.public_key().address(),
+            key.sign(vote_sign_bytes(chain_id, height, vote_type, block_hash)),
+        )
+
+    def verify(self, pubkey: PublicKey, chain_id: str) -> bool:
+        return pubkey.verify(
+            vote_sign_bytes(chain_id, self.height, self.vote_type, self.block_hash),
+            self.signature,
+        )
+
+    def marshal(self) -> bytes:
+        return (
+            encode_varint_field(1, self.height)
+            + encode_varint_field(2, self.vote_type)
+            + encode_bytes_field(3, self.block_hash)
+            + encode_bytes_field(4, self.validator.encode())
+            + encode_bytes_field(5, self.signature)
+        )
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "Vote":
+        ints = {n: v for n, wt, v in decode_fields(raw) if wt == WIRE_VARINT}
+        b = {n: v for n, wt, v in decode_fields(raw) if wt == WIRE_LEN}
+        return cls(
+            ints.get(1, 0), ints.get(2, 0), b.get(3, b""),
+            b.get(4, b"").decode(), b.get(5, b""),
+        )
+
+
+class VoteSet:
+    """One (height, type, block hash) aggregation with power accounting.
+
+    `validators` maps operator address -> (PublicKey, power); add() verifies
+    membership, target, and signature before counting the power."""
+
+    def __init__(
+        self,
+        chain_id: str,
+        height: int,
+        vote_type: int,
+        block_hash: bytes,
+        validators: dict[str, tuple[PublicKey, int]],
+    ):
+        self.chain_id = chain_id
+        self.height = height
+        self.vote_type = vote_type
+        self.block_hash = block_hash
+        self.validators = validators
+        self.votes: dict[str, Vote] = {}
+
+    def add(self, vote: Vote) -> None:
+        kind = _TYPE_NAMES.get(self.vote_type, "?")
+        if vote.height != self.height or vote.vote_type != self.vote_type:
+            raise ConsensusError(
+                f"{kind} for wrong height/type: {vote.height}/{vote.vote_type}"
+            )
+        if vote.block_hash != self.block_hash:
+            raise ConsensusError(
+                f"{kind} from {vote.validator} for a different block"
+            )
+        entry = self.validators.get(vote.validator)
+        if entry is None:
+            raise ConsensusError(f"{kind} from non-validator {vote.validator}")
+        if vote.validator in self.votes:
+            return  # idempotent
+        pubkey, _power = entry
+        if not vote.verify(pubkey, self.chain_id):
+            raise ConsensusError(f"bad {kind} signature from {vote.validator}")
+        self.votes[vote.validator] = vote
+
+    def signed_power(self) -> int:
+        return sum(self.validators[v][1] for v in self.votes)
+
+    def total_power(self) -> int:
+        return sum(p for _, p in self.validators.values())
+
+    def has_two_thirds(self) -> bool:
+        """Tendermint's strict rule: 3 x signed > 2 x total."""
+        return 3 * self.signed_power() > 2 * self.total_power()
+
+
+@dataclass(frozen=True)
+class Commit:
+    """The queryable proof a height committed: +2/3 precommits."""
+
+    height: int
+    block_hash: bytes
+    precommits: tuple[Vote, ...]
+
+    def to_json(self) -> dict:
+        return {
+            "height": self.height,
+            "block_hash": self.block_hash.hex(),
+            "precommits": [v.marshal().hex() for v in self.precommits],
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Commit":
+        return cls(
+            d["height"], bytes.fromhex(d["block_hash"]),
+            tuple(Vote.unmarshal(bytes.fromhex(v)) for v in d["precommits"]),
+        )
+
+
+def verify_commit(
+    validators: dict[str, tuple[PublicKey, int]],
+    chain_id: str,
+    commit: Commit,
+) -> bool:
+    """Light-client check: does this Commit carry >2/3 of the given
+    validator set's power in valid precommit signatures?"""
+    vs = VoteSet(chain_id, commit.height, PRECOMMIT, commit.block_hash, validators)
+    for vote in commit.precommits:
+        try:
+            vs.add(vote)
+        except ConsensusError:
+            return False  # a forged/foreign vote poisons the commit
+    return vs.has_two_thirds()

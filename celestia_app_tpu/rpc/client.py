@@ -117,6 +117,45 @@ class RemoteNode:
             txs=[t.hex() for t in data.txs],
         )
 
+    # --- voting round (consensus/votes.py) -----------------------------------
+    def propose(self, height: int, time_ns: int, data) -> dict:
+        return self.call(
+            "propose",
+            height=height,
+            time_ns=time_ns,
+            data_hash=data.hash.hex(),
+            square_size=data.square_size,
+            txs=[t.hex() for t in data.txs],
+        )
+
+    def precommit(self, height: int, block_hash: bytes, prevotes: list[str]) -> dict:
+        return self.call(
+            "precommit",
+            height=height,
+            data_hash=block_hash.hex(),
+            prevotes=prevotes,
+        )
+
+    def finalize_commit(self, height: int, time_ns: int, data, commit: dict) -> dict:
+        return self.call(
+            "finalize_commit",
+            height=height,
+            time_ns=time_ns,
+            data_hash=data.hash.hex(),
+            square_size=data.square_size,
+            txs=[t.hex() for t in data.txs],
+            commit=commit,
+        )
+
+    def commit(self, height: int):
+        """The height's Commit record, parsed — None if the node has none."""
+        res = self.call("commit", height=height)
+        if res is None:
+            return None
+        from celestia_app_tpu.consensus import Commit
+
+        return Commit.from_json(res)
+
     # --- proof queries (verify client-side against the fetched roots) --------
     def tx_inclusion_proof(self, height: int, tx_index: int):
         from celestia_app_tpu.rpc.codec import share_proof_from_json

@@ -44,8 +44,22 @@ class ServingNode(TestNode):
         validator_index: int = 0,
         n_validators: int = 1,
         peers: list[str] | None = None,
+        validator_key=None,
     ):
         super().__init__(genesis, keys, app=app)
+        from celestia_app_tpu.crypto.keys import PrivateKey
+
+        # This node's consensus key (signs prevotes/precommits). Defaults
+        # to the deterministic seed matching deterministic_genesis's
+        # validator set; operators pass their own.
+        self.validator_key = validator_key or PrivateKey.from_seed(
+            f"validator-{validator_index}".encode()
+        )
+        # height -> Commit: the +2/3 precommit records light clients verify.
+        self._commits: dict[int, "object"] = {}
+        # height -> block hash this node prevoted (it precommits only what
+        # it prevoted — the vote-consistency rule).
+        self._prevoted: dict[int, bytes] = {}
         # (BlockData, time_ns) by height: survives serving a restarted
         # chain (list index != height) and feeds peer catch-up.
         self._blocks_by_height: dict[int, tuple[BlockData, int]] = {}
@@ -90,21 +104,121 @@ class ServingNode(TestNode):
         with self._produce_lock:
             return self._produce_and_replicate(time_ns)
 
+    def _validator_set(self):
+        """address -> (PublicKey, power), the vote-accounting view."""
+        from celestia_app_tpu.crypto.keys import PublicKey
+        from celestia_app_tpu.state.staking import StakingKeeper
+
+        out = {}
+        for v in StakingKeeper(self.app.cms.working).validators():
+            if v.pubkey:
+                out[v.address] = (PublicKey(v.pubkey), v.power)
+        return out
+
+    def _sign_vote(self, height: int, vote_type: int, block_hash: bytes):
+        from celestia_app_tpu.consensus import Vote
+
+        return Vote.sign(
+            self.validator_key, self.chain_id, height, vote_type, block_hash
+        )
+
+    def _commit_block_data(self, data: BlockData, time_ns: int):
+        """The shared commit sequence + the serving plane's per-height
+        bookkeeping (block store for catch-up, app version for clients)."""
+        proposal_version = self.app.app_version  # pre-end-block upgrades
+        results = super()._commit_block_data(data, time_ns)
+        height = self.app.height
+        self._blocks_by_height[height] = (data, time_ns)
+        self._version_by_height[height] = proposal_version
+        self._prevoted.pop(height, None)  # round done
+        return results
+
     def _produce_and_replicate(self, produce_time_ns: int | None = None):
+        """One voting round per height (celestia-core's consensus shape,
+        proposer-driven — scope note in consensus/votes.py):
+
+          propose -> prevotes -> +2/3? -> precommits -> +2/3?
+          -> commit everywhere with the Commit record
+
+        Both quorum gates run BEFORE any node commits state: a failed round
+        leaves every validator exactly where it was.  Every node that
+        applies the block stores the Commit record (rpc_commit serves it).
+        """
+        from celestia_app_tpu.consensus import (
+            PRECOMMIT,
+            PREVOTE,
+            Commit,
+            ConsensusError,
+            Vote,
+            VoteSet,
+        )
+
+        peers = self.peers()
         with self.lock:
-            proposal_version = self.app.app_version  # pre-end-block upgrades
-            data, results = super().produce_block(produce_time_ns)
-            height = self.app.height
-            time_ns = self.app.last_block_time_ns
+            validators = self._validator_set()
+            time_ns = (
+                produce_time_ns
+                if produce_time_ns is not None
+                else self.app.last_block_time_ns + BLOCK_INTERVAL_NS
+            )
+            height = self.app.height + 1
+            data = self.app.prepare_proposal(self.mempool.reap(self.block_max_bytes()))
+            if not self.app.process_proposal(data):
+                raise AssertionError("node rejected its own proposal")
+            # Phase 1: prevotes (peers validate, nobody commits yet).
+            prevotes = VoteSet(self.chain_id, height, PREVOTE, data.hash, validators)
+            prevotes.add(self._sign_vote(height, PREVOTE, data.hash))
+        # Unreachable or refusing peers are tolerated — BFT advances as
+        # long as +2/3 answers; they catch up from the block store later.
+        for peer in peers:
+            try:
+                reply = peer.propose(height, time_ns, data)
+                prevotes.add(Vote.unmarshal(bytes.fromhex(reply["prevote"])))
+            except Exception:
+                continue
+        # Quorum is enforced when replicating to peers; a solo dev node
+        # (one process, however many genesis validators) commits alone.
+        if peers and not prevotes.has_two_thirds():
+            raise ConsensusError(
+                f"no +2/3 prevotes at height {height}: "
+                f"{prevotes.signed_power()}/{prevotes.total_power()}"
+            )
+        prevotes_wire = [v.marshal().hex() for v in prevotes.votes.values()]
+
+        # Phase 2: precommits — still no state committed anywhere.
+        precommits = VoteSet(self.chain_id, height, PRECOMMIT, data.hash, validators)
+        precommits.add(self._sign_vote(height, PRECOMMIT, data.hash))
+        for peer in peers:
+            try:
+                reply = peer.precommit(height, data.hash, prevotes_wire)
+                precommits.add(Vote.unmarshal(bytes.fromhex(reply["precommit"])))
+            except Exception:
+                continue
+        if peers and not precommits.has_two_thirds():
+            raise ConsensusError(
+                f"no +2/3 precommits at height {height}: "
+                f"{precommits.signed_power()}/{precommits.total_power()}"
+            )
+        commit = Commit(height, data.hash, tuple(precommits.votes.values()))
+
+        # Phase 3: the commit is decided — apply everywhere, carrying the
+        # Commit record so every node serves it.
+        with self.lock:
+            results = self._commit_block_data(data, time_ns)
             own_app_hash = self.app.cms.last_app_hash
-            self._blocks_by_height[height] = (data, time_ns)
-            self._version_by_height[height] = proposal_version
-        for peer in self.peers():
-            reply = peer.apply_block(height, time_ns, data)
+            self._commits[height] = commit
+        commit_wire = commit.to_json()
+        for peer in peers:
+            try:
+                reply = peer.finalize_commit(height, time_ns, data, commit_wire)
+            except Exception:
+                continue  # down peer: catch-up recovers it later
             if (
                 bytes.fromhex(reply["app_hash"]) != own_app_hash
                 or bytes.fromhex(reply["data_hash"]) != data.hash
             ):
+                # Divergence is never tolerated: identical inputs MUST land
+                # on identical state (the determinism contract).
                 raise ReplicationDivergence(
                     f"peer {peer.url} diverged at height {height}: "
                     f"{reply['app_hash'][:16]} != {own_app_hash.hex()[:16]}"
@@ -127,16 +241,9 @@ class ServingNode(TestNode):
                 raise ValueError(
                     f"out-of-order block {height}, at {self.app.height}"
                 )
-            proposal_version = self.app.app_version  # pre-end-block upgrades
             if not self.app.process_proposal(data):
                 raise ValueError(f"proposal rejected at height {height}")
-            results = self.app.finalize_block(time_ns, list(data.txs))
-            self.app.commit()
-            self.mempool.update(self.app.height, list(data.txs))
-            self.blocks.append(data)
-            self._blocks_by_height[height] = (data, time_ns)
-            self._version_by_height[height] = proposal_version
-            self.index_block(height, list(data.txs), results)
+            self._commit_block_data(data, time_ns)
             return {
                 "app_hash": self.app.cms.last_app_hash.hex(),
                 "data_hash": data.hash.hex(),
@@ -234,6 +341,105 @@ class ServingNode(TestNode):
             hash=bytes.fromhex(data_hash),
         )
         return self.apply_block(height, time_ns, data)
+
+    # --- the voting round (consensus/votes.py; scope note there) -------------
+    def rpc_propose(
+        self, height: int, time_ns: int, data_hash: str, square_size: int,
+        txs: list[str],
+    ) -> dict:
+        """Phase 1: validate the proposal, answer with a signed prevote.
+        No state is committed here."""
+        from celestia_app_tpu.consensus import PREVOTE
+
+        data = BlockData(
+            txs=tuple(bytes.fromhex(t) for t in txs),
+            square_size=square_size,
+            hash=bytes.fromhex(data_hash),
+        )
+        with self.lock:
+            behind = height > self.app.height + 1
+        if behind:
+            self._catch_up(height - 1)
+        with self.lock:
+            if height != self.app.height + 1:
+                raise ValueError(
+                    f"cannot prevote height {height}, at {self.app.height}"
+                )
+            if not self.app.process_proposal(data):
+                raise ValueError(f"proposal rejected at height {height}")
+            prevote = self._sign_vote(height, PREVOTE, data.hash)
+            self._prevoted[height] = data.hash
+        return {"prevote": prevote.marshal().hex()}
+
+    def rpc_precommit(
+        self, height: int, data_hash: str, prevotes: list[str]
+    ) -> dict:
+        """Phase 2: shown a +2/3 prevote set for the block this node
+        prevoted, sign a precommit.  NO state is committed here — both
+        quorum gates precede any application (Tendermint's ordering)."""
+        from celestia_app_tpu.consensus import (
+            PRECOMMIT,
+            PREVOTE,
+            ConsensusError,
+            Vote,
+            VoteSet,
+        )
+
+        block_hash = bytes.fromhex(data_hash)
+        with self.lock:
+            if self._prevoted.get(height) != block_hash:
+                raise ConsensusError(
+                    f"will not precommit height {height}: not the block "
+                    "this node prevoted"
+                )
+            vote_set = VoteSet(
+                self.chain_id, height, PREVOTE, block_hash, self._validator_set()
+            )
+        for raw in prevotes:
+            vote_set.add(Vote.unmarshal(bytes.fromhex(raw)))
+        if not vote_set.has_two_thirds():
+            raise ConsensusError(
+                f"precommit without +2/3 prevotes at height {height}: "
+                f"{vote_set.signed_power()}/{vote_set.total_power()}"
+            )
+        with self.lock:
+            precommit = self._sign_vote(height, PRECOMMIT, block_hash)
+        return {"precommit": precommit.marshal().hex()}
+
+    def rpc_finalize_commit(
+        self, height: int, time_ns: int, data_hash: str, square_size: int,
+        txs: list[str], commit: dict,
+    ) -> dict:
+        """Phase 3: the round is decided — verify the Commit record
+        (+2/3 precommits), apply the block, and keep the record so this
+        node serves it too."""
+        from celestia_app_tpu.consensus import Commit, ConsensusError, verify_commit
+
+        data = BlockData(
+            txs=tuple(bytes.fromhex(t) for t in txs),
+            square_size=square_size,
+            hash=bytes.fromhex(data_hash),
+        )
+        record = Commit.from_json(commit)
+        with self.lock:
+            validators = self._validator_set()
+        if (
+            record.height != height
+            or record.block_hash != data.hash
+            or not verify_commit(validators, self.chain_id, record)
+        ):
+            raise ConsensusError(f"invalid commit record for height {height}")
+        reply = self.apply_block(height, time_ns, data)
+        with self.lock:
+            self._commits[height] = record
+        return reply
+
+    def rpc_commit(self, height: int) -> dict | None:
+        """The Commit record (+2/3 precommits) for a height, if this node
+        drove or learned that round — what a light client verifies."""
+        with self.lock:
+            commit = self._commits.get(height)
+        return None if commit is None else commit.to_json()
 
     def rpc_tx_inclusion_proof(self, height: int, tx_index: int) -> dict:
         from celestia_app_tpu.proof.querier import query_tx_inclusion_proof

@@ -105,15 +105,30 @@ class TestNode:
         """
         if time_ns is None:
             time_ns = self.app.last_block_time_ns + BLOCK_INTERVAL_NS
-        data = self.app.prepare_proposal(self.mempool.reap())
+        data = self.app.prepare_proposal(self.mempool.reap(self.block_max_bytes()))
         if not self.app.process_proposal(data):
             raise AssertionError("node rejected its own proposal")
+        results = self._commit_block_data(data, time_ns)
+        return data, results
+
+    def block_max_bytes(self) -> int:
+        """The on-chain Block.MaxBytes cap the mempool reaps under (the
+        reference's celestia-core reap budget) — skip-semantics in the
+        mempool, so one oversized high-priority tx cannot blank blocks."""
+        from celestia_app_tpu.modules.consensus_params import ConsensusParamsKeeper
+
+        return ConsensusParamsKeeper(self.app.cms.working).block_max_bytes()
+
+    def _commit_block_data(self, data: BlockData, time_ns: int) -> list[TxResult]:
+        """Execute + commit an already-validated block and do the node
+        bookkeeping — the single copy of the commit sequence shared by the
+        local produce path and the serving plane's replication paths."""
         results = self.app.finalize_block(time_ns, list(data.txs))
         self.app.commit()
         self.mempool.update(self.app.height, list(data.txs))
         self.blocks.append(data)
         self.index_block(self.app.height, list(data.txs), results)
-        return data, results
+        return results
 
     # --- query surface shared with the RPC plane ---------------------------
     def index_block(self, height: int, txs: list[bytes], results: list[TxResult]) -> None:

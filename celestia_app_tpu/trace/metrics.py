@@ -15,6 +15,14 @@ import threading
 from collections import defaultdict
 
 
+def _fmt_value(v: float) -> str:
+    """Full-precision exposition (prometheus_client style): integers stay
+    integral; %g would round counters past ~1e6."""
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
 def _fmt_labels(labels: dict[str, str]) -> str:
     if not labels:
         return ""
@@ -38,7 +46,7 @@ class Counter:
         with self._lock:
             items = list(self._values.items()) or [((), 0.0)]
         for key, val in items:
-            out.append(f"{self.name}{_fmt_labels(dict(key))} {val:g}")
+            out.append(f"{self.name}{_fmt_labels(dict(key))} {_fmt_value(val)}")
         return out
 
 
@@ -84,7 +92,7 @@ class Histogram:
                 out.append(f'{self.name}_bucket{{le="{b:g}"}} {cumulative}')
             cumulative += self._counts[-1]
             out.append(f'{self.name}_bucket{{le="+Inf"}} {cumulative}')
-            out.append(f"{self.name}_sum {self._sum:g}")
+            out.append(f"{self.name}_sum {_fmt_value(self._sum)}")
             out.append(f"{self.name}_count {cumulative}")
         return out
 

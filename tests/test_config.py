@@ -163,6 +163,18 @@ class TestOnChainConsensusParams:
         kept = node.app._cap_block_bytes(txs)
         assert kept == [txs[0]]  # stops at the first overflow
 
+    def test_oversize_tx_cannot_blank_blocks(self):
+        """An oversized high-priority mempool tx is skipped by the reap
+        budget (skip semantics), so later txs still fill blocks — no
+        head-of-line chain stall."""
+        keys = funded_keys(2)
+        node = TestNode(deterministic_genesis(keys, gov_max_square_size=16), keys)
+        cap = node.block_max_bytes()
+        node.mempool.insert(b"\xff" * (cap + 1), priority=10**9, height=0)
+        node.mempool.insert(b"\x01" * 100, priority=1, height=0)
+        reaped = node.mempool.reap(cap)
+        assert reaped == [b"\x01" * 100]  # oversize skipped, small kept
+
     def test_prepare_respects_max_bytes(self):
         """A proposer packs only txs fitting the on-chain cap."""
         keys = funded_keys(2)

@@ -1,0 +1,231 @@
+"""BFT votes over the serving plane: prevote/precommit rounds, +2/3 power
+accounting, Commit records, and light-client verification.
+
+Reference: Tendermint's vote/commit machinery (celestia-core), which the
+round-1 review flagged as absent from the replication path ("no BFT
+votes").  Scope note in consensus/votes.py.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from celestia_app_tpu.consensus import (
+    PRECOMMIT,
+    PREVOTE,
+    Commit,
+    ConsensusError,
+    Vote,
+    VoteSet,
+    verify_commit,
+)
+from celestia_app_tpu.crypto.keys import PrivateKey
+from celestia_app_tpu.rpc.client import RemoteNode
+from celestia_app_tpu.rpc.server import ServingNode, serve
+from celestia_app_tpu.testutil.testnode import deterministic_genesis, funded_keys
+
+HASH = b"\xab" * 32
+
+
+def _val_keys(n: int) -> list[PrivateKey]:
+    return [PrivateKey.from_seed(f"validator-{i}".encode()) for i in range(n)]
+
+
+def _valset(keys, powers=None):
+    powers = powers or [100] * len(keys)
+    return {
+        k.public_key().address(): (k.public_key(), p)
+        for k, p in zip(keys, powers)
+    }
+
+
+class TestVotes:
+    def test_sign_verify_roundtrip(self):
+        key = _val_keys(1)[0]
+        v = Vote.sign(key, "chain-a", 5, PREVOTE, HASH)
+        assert v.verify(key.public_key(), "chain-a")
+        assert not v.verify(key.public_key(), "chain-b")  # chain-id domain
+        assert Vote.unmarshal(v.marshal()) == v
+
+    def test_voteset_strict_two_thirds(self):
+        keys = _val_keys(3)
+        vs = VoteSet("c", 1, PREVOTE, HASH, _valset(keys))
+        vs.add(Vote.sign(keys[0], "c", 1, PREVOTE, HASH))
+        vs.add(Vote.sign(keys[1], "c", 1, PREVOTE, HASH))
+        # 200/300 is NOT > 2/3 (Tendermint's strict rule).
+        assert not vs.has_two_thirds()
+        vs.add(Vote.sign(keys[2], "c", 1, PREVOTE, HASH))
+        assert vs.has_two_thirds()
+
+    def test_voteset_rejections(self):
+        keys = _val_keys(2)
+        outsider = PrivateKey.from_seed(b"not-a-validator")
+        vs = VoteSet("c", 1, PREVOTE, HASH, _valset(keys))
+        with pytest.raises(ConsensusError, match="non-validator"):
+            vs.add(Vote.sign(outsider, "c", 1, PREVOTE, HASH))
+        with pytest.raises(ConsensusError, match="different block"):
+            vs.add(Vote.sign(keys[0], "c", 1, PREVOTE, b"\x00" * 32))
+        with pytest.raises(ConsensusError, match="wrong height"):
+            vs.add(Vote.sign(keys[0], "c", 2, PREVOTE, HASH))
+        forged = Vote(1, PREVOTE, HASH, keys[0].public_key().address(), b"\x01" * 64)
+        with pytest.raises(ConsensusError, match="bad prevote signature"):
+            vs.add(forged)
+        # Duplicate votes are idempotent, power counted once.
+        vs.add(Vote.sign(keys[0], "c", 1, PREVOTE, HASH))
+        vs.add(Vote.sign(keys[0], "c", 1, PREVOTE, HASH))
+        assert vs.signed_power() == 100
+
+    def test_verify_commit(self):
+        keys = _val_keys(4)
+        vals = _valset(keys)
+        votes = tuple(Vote.sign(k, "c", 9, PRECOMMIT, HASH) for k in keys[:3])
+        commit = Commit(9, HASH, votes)
+        assert verify_commit(vals, "c", commit)  # 300/400 > 2/3
+        assert not verify_commit(vals, "c", Commit(9, HASH, votes[:2]))  # 200/400
+        assert not verify_commit(vals, "other-chain", commit)
+        # A forged vote poisons the whole commit.
+        forged = Vote(9, PRECOMMIT, HASH, keys[3].public_key().address(), b"z")
+        assert not verify_commit(vals, "c", Commit(9, HASH, votes + (forged,)))
+        assert Commit.from_json(commit.to_json()) == commit
+
+
+def _cluster(n_live: int, n_validators: int):
+    """n_live in-process served validators out of an n_validators genesis."""
+    keys = funded_keys(3)
+    nodes, servers = [], []
+    for i in range(n_live):
+        node = ServingNode(
+            genesis=deterministic_genesis(keys, n_validators=n_validators),
+            keys=keys,
+            validator_index=i,
+            n_validators=n_validators,
+        )
+        server = serve(node, port=0, block_interval_s=None)
+        nodes.append(node)
+        servers.append(server)
+    for i, node in enumerate(nodes):
+        node.peer_urls = [s.url for j, s in enumerate(servers) if j != i]
+    return nodes, servers
+
+
+class TestVotingRound:
+    def test_three_validator_round_produces_commit(self):
+        nodes, servers = _cluster(3, 3)
+        try:
+            data, _ = nodes[0].produce_block()
+            # All three committed the block.
+            assert all(n.app.height == 1 for n in nodes)
+            remote = RemoteNode(servers[0].url)
+            commit = remote.commit(1)
+            assert commit is not None and commit.height == 1
+            assert commit.block_hash == data.hash
+            assert len(commit.precommits) == 3
+            # Light-client check against the served validator set +
+            # deterministic consensus keys.
+            vals = _valset(_val_keys(3))
+            assert verify_commit(vals, nodes[0].chain_id, commit)
+            # A different block hash does not verify.
+            bad = Commit(1, b"\x00" * 32, commit.precommits)
+            assert not verify_commit(vals, nodes[0].chain_id, bad)
+        finally:
+            for s in servers:
+                s.stop()
+
+    def test_no_quorum_blocks_production(self):
+        """2 of 3 equal validators = exactly 2/3, NOT +2/3: the proposer
+        must refuse to commit (one dead peer, one live)."""
+        nodes, servers = _cluster(2, 3)
+        try:
+            # Point the proposer at the live peer AND a dead address for
+            # validator 2.
+            nodes[0].peer_urls = [servers[1].url, "http://127.0.0.1:9"]
+            nodes[0]._peers = []
+            with pytest.raises(ConsensusError, match=r"no \+2/3 prevotes"):
+                nodes[0].produce_block()
+            assert nodes[0].app.height == 0  # nothing committed
+            assert nodes[1].app.height == 0
+        finally:
+            for s in servers:
+                s.stop()
+
+    def test_tolerates_minority_failure(self):
+        """3 live of 4 validators (300/400 > 2/3): production advances
+        with the dead peer, which is simply absent from the commit."""
+        nodes, servers = _cluster(3, 4)
+        try:
+            nodes[0].peer_urls = nodes[0].peer_urls + ["http://127.0.0.1:9"]
+            nodes[0]._peers = []
+            data, _ = nodes[0].produce_block()
+            commit = nodes[0]._commits[1]
+            assert len(commit.precommits) == 3
+            vals = _valset(_val_keys(4))
+            assert verify_commit(vals, nodes[0].chain_id, commit)
+        finally:
+            for s in servers:
+                s.stop()
+
+    def test_peer_precommits_only_what_it_prevoted(self):
+        """A peer refuses to precommit (a) a block it never prevoted and
+        (b) a prevote set short of +2/3 — and no state commits either way."""
+        nodes, servers = _cluster(2, 3)
+        try:
+            remote = RemoteNode(servers[1].url)
+            from celestia_app_tpu.rpc.client import RPCError
+
+            data = nodes[0].app.prepare_proposal([])
+            keys = _val_keys(3)
+            prevotes = [
+                Vote.sign(k, nodes[0].chain_id, 1, PREVOTE, data.hash).marshal().hex()
+                for k in keys
+            ]
+            # (a) never prevoted: refuse even with a full prevote set.
+            with pytest.raises(RPCError, match="not the block"):
+                remote.precommit(1, data.hash, prevotes)
+            # Prevote first, then (b) a short set still refuses.
+            reply = remote.propose(
+                1, nodes[0].app.last_block_time_ns + 1, data
+            )
+            assert "prevote" in reply
+            with pytest.raises(RPCError, match=r"\+2/3 prevotes"):
+                remote.precommit(1, data.hash, prevotes[:1])
+            # With quorum shown, the precommit comes back — still height 0.
+            out = remote.precommit(1, data.hash, prevotes)
+            assert "precommit" in out
+            assert nodes[1].app.height == 0  # voting never commits state
+        finally:
+            for s in servers:
+                s.stop()
+
+    def test_all_nodes_serve_the_commit_record(self):
+        """Finding from review: the Commit must be learnable by every node
+        that applied the block, not just the proposer."""
+        nodes, servers = _cluster(3, 3)
+        try:
+            nodes[0].produce_block()
+            for i in range(3):
+                commit = RemoteNode(servers[i].url).commit(1)
+                assert commit is not None and len(commit.precommits) == 3, i
+        finally:
+            for s in servers:
+                s.stop()
+
+    def test_forged_commit_record_refused_at_finalize(self):
+        nodes, servers = _cluster(2, 3)
+        try:
+            remote = RemoteNode(servers[1].url)
+            from celestia_app_tpu.rpc.client import RPCError
+
+            data = nodes[0].app.prepare_proposal([])
+            keys = _val_keys(3)
+            short = Commit(
+                1, data.hash,
+                (Vote.sign(keys[0], nodes[0].chain_id, 1, PRECOMMIT, data.hash),),
+            )
+            with pytest.raises(RPCError, match="invalid commit record"):
+                remote.finalize_commit(
+                    1, nodes[0].app.last_block_time_ns + 1, data, short.to_json()
+                )
+            assert nodes[1].app.height == 0
+        finally:
+            for s in servers:
+                s.stop()
