@@ -46,6 +46,8 @@ from celestia_app_tpu.state.accounts import FEE_COLLECTOR
 from celestia_app_tpu.state.dec import Dec
 from celestia_app_tpu.tx.messages import (
     MsgAcknowledgement,
+    MsgBeginRedelegate,
+    MsgDelegate,
     MsgDeposit,
     MsgPayForBlobs,
     MsgRecvPacket,
@@ -55,6 +57,7 @@ from celestia_app_tpu.tx.messages import (
     MsgTimeout,
     MsgTransfer,
     MsgTryUpgrade,
+    MsgUndelegate,
     MsgVote,
 )
 from celestia_app_tpu.tx.sign import Tx
@@ -73,6 +76,7 @@ class AnteError(ValueError):
 _V1_MSGS = {
     MsgSend, MsgPayForBlobs, MsgSubmitProposal, MsgVote, MsgDeposit,
     MsgTransfer, MsgRecvPacket, MsgAcknowledgement, MsgTimeout,
+    MsgDelegate, MsgUndelegate, MsgBeginRedelegate,
 }
 _V2_MSGS = _V1_MSGS | {MsgSignalVersion, MsgTryUpgrade}
 

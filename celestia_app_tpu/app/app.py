@@ -44,6 +44,8 @@ from celestia_app_tpu.state.store import CommitStore, KVStore
 from celestia_app_tpu.tx.envelopes import unmarshal_blob_tx
 from celestia_app_tpu.tx.messages import (
     MsgAcknowledgement,
+    MsgBeginRedelegate,
+    MsgDelegate,
     MsgDeposit,
     MsgPayForBlobs,
     MsgRecvPacket,
@@ -53,6 +55,7 @@ from celestia_app_tpu.tx.messages import (
     MsgTimeout,
     MsgTransfer,
     MsgTryUpgrade,
+    MsgUndelegate,
     MsgVote,
 )
 from celestia_app_tpu.trace import traced
@@ -476,6 +479,31 @@ class App:
             return 0, []
         if isinstance(msg, (MsgTransfer, MsgRecvPacket, MsgAcknowledgement, MsgTimeout)):
             return self._handle_ibc_msg(ctx, msg)
+        if isinstance(msg, (MsgDelegate, MsgUndelegate, MsgBeginRedelegate)):
+            if msg.amount.denom != "utia":  # x/staking ErrBadDenom
+                raise ValueError(
+                    f"invalid bond denom {msg.amount.denom!r}, expected utia"
+                )
+            amount = msg.amount.amount
+            if isinstance(msg, MsgDelegate):
+                ctx.staking.delegate(
+                    ctx.bank, msg.delegator_address, msg.validator_address, amount
+                )
+                return 0, [("cosmos.staking.v1beta1.EventDelegate",
+                            msg.validator_address, amount)]
+            if isinstance(msg, MsgUndelegate):
+                completion = ctx.staking.undelegate(
+                    ctx.bank, msg.delegator_address, msg.validator_address,
+                    amount, ctx.time_ns,
+                )
+                return 0, [("cosmos.staking.v1beta1.EventUnbond",
+                            msg.validator_address, amount, completion)]
+            ctx.staking.begin_redelegate(
+                msg.delegator_address, msg.validator_address,
+                msg.validator_dst_address, amount,
+            )
+            return 0, [("cosmos.staking.v1beta1.EventRedelegate",
+                        msg.validator_address, msg.validator_dst_address, amount)]
         if isinstance(msg, (MsgSubmitProposal, MsgVote, MsgDeposit)):
             from celestia_app_tpu.modules.gov import GovKeeper, ParamChange
 
@@ -583,6 +611,9 @@ class App:
         from celestia_app_tpu.modules.gov import GovKeeper
 
         GovKeeper(ctx.store, ctx.staking, ctx.bank).end_blocker(ctx.time_ns)
+        # Matured unbonding delegations release back to delegators
+        # (x/staking EndBlocker's unbonding queue).
+        ctx.staking.complete_unbondings(ctx.bank, ctx.time_ns)
         if self.app_version == 1:
             from celestia_app_tpu.modules.blobstream.keeper import BlobstreamKeeper
 

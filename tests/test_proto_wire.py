@@ -192,6 +192,43 @@ class TestTxEnvelopeWire:
         assert ours.auth_info.fee.gas_limit == 100_000
 
 
+class TestStakingWire:
+    def test_staking_msgs(self, pb):
+        import importlib
+
+        from celestia_app_tpu.tx.messages import (
+            Coin,
+            MsgBeginRedelegate,
+            MsgDelegate,
+            MsgUndelegate,
+        )
+
+        staking = importlib.import_module("cosmos.staking.v1beta1.tx_pb2")
+        d = MsgDelegate("celestia1del", "celestiavaloper1x", Coin("utia", 777))
+        ref = staking.MsgDelegate(
+            delegator_address="celestia1del", validator_address="celestiavaloper1x",
+            amount=pb["coin"].Coin(denom="utia", amount="777"),
+        )
+        assert d.marshal() == ref.SerializeToString()
+        assert MsgDelegate.unmarshal(ref.SerializeToString()) == d
+
+        u = MsgUndelegate("celestia1del", "celestiavaloper1x", Coin("utia", 5))
+        assert u.marshal() == staking.MsgUndelegate(
+            delegator_address="celestia1del", validator_address="celestiavaloper1x",
+            amount=pb["coin"].Coin(denom="utia", amount="5"),
+        ).SerializeToString()
+
+        r = MsgBeginRedelegate(
+            "celestia1del", "celestiavaloper1x", Coin("utia", 9), "celestiavaloper1y"
+        )
+        assert r.marshal() == staking.MsgBeginRedelegate(
+            delegator_address="celestia1del",
+            validator_src_address="celestiavaloper1x",
+            validator_dst_address="celestiavaloper1y",
+            amount=pb["coin"].Coin(denom="utia", amount="9"),
+        ).SerializeToString()
+
+
 class TestGovAndIBCWire:
     def test_gov_msgs(self, pb):
         from google.protobuf import any_pb2

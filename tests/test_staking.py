@@ -1,0 +1,225 @@
+"""x/staking delegations: escrowed stake, power updates, unbonding,
+redelegation — and their ripple into signal/blobstream/consensus power.
+
+Reference: cosmos-sdk x/staking as the reference consumes it
+(MsgDelegate/MsgUndelegate/MsgBeginRedelegate via test/txsim/stake.go;
+UnbondingTime = 3 weeks, appconsts initial_consts.go:28; power =
+tokens / 10^6, the sdk's DefaultPowerReduction).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from celestia_app_tpu.state.accounts import BankKeeper
+from celestia_app_tpu.state.staking import (
+    BONDED_POOL,
+    NOT_BONDED_POOL,
+    POWER_REDUCTION,
+    StakingError,
+    StakingKeeper,
+    UNBONDING_TIME_NS,
+    Validator,
+)
+from celestia_app_tpu.state.store import KVStore
+from celestia_app_tpu.testutil import TestNode, deterministic_genesis, funded_keys
+from celestia_app_tpu.tx.messages import (
+    Coin,
+    MsgBeginRedelegate,
+    MsgDelegate,
+    MsgUndelegate,
+)
+
+
+def _keeper(powers={"v1": 100, "v2": 100}, balances={"alice": 50 * POWER_REDUCTION}):
+    store = KVStore()
+    sk = StakingKeeper(store)
+    for a, p in powers.items():
+        sk.set_validator(Validator(a, b"", p))
+    bank = BankKeeper(store)
+    for a, amt in balances.items():
+        bank.mint(a, amt)
+    return sk, bank
+
+
+class TestDelegation:
+    def test_delegate_escrows_and_raises_power(self):
+        sk, bank = _keeper()
+        sk.delegate(bank, "alice", "v1", 5 * POWER_REDUCTION)
+        assert bank.balance("alice") == 45 * POWER_REDUCTION
+        assert bank.balance(BONDED_POOL) == 5 * POWER_REDUCTION
+        assert sk.get_power("v1") == 105  # 100 genesis + 5 delegated
+        assert sk.delegation("alice", "v1") == 5 * POWER_REDUCTION
+        assert sk.total_power() == 205
+
+    def test_delegate_rejections(self):
+        sk, bank = _keeper()
+        with pytest.raises(StakingError, match="no validator"):
+            sk.delegate(bank, "alice", "ghost", 100)
+        with pytest.raises(StakingError, match="positive"):
+            sk.delegate(bank, "alice", "v1", 0)
+        with pytest.raises(StakingError):  # underfunded
+            sk.delegate(bank, "alice", "v1", 10**18)
+
+    def test_undelegate_unbonds_over_three_weeks(self):
+        sk, bank = _keeper()
+        sk.delegate(bank, "alice", "v1", 10 * POWER_REDUCTION)
+        completion = sk.undelegate(bank, "alice", "v1", 4 * POWER_REDUCTION, time_ns=1000)
+        assert completion == 1000 + UNBONDING_TIME_NS
+        # Power drops immediately; funds move to the not-bonded pool.
+        assert sk.get_power("v1") == 106
+        assert bank.balance(NOT_BONDED_POOL) == 4 * POWER_REDUCTION
+        assert bank.balance("alice") == 40 * POWER_REDUCTION  # not yet released
+        # Before maturity: nothing; at maturity: released.
+        assert sk.complete_unbondings(bank, completion - 1) == []
+        released = sk.complete_unbondings(bank, completion)
+        assert released == [("alice", 4 * POWER_REDUCTION)]
+        assert bank.balance("alice") == 44 * POWER_REDUCTION
+        assert bank.balance(NOT_BONDED_POOL) == 0
+        # Cannot undelegate more than delegated.
+        with pytest.raises(StakingError, match="invalid undelegation"):
+            sk.undelegate(bank, "alice", "v1", 100 * POWER_REDUCTION, time_ns=0)
+
+    def test_self_redelegation_rejected(self):
+        sk, bank = _keeper()
+        sk.delegate(bank, "alice", "v1", POWER_REDUCTION)
+        with pytest.raises(StakingError, match="same validator"):
+            sk.begin_redelegate("alice", "v1", "v1", POWER_REDUCTION)
+
+    def test_direct_power_reset_refused_once_delegated(self):
+        """set_validator must not erase delegated-token backing (the
+        invariant guard from review)."""
+        sk, bank = _keeper()
+        sk.delegate(bank, "alice", "v1", POWER_REDUCTION)
+        with pytest.raises(StakingError, match="holds delegations"):
+            sk.set_validator(Validator("v1", b"", 500))
+        # Undelegated validators can still be reset directly.
+        sk.set_validator(Validator("v2", b"", 500))
+        assert sk.get_power("v2") == 500
+
+    def test_wrong_denom_rejected(self):
+        addr = funded_keys(1)[0].public_key().address()
+        msg = MsgDelegate(addr, "v1", Coin("uatom", 5))
+        with pytest.raises(ValueError, match="bond denom"):
+            msg.validate_basic()
+
+    def test_redelegate_moves_power_instantly(self):
+        sk, bank = _keeper()
+        sk.delegate(bank, "alice", "v1", 6 * POWER_REDUCTION)
+        sk.begin_redelegate("alice", "v1", "v2", 6 * POWER_REDUCTION)
+        assert sk.get_power("v1") == 100 and sk.get_power("v2") == 106
+        assert sk.delegation("alice", "v2") == 6 * POWER_REDUCTION
+        assert bank.balance(BONDED_POOL) == 6 * POWER_REDUCTION  # never left
+
+
+class TestStakingOverTheWire:
+    def _chain(self):
+        from celestia_app_tpu.app import Genesis, GenesisAccount
+        from celestia_app_tpu.testutil.testnode import GENESIS_TIME_NS
+
+        keys = funded_keys(2)
+        accounts = tuple(
+            GenesisAccount(k.public_key().address(), 10**12, k.public_key().bytes)
+            for k in keys
+        )
+        validators = tuple(
+            Validator(
+                __import__("celestia_app_tpu.crypto", fromlist=["PrivateKey"])
+                .PrivateKey.from_seed(f"validator-{i}".encode()).public_key().address(),
+                b"\x02" * 33, 100,
+            )
+            for i in range(2)
+        )
+        from celestia_app_tpu.testutil.testnode import TestNode as TN
+
+        return TN(Genesis("stake-chain", GENESIS_TIME_NS, accounts, validators), keys)
+
+    def _submit(self, node, key, msg):
+        from celestia_app_tpu.state.accounts import AuthKeeper
+        from celestia_app_tpu.tx.sign import Fee, build_and_sign
+
+        acct = AuthKeeper(node.app.cms.working).get_account(key.public_key().address())
+        raw = build_and_sign(
+            [msg], key, node.chain_id, acct.account_number, acct.sequence,
+            Fee((Coin("utia", 20_000),), 200_000),
+        )
+        res = node.broadcast(raw)
+        assert res.code == 0, res.log
+        _, results = node.produce_block()
+        return results[-1]
+
+    def test_delegate_undelegate_lifecycle_through_blocks(self):
+        node = self._chain()
+        key = node.keys[0]
+        addr = key.public_key().address()
+        sk = StakingKeeper(node.app.cms.working)
+        val = sk.validators()[0].address
+        bal0 = BankKeeper(node.app.cms.working).balance(addr)
+
+        res = self._submit(node, key, MsgDelegate(addr, val, Coin("utia", 3 * POWER_REDUCTION)))
+        assert res.code == 0, res.log
+        assert StakingKeeper(node.app.cms.working).get_power(val) == 103
+
+        res = self._submit(node, key, MsgUndelegate(addr, val, Coin("utia", POWER_REDUCTION)))
+        assert res.code == 0, res.log
+        assert StakingKeeper(node.app.cms.working).get_power(val) == 102
+
+        # Jump the chain clock past the unbonding period: end blocker pays out.
+        node.produce_block(
+            time_ns=node.app.last_block_time_ns + UNBONDING_TIME_NS + 1
+        )
+        bank = BankKeeper(node.app.cms.working)
+        # alice: -3 TIA delegated, +1 TIA released, -2 fees.
+        assert bank.balance(addr) == bal0 - 2 * POWER_REDUCTION - 2 * 20_000
+        assert bank.balance(NOT_BONDED_POOL) == 0
+
+    def test_redelegate_shifts_blobstream_valset(self):
+        """A big redelegation ripples into a new blobstream valset
+        attestation (the >5% power-shift trigger)."""
+        from celestia_app_tpu.modules.blobstream.keeper import BlobstreamKeeper, Valset
+        from celestia_app_tpu.app import Genesis, GenesisAccount
+        from celestia_app_tpu.crypto import PrivateKey
+        from celestia_app_tpu.testutil.testnode import GENESIS_TIME_NS, TestNode as TN
+
+        keys = funded_keys(2)
+        accounts = tuple(
+            GenesisAccount(k.public_key().address(), 10**12, k.public_key().bytes)
+            for k in keys
+        )
+        validators = tuple(
+            Validator(
+                PrivateKey.from_seed(f"validator-{i}".encode()).public_key().address(),
+                b"\x02" * 33, 100,
+            )
+            for i in range(2)
+        )
+        node = TN(
+            Genesis("stake-v1", GENESIS_TIME_NS, accounts, validators, app_version=1),
+            keys,
+        )
+        node.produce_block()  # valset nonce 1
+        key = keys[0]
+        addr = key.public_key().address()
+        val = validators[0].address
+        # +30 power on one validator: 130/230 vs 100/200 — >5% shift.
+        self._submit(node, key, MsgDelegate(addr, val, Coin("utia", 30 * POWER_REDUCTION)))
+        ks = BlobstreamKeeper(node.app.cms.working, StakingKeeper(node.app.cms.working))
+        valsets = [a for a in ks.attestations() if isinstance(a, Valset)]
+        assert len(valsets) == 2  # genesis + post-delegation snapshot
+        assert {m.power for m in valsets[-1].members} == {130, 100}
+
+
+class TestTxsimStake:
+    def test_stake_sequence_runs(self):
+        from celestia_app_tpu.txsim.run import BlobSequence, StakeSequence, run
+
+        keys = funded_keys(3)
+        node = TestNode(deterministic_genesis(keys, gov_max_square_size=16), keys)
+        stats = run(
+            node, keys, [StakeSequence(initial_stake=500_000), BlobSequence()],
+            blocks=4, seed=7,
+        )
+        assert stats["blocks"] == 4
+        assert stats["failed"] == 0, stats
+        sk = StakingKeeper(node.app.cms.working)
+        assert sum(sk.tokens(v.address) for v in sk.validators()) > 300 * POWER_REDUCTION

@@ -171,10 +171,10 @@ class TestConsistentAppHash:
     deliberate state-machine change, a consensus-breaking change slipped in;
     if deliberate, update the pin in the same commit."""
 
-    # Re-pinned deliberately: genesis now writes the on-chain consensus
-    # params key (Block.MaxBytes derived from the gov square) into state —
-    # a consensus-breaking state-layout change.
-    PINNED = "9a1f84d4144f76aebcce41945185b5dcad0c0e65cae034becd9d1d5f856486d3"
+    # Re-pinned deliberately: staking now tracks token-backed delegations,
+    # so genesis validator registration writes a tokens record per
+    # validator — a consensus-breaking state-layout change.
+    PINNED = "4589bfc0863dd46a070900e1b89b0f9d2be427d10645807468b49d2dad2ce3eb"
 
     @staticmethod
     def _run_chain() -> str:
