@@ -5,6 +5,7 @@ from celestia_app_tpu.consensus.votes import (
     ConsensusError,
     Vote,
     VoteSet,
+    block_id,
     verify_commit,
 )
 
@@ -15,5 +16,6 @@ __all__ = [
     "PREVOTE",
     "Vote",
     "VoteSet",
+    "block_id",
     "verify_commit",
 ]

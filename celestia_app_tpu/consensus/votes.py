@@ -36,6 +36,20 @@ class ConsensusError(RuntimeError):
     pass
 
 
+def block_id(data_root: bytes, prev_app_hash: bytes) -> bytes:
+    """What votes commit to: the block's data root AND the app hash the
+    proposer executed from (Tendermint's header chains the previous app
+    hash the same way).  Two consequences: diverged state shows up as a
+    different block id BEFORE anyone commits, and a Commit at height H+1
+    attests height H's app hash — the trust anchor state sync verifies a
+    restored snapshot against."""
+    import hashlib
+
+    return hashlib.sha256(
+        b"celestia-tpu/block" + data_root + prev_app_hash
+    ).digest()
+
+
 def vote_sign_bytes(chain_id: str, height: int, vote_type: int, block_hash: bytes) -> bytes:
     """Canonical vote sign bytes (the CanonicalVote analog): chain-id
     domain separation so votes can never be replayed across chains."""
@@ -145,17 +159,22 @@ class VoteSet:
 
 @dataclass(frozen=True)
 class Commit:
-    """The queryable proof a height committed: +2/3 precommits."""
+    """The queryable proof a height committed: +2/3 precommits over
+    block_id(data_root, prev_app_hash)."""
 
     height: int
-    block_hash: bytes
+    block_hash: bytes  # = block_id(data_root, prev_app_hash)
     precommits: tuple[Vote, ...]
+    data_root: bytes = b""
+    prev_app_hash: bytes = b""
 
     def to_json(self) -> dict:
         return {
             "height": self.height,
             "block_hash": self.block_hash.hex(),
             "precommits": [v.marshal().hex() for v in self.precommits],
+            "data_root": self.data_root.hex(),
+            "prev_app_hash": self.prev_app_hash.hex(),
         }
 
     @classmethod
@@ -163,6 +182,8 @@ class Commit:
         return cls(
             d["height"], bytes.fromhex(d["block_hash"]),
             tuple(Vote.unmarshal(bytes.fromhex(v)) for v in d["precommits"]),
+            bytes.fromhex(d.get("data_root", "")),
+            bytes.fromhex(d.get("prev_app_hash", "")),
         )
 
 
@@ -172,7 +193,15 @@ def verify_commit(
     commit: Commit,
 ) -> bool:
     """Light-client check: does this Commit carry >2/3 of the given
-    validator set's power in valid precommit signatures?"""
+    validator set's power in valid precommit signatures, over a block id
+    consistent with its claimed data root + previous app hash?
+
+    The binding is unconditional: a commit whose (data_root,
+    prev_app_hash) parts don't hash to the signed block id is rejected —
+    otherwise the unsigned part fields could be rewritten freely and a
+    state-sync joiner shown a forged prev_app_hash."""
+    if commit.block_hash != block_id(commit.data_root, commit.prev_app_hash):
+        return False
     vs = VoteSet(chain_id, commit.height, PRECOMMIT, commit.block_hash, validators)
     for vote in commit.precommits:
         try:

@@ -156,6 +156,13 @@ class RemoteNode:
 
         return Commit.from_json(res)
 
+    # --- state sync -----------------------------------------------------------
+    def snapshots(self) -> list[dict]:
+        return self.call("snapshots")
+
+    def snapshot_chunk(self, height: int, index: int) -> str:
+        return self.call("snapshot_chunk", height=height, index=index)
+
     # --- proof queries (verify client-side against the fetched roots) --------
     def tx_inclusion_proof(self, height: int, tx_index: int):
         from celestia_app_tpu.rpc.codec import share_proof_from_json

@@ -45,8 +45,13 @@ class ServingNode(TestNode):
         n_validators: int = 1,
         peers: list[str] | None = None,
         validator_key=None,
+        snapshot_interval: int = 0,
     ):
         super().__init__(genesis, keys, app=app)
+        # State-sync snapshots (reference: every 1500 blocks, keep 2,
+        # app/default_overrides.go:293-297).  0 = serving disabled.
+        self.snapshot_interval = snapshot_interval
+        self._snapshots: dict[int, dict] = {}
         from celestia_app_tpu.crypto.keys import PrivateKey
 
         # This node's consensus key (signs prevotes/precommits). Defaults
@@ -131,7 +136,40 @@ class ServingNode(TestNode):
         self._blocks_by_height[height] = (data, time_ns)
         self._version_by_height[height] = proposal_version
         self._prevoted.pop(height, None)  # round done
+        if self.snapshot_interval and height % self.snapshot_interval == 0:
+            self._take_snapshot(height)
         return results
+
+    # --- state-sync snapshots -------------------------------------------------
+    SNAPSHOT_CHUNK_BYTES = 512 * 1024
+
+    def _take_snapshot(self, height: int) -> None:
+        import hashlib
+
+        state = self.app.cms.export(height)
+        blob = json.dumps(
+            {k.hex(): v.hex() for k, v in sorted(state.items())},
+            separators=(",", ":"),
+        ).encode()
+        chunks = [
+            blob[i: i + self.SNAPSHOT_CHUNK_BYTES]
+            for i in range(0, max(len(blob), 1), self.SNAPSHOT_CHUNK_BYTES)
+        ]
+        self._snapshots[height] = {
+            "height": height,
+            "app_hash": self.app.cms.last_app_hash.hex(),
+            "app_version": self.app.app_version,  # post-commit (resume needs it)
+            "chain_id": self.chain_id,
+            # Mint provisions derive from (genesis time, last block time,
+            # supply); both times must restore exactly or the synced node's
+            # first minted block diverges from every other validator.
+            "genesis_time_ns": self.app.genesis_time_ns,
+            "block_time_ns": self.app.last_block_time_ns,
+            "chunks": chunks,
+            "chunk_hashes": [hashlib.sha256(c).hexdigest() for c in chunks],
+        }
+        for h in sorted(self._snapshots)[:-2]:  # keep 2
+            del self._snapshots[h]
 
     def _produce_and_replicate(self, produce_time_ns: int | None = None):
         """One voting round per height (celestia-core's consensus shape,
@@ -151,6 +189,7 @@ class ServingNode(TestNode):
             ConsensusError,
             Vote,
             VoteSet,
+            block_id,
         )
 
         peers = self.peers()
@@ -162,17 +201,23 @@ class ServingNode(TestNode):
                 else self.app.last_block_time_ns + BLOCK_INTERVAL_NS
             )
             height = self.app.height + 1
+            prev_app_hash = self.app.cms.last_app_hash
             data = self.app.prepare_proposal(self.mempool.reap(self.block_max_bytes()))
             if not self.app.process_proposal(data):
                 raise AssertionError("node rejected its own proposal")
+            # Votes commit to block_id(data root, prev app hash): a peer
+            # whose state diverged computes a DIFFERENT id, so its prevote
+            # misses this vote set and divergence blocks quorum BEFORE
+            # anyone commits.
+            bid = block_id(data.hash, prev_app_hash)
             # Phase 1: prevotes (peers validate, nobody commits yet).
             # The node's own vote is best-effort like any peer's: a genesis
             # whose consensus pubkey differs from this node's signing key
             # (custom valsets) must not wedge production — quorum gates
             # decide, and a solo node commits regardless.
-            prevotes = VoteSet(self.chain_id, height, PREVOTE, data.hash, validators)
+            prevotes = VoteSet(self.chain_id, height, PREVOTE, bid, validators)
             try:
-                prevotes.add(self._sign_vote(height, PREVOTE, data.hash))
+                prevotes.add(self._sign_vote(height, PREVOTE, bid))
             except ConsensusError:
                 pass
         # Unreachable or refusing peers are tolerated — BFT advances as
@@ -193,14 +238,14 @@ class ServingNode(TestNode):
         prevotes_wire = [v.marshal().hex() for v in prevotes.votes.values()]
 
         # Phase 2: precommits — still no state committed anywhere.
-        precommits = VoteSet(self.chain_id, height, PRECOMMIT, data.hash, validators)
+        precommits = VoteSet(self.chain_id, height, PRECOMMIT, bid, validators)
         try:
-            precommits.add(self._sign_vote(height, PRECOMMIT, data.hash))
+            precommits.add(self._sign_vote(height, PRECOMMIT, bid))
         except ConsensusError:
             pass
         for peer in peers:
             try:
-                reply = peer.precommit(height, data.hash, prevotes_wire)
+                reply = peer.precommit(height, bid, prevotes_wire)
                 precommits.add(Vote.unmarshal(bytes.fromhex(reply["precommit"])))
             except Exception:
                 continue
@@ -209,7 +254,9 @@ class ServingNode(TestNode):
                 f"no +2/3 precommits at height {height}: "
                 f"{precommits.signed_power()}/{precommits.total_power()}"
             )
-        commit = Commit(height, data.hash, tuple(precommits.votes.values()))
+        commit = Commit(
+            height, bid, tuple(precommits.votes.values()), data.hash, prev_app_hash
+        )
 
         # Phase 3: the commit is decided — apply everywhere, carrying the
         # Commit record so every node serves it.
@@ -370,6 +417,8 @@ class ServingNode(TestNode):
             behind = height > self.app.height + 1
         if behind:
             self._catch_up(height - 1)
+        from celestia_app_tpu.consensus import block_id
+
         with self.lock:
             if height != self.app.height + 1:
                 raise ValueError(
@@ -377,8 +426,11 @@ class ServingNode(TestNode):
                 )
             if not self.app.process_proposal(data):
                 raise ValueError(f"proposal rejected at height {height}")
-            prevote = self._sign_vote(height, PREVOTE, data.hash)
-            self._prevoted[height] = data.hash
+            # Computed over THIS node's app hash: divergence yields a
+            # different block id, and the prevote simply won't count.
+            bid = block_id(data.hash, self.app.cms.last_app_hash)
+            prevote = self._sign_vote(height, PREVOTE, bid)
+            self._prevoted[height] = bid
         return {"prevote": prevote.marshal().hex()}
 
     def rpc_precommit(
@@ -435,7 +487,7 @@ class ServingNode(TestNode):
             validators = self._validator_set()
         if (
             record.height != height
-            or record.block_hash != data.hash
+            or record.data_root != data.hash
             or not verify_commit(validators, self.chain_id, record)
         ):
             raise ConsensusError(f"invalid commit record for height {height}")
@@ -450,6 +502,100 @@ class ServingNode(TestNode):
         with self.lock:
             commit = self._commits.get(height)
         return None if commit is None else commit.to_json()
+
+    # --- state-sync serving ---------------------------------------------------
+    def rpc_snapshots(self) -> list[dict]:
+        """Available snapshot metadata (newest last), chunks elided."""
+        with self.lock:
+            return [
+                {k: v for k, v in snap.items() if k != "chunks"}
+                for _, snap in sorted(self._snapshots.items())
+            ]
+
+    def rpc_snapshot_chunk(self, height: int, index: int) -> str:
+        with self.lock:
+            snap = self._snapshots.get(height)
+            if snap is None:
+                raise ValueError(f"no snapshot at height {height}")
+            return snap["chunks"][index].hex()
+
+    def state_sync_from(
+        self, peer_url: str, trusted_validators: dict | None = None
+    ) -> int:
+        """Join the chain from a peer's snapshot instead of replaying every
+        block (the reference's state sync): fetch + hash-verify chunks,
+        restore into a STAGING store, recompute the app hash from the
+        restored data, verify the NEXT height's Commit — its precommits
+        sign block_id(data_root, prev_app_hash), so +2/3 of the validator
+        power attests exactly the app hash we restored — and only then
+        swap the state in and catch up the tail.  Returns the height
+        joined at.
+
+        Trust root: the commit is checked against `trusted_validators`
+        (address -> (PublicKey, power)) or, by default, this node's OWN
+        pre-sync validator set and chain id (its genesis) — never against
+        anything the untrusted snapshot carries.  If the real valset has
+        drifted past the joiner's genesis, the operator supplies the
+        trusted set explicitly (Tendermint state sync's light-block trust
+        assumption)."""
+        import hashlib
+
+        from celestia_app_tpu.consensus import ConsensusError, verify_commit
+        from celestia_app_tpu.rpc.client import RemoteNode
+        from celestia_app_tpu.state.store import CommitStore
+
+        with self.lock:
+            trusted = trusted_validators or self._validator_set()
+            trusted_chain_id = self.chain_id
+        peer = RemoteNode(peer_url, defer_status=True)
+        metas = peer.snapshots()
+        if not metas:
+            raise ValueError(f"peer {peer_url} serves no snapshots")
+        meta = metas[-1]
+        height = meta["height"]
+        if meta["chain_id"] != trusted_chain_id:
+            raise ConsensusError(
+                f"snapshot is for chain {meta['chain_id']!r}, "
+                f"this node trusts {trusted_chain_id!r}"
+            )
+        blob = b""
+        for i, want in enumerate(meta["chunk_hashes"]):
+            chunk = bytes.fromhex(peer.snapshot_chunk(height, i))
+            if hashlib.sha256(chunk).hexdigest() != want:
+                raise ValueError(f"snapshot chunk {i} hash mismatch")
+            blob += chunk
+        state = {
+            bytes.fromhex(k): bytes.fromhex(v) for k, v in json.loads(blob).items()
+        }
+        # Staging: nothing touches self.app until every check passes.
+        cms = CommitStore()
+        cms._committed[height] = state
+        cms.load_height(height)  # recomputes the root from the data
+        if cms.last_app_hash.hex() != meta["app_hash"]:
+            raise ValueError("restored state does not match snapshot app hash")
+        # Trust link: the next height's commit must attest this app hash,
+        # signed by the TRUSTED validator set.
+        commit = peer.commit(height + 1)
+        if commit is None or commit.prev_app_hash != cms.last_app_hash:
+            raise ConsensusError(
+                f"commit at height {height + 1} does not attest the restored "
+                "app hash"
+            )
+        if not verify_commit(trusted, trusted_chain_id, commit):
+            raise ConsensusError(f"invalid commit at height {height + 1}")
+        with self.lock:
+            self.app.cms = cms
+            self.app.height = height
+            self.app.app_version = meta["app_version"]
+            self.app.chain_id = meta["chain_id"]
+            self.app.genesis_time_ns = meta["genesis_time_ns"]
+            self.app.last_block_time_ns = meta["block_time_ns"]
+            self.app._check_state = None
+        if not self.peer_urls:
+            self.peer_urls = [peer_url]
+            self._peers = []
+        self._catch_up(peer.status()["height"])
+        return height
 
     def rpc_tx_inclusion_proof(self, height: int, tx_index: int) -> dict:
         from celestia_app_tpu.proof.querier import query_tx_inclusion_proof

@@ -107,23 +107,25 @@ def run(node, keys, sequences, blocks: int, seed: int = 42) -> dict:
 
                     vals = seq._validator_addrs(node)
                     if op[0] == "delegate":
-                        seq.delegated_to = vals[int(rng.integers(0, len(vals)))]
+                        target = vals[int(rng.integers(0, len(vals)))]
                         msg = MsgDelegate(
-                            seq.address, seq.delegated_to,
-                            Coin("utia", seq.initial_stake),
+                            seq.address, target, Coin("utia", seq.initial_stake)
                         )
                     else:
                         others = [v for v in vals if v != seq.delegated_to]
                         if not others:
                             continue  # solo validator: nothing to redelegate to
-                        dst = others[int(rng.integers(0, len(others)))]
+                        target = others[int(rng.integers(0, len(others)))]
                         msg = MsgBeginRedelegate(
                             seq.address, seq.delegated_to,
-                            Coin("utia", seq.initial_stake), dst,
+                            Coin("utia", seq.initial_stake), target,
                         )
-                        seq.delegated_to = dst
                     with client._lock:
                         client._broadcast_msgs([msg], seq.address, gas=200_000)
+                    # Track only AFTER the broadcast succeeded: a rejected
+                    # submission must not desync the sequence from chain
+                    # state (it retries the same step next round).
+                    seq.delegated_to = target
                 else:
                     continue  # noop round
                 stats["submitted"] += 1

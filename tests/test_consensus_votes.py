@@ -76,16 +76,24 @@ class TestVotes:
         assert vs.signed_power() == 100
 
     def test_verify_commit(self):
+        from celestia_app_tpu.consensus import block_id
+
         keys = _val_keys(4)
         vals = _valset(keys)
-        votes = tuple(Vote.sign(k, "c", 9, PRECOMMIT, HASH) for k in keys[:3])
-        commit = Commit(9, HASH, votes)
+        dr, pah = b"\xaa" * 32, b"\xbb" * 32
+        bid = block_id(dr, pah)
+        votes = tuple(Vote.sign(k, "c", 9, PRECOMMIT, bid) for k in keys[:3])
+        commit = Commit(9, bid, votes, dr, pah)
         assert verify_commit(vals, "c", commit)  # 300/400 > 2/3
-        assert not verify_commit(vals, "c", Commit(9, HASH, votes[:2]))  # 200/400
+        assert not verify_commit(vals, "c", Commit(9, bid, votes[:2], dr, pah))
         assert not verify_commit(vals, "other-chain", commit)
         # A forged vote poisons the whole commit.
-        forged = Vote(9, PRECOMMIT, HASH, keys[3].public_key().address(), b"z")
-        assert not verify_commit(vals, "c", Commit(9, HASH, votes + (forged,)))
+        forged = Vote(9, PRECOMMIT, bid, keys[3].public_key().address(), b"z")
+        assert not verify_commit(vals, "c", Commit(9, bid, votes + (forged,), dr, pah))
+        # The binding is unconditional: rewriting the unsigned parts (or
+        # blanking data_root to dodge the check) must fail.
+        assert not verify_commit(vals, "c", Commit(9, bid, votes, b"", pah))
+        assert not verify_commit(vals, "c", Commit(9, bid, votes, dr, b"\xcc" * 32))
         assert Commit.from_json(commit.to_json()) == commit
 
 
@@ -118,7 +126,12 @@ class TestVotingRound:
             remote = RemoteNode(servers[0].url)
             commit = remote.commit(1)
             assert commit is not None and commit.height == 1
-            assert commit.block_hash == data.hash
+            # Votes sign block_id(data root, prev app hash), recorded in
+            # the commit alongside its parts.
+            from celestia_app_tpu.consensus import block_id
+
+            assert commit.data_root == data.hash
+            assert commit.block_hash == block_id(data.hash, commit.prev_app_hash)
             assert len(commit.precommits) == 3
             # Light-client check against the served validator set +
             # deterministic consensus keys.
@@ -127,6 +140,10 @@ class TestVotingRound:
             # A different block hash does not verify.
             bad = Commit(1, b"\x00" * 32, commit.precommits)
             assert not verify_commit(vals, nodes[0].chain_id, bad)
+            # Nor does a commit whose parts don't hash to its block id.
+            lied = Commit(1, commit.block_hash, commit.precommits,
+                          b"\x11" * 32, commit.prev_app_hash)
+            assert not verify_commit(vals, nodes[0].chain_id, lied)
         finally:
             for s in servers:
                 s.stop()
@@ -172,24 +189,27 @@ class TestVotingRound:
             remote = RemoteNode(servers[1].url)
             from celestia_app_tpu.rpc.client import RPCError
 
+            from celestia_app_tpu.consensus import block_id
+
             data = nodes[0].app.prepare_proposal([])
+            bid = block_id(data.hash, nodes[0].app.cms.last_app_hash)
             keys = _val_keys(3)
             prevotes = [
-                Vote.sign(k, nodes[0].chain_id, 1, PREVOTE, data.hash).marshal().hex()
+                Vote.sign(k, nodes[0].chain_id, 1, PREVOTE, bid).marshal().hex()
                 for k in keys
             ]
             # (a) never prevoted: refuse even with a full prevote set.
             with pytest.raises(RPCError, match="not the block"):
-                remote.precommit(1, data.hash, prevotes)
+                remote.precommit(1, bid, prevotes)
             # Prevote first, then (b) a short set still refuses.
             reply = remote.propose(
                 1, nodes[0].app.last_block_time_ns + 1, data
             )
             assert "prevote" in reply
             with pytest.raises(RPCError, match=r"\+2/3 prevotes"):
-                remote.precommit(1, data.hash, prevotes[:1])
+                remote.precommit(1, bid, prevotes[:1])
             # With quorum shown, the precommit comes back — still height 0.
-            out = remote.precommit(1, data.hash, prevotes)
+            out = remote.precommit(1, bid, prevotes)
             assert "precommit" in out
             assert nodes[1].app.height == 0  # voting never commits state
         finally:
@@ -237,11 +257,15 @@ class TestVotingRound:
             remote = RemoteNode(servers[1].url)
             from celestia_app_tpu.rpc.client import RPCError
 
+            from celestia_app_tpu.consensus import block_id
+
             data = nodes[0].app.prepare_proposal([])
+            bid = block_id(data.hash, nodes[0].app.cms.last_app_hash)
             keys = _val_keys(3)
             short = Commit(
-                1, data.hash,
-                (Vote.sign(keys[0], nodes[0].chain_id, 1, PRECOMMIT, data.hash),),
+                1, bid,
+                (Vote.sign(keys[0], nodes[0].chain_id, 1, PRECOMMIT, bid),),
+                data.hash, nodes[0].app.cms.last_app_hash,
             )
             with pytest.raises(RPCError, match="invalid commit record"):
                 remote.finalize_commit(

@@ -1,0 +1,178 @@
+"""State sync over the wire: a new validator joins from a snapshot.
+
+Reference: state-sync snapshots every 1500 blocks / keep 2
+(app/default_overrides.go:293-297); joining nodes restore a snapshot and
+verify it against the chain rather than replaying history.  Here the trust
+chain is explicit: votes sign block_id(data_root, prev_app_hash), so the
+Commit at height H+1 carries +2/3 validator power attesting exactly the
+app hash the snapshot restores at H.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from celestia_app_tpu.consensus import ConsensusError
+from celestia_app_tpu.rpc.client import RemoteNode
+from celestia_app_tpu.rpc.server import ServingNode, serve
+from celestia_app_tpu.state.accounts import BankKeeper
+from celestia_app_tpu.testutil.testnode import deterministic_genesis, funded_keys
+from celestia_app_tpu.tx.messages import Coin, MsgSend
+from celestia_app_tpu.tx.sign import Fee, build_and_sign
+
+
+def _chain_with_history(snapshot_interval=4, blocks=10):
+    keys = funded_keys(3)
+    # One-validator genesis: the solo producer's own precommit IS +2/3, so
+    # its commits carry the quorum a state-sync joiner verifies.
+    node = ServingNode(
+        genesis=deterministic_genesis(keys, n_validators=1),
+        keys=keys,
+        snapshot_interval=snapshot_interval,
+    )
+    server = serve(node, port=0, block_interval_s=None)
+    # Some real state churn: sends interleaved with empty blocks.
+    from celestia_app_tpu.state.accounts import AuthKeeper
+
+    for i in range(blocks):
+        if i % 2 == 0:
+            key = keys[0]
+            addr = key.public_key().address()
+            acct = AuthKeeper(node.app.cms.working).get_account(addr)
+            raw = build_and_sign(
+                [MsgSend(addr, keys[1].public_key().address(), (Coin("utia", 100 + i),))],
+                key, node.chain_id, acct.account_number, acct.sequence,
+                Fee((Coin("utia", 20_000),), 100_000),
+            )
+            assert node.broadcast(raw).code == 0
+        node.produce_block()
+    return node, server, keys
+
+
+class TestSnapshots:
+    def test_snapshots_taken_and_pruned(self):
+        node, server, _ = _chain_with_history(snapshot_interval=3, blocks=10)
+        try:
+            metas = RemoteNode(server.url).snapshots()
+            # Heights 3,6,9 taken; keep 2 -> 6 and 9.
+            assert [m["height"] for m in metas] == [6, 9]
+            assert all("chunks" not in m for m in metas)  # metadata only
+            chunk = RemoteNode(server.url).snapshot_chunk(9, 0)
+            assert len(chunk) > 0
+        finally:
+            server.stop()
+
+
+class TestStateSyncJoin:
+    def test_join_from_snapshot_and_catch_up(self):
+        node, server, keys = _chain_with_history(snapshot_interval=4, blocks=11)
+        try:
+            joiner = ServingNode(
+                genesis=deterministic_genesis(funded_keys(3), n_validators=1),
+                keys=funded_keys(3),
+            )
+            joined_at = joiner.state_sync_from(server.url)
+            assert joined_at == 8  # latest snapshot height
+            # Caught up to the tip with the identical state.
+            assert joiner.app.height == node.app.height == 11
+            assert joiner.app.cms.last_app_hash == node.app.cms.last_app_hash
+            # The restored + replayed state answers queries correctly.
+            a0 = keys[0].public_key().address()
+            assert (
+                BankKeeper(joiner.app.cms.working).balance(a0)
+                == BankKeeper(node.app.cms.working).balance(a0)
+            )
+            # And the joiner can keep producing on top.
+            joiner.produce_block()
+            assert joiner.app.height == 12
+        finally:
+            server.stop()
+
+    def test_tampered_snapshot_rejected(self):
+        node, server, _ = _chain_with_history(snapshot_interval=4, blocks=9)
+        try:
+            # Corrupt a chunk in place: the joiner must refuse.
+            with node.lock:
+                snap = node._snapshots[8]
+                snap["chunks"][0] = b'{"deadbeef":"ff"}'
+            joiner = ServingNode(
+                genesis=deterministic_genesis(funded_keys(3), n_validators=1),
+                keys=funded_keys(3),
+            )
+            with pytest.raises(ValueError, match="chunk 0 hash mismatch"):
+                joiner.state_sync_from(server.url)
+        finally:
+            server.stop()
+
+    def test_wrong_chain_refused(self):
+        """The trust root is the joiner's own genesis: a snapshot for a
+        different chain id is refused before anything is restored."""
+        node, server, _ = _chain_with_history(snapshot_interval=4, blocks=9)
+        try:
+            joiner = ServingNode(
+                genesis=deterministic_genesis(
+                    funded_keys(3), chain_id="other-chain", n_validators=1
+                ),
+                keys=funded_keys(3),
+            )
+            h0 = joiner.app.height
+            with pytest.raises(ConsensusError, match="snapshot is for chain"):
+                joiner.state_sync_from(server.url)
+            # Nothing was swapped in: the joiner still runs its own chain.
+            assert joiner.app.height == h0
+            assert joiner.chain_id == "other-chain"
+        finally:
+            server.stop()
+
+    def test_failed_sync_leaves_node_untouched(self):
+        """Review finding: verification failures must never leave the
+        joiner running on the unverified snapshot (staging-then-swap)."""
+        node, server, _ = _chain_with_history(snapshot_interval=4, blocks=9)
+        try:
+            with node.lock:
+                node._commits.pop(9, None)  # no trust anchor at H+1
+            joiner = ServingNode(
+                genesis=deterministic_genesis(funded_keys(3), n_validators=1),
+                keys=funded_keys(3),
+            )
+            old_hash = joiner.app.cms.last_app_hash
+            with pytest.raises(ConsensusError, match="does not attest"):
+                joiner.state_sync_from(server.url)
+            assert joiner.app.height == 0
+            assert joiner.app.cms.last_app_hash == old_hash
+        finally:
+            server.stop()
+
+    def test_forged_app_hash_rejected(self):
+        """A snapshot whose state was doctored (hashes recomputed to match)
+        still fails: the NEXT height's commit doesn't attest that root."""
+        import hashlib
+        import json as _json
+
+        node, server, _ = _chain_with_history(snapshot_interval=4, blocks=9)
+        try:
+            with node.lock:
+                snap = node._snapshots[8]
+                state = _json.loads(b"".join(snap["chunks"]))
+                # Mint the attacker a fat balance and re-derive everything.
+                victim_key = next(iter(state))
+                state[victim_key] = "ff" * 8
+                blob = _json.dumps(state, separators=(",", ":")).encode()
+                snap["chunks"] = [blob]
+                snap["chunk_hashes"] = [hashlib.sha256(blob).hexdigest()]
+                from celestia_app_tpu.state.store import CommitStore
+
+                cms = CommitStore()
+                cms._committed[8] = {
+                    bytes.fromhex(k): bytes.fromhex(v) for k, v in state.items()
+                }
+                cms.load_height(8)
+                snap["app_hash"] = cms.last_app_hash.hex()  # self-consistent lie
+            joiner = ServingNode(
+                genesis=deterministic_genesis(funded_keys(3), n_validators=1),
+                keys=funded_keys(3),
+            )
+            with pytest.raises(ConsensusError, match="does not attest"):
+                joiner.state_sync_from(server.url)
+        finally:
+            server.stop()
