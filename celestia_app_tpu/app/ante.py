@@ -49,16 +49,21 @@ from celestia_app_tpu.tx.messages import (
     MsgBeginRedelegate,
     MsgDelegate,
     MsgDeposit,
+    MsgFundCommunityPool,
     MsgPayForBlobs,
     MsgRecvPacket,
     MsgSend,
+    MsgSetWithdrawAddress,
     MsgSignalVersion,
     MsgSubmitProposal,
     MsgTimeout,
     MsgTransfer,
     MsgTryUpgrade,
     MsgUndelegate,
+    MsgUnjail,
     MsgVote,
+    MsgWithdrawDelegatorReward,
+    MsgWithdrawValidatorCommission,
 )
 from celestia_app_tpu.tx.sign import Tx
 
@@ -77,6 +82,8 @@ _V1_MSGS = {
     MsgSend, MsgPayForBlobs, MsgSubmitProposal, MsgVote, MsgDeposit,
     MsgTransfer, MsgRecvPacket, MsgAcknowledgement, MsgTimeout,
     MsgDelegate, MsgUndelegate, MsgBeginRedelegate,
+    MsgWithdrawDelegatorReward, MsgWithdrawValidatorCommission,
+    MsgSetWithdrawAddress, MsgFundCommunityPool, MsgUnjail,
 }
 _V2_MSGS = _V1_MSGS | {MsgSignalVersion, MsgTryUpgrade}
 
@@ -277,7 +284,11 @@ def _check_gov_proposals(msgs: list) -> None:
     """GovProposalDecorator (app/ante/gov.go): a MsgSubmitProposal with no
     inner messages is rejected before it can reach the gov keeper."""
     for m in msgs:
-        if isinstance(m, MsgSubmitProposal) and not m.changes:
+        if (
+            isinstance(m, MsgSubmitProposal)
+            and not m.changes
+            and not m.spend_recipient
+        ):
             raise AnteError("proposal must contain at least one message")
 
 

@@ -49,14 +49,19 @@ from celestia_app_tpu.tx.messages import (
     MsgDeposit,
     MsgPayForBlobs,
     MsgRecvPacket,
+    MsgFundCommunityPool,
     MsgSend,
+    MsgSetWithdrawAddress,
     MsgSignalVersion,
     MsgSubmitProposal,
     MsgTimeout,
     MsgTransfer,
     MsgTryUpgrade,
     MsgUndelegate,
+    MsgUnjail,
     MsgVote,
+    MsgWithdrawDelegatorReward,
+    MsgWithdrawValidatorCommission,
 )
 from celestia_app_tpu.trace import traced
 from celestia_app_tpu.tx.sign import Tx
@@ -205,8 +210,16 @@ class App:
             ctx.auth.set_account(a)
             if acc.balance:
                 ctx.bank.mint(acc.address, acc.balance)
+        from celestia_app_tpu.modules.distribution import DistributionKeeper
+        from celestia_app_tpu.state.staking import POWER_REDUCTION
+
+        dist = DistributionKeeper(ctx.store)
         for v in genesis.validators:
             ctx.staking.set_validator(v)
+            # A genesis validator's declared power is a notional self-bond
+            # (no escrowed delegation backs it); register it with
+            # distribution so its reward share accrues to the operator.
+            dist.set_notional(v.address, v.power * POWER_REDUCTION)
         self.cms.commit(0)
         self._check_state = None
 
@@ -375,12 +388,23 @@ class App:
         return dah.hash() == data.hash  # root equality (:152)
 
     # --- block execution ----------------------------------------------------
-    def finalize_block(self, time_ns: int, txs: list[bytes]) -> list[TxResult]:
+    def finalize_block(
+        self,
+        time_ns: int,
+        txs: list[bytes],
+        last_commit_signers: set[str] | None = None,
+        evidence: tuple = (),
+    ) -> list[TxResult]:
+        """Execute one block.  `last_commit_signers` is the set of operator
+        addresses whose precommits made the previous block's commit (ABCI
+        RequestBeginBlock.LastCommitInfo) — None skips liveness tracking
+        (harnesses without a consensus plane).  `evidence` carries
+        consensus.votes.Equivocation records (ByzantineValidators)."""
         height = self.height + 1
         block_store = self.cms.working.branch()
         ctx = Ctx(block_store, height, time_ns, self.app_version)
 
-        self._begin_block(ctx, time_ns)
+        self._begin_block(ctx, time_ns, last_commit_signers, evidence)
         results = [self._deliver_tx(ctx, raw) for raw in txs]
         self._end_block(ctx, height)
         from celestia_app_tpu.trace.metrics import registry
@@ -406,8 +430,17 @@ class App:
         )
         return app_hash
 
-    def _begin_block(self, ctx: Ctx, time_ns: int) -> None:
-        """x/mint BeginBlocker (x/mint/abci.go:14-20)."""
+    def _begin_block(
+        self,
+        ctx: Ctx,
+        time_ns: int,
+        last_commit_signers: set[str] | None = None,
+        evidence: tuple = (),
+    ) -> None:
+        """x/mint BeginBlocker (x/mint/abci.go:14-20), then x/distribution's
+        (sdk begin-block order: mint before distribution, so this block's
+        provision and the previous block's tx fees sweep together), then
+        x/evidence + x/slashing liveness."""
         supply = ctx.bank.supply()
         self.minter.update(self.genesis_time_ns, time_ns, supply)
         prev = (
@@ -419,6 +452,31 @@ class App:
         if provision > 0:
             ctx.bank.mint(FEE_COLLECTOR, provision)
         self.minter.previous_block_time_ns = time_ns
+        from celestia_app_tpu.modules.distribution import DistributionKeeper
+
+        dist = DistributionKeeper(ctx.store)
+        dist.allocate(ctx.bank, ctx.staking)
+
+        if evidence or last_commit_signers is not None:
+            from celestia_app_tpu.modules.slashing import SlashingKeeper
+
+            slashing = SlashingKeeper(ctx.store)
+            # x/evidence BeginBlocker: punish equivocations first (sdk
+            # begin-block order: evidence before slashing liveness).
+            for ev in evidence:
+                try:
+                    slashing.handle_equivocation(
+                        ctx.staking, ctx.bank, dist,
+                        self.chain_id, ev.vote_a, ev.vote_b,
+                    )
+                except ValueError:
+                    continue  # invalid evidence is dropped, not fatal
+            if last_commit_signers is not None:
+                for v in ctx.staking.bonded_validators():
+                    slashing.handle_validator_signature(
+                        ctx.staking, ctx.bank, dist,
+                        v.address, v.address in last_commit_signers, time_ns,
+                    )
 
     def _deliver_tx(self, block_ctx: Ctx, raw: bytes) -> TxResult:
         btx = unmarshal_blob_tx(raw)
@@ -485,6 +543,16 @@ class App:
                     f"invalid bond denom {msg.amount.denom!r}, expected utia"
                 )
             amount = msg.amount.amount
+            # Settle pending rewards before the stake changes (the sdk's
+            # BeforeDelegationSharesModified hook; x/distribution hooks.go).
+            from celestia_app_tpu.modules.distribution import DistributionKeeper
+
+            dist = DistributionKeeper(ctx.store)
+            dist.settle(ctx.staking, msg.delegator_address, msg.validator_address)
+            if isinstance(msg, MsgBeginRedelegate):
+                dist.settle(
+                    ctx.staking, msg.delegator_address, msg.validator_dst_address
+                )
             if isinstance(msg, MsgDelegate):
                 ctx.staking.delegate(
                     ctx.bank, msg.delegator_address, msg.validator_address, amount
@@ -504,17 +572,79 @@ class App:
             )
             return 0, [("cosmos.staking.v1beta1.EventRedelegate",
                         msg.validator_address, msg.validator_dst_address, amount)]
+        if isinstance(msg, MsgUnjail):
+            from celestia_app_tpu.modules.slashing import (
+                SlashingError,
+                SlashingKeeper,
+            )
+
+            try:
+                SlashingKeeper(ctx.store).unjail(
+                    ctx.staking, msg.validator_address, ctx.time_ns
+                )
+            except SlashingError as e:
+                raise ValueError(str(e)) from e
+            return 0, [("cosmos.slashing.v1beta1.EventUnjail", msg.validator_address)]
+        if isinstance(
+            msg,
+            (
+                MsgWithdrawDelegatorReward,
+                MsgWithdrawValidatorCommission,
+                MsgSetWithdrawAddress,
+                MsgFundCommunityPool,
+            ),
+        ):
+            from celestia_app_tpu.modules.distribution import (
+                DistributionError,
+                DistributionKeeper,
+            )
+
+            dist = DistributionKeeper(ctx.store)
+            try:
+                if isinstance(msg, MsgWithdrawDelegatorReward):
+                    paid = dist.withdraw_rewards(
+                        ctx.bank, ctx.staking,
+                        msg.delegator_address, msg.validator_address,
+                    )
+                    return 0, [(
+                        "cosmos.distribution.v1beta1.EventWithdrawRewards",
+                        msg.validator_address, paid,
+                    )]
+                if isinstance(msg, MsgWithdrawValidatorCommission):
+                    paid = dist.withdraw_commission(ctx.bank, msg.validator_address)
+                    return 0, [(
+                        "cosmos.distribution.v1beta1.EventWithdrawCommission", paid,
+                    )]
+                if isinstance(msg, MsgSetWithdrawAddress):
+                    dist.set_withdraw_address(
+                        msg.delegator_address, msg.withdraw_address
+                    )
+                    return 0, []
+                total = sum(c.amount for c in msg.amount if c.denom == "utia")
+                dist.fund_community_pool(ctx.bank, msg.depositor, total)
+                return 0, [(
+                    "cosmos.distribution.v1beta1.EventFundCommunityPool", total,
+                )]
+            except DistributionError as e:
+                raise ValueError(str(e)) from e
         if isinstance(msg, (MsgSubmitProposal, MsgVote, MsgDeposit)):
             from celestia_app_tpu.modules.gov import GovKeeper, ParamChange
 
             gov = GovKeeper(ctx.store, ctx.staking, ctx.bank)
             if isinstance(msg, MsgSubmitProposal):
                 deposit = sum(c.amount for c in msg.initial_deposit if c.denom == "utia")
+                spend = None
+                if msg.spend_recipient:
+                    spend = (
+                        msg.spend_recipient,
+                        sum(c.amount for c in msg.spend_amount if c.denom == "utia"),
+                    )
                 pid = gov.submit(
                     msg.proposer,
                     [ParamChange(c.subspace, c.key, c.value) for c in msg.changes],
                     deposit,
                     ctx.time_ns,
+                    spend=spend,
                 )
                 return 0, [("cosmos.gov.v1beta1.EventSubmitProposal", pid)]
             if isinstance(msg, MsgVote):

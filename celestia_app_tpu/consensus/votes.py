@@ -187,6 +187,44 @@ class Commit:
         )
 
 
+@dataclass(frozen=True)
+class Equivocation:
+    """Double-sign evidence: one validator, two votes for the same height
+    and vote type but different block ids — what Tendermint's evidence
+    pool gossips as DuplicateVoteEvidence.  Verification (signatures +
+    pair validity) happens in the slashing keeper, which holds the
+    validator set."""
+
+    vote_a: Vote
+    vote_b: Vote
+
+    @property
+    def validator(self) -> str:
+        return self.vote_a.validator
+
+    @property
+    def height(self) -> int:
+        return self.vote_a.height
+
+
+def find_equivocations(votes) -> list[Equivocation]:
+    """Scan votes (any iterable) for conflicting pairs per
+    (validator, height, vote type).  First conflicting pair per key wins —
+    one equivocation is enough to tombstone."""
+    seen: dict[tuple[str, int, int], Vote] = {}
+    found: list[Equivocation] = []
+    flagged: set[tuple[str, int, int]] = set()
+    for v in votes:
+        key = (v.validator, v.height, v.vote_type)
+        prior = seen.get(key)
+        if prior is None:
+            seen[key] = v
+        elif prior.block_hash != v.block_hash and key not in flagged:
+            found.append(Equivocation(prior, v))
+            flagged.add(key)
+    return found
+
+
 def verify_commit(
     validators: dict[str, tuple[PublicKey, int]],
     chain_id: str,

@@ -234,8 +234,11 @@ class BlobstreamKeeper:
         return created
 
     def _current_members(self) -> tuple[BridgeValidator, ...]:
+        # Valsets snapshot the ACTIVE set: a jailed validator must drop out
+        # (the sdk builds them from bonded validators, keeper_valset.go).
         return tuple(
-            BridgeValidator(v.address, v.power) for v in self.staking.validators()
+            BridgeValidator(v.address, v.power)
+            for v in self.staking.bonded_validators()
         )
 
     def _latest_valset(self) -> Valset | None:

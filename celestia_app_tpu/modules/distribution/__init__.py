@@ -1,0 +1,7 @@
+from celestia_app_tpu.modules.distribution.keeper import (
+    DISTRIBUTION_MODULE,
+    DistributionError,
+    DistributionKeeper,
+)
+
+__all__ = ["DISTRIBUTION_MODULE", "DistributionError", "DistributionKeeper"]

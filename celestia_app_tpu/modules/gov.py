@@ -86,6 +86,11 @@ class Proposal:
     voting_start_ns: int  # 0 until activated
     voting_end_ns: int  # 0 until activated
     total_deposit: int
+    # CommunityPoolSpendProposal content (the distrclient.ProposalHandler
+    # the reference registers in its gov router, default_overrides.go:207);
+    # a proposal carries EITHER param changes OR a spend.
+    spend_recipient: str = ""
+    spend_amount: int = 0
 
 
 class GovError(ValueError):
@@ -166,6 +171,12 @@ class GovKeeper:
                 + encode_bytes_field(2, c.key.encode())
                 + encode_bytes_field(3, c.value.encode()),
             )
+        if p.spend_recipient:
+            out += encode_bytes_field(
+                10,
+                encode_bytes_field(1, p.spend_recipient.encode())
+                + encode_varint_field(2, p.spend_amount),
+            )
         self.store.set(f"gov/prop/{p.pid:016d}".encode(), out)
         # Active index: end_blocker scans only live proposals (the sdk's
         # Active/InactiveProposalQueue analog).
@@ -182,6 +193,7 @@ class GovKeeper:
         ints = {num: val for num, wt, val in decode_fields(raw) if wt == WIRE_VARINT}
         proposer = ""
         changes: list[ParamChange] = []
+        spend_recipient, spend_amount = "", 0
         for num, wt, val in decode_fields(raw):
             if num == 2 and wt == WIRE_LEN:
                 proposer = val.decode()
@@ -193,10 +205,17 @@ class GovKeeper:
                         f.get(3, b"").decode(),
                     )
                 )
+            elif num == 10 and wt == WIRE_LEN:
+                for sn, swt, sv in decode_fields(val):
+                    if sn == 1 and swt == WIRE_LEN:
+                        spend_recipient = sv.decode()
+                    elif sn == 2 and swt == WIRE_VARINT:
+                        spend_amount = sv
         return Proposal(
             ints.get(1, 0), proposer, tuple(changes),
             ProposalStatus(ints.get(3, 1)), ints.get(4, 0), ints.get(5, 0),
             ints.get(6, 0), ints.get(7, 0), ints.get(8, 0),
+            spend_recipient, spend_amount,
         )
 
     def proposals(self) -> list[Proposal]:
@@ -231,22 +250,31 @@ class GovKeeper:
         changes: list[ParamChange],
         initial_deposit: int,
         time_ns: int,
+        spend: tuple[str, int] | None = None,
     ) -> int:
         """MsgSubmitProposal: validates against the paramfilter + registry,
         escrows the initial deposit, and opens the deposit period (or goes
-        straight to voting when the deposit already meets the minimum)."""
-        if not changes:
-            raise GovError("proposal must contain at least one message")
+        straight to voting when the deposit already meets the minimum).
+        Content is EITHER param changes OR a community-pool spend
+        (recipient, amount)."""
+        if bool(changes) == (spend is not None):
+            raise GovError(
+                "proposal must carry exactly one content: param changes or "
+                "a community pool spend"
+            )
         validate_param_changes([(c.subspace, c.key, c.value) for c in changes])
         for c in changes:
             if (c.subspace, c.key) not in self._setters:
                 raise GovError(f"unknown parameter {c.subspace}/{c.key}")
+        if spend is not None and (not spend[0] or spend[1] <= 0):
+            raise GovError("community pool spend needs a recipient and a positive amount")
         if initial_deposit < 0:
             raise GovError("negative deposit")
         pid = self._next_id()
         p = Proposal(
             pid, proposer, tuple(changes), ProposalStatus.DEPOSIT_PERIOD,
             time_ns, time_ns + self.max_deposit_period_ns, 0, 0, 0,
+            spend[0] if spend else "", spend[1] if spend else 0,
         )
         self._save(p)
         if initial_deposit:
@@ -343,6 +371,14 @@ class GovKeeper:
             )
             for c in p.changes:
                 self._setters[(c.subspace, c.key)](c.value)
+            if p.spend_recipient:
+                from celestia_app_tpu.modules.distribution import DistributionKeeper
+
+                # Fails (not halts) when the pool shrank below the ask
+                # between submission and execution.
+                DistributionKeeper(self.store).community_pool_spend(
+                    self.bank, p.spend_recipient, p.spend_amount
+                )
         except (ValueError, OverflowError):
             # OverflowError included: a passed proposal with an absurd value
             # (e.g. BlockMaxBytes >= 2^64) must FAIL cleanly, not halt the

@@ -1,0 +1,8 @@
+from celestia_app_tpu.modules.slashing.keeper import (
+    Params,
+    SigningInfo,
+    SlashingError,
+    SlashingKeeper,
+)
+
+__all__ = ["Params", "SigningInfo", "SlashingError", "SlashingKeeper"]

@@ -136,7 +136,11 @@ class RemoteNode:
             prevotes=prevotes,
         )
 
-    def finalize_commit(self, height: int, time_ns: int, data, commit: dict) -> dict:
+    def finalize_commit(
+        self, height: int, time_ns: int, data, commit: dict,
+        last_commit_signers: list[str] | None = None,
+        evidence: list | None = None,
+    ) -> dict:
         return self.call(
             "finalize_commit",
             height=height,
@@ -145,6 +149,8 @@ class RemoteNode:
             square_size=data.square_size,
             txs=[t.hex() for t in data.txs],
             commit=commit,
+            last_commit_signers=last_commit_signers,
+            evidence=evidence or [],
         )
 
     def commit(self, height: int):

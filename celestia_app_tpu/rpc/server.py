@@ -65,9 +65,19 @@ class ServingNode(TestNode):
         # height -> block hash this node prevoted (it precommits only what
         # it prevoted — the vote-consistency rule).
         self._prevoted: dict[int, bytes] = {}
-        # (BlockData, time_ns) by height: survives serving a restarted
-        # chain (list index != height) and feeds peer catch-up.
-        self._blocks_by_height: dict[int, tuple[BlockData, int]] = {}
+        # The evidence pool: every signature-valid vote this node has
+        # witnessed, keyed by height -> (validator, type, block_hash).
+        # Conflicting entries are double-sign evidence (x/evidence;
+        # Tendermint's evidence pool) shipped with the next proposal.
+        self._witnessed: dict[int, dict[tuple[str, int, bytes], "object"]] = {}
+        # (validator, height, vote_type) triples already submitted as
+        # evidence — one equivocation per key is enough to tombstone.
+        self._used_evidence: set[tuple[str, int, int]] = set()
+        # (BlockData, time_ns, last_commit_signers, evidence_wire) by
+        # height: survives serving a restarted chain (list index != height)
+        # and feeds peer catch-up — signers/evidence MUST replicate with
+        # the block or x/slashing state diverges across nodes.
+        self._blocks_by_height: dict[int, tuple] = {}
         # App version per height (the block header's Version.App in the
         # reference): clients reconstructing historical squares need the
         # hard cap in force then, not the current gov param.
@@ -110,15 +120,46 @@ class ServingNode(TestNode):
             return self._produce_and_replicate(time_ns)
 
     def _validator_set(self):
-        """address -> (PublicKey, power), the vote-accounting view."""
+        """address -> (PublicKey, power), the vote-accounting view.
+
+        Built from the BONDED set: a jailed or tombstoned validator's votes
+        stop counting toward quorum the moment the jailing block commits
+        (Tendermint rebuilds the consensus valset from bonded validators
+        the same way)."""
         from celestia_app_tpu.crypto.keys import PublicKey
         from celestia_app_tpu.state.staking import StakingKeeper
 
         out = {}
-        for v in StakingKeeper(self.app.cms.working).validators():
+        for v in StakingKeeper(self.app.cms.working).bonded_validators():
             if v.pubkey:
                 out[v.address] = (PublicKey(v.pubkey), v.power)
         return out
+
+    def _witness_vote(self, vote, validators) -> None:
+        """Feed the evidence pool: record any signature-valid vote by a
+        known validator, INCLUDING votes for a block id this node disagrees
+        with — a conflicting pair per (validator, height, type) is exactly
+        what x/evidence punishes."""
+        entry = validators.get(vote.validator)
+        if entry is None or not vote.verify(entry[0], self.chain_id):
+            return
+        self._witnessed.setdefault(vote.height, {})[
+            (vote.validator, vote.vote_type, vote.block_hash)
+        ] = vote
+
+    def _pending_evidence(self) -> list:
+        """Equivocations in the pool not yet submitted (proposer side)."""
+        from celestia_app_tpu.consensus.votes import find_equivocations
+
+        votes = [
+            v for by_key in self._witnessed.values() for v in by_key.values()
+        ]
+        return [
+            ev
+            for ev in find_equivocations(votes)
+            if (ev.validator, ev.height, ev.vote_a.vote_type)
+            not in self._used_evidence
+        ]
 
     def _sign_vote(self, height: int, vote_type: int, block_hash: bytes):
         from celestia_app_tpu.consensus import Vote
@@ -127,15 +168,38 @@ class ServingNode(TestNode):
             self.validator_key, self.chain_id, height, vote_type, block_hash
         )
 
-    def _commit_block_data(self, data: BlockData, time_ns: int):
+    def _commit_block_data(
+        self,
+        data: BlockData,
+        time_ns: int,
+        last_commit_signers: set[str] | None = None,
+        evidence: tuple = (),
+    ):
         """The shared commit sequence + the serving plane's per-height
-        bookkeeping (block store for catch-up, app version for clients)."""
+        bookkeeping (block store for catch-up, app version for clients).
+        Signers/evidence are stored with the block so catch-up replays the
+        exact x/slashing inputs every live node executed."""
         proposal_version = self.app.app_version  # pre-end-block upgrades
-        results = super()._commit_block_data(data, time_ns)
+        results = super()._commit_block_data(
+            data, time_ns,
+            last_commit_signers=last_commit_signers, evidence=evidence,
+        )
         height = self.app.height
-        self._blocks_by_height[height] = (data, time_ns)
+        evidence_wire = self._evidence_to_wire(evidence)
+        self._blocks_by_height[height] = (
+            data, time_ns,
+            sorted(last_commit_signers) if last_commit_signers is not None else None,
+            evidence_wire,
+        )
         self._version_by_height[height] = proposal_version
         self._prevoted.pop(height, None)  # round done
+        for ev in evidence:
+            self._used_evidence.add(
+                (ev.validator, ev.height, ev.vote_a.vote_type)
+            )
+        # Bound the evidence pool (Tendermint prunes expired evidence).
+        for h in [h for h in self._witnessed if h < height - 100]:
+            del self._witnessed[h]
         if self.snapshot_interval and height % self.snapshot_interval == 0:
             self._take_snapshot(height)
         return results
@@ -202,6 +266,16 @@ class ServingNode(TestNode):
             )
             height = self.app.height + 1
             prev_app_hash = self.app.cms.last_app_hash
+            # ABCI LastCommitInfo: who precommitted the previous height
+            # (x/slashing liveness input); ByzantineValidators: double-sign
+            # pairs from the evidence pool.  Both replicate with the block.
+            prev_commit = self._commits.get(height - 1)
+            last_signers = (
+                {v.validator for v in prev_commit.precommits}
+                if prev_commit is not None
+                else None
+            )
+            evidence = tuple(self._pending_evidence())
             data = self.app.prepare_proposal(self.mempool.reap(self.block_max_bytes()))
             if not self.app.process_proposal(data):
                 raise AssertionError("node rejected its own proposal")
@@ -225,7 +299,9 @@ class ServingNode(TestNode):
         for peer in peers:
             try:
                 reply = peer.propose(height, time_ns, data)
-                prevotes.add(Vote.unmarshal(bytes.fromhex(reply["prevote"])))
+                vote = Vote.unmarshal(bytes.fromhex(reply["prevote"]))
+                self._witness_vote(vote, validators)
+                prevotes.add(vote)
             except Exception:
                 continue
         # Quorum is enforced when replicating to peers; a solo dev node
@@ -246,7 +322,9 @@ class ServingNode(TestNode):
         for peer in peers:
             try:
                 reply = peer.precommit(height, bid, prevotes_wire)
-                precommits.add(Vote.unmarshal(bytes.fromhex(reply["precommit"])))
+                vote = Vote.unmarshal(bytes.fromhex(reply["precommit"]))
+                self._witness_vote(vote, validators)
+                precommits.add(vote)
             except Exception:
                 continue
         if peers and not precommits.has_two_thirds():
@@ -260,14 +338,21 @@ class ServingNode(TestNode):
 
         # Phase 3: the commit is decided — apply everywhere, carrying the
         # Commit record so every node serves it.
+        signers_wire = sorted(last_signers) if last_signers is not None else None
+        evidence_wire = self._evidence_to_wire(evidence)
         with self.lock:
-            results = self._commit_block_data(data, time_ns)
+            results = self._commit_block_data(
+                data, time_ns, last_commit_signers=last_signers, evidence=evidence
+            )
             own_app_hash = self.app.cms.last_app_hash
             self._commits[height] = commit
         commit_wire = commit.to_json()
         for peer in peers:
             try:
-                reply = peer.finalize_commit(height, time_ns, data, commit_wire)
+                reply = peer.finalize_commit(
+                    height, time_ns, data, commit_wire,
+                    last_commit_signers=signers_wire, evidence=evidence_wire,
+                )
             except Exception:
                 continue  # down peer: catch-up recovers it later
             if (
@@ -282,8 +367,16 @@ class ServingNode(TestNode):
                 )
         return data, results
 
-    def apply_block(self, height: int, time_ns: int, data: BlockData) -> dict:
-        """Peer endpoint: validate + execute a replicated proposal.
+    def apply_block(
+        self,
+        height: int,
+        time_ns: int,
+        data: BlockData,
+        last_commit_signers: set[str] | None = None,
+        evidence: tuple = (),
+    ) -> dict:
+        """Peer endpoint: validate + execute a replicated proposal (with
+        the proposer's LastCommitInfo/evidence so slashing state matches).
 
         A peer that missed blocks (e.g. it was still starting when the
         proposer advanced) first catches up from whoever serves them, so a
@@ -300,11 +393,35 @@ class ServingNode(TestNode):
                 )
             if not self.app.process_proposal(data):
                 raise ValueError(f"proposal rejected at height {height}")
-            self._commit_block_data(data, time_ns)
+            self._commit_block_data(
+                data, time_ns,
+                last_commit_signers=last_commit_signers, evidence=evidence,
+            )
             return {
                 "app_hash": self.app.cms.last_app_hash.hex(),
                 "data_hash": data.hash.hex(),
             }
+
+    @staticmethod
+    def _parse_evidence(pairs: list) -> tuple:
+        from celestia_app_tpu.consensus.votes import Equivocation, Vote
+
+        return tuple(
+            Equivocation(
+                Vote.unmarshal(bytes.fromhex(a)), Vote.unmarshal(bytes.fromhex(b))
+            )
+            for a, b in pairs
+        )
+
+    @staticmethod
+    def _evidence_to_wire(evidence: tuple) -> list:
+        """Inverse of _parse_evidence — the single definition of the
+        evidence wire shape (shipped in finalize_commit AND served to
+        catch-up peers; the two must never drift)."""
+        return [
+            [ev.vote_a.marshal().hex(), ev.vote_b.marshal().hex()]
+            for ev in evidence
+        ]
 
     def _catch_up(self, upto: int) -> None:
         """Fetch + apply committed blocks up to `upto` from any peer."""
@@ -323,7 +440,25 @@ class ServingNode(TestNode):
                     square_size=b["square_size"],
                     hash=bytes.fromhex(b["data_hash"]),
                 )
-                self.apply_block(h, b["time_ns"], data)
+                signers = b.get("last_commit_signers")
+                self.apply_block(
+                    h, b["time_ns"], data,
+                    last_commit_signers=set(signers) if signers is not None else None,
+                    evidence=self._parse_evidence(b.get("evidence") or []),
+                )
+                # Learn the Commit record too (same trust anchor as the
+                # block itself): if this node later PROPOSES, it must derive
+                # LastCommitInfo from records, and peers cross-check the
+                # shipped signer set against their own verified records.
+                try:
+                    rec = peer.commit(h)
+                    if rec is not None:
+                        from celestia_app_tpu.consensus import Commit
+
+                        with self.lock:
+                            self._commits[h] = Commit.from_json(rec)
+                except Exception:
+                    pass
                 break
             else:
                 raise ValueError(f"cannot catch up: no peer serves block {h}")
@@ -365,7 +500,7 @@ class ServingNode(TestNode):
             entry = self._blocks_by_height.get(height)
             if entry is None:
                 raise ValueError(f"no block at height {height}")
-            data, time_ns = entry
+            data, time_ns, signers, evidence_wire = entry
         return {
             "height": height,
             "time_ns": time_ns,
@@ -373,6 +508,10 @@ class ServingNode(TestNode):
             "square_size": data.square_size,
             "app_version": self._version_by_height.get(height, self.app.app_version),
             "txs": [t.hex() for t in data.txs],
+            # x/slashing inputs: a catch-up peer must replay these exactly
+            # or its app hash diverges from the nodes that were live.
+            "last_commit_signers": signers,
+            "evidence": evidence_wire,
         }
 
     def rpc_produce_block(self) -> dict:
@@ -471,10 +610,13 @@ class ServingNode(TestNode):
     def rpc_finalize_commit(
         self, height: int, time_ns: int, data_hash: str, square_size: int,
         txs: list[str], commit: dict,
+        last_commit_signers: list[str] | None = None,
+        evidence: list | None = None,
     ) -> dict:
         """Phase 3: the round is decided — verify the Commit record
-        (+2/3 precommits), apply the block, and keep the record so this
-        node serves it too."""
+        (+2/3 precommits), apply the block (with the proposer's
+        LastCommitInfo + evidence), and keep the record so this node
+        serves it too."""
         from celestia_app_tpu.consensus import Commit, ConsensusError, verify_commit
 
         data = BlockData(
@@ -485,13 +627,32 @@ class ServingNode(TestNode):
         record = Commit.from_json(commit)
         with self.lock:
             validators = self._validator_set()
+            prev_record = self._commits.get(height - 1)
         if (
             record.height != height
             or record.data_root != data.hash
             or not verify_commit(validators, self.chain_id, record)
         ):
             raise ConsensusError(f"invalid commit record for height {height}")
-        reply = self.apply_block(height, time_ns, data)
+        signers = set(last_commit_signers) if last_commit_signers is not None else None
+        if prev_record is not None:
+            # The slashing liveness input is NOT taken on the proposer's
+            # word: this node verified height-1's Commit itself, so the
+            # signer set must match it exactly — a proposer lying about who
+            # signed could otherwise jail an honest validator everywhere.
+            expected = {v.validator for v in prev_record.precommits}
+            if signers is not None and signers != expected:
+                raise ConsensusError(
+                    f"last_commit_signers mismatch at height {height}: "
+                    f"proposer says {sorted(signers)}, verified commit says "
+                    f"{sorted(expected)}"
+                )
+            signers = expected
+        reply = self.apply_block(
+            height, time_ns, data,
+            last_commit_signers=signers,
+            evidence=self._parse_evidence(evidence or []),
+        )
         with self.lock:
             self._commits[height] = record
         return reply

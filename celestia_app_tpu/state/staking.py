@@ -15,7 +15,10 @@ MsgBeginRedelegate, test/txsim/stake.go).  This keeper stores validators
     escrowed backing — only delegated amounts move real funds (the
     reference funds genesis self-bond out of band too).
 
-Rewards/distribution are out of scope (no x/distribution; PARITY.md).
+Rewards flow through x/distribution (modules/distribution), which treats a
+genesis validator's notional power as an implicit operator self-bond;
+jailing and slashing (modules/slashing) operate through the jail flag and
+`slash` below.
 """
 
 from __future__ import annotations
@@ -35,6 +38,7 @@ _VAL_PREFIX = b"staking/val/"
 _TOKENS_PREFIX = b"staking/tokens/"
 _DEL_PREFIX = b"staking/del/"
 _UBD_PREFIX = b"staking/ubd/"
+_JAIL_PREFIX = b"staking/jailed/"
 
 POWER_REDUCTION = 1_000_000  # sdk DefaultPowerReduction: 1 TIA of stake = 1 power
 UNBONDING_TIME_NS = 3 * 7 * 24 * 3600 * 10**9  # DefaultUnbondingTime, 3 weeks
@@ -120,6 +124,80 @@ class StakingKeeper:
 
     def total_power(self) -> int:
         return sum(v.power for v in self.validators())
+
+    # --- jail (x/slashing's handle on the validator set) ---------------------
+    def is_jailed(self, address: str) -> bool:
+        return self.store.get(_JAIL_PREFIX + address.encode()) is not None
+
+    def jail(self, address: str) -> None:
+        """Remove the validator from the bonded set (sdk jailValidator)."""
+        if not self.has_validator(address):
+            raise StakingError(f"no validator {address}")
+        self.store.set(_JAIL_PREFIX + address.encode(), b"\x01")
+
+    def unjail(self, address: str) -> None:
+        self.store.delete(_JAIL_PREFIX + address.encode())
+
+    def bonded_validators(self) -> list[Validator]:
+        """The active (non-jailed) set: what consensus power, signal
+        tallies, and blobstream valsets are built from."""
+        return [v for v in self.validators() if not self.is_jailed(v.address)]
+
+    def bonded_power(self) -> int:
+        return sum(v.power for v in self.bonded_validators())
+
+    def slash(self, bank, dist, validator: str, fraction_raw: int) -> int:
+        """Burn `fraction` of the validator's tokens (sdk Slash semantics:
+        bonded tokens burn from the bonded pool; every delegation — and the
+        genesis notional self-bond — shrinks pro-rata).  `fraction_raw` is a
+        Dec raw (1e18 = 100%).  `dist` settles rewards first so pending
+        rewards are computed against pre-slash stake.  Returns burned."""
+        precision = 10**18
+        if not 0 <= fraction_raw <= precision:
+            raise StakingError(f"slash fraction {fraction_raw} outside [0, 1e18]")
+        tokens = self.tokens(validator)
+        burn_total = tokens * fraction_raw // precision
+        if burn_total == 0:
+            return 0
+        dist.settle_all(self, validator)
+        prefix = _DEL_PREFIX + validator.encode() + b"/"
+        burned_backed = 0
+        for key, val in list(self.store.iterate(prefix)):
+            stake = int.from_bytes(val, "big")
+            cut = stake * fraction_raw // precision
+            if cut:
+                self.store.set(key, (stake - cut).to_bytes(16, "big"))
+                burned_backed += cut
+        notional = dist.notional(validator)
+        notional_cut = notional * fraction_raw // precision
+        if notional_cut:
+            dist.set_notional(validator, notional - notional_cut)
+        # Truncation dust stays staked: reduce tokens by what the stake
+        # records actually lost, keeping tokens == notional + Σdelegations.
+        # Only delegation cuts have bank escrow behind them; the genesis
+        # notional self-bond is power-book-only (state/staking.py header).
+        self._set_tokens(validator, tokens - burned_backed - notional_cut)
+        if burned_backed:
+            bank.burn(BONDED_POOL, burned_backed)
+        # Unbonding entries for this validator are slashed too, or an
+        # undelegation racing the evidence would dodge the burn and shift
+        # the whole loss onto the delegators who stayed (the sdk slashes
+        # unbonding delegations for the same reason; without per-entry
+        # creation heights this cuts ALL of the validator's entries — a
+        # strict superset of the sdk's created-after-infraction rule).
+        burned_unbonding = 0
+        suffix = b"/" + validator.encode()
+        for key, val in list(self.store.iterate(_UBD_PREFIX)):
+            if not key.endswith(suffix):
+                continue
+            amount = int.from_bytes(val, "big")
+            cut = amount * fraction_raw // precision
+            if cut:
+                self.store.set(key, (amount - cut).to_bytes(16, "big"))
+                burned_unbonding += cut
+        if burned_unbonding:
+            bank.burn(NOT_BONDED_POOL, burned_unbonding)
+        return burned_backed + notional_cut + burned_unbonding
 
     # --- delegations ---------------------------------------------------------
     def tokens(self, validator: str) -> int:

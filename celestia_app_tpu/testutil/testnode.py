@@ -95,20 +95,28 @@ class TestNode:
             self.mempool.insert(raw_tx, priority, self.app.height)
         return res
 
-    def produce_block(self, time_ns: int | None = None) -> tuple[BlockData, list[TxResult]]:
+    def produce_block(
+        self,
+        time_ns: int | None = None,
+        last_commit_signers: set[str] | None = None,
+        evidence: tuple = (),
+    ) -> tuple[BlockData, list[TxResult]]:
         """One full consensus round against the app itself.
 
         `time_ns` defaults to deterministic logical time (last + 15s, the
         GoalBlockTime) for reproducible tests; serving daemons pass wall
         clock so on-chain time tracks reality (x/mint provisions depend on
-        it).
+        it).  `last_commit_signers`/`evidence` feed x/slashing liveness and
+        x/evidence (ABCI LastCommitInfo / ByzantineValidators).
         """
         if time_ns is None:
             time_ns = self.app.last_block_time_ns + BLOCK_INTERVAL_NS
         data = self.app.prepare_proposal(self.mempool.reap(self.block_max_bytes()))
         if not self.app.process_proposal(data):
             raise AssertionError("node rejected its own proposal")
-        results = self._commit_block_data(data, time_ns)
+        results = self._commit_block_data(
+            data, time_ns, last_commit_signers=last_commit_signers, evidence=evidence
+        )
         return data, results
 
     def block_max_bytes(self) -> int:
@@ -119,11 +127,20 @@ class TestNode:
 
         return ConsensusParamsKeeper(self.app.cms.working).block_max_bytes()
 
-    def _commit_block_data(self, data: BlockData, time_ns: int) -> list[TxResult]:
+    def _commit_block_data(
+        self,
+        data: BlockData,
+        time_ns: int,
+        last_commit_signers: set[str] | None = None,
+        evidence: tuple = (),
+    ) -> list[TxResult]:
         """Execute + commit an already-validated block and do the node
         bookkeeping — the single copy of the commit sequence shared by the
         local produce path and the serving plane's replication paths."""
-        results = self.app.finalize_block(time_ns, list(data.txs))
+        results = self.app.finalize_block(
+            time_ns, list(data.txs),
+            last_commit_signers=last_commit_signers, evidence=evidence,
+        )
         self.app.commit()
         self.mempool.update(self.app.height, list(data.txs))
         self.blocks.append(data)

@@ -29,6 +29,9 @@ URL_MSG_SUBMIT_PROPOSAL = "/cosmos.gov.v1beta1.MsgSubmitProposal"
 URL_MSG_VOTE = "/cosmos.gov.v1beta1.MsgVote"
 URL_MSG_DEPOSIT = "/cosmos.gov.v1beta1.MsgDeposit"
 URL_PARAM_CHANGE_PROPOSAL = "/cosmos.params.v1beta1.ParameterChangeProposal"
+URL_COMMUNITY_POOL_SPEND_PROPOSAL = (
+    "/cosmos.distribution.v1beta1.CommunityPoolSpendProposal"
+)
 URL_MSG_TRANSFER = "/ibc.applications.transfer.v1.MsgTransfer"
 URL_MSG_RECV_PACKET = "/ibc.core.channel.v1.MsgRecvPacket"
 URL_MSG_ACKNOWLEDGEMENT = "/ibc.core.channel.v1.MsgAcknowledgement"
@@ -36,6 +39,15 @@ URL_MSG_TIMEOUT = "/ibc.core.channel.v1.MsgTimeout"
 URL_MSG_DELEGATE = "/cosmos.staking.v1beta1.MsgDelegate"
 URL_MSG_UNDELEGATE = "/cosmos.staking.v1beta1.MsgUndelegate"
 URL_MSG_BEGIN_REDELEGATE = "/cosmos.staking.v1beta1.MsgBeginRedelegate"
+URL_MSG_WITHDRAW_DELEGATOR_REWARD = (
+    "/cosmos.distribution.v1beta1.MsgWithdrawDelegatorReward"
+)
+URL_MSG_WITHDRAW_VALIDATOR_COMMISSION = (
+    "/cosmos.distribution.v1beta1.MsgWithdrawValidatorCommission"
+)
+URL_MSG_SET_WITHDRAW_ADDRESS = "/cosmos.distribution.v1beta1.MsgSetWithdrawAddress"
+URL_MSG_FUND_COMMUNITY_POOL = "/cosmos.distribution.v1beta1.MsgFundCommunityPool"
+URL_MSG_UNJAIL = "/cosmos.slashing.v1beta1.MsgUnjail"
 
 
 @dataclass(frozen=True)
@@ -268,15 +280,20 @@ class ProposalParamChange:
 
 @dataclass(frozen=True)
 class MsgSubmitProposal:
-    """cosmos.gov.v1beta1.MsgSubmitProposal {content=1 (Any wrapping a
-    ParameterChangeProposal {title=1, description=2, changes=3}),
-    initial_deposit=2, proposer=3}."""
+    """cosmos.gov.v1beta1.MsgSubmitProposal {content=1 (Any),
+    initial_deposit=2, proposer=3}.  Supported contents:
+    ParameterChangeProposal {title=1, description=2, changes=3} and
+    CommunityPoolSpendProposal {title=1, description=2, recipient=3,
+    amount=4} (the distrclient.ProposalHandler the reference registers,
+    default_overrides.go:207)."""
 
     title: str
     description: str
     changes: tuple[ProposalParamChange, ...]
     initial_deposit: tuple[Coin, ...]
     proposer: str
+    spend_recipient: str = ""
+    spend_amount: tuple[Coin, ...] = ()
 
     TYPE_URL = URL_MSG_SUBMIT_PROPOSAL
 
@@ -284,6 +301,11 @@ class MsgSubmitProposal:
         body = encode_bytes_field(1, self.title.encode()) + encode_bytes_field(
             2, self.description.encode()
         )
+        if self.spend_recipient:
+            body += encode_bytes_field(3, self.spend_recipient.encode())
+            for c in self.spend_amount:
+                body += encode_bytes_field(4, c.marshal())
+            return Any(URL_COMMUNITY_POOL_SPEND_PROPOSAL, body)
         for c in self.changes:
             body += encode_bytes_field(3, c.marshal())
         return Any(URL_PARAM_CHANGE_PROPOSAL, body)
@@ -301,25 +323,37 @@ class MsgSubmitProposal:
         changes: list[ProposalParamChange] = []
         deposit: list[Coin] = []
         proposer = ""
+        spend_recipient = ""
+        spend_amount: list[Coin] = []
         for num, wt, val in decode_fields(raw):
             if num == 1 and wt == WIRE_LEN:
                 content = Any.unmarshal(val)
-                if content.type_url != URL_PARAM_CHANGE_PROPOSAL:
+                if content.type_url not in (
+                    URL_PARAM_CHANGE_PROPOSAL, URL_COMMUNITY_POOL_SPEND_PROPOSAL
+                ):
                     raise ValueError(
                         f"unsupported proposal content {content.type_url}"
                     )
+                is_spend = content.type_url == URL_COMMUNITY_POOL_SPEND_PROPOSAL
                 for cn, cwt, cval in decode_fields(content.value):
                     if cn == 1 and cwt == WIRE_LEN:
                         title = cval.decode()
                     elif cn == 2 and cwt == WIRE_LEN:
                         description = cval.decode()
-                    elif cn == 3 and cwt == WIRE_LEN:
+                    elif cn == 3 and cwt == WIRE_LEN and not is_spend:
                         changes.append(ProposalParamChange.unmarshal(cval))
+                    elif cn == 3 and cwt == WIRE_LEN:
+                        spend_recipient = cval.decode()
+                    elif cn == 4 and cwt == WIRE_LEN and is_spend:
+                        spend_amount.append(Coin.unmarshal(cval))
             elif num == 2 and wt == WIRE_LEN:
                 deposit.append(Coin.unmarshal(val))
             elif num == 3 and wt == WIRE_LEN:
                 proposer = val.decode()
-        return cls(title, description, tuple(changes), tuple(deposit), proposer)
+        return cls(
+            title, description, tuple(changes), tuple(deposit), proposer,
+            spend_recipient, tuple(spend_amount),
+        )
 
     def to_any(self) -> Any:
         return Any(self.TYPE_URL, self.marshal())
@@ -335,6 +369,17 @@ class MsgSubmitProposal:
         for c in self.initial_deposit:
             if c.amount < 0:
                 raise ValueError("negative deposit")
+        if self.spend_recipient and self.changes:
+            # The wire carries exactly one content Any; encoding would
+            # silently drop the param changes — reject instead.
+            raise ValueError(
+                "proposal cannot carry both param changes and a community "
+                "pool spend"
+            )
+        if self.spend_recipient and any(
+            c.amount <= 0 for c in self.spend_amount
+        ):
+            raise ValueError("community pool spend must be positive")
 
 
 @dataclass(frozen=True)
@@ -637,7 +682,114 @@ MsgUndelegate = _staking_msg(URL_MSG_UNDELEGATE)
 MsgBeginRedelegate = _staking_msg(URL_MSG_BEGIN_REDELEGATE, has_dst=True)
 
 
+def _two_addr_msg(url: str, name1: str, name2: str | None):
+    """Two-string-field distribution messages (cosmos.distribution.v1beta1):
+    MsgWithdrawDelegatorReward {delegator_address=1, validator_address=2},
+    MsgSetWithdrawAddress {delegator_address=1, withdraw_address=2},
+    MsgWithdrawValidatorCommission {validator_address=1}."""
+
+    @dataclass(frozen=True)
+    class TwoAddrMsg:
+        addr1: str
+        addr2: str = ""
+
+        TYPE_URL = url
+        _HAS_SECOND = name2 is not None
+
+        def marshal(self) -> bytes:
+            out = encode_bytes_field(1, self.addr1.encode())
+            if self._HAS_SECOND:
+                out += encode_bytes_field(2, self.addr2.encode())
+            return out
+
+        @classmethod
+        def unmarshal(cls, raw: bytes):
+            f = {num: val for num, wt, val in decode_fields(raw) if wt == WIRE_LEN}
+            return cls(f.get(1, b"").decode(), f.get(2, b"").decode())
+
+        def to_any(self) -> Any:
+            return Any(self.TYPE_URL, self.marshal())
+
+        @property
+        def signer(self) -> str:
+            return self.addr1
+
+        def validate_basic(self) -> None:
+            if not self.addr1:
+                raise ValueError(f"{name1} must not be empty")
+            if self._HAS_SECOND and not self.addr2:
+                raise ValueError(f"{name2} must not be empty")
+
+    TwoAddrMsg.__name__ = TwoAddrMsg.__qualname__ = url.rsplit(".", 1)[-1]
+    setattr(TwoAddrMsg, name1.replace(" ", "_"), property(lambda self: self.addr1))
+    if name2 is not None:
+        setattr(TwoAddrMsg, name2.replace(" ", "_"), property(lambda self: self.addr2))
+    return TwoAddrMsg
+
+
+MsgWithdrawDelegatorReward = _two_addr_msg(
+    URL_MSG_WITHDRAW_DELEGATOR_REWARD, "delegator address", "validator address"
+)
+MsgSetWithdrawAddress = _two_addr_msg(
+    URL_MSG_SET_WITHDRAW_ADDRESS, "delegator address", "withdraw address"
+)
+MsgWithdrawValidatorCommission = _two_addr_msg(
+    URL_MSG_WITHDRAW_VALIDATOR_COMMISSION, "validator address", None
+)
+# cosmos.slashing.v1beta1.MsgUnjail {validator_addr=1} — same one-string
+# shape as a commission withdrawal, different URL and field name.
+MsgUnjail = _two_addr_msg(URL_MSG_UNJAIL, "validator address", None)
+
+
+@dataclass(frozen=True)
+class MsgFundCommunityPool:
+    """cosmos.distribution.v1beta1.MsgFundCommunityPool
+    {amount=1 (repeated Coin), depositor=2}."""
+
+    amount: tuple[Coin, ...]
+    depositor: str
+
+    TYPE_URL = URL_MSG_FUND_COMMUNITY_POOL
+
+    def marshal(self) -> bytes:
+        out = b""
+        for c in self.amount:
+            out += encode_bytes_field(1, c.marshal())
+        out += encode_bytes_field(2, self.depositor.encode())
+        return out
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgFundCommunityPool":
+        coins: list[Coin] = []
+        depositor = ""
+        for num, wt, val in decode_fields(raw):
+            if num == 1 and wt == WIRE_LEN:
+                coins.append(Coin.unmarshal(val))
+            elif num == 2 and wt == WIRE_LEN:
+                depositor = val.decode()
+        return cls(tuple(coins), depositor)
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+    @property
+    def signer(self) -> str:
+        return self.depositor
+
+    def validate_basic(self) -> None:
+        from celestia_app_tpu.crypto.keys import validate_address
+
+        validate_address(self.depositor)
+        if not self.amount or any(c.amount <= 0 for c in self.amount):
+            raise ValueError("community pool deposit must be positive")
+
+
 MSG_DECODERS = {
+    URL_MSG_UNJAIL: MsgUnjail.unmarshal,
+    URL_MSG_WITHDRAW_DELEGATOR_REWARD: MsgWithdrawDelegatorReward.unmarshal,
+    URL_MSG_WITHDRAW_VALIDATOR_COMMISSION: MsgWithdrawValidatorCommission.unmarshal,
+    URL_MSG_SET_WITHDRAW_ADDRESS: MsgSetWithdrawAddress.unmarshal,
+    URL_MSG_FUND_COMMUNITY_POOL: MsgFundCommunityPool.unmarshal,
     URL_MSG_DELEGATE: MsgDelegate.unmarshal,
     URL_MSG_UNDELEGATE: MsgUndelegate.unmarshal,
     URL_MSG_BEGIN_REDELEGATE: MsgBeginRedelegate.unmarshal,

@@ -56,9 +56,9 @@ class SendSequence:
 
 
 class StakeSequence:
-    """Delegate once, then occasionally redelegate to a random other
-    validator (test/txsim/stake.go: 1-in-10 redelegation; reward claims
-    need x/distribution, which is out of scope — PARITY.md)."""
+    """Delegate once, continuously claim rewards, and occasionally
+    redelegate to a random other validator (test/txsim/stake.go: 1-in-10
+    redelegation, MsgWithdrawDelegatorReward otherwise)."""
 
     def __init__(self, initial_stake: int = 1_000_000, validators: list[str] | None = None):
         self.initial_stake = initial_stake
@@ -75,7 +75,7 @@ class StakeSequence:
             return ("delegate", None)
         if int(rng.integers(0, 10)) == 0:
             return ("redelegate", None)
-        return ("noop", None)
+        return ("claim", None)
 
 
 def run(node, keys, sequences, blocks: int, seed: int = 42) -> dict:
@@ -126,6 +126,14 @@ def run(node, keys, sequences, blocks: int, seed: int = 42) -> dict:
                     # submission must not desync the sequence from chain
                     # state (it retries the same step next round).
                     seq.delegated_to = target
+                elif op[0] == "claim":
+                    from celestia_app_tpu.tx.messages import (
+                        MsgWithdrawDelegatorReward,
+                    )
+
+                    msg = MsgWithdrawDelegatorReward(seq.address, seq.delegated_to)
+                    with client._lock:
+                        client._broadcast_msgs([msg], seq.address, gas=200_000)
                 else:
                     continue  # noop round
                 stats["submitted"] += 1

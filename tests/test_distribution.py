@@ -1,0 +1,295 @@
+"""x/distribution: fee allocation, delegator rewards, commission, community pool.
+
+Reference: cosmos-sdk x/distribution wired at app/modules.go:137-139 with
+celestia's genesis override zeroing both proposer-reward params
+(app/default_overrides.go:129-135) — so allocation is exactly community
+tax (2%) + power-proportional validator rewards.  txsim's stake sequence
+claims these via MsgWithdrawDelegatorReward (test/txsim/stake.go:95-104).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from celestia_app_tpu.modules.distribution import (
+    DISTRIBUTION_MODULE,
+    DistributionError,
+    DistributionKeeper,
+)
+from celestia_app_tpu.state.accounts import BankKeeper, FEE_COLLECTOR
+from celestia_app_tpu.state.dec import Dec
+from celestia_app_tpu.state.staking import (
+    POWER_REDUCTION,
+    StakingKeeper,
+    Validator,
+)
+from celestia_app_tpu.state.store import KVStore
+from celestia_app_tpu.testutil import TestNode, deterministic_genesis, funded_keys
+from celestia_app_tpu.tx.messages import (
+    Coin,
+    MsgDelegate,
+    MsgFundCommunityPool,
+    MsgSetWithdrawAddress,
+    MsgWithdrawDelegatorReward,
+)
+
+
+def _world(powers={"v1": 100, "v2": 300}):
+    store = KVStore()
+    sk = StakingKeeper(store)
+    for a, p in powers.items():
+        sk.set_validator(Validator(a, b"", p))
+    bank = BankKeeper(store)
+    dist = DistributionKeeper(store)
+    for v, p in powers.items():
+        dist.set_notional(v, p * POWER_REDUCTION)
+    return store, sk, bank, dist
+
+
+def _fees(bank, amount):
+    bank.mint(FEE_COLLECTOR, amount)
+
+
+class TestAllocation:
+    def test_community_tax_and_power_split(self):
+        _, sk, bank, dist = _world()
+        _fees(bank, 1_000_000)
+        swept = dist.allocate(bank, sk)
+        assert swept == 1_000_000
+        assert bank.balance(FEE_COLLECTOR) == 0
+        assert bank.balance(DISTRIBUTION_MODULE) == 1_000_000
+        # 2% tax; v1 gets 1/4 of the rest, v2 3/4 (all to notional bonds).
+        assert dist.community_pool().truncate_int() == 20_000
+        assert dist.pending_rewards(sk, "v1", "v1") == 245_000
+        assert dist.pending_rewards(sk, "v2", "v2") == 735_000
+
+    def test_no_power_all_to_community(self):
+        store = KVStore()
+        sk = StakingKeeper(store)
+        bank = BankKeeper(store)
+        dist = DistributionKeeper(store)
+        _fees(bank, 500)
+        dist.allocate(bank, sk)
+        assert dist.community_pool().truncate_int() == 500
+
+    def test_empty_collector_noop(self):
+        _, sk, bank, dist = _world()
+        assert dist.allocate(bank, sk) == 0
+
+    def test_conservation(self):
+        """Everything swept is withdrawable + community pool (no leaks)."""
+        _, sk, bank, dist = _world()
+        bank.mint("alice", 10 * POWER_REDUCTION)
+        sk.delegate(bank, "alice", "v1", 7 * POWER_REDUCTION)
+        for fee in (999_999, 123_457, 1):
+            _fees(bank, fee)
+            dist.allocate(bank, sk)
+        total = Dec(0)
+        for v in ("v1", "v2"):
+            for d in dist.settle_all(sk, v):
+                dist.settle(sk, d, v)
+                total = total.add(
+                    Dec.from_int(dist.pending_rewards(sk, d, v))
+                )
+        # Truncation dust < 1utia per (delegator, validator) pair.
+        swept = 999_999 + 123_457 + 1
+        withdrawable = total.truncate_int() + dist.community_pool().truncate_int()
+        assert swept - 4 <= withdrawable <= swept
+
+
+class TestRewards:
+    def test_delegator_share_and_truncation(self):
+        _, sk, bank, dist = _world({"v1": 100})
+        bank.mint("alice", 100 * POWER_REDUCTION)
+        sk.delegate(bank, "alice", "v1", 100 * POWER_REDUCTION)
+        _fees(bank, 1_000_000)
+        dist.allocate(bank, sk)
+        # alice holds half the 200-power validator: 980000 / 2.
+        assert dist.pending_rewards(sk, "alice", "v1") == 490_000
+        paid = dist.withdraw_rewards(bank, sk, "alice", "v1")
+        assert paid == 490_000
+        assert bank.balance("alice") == paid
+        # Second withdraw pays nothing new.
+        assert dist.withdraw_rewards(bank, sk, "alice", "v1") == 0
+
+    def test_settle_before_stake_change(self):
+        """Rewards earned at old stake must not be recomputed at new stake."""
+        _, sk, bank, dist = _world({"v1": 100})
+        bank.mint("alice", 300 * POWER_REDUCTION)
+        sk.delegate(bank, "alice", "v1", 100 * POWER_REDUCTION)
+        _fees(bank, 1_000_000)
+        dist.allocate(bank, sk)  # alice: half of 980000
+        dist.settle(sk, "alice", "v1")  # the app's pre-delegate hook
+        sk.delegate(bank, "alice", "v1", 200 * POWER_REDUCTION)
+        _fees(bank, 1_000_000)
+        dist.allocate(bank, sk)  # alice: 300/400 of 980000
+        expected = 490_000 + 735_000
+        assert dist.pending_rewards(sk, "alice", "v1") == expected
+
+    def test_commission(self):
+        _, sk, bank, dist = _world({"v1": 100})
+        dist.set_commission_rate("v1", Dec.from_str("0.1"))
+        _fees(bank, 1_000_000)
+        dist.allocate(bank, sk)
+        # 980000 to v1: 10% commission, rest to the notional self-bond.
+        assert dist.accrued_commission("v1").truncate_int() == 98_000
+        assert dist.pending_rewards(sk, "v1", "v1") == 882_000
+        paid = dist.withdraw_commission(bank, "v1")
+        assert paid == 98_000
+        with pytest.raises(DistributionError, match="no commission"):
+            dist.withdraw_commission(bank, "v1")
+
+    def test_withdraw_address(self):
+        _, sk, bank, dist = _world({"v1": 100})
+        dist.set_withdraw_address("v1", "cold-wallet")
+        _fees(bank, 100_000)
+        dist.allocate(bank, sk)
+        dist.withdraw_rewards(bank, sk, "v1", "v1")
+        assert bank.balance("cold-wallet") == 98_000
+
+    def test_community_pool_spend(self):
+        _, sk, bank, dist = _world()
+        bank.mint("donor", 1_000)
+        dist.fund_community_pool(bank, "donor", 1_000)
+        assert dist.community_pool().truncate_int() == 1_000
+        dist.community_pool_spend(bank, "grantee", 400)
+        assert bank.balance("grantee") == 400
+        with pytest.raises(DistributionError, match="cannot spend"):
+            dist.community_pool_spend(bank, "grantee", 10_000)
+
+    def test_community_pool_spend_via_gov(self):
+        """CommunityPoolSpendProposal through the full gov lifecycle (the
+        distrclient.ProposalHandler route, default_overrides.go:207)."""
+        from celestia_app_tpu.modules.gov import (
+            DEFAULT_MIN_DEPOSIT,
+            DEFAULT_VOTING_PERIOD_NS,
+            GovError,
+            GovKeeper,
+            ProposalStatus,
+            VoteOption,
+        )
+
+        store, sk, bank, dist = _world({"v1": 100})
+        bank.mint("donor", 10_000)
+        dist.fund_community_pool(bank, "donor", 10_000)
+        bank.mint("alice", 2 * DEFAULT_MIN_DEPOSIT)
+        gov = GovKeeper(store, sk, bank)
+        with pytest.raises(GovError, match="exactly one content"):
+            gov.submit("alice", [], 0, 0)
+        pid = gov.submit(
+            "alice", [], DEFAULT_MIN_DEPOSIT, 0, spend=("grantee", 7_000)
+        )
+        gov.vote(pid, "v1", VoteOption.YES, time_ns=5)
+        events = gov.end_blocker(time_ns=DEFAULT_VOTING_PERIOD_NS + 100)
+        assert events == [("gov.proposal_passed", pid)]
+        assert bank.balance("grantee") == 7_000
+        assert dist.community_pool().truncate_int() == 3_000
+        # A second identical ask overdraws the pool: FAILED, not a halt.
+        pid2 = gov.submit(
+            "alice", [], DEFAULT_MIN_DEPOSIT, 200, spend=("grantee", 7_000)
+        )
+        gov.vote(pid2, "v1", VoteOption.YES, time_ns=300)
+        events = gov.end_blocker(time_ns=2 * DEFAULT_VOTING_PERIOD_NS + 400)
+        assert events == [("gov.proposal_failed", pid2)]
+        assert gov.get_proposal(pid2).status == ProposalStatus.FAILED
+
+    def test_msg_rejects_both_contents(self):
+        """The wire carries ONE content Any: a msg with both param changes
+        and a spend must fail validate_basic, not silently drop one."""
+        from celestia_app_tpu.tx.messages import (
+            MsgSubmitProposal,
+            ProposalParamChange,
+        )
+
+        proposer = funded_keys(1)[0].public_key().address()
+        msg = MsgSubmitProposal(
+            "t", "d",
+            (ProposalParamChange("blob", "GasPerBlobByte", "16"),),
+            (Coin("utia", 1),), proposer,
+            spend_recipient="celestia1grantee",
+            spend_amount=(Coin("utia", 5),),
+        )
+        with pytest.raises(ValueError, match="cannot carry both"):
+            msg.validate_basic()
+        # Spend-only roundtrips through the wire.
+        spend_only = MsgSubmitProposal(
+            "t", "d", (), (Coin("utia", 1),), proposer,
+            spend_recipient="celestia1grantee",
+            spend_amount=(Coin("utia", 5),),
+        )
+        back = MsgSubmitProposal.unmarshal(spend_only.marshal())
+        assert back == spend_only
+
+
+class TestThroughTheApp:
+    def _submit(self, node, key, msg, seq_hint=None):
+        from celestia_app_tpu.state.accounts import AuthKeeper
+        from celestia_app_tpu.tx.sign import Fee, build_and_sign
+
+        acct = AuthKeeper(node.app.cms.working).get_account(key.public_key().address())
+        raw = build_and_sign(
+            [msg], key, node.chain_id, acct.account_number, acct.sequence,
+            Fee((Coin("utia", 20_000),), 200_000),
+        )
+        res = node.broadcast(raw)
+        assert res.code == 0, res.log
+        _, results = node.produce_block()
+        return results[-1]
+
+    def test_delegate_earn_claim(self):
+        """The full txsim loop: delegate, let fees+provisions accrue,
+        claim — delegator balance grows by the claimed amount."""
+        keys = funded_keys(2)
+        node = TestNode(deterministic_genesis(keys, gov_max_square_size=16), keys)
+        key = keys[0]
+        addr = key.public_key().address()
+        sk = StakingKeeper(node.app.cms.working)
+        val = sk.validators()[0].address
+
+        res = self._submit(
+            node, key, MsgDelegate(addr, val, Coin("utia", 50 * POWER_REDUCTION))
+        )
+        assert res.code == 0, res.log
+        # Fees paid above sweep into rewards at the NEXT block's begin-block.
+        node.produce_block()
+        dist = DistributionKeeper(node.app.cms.working)
+        pending = dist.pending_rewards(
+            StakingKeeper(node.app.cms.working), addr, val
+        )
+        assert pending > 0
+
+        bank = BankKeeper(node.app.cms.working)
+        before = bank.balance(addr)
+        res = self._submit(node, key, MsgWithdrawDelegatorReward(addr, val))
+        assert res.code == 0, res.log
+        paid = [e for e in res.events if e[0].endswith("EventWithdrawRewards")][0][2]
+        assert paid >= pending  # more blocks accrued since the query
+        bank = BankKeeper(node.app.cms.working)
+        assert bank.balance(addr) == before + paid - 20_000  # minus claim fee
+
+    def test_set_withdraw_address_and_fund_pool(self):
+        keys = funded_keys(2)
+        node = TestNode(deterministic_genesis(keys, gov_max_square_size=16), keys)
+        key = keys[0]
+        addr = key.public_key().address()
+        other = keys[1].public_key().address()
+        res = self._submit(node, key, MsgSetWithdrawAddress(addr, other))
+        assert res.code == 0
+        res = self._submit(
+            node, key, MsgFundCommunityPool((Coin("utia", 5_000),), addr)
+        )
+        assert res.code == 0
+        dist = DistributionKeeper(node.app.cms.working)
+        assert dist.community_pool().truncate_int() >= 5_000
+
+    def test_txsim_stake_claims(self):
+        from celestia_app_tpu.txsim.run import StakeSequence, run
+
+        keys = funded_keys(2)
+        node = TestNode(deterministic_genesis(keys, gov_max_square_size=16), keys)
+        stats = run(
+            node, keys, [StakeSequence(initial_stake=500_000)], blocks=6, seed=3
+        )
+        assert stats["failed"] == 0, stats
+        # delegate + claims (some rounds redelegate instead).
+        assert stats["submitted"] >= 4

@@ -229,6 +229,56 @@ class TestStakingWire:
         ).SerializeToString()
 
 
+class TestDistributionWire:
+    def test_distribution_msgs(self, pb):
+        import importlib
+
+        from celestia_app_tpu.tx.messages import (
+            Coin,
+            MsgFundCommunityPool,
+            MsgSetWithdrawAddress,
+            MsgWithdrawDelegatorReward,
+            MsgWithdrawValidatorCommission,
+        )
+
+        dist = importlib.import_module("cosmos.distribution.v1beta1.tx_pb2")
+        w = MsgWithdrawDelegatorReward("celestia1del", "celestiavaloper1x")
+        ref = dist.MsgWithdrawDelegatorReward(
+            delegator_address="celestia1del", validator_address="celestiavaloper1x"
+        )
+        assert w.marshal() == ref.SerializeToString()
+        assert MsgWithdrawDelegatorReward.unmarshal(ref.SerializeToString()) == w
+
+        s = MsgSetWithdrawAddress("celestia1del", "celestia1cold")
+        assert s.marshal() == dist.MsgSetWithdrawAddress(
+            delegator_address="celestia1del", withdraw_address="celestia1cold"
+        ).SerializeToString()
+
+        c = MsgWithdrawValidatorCommission("celestiavaloper1x")
+        assert c.marshal() == dist.MsgWithdrawValidatorCommission(
+            validator_address="celestiavaloper1x"
+        ).SerializeToString()
+
+        f = MsgFundCommunityPool((Coin("utia", 123),), "celestia1donor")
+        ref_f = dist.MsgFundCommunityPool(
+            amount=[pb["coin"].Coin(denom="utia", amount="123")],
+            depositor="celestia1donor",
+        )
+        assert f.marshal() == ref_f.SerializeToString()
+        assert MsgFundCommunityPool.unmarshal(ref_f.SerializeToString()) == f
+
+    def test_unjail_msg(self, pb):
+        import importlib
+
+        from celestia_app_tpu.tx.messages import MsgUnjail
+
+        slashing = importlib.import_module("cosmos.slashing.v1beta1.tx_pb2")
+        u = MsgUnjail("celestiavaloper1x")
+        ref = slashing.MsgUnjail(validator_addr="celestiavaloper1x")
+        assert u.marshal() == ref.SerializeToString()
+        assert MsgUnjail.unmarshal(ref.SerializeToString()) == u
+
+
 class TestGovAndIBCWire:
     def test_gov_msgs(self, pb):
         from google.protobuf import any_pb2

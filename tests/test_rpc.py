@@ -136,8 +136,16 @@ class TestReplication:
                 v0.produce_block()
             assert v1.app.height == 0
             # Now v1 receives block 4 out of order and must catch up 1-3.
+            # Replication carries the proposer's LastCommitInfo (x/slashing
+            # input) with the block, exactly as finalize_commit ships it.
             data4, _ = v0.produce_block()
-            reply = v1.apply_block(4, v0.app.last_block_time_ns, data4)
+            b4 = v0.rpc_block(4)
+            signers = b4["last_commit_signers"]
+            reply = v1.apply_block(
+                4, b4["time_ns"], data4,
+                last_commit_signers=set(signers) if signers is not None else None,
+                evidence=v1._parse_evidence(b4["evidence"] or []),
+            )
             assert v1.app.height == 4
             assert bytes.fromhex(reply["app_hash"]) == v0.app.cms.last_app_hash
         finally:

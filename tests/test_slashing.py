@@ -1,0 +1,286 @@
+"""x/slashing + x/evidence: liveness windows, downtime jail, equivocation.
+
+Reference: cosmos-sdk x/slashing + x/evidence (app/modules.go:133-135,
+147-149) with celestia's genesis (app/default_overrides.go:100-111):
+window 5000, min-signed 75%, jail 1 minute, double-sign slash 2%,
+downtime slash 0%.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from celestia_app_tpu.consensus.votes import (
+    PRECOMMIT,
+    Equivocation,
+    Vote,
+    find_equivocations,
+)
+from celestia_app_tpu.crypto import PrivateKey
+from celestia_app_tpu.modules.distribution import DistributionKeeper
+from celestia_app_tpu.modules.slashing import (
+    Params,
+    SlashingError,
+    SlashingKeeper,
+)
+from celestia_app_tpu.state.accounts import BankKeeper
+from celestia_app_tpu.state.dec import Dec
+from celestia_app_tpu.state.staking import (
+    BONDED_POOL,
+    POWER_REDUCTION,
+    StakingKeeper,
+    Validator,
+)
+from celestia_app_tpu.state.store import KVStore
+
+CHAIN = "slash-chain"
+
+
+def _world(n_vals=2, power=100):
+    store = KVStore()
+    sk = StakingKeeper(store)
+    dist = DistributionKeeper(store)
+    bank = BankKeeper(store)
+    keys = {}
+    for i in range(n_vals):
+        key = PrivateKey.from_seed(f"val-{i}".encode())
+        addr = key.public_key().address()
+        sk.set_validator(Validator(addr, key.public_key().bytes, power))
+        dist.set_notional(addr, power * POWER_REDUCTION)
+        keys[addr] = key
+    return store, sk, bank, dist, SlashingKeeper(store), keys
+
+
+def _tiny_window(slashing, window=4, min_signed="0.75"):
+    slashing.set_params(Params(
+        signed_blocks_window=window,
+        min_signed_per_window=Dec.from_str(min_signed),
+    ))
+
+
+class TestLiveness:
+    def test_misses_accumulate_and_jail(self):
+        _, sk, bank, dist, slashing, keys = _world()
+        val = next(iter(keys))
+        _tiny_window(slashing, window=4)  # max_missed = 4 - 3 = 1
+        t = 10**9
+        assert not slashing.handle_validator_signature(sk, bank, dist, val, False, t)
+        assert slashing.signing_info(val).missed_blocks == 1
+        # Second miss crosses the line: jailed, window reset.
+        assert slashing.handle_validator_signature(sk, bank, dist, val, False, t)
+        assert sk.is_jailed(val)
+        info = slashing.signing_info(val)
+        assert info.missed_blocks == 0
+        assert info.jailed_until_ns == t + 60 * 10**9
+        # Celestia's downtime slash fraction is zero: tokens untouched.
+        assert sk.tokens(val) == 100 * POWER_REDUCTION
+
+    def test_signing_clears_window(self):
+        _, sk, bank, dist, slashing, keys = _world()
+        val = next(iter(keys))
+        _tiny_window(slashing, window=4)
+        t = 10**9
+        slashing.handle_validator_signature(sk, bank, dist, val, False, t)
+        # The window wraps: signing over the missed slot clears it.
+        for _ in range(4):
+            slashing.handle_validator_signature(sk, bank, dist, val, True, t)
+        assert slashing.signing_info(val).missed_blocks == 0
+        assert not sk.is_jailed(val)
+
+    def test_jailed_validator_out_of_bonded_set(self):
+        _, sk, bank, dist, slashing, keys = _world(n_vals=3)
+        val = next(iter(keys))
+        sk.jail(val)
+        assert len(sk.bonded_validators()) == 2
+        assert sk.bonded_power() == 200
+        assert sk.total_power() == 300  # record remains
+
+    def test_unjail_after_duration(self):
+        _, sk, bank, dist, slashing, keys = _world()
+        val = next(iter(keys))
+        _tiny_window(slashing, window=4)
+        t = 10**9
+        slashing.handle_validator_signature(sk, bank, dist, val, False, t)
+        slashing.handle_validator_signature(sk, bank, dist, val, False, t)
+        assert sk.is_jailed(val)
+        with pytest.raises(SlashingError, match="jailed until"):
+            slashing.unjail(sk, val, t + 1)
+        slashing.unjail(sk, val, t + 61 * 10**9)
+        assert not sk.is_jailed(val)
+        with pytest.raises(SlashingError, match="not jailed"):
+            slashing.unjail(sk, val, t)
+
+
+def _double_votes(key, height=5, chain=CHAIN):
+    a = Vote.sign(key, chain, height, PRECOMMIT, b"\x01" * 32)
+    b = Vote.sign(key, chain, height, PRECOMMIT, b"\x02" * 32)
+    return a, b
+
+
+class TestEquivocation:
+    def test_detect(self):
+        key = PrivateKey.from_seed(b"val-0")
+        a, b = _double_votes(key)
+        evs = find_equivocations([a, b, a])
+        assert len(evs) == 1
+        assert evs[0].validator == key.public_key().address()
+        # Same-block duplicates are not equivocations.
+        assert find_equivocations([a, a]) == []
+
+    def test_slash_tombstone_once(self):
+        _, sk, bank, dist, slashing, keys = _world()
+        addr, key = next(iter(keys.items()))
+        bank.mint("delegator", 50 * POWER_REDUCTION)
+        sk.delegate(bank, "delegator", addr, 50 * POWER_REDUCTION)
+        a, b = _double_votes(key)
+        burned = slashing.handle_equivocation(sk, bank, dist, CHAIN, a, b)
+        # 2% of 150 TIA
+        assert burned == 3 * POWER_REDUCTION
+        assert sk.is_jailed(addr)
+        assert slashing.signing_info(addr).tombstoned
+        assert sk.tokens(addr) == 147 * POWER_REDUCTION
+        # Delegation and notional shrank pro-rata; bonded pool burned the
+        # delegation-backed part only.
+        assert sk.delegation("delegator", addr) == 49 * POWER_REDUCTION
+        assert dist.notional(addr) == 98 * POWER_REDUCTION
+        assert bank.balance(BONDED_POOL) == 49 * POWER_REDUCTION
+        # Double jeopardy: same evidence again is a no-op.
+        assert slashing.handle_equivocation(sk, bank, dist, CHAIN, a, b) == 0
+        # Tombstoned validators cannot unjail.
+        with pytest.raises(SlashingError, match="tombstoned"):
+            slashing.unjail(sk, addr, 1 << 61)
+
+    def test_unbonding_entries_slashed(self):
+        """An undelegation racing the evidence must not dodge the burn."""
+        from celestia_app_tpu.state.staking import NOT_BONDED_POOL
+
+        _, sk, bank, dist, slashing, keys = _world(n_vals=1)
+        addr, key = next(iter(keys.items()))
+        bank.mint("delegator", 100 * POWER_REDUCTION)
+        sk.delegate(bank, "delegator", addr, 100 * POWER_REDUCTION)
+        sk.undelegate(bank, "delegator", addr, 50 * POWER_REDUCTION, time_ns=0)
+        a, b = _double_votes(key)
+        burned = slashing.handle_equivocation(sk, bank, dist, CHAIN, a, b)
+        # 2% of: 50 bonded delegation + 100 notional + 50 unbonding.
+        assert burned == 4 * POWER_REDUCTION
+        assert bank.balance(NOT_BONDED_POOL) == 49 * POWER_REDUCTION
+        # The matured payout is the slashed amount.
+        from celestia_app_tpu.state.staking import UNBONDING_TIME_NS
+
+        released = sk.complete_unbondings(bank, UNBONDING_TIME_NS + 1)
+        assert released == [("delegator", 49 * POWER_REDUCTION)]
+
+    def test_rejects_forged_pair(self):
+        _, sk, bank, dist, slashing, keys = _world()
+        addr, key = next(iter(keys.items()))
+        other = PrivateKey.from_seed(b"not-a-val")
+        from celestia_app_tpu.consensus.votes import vote_sign_bytes
+
+        a = Vote(5, PRECOMMIT, b"\x01" * 32, addr,
+                 other.sign(vote_sign_bytes(CHAIN, 5, PRECOMMIT, b"\x01" * 32)))
+        b = Vote(5, PRECOMMIT, b"\x02" * 32, addr,
+                 other.sign(vote_sign_bytes(CHAIN, 5, PRECOMMIT, b"\x02" * 32)))
+        with pytest.raises(SlashingError, match="signature"):
+            slashing.handle_equivocation(sk, bank, dist, CHAIN, a, b)
+        va, _ = _double_votes(key)
+        with pytest.raises(SlashingError, match="not an equivocation"):
+            slashing.handle_equivocation(sk, bank, dist, CHAIN, va, va)
+
+    def test_rewards_settled_before_slash(self):
+        """Pending rewards must be valued at pre-slash stake."""
+        from celestia_app_tpu.state.accounts import FEE_COLLECTOR
+
+        _, sk, bank, dist, slashing, keys = _world(n_vals=1)
+        addr, key = next(iter(keys.items()))
+        bank.mint("delegator", 100 * POWER_REDUCTION)
+        sk.delegate(bank, "delegator", addr, 100 * POWER_REDUCTION)
+        bank.mint(FEE_COLLECTOR, 1_000_000)
+        dist.allocate(bank, sk)
+        pending_before = dist.pending_rewards(sk, "delegator", addr)
+        a, b = _double_votes(key)
+        slashing.handle_equivocation(sk, bank, dist, CHAIN, a, b)
+        assert dist.pending_rewards(sk, "delegator", addr) == pending_before
+
+
+class TestThroughTheApp:
+    def _net(self):
+        from celestia_app_tpu.app import Genesis, GenesisAccount
+        from celestia_app_tpu.testutil.testnode import GENESIS_TIME_NS, TestNode
+        from celestia_app_tpu.testutil import funded_keys
+
+        keys = funded_keys(2)
+        accounts = tuple(
+            GenesisAccount(k.public_key().address(), 10**12, k.public_key().bytes)
+            for k in keys
+        )
+        val_keys = [PrivateKey.from_seed(f"val-{i}".encode()) for i in range(3)]
+        validators = tuple(
+            Validator(k.public_key().address(), k.public_key().bytes, 100)
+            for k in val_keys
+        )
+        node = TestNode(
+            Genesis("slash-chain", GENESIS_TIME_NS, accounts, validators), keys
+        )
+        return node, keys, val_keys
+
+    def test_liveness_through_blocks(self):
+        node, keys, val_keys = self._net()
+        SlashingKeeper(node.app.cms.working)  # params live in state
+        # Shrink the window so 2 misses jail (params persist via commit).
+        store = node.app.cms.working
+        SlashingKeeper(store).set_params(Params(
+            signed_blocks_window=4, min_signed_per_window=Dec.from_str("0.75")
+        ))
+        lazy = val_keys[0].public_key().address()
+        active = {k.public_key().address() for k in val_keys[1:]}
+        node.produce_block(last_commit_signers=active)
+        node.produce_block(last_commit_signers=active)
+        sk = StakingKeeper(node.app.cms.working)
+        assert sk.is_jailed(lazy)
+        assert {v.address for v in sk.bonded_validators()} == active
+
+    def test_evidence_and_unjail_msg(self):
+        node, keys, val_keys = self._net()
+        byz_key = val_keys[0]
+        byz = byz_key.public_key().address()
+        a, b = _double_votes(byz_key, chain=node.chain_id)
+        node.produce_block(evidence=(Equivocation(a, b),))
+        sk = StakingKeeper(node.app.cms.working)
+        assert sk.is_jailed(byz)
+        assert sk.tokens(byz) == 98 * POWER_REDUCTION
+        assert SlashingKeeper(node.app.cms.working).signing_info(byz).tombstoned
+
+
+class TestServingPlaneLiveness:
+    def test_commits_feed_liveness(self):
+        """The devnet's own commits drive x/slashing: after real voting
+        rounds, every validator's signing window has advanced and both
+        replicas hold identical slashing state (the determinism contract
+        extends to LastCommitInfo)."""
+        from celestia_app_tpu.rpc.devnet import serve
+        from celestia_app_tpu.rpc.server import ServingNode
+        from celestia_app_tpu.testutil import deterministic_genesis, funded_keys
+
+        keys = funded_keys(2)
+        genesis = deterministic_genesis(keys, n_validators=2)
+        v0 = ServingNode(genesis=genesis, keys=keys, validator_index=0,
+                         n_validators=2)
+        s0 = serve(v0, port=0, block_interval_s=None)
+        v1 = ServingNode(genesis=genesis, keys=keys, validator_index=1,
+                         n_validators=2, peers=[s0.url])
+        s1 = serve(v1, port=0, block_interval_s=None)
+        v0.peer_urls = [s1.url]
+        try:
+            for _ in range(3):
+                v0.produce_block()
+            sk = StakingKeeper(v0.app.cms.working)
+            slashing = SlashingKeeper(v0.app.cms.working)
+            for v in sk.validators():
+                info = slashing.signing_info(v.address)
+                # Height 1 has no LastCommitInfo; 2 and 3 do.
+                assert info.index_offset == 2, (v.address, info)
+                assert info.missed_blocks == 0
+            assert v0.app.cms.last_app_hash == v1.app.cms.last_app_hash
+        finally:
+            s0.stop()
+            s1.stop()

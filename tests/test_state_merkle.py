@@ -171,10 +171,11 @@ class TestConsistentAppHash:
     deliberate state-machine change, a consensus-breaking change slipped in;
     if deliberate, update the pin in the same commit."""
 
-    # Re-pinned deliberately: staking now tracks token-backed delegations,
-    # so genesis validator registration writes a tokens record per
-    # validator — a consensus-breaking state-layout change.
-    PINNED = "4589bfc0863dd46a070900e1b89b0f9d2be427d10645807468b49d2dad2ce3eb"
+    # Re-pinned deliberately: x/distribution landed — genesis validators
+    # get a notional-self-bond record and every block sweeps the fee
+    # collector into reward accumulators — a consensus-breaking
+    # state-layout change.
+    PINNED = "d617bf64cccace516eecd7f2dd4c9a9b318a11a05e0508db85c78836821eb422"
 
     @staticmethod
     def _run_chain() -> str:
