@@ -46,10 +46,15 @@ from celestia_app_tpu.state.accounts import FEE_COLLECTOR
 from celestia_app_tpu.state.dec import Dec
 from celestia_app_tpu.tx.messages import (
     MsgAcknowledgement,
+    MsgAuthzExec,
+    MsgAuthzGrant,
+    MsgAuthzRevoke,
     MsgBeginRedelegate,
     MsgDelegate,
     MsgDeposit,
     MsgFundCommunityPool,
+    MsgGrantAllowance,
+    MsgRevokeAllowance,
     MsgPayForBlobs,
     MsgRecvPacket,
     MsgSend,
@@ -84,6 +89,8 @@ _V1_MSGS = {
     MsgDelegate, MsgUndelegate, MsgBeginRedelegate,
     MsgWithdrawDelegatorReward, MsgWithdrawValidatorCommission,
     MsgSetWithdrawAddress, MsgFundCommunityPool, MsgUnjail,
+    MsgGrantAllowance, MsgRevokeAllowance,
+    MsgAuthzGrant, MsgAuthzExec, MsgAuthzRevoke,
 }
 _V2_MSGS = _V1_MSGS | {MsgSignalVersion, MsgTryUpgrade}
 
@@ -150,8 +157,14 @@ def _run(
         raise AnteError("tx has no messages")
 
     # --- 2: msg version gating ---------------------------------------------
+    # Nested authz msgs are gated too (the reference's MsgVersioningGateKeeper
+    # unpacks MsgExec, msg_gatekeeper.go).
     allowed = allowed_msg_types(ctx.app_version)
+    to_gate = list(msgs)
     for m in msgs:
+        if isinstance(m, MsgAuthzExec):
+            to_gate.extend(m.inner_msgs())
+    for m in to_gate:
         if type(m) not in allowed:
             raise AnteError(
                 f"message {type(m).__name__} not allowed at app version {ctx.app_version}"
@@ -230,9 +243,34 @@ def _run(
     # (DeductFeeDecorator at ante.go:46-49 vs SigVerification at :60-63), so
     # an underfunded fee payer surfaces as insufficient funds even when the
     # signature is also bad.  The branch is discarded on rejection.
+    # Fee.granter routes payment through an x/feegrant allowance (the sdk's
+    # DeductFeeDecorator feegrant path; txsim's master account pays its
+    # sub-accounts' fees this way, test/txsim/account.go:238-239).
+    # An explicit Fee.payer must be the signer: honoring a third-party
+    # payer would charge an account that never signed (the sdk requires
+    # the payer to be a tx signer; with single-signer txs that means the
+    # signer itself).  Silently ignoring the field would debit the wrong
+    # account from the client's point of view.
+    if fee.payer and fee.payer != signer_addr:
+        raise AnteError(
+            f"fee payer {fee.payer} must be the tx signer {signer_addr}"
+        )
+    fee_payer = signer_addr
+    if fee.granter:
+        from celestia_app_tpu.modules.feegrant import FeegrantError, FeegrantKeeper
+
+        try:
+            FeegrantKeeper(ctx.store).use_grant(
+                fee.granter, signer_addr, fee_utia,
+                [type(m).TYPE_URL for m in msgs], ctx.time_ns,
+            )
+        except FeegrantError as e:
+            raise AnteError(str(e)) from e
+        fee_payer = fee.granter
     if fee_utia:
         try:
-            ctx.bank.send(signer_addr, FEE_COLLECTOR, fee_utia)
+            # Vesting-aware: fees cannot spend still-vesting tokens.
+            ctx.send_spendable(fee_payer, FEE_COLLECTOR, fee_utia)
         except ValueError as e:
             raise AnteError(str(e)) from e
 

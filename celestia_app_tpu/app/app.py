@@ -44,11 +44,16 @@ from celestia_app_tpu.state.store import CommitStore, KVStore
 from celestia_app_tpu.tx.envelopes import unmarshal_blob_tx
 from celestia_app_tpu.tx.messages import (
     MsgAcknowledgement,
+    MsgAuthzExec,
+    MsgAuthzGrant,
+    MsgAuthzRevoke,
     MsgBeginRedelegate,
     MsgDelegate,
     MsgDeposit,
+    MsgGrantAllowance,
     MsgPayForBlobs,
     MsgRecvPacket,
+    MsgRevokeAllowance,
     MsgFundCommunityPool,
     MsgSend,
     MsgSetWithdrawAddress,
@@ -72,6 +77,12 @@ class GenesisAccount:
     address: str
     balance: int  # utia
     pubkey: bytes = b""
+    # Optional vesting schedule (x/auth/vesting; celestia mainnet genesis
+    # carries vesting accounts): type 1 = continuous, 2 = delayed.
+    vesting_type: int = 0
+    original_vesting: int = 0
+    vesting_start_ns: int = 0
+    vesting_end_ns: int = 0
 
 
 @dataclass(frozen=True)
@@ -123,6 +134,17 @@ class Ctx:
 
     def branch(self) -> "Ctx":
         return Ctx(self.store.branch(), self.height, self.time_ns, self.app_version)
+
+    def send_spendable(self, sender: str, recipient: str, amount: int) -> None:
+        """Transfer that cannot dip into still-vesting tokens."""
+        from celestia_app_tpu.state.accounts import send_spendable
+
+        send_spendable(self.auth, self.bank, sender, recipient, amount, self.time_ns)
+
+    def assert_spendable(self, sender: str, amount: int) -> None:
+        from celestia_app_tpu.state.accounts import assert_spendable
+
+        assert_spendable(self.auth, self.bank, sender, amount, self.time_ns)
 
 
 class App:
@@ -207,6 +229,11 @@ class App:
         ctx = Ctx(self.cms.working, 0, genesis.genesis_time_ns, self.app_version)
         for acc in genesis.accounts:
             a = ctx.auth.create_account(acc.address, acc.pubkey)
+            if acc.vesting_type:
+                a.vesting_type = acc.vesting_type
+                a.original_vesting = acc.original_vesting
+                a.vesting_start_ns = acc.vesting_start_ns or genesis.genesis_time_ns
+                a.vesting_end_ns = acc.vesting_end_ns
             ctx.auth.set_account(a)
             if acc.balance:
                 ctx.bank.mint(acc.address, acc.balance)
@@ -220,6 +247,11 @@ class App:
             # (no escrowed delegation backs it); register it with
             # distribution so its reward share accrues to the operator.
             dist.set_notional(v.address, v.power * POWER_REDUCTION)
+        # x/crisis: genesis invariant assertion (the reference runs module
+        # invariants at genesis unless skipGenesisInvariants).
+        from celestia_app_tpu.modules.crisis import assert_invariants
+
+        assert_invariants(self.cms.working)
         self.cms.commit(0)
         self._check_state = None
 
@@ -516,8 +548,52 @@ class App:
     def _handle_msg(self, ctx: Ctx, msg, gas_remaining: int):
         if isinstance(msg, MsgSend):
             total = sum(c.amount for c in msg.amount if c.denom == "utia")
-            ctx.bank.send(msg.from_address, msg.to_address, total)
+            ctx.send_spendable(msg.from_address, msg.to_address, total)
             return 0, [("transfer", msg.from_address, msg.to_address, total)]
+        if isinstance(msg, MsgAuthzExec):
+            return self._handle_authz_exec(ctx, msg, gas_remaining)
+        if isinstance(msg, (MsgAuthzGrant, MsgAuthzRevoke)):
+            from celestia_app_tpu.modules.authz import AuthzError, AuthzKeeper, Grant
+
+            authz = AuthzKeeper(ctx.store)
+            try:
+                if isinstance(msg, MsgAuthzGrant):
+                    authz.grant(
+                        msg.granter, msg.grantee,
+                        Grant(msg.msg_type_url, msg.spend_limit, msg.expiration_ns),
+                    )
+                    return 0, [("cosmos.authz.v1beta1.EventGrant",
+                                msg.granter, msg.grantee, msg.msg_type_url)]
+                authz.revoke(msg.granter, msg.grantee, msg.msg_type_url)
+                return 0, [("cosmos.authz.v1beta1.EventRevoke",
+                            msg.granter, msg.grantee, msg.msg_type_url)]
+            except AuthzError as e:
+                raise ValueError(str(e)) from e
+        if isinstance(msg, (MsgGrantAllowance, MsgRevokeAllowance)):
+            from celestia_app_tpu.modules.feegrant import (
+                Allowance,
+                FeegrantError,
+                FeegrantKeeper,
+            )
+
+            feegrant = FeegrantKeeper(ctx.store)
+            try:
+                if isinstance(msg, MsgGrantAllowance):
+                    feegrant.grant(
+                        msg.granter, msg.grantee,
+                        Allowance(
+                            spend_limit=msg.spend_limit,
+                            expiration_ns=msg.expiration_ns,
+                            allowed_msgs=msg.allowed_msgs,
+                        ),
+                    )
+                    return 0, [("cosmos.feegrant.v1beta1.EventSetFeeGrant",
+                                msg.granter, msg.grantee)]
+                feegrant.revoke(msg.granter, msg.grantee)
+                return 0, [("cosmos.feegrant.v1beta1.EventRevokeFeeGrant",
+                            msg.granter, msg.grantee)]
+            except FeegrantError as e:
+                raise ValueError(str(e)) from e
         if isinstance(msg, MsgPayForBlobs):
             # keeper.PayForBlobs (x/blob/keeper/keeper.go:43-57): consume
             # shares x 512 x gasPerBlobByte, emit the event.
@@ -554,12 +630,25 @@ class App:
                     ctx.staking, msg.delegator_address, msg.validator_dst_address
                 )
             if isinstance(msg, MsgDelegate):
+                # Vesting bookkeeping BEFORE the escrow moves: delegations
+                # consume locked tokens first (sdk TrackDelegation), so a
+                # vesting account's later-received liquid funds stay
+                # spendable.
+                acc = ctx.auth.get_account(msg.delegator_address)
+                if acc is not None and acc.vesting_type:
+                    acc.track_delegation(amount, ctx.time_ns)
+                    ctx.auth.set_account(acc)
                 ctx.staking.delegate(
                     ctx.bank, msg.delegator_address, msg.validator_address, amount
                 )
                 return 0, [("cosmos.staking.v1beta1.EventDelegate",
                             msg.validator_address, amount)]
             if isinstance(msg, MsgUndelegate):
+                # No vesting bookkeeping here: the tokens return at
+                # unbonding COMPLETION (end blocker), and that's when the
+                # lock re-encumbers them (sdk TrackUndelegation runs at
+                # CompleteUnbonding) — untracking now would freeze the
+                # account's liquid funds for the whole unbonding window.
                 completion = ctx.staking.undelegate(
                     ctx.bank, msg.delegator_address, msg.validator_address,
                     amount, ctx.time_ns,
@@ -621,6 +710,7 @@ class App:
                     )
                     return 0, []
                 total = sum(c.amount for c in msg.amount if c.denom == "utia")
+                ctx.assert_spendable(msg.depositor, total)
                 dist.fund_community_pool(ctx.bank, msg.depositor, total)
                 return 0, [(
                     "cosmos.distribution.v1beta1.EventFundCommunityPool", total,
@@ -633,6 +723,7 @@ class App:
             gov = GovKeeper(ctx.store, ctx.staking, ctx.bank)
             if isinstance(msg, MsgSubmitProposal):
                 deposit = sum(c.amount for c in msg.initial_deposit if c.denom == "utia")
+                ctx.assert_spendable(msg.proposer, deposit)
                 spend = None
                 if msg.spend_recipient:
                     spend = (
@@ -651,9 +742,41 @@ class App:
                 gov.vote(msg.proposal_id, msg.voter, msg.option, ctx.time_ns)
                 return 0, [("cosmos.gov.v1beta1.EventVote", msg.proposal_id, msg.voter)]
             deposit = sum(c.amount for c in msg.amount if c.denom == "utia")
+            ctx.assert_spendable(msg.depositor, deposit)
             gov.deposit(msg.proposal_id, msg.depositor, deposit, ctx.time_ns)
             return 0, [("cosmos.gov.v1beta1.EventDeposit", msg.proposal_id, deposit)]
         raise ValueError(f"no handler for {type(msg).__name__}")
+
+    def _handle_authz_exec(self, ctx: Ctx, msg, gas_remaining: int):
+        """MsgExec (sdk authz DispatchActions): each inner msg's signer is
+        the GRANTER; the grant (granter -> grantee=tx signer, msg type) is
+        checked-and-consumed, then the msg runs through the normal
+        handlers.  PFBs cannot ride in an exec (blobs only travel in
+        BlobTxs), matching the reference's gatekeeping."""
+        from celestia_app_tpu.modules.authz import AuthzError, AuthzKeeper
+
+        authz = AuthzKeeper(ctx.store)
+        gas_total, events = 0, []
+        for inner in msg.inner_msgs():
+            if isinstance(inner, (MsgPayForBlobs, MsgAuthzExec)):
+                raise ValueError(
+                    f"{type(inner).__name__} cannot be nested in MsgExec"
+                )
+            granter = getattr(inner, "signer", None) or getattr(
+                inner, "from_address", None
+            )
+            if not granter:
+                raise ValueError(
+                    f"cannot determine granter for {type(inner).__name__}"
+                )
+            try:
+                authz.accept(granter, msg.grantee, inner, ctx.time_ns)
+            except AuthzError as e:
+                raise ValueError(str(e)) from e
+            used, evts = self._handle_msg(ctx, inner, gas_remaining - gas_total)
+            gas_total += used
+            events.extend(evts)
+        return gas_total, events
 
     def _handle_ibc_msg(self, ctx: Ctx, msg):
         """Transfer sends + the three relay callbacks through the versioned
@@ -670,6 +793,9 @@ class App:
 
         channels = ChannelKeeper(ctx.store)
         if isinstance(msg, MsgTransfer):
+            if msg.token.denom == "utia":
+                # Escrow is an outflow: vesting tokens cannot leave via IBC.
+                ctx.assert_spendable(msg.sender, msg.token.amount)
             keeper = TransferKeeper(channels, ctx.bank)
             packet = keeper.send_transfer(
                 source_channel=msg.source_channel,
@@ -742,8 +868,16 @@ class App:
 
         GovKeeper(ctx.store, ctx.staking, ctx.bank).end_blocker(ctx.time_ns)
         # Matured unbonding delegations release back to delegators
-        # (x/staking EndBlocker's unbonding queue).
-        ctx.staking.complete_unbondings(ctx.bank, ctx.time_ns)
+        # (x/staking EndBlocker's unbonding queue); returning tokens
+        # re-encumber a vesting account's lock (sdk TrackUndelegation at
+        # CompleteUnbonding).
+        for delegator, amount in ctx.staking.complete_unbondings(
+            ctx.bank, ctx.time_ns
+        ):
+            acc = ctx.auth.get_account(delegator)
+            if acc is not None and acc.vesting_type:
+                acc.track_undelegation(amount)
+                ctx.auth.set_account(acc)
         if self.app_version == 1:
             from celestia_app_tpu.modules.blobstream.keeper import BlobstreamKeeper
 

@@ -312,6 +312,22 @@ def cmd_export(args) -> int:
     return 0
 
 
+def cmd_check_invariants(args) -> int:
+    """x/crisis on demand (the sdk's MsgVerifyInvariant / invariant-check
+    path): run every registered module invariant against committed state."""
+    from celestia_app_tpu.modules.crisis import InvariantBroken, assert_invariants
+
+    app = load_app(args.home)
+    try:
+        names = assert_invariants(app.cms.working)
+    except InvariantBroken as e:
+        print(f"INVARIANT BROKEN at height {app.height}: {e}", file=sys.stderr)
+        return 1
+    print(f"ok: {len(names)} invariants hold at height {app.height}: "
+          + ", ".join(names))
+    return 0
+
+
 def cmd_rollback(args) -> int:
     app = load_app(args.home)
     if app.height == 0:
@@ -441,6 +457,11 @@ def main(argv: list[str] | None = None) -> int:
 
     p = sub.add_parser("rollback", help="drop the latest committed height")
     p.set_defaults(fn=cmd_rollback)
+
+    p = sub.add_parser(
+        "check-invariants", help="run x/crisis module invariants"
+    )
+    p.set_defaults(fn=cmd_check_invariants)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -431,10 +431,31 @@ class ServingNode(TestNode):
             if h > upto:
                 return
             for peer in self.peers():
+                # Fetch the block AND its Commit record from the same peer
+                # BEFORE applying anything: if this node later PROPOSES, it
+                # derives LastCommitInfo from records, and peers cross-check
+                # the shipped signer set against their own verified records
+                # — advancing without the record risks proposing with
+                # LastCommitInfo=None while peers derive the real signer
+                # set, a guaranteed app-hash divergence.  A transient fetch
+                # failure moves on to the next peer like any other.
                 try:
                     b = peer.block(h)
+                    rec = peer.commit(h)  # parsed Commit, or None
                 except Exception:
                     continue
+                if rec is None:
+                    # This peer applied the block but never held the round's
+                    # record (it state-synced past it); ask the others.
+                    for other in self.peers():
+                        if other is peer:
+                            continue
+                        try:
+                            rec = other.commit(h)
+                        except Exception:
+                            continue
+                        if rec is not None:
+                            break
                 data = BlockData(
                     txs=tuple(bytes.fromhex(t) for t in b["txs"]),
                     square_size=b["square_size"],
@@ -446,19 +467,9 @@ class ServingNode(TestNode):
                     last_commit_signers=set(signers) if signers is not None else None,
                     evidence=self._parse_evidence(b.get("evidence") or []),
                 )
-                # Learn the Commit record too (same trust anchor as the
-                # block itself): if this node later PROPOSES, it must derive
-                # LastCommitInfo from records, and peers cross-check the
-                # shipped signer set against their own verified records.
-                try:
-                    rec = peer.commit(h)
-                    if rec is not None:
-                        from celestia_app_tpu.consensus import Commit
-
-                        with self.lock:
-                            self._commits[h] = Commit.from_json(rec)
-                except Exception:
-                    pass
+                if rec is not None:
+                    with self.lock:
+                        self._commits[h] = rec
                 break
             else:
                 raise ValueError(f"cannot catch up: no peer serves block {h}")

@@ -4,6 +4,13 @@ The minimal stateful substrate the reference app needs from cosmos-sdk
 auth/bank for its tx flow: account numbers/sequences/pubkeys for signature
 checks (ante), balances for fees and sends, module accounts for fee
 collection and minting.
+
+Vesting accounts (the reference wires x/auth/vesting, app/modules.go:105)
+are base accounts with a lock schedule: `Account.locked(time_ns)` is the
+still-vesting amount, and `send_spendable` refuses transfers that would dip
+into it.  As in the sdk, locked tokens CAN be delegated (staking escrows
+bypass the spendable check) — the lock follows the account, not the coins,
+so undelegated tokens return under the same schedule.
 """
 
 from __future__ import annotations
@@ -29,34 +36,94 @@ _SUPPLY_KEY = b"bank/supply/"
 _GLOBAL_ACC_NUM = b"auth/global_account_number"
 
 
+VESTING_NONE = 0
+VESTING_CONTINUOUS = 1  # linear release between start and end
+VESTING_DELAYED = 2  # everything releases at end
+
+
 @dataclass
 class Account:
     address: str
     pubkey: bytes  # 33-byte compressed secp256k1, b"" until first known
     account_number: int
     sequence: int
+    # Vesting schedule (x/auth/vesting Continuous/DelayedVestingAccount);
+    # all-zero for base accounts, and all-zero accounts marshal exactly as
+    # before these fields existed (no state-layout break).
+    vesting_type: int = VESTING_NONE
+    original_vesting: int = 0
+    vesting_start_ns: int = 0
+    vesting_end_ns: int = 0
+    # Locked tokens currently delegated (sdk DelegatedVesting): they are
+    # out of the balance, so the lock must not double-count them or
+    # later-received liquid funds would freeze.
+    delegated_vesting: int = 0
 
     def marshal(self) -> bytes:
-        return (
+        out = (
             encode_bytes_field(1, self.address.encode())
             + encode_bytes_field(2, self.pubkey)
             + encode_varint_field(3, self.account_number)
             + encode_varint_field(4, self.sequence)
         )
+        if self.vesting_type:
+            out += (
+                encode_varint_field(5, self.vesting_type)
+                + encode_varint_field(6, self.original_vesting)
+                + encode_varint_field(7, self.vesting_start_ns)
+                + encode_varint_field(8, self.vesting_end_ns)
+                + encode_varint_field(9, self.delegated_vesting)
+            )
+        return out
 
     @classmethod
     def unmarshal(cls, raw: bytes) -> "Account":
-        addr, pk, num, seq = "", b"", 0, 0
+        addr, pk = "", b""
+        ints = {}
         for fnum, wt, val in decode_fields(raw):
             if fnum == 1 and wt == WIRE_LEN:
                 addr = val.decode()
             elif fnum == 2 and wt == WIRE_LEN:
                 pk = val
-            elif fnum == 3 and wt == WIRE_VARINT:
-                num = val
-            elif fnum == 4 and wt == WIRE_VARINT:
-                seq = val
-        return cls(addr, pk, num, seq)
+            elif wt == WIRE_VARINT:
+                ints[fnum] = val
+        return cls(
+            addr, pk, ints.get(3, 0), ints.get(4, 0),
+            ints.get(5, 0), ints.get(6, 0), ints.get(7, 0), ints.get(8, 0),
+            ints.get(9, 0),
+        )
+
+    def _schedule_locked(self, time_ns: int) -> int:
+        if self.vesting_type == VESTING_NONE or self.original_vesting == 0:
+            return 0
+        if time_ns >= self.vesting_end_ns:
+            return 0
+        if self.vesting_type == VESTING_DELAYED:
+            return self.original_vesting
+        # Continuous: vested grows linearly from start to end (truncating,
+        # as sdk's coin arithmetic does); nothing vests before start.
+        if time_ns <= self.vesting_start_ns:
+            return self.original_vesting
+        elapsed = time_ns - self.vesting_start_ns
+        duration = self.vesting_end_ns - self.vesting_start_ns
+        vested = self.original_vesting * elapsed // duration
+        return self.original_vesting - vested
+
+    def locked(self, time_ns: int) -> int:
+        """Still-vesting tokens encumbering the BALANCE at `time_ns`
+        (sdk LockedCoins = schedule minus DelegatedVesting: locked tokens
+        sitting in the staking escrow are no longer in the balance)."""
+        return max(0, self._schedule_locked(time_ns) - self.delegated_vesting)
+
+    def track_delegation(self, amount: int, time_ns: int) -> None:
+        """Called when this account delegates (sdk TrackDelegation):
+        delegations consume locked tokens first."""
+        still_locked = self.locked(time_ns)
+        self.delegated_vesting += min(amount, still_locked)
+
+    def track_undelegation(self, amount: int) -> None:
+        """Called when this account undelegates (sdk TrackUndelegation)."""
+        self.delegated_vesting -= min(self.delegated_vesting, amount)
 
 
 class AuthKeeper:
@@ -123,3 +190,39 @@ class BankKeeper:
 
     def _set_supply(self, denom: str, amount: int) -> None:
         self.store.set(_SUPPLY_KEY + denom.encode(), amount.to_bytes(16, "big"))
+
+    def balances(self) -> dict[tuple[str, str], int]:
+        """(address, denom) -> amount over all accounts — the x/crisis
+        supply invariant walks this."""
+        out = {}
+        for key, val in self.store.iterate(_BAL_PREFIX):
+            addr, denom = key[len(_BAL_PREFIX):].rsplit(b"/", 1)
+            out[(addr.decode(), denom.decode())] = int.from_bytes(val, "big")
+        return out
+
+
+def assert_spendable(
+    auth: AuthKeeper, bank: BankKeeper, sender: str, amount: int, time_ns: int
+) -> None:
+    """Raise unless `sender` can part with `amount` without dipping into
+    still-vesting tokens (sdk LockedCoins).  Module accounts have no
+    Account record and no lock."""
+    acc = auth.get_account(sender)
+    locked = acc.locked(time_ns) if acc is not None else 0
+    if locked:
+        bal = bank.balance(sender)
+        if bal - amount < locked:
+            raise ValueError(
+                f"insufficient spendable funds: {sender} has {bal}utia with "
+                f"{locked}utia still vesting, cannot send {amount}"
+            )
+
+
+def send_spendable(
+    auth: AuthKeeper, bank: BankKeeper, sender: str, recipient: str,
+    amount: int, time_ns: int,
+) -> None:
+    """A transfer that respects the sender's vesting lock: spendable =
+    balance - locked (sdk bank SendCoins via LockedCoins)."""
+    assert_spendable(auth, bank, sender, amount, time_ns)
+    bank.send(sender, recipient, amount)

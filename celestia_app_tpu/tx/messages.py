@@ -48,6 +48,28 @@ URL_MSG_WITHDRAW_VALIDATOR_COMMISSION = (
 URL_MSG_SET_WITHDRAW_ADDRESS = "/cosmos.distribution.v1beta1.MsgSetWithdrawAddress"
 URL_MSG_FUND_COMMUNITY_POOL = "/cosmos.distribution.v1beta1.MsgFundCommunityPool"
 URL_MSG_UNJAIL = "/cosmos.slashing.v1beta1.MsgUnjail"
+URL_MSG_GRANT_ALLOWANCE = "/cosmos.feegrant.v1beta1.MsgGrantAllowance"
+URL_MSG_REVOKE_ALLOWANCE = "/cosmos.feegrant.v1beta1.MsgRevokeAllowance"
+URL_BASIC_ALLOWANCE = "/cosmos.feegrant.v1beta1.BasicAllowance"
+URL_ALLOWED_MSG_ALLOWANCE = "/cosmos.feegrant.v1beta1.AllowedMsgAllowance"
+URL_MSG_AUTHZ_GRANT = "/cosmos.authz.v1beta1.MsgGrant"
+URL_MSG_AUTHZ_EXEC = "/cosmos.authz.v1beta1.MsgExec"
+URL_MSG_AUTHZ_REVOKE = "/cosmos.authz.v1beta1.MsgRevoke"
+URL_GENERIC_AUTHORIZATION = "/cosmos.authz.v1beta1.GenericAuthorization"
+URL_SEND_AUTHORIZATION = "/cosmos.bank.v1beta1.SendAuthorization"
+
+
+def _encode_timestamp(ns: int) -> bytes:
+    """google.protobuf.Timestamp {seconds=1, nanos=2}."""
+    out = encode_varint_field(1, ns // 10**9)
+    if ns % 10**9:
+        out += encode_varint_field(2, ns % 10**9)
+    return out
+
+
+def _decode_timestamp(raw: bytes) -> int:
+    f = {n: v for n, wt, v in decode_fields(raw) if wt == WIRE_VARINT}
+    return f.get(1, 0) * 10**9 + f.get(2, 0)
 
 
 @dataclass(frozen=True)
@@ -784,7 +806,305 @@ class MsgFundCommunityPool:
             raise ValueError("community pool deposit must be positive")
 
 
+@dataclass(frozen=True)
+class MsgGrantAllowance:
+    """cosmos.feegrant.v1beta1.MsgGrantAllowance {granter=1, grantee=2,
+    allowance=3 (Any)}.  Wire allowances: BasicAllowance {spend_limit=1
+    repeated Coin, expiration=2 Timestamp} optionally wrapped in
+    AllowedMsgAllowance {allowance=1 Any, allowed_messages=2}."""
+
+    granter: str
+    grantee: str
+    spend_limit: int = 0  # 0 = unlimited
+    expiration_ns: int = 0  # 0 = never
+    allowed_msgs: tuple[str, ...] = ()
+
+    TYPE_URL = URL_MSG_GRANT_ALLOWANCE
+
+    def _allowance(self) -> Any:
+        basic = b""
+        if self.spend_limit:
+            basic += encode_bytes_field(1, Coin("utia", self.spend_limit).marshal())
+        if self.expiration_ns:
+            basic += encode_bytes_field(2, _encode_timestamp(self.expiration_ns))
+        inner = Any(URL_BASIC_ALLOWANCE, basic)
+        if not self.allowed_msgs:
+            return inner
+        body = encode_bytes_field(1, inner.marshal())
+        for url in self.allowed_msgs:
+            body += encode_bytes_field(2, url.encode())
+        return Any(URL_ALLOWED_MSG_ALLOWANCE, body)
+
+    def marshal(self) -> bytes:
+        return (
+            encode_bytes_field(1, self.granter.encode())
+            + encode_bytes_field(2, self.grantee.encode())
+            + encode_bytes_field(3, self._allowance().marshal())
+        )
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgGrantAllowance":
+        f = {n: v for n, wt, v in decode_fields(raw) if wt == WIRE_LEN}
+        granter = f.get(1, b"").decode()
+        grantee = f.get(2, b"").decode()
+        spend, exp = 0, 0
+        allowed: list[str] = []
+        a = Any.unmarshal(f.get(3, b""))
+        if a.type_url == URL_ALLOWED_MSG_ALLOWANCE:
+            inner_raw = b""
+            for n, wt, v in decode_fields(a.value):
+                if n == 1 and wt == WIRE_LEN:
+                    inner_raw = v
+                elif n == 2 and wt == WIRE_LEN:
+                    allowed.append(v.decode())
+            a = Any.unmarshal(inner_raw)
+        if a.type_url != URL_BASIC_ALLOWANCE:
+            raise ValueError(f"unsupported allowance {a.type_url}")
+        for n, wt, v in decode_fields(a.value):
+            if n == 1 and wt == WIRE_LEN:
+                c = Coin.unmarshal(v)
+                if c.denom != "utia":
+                    # Dropping a foreign-denom limit would decode a capped
+                    # allowance as UNLIMITED (0) — reject instead.
+                    raise ValueError(
+                        f"unsupported fee allowance denom {c.denom!r}"
+                    )
+                spend += c.amount
+            elif n == 2 and wt == WIRE_LEN:
+                exp = _decode_timestamp(v)
+        return cls(granter, grantee, spend, exp, tuple(allowed))
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+    @property
+    def signer(self) -> str:
+        return self.granter
+
+    def validate_basic(self) -> None:
+        from celestia_app_tpu.crypto.keys import validate_address
+
+        validate_address(self.granter)
+        validate_address(self.grantee)
+        if self.granter == self.grantee:
+            raise ValueError("cannot self-grant a fee allowance")
+
+
+@dataclass(frozen=True)
+class MsgRevokeAllowance:
+    """cosmos.feegrant.v1beta1.MsgRevokeAllowance {granter=1, grantee=2}."""
+
+    granter: str
+    grantee: str
+
+    TYPE_URL = URL_MSG_REVOKE_ALLOWANCE
+
+    def marshal(self) -> bytes:
+        return encode_bytes_field(1, self.granter.encode()) + encode_bytes_field(
+            2, self.grantee.encode()
+        )
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgRevokeAllowance":
+        f = {n: v for n, wt, v in decode_fields(raw) if wt == WIRE_LEN}
+        return cls(f.get(1, b"").decode(), f.get(2, b"").decode())
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+    @property
+    def signer(self) -> str:
+        return self.granter
+
+    def validate_basic(self) -> None:
+        from celestia_app_tpu.crypto.keys import validate_address
+
+        validate_address(self.granter)
+        validate_address(self.grantee)
+
+
+@dataclass(frozen=True)
+class MsgAuthzGrant:
+    """cosmos.authz.v1beta1.MsgGrant {granter=1, grantee=2, grant=3
+    {authorization=1 (Any), expiration=2 Timestamp}}.  Authorizations:
+    GenericAuthorization {msg=1} or SendAuthorization {spend_limit=1}."""
+
+    granter: str
+    grantee: str
+    msg_type_url: str
+    spend_limit: int = 0  # >0 encodes a SendAuthorization
+    expiration_ns: int = 0
+
+    TYPE_URL = URL_MSG_AUTHZ_GRANT
+
+    def _authorization(self) -> Any:
+        if self.spend_limit:
+            return Any(
+                URL_SEND_AUTHORIZATION,
+                encode_bytes_field(1, Coin("utia", self.spend_limit).marshal()),
+            )
+        return Any(
+            URL_GENERIC_AUTHORIZATION,
+            encode_bytes_field(1, self.msg_type_url.encode()),
+        )
+
+    def marshal(self) -> bytes:
+        grant = encode_bytes_field(1, self._authorization().marshal())
+        if self.expiration_ns:
+            grant += encode_bytes_field(2, _encode_timestamp(self.expiration_ns))
+        return (
+            encode_bytes_field(1, self.granter.encode())
+            + encode_bytes_field(2, self.grantee.encode())
+            + encode_bytes_field(3, grant)
+        )
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgAuthzGrant":
+        f = {n: v for n, wt, v in decode_fields(raw) if wt == WIRE_LEN}
+        granter = f.get(1, b"").decode()
+        grantee = f.get(2, b"").decode()
+        url, spend, exp = "", 0, 0
+        for n, wt, v in decode_fields(f.get(3, b"")):
+            if n == 1 and wt == WIRE_LEN:
+                auth = Any.unmarshal(v)
+                if auth.type_url == URL_GENERIC_AUTHORIZATION:
+                    for an, awt, av in decode_fields(auth.value):
+                        if an == 1 and awt == WIRE_LEN:
+                            url = av.decode()
+                elif auth.type_url == URL_SEND_AUTHORIZATION:
+                    url = URL_MSG_SEND
+                    for an, awt, av in decode_fields(auth.value):
+                        if an == 1 and awt == WIRE_LEN:
+                            c = Coin.unmarshal(av)
+                            if c.denom != "utia":
+                                # A foreign-denom limit must not decode to
+                                # spend_limit=0 (= unbounded).
+                                raise ValueError(
+                                    f"unsupported authorization denom {c.denom!r}"
+                                )
+                            spend += c.amount
+                else:
+                    raise ValueError(f"unsupported authorization {auth.type_url}")
+            elif n == 2 and wt == WIRE_LEN:
+                exp = _decode_timestamp(v)
+        return cls(granter, grantee, url, spend, exp)
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+    @property
+    def signer(self) -> str:
+        return self.granter
+
+    def validate_basic(self) -> None:
+        from celestia_app_tpu.crypto.keys import validate_address
+
+        validate_address(self.granter)
+        validate_address(self.grantee)
+        if self.granter == self.grantee:
+            raise ValueError("cannot self-grant")
+        if not self.msg_type_url:
+            raise ValueError("authorization needs a msg type url")
+        if self.spend_limit and self.msg_type_url != URL_MSG_SEND:
+            # spend_limit>0 encodes a SendAuthorization; combining it with
+            # another msg type would sign a different authority than this
+            # object declares.
+            raise ValueError(
+                "spend_limit applies only to a MsgSend authorization"
+            )
+
+
+@dataclass(frozen=True)
+class MsgAuthzExec:
+    """cosmos.authz.v1beta1.MsgExec {grantee=1, msgs=2 (repeated Any)}."""
+
+    grantee: str
+    msgs: tuple[Any, ...]
+
+    TYPE_URL = URL_MSG_AUTHZ_EXEC
+
+    def marshal(self) -> bytes:
+        out = encode_bytes_field(1, self.grantee.encode())
+        for m in self.msgs:
+            out += encode_bytes_field(2, m.marshal())
+        return out
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgAuthzExec":
+        grantee = ""
+        msgs: list[Any] = []
+        for n, wt, v in decode_fields(raw):
+            if n == 1 and wt == WIRE_LEN:
+                grantee = v.decode()
+            elif n == 2 and wt == WIRE_LEN:
+                msgs.append(Any.unmarshal(v))
+        return cls(grantee, tuple(msgs))
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+    def inner_msgs(self) -> list:
+        return [decode_msg(m) for m in self.msgs]
+
+    @property
+    def signer(self) -> str:
+        return self.grantee
+
+    def validate_basic(self) -> None:
+        from celestia_app_tpu.crypto.keys import validate_address
+
+        validate_address(self.grantee)
+        if not self.msgs:
+            raise ValueError("MsgExec needs at least one message")
+        for m in self.inner_msgs():
+            m.validate_basic()
+
+
+@dataclass(frozen=True)
+class MsgAuthzRevoke:
+    """cosmos.authz.v1beta1.MsgRevoke {granter=1, grantee=2, msg_type_url=3}."""
+
+    granter: str
+    grantee: str
+    msg_type_url: str
+
+    TYPE_URL = URL_MSG_AUTHZ_REVOKE
+
+    def marshal(self) -> bytes:
+        return (
+            encode_bytes_field(1, self.granter.encode())
+            + encode_bytes_field(2, self.grantee.encode())
+            + encode_bytes_field(3, self.msg_type_url.encode())
+        )
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgAuthzRevoke":
+        f = {n: v for n, wt, v in decode_fields(raw) if wt == WIRE_LEN}
+        return cls(
+            f.get(1, b"").decode(), f.get(2, b"").decode(), f.get(3, b"").decode()
+        )
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+    @property
+    def signer(self) -> str:
+        return self.granter
+
+    def validate_basic(self) -> None:
+        from celestia_app_tpu.crypto.keys import validate_address
+
+        validate_address(self.granter)
+        if not self.msg_type_url:
+            raise ValueError("revoke needs a msg type url")
+
+
 MSG_DECODERS = {
+    URL_MSG_GRANT_ALLOWANCE: MsgGrantAllowance.unmarshal,
+    URL_MSG_REVOKE_ALLOWANCE: MsgRevokeAllowance.unmarshal,
+    URL_MSG_AUTHZ_GRANT: MsgAuthzGrant.unmarshal,
+    URL_MSG_AUTHZ_EXEC: MsgAuthzExec.unmarshal,
+    URL_MSG_AUTHZ_REVOKE: MsgAuthzRevoke.unmarshal,
     URL_MSG_UNJAIL: MsgUnjail.unmarshal,
     URL_MSG_WITHDRAW_DELEGATOR_REWARD: MsgWithdrawDelegatorReward.unmarshal,
     URL_MSG_WITHDRAW_VALIDATOR_COMMISSION: MsgWithdrawValidatorCommission.unmarshal,

@@ -25,26 +25,42 @@ SIGN_MODE_DIRECT = 1
 
 @dataclass(frozen=True)
 class Fee:
+    """cosmos.tx.v1beta1.Fee {amount=1, gas_limit=2, payer=3, granter=4}.
+    `granter` routes the fee through an x/feegrant allowance (the
+    reference's txsim master account pays sub-account fees this way,
+    test/txsim/account.go:238-239)."""
+
     amount: tuple[Coin, ...]
     gas_limit: int
+    payer: str = ""
+    granter: str = ""
 
     def marshal(self) -> bytes:
         out = b""
         for c in self.amount:
             out += encode_bytes_field(1, c.marshal())
         out += encode_varint_field(2, self.gas_limit)
+        if self.payer:
+            out += encode_bytes_field(3, self.payer.encode())
+        if self.granter:
+            out += encode_bytes_field(4, self.granter.encode())
         return out
 
     @classmethod
     def unmarshal(cls, raw: bytes) -> "Fee":
         coins: list[Coin] = []
         gas = 0
+        payer, granter = "", ""
         for num, wt, val in decode_fields(raw):
             if num == 1 and wt == WIRE_LEN:
                 coins.append(Coin.unmarshal(val))
             elif num == 2 and wt == WIRE_VARINT:
                 gas = val
-        return cls(tuple(coins), gas)
+            elif num == 3 and wt == WIRE_LEN:
+                payer = val.decode()
+            elif num == 4 and wt == WIRE_LEN:
+                granter = val.decode()
+        return cls(tuple(coins), gas, payer, granter)
 
 
 def _marshal_pubkey(pk: PublicKey) -> bytes:

@@ -78,11 +78,25 @@ class StakeSequence:
         return ("claim", None)
 
 
-def run(node, keys, sequences, blocks: int, seed: int = 42) -> dict:
-    """Drive `sequences` for `blocks` blocks; returns submission stats."""
+def run(
+    node, keys, sequences, blocks: int, seed: int = 42,
+    use_feegrant: bool = False,
+) -> dict:
+    """Drive `sequences` for `blocks` blocks; returns submission stats.
+
+    `use_feegrant` mirrors the reference AccountManager: the master (first)
+    account grants every other account a fee allowance up front and then
+    pays all their fees (test/txsim/account.go:238-239,318-330)."""
     rng = np.random.default_rng(seed)
     client = TxClient(node, keys)
     addrs = client.signer.addresses()
+    if use_feegrant and len(addrs) > 1:
+        from celestia_app_tpu.tx.messages import MsgGrantAllowance
+
+        master = addrs[0]
+        grants = [MsgGrantAllowance(master, a) for a in addrs[1:]]
+        client.submit_tx(grants, master, gas=200_000)  # confirms inclusion
+        client.fee_granter = master
     for i, seq in enumerate(sequences):
         seq.address = addrs[i % len(addrs)]
 

@@ -44,7 +44,10 @@ class Signer:
     def addresses(self) -> list[str]:
         return list(self._accounts)
 
-    def create_tx(self, address: str, msgs: list, gas: int, fee_utia: int) -> bytes:
+    def create_tx(
+        self, address: str, msgs: list, gas: int, fee_utia: int,
+        fee_granter: str = "",
+    ) -> bytes:
         acc = self._accounts[address]
         raw = build_and_sign(
             msgs,
@@ -52,16 +55,17 @@ class Signer:
             self.chain_id,
             acc.account_number,
             acc.sequence,
-            Fee((Coin("utia", fee_utia),), gas),
+            Fee((Coin("utia", fee_utia),), gas, granter=fee_granter),
         )
         return raw
 
     def create_pay_for_blobs(
-        self, address: str, blobs: list[Blob], gas: int, fee_utia: int
+        self, address: str, blobs: list[Blob], gas: int, fee_utia: int,
+        fee_granter: str = "",
     ) -> bytes:
         """BlobTx bytes for a PFB (signer.CreatePayForBlobs)."""
         msg = new_msg_pay_for_blobs(address, blobs)
-        raw_tx = self.create_tx(address, [msg], gas, fee_utia)
+        raw_tx = self.create_tx(address, [msg], gas, fee_utia, fee_granter)
         return BlobTx(raw_tx, tuple(blobs)).marshal()
 
     def increment_sequence(self, address: str) -> None:

@@ -54,11 +54,15 @@ class TxClient:
         keys: list[PrivateKey],
         gas_price: Fraction = DEFAULT_GAS_PRICE,
         gas_multiplier: Fraction = DEFAULT_GAS_MULTIPLIER,
+        fee_granter: str = "",
     ):
         self._node = node
         self._lock = threading.Lock()
         self.gas_price = gas_price
         self.gas_multiplier = gas_multiplier
+        # pkg/user SetFeeGranter: every tx's fee is charged to this
+        # account's x/feegrant allowance instead of the signer.
+        self.fee_granter = fee_granter
         self.signer = Signer(node.chain_id)
         for k in keys:
             addr = k.public_key().address()
@@ -87,16 +91,22 @@ class TxClient:
     def _fee_for(self, gas: int, price: Fraction) -> int:
         return -(-(gas * price.numerator) // price.denominator)  # ceil
 
+    def _granter_for(self, address: str) -> str:
+        # The master account pays its own fees directly.
+        return self.fee_granter if self.fee_granter != address else ""
+
     def _broadcast_pfb(self, blobs, address: str) -> TxResponse:
         gas = self.estimate_gas(blobs)
         build = lambda price: self.signer.create_pay_for_blobs(
-            address, blobs, gas, self._fee_for(gas, price)
+            address, blobs, gas, self._fee_for(gas, price),
+            self._granter_for(address),
         )
         return self._broadcast_with_retry(build, address, gas)
 
     def _broadcast_msgs(self, msgs, address: str, gas: int) -> TxResponse:
         build = lambda price: self.signer.create_tx(
-            address, msgs, gas, self._fee_for(gas, price)
+            address, msgs, gas, self._fee_for(gas, price),
+            self._granter_for(address),
         )
         return self._broadcast_with_retry(build, address, gas)
 

@@ -1,0 +1,457 @@
+"""x/feegrant, x/authz, vesting accounts, x/crisis invariants.
+
+Reference wiring: feegrant app/modules.go:117-119 (txsim's master-pays
+pattern, test/txsim/account.go:238-239,318-330), authz :153-155, vesting
+:105, crisis :123-125.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from celestia_app_tpu.modules.authz import AuthzError, AuthzKeeper, Grant
+from celestia_app_tpu.modules.crisis import InvariantBroken, assert_invariants
+from celestia_app_tpu.modules.feegrant import (
+    Allowance,
+    FeegrantError,
+    FeegrantKeeper,
+)
+from celestia_app_tpu.state.accounts import (
+    VESTING_CONTINUOUS,
+    VESTING_DELAYED,
+    Account,
+    AuthKeeper,
+    BankKeeper,
+)
+from celestia_app_tpu.state.store import KVStore
+from celestia_app_tpu.testutil import TestNode, deterministic_genesis, funded_keys
+from celestia_app_tpu.tx.messages import (
+    Any,
+    Coin,
+    MsgAuthzExec,
+    MsgAuthzGrant,
+    MsgAuthzRevoke,
+    MsgGrantAllowance,
+    MsgRevokeAllowance,
+    MsgSend,
+)
+from celestia_app_tpu.tx.sign import Fee, build_and_sign
+
+HOUR_NS = 3600 * 10**9
+
+
+class TestFeegrantKeeper:
+    def test_basic_allowance_lifecycle(self):
+        store = KVStore()
+        k = FeegrantKeeper(store)
+        k.grant("master", "sub", Allowance(spend_limit=100_000))
+        with pytest.raises(FeegrantError, match="already exists"):
+            k.grant("master", "sub", Allowance())
+        k.use_grant("master", "sub", 60_000, [], time_ns=0)
+        assert k.get("master", "sub").spend_limit == 40_000
+        with pytest.raises(FeegrantError, match="exceeds allowance"):
+            k.use_grant("master", "sub", 50_000, [], time_ns=0)
+        k.use_grant("master", "sub", 40_000, [], time_ns=0)
+        assert k.get("master", "sub") is None  # spent out: pruned
+
+    def test_expiration_and_msg_filter(self):
+        store = KVStore()
+        k = FeegrantKeeper(store)
+        k.grant("m", "s", Allowance(
+            expiration_ns=HOUR_NS, allowed_msgs=("/cosmos.bank.v1beta1.MsgSend",)
+        ))
+        with pytest.raises(FeegrantError, match="does not cover"):
+            k.use_grant("m", "s", 1, ["/celestia.blob.v1.MsgPayForBlobs"], 0)
+        k.use_grant("m", "s", 1, ["/cosmos.bank.v1beta1.MsgSend"], 0)
+        with pytest.raises(FeegrantError, match="expired"):
+            k.use_grant("m", "s", 1, [], HOUR_NS)
+        assert k.get("m", "s") is None  # expired grants prune
+
+    def test_periodic_allowance(self):
+        store = KVStore()
+        k = FeegrantKeeper(store)
+        k.grant("m", "s", Allowance(
+            spend_limit=100, period_ns=HOUR_NS, period_spend_limit=30,
+        ))
+        k.use_grant("m", "s", 30, [], time_ns=1)
+        with pytest.raises(FeegrantError, match="period allowance"):
+            k.use_grant("m", "s", 1, [], time_ns=2)
+        # Next period refills (capped by the overall limit).
+        k.use_grant("m", "s", 30, [], time_ns=HOUR_NS + 1)
+        assert k.get("m", "s").spend_limit == 40
+
+    def test_revoke(self):
+        store = KVStore()
+        k = FeegrantKeeper(store)
+        k.grant("m", "s", Allowance())
+        k.revoke("m", "s")
+        with pytest.raises(FeegrantError, match="no fee allowance"):
+            k.revoke("m", "s")
+
+
+class TestAuthzKeeper:
+    def test_generic_grant_and_expiry(self):
+        store = KVStore()
+        k = AuthzKeeper(store)
+        url = "/cosmos.staking.v1beta1.MsgDelegate"
+        k.grant("g", "e", Grant(url, expiration_ns=HOUR_NS))
+
+        class Fake:
+            TYPE_URL = url
+
+        k.accept("g", "e", Fake(), time_ns=0)
+        with pytest.raises(AuthzError, match="expired"):
+            k.accept("g", "e", Fake(), time_ns=HOUR_NS)
+
+    def test_send_authorization_decrements(self):
+        store = KVStore()
+        k = AuthzKeeper(store)
+        url = "/cosmos.bank.v1beta1.MsgSend"
+        k.grant("g", "e", Grant(url, spend_limit=1000))
+        msg = MsgSend("g", "x", (Coin("utia", 700),))
+        k.accept("g", "e", msg, 0)
+        assert k.get("g", "e", url).spend_limit == 300
+        with pytest.raises(AuthzError, match="exceeds"):
+            k.accept("g", "e", msg, 0)
+        k.accept("g", "e", MsgSend("g", "x", (Coin("utia", 300),)), 0)
+        assert k.get("g", "e", url) is None  # exhausted: pruned
+
+
+class TestVestingAccount:
+    def test_delayed_lock(self):
+        a = Account("x", b"", 0, 0, VESTING_DELAYED, 1000, 0, HOUR_NS)
+        assert a.locked(0) == 1000
+        assert a.locked(HOUR_NS - 1) == 1000
+        assert a.locked(HOUR_NS) == 0
+
+    def test_continuous_lock(self):
+        a = Account("x", b"", 0, 0, VESTING_CONTINUOUS, 1000, 0, HOUR_NS)
+        assert a.locked(0) == 1000
+        assert a.locked(HOUR_NS // 2) == 500
+        assert a.locked(HOUR_NS) == 0
+
+    def test_delegation_tracking_frees_liquid_funds(self):
+        """Delegating locked tokens must not freeze later-received liquid
+        funds (sdk DelegatedVesting semantics)."""
+        a = Account("x", b"", 0, 0, VESTING_DELAYED, 1000, 0, HOUR_NS)
+        a.track_delegation(1000, time_ns=0)
+        assert a.delegated_vesting == 1000
+        assert a.locked(0) == 0  # the lock rode out with the delegation
+        a.track_undelegation(400)
+        assert a.locked(0) == 400  # returning tokens re-encumber
+
+    def test_foreign_denom_limits_rejected(self):
+        """A non-utia spend limit must not decode as UNLIMITED."""
+        from celestia_app_tpu.tx.messages import (
+            MsgAuthzGrant,
+            MsgGrantAllowance,
+        )
+
+        fg = MsgGrantAllowance("celestia1m", "celestia1s", spend_limit=50)
+        bad = fg.marshal().replace(b"utia", b"atom")
+        with pytest.raises(ValueError, match="denom"):
+            MsgGrantAllowance.unmarshal(bad)
+        az = MsgAuthzGrant(
+            "celestia1g", "celestia1e", "/cosmos.bank.v1beta1.MsgSend",
+            spend_limit=50,
+        )
+        bad = az.marshal().replace(b"utia", b"atom")
+        with pytest.raises(ValueError, match="denom"):
+            MsgAuthzGrant.unmarshal(bad)
+        # spend_limit only combines with MsgSend authority.
+        from celestia_app_tpu.crypto import PrivateKey
+
+        g = PrivateKey.from_seed(b"g").public_key().address()
+        e = PrivateKey.from_seed(b"e").public_key().address()
+        with pytest.raises(ValueError, match="MsgSend authorization"):
+            MsgAuthzGrant(
+                g, e, "/cosmos.staking.v1beta1.MsgDelegate", spend_limit=5
+            ).validate_basic()
+
+    def test_wire_backcompat(self):
+        """Base accounts marshal exactly as before vesting existed."""
+        base = Account("celestia1x", b"\x02" * 33, 7, 3)
+        assert Account.unmarshal(base.marshal()) == base
+        assert b"\x28" not in base.marshal()[-2:]  # no field-5 tag emitted
+        vest = Account("celestia1x", b"", 1, 0, VESTING_DELAYED, 99, 5, 10)
+        assert Account.unmarshal(vest.marshal()) == vest
+
+
+class TestThroughTheApp:
+    def _node(self, vesting=None):
+        from celestia_app_tpu.app import Genesis, GenesisAccount
+        from celestia_app_tpu.state.staking import Validator
+        from celestia_app_tpu.crypto import PrivateKey
+        from celestia_app_tpu.testutil.testnode import GENESIS_TIME_NS, TestNode as TN
+
+        keys = funded_keys(3)
+        accounts = []
+        for i, k in enumerate(keys):
+            extra = {}
+            if vesting and i == 1:
+                extra = vesting
+            accounts.append(GenesisAccount(
+                k.public_key().address(), 10**12, k.public_key().bytes, **extra
+            ))
+        vk = PrivateKey.from_seed(b"validator-0")
+        validators = (Validator(vk.public_key().address(),
+                                vk.public_key().bytes, 100),)
+        node = TN(Genesis("fgav-chain", GENESIS_TIME_NS, tuple(accounts),
+                          validators), keys)
+        return node, keys
+
+    def _submit(self, node, key, msgs, granter="", expect_code=0):
+        from celestia_app_tpu.state.accounts import AuthKeeper
+
+        addr = key.public_key().address()
+        acct = AuthKeeper(node.app.cms.working).get_account(addr)
+        raw = build_and_sign(
+            msgs, key, node.chain_id, acct.account_number, acct.sequence,
+            Fee((Coin("utia", 20_000),), 200_000, granter=granter),
+        )
+        res = node.broadcast(raw)
+        if expect_code == 0:
+            assert res.code == 0, res.log
+            _, results = node.produce_block()
+            return results[-1]
+        assert res.code != 0
+        return res
+
+    def test_feegrant_pays_fees(self):
+        node, keys = self._node()
+        master, sub = keys[0], keys[1]
+        m_addr = master.public_key().address()
+        s_addr = sub.public_key().address()
+        self._submit(node, master, [MsgGrantAllowance(m_addr, s_addr)])
+        bank = BankKeeper(node.app.cms.working)
+        m0, s0 = bank.balance(m_addr), bank.balance(s_addr)
+        to = keys[2].public_key().address()
+        res = self._submit(
+            node, sub, [MsgSend(s_addr, to, (Coin("utia", 500),))],
+            granter=m_addr,
+        )
+        assert res.code == 0, res.log
+        bank = BankKeeper(node.app.cms.working)
+        assert bank.balance(m_addr) == m0 - 20_000  # master paid the fee
+        assert bank.balance(s_addr) == s0 - 500  # sub paid only the send
+
+    def test_feegrant_missing_rejected_at_checktx(self):
+        node, keys = self._node()
+        sub = keys[1]
+        s_addr = sub.public_key().address()
+        res = self._submit(
+            node, sub, [MsgSend(s_addr, keys[2].public_key().address(),
+                                (Coin("utia", 1),))],
+            granter=keys[0].public_key().address(), expect_code=1,
+        )
+        assert "no fee allowance" in res.log
+
+    def test_feegrant_revoked_stops_paying(self):
+        node, keys = self._node()
+        master, sub = keys[0], keys[1]
+        m_addr, s_addr = (k.public_key().address() for k in (master, sub))
+        self._submit(node, master, [MsgGrantAllowance(m_addr, s_addr)])
+        self._submit(node, master, [MsgRevokeAllowance(m_addr, s_addr)])
+        res = self._submit(
+            node, sub, [MsgSend(s_addr, m_addr, (Coin("utia", 1),))],
+            granter=m_addr, expect_code=1,
+        )
+        assert "no fee allowance" in res.log
+
+    def test_authz_exec_send(self):
+        node, keys = self._node()
+        granter, grantee = keys[0], keys[1]
+        g_addr = granter.public_key().address()
+        e_addr = grantee.public_key().address()
+        to = keys[2].public_key().address()
+        self._submit(node, granter, [MsgAuthzGrant(
+            g_addr, e_addr, "/cosmos.bank.v1beta1.MsgSend", spend_limit=1000
+        )])
+        bank = BankKeeper(node.app.cms.working)
+        g0, to0 = bank.balance(g_addr), bank.balance(to)
+        inner = MsgSend(g_addr, to, (Coin("utia", 800),))
+        res = self._submit(node, grantee, [MsgAuthzExec(
+            e_addr, (inner.to_any(),)
+        )])
+        assert res.code == 0, res.log
+        bank = BankKeeper(node.app.cms.working)
+        assert bank.balance(g_addr) == g0 - 800  # granter's funds moved
+        assert bank.balance(to) == to0 + 800
+        # Limit decremented: another 800 exceeds the remaining 200.
+        res = self._submit(node, grantee, [MsgAuthzExec(
+            e_addr, (inner.to_any(),)
+        )])
+        assert res.code != 0
+        assert "exceeds" in res.log
+
+    def test_authz_revoke_and_unauthorized(self):
+        node, keys = self._node()
+        granter, grantee = keys[0], keys[1]
+        g_addr = granter.public_key().address()
+        e_addr = grantee.public_key().address()
+        url = "/cosmos.bank.v1beta1.MsgSend"
+        self._submit(node, granter, [MsgAuthzGrant(g_addr, e_addr, url,
+                                                   spend_limit=1000)])
+        self._submit(node, granter, [MsgAuthzRevoke(g_addr, e_addr, url)])
+        inner = MsgSend(g_addr, e_addr, (Coin("utia", 1),))
+        res = self._submit(node, grantee, [MsgAuthzExec(e_addr,
+                                                        (inner.to_any(),))])
+        assert res.code != 0
+        assert "no authorization" in res.log
+
+    def test_vesting_account_locks_sends(self):
+        from celestia_app_tpu.testutil.testnode import BLOCK_INTERVAL_NS
+
+        node, keys = self._node(vesting={
+            "vesting_type": VESTING_DELAYED,
+            "original_vesting": 10**12 - 10**6,  # nearly everything locked
+            "vesting_end_ns": 0,  # patched below via genesis start
+        })
+        # end = genesis + 1000 blocks; everything locked now.
+        acct_addr = keys[1].public_key().address()
+        auth = AuthKeeper(node.app.cms.working)
+        a = auth.get_account(acct_addr)
+        a.vesting_end_ns = node.app.genesis_time_ns + 1000 * BLOCK_INTERVAL_NS
+        auth.set_account(a)
+        node.app.cms.commit(node.app.height)  # persist the schedule tweak
+
+        to = keys[2].public_key().address()
+        # The lock enforces at execution (sdk: bank send fails in
+        # DeliverTx; CheckTx's ante doesn't simulate msg outflows).
+        res = self._submit(
+            node, keys[1],
+            [MsgSend(acct_addr, to, (Coin("utia", 10**9),))],
+        )
+        assert res.code != 0
+        assert "still vesting" in res.log
+        # Small spendable remainder still moves (minus fee headroom).
+        res = self._submit(
+            node, keys[1],
+            [MsgSend(acct_addr, to, (Coin("utia", 100_000),))],
+        )
+        assert res.code == 0, res.log
+
+    def test_vesting_allows_delegation(self):
+        """Locked tokens CAN be delegated (sdk vesting semantics)."""
+        from celestia_app_tpu.state.staking import StakingKeeper
+        from celestia_app_tpu.tx.messages import MsgDelegate
+
+        node, keys = self._node(vesting={
+            "vesting_type": VESTING_DELAYED,
+            "original_vesting": 10**11,
+            "vesting_end_ns": 10**20,
+        })
+        addr = keys[1].public_key().address()
+        val = StakingKeeper(node.app.cms.working).validators()[0].address
+        res = self._submit(node, keys[1], [MsgDelegate(
+            addr, val, Coin("utia", 10**10)
+        )])
+        assert res.code == 0, res.log
+
+    def test_vesting_liquid_funds_spendable_during_unbonding(self):
+        """Undelegated locked tokens re-encumber at unbonding COMPLETION,
+        not at MsgUndelegate — liquid funds stay spendable meanwhile."""
+        from celestia_app_tpu.state.staking import (
+            StakingKeeper,
+            UNBONDING_TIME_NS,
+        )
+        from celestia_app_tpu.tx.messages import MsgDelegate, MsgUndelegate
+
+        locked_amt = 10**11
+        node, keys = self._node(vesting={
+            "vesting_type": VESTING_DELAYED,
+            "original_vesting": locked_amt,
+            "vesting_end_ns": 10**20,
+        })
+        addr = keys[1].public_key().address()
+        to = keys[2].public_key().address()
+        val = StakingKeeper(node.app.cms.working).validators()[0].address
+        self._submit(node, keys[1], [MsgDelegate(
+            addr, val, Coin("utia", locked_amt)
+        )])
+        self._submit(node, keys[1], [MsgUndelegate(
+            addr, val, Coin("utia", locked_amt)
+        )])
+        # During the unbonding window: the tokens are in the pool, not the
+        # balance — the remaining liquid funds must still move.
+        res = self._submit(node, keys[1], [MsgSend(
+            addr, to, (Coin("utia", 10**10),)
+        )])
+        assert res.code == 0, res.log
+        # Completion returns the tokens and the lock re-encumbers them.
+        node.produce_block(
+            time_ns=node.app.last_block_time_ns + UNBONDING_TIME_NS + 1
+        )
+        auth = AuthKeeper(node.app.cms.working)
+        assert auth.get_account(addr).delegated_vesting == 0
+        # A send that dips into the re-encumbered band is rejected...
+        balance = BankKeeper(node.app.cms.working).balance(addr)
+        res = self._submit(node, keys[1], [MsgSend(
+            addr, to, (Coin("utia", balance - locked_amt + 1),)
+        )])
+        assert res.code != 0
+        assert "still vesting" in res.log
+        # ...while one that stays above it (minus the 20k fee) clears.
+        res = self._submit(node, keys[1], [MsgSend(
+            addr, to, (Coin("utia", balance - locked_amt - 40_000),)
+        )])
+        assert res.code == 0, res.log
+
+    def test_txsim_feegrant_mode(self):
+        from celestia_app_tpu.txsim.run import BlobSequence, run
+
+        keys = funded_keys(3)
+        node = TestNode(deterministic_genesis(keys, gov_max_square_size=16), keys)
+        master = keys[0].public_key().address()
+        bank0 = BankKeeper(node.app.cms.working)
+        sub_balances = [
+            bank0.balance(k.public_key().address()) for k in keys[1:]
+        ]
+        stats = run(
+            node, keys, [BlobSequence(), BlobSequence(), BlobSequence()],
+            blocks=3, seed=11, use_feegrant=True,
+        )
+        assert stats["failed"] == 0, stats
+        # Sub accounts' balances never dropped: the master paid every fee.
+        bank = BankKeeper(node.app.cms.working)
+        for k, before in zip(keys[1:], sub_balances):
+            assert bank.balance(k.public_key().address()) == before
+
+
+class TestCrisisInvariants:
+    def test_clean_chain_holds(self):
+        keys = funded_keys(2)
+        node = TestNode(deterministic_genesis(keys, gov_max_square_size=16), keys)
+        node.produce_block()
+        names = assert_invariants(node.app.cms.working)
+        assert len(names) == 4
+
+    def test_broken_supply_detected(self):
+        keys = funded_keys(2)
+        node = TestNode(deterministic_genesis(keys, gov_max_square_size=16), keys)
+        store = node.app.cms.working
+        # Corrupt a balance without touching supply.
+        bank = BankKeeper(store)
+        bank._set_balance(keys[0].public_key().address(), "utia", 1)
+        with pytest.raises(InvariantBroken, match="bank/total-supply"):
+            assert_invariants(store)
+
+    def test_broken_bonded_pool_detected(self):
+        from celestia_app_tpu.state.staking import BONDED_POOL
+
+        keys = funded_keys(2)
+        node = TestNode(deterministic_genesis(keys, gov_max_square_size=16), keys)
+        store = node.app.cms.working
+        BankKeeper(store).mint(BONDED_POOL, 5)
+        with pytest.raises(InvariantBroken, match="staking/bonded-pool"):
+            assert_invariants(store)
+
+    def test_settling_does_not_leak_into_state(self):
+        """assert_invariants must not change the app hash (it settles
+        rewards on a branch)."""
+        keys = funded_keys(2)
+        node = TestNode(deterministic_genesis(keys, gov_max_square_size=16), keys)
+        node.produce_block()
+        h0 = node.app.cms.working.hash()
+        assert_invariants(node.app.cms.working)
+        assert node.app.cms.working.hash() == h0

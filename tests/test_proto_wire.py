@@ -267,6 +267,127 @@ class TestDistributionWire:
         assert f.marshal() == ref_f.SerializeToString()
         assert MsgFundCommunityPool.unmarshal(ref_f.SerializeToString()) == f
 
+    def test_feegrant_msgs(self, pb):
+        import importlib
+
+        from celestia_app_tpu.tx.messages import (
+            MsgGrantAllowance,
+            MsgRevokeAllowance,
+        )
+
+        fg = importlib.import_module("cosmos.feegrant.v1beta1.tx_pb2")
+        from google.protobuf import any_pb2, timestamp_pb2
+
+        basic = fg.BasicAllowance(
+            spend_limit=[pb["coin"].Coin(denom="utia", amount="5000")],
+            expiration=timestamp_pb2.Timestamp(seconds=120, nanos=7),
+        )
+        allowance = any_pb2.Any(
+            type_url="/cosmos.feegrant.v1beta1.BasicAllowance",
+            value=basic.SerializeToString(),
+        )
+        ref = fg.MsgGrantAllowance(
+            granter="celestia1m", grantee="celestia1s", allowance=allowance
+        )
+        ours = MsgGrantAllowance(
+            "celestia1m", "celestia1s",
+            spend_limit=5000, expiration_ns=120 * 10**9 + 7,
+        )
+        assert ours.marshal() == ref.SerializeToString()
+        assert MsgGrantAllowance.unmarshal(ref.SerializeToString()) == ours
+
+        # AllowedMsgAllowance wrapping.
+        wrapped = fg.AllowedMsgAllowance(
+            allowance=allowance,
+            allowed_messages=["/cosmos.bank.v1beta1.MsgSend"],
+        )
+        ref2 = fg.MsgGrantAllowance(
+            granter="celestia1m", grantee="celestia1s",
+            allowance=any_pb2.Any(
+                type_url="/cosmos.feegrant.v1beta1.AllowedMsgAllowance",
+                value=wrapped.SerializeToString(),
+            ),
+        )
+        ours2 = MsgGrantAllowance(
+            "celestia1m", "celestia1s", 5000, 120 * 10**9 + 7,
+            ("/cosmos.bank.v1beta1.MsgSend",),
+        )
+        assert ours2.marshal() == ref2.SerializeToString()
+        assert MsgGrantAllowance.unmarshal(ref2.SerializeToString()) == ours2
+
+        r = MsgRevokeAllowance("celestia1m", "celestia1s")
+        assert r.marshal() == fg.MsgRevokeAllowance(
+            granter="celestia1m", grantee="celestia1s"
+        ).SerializeToString()
+
+    def test_authz_msgs(self, pb):
+        import importlib
+
+        from celestia_app_tpu.tx.messages import (
+            Coin,
+            MsgAuthzExec,
+            MsgAuthzGrant,
+            MsgAuthzRevoke,
+            MsgSend,
+        )
+
+        az = importlib.import_module("cosmos.authz.v1beta1.tx_pb2")
+        bank_az = importlib.import_module("cosmos.bank.v1beta1.authz_pb2")
+        from google.protobuf import any_pb2, timestamp_pb2
+
+        gen = az.GenericAuthorization(msg="/cosmos.staking.v1beta1.MsgDelegate")
+        ref = az.MsgGrant(
+            granter="celestia1g", grantee="celestia1e",
+            grant=az.Grant(
+                authorization=any_pb2.Any(
+                    type_url="/cosmos.authz.v1beta1.GenericAuthorization",
+                    value=gen.SerializeToString(),
+                ),
+                expiration=timestamp_pb2.Timestamp(seconds=99),
+            ),
+        )
+        ours = MsgAuthzGrant(
+            "celestia1g", "celestia1e", "/cosmos.staking.v1beta1.MsgDelegate",
+            expiration_ns=99 * 10**9,
+        )
+        assert ours.marshal() == ref.SerializeToString()
+        assert MsgAuthzGrant.unmarshal(ref.SerializeToString()) == ours
+
+        send_auth = bank_az.SendAuthorization(
+            spend_limit=[pb["coin"].Coin(denom="utia", amount="777")]
+        )
+        ref_send = az.MsgGrant(
+            granter="celestia1g", grantee="celestia1e",
+            grant=az.Grant(authorization=any_pb2.Any(
+                type_url="/cosmos.bank.v1beta1.SendAuthorization",
+                value=send_auth.SerializeToString(),
+            )),
+        )
+        ours_send = MsgAuthzGrant(
+            "celestia1g", "celestia1e", "/cosmos.bank.v1beta1.MsgSend",
+            spend_limit=777,
+        )
+        assert ours_send.marshal() == ref_send.SerializeToString()
+
+        inner = MsgSend("celestia1g", "celestia1x", (Coin("utia", 5),))
+        ref_exec = az.MsgExec(
+            grantee="celestia1e",
+            msgs=[any_pb2.Any(
+                type_url="/cosmos.bank.v1beta1.MsgSend",
+                value=inner.marshal(),
+            )],
+        )
+        ours_exec = MsgAuthzExec("celestia1e", (inner.to_any(),))
+        assert ours_exec.marshal() == ref_exec.SerializeToString()
+        back = MsgAuthzExec.unmarshal(ref_exec.SerializeToString())
+        assert back.inner_msgs() == [inner]
+
+        rv = MsgAuthzRevoke("celestia1g", "celestia1e", inner.TYPE_URL)
+        assert rv.marshal() == az.MsgRevoke(
+            granter="celestia1g", grantee="celestia1e",
+            msg_type_url=inner.TYPE_URL,
+        ).SerializeToString()
+
     def test_unjail_msg(self, pb):
         import importlib
 
