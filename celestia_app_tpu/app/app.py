@@ -817,6 +817,18 @@ class App:
             # ErrNoOpMsg), so a racing relayer's batched siblings survive.
             if channels.has_receipt(packet):
                 return 0, [("ibc.noop", "recv", packet.sequence)]
+            dest_chan = channels.channel(
+                packet.destination_port, packet.destination_channel
+            )
+            if dest_chan.connection_id:
+                # Connection-backed channel: the packet commitment must be
+                # PROVEN in the sender's state through the light client.
+                from celestia_app_tpu.modules.ibc.handshake import verify_recv_proof
+
+                verify_recv_proof(
+                    ctx.store, dest_chan, packet,
+                    msg.state_proof(), msg.proof_height,
+                )
             channels.recv_packet(packet, ctx.height, ctx.time_ns)
             # The app callback runs on a cache; its state lands only when
             # the ack is a success (ibc-go msg_server.go RecvPacket's
@@ -847,6 +859,14 @@ class App:
                 packet.source_port, packet.source_channel, packet.sequence
             ) is None:
                 return 0, [("ibc.noop", "ack", packet.sequence)]
+            src_chan = channels.channel(packet.source_port, packet.source_channel)
+            if src_chan.connection_id:
+                from celestia_app_tpu.modules.ibc.handshake import verify_ack_proof
+
+                verify_ack_proof(
+                    ctx.store, src_chan, packet, msg.acknowledgement,
+                    msg.state_proof(), msg.proof_height,
+                )
             channels.acknowledge_packet(packet)
             stack.on_acknowledgement_packet(ctx, packet, msg.acknowledgement)
             return 0, [("ibc.acknowledge_packet", packet.sequence)]
@@ -855,8 +875,17 @@ class App:
             packet.source_port, packet.source_channel, packet.sequence
         ) is None:
             return 0, [("ibc.noop", "timeout", packet.sequence)]
-        # The relayer's proof height stands in for the counterparty view;
-        # the timestamp check uses this chain's clock (IBC-lite trust note).
+        src_chan = channels.channel(packet.source_port, packet.source_channel)
+        if src_chan.connection_id:
+            # Proven non-receipt on the counterparty at the proof height.
+            from celestia_app_tpu.modules.ibc.handshake import verify_timeout_proof
+
+            verify_timeout_proof(
+                ctx.store, src_chan, packet, msg.state_proof(), msg.proof_height
+            )
+        # The proof height stands in for the counterparty view; the
+        # timestamp check uses this chain's clock (scope note in
+        # verify_timeout_proof).
         channels.timeout_packet(packet, msg.proof_height, ctx.time_ns)
         stack.on_timeout_packet(ctx, packet)
         return 0, [("ibc.timeout_packet", packet.sequence)]

@@ -50,9 +50,13 @@ class Channel:
     counterparty_channel_id: str
     state: str = "OPEN"
     version: str = "ics20-1"
+    # Set when the channel was created by the proof-verified handshake
+    # (modules/ibc/handshake.py); empty for direct-OPEN test channels.
+    # A connection-backed channel REQUIRES packet proofs on relay.
+    connection_id: str = ""
 
     def marshal(self) -> bytes:
-        return (
+        out = (
             encode_bytes_field(1, self.port.encode())
             + encode_bytes_field(2, self.channel_id.encode())
             + encode_bytes_field(3, self.counterparty_port.encode())
@@ -60,13 +64,16 @@ class Channel:
             + encode_bytes_field(5, self.state.encode())
             + encode_bytes_field(6, self.version.encode())
         )
+        if self.connection_id:
+            out += encode_bytes_field(7, self.connection_id.encode())
+        return out
 
     @classmethod
     def unmarshal(cls, raw: bytes) -> "Channel":
         f = {num: val for num, wt, val in decode_fields(raw) if wt == WIRE_LEN}
         return cls(
             f[1].decode(), f[2].decode(), f[3].decode(), f[4].decode(),
-            f[5].decode(), f[6].decode(),
+            f[5].decode(), f[6].decode(), f.get(7, b"").decode(),
         )
 
 
@@ -237,7 +244,26 @@ class ChannelKeeper:
         return self.store.get(_chan_key(b"ack", port, channel_id, seq))
 
     # --- ack / timeout on the sender ----------------------------------------
+    def _check_counterparty_routing(self, packet: Packet) -> None:
+        """packet.destination MUST be the source channel's counterparty.
+        CommitPacket excludes the destination fields, so without this check
+        a relayer could rewrite them and prove non-receipt (or replay an
+        ack) under a key nothing was ever written to — ibc-go's
+        AcknowledgePacket/TimeoutPacket make the same check for the same
+        reason."""
+        chan = self.channel(packet.source_port, packet.source_channel)
+        if (
+            chan.counterparty_port != packet.destination_port
+            or chan.counterparty_channel_id != packet.destination_channel
+        ):
+            raise IBCError(
+                f"packet destination {packet.destination_port}/"
+                f"{packet.destination_channel} is not channel "
+                f"{packet.source_channel}'s counterparty"
+            )
+
     def acknowledge_packet(self, packet: Packet) -> None:
+        self._check_counterparty_routing(packet)
         key = _chan_key(
             b"commit", packet.source_port, packet.source_channel, packet.sequence
         )

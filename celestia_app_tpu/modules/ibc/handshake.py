@@ -1,0 +1,358 @@
+"""03-connection + 04-channel handshakes, proof-verified end to end.
+
+The reference's handshakes live in ibc-go core (02/03/04 keepers).  Here
+each step verifies the counterparty's PREVIOUS step through the
+connection's light client (modules/ibc/client.py): the counterparty wrote
+its connection/channel record into its SMT-committed store, the relayer
+ships `cms.proof(key)` for that record, and `verify_membership` checks it
+against the app hash a verified Commit pinned.  Both chains run this same
+code, so the storage keys proven are symmetric by construction:
+
+    connection record:  ibc/conn/{connection_id}
+    channel record:     ibc/chan/{port}/{channel_id}
+    packet commitment:  ibc/commit/{port}/{channel}/{seq, 8B BE}
+    packet receipt:     ibc/receipt/{port}/{channel}/{seq, 8B BE}
+    packet ack:         ibc/ack/{port}/{channel}/{seq, 8B BE}
+
+State machines (ibc-go semantics):
+    connection: INIT -> TRYOPEN -> OPEN        (Init/Try/Ack/Confirm)
+    channel:    INIT -> TRYOPEN -> OPEN        (Init/Try/Ack/Confirm)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+from celestia_app_tpu.encoding.proto import (
+    WIRE_LEN,
+    decode_fields,
+    encode_bytes_field,
+)
+from celestia_app_tpu.modules.ibc.client import ClientKeeper
+from celestia_app_tpu.modules.ibc.core import Channel, IBCError, _chan_key
+from celestia_app_tpu.state.store import KVStore
+
+_CONN_PREFIX = b"ibc/conn/"
+_NEXT_CONN_KEY = b"ibc/next_connection_id"
+_NEXT_CHAN_KEY = b"ibc/next_channel_id"
+
+
+def connection_key(connection_id: str) -> bytes:
+    return _CONN_PREFIX + connection_id.encode()
+
+
+def channel_key(port: str, channel_id: str) -> bytes:
+    return _chan_key(b"chan", port, channel_id)
+
+
+@dataclass(frozen=True)
+class ConnectionEnd:
+    connection_id: str
+    client_id: str  # our client of the counterparty chain
+    counterparty_connection_id: str = ""
+    counterparty_client_id: str = ""
+    state: str = "INIT"
+
+    def marshal(self) -> bytes:
+        return (
+            encode_bytes_field(1, self.connection_id.encode())
+            + encode_bytes_field(2, self.client_id.encode())
+            + encode_bytes_field(3, self.counterparty_connection_id.encode())
+            + encode_bytes_field(4, self.counterparty_client_id.encode())
+            + encode_bytes_field(5, self.state.encode())
+        )
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "ConnectionEnd":
+        f = {n: v for n, wt, v in decode_fields(raw) if wt == WIRE_LEN}
+        return cls(
+            f[1].decode(), f[2].decode(), f.get(3, b"").decode(),
+            f.get(4, b"").decode(), f.get(5, b"OPEN").decode(),
+        )
+
+
+class ConnectionKeeper:
+    """03-connection: the four-step handshake, each step proving the
+    counterparty's record through the light client."""
+
+    def __init__(self, store: KVStore):
+        self.store = store
+        self.clients = ClientKeeper(store)
+
+    def _next_id(self) -> str:
+        n = int.from_bytes(self.store.get(_NEXT_CONN_KEY) or b"\x00", "big")
+        self.store.set(_NEXT_CONN_KEY, (n + 1).to_bytes(8, "big"))
+        return f"connection-{n}"
+
+    def _save(self, end: ConnectionEnd) -> None:
+        self.store.set(connection_key(end.connection_id), end.marshal())
+
+    def connection(self, connection_id: str) -> ConnectionEnd:
+        raw = self.store.get(connection_key(connection_id))
+        if raw is None:
+            raise IBCError(f"no connection {connection_id}")
+        return ConnectionEnd.unmarshal(raw)
+
+    def open_init(self, client_id: str, counterparty_client_id: str) -> str:
+        """ConnOpenInit (chain A): record intent; nothing to prove yet."""
+        self.clients.client_state(client_id)  # must exist
+        end = ConnectionEnd(
+            self._next_id(), client_id,
+            counterparty_client_id=counterparty_client_id, state="INIT",
+        )
+        self._save(end)
+        return end.connection_id
+
+    def open_try(
+        self, client_id: str, counterparty_connection_id: str,
+        counterparty_client_id: str, proof_init, proof_height: int,
+    ) -> str:
+        """ConnOpenTry (chain B): verify A really has an INIT record
+        naming our client.  A's INIT doesn't know B's connection id yet —
+        it recorded only the client pair, which is exactly what we verify."""
+        expected = ConnectionEnd(
+            counterparty_connection_id,
+            client_id=counterparty_client_id,  # A's client of us
+            counterparty_connection_id="",
+            counterparty_client_id=client_id,  # our client of A, as A named it
+            state="INIT",
+        )
+        self.clients.verify_membership(
+            client_id, proof_height,
+            connection_key(counterparty_connection_id),
+            expected.marshal(), proof_init,
+        )
+        end = ConnectionEnd(
+            self._next_id(), client_id,
+            counterparty_connection_id=counterparty_connection_id,
+            counterparty_client_id=counterparty_client_id, state="TRYOPEN",
+        )
+        self._save(end)
+        return end.connection_id
+
+    def open_ack(
+        self, connection_id: str, counterparty_connection_id: str,
+        proof_try, proof_height: int,
+    ) -> None:
+        """ConnOpenAck (chain A): verify B's TRYOPEN names our connection."""
+        end = self.connection(connection_id)
+        if end.state != "INIT":
+            raise IBCError(
+                f"connection {connection_id} is {end.state}, expected INIT"
+            )
+        expected = ConnectionEnd(
+            counterparty_connection_id, end.counterparty_client_id,
+            counterparty_connection_id=connection_id,
+            counterparty_client_id=end.client_id, state="TRYOPEN",
+        )
+        self.clients.verify_membership(
+            end.client_id, proof_height,
+            connection_key(counterparty_connection_id),
+            expected.marshal(), proof_try,
+        )
+        self._save(replace(
+            end, state="OPEN",
+            counterparty_connection_id=counterparty_connection_id,
+        ))
+
+    def open_confirm(
+        self, connection_id: str, proof_ack, proof_height: int
+    ) -> None:
+        """ConnOpenConfirm (chain B): verify A went OPEN."""
+        end = self.connection(connection_id)
+        if end.state != "TRYOPEN":
+            raise IBCError(
+                f"connection {connection_id} is {end.state}, expected TRYOPEN"
+            )
+        expected = ConnectionEnd(
+            end.counterparty_connection_id, end.counterparty_client_id,
+            counterparty_connection_id=connection_id,
+            counterparty_client_id=end.client_id, state="OPEN",
+        )
+        self.clients.verify_membership(
+            end.client_id, proof_height,
+            connection_key(end.counterparty_connection_id),
+            expected.marshal(), proof_ack,
+        )
+        self._save(replace(end, state="OPEN"))
+
+
+class ChannelHandshake:
+    """04-channel handshake over an OPEN connection.  Channels created
+    this way carry their connection id, which marks them proof-required
+    on the packet path (modules/ibc/__init__ relay verification)."""
+
+    def __init__(self, store: KVStore):
+        self.store = store
+        self.connections = ConnectionKeeper(store)
+
+    def _next_channel_id(self) -> str:
+        n = int.from_bytes(self.store.get(_NEXT_CHAN_KEY) or b"\x00", "big")
+        self.store.set(_NEXT_CHAN_KEY, (n + 1).to_bytes(8, "big"))
+        return f"channel-{n}"
+
+    def _save(self, chan: Channel) -> None:
+        self.store.set(channel_key(chan.port, chan.channel_id), chan.marshal())
+
+    def _get(self, port: str, channel_id: str) -> Channel:
+        raw = self.store.get(channel_key(port, channel_id))
+        if raw is None:
+            raise IBCError(f"unknown channel {port}/{channel_id}")
+        return Channel.unmarshal(raw)
+
+    def _open_connection(self, connection_id: str) -> ConnectionEnd:
+        end = self.connections.connection(connection_id)
+        if end.state != "OPEN":
+            raise IBCError(
+                f"connection {connection_id} is {end.state}, expected OPEN"
+            )
+        return end
+
+    def open_init(self, connection_id: str, port: str,
+                  counterparty_port: str, version: str = "ics20-1") -> str:
+        self._open_connection(connection_id)
+        chan = Channel(
+            port, self._next_channel_id(), counterparty_port, "",
+            state="INIT", version=version, connection_id=connection_id,
+        )
+        self._save(chan)
+        return chan.channel_id
+
+    def open_try(
+        self, connection_id: str, port: str, counterparty_port: str,
+        counterparty_channel_id: str, proof_init, proof_height: int,
+        version: str = "ics20-1",
+    ) -> str:
+        end = self._open_connection(connection_id)
+        expected = Channel(
+            counterparty_port, counterparty_channel_id, port, "",
+            state="INIT", version=version,
+            connection_id=end.counterparty_connection_id,
+        )
+        self.connections.clients.verify_membership(
+            end.client_id, proof_height,
+            channel_key(counterparty_port, counterparty_channel_id),
+            expected.marshal(), proof_init,
+        )
+        chan = Channel(
+            port, self._next_channel_id(), counterparty_port,
+            counterparty_channel_id, state="TRYOPEN", version=version,
+            connection_id=connection_id,
+        )
+        self._save(chan)
+        return chan.channel_id
+
+    def open_ack(
+        self, port: str, channel_id: str, counterparty_channel_id: str,
+        proof_try, proof_height: int,
+    ) -> None:
+        chan = self._get(port, channel_id)
+        if chan.state != "INIT":
+            raise IBCError(f"channel {channel_id} is {chan.state}, expected INIT")
+        end = self._open_connection(chan.connection_id)
+        expected = Channel(
+            chan.counterparty_port, counterparty_channel_id, port, channel_id,
+            state="TRYOPEN", version=chan.version,
+            connection_id=end.counterparty_connection_id,
+        )
+        self.connections.clients.verify_membership(
+            end.client_id, proof_height,
+            channel_key(chan.counterparty_port, counterparty_channel_id),
+            expected.marshal(), proof_try,
+        )
+        self._save(replace(
+            chan, state="OPEN",
+            counterparty_channel_id=counterparty_channel_id,
+        ))
+        self._init_sequence(port, channel_id)
+
+    def open_confirm(
+        self, port: str, channel_id: str, proof_ack, proof_height: int
+    ) -> None:
+        chan = self._get(port, channel_id)
+        if chan.state != "TRYOPEN":
+            raise IBCError(
+                f"channel {channel_id} is {chan.state}, expected TRYOPEN"
+            )
+        end = self._open_connection(chan.connection_id)
+        expected = Channel(
+            chan.counterparty_port, chan.counterparty_channel_id, port,
+            channel_id, state="OPEN", version=chan.version,
+            connection_id=end.counterparty_connection_id,
+        )
+        self.connections.clients.verify_membership(
+            end.client_id, proof_height,
+            channel_key(chan.counterparty_port, chan.counterparty_channel_id),
+            expected.marshal(), proof_ack,
+        )
+        self._save(replace(chan, state="OPEN"))
+        self._init_sequence(port, channel_id)
+
+    def _init_sequence(self, port: str, channel_id: str) -> None:
+        key = _chan_key(b"nextseq", port, channel_id)
+        if self.store.get(key) is None:
+            self.store.set(key, (1).to_bytes(8, "big"))
+
+
+# --- packet-proof verification (the relay msgs' proof path) -----------------
+
+
+def _require_proof(proof, what: str):
+    if proof is None:
+        raise IBCError(
+            f"channel is connection-backed: a verified {what} proof is "
+            "required (IBC-lite trusted relay only applies to direct-OPEN "
+            "channels)"
+        )
+
+
+def verify_recv_proof(store, chan: Channel, packet, proof, proof_height: int) -> None:
+    """MsgRecvPacket on a connection-backed channel: the packet's
+    commitment must exist in the SENDER's proven state."""
+    _require_proof(proof, "commitment")
+    conn = ConnectionKeeper(store)
+    end = conn.connection(chan.connection_id)
+    key = _chan_key(
+        b"commit", packet.source_port, packet.source_channel, packet.sequence
+    )
+    conn.clients.verify_membership(
+        end.client_id, proof_height, key, packet.commitment(), proof
+    )
+
+
+def verify_ack_proof(
+    store, chan: Channel, packet, ack: bytes, proof, proof_height: int
+) -> None:
+    """MsgAcknowledgement: the RECEIVER's proven state holds
+    sha256(ack) under the packet's ack key (ibc-go
+    CommitAcknowledgement)."""
+    import hashlib
+
+    _require_proof(proof, "acknowledgement")
+    conn = ConnectionKeeper(store)
+    end = conn.connection(chan.connection_id)
+    key = _chan_key(
+        b"ack", packet.destination_port, packet.destination_channel,
+        packet.sequence,
+    )
+    conn.clients.verify_membership(
+        end.client_id, proof_height, key, hashlib.sha256(ack).digest(), proof
+    )
+
+
+def verify_timeout_proof(
+    store, chan: Channel, packet, proof, proof_height: int
+) -> None:
+    """MsgTimeout: the RECEIVER's proven state has NO receipt for the
+    packet at `proof_height` (it never arrived), and the proof height
+    itself is past the packet's height timeout — so it can never arrive
+    before timing out.  (Timestamp timeouts still use the local clock:
+    this chain's Commits don't carry counterparty time — scope note.)"""
+    _require_proof(proof, "non-receipt")
+    conn = ConnectionKeeper(store)
+    end = conn.connection(chan.connection_id)
+    key = _chan_key(
+        b"receipt", packet.destination_port, packet.destination_channel,
+        packet.sequence,
+    )
+    conn.clients.verify_non_membership(end.client_id, proof_height, key, proof)

@@ -204,3 +204,60 @@ def verify(proof: StateProof, app_hash: bytes) -> bool:
 
 def value_hash(value: bytes) -> bytes:
     return _h(value)
+
+
+def proof_marshal(proof: StateProof) -> bytes:
+    """Wire form for proofs that cross chains (IBC relay msgs):
+    {key=1, has_value=2, value=3, path=4{bit=1, sibling=2},
+    leaf_kh=5, leaf_vh=6}."""
+    from celestia_app_tpu.encoding.proto import (
+        encode_bytes_field,
+        encode_varint_field,
+    )
+
+    out = encode_bytes_field(1, proof.key)
+    out += encode_varint_field(2, int(proof.value is not None))
+    if proof.value is not None:
+        out += encode_bytes_field(3, proof.value)
+    for bit, sibling in proof.path:
+        out += encode_bytes_field(
+            4, encode_varint_field(1, bit) + encode_bytes_field(2, sibling)
+        )
+    if proof.leaf_kh is not None:
+        out += encode_bytes_field(5, proof.leaf_kh)
+        out += encode_bytes_field(6, proof.leaf_vh or b"")
+    return out
+
+
+def proof_unmarshal(raw: bytes) -> StateProof:
+    from celestia_app_tpu.encoding.proto import (
+        WIRE_LEN,
+        WIRE_VARINT,
+        decode_fields,
+    )
+
+    key, value, has_value = b"", b"", False
+    path: list[tuple[int, bytes]] = []
+    leaf_kh = leaf_vh = None
+    for n, wt, v in decode_fields(raw):
+        if n == 1 and wt == WIRE_LEN:
+            key = v
+        elif n == 2 and wt == WIRE_VARINT:
+            has_value = bool(v)
+        elif n == 3 and wt == WIRE_LEN:
+            value = v
+        elif n == 4 and wt == WIRE_LEN:
+            bit, sib = 0, b""
+            for pn, pwt, pv in decode_fields(v):
+                if pn == 1 and pwt == WIRE_VARINT:
+                    bit = pv
+                elif pn == 2 and pwt == WIRE_LEN:
+                    sib = pv
+            path.append((bit, sib))
+        elif n == 5 and wt == WIRE_LEN:
+            leaf_kh = v
+        elif n == 6 and wt == WIRE_LEN:
+            leaf_vh = v
+    return StateProof(
+        key, value if has_value else None, path, leaf_kh, leaf_vh
+    )

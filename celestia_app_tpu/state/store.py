@@ -149,6 +149,12 @@ class CommitStore:
     def __init__(self):
         self.working = KVStore()
         self._committed: dict[int, dict[bytes, bytes]] = {}
+        # height -> app hash, recorded at commit (historical queries);
+        # height -> read-only KVStore view, memoized lazily — rebuilding
+        # the SMT from a snapshot is O(state), so one view serves all of a
+        # height's proofs.
+        self._app_hashes: dict[int, bytes] = {}
+        self._views: dict[int, KVStore] = {}
         self.last_height = 0
         self.last_app_hash = b"\x00" * 32
 
@@ -156,11 +162,38 @@ class CommitStore:
         self._committed[height] = self.working.snapshot()
         self.last_height = height
         self.last_app_hash = self.working.hash()
+        self._app_hashes[height] = self.last_app_hash
         return self.last_app_hash
 
     def proof(self, key: bytes) -> smt.StateProof:
         """State proof for `key` against `last_app_hash` (call post-commit)."""
         return self.working.proof(key)
+
+    def _view(self, height: int) -> KVStore:
+        """Memoized read-only store over a committed snapshot."""
+        view = self._views.get(height)
+        if view is None:
+            if height not in self._committed:
+                raise KeyError(f"no committed state at height {height}")
+            view = KVStore(self._committed[height])
+            self._views[height] = view
+            for h in sorted(self._views)[:-8]:  # bound the cache
+                del self._views[h]
+        return view
+
+    def app_hash_at(self, height: int) -> bytes:
+        """The app hash of a past committed height (recomputed from the
+        snapshot for stores restored from disk)."""
+        got = self._app_hashes.get(height)
+        if got is None:
+            got = self._app_hashes[height] = self._view(height).hash()
+        return got
+
+    def proof_at(self, key: bytes, height: int) -> smt.StateProof:
+        """State proof for `key` against the app hash of a PAST committed
+        height (IBC relayers prove at the height a light-client consensus
+        state pins, which trails the chain tip)."""
+        return self._view(height).proof(key)
 
     def load_height(self, height: int) -> None:
         if height == 0:
@@ -177,6 +210,8 @@ class CommitStore:
         if self.last_height == 0:
             raise ValueError("nothing to roll back")
         self._committed.pop(self.last_height, None)
+        self._app_hashes.pop(self.last_height, None)
+        self._views.pop(self.last_height, None)
         self.load_height(self.last_height - 1) if self.last_height > 1 else self.load_height(0)
         return self.last_height
 
@@ -184,6 +219,8 @@ class CommitStore:
         cutoff = self.last_height - keep_recent
         for h in [h for h in self._committed if h < cutoff]:
             del self._committed[h]
+            self._app_hashes.pop(h, None)
+            self._views.pop(h, None)
 
     def export(self, height: int | None = None) -> dict[bytes, bytes]:
         if height is None:

@@ -566,14 +566,18 @@ class MsgTransfer:
             raise ValueError("source channel must not be empty")
 
 
-def _relay_msg(url: str, signer_field: int, ack_field: int | None = None,
-               height_field: int | None = None):
+def _relay_msg(url: str, signer_field: int, proof_field: int,
+               height_field: int, ack_field: int | None = None):
     """MsgRecvPacket / MsgAcknowledgement / MsgTimeout share one shape:
-    a packet, optional ack bytes / proof height, and the relayer signer.
-    Field numbers follow ibc.core.channel.v1 (MsgRecvPacket signer=4;
-    MsgAcknowledgement acknowledgement=2, signer=5; MsgTimeout
-    proof_height=3, signer=5; proof fields omitted — verification is
-    delegated per the IBC-lite scope note in modules/ibc)."""
+    a packet, a state proof + proof height, optional ack bytes, and the
+    relayer signer.  Field numbers follow ibc.core.channel.v1
+    (MsgRecvPacket proof_commitment=2, proof_height=3, signer=4;
+    MsgAcknowledgement acknowledgement=2, proof_acked=3, proof_height=4,
+    signer=5; MsgTimeout proof_unreceived=2, proof_height=3, signer=5).
+    `proof` carries a marshaled SMT StateProof (state/smt.py) verified
+    through the channel's light client when the channel is
+    connection-backed; empty for direct-OPEN test channels (IBC-lite
+    trusted relay)."""
 
     @dataclass(frozen=True)
     class RelayMsg:
@@ -581,17 +585,21 @@ def _relay_msg(url: str, signer_field: int, ack_field: int | None = None,
         signer: str
         acknowledgement: bytes = b""
         proof_height: int = 0
+        proof: bytes = b""
 
         TYPE_URL = url
         _SIGNER_FIELD = signer_field
         _ACK_FIELD = ack_field
+        _PROOF_FIELD = proof_field
         _HEIGHT_FIELD = height_field
 
         def marshal(self) -> bytes:
             out = encode_bytes_field(1, self.packet_bytes)
             if self._ACK_FIELD is not None and self.acknowledgement:
                 out += encode_bytes_field(self._ACK_FIELD, self.acknowledgement)
-            if self._HEIGHT_FIELD is not None and self.proof_height:
+            if self.proof:
+                out += encode_bytes_field(self._PROOF_FIELD, self.proof)
+            if self.proof_height:
                 out += encode_bytes_field(
                     self._HEIGHT_FIELD, encode_varint_field(2, self.proof_height)
                 )
@@ -600,18 +608,20 @@ def _relay_msg(url: str, signer_field: int, ack_field: int | None = None,
 
         @classmethod
         def unmarshal(cls, raw: bytes):
-            packet, signer, ack, ph = b"", "", b"", 0
+            packet, signer, ack, ph, proof = b"", "", b"", 0, b""
             for num, wt, val in decode_fields(raw):
                 if num == 1 and wt == WIRE_LEN:
                     packet = val
                 elif num == cls._ACK_FIELD and wt == WIRE_LEN:
                     ack = val
+                elif num == cls._PROOF_FIELD and wt == WIRE_LEN:
+                    proof = val
                 elif num == cls._HEIGHT_FIELD and wt == WIRE_LEN:
                     hf = {n: v for n, wt2, v in decode_fields(val) if wt2 == WIRE_VARINT}
                     ph = hf.get(2, 0)
                 elif num == cls._SIGNER_FIELD and wt == WIRE_LEN:
                     signer = val.decode()
-            return cls(packet, signer, ack, ph)
+            return cls(packet, signer, ack, ph, proof)
 
         def to_any(self) -> Any:
             return Any(self.TYPE_URL, self.marshal())
@@ -621,6 +631,11 @@ def _relay_msg(url: str, signer_field: int, ack_field: int | None = None,
 
             return Packet.unmarshal(self.packet_bytes)
 
+        def state_proof(self):
+            from celestia_app_tpu.state import smt
+
+            return smt.proof_unmarshal(self.proof) if self.proof else None
+
         def validate_basic(self) -> None:
             if not self.packet_bytes:
                 raise ValueError("relay msg missing packet")
@@ -629,9 +644,16 @@ def _relay_msg(url: str, signer_field: int, ack_field: int | None = None,
     return RelayMsg
 
 
-MsgRecvPacket = _relay_msg(URL_MSG_RECV_PACKET, signer_field=4)
-MsgAcknowledgement = _relay_msg(URL_MSG_ACKNOWLEDGEMENT, signer_field=5, ack_field=2)
-MsgTimeout = _relay_msg(URL_MSG_TIMEOUT, signer_field=5, height_field=3)
+MsgRecvPacket = _relay_msg(
+    URL_MSG_RECV_PACKET, signer_field=4, proof_field=2, height_field=3
+)
+MsgAcknowledgement = _relay_msg(
+    URL_MSG_ACKNOWLEDGEMENT, signer_field=5, proof_field=3, height_field=4,
+    ack_field=2,
+)
+MsgTimeout = _relay_msg(
+    URL_MSG_TIMEOUT, signer_field=5, proof_field=2, height_field=3
+)
 
 
 def _staking_msg(url: str, has_dst: bool = False):

@@ -464,17 +464,34 @@ class TestGovAndIBCWire:
         assert packet.marshal() == ref_packet.SerializeToString()
         assert Packet.unmarshal(ref_packet.SerializeToString()) == packet
 
-        recv = MsgRecvPacket(packet.marshal(), "celestia1relayer")
-        assert recv.marshal() == pb["chan"].MsgRecvPacket(
-            packet=ref_packet, signer="celestia1relayer"
-        ).SerializeToString()
-        ack = MsgAcknowledgement(packet.marshal(), "celestia1relayer", b"ACK")
+        recv = MsgRecvPacket(
+            packet.marshal(), "celestia1relayer",
+            proof_height=42, proof=b"\x0a\x03key",
+        )
+        ref_recv = pb["chan"].MsgRecvPacket(
+            packet=ref_packet, proof_commitment=b"\x0a\x03key",
+            proof_height=pb["chan"].Height(revision_height=42),
+            signer="celestia1relayer",
+        )
+        assert recv.marshal() == ref_recv.SerializeToString()
+        assert MsgRecvPacket.unmarshal(ref_recv.SerializeToString()) == recv
+        ack = MsgAcknowledgement(
+            packet.marshal(), "celestia1relayer", b"ACK",
+            proof_height=43, proof=b"\x0a\x01p",
+        )
         assert ack.marshal() == pb["chan"].MsgAcknowledgement(
-            packet=ref_packet, acknowledgement=b"ACK", signer="celestia1relayer"
+            packet=ref_packet, acknowledgement=b"ACK",
+            proof_acked=b"\x0a\x01p",
+            proof_height=pb["chan"].Height(revision_height=43),
+            signer="celestia1relayer",
         ).SerializeToString()
-        to = MsgTimeout(packet.marshal(), "celestia1relayer", proof_height=77)
+        to = MsgTimeout(
+            packet.marshal(), "celestia1relayer", proof_height=77,
+            proof=b"\x0a\x01q",
+        )
         assert to.marshal() == pb["chan"].MsgTimeout(
-            packet=ref_packet, proof_height=pb["chan"].Height(revision_height=77),
+            packet=ref_packet, proof_unreceived=b"\x0a\x01q",
+            proof_height=pb["chan"].Height(revision_height=77),
             signer="celestia1relayer",
         ).SerializeToString()
 
