@@ -67,6 +67,7 @@ from celestia_app_tpu.tx.messages import (
     MsgUndelegate,
     MsgUnjail,
     MsgVote,
+    MsgVoteWeighted,
     MsgWithdrawDelegatorReward,
     MsgWithdrawValidatorCommission,
 )
@@ -84,7 +85,7 @@ class AnteError(ValueError):
 # exist in every version, as x/gov and ibc are wired for v1 and v2 in
 # app/modules.go:96-189).
 _V1_MSGS = {
-    MsgSend, MsgPayForBlobs, MsgSubmitProposal, MsgVote, MsgDeposit,
+    MsgSend, MsgPayForBlobs, MsgSubmitProposal, MsgVote, MsgVoteWeighted, MsgDeposit,
     MsgTransfer, MsgRecvPacket, MsgAcknowledgement, MsgTimeout,
     MsgDelegate, MsgUndelegate, MsgBeginRedelegate,
     MsgWithdrawDelegatorReward, MsgWithdrawValidatorCommission,

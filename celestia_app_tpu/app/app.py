@@ -65,6 +65,7 @@ from celestia_app_tpu.tx.messages import (
     MsgUndelegate,
     MsgUnjail,
     MsgVote,
+    MsgVoteWeighted,
     MsgWithdrawDelegatorReward,
     MsgWithdrawValidatorCommission,
 )
@@ -717,7 +718,7 @@ class App:
                 )]
             except DistributionError as e:
                 raise ValueError(str(e)) from e
-        if isinstance(msg, (MsgSubmitProposal, MsgVote, MsgDeposit)):
+        if isinstance(msg, (MsgSubmitProposal, MsgVote, MsgVoteWeighted, MsgDeposit)):
             from celestia_app_tpu.modules.gov import GovKeeper, ParamChange
 
             gov = GovKeeper(ctx.store, ctx.staking, ctx.bank)
@@ -740,6 +741,16 @@ class App:
                 return 0, [("cosmos.gov.v1beta1.EventSubmitProposal", pid)]
             if isinstance(msg, MsgVote):
                 gov.vote(msg.proposal_id, msg.voter, msg.option, ctx.time_ns)
+                return 0, [("cosmos.gov.v1beta1.EventVote", msg.proposal_id, msg.voter)]
+            if isinstance(msg, MsgVoteWeighted):
+                from celestia_app_tpu.modules.gov import VoteOption
+                from celestia_app_tpu.state.dec import Dec
+
+                gov.vote_weighted(
+                    msg.proposal_id, msg.voter,
+                    [(VoteOption(o), Dec.from_str(w)) for o, w in msg.options],
+                    ctx.time_ns,
+                )
                 return 0, [("cosmos.gov.v1beta1.EventVote", msg.proposal_id, msg.voter)]
             deposit = sum(c.amount for c in msg.amount if c.denom == "utia")
             ctx.assert_spendable(msg.depositor, deposit)

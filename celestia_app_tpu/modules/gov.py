@@ -313,41 +313,125 @@ class GovKeeper:
             raise GovError("deposit must be positive")
         self._add_deposit(p, depositor, amount, time_ns)
 
-    def vote(self, pid: int, validator: str, option, time_ns: int | None = None) -> None:
-        """MsgVote: validator-power voting during the voting period.
-
-        `option` accepts a VoteOption or a bool (True=YES / False=NO, the
-        round-1 API kept for the expedited test path)."""
+    def vote(self, pid: int, voter: str, option, time_ns: int | None = None) -> None:
+        """MsgVote: a single full-weight option (bool accepted for the
+        round-1 expedited test path).  Any address may vote; tally weighs
+        it by the voter's staked power (delegations + validator self-bond,
+        sdk tally.go)."""
         if isinstance(option, bool):
             option = VoteOption.YES if option else VoteOption.NO
+        self.vote_weighted(pid, voter, [(VoteOption(option), Dec.from_int(1))], time_ns)
+
+    def vote_weighted(
+        self,
+        pid: int,
+        voter: str,
+        options: list[tuple[VoteOption, Dec]],
+        time_ns: int | None = None,
+    ) -> None:
+        """MsgVoteWeighted: split one vote across options; weights must be
+        positive and sum to exactly 1 (sdk ValidWeightedVoteOption)."""
         p = self.get_proposal(pid)
         if p.status != ProposalStatus.VOTING_PERIOD:
             raise GovError(f"proposal {pid} is not in its voting period")
         if time_ns is not None and time_ns >= p.voting_end_ns:
             raise GovError(f"voting period for proposal {pid} has ended")
-        if not self.staking.has_validator(validator):
-            raise GovError(f"no validator {validator}")
-        self.store.set(
-            f"gov/vote/{pid}/{validator}".encode(), bytes([int(option)])
-        )
+        if not options:
+            raise GovError("vote needs at least one option")
+        total = Dec(0)
+        seen: set[VoteOption] = set()
+        for opt, weight in options:
+            VoteOption(opt)  # raises on junk
+            if weight <= Dec(0):
+                raise GovError("vote weights must be positive")
+            if opt in seen:
+                raise GovError(f"duplicate vote option {opt}")
+            seen.add(opt)
+            total = total.add(weight)
+        if total.raw != Dec.from_int(1).raw:
+            raise GovError(f"vote weights must sum to 1, got {total}")
+        from celestia_app_tpu.tx.messages import encode_weighted_option
+
+        out = b""
+        for opt, weight in options:
+            # Stored in the proto WeightedVoteOption shape (one codec for
+            # the wire msg and the vote record).
+            out += encode_bytes_field(
+                1, encode_weighted_option(int(opt), str(weight))
+            )
+        self.store.set(f"gov/vote/{pid}/{voter}".encode(), out)
+
+    @staticmethod
+    def _parse_vote(raw: bytes) -> list[tuple[VoteOption, int]]:
+        """[(option, weight_raw)] — weight_raw is a Dec raw (1e18 = 1)."""
+        from celestia_app_tpu.tx.messages import decode_weighted_option
+
+        out = []
+        for n, wt, v in decode_fields(raw):
+            if n == 1 and wt == WIRE_LEN:
+                opt, weight = decode_weighted_option(v)
+                out.append((VoteOption(opt), Dec.from_str(weight).raw))
+        return out
 
     def _tally(self, pid: int) -> tuple[bool, bool]:
-        """(passes, burn_deposits) — sdk gov keeper/tally.go semantics:
-        no quorum -> fail+burn; veto > 1/3 of votes -> fail+burn;
-        yes <= 1/2 of non-abstain -> fail+refund; else pass+refund."""
-        power: dict[VoteOption, int] = {o: 0 for o in VoteOption}
+        """(passes, burn_deposits) — sdk gov keeper/tally.go:
+
+        every voter's DELEGATED stake votes directly; a validator votes
+        its remaining tokens (self-bond + delegations whose delegators
+        did not vote themselves — inherit-unless-overridden).  Votes are
+        token-weighted against total bonded tokens.  Outcomes: no quorum
+        -> fail+burn; veto > 1/3 of votes -> fail+burn; yes <= 1/2 of
+        non-abstain -> fail+refund; else pass+refund."""
+        from celestia_app_tpu.state.staking import _DEL_PREFIX  # noqa: PLC2701
+
+        votes: dict[str, list[tuple[VoteOption, int]]] = {}
         prefix = f"gov/vote/{pid}/".encode()
         for key, val in self.store.iterate(prefix):
-            addr = key[len(prefix):].decode()
-            power[VoteOption(val[0])] += self.staking.get_power(addr)
-        total_bonded = self.staking.total_power()
-        voted = sum(power.values())
-        if total_bonded == 0 or Fraction(voted, total_bonded) < QUORUM:
+            votes[key[len(prefix):].decode()] = self._parse_vote(val)
+
+        bonded = {
+            v.address for v in self.staking.bonded_validators()
+        } if hasattr(self.staking, "bonded_validators") else {
+            v.address for v in self.staking.validators()
+        }
+        # delegator -> [(validator, stake)] over bonded validators only.
+        by_delegator: dict[str, list[tuple[str, int]]] = {}
+        for key, val in self.store.iterate(_DEL_PREFIX):
+            validator, delegator = key[len(_DEL_PREFIX):].split(b"/", 1)
+            validator = validator.decode()
+            if validator in bonded:
+                by_delegator.setdefault(delegator.decode(), []).append(
+                    (validator, int.from_bytes(val, "big"))
+                )
+
+        PREC = 10**18
+        power_raw: dict[VoteOption, int] = {o: 0 for o in VoteOption}
+        deductions: dict[str, int] = {}
+        for voter, opts in votes.items():
+            stake = 0
+            for validator, amount in by_delegator.get(voter, ()):
+                stake += amount
+                deductions[validator] = deductions.get(validator, 0) + amount
+            for opt, weight_raw in opts:
+                power_raw[opt] += stake * weight_raw
+        for validator in bonded:
+            opts = votes.get(validator)
+            if not opts:
+                continue  # non-voting validators contribute nothing (sdk)
+            vp = self.staking.tokens(validator) - deductions.get(validator, 0)
+            if vp <= 0:
+                continue
+            for opt, weight_raw in opts:
+                power_raw[opt] += vp * weight_raw
+
+        total_bonded = sum(self.staking.tokens(v) for v in bonded)
+        voted = sum(power_raw.values())  # token-units x 1e18
+        if total_bonded == 0 or Fraction(voted, total_bonded * PREC) < QUORUM:
             return False, True
-        if voted and Fraction(power[VoteOption.NO_WITH_VETO], voted) > VETO_THRESHOLD:
+        if voted and Fraction(power_raw[VoteOption.NO_WITH_VETO], voted) > VETO_THRESHOLD:
             return False, True
-        non_abstain = voted - power[VoteOption.ABSTAIN]
-        if non_abstain == 0 or Fraction(power[VoteOption.YES], non_abstain) <= THRESHOLD:
+        non_abstain = voted - power_raw[VoteOption.ABSTAIN]
+        if non_abstain == 0 or Fraction(power_raw[VoteOption.YES], non_abstain) <= THRESHOLD:
             return False, False
         return True, False
 

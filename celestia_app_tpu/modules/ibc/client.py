@@ -129,9 +129,9 @@ class ClientKeeper:
         set; returns the new client id (07-tendermint-style numbering)."""
         if not validators:
             raise IBCError("client needs a non-empty validator set")
-        n = int.from_bytes(self.store.get(_NEXT_CLIENT_KEY) or b"\x00", "big")
-        self.store.set(_NEXT_CLIENT_KEY, (n + 1).to_bytes(8, "big"))
-        client_id = f"07-tpu-{n}"
+        from celestia_app_tpu.modules.ibc.core import next_counter
+
+        client_id = f"07-tpu-{next_counter(self.store, _NEXT_CLIENT_KEY)}"
         cs = ClientState(
             client_id, chain_id,
             tuple(

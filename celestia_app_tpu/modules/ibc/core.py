@@ -129,6 +129,14 @@ class Packet:
         return hashlib.sha256(buf).digest()
 
 
+def next_counter(store: KVStore, key: bytes) -> int:
+    """Monotonic 8-byte-BE counter starting at 0 (client / connection /
+    channel id allocation — one definition of the byte width and start)."""
+    n = int.from_bytes(store.get(key) or b"\x00", "big")
+    store.set(key, (n + 1).to_bytes(8, "big"))
+    return n
+
+
 def _chan_key(kind: bytes, port: str, channel_id: str, seq: int | None = None) -> bytes:
     key = b"ibc/" + kind + b"/" + port.encode() + b"/" + channel_id.encode()
     if seq is not None:
@@ -207,6 +215,12 @@ class ChannelKeeper:
     def recv_packet(self, packet: Packet, height: int, time_ns: int) -> None:
         """Receipt write + replay/timeout checks (RecvPacket core half)."""
         chan = self.channel(packet.destination_port, packet.destination_channel)
+        if chan.state != "OPEN":
+            # Reachable since handshakes exist: a TRYOPEN channel awaiting
+            # open_confirm must not accept packets (ibc-go RecvPacket).
+            raise IBCError(
+                f"channel {packet.destination_channel} is {chan.state}, not OPEN"
+            )
         if (
             chan.counterparty_port != packet.source_port
             or chan.counterparty_channel_id != packet.source_channel
@@ -252,6 +266,10 @@ class ChannelKeeper:
         AcknowledgePacket/TimeoutPacket make the same check for the same
         reason."""
         chan = self.channel(packet.source_port, packet.source_channel)
+        if chan.state != "OPEN":
+            raise IBCError(
+                f"channel {packet.source_channel} is {chan.state}, not OPEN"
+            )
         if (
             chan.counterparty_port != packet.destination_port
             or chan.counterparty_channel_id != packet.destination_channel

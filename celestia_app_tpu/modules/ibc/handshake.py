@@ -80,9 +80,9 @@ class ConnectionKeeper:
         self.clients = ClientKeeper(store)
 
     def _next_id(self) -> str:
-        n = int.from_bytes(self.store.get(_NEXT_CONN_KEY) or b"\x00", "big")
-        self.store.set(_NEXT_CONN_KEY, (n + 1).to_bytes(8, "big"))
-        return f"connection-{n}"
+        from celestia_app_tpu.modules.ibc.core import next_counter
+
+        return f"connection-{next_counter(self.store, _NEXT_CONN_KEY)}"
 
     def _save(self, end: ConnectionEnd) -> None:
         self.store.set(connection_key(end.connection_id), end.marshal())
@@ -187,9 +187,9 @@ class ChannelHandshake:
         self.connections = ConnectionKeeper(store)
 
     def _next_channel_id(self) -> str:
-        n = int.from_bytes(self.store.get(_NEXT_CHAN_KEY) or b"\x00", "big")
-        self.store.set(_NEXT_CHAN_KEY, (n + 1).to_bytes(8, "big"))
-        return f"channel-{n}"
+        from celestia_app_tpu.modules.ibc.core import next_counter
+
+        return f"channel-{next_counter(self.store, _NEXT_CHAN_KEY)}"
 
     def _save(self, chan: Channel) -> None:
         self.store.set(channel_key(chan.port, chan.channel_id), chan.marshal())

@@ -27,6 +27,7 @@ URL_MSG_SIGNAL_VERSION = "/celestia.signal.v1.MsgSignalVersion"
 URL_MSG_TRY_UPGRADE = "/celestia.signal.v1.MsgTryUpgrade"
 URL_MSG_SUBMIT_PROPOSAL = "/cosmos.gov.v1beta1.MsgSubmitProposal"
 URL_MSG_VOTE = "/cosmos.gov.v1beta1.MsgVote"
+URL_MSG_VOTE_WEIGHTED = "/cosmos.gov.v1beta1.MsgVoteWeighted"
 URL_MSG_DEPOSIT = "/cosmos.gov.v1beta1.MsgDeposit"
 URL_PARAM_CHANGE_PROPOSAL = "/cosmos.params.v1beta1.ParameterChangeProposal"
 URL_COMMUNITY_POOL_SPEND_PROPOSAL = (
@@ -445,6 +446,93 @@ class MsgVote:
             raise ValueError("invalid proposal id")
         if self.option not in (1, 2, 3, 4):
             raise ValueError(f"invalid vote option {self.option}")
+
+
+def encode_weighted_option(option: int, weight: str) -> bytes:
+    """WeightedVoteOption {option=1, weight=2 (Dec string)} — the single
+    codec for this shape, shared by the MsgVoteWeighted wire form and the
+    gov keeper's vote records."""
+    return encode_varint_field(1, option) + encode_bytes_field(
+        2, weight.encode()
+    )
+
+
+def decode_weighted_option(raw: bytes) -> tuple[int, str]:
+    opt, weight = 0, ""
+    for n, wt, v in decode_fields(raw):
+        if n == 1 and wt == WIRE_VARINT:
+            opt = v
+        elif n == 2 and wt == WIRE_LEN:
+            weight = v.decode()
+    return opt, weight
+
+
+@dataclass(frozen=True)
+class MsgVoteWeighted:
+    """cosmos.gov.v1beta1.MsgVoteWeighted {proposal_id=1, voter=2,
+    options=3 (repeated WeightedVoteOption {option=1, weight=2})} —
+    weight is an 18-decimal Dec string on the wire."""
+
+    proposal_id: int
+    voter: str
+    options: tuple[tuple[int, str], ...]  # (VoteOption number, Dec string)
+
+    TYPE_URL = URL_MSG_VOTE_WEIGHTED
+
+    def marshal(self) -> bytes:
+        out = encode_varint_field(1, self.proposal_id)
+        out += encode_bytes_field(2, self.voter.encode())
+        for opt, weight in self.options:
+            out += encode_bytes_field(3, encode_weighted_option(opt, weight))
+        return out
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgVoteWeighted":
+        pid, voter = 0, ""
+        options: list[tuple[int, str]] = []
+        for num, wt, val in decode_fields(raw):
+            if num == 1 and wt == WIRE_VARINT:
+                pid = val
+            elif num == 2 and wt == WIRE_LEN:
+                voter = val.decode()
+            elif num == 3 and wt == WIRE_LEN:
+                options.append(decode_weighted_option(val))
+        return cls(pid, voter, tuple(options))
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+    @property
+    def signer(self) -> str:
+        return self.voter
+
+    def validate_basic(self) -> None:
+        """Stateless parity with sdk v1beta1 ValidateBasic: options
+        non-empty, each weight in (0, 1], no duplicates, total exactly 1 —
+        invalid weighted votes must die at CheckTx, not DeliverTx."""
+        from celestia_app_tpu.crypto.keys import validate_address
+        from celestia_app_tpu.state.dec import Dec
+
+        validate_address(self.voter)
+        if self.proposal_id <= 0:
+            raise ValueError("invalid proposal id")
+        if not self.options:
+            raise ValueError("weighted vote needs at least one option")
+        total = Dec(0)
+        seen: set[int] = set()
+        one = Dec.from_int(1)
+        for opt, weight in self.options:
+            if opt not in (1, 2, 3, 4):
+                raise ValueError(f"invalid vote option {opt}")
+            if opt in seen:
+                raise ValueError(f"duplicate vote option {opt}")
+            seen.add(opt)
+            w = Dec.from_str(weight)
+            if w <= Dec(0) or one < w:
+                raise ValueError(f"vote weight {weight} outside (0, 1]")
+            total = total.add(w)
+        if total.raw != one.raw:
+            raise ValueError(f"vote weights must sum to 1, got {total}")
 
 
 @dataclass(frozen=True)
@@ -1141,6 +1229,7 @@ MSG_DECODERS = {
     URL_MSG_TRY_UPGRADE: MsgTryUpgrade.unmarshal,
     URL_MSG_SUBMIT_PROPOSAL: MsgSubmitProposal.unmarshal,
     URL_MSG_VOTE: MsgVote.unmarshal,
+    URL_MSG_VOTE_WEIGHTED: MsgVoteWeighted.unmarshal,
     URL_MSG_DEPOSIT: MsgDeposit.unmarshal,
     URL_MSG_TRANSFER: MsgTransfer.unmarshal,
     URL_MSG_RECV_PACKET: MsgRecvPacket.unmarshal,

@@ -356,3 +356,94 @@ class TestOnChainParams:
         assert node.app.gov_max_square_size == 64
         BlobParamsKeeper(node.app.cms.working).set_gov_max_square_size(32)
         assert node.app.max_effective_square_size() == 32
+
+
+class TestDelegatorVoting:
+    """sdk tally.go: delegators vote their own stake; validators vote
+    their remaining tokens (inherit-unless-overridden); weighted votes
+    split one voter's power across options."""
+
+    def _world(self):
+        from celestia_app_tpu.state.staking import POWER_REDUCTION
+
+        gov, store, bank = make_gov_with_bank(
+            {"v1": 60, "v2": 40},
+            {"alice": 100 * POWER_REDUCTION, "bob": 100 * POWER_REDUCTION,
+             "proposer": 2 * DEFAULT_MIN_DEPOSIT},
+        )
+        return gov, store, bank, POWER_REDUCTION
+
+    def _proposal(self, gov):
+        pid = gov.submit("proposer", [CHANGE], DEFAULT_MIN_DEPOSIT, time_ns=0)
+        return pid
+
+    def test_delegator_overrides_validator(self):
+        """v1 votes NO with 60+20=80 power; alice's 20 delegated to v1
+        votes YES — her stake comes OUT of v1's vote."""
+        gov, store, bank, PR = self._world()
+        StakingKeeper(store).delegate(bank, "alice", "v1", 20 * PR)
+        pid = self._proposal(gov)
+        gov.vote(pid, "v1", VoteOption.NO, time_ns=5)
+        gov.vote(pid, "alice", VoteOption.YES, time_ns=6)
+        # totals: v1 tokens 80 (60 notional-free + 20 delegated)... tally:
+        # alice 20 YES; v1 80-20=60 NO; v2 40 silent. YES=20 NO=60 -> fails
+        # threshold but NOT quorum (80/120 voted).
+        passes, burn = gov._tally(pid)
+        assert (passes, burn) == (False, False)
+        # Flip: alice delegates enough to outvote the validator.
+        StakingKeeper(store).delegate(bank, "alice", "v1", 70 * PR)
+        gov2, pid2 = gov, self._proposal(gov)
+        gov2.vote(pid2, "v1", VoteOption.NO, time_ns=5)
+        gov2.vote(pid2, "alice", VoteOption.YES, time_ns=6)
+        # alice 90 YES; v1 150-90=60 NO -> passes 90 > 60.
+        passes, burn = gov2._tally(pid2)
+        assert (passes, burn) == (True, False)
+
+    def test_delegator_without_vote_inherits(self):
+        gov, store, bank, PR = self._world()
+        StakingKeeper(store).delegate(bank, "alice", "v1", 40 * PR)
+        pid = self._proposal(gov)
+        gov.vote(pid, "v1", VoteOption.YES, time_ns=5)
+        # alice silent: her 40 rides with v1 -> YES=100 of 140 total.
+        passes, burn = gov._tally(pid)
+        assert (passes, burn) == (True, False)
+
+    def test_nonstaker_vote_counts_nothing(self):
+        gov, store, bank, PR = self._world()
+        pid = self._proposal(gov)
+        gov.vote(pid, "bob", VoteOption.YES, time_ns=5)  # no stake at all
+        passes, burn = gov._tally(pid)
+        assert (passes, burn) == (False, True)  # no quorum
+
+    def test_weighted_vote_splits_power(self):
+        from celestia_app_tpu.state.dec import Dec
+
+        gov, store, bank, PR = self._world()
+        pid = self._proposal(gov)
+        # v1 (60%) splits 50/50 yes/veto; v2 (40%) votes yes.
+        gov.vote_weighted(pid, "v1", [
+            (VoteOption.YES, Dec.from_str("0.5")),
+            (VoteOption.NO_WITH_VETO, Dec.from_str("0.5")),
+        ], time_ns=5)
+        gov.vote(pid, "v2", VoteOption.YES, time_ns=6)
+        # veto share = 30/100 < 1/3; yes = 70/100 of non-abstain -> passes.
+        passes, burn = gov._tally(pid)
+        assert (passes, burn) == (True, False)
+
+    def test_weighted_vote_validation(self):
+        from celestia_app_tpu.state.dec import Dec
+
+        gov, store, bank, PR = self._world()
+        pid = self._proposal(gov)
+        with pytest.raises(GovError, match="sum to 1"):
+            gov.vote_weighted(pid, "v1", [(VoteOption.YES, Dec.from_str("0.6"))], 5)
+        with pytest.raises(GovError, match="positive"):
+            gov.vote_weighted(pid, "v1", [
+                (VoteOption.YES, Dec.from_str("1.5")),
+                (VoteOption.NO, Dec.from_str("-0.5")),
+            ], 5)
+        with pytest.raises(GovError, match="duplicate"):
+            gov.vote_weighted(pid, "v1", [
+                (VoteOption.YES, Dec.from_str("0.5")),
+                (VoteOption.YES, Dec.from_str("0.5")),
+            ], 5)

@@ -230,3 +230,51 @@ class TestVerifiedRelay:
         assert result.code == 0, result.log
         # Escrow refunded (minus the two tx fees paid on a).
         assert a.balance(sender.public_key().address()) == before - 20_000
+
+class TestHalfOpenChannel:
+    def test_tryopen_channel_rejects_packets(self):
+        """A TRYOPEN channel awaiting open_confirm must not accept
+        packets (ibc-go RecvPacket's state check)."""
+        from celestia_app_tpu.modules.ibc import ChannelKeeper
+        from celestia_app_tpu.modules.ibc.core import Packet
+        from celestia_app_tpu.modules.ibc.handshake import (
+            ChannelHandshake,
+            ConnectionKeeper,
+            channel_key,
+        )
+
+        chains = VerifiedChains()
+        a, b = chains.a, chains.b
+        # Run the connection handshake fully, then stop the channel
+        # handshake after open_try (b stays TRYOPEN).
+        conn_a = ConnectionKeeper(a.store).open_init(
+            chains.client_on_a, chains.client_on_b
+        )
+        h = chains.sync(a, b)
+        from celestia_app_tpu.modules.ibc.handshake import connection_key
+
+        conn_b = ConnectionKeeper(b.store).open_try(
+            chains.client_on_b, conn_a, chains.client_on_a,
+            a.proof_at(connection_key(conn_a), h), h,
+        )
+        h = chains.sync(b, a)
+        ConnectionKeeper(a.store).open_ack(
+            conn_a, conn_b, b.proof_at(connection_key(conn_b), h), h
+        )
+        h = chains.sync(a, b)
+        ConnectionKeeper(b.store).open_confirm(
+            conn_b, a.proof_at(connection_key(conn_a), h), h
+        )
+        chan_a = ChannelHandshake(a.store).open_init(
+            conn_a, TRANSFER_PORT, TRANSFER_PORT
+        )
+        h = chains.sync(a, b)
+        chan_b = ChannelHandshake(b.store).open_try(
+            conn_b, TRANSFER_PORT, TRANSFER_PORT, chan_a,
+            a.proof_at(channel_key(TRANSFER_PORT, chan_a), h), h,
+        )
+        packet = Packet(
+            1, TRANSFER_PORT, chan_a, TRANSFER_PORT, chan_b, b"{}",
+        )
+        with pytest.raises(IBCError, match="TRYOPEN, not OPEN"):
+            ChannelKeeper(b.store).recv_packet(packet, 1, 0)

@@ -440,6 +440,45 @@ class TestGovAndIBCWire:
             amount=[pb["coin"].Coin(denom="utia", amount="5")],
         ).SerializeToString()
 
+        from celestia_app_tpu.tx.messages import MsgVoteWeighted
+
+        w = "0.500000000000000000"
+        wv = MsgVoteWeighted(3, "celestia1v", ((1, w), (4, w)))
+        ref_wv = pb["gov"].MsgVoteWeighted(
+            proposal_id=3, voter="celestia1v",
+            options=[
+                pb["gov"].WeightedVoteOption(option=1, weight=w),
+                pb["gov"].WeightedVoteOption(option=4, weight=w),
+            ],
+        )
+        assert wv.marshal() == ref_wv.SerializeToString()
+        assert MsgVoteWeighted.unmarshal(ref_wv.SerializeToString()) == wv
+
+        # CommunityPoolSpendProposal content round-trips through
+        # MsgSubmitProposal against the protoc encoding.
+        import importlib
+
+        dist_pb = importlib.import_module("cosmos.distribution.v1beta1.tx_pb2")
+        spend_content = dist_pb.CommunityPoolSpendProposal(
+            title="t", description="d", recipient="celestia1grantee",
+            amount=[pb["coin"].Coin(denom="utia", amount="7000")],
+        )
+        ref_spend = pb["gov"].MsgSubmitProposal(
+            content=any_pb2.Any(
+                type_url="/cosmos.distribution.v1beta1.CommunityPoolSpendProposal",
+                value=spend_content.SerializeToString(),
+            ),
+            initial_deposit=[pb["coin"].Coin(denom="utia", amount="100")],
+            proposer="celestia1prop",
+        )
+        spend_msg = MsgSubmitProposal(
+            "t", "d", (), (Coin("utia", 100),), "celestia1prop",
+            spend_recipient="celestia1grantee",
+            spend_amount=(Coin("utia", 7000),),
+        )
+        assert spend_msg.marshal() == ref_spend.SerializeToString()
+        assert MsgSubmitProposal.unmarshal(ref_spend.SerializeToString()) == spend_msg
+
     def test_ibc_packet_and_relay_msgs(self, pb):
         from celestia_app_tpu.modules.ibc.core import Height, Packet
         from celestia_app_tpu.tx.messages import (
