@@ -844,22 +844,46 @@ class App:
             # The app callback runs on a cache; its state lands only when
             # the ack is a success (ibc-go msg_server.go RecvPacket's
             # cacheCtx) — an error ack must not leave minted vouchers or
-            # half-done forwards behind.
+            # half-done forwards behind.  The destination port routes to
+            # the app module (ibc-go's port router): transfer or icahost.
             recv_ctx = ctx.branch()
-            recv_keeper = TransferKeeper(ChannelKeeper(recv_ctx.store), recv_ctx.bank)
-            stack = build_transfer_stack(
-                self.app_version, recv_keeper, token_filter=self.ibc_token_filter
-            )
-            ack = stack.on_recv_packet(recv_ctx, packet)
+            from celestia_app_tpu.modules.ibc.ica import ICA_HOST_PORT
+
+            if packet.destination_port == ICA_HOST_PORT:
+                if self.app_version < 2:
+                    raise ValueError(
+                        "icahost is a v2 module (app/modules.go:185-187)"
+                    )
+                from celestia_app_tpu.modules.ibc.ica import (
+                    ICAHostKeeper,
+                    ICAHostModule,
+                )
+
+                ica = ICAHostModule(
+                    ICAHostKeeper(recv_ctx.store), self._handle_msg
+                )
+                ack, recv_events = ica.on_recv_packet(recv_ctx, packet)
+            else:
+                recv_keeper = TransferKeeper(
+                    ChannelKeeper(recv_ctx.store), recv_ctx.bank
+                )
+                stack = build_transfer_stack(
+                    self.app_version, recv_keeper,
+                    token_filter=self.ibc_token_filter,
+                )
+                ack = stack.on_recv_packet(recv_ctx, packet)
+                # Middleware (PFM) may have sent onward packets during recv.
+                recv_events = [
+                    ("ibc.send_packet", p.marshal().hex()) for p in recv_keeper.sent
+                ]
             events = [("ibc.write_acknowledgement", packet.marshal().hex(), ack.hex())]
             if not ack_is_error(ack):
                 ctx.store.write_back(recv_ctx.store)
-                # Middleware (PFM) may have sent onward packets during recv.
-                events += [
-                    ("ibc.send_packet", p.marshal().hex()) for p in recv_keeper.sent
-                ]
+                events += recv_events
             channels.write_acknowledgement(packet, ack)
             return 0, events
+        from celestia_app_tpu.modules.ibc.transfer import TRANSFER_PORT
+
         keeper = TransferKeeper(channels, ctx.bank)
         stack = build_transfer_stack(
             self.app_version, keeper, token_filter=self.ibc_token_filter
@@ -879,7 +903,11 @@ class App:
                     msg.state_proof(), msg.proof_height,
                 )
             channels.acknowledge_packet(packet)
-            stack.on_acknowledgement_packet(ctx, packet, msg.acknowledgement)
+            # Port routing (ibc-go's router): only the transfer app has an
+            # ack callback (refund-on-error); other ports' acks — e.g. an
+            # ICA controller's — just clear the commitment.
+            if packet.source_port == TRANSFER_PORT:
+                stack.on_acknowledgement_packet(ctx, packet, msg.acknowledgement)
             return 0, [("ibc.acknowledge_packet", packet.sequence)]
         packet = msg.packet()  # MsgTimeout
         if channels.packet_commitment(
@@ -898,7 +926,8 @@ class App:
         # timestamp check uses this chain's clock (scope note in
         # verify_timeout_proof).
         channels.timeout_packet(packet, msg.proof_height, ctx.time_ns)
-        stack.on_timeout_packet(ctx, packet)
+        if packet.source_port == TRANSFER_PORT:
+            stack.on_timeout_packet(ctx, packet)
         return 0, [("ibc.timeout_packet", packet.sequence)]
 
     def _end_block(self, ctx: Ctx, height: int) -> None:

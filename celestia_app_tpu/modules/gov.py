@@ -15,8 +15,11 @@ app/app.go:739-750).  This module implements that lifecycle:
     -> PASSED proposals execute their param changes through the registry,
        re-checking the paramfilter blocklist at execution.
 
-Voting power is validator power (staking), matching how celestia governance
-is decided in practice; delegator-level votes are out of scope (PARITY.md).
+Voting follows sdk tally.go: any address votes (MsgVote or weighted
+MsgVoteWeighted); delegators vote their own staked tokens directly, and a
+bonded validator votes its remaining tokens — self-bond plus delegations
+whose delegators did not override it (inherit-unless-overridden).  The
+tally is token-weighted against total bonded tokens.
 """
 
 from __future__ import annotations
@@ -389,11 +392,7 @@ class GovKeeper:
         for key, val in self.store.iterate(prefix):
             votes[key[len(prefix):].decode()] = self._parse_vote(val)
 
-        bonded = {
-            v.address for v in self.staking.bonded_validators()
-        } if hasattr(self.staking, "bonded_validators") else {
-            v.address for v in self.staking.validators()
-        }
+        bonded = {v.address for v in self.staking.bonded_validators()}
         # delegator -> [(validator, stake)] over bonded validators only.
         by_delegator: dict[str, list[tuple[str, int]]] = {}
         for key, val in self.store.iterate(_DEL_PREFIX):

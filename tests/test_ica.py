@@ -1,0 +1,197 @@
+"""ICS-27 interchain accounts (host side).
+
+Reference: ibc-go 27-interchain-accounts host, wired v2-only with
+celestia's allow list (app/modules.go:185-187, app/ica_host.go:3-17,
+default_overrides.go:161-166: host enabled, controller disabled).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from celestia_app_tpu.modules.ibc import Channel, ChannelKeeper
+from celestia_app_tpu.modules.ibc.core import IBCError, Packet
+from celestia_app_tpu.modules.ibc.ica import (
+    CONTROLLER_PORT_PREFIX,
+    ICA_HOST_PORT,
+    ICAHostKeeper,
+    decode_packet_data,
+    encode_packet_data,
+)
+from celestia_app_tpu.state.accounts import AuthKeeper, BankKeeper
+from celestia_app_tpu.testutil.ibc import ConnectedChains
+from celestia_app_tpu.tx.messages import (
+    Coin,
+    MsgAcknowledgement,
+    MsgDelegate,
+    MsgRecvPacket,
+    MsgSend,
+)
+
+OWNER_PORT = CONTROLLER_PORT_PREFIX + "alice"
+
+
+def _ica_chains():
+    """celestia host (chain a) <- controller (chain b) over an icahost
+    channel pair, plus the registered interchain account, pre-funded."""
+    chains = ConnectedChains()
+    a, b = chains.a, chains.b
+    for end, port, cp_port in (
+        (a, ICA_HOST_PORT, OWNER_PORT),
+        (b, OWNER_PORT, ICA_HOST_PORT),
+    ):
+        ChannelKeeper(end.store).create_channel(Channel(
+            port, "channel-7", cp_port, "channel-7", version="ics27-1",
+        ))
+    # Direct-OPEN test channels carry no connection id; the registration
+    # binds to the channel's (empty) connection exactly as the recv-side
+    # lookup reads it back.
+    ica = ICAHostKeeper(a.store).register_account(
+        AuthKeeper(a.store), "", OWNER_PORT
+    )
+    BankKeeper(a.store).mint(ica, 1_000_000)
+    return chains, a, b, ica
+
+
+def _ica_packet(b, msgs, seq=1):
+    return Packet(
+        seq, OWNER_PORT, "channel-7", ICA_HOST_PORT, "channel-7",
+        encode_packet_data(msgs),
+    )
+
+
+class TestRegistration:
+    def test_derive_and_register_idempotent(self):
+        chains, a, b, ica = _ica_chains()
+        keeper = ICAHostKeeper(a.store)
+        assert keeper.interchain_account("", OWNER_PORT) == ica
+        # Re-registration (channel reopen) returns the same account.
+        again = keeper.register_account(AuthKeeper(a.store), "", OWNER_PORT)
+        assert again == ica
+        # Different owner or connection -> different account.
+        other = keeper.register_account(
+            AuthKeeper(a.store), "", CONTROLLER_PORT_PREFIX + "bob"
+        )
+        assert other != ica
+        assert keeper.derive_address("connection-0", OWNER_PORT) != ica
+        with pytest.raises(IBCError, match="must start with"):
+            keeper.register_account(AuthKeeper(a.store), "connection-0", "evil")
+
+    def test_packet_data_roundtrip(self):
+        msg = MsgSend("celestia1from", "celestia1to", (Coin("utia", 5),))
+        raw = encode_packet_data([msg], memo="hi")
+        ptype, msgs, memo = decode_packet_data(raw)
+        assert ptype == 1 and memo == "hi"
+        assert msgs == [msg]
+
+
+class TestExecution:
+    def test_execute_send_from_ica(self):
+        chains, a, b, ica = _ica_chains()
+        to = a.keys[0].public_key().address()
+        before = a.balance(to)
+        msg = MsgSend(ica, to, (Coin("utia", 40_000),))
+        res, results = a.submit(a.relayer, MsgRecvPacket(
+            _ica_packet(b, [msg]).marshal(),
+            a.relayer.public_key().address(),
+        ))
+        assert res.code == 0, res.log
+        ack = chains._written_ack(results)
+        assert ack == b'{"result":"AQ=="}'
+        assert a.balance(to) == before + 40_000
+        assert a.balance(ica) == 1_000_000 - 40_000
+
+    def test_execute_delegate_from_ica(self):
+        from celestia_app_tpu.state.staking import StakingKeeper
+
+        chains, a, b, ica = _ica_chains()
+        val = StakingKeeper(a.store).validators()[0].address
+        msg = MsgDelegate(ica, val, Coin("utia", 500_000))
+        res, results = a.submit(a.relayer, MsgRecvPacket(
+            _ica_packet(b, [msg]).marshal(),
+            a.relayer.public_key().address(),
+        ))
+        assert res.code == 0, res.log
+        assert chains._written_ack(results) == b'{"result":"AQ=="}'
+        assert StakingKeeper(a.store).delegation(ica, val) == 500_000
+
+    def test_wrong_signer_error_ack_no_state_change(self):
+        """Msgs signed by anyone but the interchain account get an error
+        ack and leave NO state behind."""
+        chains, a, b, ica = _ica_chains()
+        victim = a.keys[0].public_key().address()
+        v_before = a.balance(victim)
+        msg = MsgSend(victim, ica, (Coin("utia", 999),))  # steal attempt
+        res, results = a.submit(a.relayer, MsgRecvPacket(
+            _ica_packet(b, [msg]).marshal(),
+            a.relayer.public_key().address(),
+        ))
+        assert res.code == 0  # recv succeeds; the ACK carries the error
+        ack = chains._written_ack(results)
+        assert b"error" in ack and b"not the interchain account" in ack
+        assert a.balance(victim) == v_before
+
+    def test_disallowed_msg_error_ack(self):
+        from celestia_app_tpu.tx.messages import MsgUnjail
+
+        chains, a, b, ica = _ica_chains()
+        res, results = a.submit(a.relayer, MsgRecvPacket(
+            _ica_packet(b, [MsgUnjail(ica)]).marshal(),
+            a.relayer.public_key().address(),
+        ))
+        assert res.code == 0
+        assert b"not in the ICA allow list" in chains._written_ack(results)
+
+    def test_host_disabled(self):
+        chains, a, b, ica = _ica_chains()
+        ICAHostKeeper(a.store).set_host_enabled(False)
+        msg = MsgSend(ica, a.keys[0].public_key().address(), (Coin("utia", 1),))
+        res, results = a.submit(a.relayer, MsgRecvPacket(
+            _ica_packet(b, [msg]).marshal(),
+            a.relayer.public_key().address(),
+        ))
+        assert b"disabled" in chains._written_ack(results)
+
+    def test_v1_rejects_icahost(self):
+        """ica is a v2 module: at app version 1 the packet is rejected
+        outright (versioned module manager parity)."""
+        chains = ConnectedChains(app_version=1)
+        a, b = chains.a, chains.b
+        for end, port, cp_port in (
+            (a, ICA_HOST_PORT, OWNER_PORT), (b, OWNER_PORT, ICA_HOST_PORT),
+        ):
+            ChannelKeeper(end.store).create_channel(Channel(
+                port, "channel-7", cp_port, "channel-7", version="ics27-1",
+            ))
+        ica = ICAHostKeeper(a.store).register_account(
+            AuthKeeper(a.store), "", OWNER_PORT
+        )
+        msg = MsgSend(ica, a.keys[0].public_key().address(), (Coin("utia", 1),))
+        res, _ = a.submit(a.relayer, MsgRecvPacket(
+            _ica_packet(b, [msg]).marshal(),
+            a.relayer.public_key().address(),
+        ))
+        assert res.code != 0
+        assert "v2 module" in res.log
+
+    def test_ack_relays_back(self):
+        """The controller learns the outcome: relay the ack to chain b."""
+        chains, a, b, ica = _ica_chains()
+        # Controller-side commitment for the packet (b sent it).
+        from celestia_app_tpu.modules.ibc.core import _chan_key
+
+        packet = _ica_packet(b, [MsgSend(ica, a.keys[0].public_key().address(),
+                                         (Coin("utia", 7),))])
+        ChannelKeeper(b.store).store.set(
+            _chan_key(b"commit", OWNER_PORT, "channel-7", packet.sequence),
+            packet.commitment(),
+        )
+        res, results = a.submit(a.relayer, MsgRecvPacket(
+            packet.marshal(), a.relayer.public_key().address(),
+        ))
+        assert res.code == 0, res.log
+        ack = chains._written_ack(results)
+        res, _ = b.submit(b.relayer, MsgAcknowledgement(
+            packet.marshal(), b.relayer.public_key().address(), ack,
+        ))
+        assert res.code == 0, res.log
