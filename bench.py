@@ -21,7 +21,7 @@ Robustness (round-1 failure was an unusable accelerator tunnel):
 
 Env knobs:
   BENCH_K            run only this square size (default: 128, 256, 512)
-  BENCH_MODE         run only this mode: extend | repair | stream
+  BENCH_MODE         run only this mode: extend | compute | repair | stream
   BENCH_ITERS        timed iterations (default 5; 2 at k>=256)
   BENCH_BASELINE_S   skip the host-baseline run, use the given seconds/block
   BENCH_TOTAL_BUDGET wall-clock budget in seconds (default 1500)
@@ -45,7 +45,10 @@ BASELINE_NOTE = (
     "host baseline is the in-image single-core numpy-GF + hashlib-SHA256 "
     "path at k=128; the reference's Go leopard SIMD + SHA-NI codec is not "
     "runnable in this image (no Go toolchain), so vs_baseline overstates "
-    "the margin vs the real reference CPU path"
+    "the margin vs the real reference CPU path. The extend/stream/repair "
+    "modes are bound by this environment's host<->device network tunnel "
+    "(~34 MB/s sustained h2d); the `compute` rows isolate the on-chip "
+    "pipeline rate the same offload reaches behind a PCIe link."
 )
 
 
@@ -78,6 +81,27 @@ def _extend_seconds(ods: np.ndarray, iters: int) -> float:
         eds = ExtendedDataSquare.compute(ods)
         eds.data_root()
     jax.effects_barrier()
+    return (time.perf_counter() - t0) / iters
+
+
+def _compute_seconds(ods: np.ndarray, iters: int) -> float:
+    """Device-resident pipeline rate: shares already in HBM, full fused
+    extend+NMT+DAH program, data root back to host.  Isolates the chip's
+    compute from the host link (through this environment's network tunnel
+    the link sustains ~34 MB/s and dominates `extend`; on PCIe-attached
+    hardware the link is 10+ GB/s and `extend` approaches this number)."""
+    import jax
+    import jax.numpy as jnp
+
+    from celestia_app_tpu.da.eds import jit_pipeline
+
+    k = ods.shape[0]
+    pipe = jit_pipeline(k)
+    x = jax.device_put(jnp.asarray(ods))
+    np.asarray(pipe(x)[3])  # warmup / compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        np.asarray(pipe(x)[3])
     return (time.perf_counter() - t0) / iters
 
 
@@ -147,27 +171,28 @@ def _repair_seconds(ods: np.ndarray, iters: int) -> float:
 
 
 def _stream_seconds(ods: np.ndarray, iters: int) -> float:
-    """BASELINE config 5: pipelined block stream (async dispatch overlap)."""
+    """BASELINE config 5: pipelined block stream — the feeder thread
+    transfers block i+1 while the device computes block i, so steady state
+    approaches max(transfer, compute) instead of their sum."""
     import jax
     import jax.numpy as jnp
 
     from celestia_app_tpu.da.eds import jit_pipeline
+    from celestia_app_tpu.parallel.pipeline import stream_blocks
 
     k = ods.shape[0]
-    pipe = jit_pipeline(k)
+    jax.block_until_ready(jit_pipeline(k)(jnp.asarray(ods)))  # warmup/compile
     blocks = [np.roll(ods, i, axis=0) for i in range(4)]
-    jax.block_until_ready(pipe(jnp.asarray(blocks[0])))  # warmup
+
+    def feed(n):
+        for i in range(n):
+            yield i, blocks[i % len(blocks)]
+
+    n = 4 * iters
+    list(stream_blocks(feed(2), k))  # warm the feeder path
     t0 = time.perf_counter()
-    pending = None
-    n = 0
-    for _ in range(iters):
-        for b in blocks:
-            out = pipe(jnp.asarray(b))
-            if pending is not None:
-                np.asarray(pending[3])  # fetch previous root (host sync)
-            pending = out
-            n += 1
-    np.asarray(pending[3])
+    for _tag, eds in stream_blocks(feed(n), k):
+        eds.data_root()  # host sync per block, as a server would
     return (time.perf_counter() - t0) / n
 
 
@@ -189,8 +214,10 @@ def _stage_plan() -> list[dict]:
     plan = [
         {"mode": "extend", "k": 128},
         {"mode": "host", "k": 128},
+        {"mode": "compute", "k": 128},
         {"mode": "extend", "k": 256},
         {"mode": "extend", "k": 512},
+        {"mode": "compute", "k": 512},
         {"mode": "repair", "k": 128},
         {"mode": "stream", "k": 128},
     ]
@@ -230,6 +257,9 @@ def _run_child() -> None:
             ods_mb = ods.nbytes / 1e6
             if mode == "host":
                 secs = _host_seconds_per_block(ods)
+                mb = ods_mb
+            elif mode == "compute":
+                secs = _compute_seconds(ods, max(iters, 5))
                 mb = ods_mb
             elif mode == "repair":
                 secs = _repair_seconds(ods, iters)

@@ -882,7 +882,17 @@ class App:
                 events += recv_events
             channels.write_acknowledgement(packet, ack)
             return 0, events
-        from celestia_app_tpu.modules.ibc.transfer import TRANSFER_PORT
+        from celestia_app_tpu.modules.ibc.ica import (
+            CONTROLLER_PORT_PREFIX,
+            ICA_HOST_PORT,
+        )
+
+        def _ica_port(port: str) -> bool:
+            # Port routing (ibc-go's router): the ONLY non-transfer app
+            # here is ICA; every other port belongs to the transfer app
+            # (send_transfer escrows for arbitrary ports, so the refund
+            # callbacks must fire for them too).
+            return port == ICA_HOST_PORT or port.startswith(CONTROLLER_PORT_PREFIX)
 
         keeper = TransferKeeper(channels, ctx.bank)
         stack = build_transfer_stack(
@@ -903,10 +913,9 @@ class App:
                     msg.state_proof(), msg.proof_height,
                 )
             channels.acknowledge_packet(packet)
-            # Port routing (ibc-go's router): only the transfer app has an
-            # ack callback (refund-on-error); other ports' acks — e.g. an
-            # ICA controller's — just clear the commitment.
-            if packet.source_port == TRANSFER_PORT:
+            # Only ICA acks bypass the transfer app's refund-on-error
+            # callback; an ICA controller's ack just clears the commitment.
+            if not _ica_port(packet.source_port):
                 stack.on_acknowledgement_packet(ctx, packet, msg.acknowledgement)
             return 0, [("ibc.acknowledge_packet", packet.sequence)]
         packet = msg.packet()  # MsgTimeout
@@ -926,7 +935,7 @@ class App:
         # timestamp check uses this chain's clock (scope note in
         # verify_timeout_proof).
         channels.timeout_packet(packet, msg.proof_height, ctx.time_ns)
-        if packet.source_port == TRANSFER_PORT:
+        if not _ica_port(packet.source_port):
             stack.on_timeout_packet(ctx, packet)
         return 0, [("ibc.timeout_packet", packet.sequence)]
 

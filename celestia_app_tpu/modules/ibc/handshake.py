@@ -240,7 +240,23 @@ class ChannelHandshake:
             connection_id=connection_id,
         )
         self._save(chan)
+        self._on_chan_open_try(chan)
         return chan.channel_id
+
+    def _on_chan_open_try(self, chan: Channel) -> None:
+        """App-module channel-open callback (ibc-go OnChanOpenTry): a
+        channel opened TO port `icahost` registers the interchain account
+        for (connection, controller port) — without this the handshake
+        would open a channel no EXECUTE_TX could ever use."""
+        from celestia_app_tpu.modules.ibc.ica import ICA_HOST_PORT, ICAHostKeeper
+
+        if chan.port == ICA_HOST_PORT:
+            from celestia_app_tpu.state.accounts import AuthKeeper
+
+            ICAHostKeeper(self.store).register_account(
+                AuthKeeper(self.store), chan.connection_id,
+                chan.counterparty_port,
+            )
 
     def open_ack(
         self, port: str, channel_id: str, counterparty_channel_id: str,

@@ -347,3 +347,37 @@ class TestPacketForward:
         assert chains._sent_packet(results) is None  # delivered, not forwarded
         voucher = voucher_denom(TRANSFER_PORT, b.channel_id, "utia")
         assert b.balance(b.keys[0].public_key().address(), denom=voucher) == 9
+
+
+class TestCustomPortRefund:
+    def test_timeout_refunds_on_nonstandard_transfer_port(self):
+        """The refund callback keys off the app owning the port, not the
+        literal string 'transfer': an escrow made through a custom port
+        still refunds on timeout (only ICA ports bypass the transfer
+        app)."""
+        from celestia_app_tpu.modules.ibc import Channel, ChannelKeeper
+        from celestia_app_tpu.testutil.ibc import ConnectedChains
+        from celestia_app_tpu.tx.messages import Coin, MsgTimeout, MsgTransfer
+
+        chains = ConnectedChains()
+        a = chains.a
+        ChannelKeeper(a.store).create_channel(Channel(
+            "transfer-2", "channel-9", "transfer-2", "channel-9"
+        ))
+        sender = a.keys[0]
+        addr = sender.public_key().address()
+        before = a.balance(addr)
+        res, results = a.submit(sender, MsgTransfer(
+            "transfer-2", "channel-9", Coin("utia", 5_000), addr, "cosmos1r",
+            timeout_revision_height=a.node.app.height + 1,
+        ))
+        assert res.code == 0, res.log
+        packet = chains._sent_packet(results)
+        assert packet is not None
+        assert a.balance(addr) == before - 5_000 - 20_000  # escrowed + fee
+        res, _ = a.submit(a.relayer, MsgTimeout(
+            packet.marshal(), a.relayer.public_key().address(),
+            proof_height=a.node.app.height + 5,
+        ))
+        assert res.code == 0, res.log
+        assert a.balance(addr) == before - 20_000  # escrow refunded

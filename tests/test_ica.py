@@ -195,3 +195,49 @@ class TestExecution:
             packet.marshal(), b.relayer.public_key().address(), ack,
         ))
         assert res.code == 0, res.log
+
+
+class TestHandshakeRegistration:
+    def test_channel_open_registers_account(self):
+        """The 04-channel handshake to port icahost registers the
+        interchain account (ibc-go OnChanOpenTry) — the packet-driven
+        registration path, no manual keeper call."""
+        from celestia_app_tpu.testutil.ibc import VerifiedChains
+        from celestia_app_tpu.modules.ibc.handshake import (
+            ChannelHandshake,
+            ConnectionKeeper,
+            channel_key,
+            connection_key,
+        )
+
+        chains = VerifiedChains()
+        a, b = chains.a, chains.b  # a = host, b = controller
+        conn_b = ConnectionKeeper(b.store).open_init(
+            chains.client_on_b, chains.client_on_a
+        )
+        h = chains.sync(b, a)
+        conn_a = ConnectionKeeper(a.store).open_try(
+            chains.client_on_a, conn_b, chains.client_on_b,
+            b.proof_at(connection_key(conn_b), h), h,
+        )
+        h = chains.sync(a, b)
+        ConnectionKeeper(b.store).open_ack(
+            conn_b, conn_a, a.proof_at(connection_key(conn_a), h), h
+        )
+        h = chains.sync(b, a)
+        ConnectionKeeper(a.store).open_confirm(
+            conn_a, b.proof_at(connection_key(conn_b), h), h
+        )
+        # Controller opens the ICA channel; host's open_try registers.
+        chan_b = ChannelHandshake(b.store).open_init(
+            conn_b, OWNER_PORT, ICA_HOST_PORT, version="ics27-1"
+        )
+        h = chains.sync(b, a)
+        ChannelHandshake(a.store).open_try(
+            conn_a, ICA_HOST_PORT, OWNER_PORT, chan_b,
+            b.proof_at(channel_key(OWNER_PORT, chan_b), h), h,
+            version="ics27-1",
+        )
+        account = ICAHostKeeper(a.store).interchain_account(conn_a, OWNER_PORT)
+        assert account is not None
+        assert AuthKeeper(a.store).get_account(account) is not None
