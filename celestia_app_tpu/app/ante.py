@@ -50,8 +50,10 @@ from celestia_app_tpu.tx.messages import (
     MsgAuthzGrant,
     MsgAuthzRevoke,
     MsgBeginRedelegate,
+    MsgCreateValidator,
     MsgDelegate,
     MsgDeposit,
+    MsgEditValidator,
     MsgFundCommunityPool,
     MsgGrantAllowance,
     MsgRevokeAllowance,
@@ -88,6 +90,7 @@ _V1_MSGS = {
     MsgSend, MsgPayForBlobs, MsgSubmitProposal, MsgVote, MsgVoteWeighted, MsgDeposit,
     MsgTransfer, MsgRecvPacket, MsgAcknowledgement, MsgTimeout,
     MsgDelegate, MsgUndelegate, MsgBeginRedelegate,
+    MsgCreateValidator, MsgEditValidator,
     MsgWithdrawDelegatorReward, MsgWithdrawValidatorCommission,
     MsgSetWithdrawAddress, MsgFundCommunityPool, MsgUnjail,
     MsgGrantAllowance, MsgRevokeAllowance,

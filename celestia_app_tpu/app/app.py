@@ -48,8 +48,10 @@ from celestia_app_tpu.tx.messages import (
     MsgAuthzGrant,
     MsgAuthzRevoke,
     MsgBeginRedelegate,
+    MsgCreateValidator,
     MsgDelegate,
     MsgDeposit,
+    MsgEditValidator,
     MsgGrantAllowance,
     MsgPayForBlobs,
     MsgRecvPacket,
@@ -614,6 +616,37 @@ class App:
             return 0, []
         if isinstance(msg, (MsgTransfer, MsgRecvPacket, MsgAcknowledgement, MsgTimeout)):
             return self._handle_ibc_msg(ctx, msg)
+        if isinstance(msg, (MsgCreateValidator, MsgEditValidator)):
+            from celestia_app_tpu.modules.distribution import DistributionKeeper
+            from celestia_app_tpu.state.dec import Dec as _Dec
+            from celestia_app_tpu.state.staking import StakingError
+
+            dist = DistributionKeeper(ctx.store)
+            try:
+                if isinstance(msg, MsgCreateValidator):
+                    # Same vesting bookkeeping as MsgDelegate: a self-bond
+                    # consumes locked tokens first (sdk TrackDelegation).
+                    acc = ctx.auth.get_account(msg.delegator_address)
+                    if acc is not None and acc.vesting_type:
+                        acc.track_delegation(msg.value.amount, ctx.time_ns)
+                        ctx.auth.set_account(acc)
+                    ctx.staking.create_validator(
+                        ctx.bank, dist, msg.validator_address, msg.pubkey,
+                        msg.delegator_address, msg.value.amount,
+                        _Dec.from_str(msg.commission_rate or "0").raw,
+                    )
+                    return 0, [("cosmos.staking.v1beta1.EventCreateValidator",
+                                msg.validator_address, msg.value.amount)]
+                if not ctx.staking.has_validator(msg.validator_address):
+                    raise ValueError(f"no validator {msg.validator_address}")
+                if msg.commission_rate:
+                    dist.set_commission_rate(
+                        msg.validator_address, _Dec.from_str(msg.commission_rate)
+                    )
+                return 0, [("cosmos.staking.v1beta1.EventEditValidator",
+                            msg.validator_address)]
+            except StakingError as e:
+                raise ValueError(str(e)) from e
         if isinstance(msg, (MsgDelegate, MsgUndelegate, MsgBeginRedelegate)):
             if msg.amount.denom != "utia":  # x/staking ErrBadDenom
                 raise ValueError(

@@ -281,6 +281,35 @@ class StakingKeeper:
         self._set_delegation(delegator, dst, self.delegation(delegator, dst) + amount)
         self._set_tokens(dst, self.tokens(dst) + amount)
 
+    def create_validator(
+        self, bank, dist, operator: str, pubkey: bytes,
+        delegator: str, self_stake: int, commission_rate_raw: int = 0,
+    ) -> None:
+        """MsgCreateValidator: a NEW validator joins with an escrowed
+        self-delegation (unlike genesis validators' notional power).  The
+        bonded set — consensus votes, signal tallies, blobstream valsets,
+        reward allocation — picks it up from the next block."""
+        if self.has_validator(operator):
+            raise StakingError(f"validator {operator} already exists")
+        if self_stake <= 0:
+            raise StakingError("self delegation must be positive")
+        if not pubkey:
+            raise StakingError("validator needs a consensus pubkey")
+        # One consensus key, one validator (sdk ErrValidatorPubKeyExists):
+        # a shared key would let one signer double-count its power toward
+        # the +2/3 quorum under two bonded-set entries.
+        for v in self.validators():
+            if v.pubkey == pubkey:
+                raise StakingError(
+                    f"consensus pubkey already used by validator {v.address}"
+                )
+        self.set_validator(Validator(operator, pubkey, 0))
+        if commission_rate_raw:
+            from celestia_app_tpu.state.dec import Dec
+
+            dist.set_commission_rate(operator, Dec(commission_rate_raw))
+        self.delegate(bank, delegator, operator, self_stake)
+
     def complete_unbondings(self, bank, time_ns: int) -> list[tuple[str, int]]:
         """End blocker: release matured unbonding entries.  Returns the
         (delegator, amount) payouts."""

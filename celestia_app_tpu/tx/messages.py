@@ -49,6 +49,9 @@ URL_MSG_WITHDRAW_VALIDATOR_COMMISSION = (
 URL_MSG_SET_WITHDRAW_ADDRESS = "/cosmos.distribution.v1beta1.MsgSetWithdrawAddress"
 URL_MSG_FUND_COMMUNITY_POOL = "/cosmos.distribution.v1beta1.MsgFundCommunityPool"
 URL_MSG_UNJAIL = "/cosmos.slashing.v1beta1.MsgUnjail"
+URL_MSG_CREATE_VALIDATOR = "/cosmos.staking.v1beta1.MsgCreateValidator"
+URL_MSG_EDIT_VALIDATOR = "/cosmos.staking.v1beta1.MsgEditValidator"
+URL_SECP256K1_PUBKEY_STR = "/cosmos.crypto.secp256k1.PubKey"
 URL_MSG_GRANT_ALLOWANCE = "/cosmos.feegrant.v1beta1.MsgGrantAllowance"
 URL_MSG_REVOKE_ALLOWANCE = "/cosmos.feegrant.v1beta1.MsgRevokeAllowance"
 URL_BASIC_ALLOWANCE = "/cosmos.feegrant.v1beta1.BasicAllowance"
@@ -814,6 +817,154 @@ MsgUndelegate = _staking_msg(URL_MSG_UNDELEGATE)
 MsgBeginRedelegate = _staking_msg(URL_MSG_BEGIN_REDELEGATE, has_dst=True)
 
 
+@dataclass(frozen=True)
+class MsgCreateValidator:
+    """cosmos.staking.v1beta1.MsgCreateValidator {description=1
+    {moniker=1}, commission=2 {rate=1, max_rate=2, max_change_rate=3 —
+    Dec strings}, min_self_delegation=3 (string), delegator_address=4,
+    validator_address=5, pubkey=6 (Any), value=7 (Coin)}."""
+
+    moniker: str
+    commission_rate: str  # Dec string, e.g. "0.100000000000000000"
+    delegator_address: str
+    validator_address: str
+    pubkey: bytes  # consensus pubkey bytes (secp256k1 compressed here)
+    value: Coin
+    min_self_delegation: int = 1
+    commission_max_rate: str = "1.000000000000000000"
+    commission_max_change_rate: str = "0.010000000000000000"
+
+    TYPE_URL = URL_MSG_CREATE_VALIDATOR
+
+    def marshal(self) -> bytes:
+        out = encode_bytes_field(1, encode_bytes_field(1, self.moniker.encode()))
+        out += encode_bytes_field(
+            2,
+            encode_bytes_field(1, self.commission_rate.encode())
+            + encode_bytes_field(2, self.commission_max_rate.encode())
+            + encode_bytes_field(3, self.commission_max_change_rate.encode()),
+        )
+        out += encode_bytes_field(3, str(self.min_self_delegation).encode())
+        out += encode_bytes_field(4, self.delegator_address.encode())
+        out += encode_bytes_field(5, self.validator_address.encode())
+        out += encode_bytes_field(
+            6,
+            Any(
+                URL_SECP256K1_PUBKEY_STR, encode_bytes_field(1, self.pubkey)
+            ).marshal(),
+        )
+        out += encode_bytes_field(7, self.value.marshal())
+        return out
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgCreateValidator":
+        f = {n: v for n, wt, v in decode_fields(raw) if wt == WIRE_LEN}
+        moniker = ""
+        for n, wt, v in decode_fields(f.get(1, b"")):
+            if n == 1 and wt == WIRE_LEN:
+                moniker = v.decode()
+        rates = {}
+        for n, wt, v in decode_fields(f.get(2, b"")):
+            if wt == WIRE_LEN:
+                rates[n] = v.decode()
+        pk = b""
+        if 6 in f:
+            a = Any.unmarshal(f[6])
+            if a.type_url != URL_SECP256K1_PUBKEY_STR:
+                raise ValueError(
+                    f"unsupported consensus pubkey type {a.type_url}"
+                )
+            for n, wt, v in decode_fields(a.value):
+                if n == 1 and wt == WIRE_LEN:
+                    pk = v
+        return cls(
+            moniker, rates.get(1, ""), f.get(4, b"").decode(),
+            f.get(5, b"").decode(), pk, Coin.unmarshal(f.get(7, b"")),
+            int(f.get(3, b"1").decode() or "1"),
+            rates.get(2, "1.000000000000000000"),
+            rates.get(3, "0.010000000000000000"),
+        )
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+    @property
+    def signer(self) -> str:
+        return self.delegator_address
+
+    def validate_basic(self) -> None:
+        from celestia_app_tpu.crypto.keys import validate_address
+        from celestia_app_tpu.state.dec import Dec
+
+        validate_address(self.delegator_address)
+        validate_address(self.validator_address)
+        if self.validator_address != self.delegator_address:
+            # The sdk derives the operator address from the signer's key;
+            # in this framework's single-address model that means they are
+            # literally equal — otherwise anyone could squat a validator
+            # record under an address they don't control.
+            raise ValueError(
+                "validator address must be the signer (operator = delegator)"
+            )
+        if not self.pubkey:
+            raise ValueError("validator needs a consensus pubkey")
+        if self.value.denom != "utia" or self.value.amount <= 0:
+            raise ValueError("self delegation must be positive utia")
+        if self.value.amount < self.min_self_delegation:
+            raise ValueError("self delegation below min_self_delegation")
+        rate = Dec.from_str(self.commission_rate or "0")
+        max_rate = Dec.from_str(self.commission_max_rate or "1")
+        if rate < Dec(0) or Dec.from_int(1) < rate:
+            raise ValueError("commission rate outside [0, 1]")
+        if max_rate < rate:
+            raise ValueError("commission rate exceeds its own max_rate")
+
+
+@dataclass(frozen=True)
+class MsgEditValidator:
+    """cosmos.staking.v1beta1.MsgEditValidator {description=1 {moniker=1},
+    validator_address=2, commission_rate=3 (Dec string, empty = keep)}."""
+
+    moniker: str
+    validator_address: str
+    commission_rate: str = ""
+
+    TYPE_URL = URL_MSG_EDIT_VALIDATOR
+
+    def marshal(self) -> bytes:
+        out = encode_bytes_field(1, encode_bytes_field(1, self.moniker.encode()))
+        out += encode_bytes_field(2, self.validator_address.encode())
+        if self.commission_rate:
+            out += encode_bytes_field(3, self.commission_rate.encode())
+        return out
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgEditValidator":
+        f = {n: v for n, wt, v in decode_fields(raw) if wt == WIRE_LEN}
+        moniker = ""
+        for n, wt, v in decode_fields(f.get(1, b"")):
+            if n == 1 and wt == WIRE_LEN:
+                moniker = v.decode()
+        return cls(moniker, f.get(2, b"").decode(), f.get(3, b"").decode())
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+    @property
+    def signer(self) -> str:
+        return self.validator_address
+
+    def validate_basic(self) -> None:
+        if not self.validator_address:
+            raise ValueError("validator address must not be empty")
+        if self.commission_rate:
+            from celestia_app_tpu.state.dec import Dec
+
+            rate = Dec.from_str(self.commission_rate)
+            if rate < Dec(0) or Dec.from_int(1) < rate:
+                raise ValueError("commission rate outside [0, 1]")
+
+
 def _two_addr_msg(url: str, name1: str, name2: str | None):
     """Two-string-field distribution messages (cosmos.distribution.v1beta1):
     MsgWithdrawDelegatorReward {delegator_address=1, validator_address=2},
@@ -1210,6 +1361,8 @@ class MsgAuthzRevoke:
 
 
 MSG_DECODERS = {
+    URL_MSG_CREATE_VALIDATOR: MsgCreateValidator.unmarshal,
+    URL_MSG_EDIT_VALIDATOR: MsgEditValidator.unmarshal,
     URL_MSG_GRANT_ALLOWANCE: MsgGrantAllowance.unmarshal,
     URL_MSG_REVOKE_ALLOWANCE: MsgRevokeAllowance.unmarshal,
     URL_MSG_AUTHZ_GRANT: MsgAuthzGrant.unmarshal,

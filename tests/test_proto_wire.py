@@ -228,6 +228,51 @@ class TestStakingWire:
             amount=pb["coin"].Coin(denom="utia", amount="9"),
         ).SerializeToString()
 
+    def test_create_edit_validator_msgs(self, pb):
+        import importlib
+
+        from google.protobuf import any_pb2
+
+        from celestia_app_tpu.tx.messages import (
+            Coin,
+            MsgCreateValidator,
+            MsgEditValidator,
+        )
+
+        staking = importlib.import_module("cosmos.staking.v1beta1.tx_pb2")
+        pk = b"\x02" * 33
+        ours = MsgCreateValidator(
+            "val-1", "0.100000000000000000", "celestia1del",
+            "celestiavaloper1x", pk, Coin("utia", 1_000_000),
+        )
+        ref = staking.MsgCreateValidator(
+            description=staking.Description(moniker="val-1"),
+            commission=staking.CommissionRates(
+                rate="0.100000000000000000",
+                max_rate="1.000000000000000000",
+                max_change_rate="0.010000000000000000",
+            ),
+            min_self_delegation="1",
+            delegator_address="celestia1del",
+            validator_address="celestiavaloper1x",
+            pubkey=any_pb2.Any(
+                type_url="/cosmos.crypto.secp256k1.PubKey",
+                value=b"\x0a\x21" + pk,
+            ),
+            value=pb["coin"].Coin(denom="utia", amount="1000000"),
+        )
+        assert ours.marshal() == ref.SerializeToString()
+        assert MsgCreateValidator.unmarshal(ref.SerializeToString()) == ours
+
+        e = MsgEditValidator("val-1", "celestiavaloper1x",
+                             "0.200000000000000000")
+        assert e.marshal() == staking.MsgEditValidator(
+            description=staking.Description(moniker="val-1"),
+            validator_address="celestiavaloper1x",
+            commission_rate="0.200000000000000000",
+        ).SerializeToString()
+        assert MsgEditValidator.unmarshal(e.marshal()) == e
+
 
 class TestDistributionWire:
     def test_distribution_msgs(self, pb):

@@ -223,3 +223,131 @@ class TestTxsimStake:
         assert stats["failed"] == 0, stats
         sk = StakingKeeper(node.app.cms.working)
         assert sum(sk.tokens(v.address) for v in sk.validators()) > 300 * POWER_REDUCTION
+
+
+class TestCreateValidator:
+    """Dynamic validator sets: MsgCreateValidator / MsgEditValidator
+    (cosmos-sdk x/staking msg surface beyond the txsim sequence)."""
+
+    def _chain(self):
+        from celestia_app_tpu.app import Genesis, GenesisAccount
+        from celestia_app_tpu.crypto import PrivateKey
+        from celestia_app_tpu.testutil.testnode import GENESIS_TIME_NS, TestNode as TN
+
+        keys = funded_keys(2)
+        accounts = tuple(
+            GenesisAccount(k.public_key().address(), 10**12, k.public_key().bytes)
+            for k in keys
+        )
+        vk = PrivateKey.from_seed(b"validator-0")
+        validators = (Validator(vk.public_key().address(),
+                                vk.public_key().bytes, 100),)
+        return TN(Genesis("cv-chain", GENESIS_TIME_NS, accounts, validators),
+                  keys), keys
+
+    def _submit(self, node, key, msg):
+        from celestia_app_tpu.state.accounts import AuthKeeper
+        from celestia_app_tpu.tx.sign import Fee, build_and_sign
+
+        acct = AuthKeeper(node.app.cms.working).get_account(
+            key.public_key().address()
+        )
+        raw = build_and_sign(
+            [msg], key, node.chain_id, acct.account_number, acct.sequence,
+            Fee((Coin("utia", 20_000),), 400_000),
+        )
+        res = node.broadcast(raw)
+        assert res.code == 0, res.log
+        _, results = node.produce_block()
+        return results[-1]
+
+    def test_create_validator_joins_bonded_set(self):
+        from celestia_app_tpu.crypto import PrivateKey
+        from celestia_app_tpu.modules.distribution import DistributionKeeper
+        from celestia_app_tpu.state.dec import Dec
+        from celestia_app_tpu.tx.messages import MsgCreateValidator
+
+        node, keys = self._chain()
+        operator = keys[0].public_key().address()
+        cons_key = PrivateKey.from_seed(b"new-val-cons")
+        res = self._submit(node, keys[0], MsgCreateValidator(
+            "newval", "0.100000000000000000", operator, operator,
+            cons_key.public_key().bytes,
+            Coin("utia", 50 * POWER_REDUCTION),
+        ))
+        assert res.code == 0, res.log
+        sk = StakingKeeper(node.app.cms.working)
+        assert sk.get_power(operator) == 50
+        assert {v.address for v in sk.bonded_validators()} >= {operator}
+        # Escrowed self-bond (NOT notional): the bonded pool backs it.
+        assert sk.delegation(operator, operator) == 50 * POWER_REDUCTION
+        dist = DistributionKeeper(node.app.cms.working)
+        assert dist.commission_rate(operator).raw == Dec.from_str("0.1").raw
+        # It earns rewards like any bonded validator; commission accrues.
+        node.produce_block()
+        node.produce_block()
+        assert dist.accrued_commission(operator).raw > 0
+        # Duplicate creation rejected.
+        res = self._submit(node, keys[0], MsgCreateValidator(
+            "again", "0", operator, operator,
+            cons_key.public_key().bytes, Coin("utia", 1_000_000),
+        ))
+        assert res.code != 0
+        assert "already exists" in res.log
+
+    def test_edit_validator_commission(self):
+        from celestia_app_tpu.crypto import PrivateKey
+        from celestia_app_tpu.modules.distribution import DistributionKeeper
+        from celestia_app_tpu.state.dec import Dec
+        from celestia_app_tpu.tx.messages import MsgCreateValidator, MsgEditValidator
+
+        node, keys = self._chain()
+        operator = keys[0].public_key().address()
+        self._submit(node, keys[0], MsgCreateValidator(
+            "v", "0", operator, operator,
+            PrivateKey.from_seed(b"nv").public_key().bytes,
+            Coin("utia", 10 * POWER_REDUCTION),
+        ))
+        res = self._submit(node, keys[0], MsgEditValidator(
+            "v", operator, "0.250000000000000000"
+        ))
+        assert res.code == 0, res.log
+        assert DistributionKeeper(node.app.cms.working).commission_rate(
+            operator
+        ).raw == Dec.from_str("0.25").raw
+        # Invariants still hold with the new escrow-backed validator.
+        from celestia_app_tpu.modules.crisis import assert_invariants
+
+        assert_invariants(node.app.cms.working)
+
+    def test_squat_and_shared_pubkey_rejected(self):
+        from celestia_app_tpu.crypto import PrivateKey
+        from celestia_app_tpu.tx.messages import MsgCreateValidator
+
+        node, keys = self._chain()
+        op0 = keys[0].public_key().address()
+        op1 = keys[1].public_key().address()
+        # validator_address must BE the signer: squatting rejected at
+        # CheckTx (validate_basic).
+        from celestia_app_tpu.state.accounts import AuthKeeper
+        from celestia_app_tpu.tx.sign import Fee, build_and_sign
+
+        acct = AuthKeeper(node.app.cms.working).get_account(op0)
+        raw = build_and_sign(
+            [MsgCreateValidator("sq", "0", op0, op1,
+                                PrivateKey.from_seed(b"x").public_key().bytes,
+                                Coin("utia", 10**6))],
+            keys[0], node.chain_id, acct.account_number, acct.sequence,
+            Fee((Coin("utia", 20_000),), 400_000),
+        )
+        res = node.broadcast(raw)
+        assert res.code != 0
+        assert "must be the signer" in res.log
+        # One consensus key, one validator: reusing the genesis
+        # validator's pubkey is rejected.
+        genesis_pk = PrivateKey.from_seed(b"validator-0").public_key().bytes
+        res = self._submit(node, keys[0], MsgCreateValidator(
+            "dup", "0", op0, op0, genesis_pk, Coin("utia", 10**6),
+        ))
+        assert res.code != 0
+        assert "pubkey already used" in res.log
