@@ -617,35 +617,42 @@ class App:
         if isinstance(msg, (MsgTransfer, MsgRecvPacket, MsgAcknowledgement, MsgTimeout)):
             return self._handle_ibc_msg(ctx, msg)
         if isinstance(msg, (MsgCreateValidator, MsgEditValidator)):
-            from celestia_app_tpu.modules.distribution import DistributionKeeper
+            from celestia_app_tpu.modules.distribution import (
+                DistributionError,
+                DistributionKeeper,
+            )
             from celestia_app_tpu.state.dec import Dec as _Dec
             from celestia_app_tpu.state.staking import StakingError
 
             dist = DistributionKeeper(ctx.store)
             try:
                 if isinstance(msg, MsgCreateValidator):
-                    # Same vesting bookkeeping as MsgDelegate: a self-bond
-                    # consumes locked tokens first (sdk TrackDelegation).
-                    acc = ctx.auth.get_account(msg.delegator_address)
-                    if acc is not None and acc.vesting_type:
-                        acc.track_delegation(msg.value.amount, ctx.time_ns)
-                        ctx.auth.set_account(acc)
+                    self._track_vesting_delegation(
+                        ctx, msg.delegator_address, msg.value.amount
+                    )
                     ctx.staking.create_validator(
                         ctx.bank, dist, msg.validator_address, msg.pubkey,
                         msg.delegator_address, msg.value.amount,
                         _Dec.from_str(msg.commission_rate or "0").raw,
+                        msg.min_self_delegation,
+                    )
+                    # The bounds the operator declared bind every later edit.
+                    dist.set_commission_bounds(
+                        msg.validator_address,
+                        _Dec.from_str(msg.commission_max_rate or "1"),
+                        _Dec.from_str(msg.commission_max_change_rate or "1"),
                     )
                     return 0, [("cosmos.staking.v1beta1.EventCreateValidator",
                                 msg.validator_address, msg.value.amount)]
                 if not ctx.staking.has_validator(msg.validator_address):
                     raise ValueError(f"no validator {msg.validator_address}")
                 if msg.commission_rate:
-                    dist.set_commission_rate(
+                    dist.change_commission_rate(
                         msg.validator_address, _Dec.from_str(msg.commission_rate)
                     )
                 return 0, [("cosmos.staking.v1beta1.EventEditValidator",
                             msg.validator_address)]
-            except StakingError as e:
+            except (StakingError, DistributionError) as e:
                 raise ValueError(str(e)) from e
         if isinstance(msg, (MsgDelegate, MsgUndelegate, MsgBeginRedelegate)):
             if msg.amount.denom != "utia":  # x/staking ErrBadDenom
@@ -664,14 +671,7 @@ class App:
                     ctx.staking, msg.delegator_address, msg.validator_dst_address
                 )
             if isinstance(msg, MsgDelegate):
-                # Vesting bookkeeping BEFORE the escrow moves: delegations
-                # consume locked tokens first (sdk TrackDelegation), so a
-                # vesting account's later-received liquid funds stay
-                # spendable.
-                acc = ctx.auth.get_account(msg.delegator_address)
-                if acc is not None and acc.vesting_type:
-                    acc.track_delegation(amount, ctx.time_ns)
-                    ctx.auth.set_account(acc)
+                self._track_vesting_delegation(ctx, msg.delegator_address, amount)
                 ctx.staking.delegate(
                     ctx.bank, msg.delegator_address, msg.validator_address, amount
                 )
@@ -687,6 +687,19 @@ class App:
                     ctx.bank, msg.delegator_address, msg.validator_address,
                     amount, ctx.time_ns,
                 )
+                # An operator undelegating below its declared
+                # min_self_delegation is jailed (sdk Undelegate's
+                # jailValidator path): no skin in the game, no vote.
+                min_self = ctx.staking.min_self_delegation(msg.validator_address)
+                if (
+                    msg.delegator_address == msg.validator_address
+                    and min_self
+                    and ctx.staking.delegation(
+                        msg.delegator_address, msg.validator_address
+                    ) < min_self
+                    and not ctx.staking.is_jailed(msg.validator_address)
+                ):
+                    ctx.staking.jail(msg.validator_address)
                 return 0, [("cosmos.staking.v1beta1.EventUnbond",
                             msg.validator_address, amount, completion)]
             ctx.staking.begin_redelegate(
@@ -790,6 +803,17 @@ class App:
             gov.deposit(msg.proposal_id, msg.depositor, deposit, ctx.time_ns)
             return 0, [("cosmos.gov.v1beta1.EventDeposit", msg.proposal_id, deposit)]
         raise ValueError(f"no handler for {type(msg).__name__}")
+
+    @staticmethod
+    def _track_vesting_delegation(ctx: Ctx, delegator: str, amount: int) -> None:
+        """Vesting bookkeeping BEFORE a staking escrow moves: delegations
+        (incl. a create-validator self-bond) consume locked tokens first
+        (sdk TrackDelegation), so a vesting account's later-received
+        liquid funds stay spendable."""
+        acc = ctx.auth.get_account(delegator)
+        if acc is not None and acc.vesting_type:
+            acc.track_delegation(amount, ctx.time_ns)
+            ctx.auth.set_account(acc)
 
     def _handle_authz_exec(self, ctx: Ctx, msg, gas_remaining: int):
         """MsgExec (sdk authz DispatchActions): each inner msg's signer is

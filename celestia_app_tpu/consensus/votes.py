@@ -73,10 +73,16 @@ class Vote:
     @classmethod
     def sign(
         cls, key: PrivateKey, chain_id: str, height: int, vote_type: int,
-        block_hash: bytes,
+        block_hash: bytes, validator: str | None = None,
     ) -> "Vote":
+        """`validator` is the OPERATOR address this vote speaks for; it
+        defaults to the key's own derived address (genesis validators),
+        but a validator created via MsgCreateValidator has an operator
+        address distinct from its consensus key's — such nodes pass it
+        explicitly.  Verification is by the registered pubkey either way."""
         return cls(
-            height, vote_type, block_hash, key.public_key().address(),
+            height, vote_type, block_hash,
+            validator if validator is not None else key.public_key().address(),
             key.sign(vote_sign_bytes(chain_id, height, vote_type, block_hash)),
         )
 

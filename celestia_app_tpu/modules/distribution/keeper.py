@@ -85,6 +85,41 @@ class DistributionKeeper:
     def accrued_commission(self, validator: str) -> Dec:
         return self._get_dec(_COMM_PREFIX + validator.encode())
 
+    # Commission bounds declared at creation (sdk CommissionRates): the
+    # operator's own promise to delegators, enforced on every edit.
+    def set_commission_bounds(
+        self, validator: str, max_rate: Dec, max_change_rate: Dec
+    ) -> None:
+        self.store.set(
+            _COMM_RATE_PREFIX + validator.encode() + b"/bounds",
+            f"{max_rate.raw}|{max_change_rate.raw}".encode(),
+        )
+
+    def commission_bounds(self, validator: str) -> tuple[Dec, Dec]:
+        """(max_rate, max_change_rate); unlimited for validators that
+        never declared bounds (genesis validators)."""
+        raw = self.store.get(_COMM_RATE_PREFIX + validator.encode() + b"/bounds")
+        if raw is None:
+            return Dec.from_int(1), Dec.from_int(1)
+        a, b = raw.decode().split("|")
+        return Dec(int(a)), Dec(int(b))
+
+    def change_commission_rate(self, validator: str, new_rate: Dec) -> None:
+        """MsgEditValidator's rate change, against the declared bounds
+        (sdk ErrCommissionGTMaxRate / max-change-rate checks)."""
+        max_rate, max_change = self.commission_bounds(validator)
+        if max_rate < new_rate:
+            raise DistributionError(
+                f"commission rate {new_rate} exceeds declared max {max_rate}"
+            )
+        old = self.commission_rate(validator)
+        delta = Dec(abs(new_rate.raw - old.raw))
+        if max_change < delta:
+            raise DistributionError(
+                f"commission change {delta} exceeds max change rate {max_change}"
+            )
+        self.set_commission_rate(validator, new_rate)
+
     # --- community pool -----------------------------------------------------
     def community_pool(self) -> Dec:
         return self._get_dec(_COMMUNITY_KEY)

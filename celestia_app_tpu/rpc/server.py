@@ -165,8 +165,30 @@ class ServingNode(TestNode):
         from celestia_app_tpu.consensus import Vote
 
         return Vote.sign(
-            self.validator_key, self.chain_id, height, vote_type, block_hash
+            self.validator_key, self.chain_id, height, vote_type, block_hash,
+            validator=self._operator_address(),
         )
+
+    def _operator_address(self) -> str:
+        """The bonded validator this node's consensus key speaks for.
+        Genesis validators' operator address IS the key's address; a
+        validator created via MsgCreateValidator registers the consensus
+        pubkey under the operator's account address instead.  Cached per
+        committed height — votes are signed twice per round and the
+        valset only moves when a block commits."""
+        cached = getattr(self, "_operator_cache", None)
+        if cached is not None and cached[0] == self.app.height:
+            return cached[1]
+        from celestia_app_tpu.state.staking import StakingKeeper
+
+        own = self.validator_key.public_key()
+        addr = own.address()  # not (yet) a validator: vote as itself
+        for v in StakingKeeper(self.app.cms.working).bonded_validators():
+            if v.pubkey == own.bytes:
+                addr = v.address
+                break
+        self._operator_cache = (self.app.height, addr)
+        return addr
 
     def _commit_block_data(
         self,

@@ -281,9 +281,19 @@ class StakingKeeper:
         self._set_delegation(delegator, dst, self.delegation(delegator, dst) + amount)
         self._set_tokens(dst, self.tokens(dst) + amount)
 
+    def min_self_delegation(self, validator: str) -> int:
+        raw = self.store.get(b"staking/minself/" + validator.encode())
+        return int(raw.decode()) if raw else 0
+
+    def _set_min_self_delegation(self, validator: str, amount: int) -> None:
+        self.store.set(
+            b"staking/minself/" + validator.encode(), str(amount).encode()
+        )
+
     def create_validator(
         self, bank, dist, operator: str, pubkey: bytes,
         delegator: str, self_stake: int, commission_rate_raw: int = 0,
+        min_self_delegation: int = 0,
     ) -> None:
         """MsgCreateValidator: a NEW validator joins with an escrowed
         self-delegation (unlike genesis validators' notional power).  The
@@ -308,6 +318,8 @@ class StakingKeeper:
             from celestia_app_tpu.state.dec import Dec
 
             dist.set_commission_rate(operator, Dec(commission_rate_raw))
+        if min_self_delegation:
+            self._set_min_self_delegation(operator, min_self_delegation)
         self.delegate(bank, delegator, operator, self_stake)
 
     def complete_unbondings(self, bank, time_ns: int) -> list[tuple[str, int]]:

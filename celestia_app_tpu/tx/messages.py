@@ -837,7 +837,12 @@ class MsgCreateValidator:
     TYPE_URL = URL_MSG_CREATE_VALIDATOR
 
     def marshal(self) -> bytes:
-        out = encode_bytes_field(1, encode_bytes_field(1, self.moniker.encode()))
+        # proto3 canonical form: an empty Description submessage still
+        # appears (field presence), but its empty moniker string does not.
+        out = encode_bytes_field(
+            1,
+            encode_bytes_field(1, self.moniker.encode()) if self.moniker else b"",
+        )
         out += encode_bytes_field(
             2,
             encode_bytes_field(1, self.commission_rate.encode())
@@ -932,7 +937,10 @@ class MsgEditValidator:
     TYPE_URL = URL_MSG_EDIT_VALIDATOR
 
     def marshal(self) -> bytes:
-        out = encode_bytes_field(1, encode_bytes_field(1, self.moniker.encode()))
+        out = encode_bytes_field(
+            1,
+            encode_bytes_field(1, self.moniker.encode()) if self.moniker else b"",
+        )
         out += encode_bytes_field(2, self.validator_address.encode())
         if self.commission_rate:
             out += encode_bytes_field(3, self.commission_rate.encode())

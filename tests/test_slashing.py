@@ -284,3 +284,87 @@ class TestServingPlaneLiveness:
         finally:
             s0.stop()
             s1.stop()
+
+
+class TestValidatorJoinsLiveDevnet:
+    def test_created_validator_votes_in_consensus(self):
+        """The full dynamic-valset loop over sockets: a tx creates a new
+        validator on a live devnet, a node holding that consensus key
+        joins via state sync, and its precommits start counting toward
+        the +2/3 quorum (LastCommitInfo picks it up too)."""
+        from celestia_app_tpu.crypto import PrivateKey
+        from celestia_app_tpu.rpc.devnet import serve
+        from celestia_app_tpu.rpc.server import ServingNode
+        from celestia_app_tpu.state.accounts import AuthKeeper
+        from celestia_app_tpu.state.staking import StakingKeeper
+        from celestia_app_tpu.testutil import deterministic_genesis, funded_keys
+        from celestia_app_tpu.tx.messages import Coin, MsgCreateValidator
+        from celestia_app_tpu.tx.sign import Fee, build_and_sign
+
+        keys = funded_keys(2)
+        genesis = deterministic_genesis(keys, n_validators=2)
+        v0 = ServingNode(genesis=genesis, keys=keys, validator_index=0,
+                         n_validators=2, snapshot_interval=2)
+        s0 = serve(v0, port=0, block_interval_s=None)
+        v1 = ServingNode(genesis=genesis, keys=keys, validator_index=1,
+                         n_validators=2, peers=[s0.url])
+        s1 = serve(v1, port=0, block_interval_s=None)
+        v0.peer_urls = [s1.url]
+        servers = [s0, s1]
+        try:
+            # The joining operator: account keys[0], fresh consensus key.
+            new_cons = PrivateKey.from_seed(b"joiner-consensus")
+            operator = keys[0].public_key().address()
+            acct = AuthKeeper(v0.app.cms.working).get_account(operator)
+            raw = build_and_sign(
+                [MsgCreateValidator(
+                    "joiner", "0.100000000000000000", operator, operator,
+                    new_cons.public_key().bytes,
+                    # 50 power on a 100+100 valset: the two live genesis
+                    # validators keep +2/3 (200/250) until the new node
+                    # joins and starts voting.
+                    Coin("utia", 50 * POWER_REDUCTION),
+                )],
+                keys[0], v0.chain_id, acct.account_number, acct.sequence,
+                Fee((Coin("utia", 20_000),), 400_000),
+            )
+            assert v0.broadcast(raw).code == 0
+            v0.produce_block()
+            v0.produce_block()  # snapshot lands (interval 2)
+            v0.produce_block()  # commit at snapshot+1: the sync trust link
+            sk = StakingKeeper(v0.app.cms.working)
+            assert sk.get_power(operator) == 50
+
+            # Node 3 joins with the new validator's consensus key.
+            v2 = ServingNode(
+                genesis=genesis, keys=keys, validator_index=2,
+                n_validators=3, validator_key=new_cons,
+            )
+            v2.state_sync_from(s0.url)
+            s2 = serve(v2, port=0, block_interval_s=None)
+            servers.append(s2)
+            v0.peer_urls = [s1.url, s2.url]
+            v0._peers = []
+            v2.peer_urls = [s0.url, s1.url]
+
+            data, _ = v0.produce_block()
+            # The new validator's precommit is in the commit record...
+            commit = v0._commits[v0.app.height]
+            assert operator in {v.validator for v in commit.precommits}
+            # ...and the NEXT blocks' LastCommitInfo credit its liveness:
+            # it MISSED the blocks between creation and its node joining,
+            # and stops missing once its precommits land.
+            v0.produce_block()
+            info1 = SlashingKeeper(v0.app.cms.working).signing_info(operator)
+            assert info1.index_offset >= 3
+            assert info1.missed_blocks >= 1  # the pre-join gap
+            v0.produce_block()
+            info2 = SlashingKeeper(v0.app.cms.working).signing_info(operator)
+            assert info2.index_offset == info1.index_offset + 1
+            assert info2.missed_blocks <= info1.missed_blocks  # no new misses
+            # All three replicas agree.
+            assert (v0.app.cms.last_app_hash == v1.app.cms.last_app_hash
+                    == v2.app.cms.last_app_hash)
+        finally:
+            for s in servers:
+                s.stop()

@@ -307,6 +307,8 @@ class TestCreateValidator:
             "v", "0", operator, operator,
             PrivateKey.from_seed(b"nv").public_key().bytes,
             Coin("utia", 10 * POWER_REDUCTION),
+            commission_max_rate="0.300000000000000000",
+            commission_max_change_rate="0.300000000000000000",
         ))
         res = self._submit(node, keys[0], MsgEditValidator(
             "v", operator, "0.250000000000000000"
@@ -315,6 +317,18 @@ class TestCreateValidator:
         assert DistributionKeeper(node.app.cms.working).commission_rate(
             operator
         ).raw == Dec.from_str("0.25").raw
+        # The bounds declared at creation bind every edit (sdk
+        # ErrCommissionGTMaxRate / max-change-rate): raising past the
+        # declared max, or jumping more than max_change, both fail.
+        res = self._submit(node, keys[0], MsgEditValidator(
+            "v", operator, "0.290000000000000000"
+        ))
+        assert res.code == 0, res.log  # within both bounds
+        res = self._submit(node, keys[0], MsgEditValidator(
+            "v", operator, "0.310000000000000000"
+        ))
+        assert res.code != 0
+        assert "exceeds declared max" in res.log
         # Invariants still hold with the new escrow-backed validator.
         from celestia_app_tpu.modules.crisis import assert_invariants
 
@@ -351,3 +365,28 @@ class TestCreateValidator:
         ))
         assert res.code != 0
         assert "pubkey already used" in res.log
+
+    def test_undelegating_below_min_self_delegation_jails(self):
+        from celestia_app_tpu.crypto import PrivateKey
+        from celestia_app_tpu.tx.messages import MsgCreateValidator, MsgUndelegate
+
+        node, keys = self._chain()
+        operator = keys[0].public_key().address()
+        self._submit(node, keys[0], MsgCreateValidator(
+            "v", "0", operator, operator,
+            PrivateKey.from_seed(b"nv2").public_key().bytes,
+            Coin("utia", 10 * POWER_REDUCTION),
+            min_self_delegation=5 * POWER_REDUCTION,
+        ))
+        sk = StakingKeeper(node.app.cms.working)
+        assert sk.min_self_delegation(operator) == 5 * POWER_REDUCTION
+        # Dropping to 6 TIA stays above the floor: still bonded.
+        self._submit(node, keys[0], MsgUndelegate(
+            operator, operator, Coin("utia", 4 * POWER_REDUCTION)
+        ))
+        assert not StakingKeeper(node.app.cms.working).is_jailed(operator)
+        # Dropping below the declared floor jails (sdk Undelegate).
+        self._submit(node, keys[0], MsgUndelegate(
+            operator, operator, Coin("utia", 2 * POWER_REDUCTION)
+        ))
+        assert StakingKeeper(node.app.cms.working).is_jailed(operator)
