@@ -266,10 +266,6 @@ class ChannelKeeper:
         AcknowledgePacket/TimeoutPacket make the same check for the same
         reason."""
         chan = self.channel(packet.source_port, packet.source_channel)
-        if chan.state != "OPEN":
-            raise IBCError(
-                f"channel {packet.source_channel} is {chan.state}, not OPEN"
-            )
         if (
             chan.counterparty_port != packet.destination_port
             or chan.counterparty_channel_id != packet.destination_channel
@@ -279,9 +275,9 @@ class ChannelKeeper:
                 f"{packet.destination_channel} is not channel "
                 f"{packet.source_channel}'s counterparty"
             )
+        return chan
 
-    def acknowledge_packet(self, packet: Packet) -> None:
-        self._check_counterparty_routing(packet)
+    def _delete_commitment(self, packet: Packet) -> None:
         key = _chan_key(
             b"commit", packet.source_port, packet.source_channel, packet.sequence
         )
@@ -295,10 +291,22 @@ class ChannelKeeper:
             raise IBCError("packet commitment mismatch")
         self.store.delete(key)
 
+    def acknowledge_packet(self, packet: Packet) -> None:
+        chan = self._check_counterparty_routing(packet)
+        if chan.state != "OPEN":
+            raise IBCError(
+                f"channel {packet.source_channel} is {chan.state}, not OPEN"
+            )
+        self._delete_commitment(packet)
+
     def timeout_packet(self, packet: Packet, proof_height: int, proof_time_ns: int) -> None:
         """TimeoutPacket: the packet must actually be past its timeout as
         observed on the counterparty (height/time supplied by the relayer's
-        proof in the reference; trusted here)."""
+        proof in the reference; trusted here).  NO channel-state check:
+        in-flight packets on a CLOSED channel must still flush through
+        timeouts (ibc-go TimeoutPacket works on any state so escrows can
+        refund after a close)."""
+        self._check_counterparty_routing(packet)
         timed_out = (
             not packet.timeout_height.is_zero()
             and proof_height >= packet.timeout_height.revision_height
@@ -308,4 +316,4 @@ class ChannelKeeper:
         )
         if not timed_out:
             raise IBCError("packet has not timed out yet")
-        self.acknowledge_packet(packet)  # same commitment check + delete
+        self._delete_commitment(packet)

@@ -309,6 +309,73 @@ class ChannelHandshake:
         if self.store.get(key) is None:
             self.store.set(key, (1).to_bytes(8, "big"))
 
+    # --- closing (ChanCloseInit / ChanCloseConfirm) -------------------------
+    @staticmethod
+    def _user_close_forbidden(port: str) -> str | None:
+        """ibc-go app-module OnChanCloseInit parity: ICS-20 refuses
+        (escrowed funds must stay redeemable) and BOTH ICA sides refuse
+        (ICA channels close only through the ordered-channel timeout
+        path, never by users)."""
+        from celestia_app_tpu.modules.ibc.ica import (
+            CONTROLLER_PORT_PREFIX,
+            ICA_HOST_PORT,
+        )
+        from celestia_app_tpu.modules.ibc.transfer import TRANSFER_PORT
+
+        if port == TRANSFER_PORT:
+            return (
+                "transfer channels cannot be closed by users "
+                "(ics20 OnChanCloseInit)"
+            )
+        if port == ICA_HOST_PORT or port.startswith(CONTROLLER_PORT_PREFIX):
+            return (
+                "interchain-account channels cannot be closed by users "
+                "(ica OnChanCloseInit; they close via the timeout path)"
+            )
+        return None
+
+    def close_init(self, port: str, channel_id: str) -> None:
+        """ChanCloseInit: the local end goes CLOSED (only for app ports
+        whose module allows user-initiated closes)."""
+        chan = self._get(port, channel_id)
+        if chan.state != "OPEN":
+            raise IBCError(
+                f"channel {channel_id} is {chan.state}, expected OPEN"
+            )
+        refusal = self._user_close_forbidden(port)
+        if refusal is not None:
+            raise IBCError(refusal)
+        self._save(replace(chan, state="CLOSED"))
+
+    def close_confirm(
+        self, port: str, channel_id: str, proof_init, proof_height: int
+    ) -> None:
+        """ChanCloseConfirm: close the local end after PROVING the
+        counterparty already closed (connection-backed channels only).
+        In-flight packets still flush: timeout_packet works on CLOSED
+        channels (core.py), so escrows refund after a close."""
+        chan = self._get(port, channel_id)
+        if chan.state == "CLOSED":
+            return  # idempotent
+        if not chan.connection_id:
+            raise IBCError(
+                "close-confirm needs a connection-backed channel "
+                "(direct-OPEN test channels close via close_init on both "
+                "ends)"
+            )
+        end = self.connections.connection(chan.connection_id)
+        expected = Channel(
+            chan.counterparty_port, chan.counterparty_channel_id, port,
+            channel_id, state="CLOSED", version=chan.version,
+            connection_id=end.counterparty_connection_id,
+        )
+        self.connections.clients.verify_membership(
+            end.client_id, proof_height,
+            channel_key(chan.counterparty_port, chan.counterparty_channel_id),
+            expected.marshal(), proof_init,
+        )
+        self._save(replace(chan, state="CLOSED"))
+
 
 # --- packet-proof verification (the relay msgs' proof path) -----------------
 

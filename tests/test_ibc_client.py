@@ -278,3 +278,106 @@ class TestHalfOpenChannel:
         )
         with pytest.raises(IBCError, match="TRYOPEN, not OPEN"):
             ChannelKeeper(b.store).recv_packet(packet, 1, 0)
+
+
+class TestChannelClose:
+    def _open_custom_channel(self, chains):
+        """A connection + 'misc'-port channel pair via the proof-verified
+        handshake (a port whose app allows user closes)."""
+        from celestia_app_tpu.modules.ibc.handshake import (
+            ChannelHandshake,
+            ConnectionKeeper,
+            channel_key,
+            connection_key,
+        )
+
+        a, b = chains.a, chains.b
+        conn_a = ConnectionKeeper(a.store).open_init(
+            chains.client_on_a, chains.client_on_b
+        )
+        h = chains.sync(a, b)
+        conn_b = ConnectionKeeper(b.store).open_try(
+            chains.client_on_b, conn_a, chains.client_on_a,
+            a.proof_at(connection_key(conn_a), h), h,
+        )
+        h = chains.sync(b, a)
+        ConnectionKeeper(a.store).open_ack(
+            conn_a, conn_b, b.proof_at(connection_key(conn_b), h), h
+        )
+        h = chains.sync(a, b)
+        ConnectionKeeper(b.store).open_confirm(
+            conn_b, a.proof_at(connection_key(conn_a), h), h
+        )
+        chan_a = ChannelHandshake(a.store).open_init(conn_a, "misc", "misc")
+        h = chains.sync(a, b)
+        chan_b = ChannelHandshake(b.store).open_try(
+            conn_b, "misc", "misc", chan_a,
+            a.proof_at(channel_key("misc", chan_a), h), h,
+        )
+        h = chains.sync(b, a)
+        ChannelHandshake(a.store).open_ack(
+            "misc", chan_a, chan_b,
+            b.proof_at(channel_key("misc", chan_b), h), h,
+        )
+        h = chains.sync(a, b)
+        ChannelHandshake(b.store).open_confirm(
+            "misc", chan_b, a.proof_at(channel_key("misc", chan_a), h), h
+        )
+        return chan_a, chan_b
+
+    def test_close_handshake_over_proofs(self):
+        from celestia_app_tpu.modules.ibc import ChannelKeeper
+        from celestia_app_tpu.modules.ibc.core import Height, Packet
+        from celestia_app_tpu.modules.ibc.handshake import (
+            ChannelHandshake,
+            channel_key,
+        )
+
+        chains = VerifiedChains()
+        a, b = chains.a, chains.b
+        chan_a, chan_b = self._open_custom_channel(chains)
+        # An in-flight packet sent BEFORE the close...
+        packet = ChannelKeeper(a.store).send_packet(
+            "misc", chan_a, b"payload", timeout_height=Height(0, 10**6)
+        )
+        # ...then a closes, b proof-confirms.
+        ChannelHandshake(a.store).close_init("misc", chan_a)
+        h = chains.sync(a, b)
+        ChannelHandshake(b.store).close_confirm(
+            "misc", chan_b, a.proof_at(channel_key("misc", chan_a), h), h
+        )
+        assert ChannelKeeper(b.store).channel("misc", chan_b).state == "CLOSED"
+        # Packets are refused on the closed end...
+        with pytest.raises(IBCError, match="CLOSED, not OPEN"):
+            ChannelKeeper(b.store).recv_packet(packet, 1, 0)
+        # ...but the sender can still TIMEOUT the stranded in-flight packet
+        # (ibc-go allows timeouts on closed channels so escrows flush).
+        ChannelKeeper(a.store).timeout_packet(packet, 10**6 + 1, 0)
+        assert ChannelKeeper(a.store).packet_commitment(
+            "misc", chan_a, packet.sequence
+        ) is None
+
+    def test_protected_ports_refuse_user_close(self):
+        from celestia_app_tpu.modules.ibc import Channel, ChannelKeeper
+        from celestia_app_tpu.modules.ibc.handshake import ChannelHandshake
+        from celestia_app_tpu.modules.ibc.ica import (
+            CONTROLLER_PORT_PREFIX,
+            ICA_HOST_PORT,
+        )
+
+        chains = VerifiedChains()
+        chains.handshake()  # opens a transfer channel pair
+        with pytest.raises(IBCError, match="cannot be closed"):
+            ChannelHandshake(chains.a.store).close_init(
+                TRANSFER_PORT, chains.a.channel_id
+            )
+        # Both ICA sides refuse too (ibc-go ica OnChanCloseInit).
+        owner = CONTROLLER_PORT_PREFIX + "alice"
+        for port, cp in ((ICA_HOST_PORT, owner), (owner, ICA_HOST_PORT)):
+            ChannelKeeper(chains.a.store).create_channel(Channel(
+                port, f"channel-{port}", cp, "channel-x", version="ics27-1",
+            ))
+            with pytest.raises(IBCError, match="interchain-account"):
+                ChannelHandshake(chains.a.store).close_init(
+                    port, f"channel-{port}"
+                )
