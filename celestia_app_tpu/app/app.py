@@ -503,6 +503,7 @@ class App:
                     slashing.handle_equivocation(
                         ctx.staking, ctx.bank, dist,
                         self.chain_id, ev.vote_a, ev.vote_b,
+                        current_height=ctx.height,
                     )
                 except ValueError:
                     continue  # invalid evidence is dropped, not fatal
@@ -706,6 +707,19 @@ class App:
                 msg.delegator_address, msg.validator_address,
                 msg.validator_dst_address, amount,
             )
+            # Same skin-in-the-game rule as the undelegate path: an operator
+            # redelegating its self-bond below min_self_delegation is jailed
+            # (sdk BeginRedelegate jails the source validator too).
+            min_self = ctx.staking.min_self_delegation(msg.validator_address)
+            if (
+                msg.delegator_address == msg.validator_address
+                and min_self
+                and ctx.staking.delegation(
+                    msg.delegator_address, msg.validator_address
+                ) < min_self
+                and not ctx.staking.is_jailed(msg.validator_address)
+            ):
+                ctx.staking.jail(msg.validator_address)
             return 0, [("cosmos.staking.v1beta1.EventRedelegate",
                         msg.validator_address, msg.validator_dst_address, amount)]
         if isinstance(msg, MsgUnjail):

@@ -3,15 +3,12 @@
 The reference's consensus (celestia-core, Tendermint v0.34) gossips votes
 over p2p; a block commits only with >2/3 of validator power precommitting
 its block id, and the resulting Commit is what light clients verify.  This
-module carries that vote layer for the serving plane's replication
-(rpc/server.py): one voting round per height — proposal -> prevotes ->
-commit -> precommits -> queryable Commit record — with Tendermint's
->2/3-power rule and per-vote secp256k1 signatures over domain-separated
-sign bytes.
-
-Honest scope (PARITY.md): single round per height, no round changes, nil
-votes, locking, or evidence; the proposer drives the round rather than a
-gossip mesh.
+module carries that vote layer: votes are (height, round, type, block id)
+with per-vote secp256k1 signatures over domain-separated sign bytes; a
+nil vote is block_hash == b"" (Tendermint's nil prevote/precommit).  The
+multi-round state machine (round changes, polka locking, proposer
+rotation) lives in consensus/machine.py; VoteSet here is the
+single-target tally the commit-verification path uses.
 """
 
 from __future__ import annotations
@@ -36,29 +33,43 @@ class ConsensusError(RuntimeError):
     pass
 
 
-def block_id(data_root: bytes, prev_app_hash: bytes) -> bytes:
-    """What votes commit to: the block's data root AND the app hash the
+def block_id(data_root: bytes, prev_app_hash: bytes, time_ns: int = 0) -> bytes:
+    """What votes commit to: the block's data root, the app hash the
     proposer executed from (Tendermint's header chains the previous app
-    hash the same way).  Two consequences: diverged state shows up as a
-    different block id BEFORE anyone commits, and a Commit at height H+1
-    attests height H's app hash — the trust anchor state sync verifies a
-    restored snapshot against."""
+    hash the same way), and the block time.  Three consequences: diverged
+    state shows up as a different block id BEFORE anyone commits; a Commit
+    at height H+1 attests height H's app hash — the trust anchor state
+    sync verifies a restored snapshot against; and the block time is
+    +2/3-attested, so IBC timestamp timeouts verify against a committed
+    consensus timestamp instead of anyone's local clock (Tendermint
+    headers carry Time inside the signed header for the same reason)."""
     import hashlib
 
     return hashlib.sha256(
         b"celestia-tpu/block" + data_root + prev_app_hash
+        + time_ns.to_bytes(12, "big")
     ).digest()
 
 
-def vote_sign_bytes(chain_id: str, height: int, vote_type: int, block_hash: bytes) -> bytes:
+#: A nil vote's block hash (Tendermint's nil prevote/precommit).
+NIL = b""
+
+
+def vote_sign_bytes(
+    chain_id: str, height: int, vote_type: int, block_hash: bytes,
+    round: int = 0,
+) -> bytes:
     """Canonical vote sign bytes (the CanonicalVote analog): chain-id
-    domain separation so votes can never be replayed across chains."""
+    domain separation so votes can never be replayed across chains; the
+    round is signed so a round-r vote can never be replayed as round-r'
+    (CanonicalVote carries Round the same way)."""
     return (
         encode_bytes_field(1, b"celestia-tpu/vote")
         + encode_bytes_field(2, chain_id.encode())
         + encode_varint_field(3, height)
         + encode_varint_field(4, vote_type)
         + encode_bytes_field(5, block_hash)
+        + encode_varint_field(6, round)
     )
 
 
@@ -66,14 +77,19 @@ def vote_sign_bytes(chain_id: str, height: int, vote_type: int, block_hash: byte
 class Vote:
     height: int
     vote_type: int  # PREVOTE | PRECOMMIT
-    block_hash: bytes
+    block_hash: bytes  # NIL (b"") for a nil vote
     validator: str  # operator address
     signature: bytes
+    round: int = 0
+
+    @property
+    def is_nil(self) -> bool:
+        return self.block_hash == NIL
 
     @classmethod
     def sign(
         cls, key: PrivateKey, chain_id: str, height: int, vote_type: int,
-        block_hash: bytes, validator: str | None = None,
+        block_hash: bytes, validator: str | None = None, round: int = 0,
     ) -> "Vote":
         """`validator` is the OPERATOR address this vote speaks for; it
         defaults to the key's own derived address (genesis validators),
@@ -83,12 +99,15 @@ class Vote:
         return cls(
             height, vote_type, block_hash,
             validator if validator is not None else key.public_key().address(),
-            key.sign(vote_sign_bytes(chain_id, height, vote_type, block_hash)),
+            key.sign(vote_sign_bytes(chain_id, height, vote_type, block_hash, round)),
+            round,
         )
 
     def verify(self, pubkey: PublicKey, chain_id: str) -> bool:
         return pubkey.verify(
-            vote_sign_bytes(chain_id, self.height, self.vote_type, self.block_hash),
+            vote_sign_bytes(
+                chain_id, self.height, self.vote_type, self.block_hash, self.round
+            ),
             self.signature,
         )
 
@@ -99,6 +118,7 @@ class Vote:
             + encode_bytes_field(3, self.block_hash)
             + encode_bytes_field(4, self.validator.encode())
             + encode_bytes_field(5, self.signature)
+            + encode_varint_field(6, self.round)
         )
 
     @classmethod
@@ -107,7 +127,7 @@ class Vote:
         b = {n: v for n, wt, v in decode_fields(raw) if wt == WIRE_LEN}
         return cls(
             ints.get(1, 0), ints.get(2, 0), b.get(3, b""),
-            b.get(4, b"").decode(), b.get(5, b""),
+            b.get(4, b"").decode(), b.get(5, b""), ints.get(6, 0),
         )
 
 
@@ -124,9 +144,11 @@ class VoteSet:
         vote_type: int,
         block_hash: bytes,
         validators: dict[str, tuple[PublicKey, int]],
+        round: int = 0,
     ):
         self.chain_id = chain_id
         self.height = height
+        self.round = round
         self.vote_type = vote_type
         self.block_hash = block_hash
         self.validators = validators
@@ -134,9 +156,14 @@ class VoteSet:
 
     def add(self, vote: Vote) -> None:
         kind = _TYPE_NAMES.get(self.vote_type, "?")
-        if vote.height != self.height or vote.vote_type != self.vote_type:
+        if (
+            vote.height != self.height
+            or vote.vote_type != self.vote_type
+            or vote.round != self.round
+        ):
             raise ConsensusError(
-                f"{kind} for wrong height/type: {vote.height}/{vote.vote_type}"
+                f"{kind} for wrong height/round/type: "
+                f"{vote.height}/{vote.round}/{vote.vote_type}"
             )
         if vote.block_hash != self.block_hash:
             raise ConsensusError(
@@ -166,21 +193,25 @@ class VoteSet:
 @dataclass(frozen=True)
 class Commit:
     """The queryable proof a height committed: +2/3 precommits over
-    block_id(data_root, prev_app_hash)."""
+    block_id(data_root, prev_app_hash), all from the same round."""
 
     height: int
     block_hash: bytes  # = block_id(data_root, prev_app_hash)
     precommits: tuple[Vote, ...]
     data_root: bytes = b""
     prev_app_hash: bytes = b""
+    round: int = 0
+    time_ns: int = 0  # block time (see commit timestamps, machine.py)
 
     def to_json(self) -> dict:
         return {
             "height": self.height,
+            "round": self.round,
             "block_hash": self.block_hash.hex(),
             "precommits": [v.marshal().hex() for v in self.precommits],
             "data_root": self.data_root.hex(),
             "prev_app_hash": self.prev_app_hash.hex(),
+            "time_ns": self.time_ns,
         }
 
     @classmethod
@@ -190,16 +221,19 @@ class Commit:
             tuple(Vote.unmarshal(bytes.fromhex(v)) for v in d["precommits"]),
             bytes.fromhex(d.get("data_root", "")),
             bytes.fromhex(d.get("prev_app_hash", "")),
+            d.get("round", 0),
+            d.get("time_ns", 0),
         )
 
 
 @dataclass(frozen=True)
 class Equivocation:
-    """Double-sign evidence: one validator, two votes for the same height
-    and vote type but different block ids — what Tendermint's evidence
-    pool gossips as DuplicateVoteEvidence.  Verification (signatures +
-    pair validity) happens in the slashing keeper, which holds the
-    validator set."""
+    """Double-sign evidence: one validator, two votes for the same height,
+    ROUND, and vote type but different block ids — what Tendermint's
+    evidence pool gossips as DuplicateVoteEvidence.  (Voting for different
+    blocks in different rounds is the protocol working, not a fault.)
+    Verification (signatures + pair validity) happens in the slashing
+    keeper, which holds the validator set."""
 
     vote_a: Vote
     vote_b: Vote
@@ -215,13 +249,13 @@ class Equivocation:
 
 def find_equivocations(votes) -> list[Equivocation]:
     """Scan votes (any iterable) for conflicting pairs per
-    (validator, height, vote type).  First conflicting pair per key wins —
-    one equivocation is enough to tombstone."""
-    seen: dict[tuple[str, int, int], Vote] = {}
+    (validator, height, round, vote type).  First conflicting pair per key
+    wins — one equivocation is enough to tombstone."""
+    seen: dict[tuple[str, int, int, int], Vote] = {}
     found: list[Equivocation] = []
-    flagged: set[tuple[str, int, int]] = set()
+    flagged: set[tuple[str, int, int, int]] = set()
     for v in votes:
-        key = (v.validator, v.height, v.vote_type)
+        key = (v.validator, v.height, v.round, v.vote_type)
         prior = seen.get(key)
         if prior is None:
             seen[key] = v
@@ -241,12 +275,18 @@ def verify_commit(
     consistent with its claimed data root + previous app hash?
 
     The binding is unconditional: a commit whose (data_root,
-    prev_app_hash) parts don't hash to the signed block id is rejected —
-    otherwise the unsigned part fields could be rewritten freely and a
-    state-sync joiner shown a forged prev_app_hash."""
-    if commit.block_hash != block_id(commit.data_root, commit.prev_app_hash):
+    prev_app_hash, time_ns) parts don't hash to the signed block id is
+    rejected — otherwise the unsigned part fields could be rewritten
+    freely and a state-sync joiner shown a forged prev_app_hash (or an
+    IBC light client a forged consensus timestamp)."""
+    if commit.block_hash != block_id(
+        commit.data_root, commit.prev_app_hash, commit.time_ns
+    ):
         return False
-    vs = VoteSet(chain_id, commit.height, PRECOMMIT, commit.block_hash, validators)
+    vs = VoteSet(
+        chain_id, commit.height, PRECOMMIT, commit.block_hash, validators,
+        round=commit.round,
+    )
     for vote in commit.precommits:
         try:
             vs.add(vote)

@@ -50,6 +50,10 @@ class PriorityMempool:
     def tx_key(tx: bytes) -> bytes:
         return hashlib.sha256(tx).digest()
 
+    def has_tx(self, tx: bytes) -> bool:
+        """Is this exact tx resident? (gossip relay dedup)."""
+        return self.tx_key(tx) in self._entries
+
     def insert(self, tx: bytes, priority: int, height: int) -> bool:
         """Admit a checked tx; False if duplicate, oversized, or the pool is
         full of higher-priority txs."""

@@ -15,46 +15,41 @@ Reference shape (x/blobstream/client/verify.go, overview.md):
 This module provides TPU-repo equivalents of all three roles against the
 JSON-RPC serving plane plus `BlobstreamContract`, an in-process stand-in
 for the Ethereum contract (storage layout and checks modeled on
-Blobstream.sol via x/blobstream/types/abi_consts.go; signatures are
-secp256k1 over a sha256 domain-separated digest instead of keccak256 —
-there is no keccak implementation in-image, and EVM byte-parity is out of
-scope, which PARITY.md records).
+Blobstream.sol via x/blobstream/types/abi_consts.go).  Digests are
+EVM-byte-parity keccak256 over the reference's ABI layouts
+(modules/blobstream/evm.py, crypto/keccak.py) — the round-2 sha256
+stand-in (then recorded as a PARITY deviation) is gone.
 """
 
 from __future__ import annotations
 
-import hashlib
 from dataclasses import dataclass
 from fractions import Fraction
 
 from celestia_app_tpu import merkle
 from celestia_app_tpu.crypto.keys import PrivateKey, PublicKey
+from celestia_app_tpu.modules.blobstream.evm import (
+    data_commitment_sign_bytes,
+    valset_sign_bytes,
+)
 from celestia_app_tpu.modules.blobstream.keeper import (
     BridgeValidator,
     encode_data_root_tuple,
 )
 
-# "transactionBatch" zero-padded to 32 bytes (abi_consts.go:115).
-DATA_COMMITMENT_DOMAIN = b"transactionBatch".ljust(32, b"\x00")
-# "checkpoint" zero-padded (Gravity valset domain; abi_consts.go valset ABI).
-VALSET_DOMAIN = b"checkpoint".ljust(32, b"\x00")
-
 
 def data_commitment_digest(nonce: int, tuple_root: bytes) -> bytes:
-    """The message an orchestrator signs for a DataCommitment attestation."""
-    return hashlib.sha256(
-        DATA_COMMITMENT_DOMAIN + nonce.to_bytes(32, "big") + tuple_root
-    ).digest()
+    """The message an orchestrator signs for a DataCommitment attestation
+    (reference domainSeparateDataRootTupleRoot keccak digest)."""
+    return data_commitment_sign_bytes(nonce, tuple_root)
 
 
 def valset_checkpoint(
     nonce: int, members: tuple[BridgeValidator, ...]
 ) -> bytes:
-    """Checkpoint hash registering a validator set in the contract."""
-    h = hashlib.sha256(VALSET_DOMAIN + nonce.to_bytes(32, "big"))
-    for m in members:
-        h.update(m.address.encode() + m.power.to_bytes(8, "big"))
-    return h.digest()
+    """Checkpoint digest registering a validator set in the contract
+    (reference Valset.SignBytes, valset.go:32-56)."""
+    return valset_sign_bytes(nonce, members)
 
 
 @dataclass(frozen=True)

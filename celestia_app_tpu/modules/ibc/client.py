@@ -95,24 +95,30 @@ class ClientState:
 @dataclass(frozen=True)
 class ConsensusState:
     """What a verified Commit at `height` pins: the counterparty's data
-    root at `height` and its app hash at `height - 1`."""
+    root at `height`, its app hash at `height - 1`, and the block time —
+    all inside the signed block id, so timestamp timeouts verify against
+    a +2/3-attested clock, not anyone's local one (ibc-go's
+    ConsensusState carries Timestamp from the Tendermint header the same
+    way)."""
 
     height: int
     data_root: bytes
     prev_app_hash: bytes
+    time_ns: int = 0
 
     def marshal(self) -> bytes:
         return (
             encode_varint_field(1, self.height)
             + encode_bytes_field(2, self.data_root)
             + encode_bytes_field(3, self.prev_app_hash)
+            + encode_varint_field(4, self.time_ns)
         )
 
     @classmethod
     def unmarshal(cls, raw: bytes) -> "ConsensusState":
         ints = {n: v for n, wt, v in decode_fields(raw) if wt == WIRE_VARINT}
         b = {n: v for n, wt, v in decode_fields(raw) if wt == WIRE_LEN}
-        return cls(ints.get(1, 0), b.get(2, b""), b.get(3, b""))
+        return cls(ints.get(1, 0), b.get(2, b""), b.get(3, b""), ints.get(4, 0))
 
 
 class ClientKeeper:
@@ -167,7 +173,10 @@ class ClientKeeper:
                 f"commit at height {commit.height} fails verification "
                 f"against client {client_id}"
             )
-        new = ConsensusState(commit.height, commit.data_root, commit.prev_app_hash)
+        new = ConsensusState(
+            commit.height, commit.data_root, commit.prev_app_hash,
+            getattr(commit, "time_ns", 0),
+        )
         key = (
             _CONSENSUS_PREFIX + client_id.encode() + b"/"
             + commit.height.to_bytes(8, "big")
@@ -175,8 +184,8 @@ class ClientKeeper:
         existing = self.store.get(key)
         if existing is not None:
             prior = ConsensusState.unmarshal(existing)
-            if (prior.data_root, prior.prev_app_hash) != (
-                new.data_root, new.prev_app_hash,
+            if (prior.data_root, prior.prev_app_hash, prior.time_ns) != (
+                new.data_root, new.prev_app_hash, new.time_ns,
             ):
                 # Two +2/3-signed commits for one height: equivocation at
                 # chain scale.  Freeze; never serve this client again.

@@ -37,6 +37,10 @@ DOWNTIME_JAIL_DURATION_NS = 60 * 10**9  # 1 minute
 SLASH_FRACTION_DOUBLE_SIGN = Dec.from_str("0.02")
 SLASH_FRACTION_DOWNTIME = Dec.from_str("0")
 
+# Evidence max age in blocks: UnbondingTime / GoalBlockTime + 1 (reference
+# app/default_overrides.go:254 DefaultEvidenceParams).
+EVIDENCE_MAX_AGE_BLOCKS = (3 * 7 * 24 * 3600) // 15 + 1
+
 _INFO_PREFIX = b"slash/info/"
 _BITMAP_PREFIX = b"slash/bitmap/"
 _PARAMS_KEY = b"slash/params"
@@ -165,21 +169,35 @@ class SlashingKeeper:
 
     # --- equivocation (x/evidence Equivocation handling) ----------------------
     def handle_equivocation(
-        self, staking, bank, dist, chain_id: str, vote_a, vote_b
+        self, staking, bank, dist, chain_id: str, vote_a, vote_b,
+        current_height: int | None = None,
     ) -> int:
         """Verify the two conflicting votes, slash 2%, tombstone, jail
         forever.  Returns the burned amount.  A tombstoned validator is
         punished once (sdk: evidence for a tombstoned validator is a
-        no-op)."""
+        no-op).  Evidence older than the unbonding window is rejected
+        (reference app/default_overrides.go:249-254: MaxAgeNumBlocks =
+        UnbondingTime/GoalBlockTime + 1) — slashing for an infraction the
+        current delegators could not have witnessed would burn stake that
+        joined after the fault."""
         from celestia_app_tpu.crypto.keys import PublicKey
 
         if (
             vote_a.validator != vote_b.validator
             or vote_a.height != vote_b.height
+            or getattr(vote_a, "round", 0) != getattr(vote_b, "round", 0)
             or vote_a.vote_type != vote_b.vote_type
             or vote_a.block_hash == vote_b.block_hash
         ):
             raise SlashingError("votes are not an equivocation pair")
+        if current_height is not None and (
+            vote_a.height < current_height - EVIDENCE_MAX_AGE_BLOCKS
+        ):
+            raise SlashingError(
+                f"equivocation at height {vote_a.height} is older than the "
+                f"evidence window ({EVIDENCE_MAX_AGE_BLOCKS} blocks before "
+                f"{current_height})"
+            )
         val = staking.get_validator(vote_a.validator)
         if val is None:
             raise SlashingError(f"no validator {vote_a.validator}")
@@ -212,4 +230,21 @@ class SlashingKeeper:
             raise SlashingError(
                 f"validator {validator} jailed until {info.jailed_until_ns}"
             )
+        # sdk Unjail refuses while the operator's self-bond sits below its
+        # declared min_self_delegation (ErrSelfDelegationTooLowToUnjail): a
+        # validator jailed by the undelegate-below-min path has
+        # jailed_until_ns == 0 and would otherwise unjail immediately
+        # without restoring its bond.  Genesis validators' notional
+        # self-bond counts as operator stake (state/staking.py header).
+        min_self = staking.min_self_delegation(validator)
+        if min_self:
+            from celestia_app_tpu.modules.distribution import DistributionKeeper
+
+            self_bond = staking.delegation(validator, validator)
+            self_bond += DistributionKeeper(self.store).notional(validator)
+            if self_bond < min_self:
+                raise SlashingError(
+                    f"validator {validator} self-delegation {self_bond} is "
+                    f"below its min self delegation {min_self}"
+                )
         staking.unjail(validator)

@@ -153,6 +153,10 @@ class RemoteNode:
             evidence=evidence or [],
         )
 
+    def consensus(self, msg: dict) -> dict:
+        """Deliver a gossip consensus message (rpc/gossip.py flood)."""
+        return self.call("consensus", msg=msg)
+
     def commit(self, height: int):
         """The height's Commit record, parsed — None if the node has none."""
         res = self.call("commit", height=height)

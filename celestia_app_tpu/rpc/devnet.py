@@ -38,20 +38,45 @@ def run_validator(
     base_port: int,
     block_interval_ms: int = 300,
     n_accounts: int = 4,
+    mode: str = "gossip",
+    peer_indices: list[int] | None = None,
 ) -> None:
-    """Serve validator `index` of `n`; blocks until killed."""
+    """Serve validator `index` of `n`; blocks until killed.
+
+    mode="gossip" (default): the multi-round Tendermint machine over p2p
+    flood gossip (rpc/gossip.py) — survives proposer crashes via round
+    changes.  mode="push": the legacy proposer-push round (one round per
+    height, the round-1/2 plane).  `peer_indices` restricts this node's
+    peer list (partial topologies, e.g. a ring, to exercise multi-hop
+    relay); default is fully connected.
+    """
     keys = funded_keys(n_accounts)
+    if peer_indices is None:
+        peer_indices = [j for j in range(n) if j != index]
     node = ServingNode(
         genesis=deterministic_genesis(keys, n_validators=n),
         keys=keys,
         validator_index=index,
         n_validators=n,
-        peers=[_url(base_port, j) for j in range(n) if j != index],
+        peers=[_url(base_port, j) for j in peer_indices],
     )
-    server = serve(
-        node, port=base_port + index, block_interval_s=None
-    )
-    print(f"validator {index}/{n} serving on {server.url}", flush=True)
+    driver = None
+    if mode == "gossip":
+        driver = node.enable_gossip_consensus(
+            interval_s=block_interval_ms / 1000.0
+        )
+    server = serve(node, port=base_port + index, block_interval_s=None)
+    print(f"validator {index}/{n} serving on {server.url} ({mode})", flush=True)
+
+    # AOT warmup BEFORE consensus starts (SURVEY §7 hard part 4: compiles
+    # must never sit on the block path — a first-block compile under the
+    # node lock stalls every round timeout).  Small sizes cover empty/
+    # near-empty devnet blocks; bigger squares hit the persistent compile
+    # cache (see spawn_devnet's JAX_COMPILATION_CACHE_DIR).
+    from celestia_app_tpu.da.eds import warmup
+
+    warmup([1, 2, 4])
+    print(f"validator {index} warmed", flush=True)
 
     # Startup barrier: wait for every peer to serve before proposing.
     for peer_url in node.peer_urls:
@@ -66,6 +91,11 @@ def run_validator(
                     raise TimeoutError(f"peer {peer_url} never came up")
                 time.sleep(0.1)
     print(f"validator {index} peers up", flush=True)
+
+    if driver is not None:
+        driver.start()
+        while True:
+            time.sleep(60)  # the driver's timers run the chain
 
     interval = block_interval_ms / 1000.0
     while True:
@@ -103,21 +133,45 @@ def spawn_devnet(
     block_interval_ms: int = 300,
     wait_s: float = 120.0,
     env: dict | None = None,
+    mode: str = "gossip",
+    topology: dict[int, list[int]] | None = None,
 ) -> Devnet:
-    """Launch n validator processes; returns once all serve their RPC."""
+    """Launch n validator processes; returns once all serve their RPC.
+
+    `topology` maps validator index -> peer indices (partial meshes, e.g.
+    a ring for multi-hop relay tests); default fully connected.
+    """
     import os
 
     procs = []
     child_env = dict(os.environ if env is None else env)
+    # Compiles amortize across validator processes and runs; without this
+    # every child pays its own first-block jit compile under the node lock.
+    child_env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/celestia_jax_cache")
+    # Pre-warm the persistent cache ONCE before spawning: n validators
+    # compiling the same pipelines concurrently on a small host serializes
+    # onto the cores and multiplies the startup time by n; after this
+    # one-shot, every child's own warmup is a fast cache deserialization.
+    subprocess.run(
+        [sys.executable, "-c",
+         "from celestia_app_tpu.da.eds import warmup; warmup([1, 2, 4])"],
+        env=child_env, timeout=600,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        check=False,
+    )
     for i in range(n):
+        cmd = [
+            sys.executable, "-m", "celestia_app_tpu.rpc.devnet",
+            "--index", str(i), "--n", str(n),
+            "--base-port", str(base_port),
+            "--block-interval-ms", str(block_interval_ms),
+            "--mode", mode,
+        ]
+        if topology is not None:
+            cmd += ["--peers", ",".join(str(j) for j in topology[i])]
         procs.append(
             subprocess.Popen(
-                [
-                    sys.executable, "-m", "celestia_app_tpu.rpc.devnet",
-                    "--index", str(i), "--n", str(n),
-                    "--base-port", str(base_port),
-                    "--block-interval-ms", str(block_interval_ms),
-                ],
+                cmd,
                 env=child_env,
                 stdout=subprocess.DEVNULL,
                 stderr=subprocess.DEVNULL,
@@ -149,8 +203,19 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--n", type=int, required=True)
     ap.add_argument("--base-port", type=int, default=26800)
     ap.add_argument("--block-interval-ms", type=int, default=300)
+    ap.add_argument("--mode", choices=["gossip", "push"], default="gossip")
+    ap.add_argument("--peers", default=None,
+                    help="comma-separated peer indices (default: all others)")
     args = ap.parse_args(argv)
-    run_validator(args.index, args.n, args.base_port, args.block_interval_ms)
+    peer_indices = (
+        [int(x) for x in args.peers.split(",") if x != ""]
+        if args.peers is not None
+        else None
+    )
+    run_validator(
+        args.index, args.n, args.base_port, args.block_interval_ms,
+        mode=args.mode, peer_indices=peer_indices,
+    )
     return 0
 
 

@@ -104,15 +104,49 @@ class ServingNode(TestNode):
 
     # --- tx admission + gossip ----------------------------------------------
     def broadcast(self, raw_tx: bytes, relay: bool = True):
+        """Mempool gossip: multi-hop flood with mempool-insert dedup.
+
+        A tx relays onward only when it was NEWLY admitted here, so the
+        flood terminates (re-received txs are already resident) yet
+        crosses partial topologies hop by hop — a tx submitted anywhere
+        reaches the proposer without the submitter knowing who that is
+        (reference: mempool v1 gossip, app/default_overrides.go:258-284).
+        """
         with self.lock:
+            known = self.mempool.has_tx(raw_tx)
             res = super().broadcast(raw_tx)
-        if res.code == 0 and relay:
-            for peer in self.peers():
-                try:
-                    peer.broadcast(raw_tx, relay=False)
-                except Exception:
-                    pass  # mempool gossip is best-effort; consensus is not
+            inserted = not known and res.code == 0 and self.mempool.has_tx(raw_tx)
+        if inserted and relay:
+            def _relay():
+                for peer in self.peers():
+                    try:
+                        peer.broadcast(raw_tx, relay=True)
+                    except Exception:
+                        pass  # mempool gossip is best-effort; consensus is not
+
+            self.gossip_pool.submit(_relay)
         return res
+
+    @property
+    def gossip_pool(self):
+        """Shared executor for async gossip sends (tx relay + consensus
+        flood).  A pool, not ad-hoc threads: NodeServer.stop drains it so
+        no send outlives the server (stray daemon threads dying inside
+        C-runtime calls abort the interpreter at exit)."""
+        pool = getattr(self, "_gossip_pool", None)
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            pool = self._gossip_pool = ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="gossip"
+            )
+        return pool
+
+    def shutdown_gossip(self) -> None:
+        pool = getattr(self, "_gossip_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=True, cancel_futures=True)
+            self._gossip_pool = None
 
     # --- block production + replication --------------------------------------
     def produce_block(self, time_ns: int | None = None):
@@ -157,7 +191,7 @@ class ServingNode(TestNode):
         return [
             ev
             for ev in find_equivocations(votes)
-            if (ev.validator, ev.height, ev.vote_a.vote_type)
+            if (ev.validator, ev.height, ev.vote_a.round, ev.vote_a.vote_type)
             not in self._used_evidence
         ]
 
@@ -217,7 +251,7 @@ class ServingNode(TestNode):
         self._prevoted.pop(height, None)  # round done
         for ev in evidence:
             self._used_evidence.add(
-                (ev.validator, ev.height, ev.vote_a.vote_type)
+                (ev.validator, ev.height, ev.vote_a.round, ev.vote_a.vote_type)
             )
         # Bound the evidence pool (Tendermint prunes expired evidence).
         for h in [h for h in self._witnessed if h < height - 100]:
@@ -301,11 +335,11 @@ class ServingNode(TestNode):
             data = self.app.prepare_proposal(self.mempool.reap(self.block_max_bytes()))
             if not self.app.process_proposal(data):
                 raise AssertionError("node rejected its own proposal")
-            # Votes commit to block_id(data root, prev app hash): a peer
-            # whose state diverged computes a DIFFERENT id, so its prevote
-            # misses this vote set and divergence blocks quorum BEFORE
-            # anyone commits.
-            bid = block_id(data.hash, prev_app_hash)
+            # Votes commit to block_id(data root, prev app hash, time): a
+            # peer whose state diverged computes a DIFFERENT id, so its
+            # prevote misses this vote set and divergence blocks quorum
+            # BEFORE anyone commits.
+            bid = block_id(data.hash, prev_app_hash, time_ns)
             # Phase 1: prevotes (peers validate, nobody commits yet).
             # The node's own vote is best-effort like any peer's: a genesis
             # whose consensus pubkey differs from this node's signing key
@@ -355,7 +389,8 @@ class ServingNode(TestNode):
                 f"{precommits.signed_power()}/{precommits.total_power()}"
             )
         commit = Commit(
-            height, bid, tuple(precommits.votes.values()), data.hash, prev_app_hash
+            height, bid, tuple(precommits.votes.values()), data.hash,
+            prev_app_hash, time_ns=time_ns,
         )
 
         # Phase 3: the commit is decided — apply everywhere, carrying the
@@ -600,7 +635,7 @@ class ServingNode(TestNode):
                 raise ValueError(f"proposal rejected at height {height}")
             # Computed over THIS node's app hash: divergence yields a
             # different block id, and the prevote simply won't count.
-            bid = block_id(data.hash, self.app.cms.last_app_hash)
+            bid = block_id(data.hash, self.app.cms.last_app_hash, time_ns)
             prevote = self._sign_vote(height, PREVOTE, bid)
             self._prevoted[height] = bid
         return {"prevote": prevote.marshal().hex()}
@@ -696,6 +731,55 @@ class ServingNode(TestNode):
         with self.lock:
             commit = self._commits.get(height)
         return None if commit is None else commit.to_json()
+
+    # --- gossip consensus (rpc/gossip.py) ------------------------------------
+    def enable_gossip_consensus(self, timeouts=None, interval_s: float = 0.2):
+        """Attach a ConsensusDriver (multi-round Tendermint machine over
+        p2p flood gossip).  Call driver.start() once peers are serving."""
+        from celestia_app_tpu.rpc.gossip import ConsensusDriver
+
+        self.consensus_driver = ConsensusDriver(
+            self, timeouts=timeouts, interval_s=interval_s
+        )
+        return self.consensus_driver
+
+    def rpc_consensus(self, msg: dict) -> dict:
+        driver = getattr(self, "consensus_driver", None)
+        if driver is None:
+            raise ValueError("gossip consensus is not enabled on this node")
+        return driver.handle(msg)
+
+    def rpc_consensus_state(self) -> dict:
+        """Round-machine introspection (the consensus reactor's dump_state
+        analog): current height/round/step, tallies, backlog depth."""
+        driver = getattr(self, "consensus_driver", None)
+        if driver is None:
+            return {"enabled": False}
+        with self.lock:
+            m = driver.machine
+            out = {
+                "enabled": True,
+                "app_height": self.app.height,
+                "backlog": len(driver.backlog),
+                "machine": None,
+            }
+            if m is not None:
+                out["machine"] = {
+                    "height": m.height,
+                    "round": m.round,
+                    "step": m.step,
+                    "locked_round": m.locked_round,
+                    "proposer": m.proposer(m.round),
+                    "my_address": m.my_address,
+                    "proposals": sorted(m.proposals),
+                    "prevote_power": {
+                        r: t.power_any() for r, t in m.prevotes.items()
+                    },
+                    "precommit_power": {
+                        r: t.power_any() for r, t in m.precommits.items()
+                    },
+                }
+            return out
 
     # --- state-sync serving ---------------------------------------------------
     def rpc_snapshots(self) -> list[dict]:
@@ -1027,8 +1111,12 @@ class NodeServer:
 
     def stop(self):
         self._stop.set()
+        driver = getattr(self.node, "consensus_driver", None)
+        if driver is not None:
+            driver.stop()
         self.httpd.shutdown()
         self.httpd.server_close()
+        self.node.shutdown_gossip()
 
 
 def serve(

@@ -92,6 +92,17 @@ class StakingKeeper:
                 f"validator {v.address} holds delegations; power cannot be "
                 "set directly"
             )
+        # One consensus key, one bonded entry — also on the genesis/test
+        # path: vote sign bytes exclude the validator address, so two
+        # records sharing a pubkey would let one signer count its power
+        # twice toward +2/3 (same rule as create_validator).
+        if v.pubkey:
+            for other in self.validators():
+                if other.pubkey == v.pubkey and other.address != v.address:
+                    raise StakingError(
+                        f"consensus pubkey already used by validator "
+                        f"{other.address}"
+                    )
         self.store.set(_VAL_PREFIX + v.address.encode(), v.marshal())
         # Keep tokens consistent with directly-set power.
         self.store.set(

@@ -111,12 +111,13 @@ class ChainEnd:
 
         data_root = self.node.blocks[height - 1].hash
         prev_hash = self.app_hash_at(height - 1)
-        bid = block_id(data_root, prev_hash)
+        time_ns = self.node.block_times[height]
+        bid = block_id(data_root, prev_hash, time_ns)
         votes = tuple(
             Vote.sign(k, self.chain_id, height, PRECOMMIT, bid)
             for k in self.val_keys
         )
-        return Commit(height, bid, votes, data_root, prev_hash)
+        return Commit(height, bid, votes, data_root, prev_hash, time_ns=time_ns)
 
     def proof_at(self, key: bytes, height: int):
         return self.node.app.cms.proof_at(key, height)

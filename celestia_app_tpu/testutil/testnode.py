@@ -79,6 +79,7 @@ class TestNode:
             self.app.init_chain(genesis or deterministic_genesis(self.keys))
         self.mempool = PriorityMempool()
         self.blocks: list[BlockData] = []
+        self.block_times: dict[int, int] = {}  # height -> block time
         # tx hash -> (height, code, log): the RPC `tx` query's index.
         self.tx_index: dict[bytes, tuple[int, int, str]] = {}
 
@@ -144,6 +145,7 @@ class TestNode:
         self.app.commit()
         self.mempool.update(self.app.height, list(data.txs))
         self.blocks.append(data)
+        self.block_times[self.app.height] = time_ns
         self.index_block(self.app.height, list(data.txs), results)
         return results
 

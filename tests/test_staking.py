@@ -126,7 +126,7 @@ class TestStakingOverTheWire:
             Validator(
                 __import__("celestia_app_tpu.crypto", fromlist=["PrivateKey"])
                 .PrivateKey.from_seed(f"validator-{i}".encode()).public_key().address(),
-                b"\x02" * 33, 100,
+                b"\x02" * 32 + bytes([i]), 100,
             )
             for i in range(2)
         )
@@ -189,7 +189,7 @@ class TestStakingOverTheWire:
         validators = tuple(
             Validator(
                 PrivateKey.from_seed(f"validator-{i}".encode()).public_key().address(),
-                b"\x02" * 33, 100,
+                b"\x02" * 32 + bytes([i]), 100,
             )
             for i in range(2)
         )
