@@ -34,6 +34,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from celestia_app_tpu.app.gas import (
+    GasKVStore,
     GasMeter,
     MAX_MEMO_CHARACTERS,
     OutOfGas,
@@ -107,9 +108,12 @@ def allowed_msg_types(app_version: int) -> set[type]:
 class AnteResult:
     priority: int = 0
     gas_wanted: int = 0
-    gas_consumed: int = 0  # meter reading after the chain (tx size + sig gas)
+    gas_consumed: int = 0  # meter reading after the chain (size+sig+store gas)
     signer: str = ""
     events: list = field(default_factory=list)
+    # The tx's single gas meter (sdk runTx): execution continues on it so
+    # store access during message handling is charged too.
+    meter: GasMeter | None = None
 
 
 def run_ante(
@@ -180,6 +184,9 @@ def _run(
     if fee.gas_limit == 0:
         raise AnteError("gas limit must be positive")
     meter = GasMeter(None if simulate else fee.gas_limit)
+    # Every store access from here on is charged the sdk KVStore gas
+    # schedule (gaskv wrapping in baseapp's runTx context).
+    ctx = ctx.with_store(GasKVStore(ctx.store, meter))
 
     # --- 4: extension options (RejectExtensionOptionsDecorator: any critical
     # extension option rejects; non-critical ones pass by definition) ---------
@@ -319,6 +326,7 @@ def _run(
         gas_wanted=fee.gas_limit,
         gas_consumed=meter.consumed,
         signer=signer_addr,
+        meter=meter,
     )
 
 

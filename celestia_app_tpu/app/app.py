@@ -138,6 +138,10 @@ class Ctx:
     def branch(self) -> "Ctx":
         return Ctx(self.store.branch(), self.height, self.time_ns, self.app_version)
 
+    def with_store(self, store) -> "Ctx":
+        """Same coordinates over a different store view (e.g. gas-metered)."""
+        return Ctx(store, self.height, self.time_ns, self.app_version)
+
     def send_spendable(self, sender: str, recipient: str, amount: int) -> None:
         """Transfer that cannot dip into still-vesting tokens."""
         from celestia_app_tpu.state.accounts import send_spendable
@@ -524,29 +528,46 @@ class App:
         except (AnteError, ValueError) as e:
             return TxResult(code=1, log=str(e))
 
-        # The ante chain's meter reading (tx-size + sig gas) carries into
-        # execution, as with the sdk's single per-tx gas meter.
-        gas_used = ante_res.gas_consumed
+        # The tx's SINGLE gas meter (sdk runTx) carries from the ante chain
+        # into execution: store access during message handling is charged
+        # the KVStore schedule, and blob gas consumes against the same
+        # limit (closes the round-2 store-gas PARITY deviation).
+        from celestia_app_tpu.app.gas import GasKVStore, OutOfGas
+
+        meter = ante_res.meter
         events: list = []
         # Messages run on their own branch (baseapp runMsgs' cache): a failed
         # execution rolls back msg effects ONLY — the ante effects (fee
         # deduction, sequence bump) stay committed, so a failed tx still pays
         # its fee and cannot be replayed (msCache.Write() precedes runMsgs).
         msg_ctx = tx_ctx.branch()
+        exec_ctx = msg_ctx.with_store(GasKVStore(msg_ctx.store, meter))
         try:
             for msg in tx.msgs():
-                used, evts = self._handle_msg(msg_ctx, msg, ante_res.gas_wanted - gas_used)
-                gas_used += used
+                used, evts = self._handle_msg(
+                    exec_ctx, msg, ante_res.gas_wanted - meter.consumed
+                )
+                if used:
+                    meter.consume(used, "execution")
                 events.extend(evts)
+        except OutOfGas as e:
+            block_ctx.store.write_back(tx_ctx.store)  # ante effects persist
+            return TxResult(
+                code=11,  # sdk ErrOutOfGas
+                log=str(e), gas_wanted=ante_res.gas_wanted,
+                gas_used=meter.consumed,
+            )
         except Exception as e:
             block_ctx.store.write_back(tx_ctx.store)  # ante effects persist
             return TxResult(
-                code=2, log=str(e), gas_wanted=ante_res.gas_wanted, gas_used=gas_used
+                code=2, log=str(e), gas_wanted=ante_res.gas_wanted,
+                gas_used=meter.consumed,
             )
         tx_ctx.store.write_back(msg_ctx.store)
         block_ctx.store.write_back(tx_ctx.store)
         return TxResult(
-            code=0, gas_wanted=ante_res.gas_wanted, gas_used=gas_used, events=events
+            code=0, gas_wanted=ante_res.gas_wanted, gas_used=meter.consumed,
+            events=events,
         )
 
     def _handle_msg(self, ctx: Ctx, msg, gas_remaining: int):
@@ -996,16 +1017,26 @@ class App:
             return 0, [("ibc.noop", "timeout", packet.sequence)]
         src_chan = channels.channel(packet.source_port, packet.source_channel)
         if src_chan.connection_id:
-            # Proven non-receipt on the counterparty at the proof height.
-            from celestia_app_tpu.modules.ibc.handshake import verify_timeout_proof
+            # Proven non-receipt on the counterparty at the proof height;
+            # the timestamp bound comes from the counterparty's ATTESTED
+            # consensus time at that height, never the local clock (a
+            # lagging local clock would otherwise let the sender refund
+            # escrow while the receiver could still accept the packet).
+            from celestia_app_tpu.modules.ibc.handshake import (
+                counterparty_proof_time,
+                verify_timeout_proof,
+            )
 
             verify_timeout_proof(
                 ctx.store, src_chan, packet, msg.state_proof(), msg.proof_height
             )
-        # The proof height stands in for the counterparty view; the
-        # timestamp check uses this chain's clock (scope note in
-        # verify_timeout_proof).
-        channels.timeout_packet(packet, msg.proof_height, ctx.time_ns)
+            proof_time_ns = counterparty_proof_time(
+                ctx.store, src_chan, msg.proof_height
+            )
+        else:
+            # Harness-direct channels (no connection/client): trusted mode.
+            proof_time_ns = ctx.time_ns
+        channels.timeout_packet(packet, msg.proof_height, proof_time_ns)
         if not _ica_port(packet.source_port):
             stack.on_timeout_packet(ctx, packet)
         return 0, [("ibc.timeout_packet", packet.sequence)]

@@ -216,12 +216,53 @@ def cmd_start(args) -> int:
         print(f"warmed square sizes {warmed} in {time.time() - t0:.1f}s",
               flush=True)
     node = None
-    if getattr(args, "serve", False):
+    peers = [u for u in (getattr(args, "peers", "") or "").split(",") if u]
+    if getattr(args, "serve", False) or peers:
         from celestia_app_tpu.rpc.server import ServingNode, serve as rpc_serve
 
-        node = ServingNode(app=app)
+        node = ServingNode(
+            app=app,
+            validator_index=getattr(args, "validator_index", 0),
+            n_validators=getattr(args, "n_validators", 1) or 1,
+            peers=peers,
+        )
         server = rpc_serve(node, port=args.rpc_port, block_interval_s=None)
         print(f"RPC serving on {server.url}", flush=True)
+    if peers:
+        # Multi-validator mode: consensus runs through the gossip round
+        # machine (rpc/gossip.py) — this daemon is one validator of a
+        # network, like `celestia-appd start` joining a chain.  The WAL
+        # (double-sign protection) lives under the home dir.
+        wal_path = os.path.join(args.home, "data", "consensus-wal.jsonl")
+        driver = node.enable_gossip_consensus(
+            interval_s=args.block_interval if not args.no_sleep else 0.05,
+            wal_path=wal_path,
+        )
+        from celestia_app_tpu.rpc.client import RemoteNode
+
+        for peer_url in peers:
+            peer = RemoteNode(peer_url, defer_status=True, timeout=2.0)
+            deadline = time.time() + 120
+            while True:
+                try:
+                    peer.status()
+                    break
+                except Exception:
+                    if time.time() > deadline:
+                        raise TimeoutError(f"peer {peer_url} never came up")
+                    time.sleep(0.2)
+        driver.start()
+        print(f"gossip consensus started (wal: {wal_path})", flush=True)
+        last_saved = app.height
+        try:
+            while True:
+                time.sleep(max(args.block_interval, 1.0))
+                with node.lock:
+                    if app.height != last_saved:
+                        save_app(args.home, app)
+                        last_saved = app.height
+        except KeyboardInterrupt:
+            return 0
     print(f"chain {app.chain_id} at height {app.height}, producing blocks...",
           flush=True)
     produced = 0
@@ -426,6 +467,13 @@ def main(argv: list[str] | None = None) -> int:
                    help="node min gas price in utia (tier-1 override)")
     p.add_argument("--serve", action="store_true",
                    help="serve the JSON-RPC endpoint (broadcast/query/proofs)")
+    p.add_argument("--peers", default="",
+                   help="comma-separated peer RPC URLs: join as one gossip "
+                        "validator of a network (implies --serve)")
+    p.add_argument("--validator-index", type=int, default=0,
+                   help="this validator's index in the network's valset")
+    p.add_argument("--n-validators", type=int, default=0,
+                   help="total validators in the network (gossip mode)")
     p.add_argument("--rpc-port", type=int, default=26657)
     p.add_argument("--warmup", choices=["none", "minimal", "all"],
                    default="minimal",

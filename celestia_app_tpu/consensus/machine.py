@@ -144,6 +144,15 @@ class EvidenceFound:
     equivocation: Equivocation
 
 
+@dataclass(frozen=True)
+class Locked:
+    """This validator just locked on a value (drivers journal it to the
+    WAL so a restart resumes with the lock — cross-round safety)."""
+
+    round: int
+    block_hash: bytes
+
+
 class RoundTally:
     """All votes of one type for one (height, round): per-block-id power
     tally including nil, with equivocation capture.
@@ -254,6 +263,9 @@ class RoundMachine:
         my_address: str | None = None,
         my_key=None,
         timeouts: dict | None = None,
+        sign_guard=None,  # f(height, round, type, block_hash) -> bool (WAL)
+        locked_value: bytes | None = None,
+        locked_round: int = -1,
     ):
         self.chain_id = chain_id
         self.height = height
@@ -262,11 +274,17 @@ class RoundMachine:
         self.my_address = my_address
         self.my_key = my_key
         self.timeouts = timeouts or DEFAULT_TIMEOUTS
+        # The double-sign gate (consensus/wal.py): consulted before every
+        # own signature; False => this validator already signed something
+        # conflicting for these coordinates (possibly before a restart).
+        self.sign_guard = sign_guard
 
         self.round = 0
         self.step = PROPOSE
-        self.locked_value: bytes | None = None
-        self.locked_round = -1
+        # Lock state may be restored from the WAL on restart: safety
+        # requires honoring a pre-crash lock in later rounds.
+        self.locked_value = locked_value
+        self.locked_round = locked_round
         self.valid_value: bytes | None = None
         self.valid_round = -1
         self.decided: Decided | None = None
@@ -298,8 +316,14 @@ class RoundMachine:
         return ScheduleTimeout(round, step, base + delta * round)
 
     def _vote(self, vote_type: int, block_hash: bytes, effects: list) -> None:
-        """Sign, self-count, and broadcast a vote (no-op for observers)."""
+        """Sign, self-count, and broadcast a vote (no-op for observers;
+        refused by the sign guard if these coordinates were already
+        signed differently — the WAL's double-sign protection)."""
         if self.my_key is None or self.my_address not in self.validators:
+            return
+        if self.sign_guard is not None and not self.sign_guard(
+            self.height, self.round, vote_type, block_hash
+        ):
             return
         vote = Vote.sign(
             self.my_key, self.chain_id, self.height, vote_type, block_hash,
@@ -484,6 +508,7 @@ class RoundMachine:
                 if self.step == PREVOTE_STEP:
                     self.locked_value = prop.block_hash
                     self.locked_round = r
+                    effects.append(Locked(r, prop.block_hash))
                     self._vote(PRECOMMIT, prop.block_hash, effects)
                     self.step = PRECOMMIT_STEP
                 self.valid_value = prop.block_hash

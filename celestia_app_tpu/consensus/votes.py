@@ -246,6 +246,14 @@ class Equivocation:
     def height(self) -> int:
         return self.vote_a.height
 
+    def key(self) -> tuple:
+        """The dedup identity (one equivocation per coordinates is enough
+        to tombstone) — the single definition every pool/used-set uses."""
+        return (
+            self.validator, self.height, self.vote_a.round,
+            self.vote_a.vote_type,
+        )
+
 
 def find_equivocations(votes) -> list[Equivocation]:
     """Scan votes (any iterable) for conflicting pairs per

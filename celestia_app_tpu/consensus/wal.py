@@ -1,0 +1,127 @@
+"""Consensus write-ahead log: double-sign protection across restarts.
+
+celestia-core persists a WAL and replays it on boot so a restarted
+validator never signs twice for the same (height, round, step) — the
+fault x/slashing tombstones for (VERDICT r2 §2.2: "no WAL").  This is
+the minimal safety core of that mechanism:
+
+  * every OWN vote is journaled (fsync) BEFORE it is broadcast; signing
+    a conflicting vote for coordinates already in the journal is refused
+    — even after a crash+restart wiped the in-memory machine;
+  * polka locks are journaled too, so a restarted validator resumes
+    locked on what it locked on (the cross-round safety input) instead
+    of prevoting fresh values.
+
+The journal is line-JSON, append-only, pruned by rewriting once the
+height moves far past (prune()).  It deliberately does NOT replay the
+full message stream (celestia-core's WAL also recovers liveness state);
+crash recovery here re-joins via catch-up, which this framework already
+does — the WAL only has to prevent equivocation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+class VoteWAL:
+    def __init__(self, path: str):
+        self.path = path
+        # (height, round, vote_type) -> block_hash hex
+        self.votes: dict[tuple[int, int, int], str] = {}
+        # height -> (locked_round, locked_value hex)
+        self.locks: dict[int, tuple[int, str]] = {}
+        self._load()
+        self._fh = open(path, "a", buffering=1)
+
+    def _load(self) -> None:
+        if not os.path.exists(self.path):
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            return
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail write from a crash: ignore
+                if rec.get("k") == "vote":
+                    self.votes[(rec["h"], rec["r"], rec["t"])] = rec["b"]
+                elif rec.get("k") == "lock":
+                    self.locks[rec["h"]] = (rec["r"], rec["b"])
+
+    def _append(self, rec: dict) -> None:
+        self._fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+    # --- the sign guard -----------------------------------------------------
+    def may_sign(self, height: int, round: int, vote_type: int,
+                 block_hash: bytes) -> bool:
+        """True iff signing this vote cannot be an equivocation.  Records
+        the vote (durably) when allowed — record-then-sign ordering, so a
+        crash between the two can at worst lose a vote, never double
+        one."""
+        key = (height, round, vote_type)
+        prior = self.votes.get(key)
+        if prior is not None:
+            return prior == block_hash.hex()  # idempotent re-sign is fine
+        self.votes[key] = block_hash.hex()
+        self._append({
+            "k": "vote", "h": height, "r": round, "t": vote_type,
+            "b": block_hash.hex(),
+        })
+        return True
+
+    # --- lock persistence ---------------------------------------------------
+    def record_lock(self, height: int, round: int, value: bytes) -> None:
+        self.locks[height] = (round, value.hex())
+        self._append({"k": "lock", "h": height, "r": round, "b": value.hex()})
+
+    def lock_for(self, height: int) -> tuple[int, bytes] | None:
+        got = self.locks.get(height)
+        if got is None:
+            return None
+        return got[0], bytes.fromhex(got[1])
+
+    # --- maintenance --------------------------------------------------------
+    def prune(self, below_height: int) -> None:
+        """Drop records for long-committed heights (rewrite in place)."""
+        self.votes = {k: v for k, v in self.votes.items() if k[0] >= below_height}
+        self.locks = {h: v for h, v in self.locks.items() if h >= below_height}
+        self._fh.close()
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            for (h, r, t), b in sorted(self.votes.items()):
+                f.write(json.dumps(
+                    {"k": "vote", "h": h, "r": r, "t": t, "b": b},
+                    separators=(",", ":"),
+                ) + "\n")
+            for h, (r, b) in sorted(self.locks.items()):
+                f.write(json.dumps(
+                    {"k": "lock", "h": h, "r": r, "b": b},
+                    separators=(",", ":"),
+                ) + "\n")
+            # The retained records still guard against double-signing:
+            # fsync BEFORE the rename (and the directory after), or a
+            # crash can persist the rename with an empty file and lose
+            # exactly the durability the journal exists for.
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        try:
+            dfd = os.open(os.path.dirname(self.path) or ".", os.O_RDONLY)
+            os.fsync(dfd)
+            os.close(dfd)
+        except OSError:
+            pass  # directory fsync is best-effort on odd filesystems
+        self._fh = open(self.path, "a", buffering=1)
+
+    def close(self) -> None:
+        try:
+            self._fh.close()
+        except OSError:
+            pass

@@ -103,3 +103,15 @@ class PriorityMempool:
         ]
         for k in expired:
             self._remove(k)
+
+    def resident_txs(self) -> list[bytes]:
+        """All resident txs in (priority desc, FIFO) order — the order a
+        proposer would take them (recheck runs in this order)."""
+        return [
+            e.tx for e in sorted(
+                self._entries.values(), key=lambda e: (-e.priority, e.seq)
+            )
+        ]
+
+    def remove_tx(self, tx: bytes) -> None:
+        self._remove(self.tx_key(tx))

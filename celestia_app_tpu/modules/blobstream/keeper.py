@@ -53,6 +53,13 @@ def get_data_commitment_window(store: KVStore) -> int:
 class BridgeValidator:
     address: str
     power: int
+    # The EVM address the orchestrator signs with: a 0x-hex string when
+    # registered via MsgRegisterEVMAddress, else None and the digest layer
+    # falls back to DefaultEVMAddress (the operator's own 20 payload
+    # bytes, reference types/types.go:13).  It MUST ride in the valset
+    # snapshot: the contract's stored valset uses the registered address,
+    # so a digest built from the default would diverge byte-for-byte.
+    evm_address: str | None = None
 
 
 @dataclass(frozen=True)
@@ -72,9 +79,11 @@ class Valset:
             + encode_varint_field(4, self.time_ns)
         )
         for m in self.members:
-            out += encode_bytes_field(
-                5, encode_bytes_field(1, m.address.encode()) + encode_varint_field(2, m.power)
-            )
+            member = encode_bytes_field(1, m.address.encode())
+            member += encode_varint_field(2, m.power)
+            if m.evm_address:
+                member += encode_bytes_field(3, m.evm_address.encode())
+            out += encode_bytes_field(5, member)
         return out
 
 
@@ -106,13 +115,15 @@ def _unmarshal_attestation(raw: bytes):
         members = []
         for num, wt, val in decode_fields(raw):
             if num == 5 and wt == WIRE_LEN:
-                addr, power = "", 0
+                addr, power, evm = "", 0, None
                 for mn, mwt, mval in decode_fields(val):
                     if mn == 1 and mwt == WIRE_LEN:
                         addr = mval.decode()
                     elif mn == 2 and mwt == WIRE_VARINT:
                         power = mval
-                members.append(BridgeValidator(addr, power))
+                    elif mn == 3 and mwt == WIRE_LEN:
+                        evm = mval.decode()
+                members.append(BridgeValidator(addr, power, evm))
         return Valset(
             fields.get(2, 0), fields.get(3, 0), fields.get(4, 0), tuple(members)
         )
@@ -237,7 +248,7 @@ class BlobstreamKeeper:
         # Valsets snapshot the ACTIVE set: a jailed validator must drop out
         # (the sdk builds them from bonded validators, keeper_valset.go).
         return tuple(
-            BridgeValidator(v.address, v.power)
+            BridgeValidator(v.address, v.power, self.evm_address(v.address))
             for v in self.staking.bonded_validators()
         )
 

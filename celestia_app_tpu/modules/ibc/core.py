@@ -54,6 +54,10 @@ class Channel:
     # (modules/ibc/handshake.py); empty for direct-OPEN test channels.
     # A connection-backed channel REQUIRES packet proofs on relay.
     connection_id: str = ""
+    # ibc-go channeltypes.Order: UNORDERED (transfer) or ORDERED (ICA).
+    # ORDERED channels enforce exact receive sequencing and CLOSE on a
+    # packet timeout (a gap can never be filled once its packet expired).
+    ordering: str = "UNORDERED"
 
     def marshal(self) -> bytes:
         out = (
@@ -66,6 +70,8 @@ class Channel:
         )
         if self.connection_id:
             out += encode_bytes_field(7, self.connection_id.encode())
+        if self.ordering != "UNORDERED":
+            out += encode_bytes_field(8, self.ordering.encode())
         return out
 
     @classmethod
@@ -74,6 +80,7 @@ class Channel:
         return cls(
             f[1].decode(), f[2].decode(), f[3].decode(), f[4].decode(),
             f[5].decode(), f[6].decode(), f.get(7, b"").decode(),
+            f.get(8, b"UNORDERED").decode(),
         )
 
 
@@ -226,7 +233,22 @@ class ChannelKeeper:
             or chan.counterparty_channel_id != packet.source_channel
         ):
             raise IBCError("packet routed to the wrong channel")
-        if self.has_receipt(packet):
+        if chan.ordering == "ORDERED":
+            # ibc-go ORDERED semantics: the receive sequence must be
+            # exactly the next expected (ErrPacketSequenceOutOfOrder);
+            # the counter, not receipts, is the replay protection.
+            recv_key = _chan_key(
+                b"nextrecvseq", packet.destination_port,
+                packet.destination_channel,
+            )
+            expected = int.from_bytes(self.store.get(recv_key) or b"\x01", "big")
+            if packet.sequence != expected:
+                raise IBCError(
+                    f"ordered channel {packet.destination_channel}: packet "
+                    f"sequence {packet.sequence} != next expected {expected}"
+                )
+            self.store.set(recv_key, (expected + 1).to_bytes(8, "big"))
+        elif self.has_receipt(packet):
             raise IBCError(
                 f"packet sequence {packet.sequence} already received"
             )
@@ -301,12 +323,15 @@ class ChannelKeeper:
 
     def timeout_packet(self, packet: Packet, proof_height: int, proof_time_ns: int) -> None:
         """TimeoutPacket: the packet must actually be past its timeout as
-        observed on the counterparty (height/time supplied by the relayer's
-        proof in the reference; trusted here).  NO channel-state check:
-        in-flight packets on a CLOSED channel must still flush through
-        timeouts (ibc-go TimeoutPacket works on any state so escrows can
-        refund after a close)."""
-        self._check_counterparty_routing(packet)
+        observed on the counterparty (height/time from the relayer's
+        verified proof / attested consensus time).  NO channel-state
+        check: in-flight packets on a CLOSED channel must still flush
+        through timeouts (ibc-go TimeoutPacket works on any state so
+        escrows can refund after a close).  On an ORDERED channel the
+        timeout CLOSES the channel (ibc-go timeoutExecuted): the expired
+        sequence leaves a hole the receiver's exact-order rule can never
+        accept past."""
+        chan = self._check_counterparty_routing(packet)
         timed_out = (
             not packet.timeout_height.is_zero()
             and proof_height >= packet.timeout_height.revision_height
@@ -317,3 +342,12 @@ class ChannelKeeper:
         if not timed_out:
             raise IBCError("packet has not timed out yet")
         self._delete_commitment(packet)
+        if chan.ordering == "ORDERED" and chan.state != "CLOSED":
+            self.store.set(
+                _chan_key(b"chan", chan.port, chan.channel_id),
+                Channel(
+                    chan.port, chan.channel_id, chan.counterparty_port,
+                    chan.counterparty_channel_id, "CLOSED", chan.version,
+                    chan.connection_id, chan.ordering,
+                ).marshal(),
+            )

@@ -208,12 +208,34 @@ class ChannelHandshake:
             )
         return end
 
+    @staticmethod
+    def _ordering_for(port: str, counterparty_port: str) -> str:
+        """Channel ordering by application (ibc-go: the app module picks
+        it at handshake time): ICA runs over ORDERED channels, transfer
+        (and everything else here) over UNORDERED.  Both ends derive the
+        same answer (the ports swap but the rule is symmetric), and it is
+        part of the proven channel ends, so a mismatch fails the
+        handshake."""
+        from celestia_app_tpu.modules.ibc.ica import (
+            CONTROLLER_PORT_PREFIX,
+            ICA_HOST_PORT,
+        )
+
+        ica = (
+            port == ICA_HOST_PORT
+            or counterparty_port == ICA_HOST_PORT
+            or port.startswith(CONTROLLER_PORT_PREFIX)
+            or counterparty_port.startswith(CONTROLLER_PORT_PREFIX)
+        )
+        return "ORDERED" if ica else "UNORDERED"
+
     def open_init(self, connection_id: str, port: str,
                   counterparty_port: str, version: str = "ics20-1") -> str:
         self._open_connection(connection_id)
         chan = Channel(
             port, self._next_channel_id(), counterparty_port, "",
             state="INIT", version=version, connection_id=connection_id,
+            ordering=self._ordering_for(port, counterparty_port),
         )
         self._save(chan)
         return chan.channel_id
@@ -224,10 +246,12 @@ class ChannelHandshake:
         version: str = "ics20-1",
     ) -> str:
         end = self._open_connection(connection_id)
+        ordering = self._ordering_for(port, counterparty_port)
         expected = Channel(
             counterparty_port, counterparty_channel_id, port, "",
             state="INIT", version=version,
             connection_id=end.counterparty_connection_id,
+            ordering=ordering,
         )
         self.connections.clients.verify_membership(
             end.client_id, proof_height,
@@ -237,7 +261,7 @@ class ChannelHandshake:
         chan = Channel(
             port, self._next_channel_id(), counterparty_port,
             counterparty_channel_id, state="TRYOPEN", version=version,
-            connection_id=connection_id,
+            connection_id=connection_id, ordering=ordering,
         )
         self._save(chan)
         self._on_chan_open_try(chan)
@@ -270,6 +294,7 @@ class ChannelHandshake:
             chan.counterparty_port, counterparty_channel_id, port, channel_id,
             state="TRYOPEN", version=chan.version,
             connection_id=end.counterparty_connection_id,
+            ordering=chan.ordering,
         )
         self.connections.clients.verify_membership(
             end.client_id, proof_height,
@@ -295,6 +320,7 @@ class ChannelHandshake:
             chan.counterparty_port, chan.counterparty_channel_id, port,
             channel_id, state="OPEN", version=chan.version,
             connection_id=end.counterparty_connection_id,
+            ordering=chan.ordering,
         )
         self.connections.clients.verify_membership(
             end.client_id, proof_height,
@@ -368,6 +394,7 @@ class ChannelHandshake:
             chan.counterparty_port, chan.counterparty_channel_id, port,
             channel_id, state="CLOSED", version=chan.version,
             connection_id=end.counterparty_connection_id,
+            ordering=chan.ordering,
         )
         self.connections.clients.verify_membership(
             end.client_id, proof_height,
@@ -429,8 +456,9 @@ def verify_timeout_proof(
     """MsgTimeout: the RECEIVER's proven state has NO receipt for the
     packet at `proof_height` (it never arrived), and the proof height
     itself is past the packet's height timeout — so it can never arrive
-    before timing out.  (Timestamp timeouts still use the local clock:
-    this chain's Commits don't carry counterparty time — scope note.)"""
+    before timing out.  Timestamp timeouts verify against the
+    counterparty's +2/3-attested block time (counterparty_proof_time),
+    not anyone's local clock."""
     _require_proof(proof, "non-receipt")
     conn = ConnectionKeeper(store)
     end = conn.connection(chan.connection_id)
@@ -439,3 +467,23 @@ def verify_timeout_proof(
         packet.sequence,
     )
     conn.clients.verify_non_membership(end.client_id, proof_height, key, proof)
+
+
+def counterparty_proof_time(store, chan: Channel, proof_height: int) -> int:
+    """The attested counterparty time bounding a non-receipt at
+    `proof_height` (ibc-go GetTimestampAtHeight over the 07-tendermint
+    consensus state).
+
+    The proven state is the counterparty's app hash AFTER its block
+    `proof_height`, pinned by the consensus state at proof_height + 1 —
+    whose time_ns is inside the +2/3-signed block id (consensus/votes.py
+    block_id).  Any future receipt lands in a block >= proof_height + 1
+    with a strictly later time (BFT time monotonicity, enforced at
+    proposal validation), so `cs.time_ns >= packet.timeout_timestamp`
+    proves the packet can never be accepted.  Returns 0 (= timestamp
+    timeout never provable; use a height timeout) for consensus states
+    recorded without a time."""
+    conn = ConnectionKeeper(store)
+    end = conn.connection(chan.connection_id)
+    cs = conn.clients.consensus_state(end.client_id, proof_height + 1)
+    return cs.time_ns

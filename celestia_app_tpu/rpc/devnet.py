@@ -40,6 +40,7 @@ def run_validator(
     n_accounts: int = 4,
     mode: str = "gossip",
     peer_indices: list[int] | None = None,
+    wal_dir: str | None = None,
 ) -> None:
     """Serve validator `index` of `n`; blocks until killed.
 
@@ -48,7 +49,8 @@ def run_validator(
     changes.  mode="push": the legacy proposer-push round (one round per
     height, the round-1/2 plane).  `peer_indices` restricts this node's
     peer list (partial topologies, e.g. a ring, to exercise multi-hop
-    relay); default is fully connected.
+    relay); default is fully connected.  `wal_dir` enables the
+    double-sign WAL (one file per validator index).
     """
     keys = funded_keys(n_accounts)
     if peer_indices is None:
@@ -62,8 +64,14 @@ def run_validator(
     )
     driver = None
     if mode == "gossip":
+        import os as _os
+
         driver = node.enable_gossip_consensus(
-            interval_s=block_interval_ms / 1000.0
+            interval_s=block_interval_ms / 1000.0,
+            wal_path=(
+                _os.path.join(wal_dir, f"wal-{index}.jsonl")
+                if wal_dir else None
+            ),
         )
     server = serve(node, port=base_port + index, block_interval_s=None)
     print(f"validator {index}/{n} serving on {server.url} ({mode})", flush=True)
@@ -206,6 +214,8 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--mode", choices=["gossip", "push"], default="gossip")
     ap.add_argument("--peers", default=None,
                     help="comma-separated peer indices (default: all others)")
+    ap.add_argument("--wal-dir", default=None,
+                    help="directory for the double-sign WAL (off if unset)")
     args = ap.parse_args(argv)
     peer_indices = (
         [int(x) for x in args.peers.split(",") if x != ""]
@@ -214,7 +224,7 @@ def main(argv: list[str] | None = None) -> int:
     )
     run_validator(
         args.index, args.n, args.base_port, args.block_interval_ms,
-        mode=args.mode, peer_indices=peer_indices,
+        mode=args.mode, peer_indices=peer_indices, wal_dir=args.wal_dir,
     )
     return 0
 

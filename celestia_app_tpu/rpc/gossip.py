@@ -34,6 +34,7 @@ from celestia_app_tpu.consensus.machine import (
     BroadcastVote,
     Decided,
     EvidenceFound,
+    Locked,
     Proposal,
     RequestProposal,
     RoundMachine,
@@ -63,10 +64,28 @@ class ConsensusDriver:
     queued in an outbox and flushed after the lock is released.
     """
 
-    def __init__(self, node, timeouts=None, interval_s: float = 0.2):
+    def __init__(
+        self, node, timeouts=None, interval_s: float = 0.2,
+        latency_s: float = 0.0, jitter_s: float = 0.0,
+        wal_path: str | None = None,
+    ):
         self.node = node
         self.timeouts = timeouts or FAST_TIMEOUTS
         self.interval_s = interval_s
+        # Double-sign protection across restarts (consensus/wal.py): own
+        # votes journal durably before broadcast; locks are restored into
+        # the next machine for the same height.
+        self.wal = None
+        if wal_path is not None:
+            from celestia_app_tpu.consensus.wal import VoteWAL
+
+            self.wal = VoteWAL(wal_path)
+        # Chaos injection (the BitTwister analog, reference
+        # test/e2e/benchmark/benchmark.go:112-119): every peer send sleeps
+        # latency_s plus a deterministic per-message jitter in
+        # [0, jitter_s], modeling a slow link without containers.
+        self.latency_s = latency_s
+        self.jitter_s = jitter_s
         self.machine: RoundMachine | None = None
         # block_hash -> {"data": BlockData, "time_ns": int,
         #                "last_commit": dict|None, "evidence": list}
@@ -106,6 +125,8 @@ class ConsensusDriver:
         for t in self._timers:
             if t.is_alive():
                 t.join(timeout=5.0)
+        if self.wal is not None:
+            self.wal.close()
 
     def _new_height_locked(self, outbox: list) -> None:
         node = self.node
@@ -117,11 +138,22 @@ class ConsensusDriver:
             # push plane's is_proposer rotation shape.
             shift = (height - 1) % len(order)
             order = order[shift:] + order[:shift]
+        locked_round, locked_value = -1, None
+        sign_guard = None
+        if self.wal is not None:
+            restored = self.wal.lock_for(height)
+            if restored is not None:
+                locked_round, locked_value = restored
+            sign_guard = self.wal.may_sign
+            self.wal.prune(height - 2)
         self.machine = RoundMachine(
             node.chain_id, height, validators, order or ["<none>"],
             my_address=node._operator_address(),
             my_key=node.validator_key,
             timeouts=self.timeouts,
+            sign_guard=sign_guard,
+            locked_value=locked_value,
+            locked_round=locked_round,
         )
         self.valsets[height] = validators
         for h in [h for h in self.valsets if h < height - 128]:
@@ -165,12 +197,13 @@ class ConsensusDriver:
                 self._commit_decided_locked(e)
             elif isinstance(e, EvidenceFound):
                 eq = e.equivocation
-                key = (
-                    eq.validator, eq.height,
-                    eq.vote_a.round, eq.vote_a.vote_type,
-                )
-                if key not in self.node._used_evidence:
+                if eq.key() not in self.node._used_evidence:
                     self.evidence_pool.append(eq)
+            elif isinstance(e, Locked):
+                if self.wal is not None:
+                    self.wal.record_lock(
+                        self.machine.height, e.round, e.block_hash
+                    )
 
     def _schedule(self, t: ScheduleTimeout) -> None:
         if self._stopped or self.machine is None:
@@ -216,8 +249,7 @@ class ConsensusDriver:
             prev_commit = node._commits.get(height - 1)
             evidence = [
                 eq for eq in self.evidence_pool
-                if (eq.validator, eq.height, eq.vote_a.round,
-                    eq.vote_a.vote_type) not in node._used_evidence
+                if eq.key() not in node._used_evidence
             ]
             bid = block_id(data.hash, node.app.cms.last_app_hash, time_ns)
             self.payloads[bid] = {
@@ -256,13 +288,10 @@ class ConsensusDriver:
         )
         node._commits[m.height] = record
         for eq in evidence:
-            node._used_evidence.add(
-                (eq.validator, eq.height, eq.vote_a.round, eq.vote_a.vote_type)
-            )
+            node._used_evidence.add(eq.key())
         self.evidence_pool = [
             eq for eq in self.evidence_pool
-            if (eq.validator, eq.height, eq.vote_a.round, eq.vote_a.vote_type)
-            not in node._used_evidence
+            if eq.key() not in node._used_evidence
         ]
         self.payloads.clear()
         self.machine = None
@@ -295,6 +324,14 @@ class ConsensusDriver:
                 except ConsensusError:
                     pass
 
+    #: Re-relay fan-out cap.  The ORIGINATOR of a message already sends it
+    #: to every peer directly (full one-hop coverage on healthy links);
+    #: receiver relays exist to route around dead/slow links, so a small
+    #: deterministic subset suffices — without the cap the flood costs
+    #: O(n^2) sends per message, which drowns large devnets (the
+    #: reference's gossip also maintains a bounded peer set, not a clique).
+    RELAY_FANOUT = 6
+
     # --- ingress -----------------------------------------------------------
     def handle(self, msg: dict) -> dict:
         """rpc_consensus: dedup, relay, process.  Returns a small ack."""
@@ -306,7 +343,7 @@ class ConsensusDriver:
             if len(self.seen) > 100_000:
                 self.seen.clear()  # crude bound; dedup re-warms quickly
         # Relay FIRST and outside the lock (flood; dedup terminates it).
-        self.node.gossip_pool.submit(self._send_all, [msg])
+        self.node.gossip_pool.submit(self._relay, msg)
         try:
             self._process(msg)
         except ConsensusError:
@@ -317,9 +354,22 @@ class ConsensusDriver:
     def _msg_id(msg: dict) -> tuple:
         if msg.get("kind") == "vote":
             return ("vote", msg.get("vote", ""))
+        # The PAYLOAD is part of the identity: the proposal signature does
+        # not cover the block bytes (the signed block id does, indirectly),
+        # so without this a tampered relay copy would dedup-block the
+        # genuine message mesh-wide and censor an honest proposal.
+        import hashlib as _hashlib
+        import json as _json
+
+        payload = _hashlib.sha256(
+            _json.dumps(
+                [msg.get("block"), msg.get("last_commit"), msg.get("evidence")],
+                sort_keys=True, separators=(",", ":"), default=str,
+            ).encode()
+        ).hexdigest()
         return (
             "proposal", msg.get("height"), msg.get("round"),
-            msg.get("proposer"), msg.get("block_hash"),
+            msg.get("proposer"), msg.get("block_hash"), payload,
         )
 
     def _process(self, msg: dict) -> None:
@@ -361,16 +411,32 @@ class ConsensusDriver:
                     int(msg["pol_round"]), msg["proposer"],
                     bytes.fromhex(msg["signature"]),
                 )
-                valid = m.verify_proposal(prop) and self._validate_payload(
-                    prop, msg
-                )
-                self._execute_locked(m.on_proposal(prop, valid), outbox)
+                if not m.verify_proposal(prop):
+                    # Unauthenticated garbage (forged signature, wrong
+                    # proposer): DROP.  Feeding it to the machine as an
+                    # invalid proposal would let any unauthenticated
+                    # sender draw a nil prevote per round — a liveness
+                    # DoS against an honest proposer.
+                    return
+                verdict = self._validate_payload(prop, msg)
+                if verdict is None:
+                    # Payload does not match the SIGNED block id (a
+                    # tampered relay copy, or this node's state diverged):
+                    # not the proposer's content — drop and let the
+                    # propose timeout govern, never blame the proposer.
+                    return
+                self._execute_locked(m.on_proposal(prop, verdict), outbox)
         self._send_all(outbox)
 
-    def _validate_payload(self, prop: Proposal, msg: dict) -> bool:
-        """Block-level validation under the node lock: the id binds the
-        payload to this node's state, the LastCommit is verified, and the
-        block passes ProcessProposal."""
+    def _validate_payload(self, prop: Proposal, msg: dict) -> bool | None:
+        """Block-level validation under the node lock.
+
+        Returns True (prevote it), False (the proposer's own signed
+        content is invalid: nil prevote), or None (the payload is NOT
+        what the proposer signed — tampered relay copy or local state
+        divergence — so drop without judging the proposer; the signed
+        block id binds data root, prev app hash, and time, which is what
+        separates the two cases)."""
         node = self.node
         block = msg.get("block") or {}
         try:
@@ -381,12 +447,9 @@ class ConsensusDriver:
             )
             time_ns = int(block["time_ns"])
         except (KeyError, ValueError):
-            return False
-        # The proposal's block id must be THIS node's view of the block:
-        # a diverged proposer (or a diverged self) fails here and the
-        # proposal draws a nil prevote.
+            return None  # malformed relay copy, not the proposer's content
         if block_id(data.hash, node.app.cms.last_app_hash, time_ns) != prop.block_hash:
-            return False
+            return None
         if time_ns <= node.app.last_block_time_ns:
             return False  # block time must advance (BFT time monotonicity)
         # LastCommit: required after height 1; must attest the block id
@@ -427,15 +490,52 @@ class ConsensusDriver:
         return True
 
     # --- egress ------------------------------------------------------------
+    def _relay(self, msg: dict) -> None:
+        """Re-relay a received message to a bounded, deterministic peer
+        subset (see RELAY_FANOUT)."""
+        peers = self.node.peers()
+        if len(peers) > self.RELAY_FANOUT:
+            import hashlib as _hashlib
+
+            start = _hashlib.sha256(repr(self._msg_id(msg)).encode()).digest()[0]
+            start %= len(peers)
+            peers = [
+                peers[(start + i) % len(peers)]
+                for i in range(self.RELAY_FANOUT)
+            ]
+        for peer in peers:
+            self._send_to(peer, [msg])
+
     def _send_all(self, msgs: list) -> None:
+        """Originator broadcast: every peer, full coverage."""
         if not msgs:
             return
-        for peer in self.node.peers():
-            for msg in msgs:
-                try:
-                    peer.consensus(msg)
-                except Exception:
-                    continue  # unreachable peer: the flood routes around it
+        peers = self.node.peers()
+        if self.latency_s or self.jitter_s:
+            # Per-peer fan-out so injected latency costs one delay, not
+            # one per link (a real network delays links in parallel).
+            for peer in peers:
+                self.node.gossip_pool.submit(self._send_to, peer, list(msgs))
+            return
+        for peer in peers:
+            self._send_to(peer, msgs)
+
+    def _send_to(self, peer, msgs: list) -> None:
+        import time as _time
+
+        for msg in msgs:
+            if self.latency_s or self.jitter_s:
+                jitter = 0.0
+                if self.jitter_s:
+                    import hashlib as _hashlib
+
+                    digest = _hashlib.sha256(repr(msg).encode()).digest()
+                    jitter = self.jitter_s * digest[0] / 255.0
+                _time.sleep(self.latency_s + jitter)
+            try:
+                peer.consensus(msg)
+            except Exception:
+                continue  # unreachable peer: the flood routes around it
 
     def _send_all_later(self, msgs: list) -> None:
         if msgs:

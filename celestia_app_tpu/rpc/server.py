@@ -132,13 +132,20 @@ class ServingNode(TestNode):
         """Shared executor for async gossip sends (tx relay + consensus
         flood).  A pool, not ad-hoc threads: NodeServer.stop drains it so
         no send outlives the server (stray daemon threads dying inside
-        C-runtime calls abort the interpreter at exit)."""
+        C-runtime calls abort the interpreter at exit).  Sized up under
+        chaos latency injection — injected sleeps park workers, and an
+        8-worker pool would serialize a block's worth of sends behind
+        them."""
         pool = getattr(self, "_gossip_pool", None)
         if pool is None:
             from concurrent.futures import ThreadPoolExecutor
 
+            driver = getattr(self, "consensus_driver", None)
+            workers = 8
+            if driver is not None and (driver.latency_s or driver.jitter_s):
+                workers = 48
             pool = self._gossip_pool = ThreadPoolExecutor(
-                max_workers=8, thread_name_prefix="gossip"
+                max_workers=workers, thread_name_prefix="gossip"
             )
         return pool
 
@@ -191,8 +198,7 @@ class ServingNode(TestNode):
         return [
             ev
             for ev in find_equivocations(votes)
-            if (ev.validator, ev.height, ev.vote_a.round, ev.vote_a.vote_type)
-            not in self._used_evidence
+            if ev.key() not in self._used_evidence
         ]
 
     def _sign_vote(self, height: int, vote_type: int, block_hash: bytes):
@@ -250,9 +256,7 @@ class ServingNode(TestNode):
         self._version_by_height[height] = proposal_version
         self._prevoted.pop(height, None)  # round done
         for ev in evidence:
-            self._used_evidence.add(
-                (ev.validator, ev.height, ev.vote_a.round, ev.vote_a.vote_type)
-            )
+            self._used_evidence.add(ev.key())
         # Bound the evidence pool (Tendermint prunes expired evidence).
         for h in [h for h in self._witnessed if h < height - 100]:
             del self._witnessed[h]
@@ -733,13 +737,20 @@ class ServingNode(TestNode):
         return None if commit is None else commit.to_json()
 
     # --- gossip consensus (rpc/gossip.py) ------------------------------------
-    def enable_gossip_consensus(self, timeouts=None, interval_s: float = 0.2):
+    def enable_gossip_consensus(
+        self, timeouts=None, interval_s: float = 0.2,
+        latency_s: float = 0.0, jitter_s: float = 0.0,
+        wal_path: str | None = None,
+    ):
         """Attach a ConsensusDriver (multi-round Tendermint machine over
-        p2p flood gossip).  Call driver.start() once peers are serving."""
+        p2p flood gossip).  Call driver.start() once peers are serving.
+        latency_s/jitter_s inject per-send delay (chaos tier); wal_path
+        enables the double-sign WAL (consensus/wal.py)."""
         from celestia_app_tpu.rpc.gossip import ConsensusDriver
 
         self.consensus_driver = ConsensusDriver(
-            self, timeouts=timeouts, interval_s=interval_s
+            self, timeouts=timeouts, interval_s=interval_s,
+            latency_s=latency_s, jitter_s=jitter_s, wal_path=wal_path,
         )
         return self.consensus_driver
 

@@ -144,6 +144,15 @@ class TestNode:
         )
         self.app.commit()
         self.mempool.update(self.app.height, list(data.txs))
+        # Mempool RECHECK (CometBFT's recheck=true default): replay the
+        # resident txs through CheckTx against the fresh state.  This (a)
+        # evicts txs the new state invalidated, and (b) rebuilds the check
+        # state's sequence expectations to include resident txs — without
+        # it, a client pipelining sequences ahead of commits is rejected
+        # with a sequence mismatch the moment a block lands.
+        for raw in self.mempool.resident_txs():
+            if self.app.check_tx(raw).code != 0:
+                self.mempool.remove_tx(raw)
         self.blocks.append(data)
         self.block_times[self.app.height] = time_ns
         self.index_block(self.app.height, list(data.txs), results)

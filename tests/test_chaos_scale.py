@@ -1,0 +1,229 @@
+"""Chaos + scale tier: many validators, injected latency, sustained fill.
+
+Reference shape (VERDICT r2 item 9): the knuu e2e benchmark runs tens of
+validators on k8s with BitTwister latency injection
+(test/e2e/benchmark/benchmark.go:112-119, 70 ms per throughput.go:38) and
+passes only if every block carries >= 90% of MaxBlockBytes over a
+5-minute run (throughput.go:110-128).
+
+Containers are out of scope here, and so is the reference's hardware: its
+20+-validator runs get a CLUSTER (8 CPUs per validator); this image has
+ONE core for everything.  Measured on it, 20 loaded validators plus a
+saturating PFB loader livelock — a round's flood processing costs more
+than the round timeouts.  So the chaos dimensions are covered pairwise,
+both under the same 70 ms per-send injection:
+
+  * test_sustained_fill_under_latency — the THROUGHPUT criterion: a
+    gossip devnet under saturating PFB load sustains the 90%-fill bar
+    for 20 consecutive blocks (the 5-minute-equivalent at the 15 s goal
+    block time), with 8 validators (the per-core honest maximum);
+  * test_twenty_validators_agree_under_latency — the SCALE criterion:
+    >= 20 validators commit and agree through the latency-injected
+    flood (empty blocks; the load dimension is the other test's job).
+
+The load generator submits each signed PFB to every node directly
+(txsim's many-endpoints shape) so the fill measurement isolates
+consensus-under-latency; multi-hop mempool gossip propagation has its
+own test (tests/test_gossip_consensus.py ring topology).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.modules.blob.types import estimate_gas
+from celestia_app_tpu.rpc.server import ServingNode, serve
+from celestia_app_tpu.shares.namespace import Namespace
+from celestia_app_tpu.shares.sparse import Blob
+from celestia_app_tpu.testutil.benchmark import max_block_bytes
+from celestia_app_tpu.testutil.testnode import deterministic_genesis, funded_keys
+from celestia_app_tpu.user import Signer
+
+GOV_SQUARE = 16  # cap = 16*16*478 = 122 KB/block: the criterion is the
+# RATIO; 6 KB blobs (~13 shares) pack ~18 to a 256-share square = ~92%
+# byte fill (at gov-8 a single blob is 20% of the square and 90% is
+# geometrically unreachable)
+LATENCY_S = 0.07
+BLOCKS_REQUIRED = 20  # 5 min / 15 s goal block time
+BLOB_BYTES = 6_000
+
+
+def _cluster(n, interval_s=0.05, timeouts=None):
+    keys = funded_keys(2)
+    genesis = deterministic_genesis(
+        keys, n_validators=n, gov_max_square_size=GOV_SQUARE
+    )
+    nodes, servers = [], []
+    for i in range(n):
+        node = ServingNode(
+            genesis=genesis, keys=keys, validator_index=i, n_validators=n,
+        )
+        node.enable_gossip_consensus(
+            interval_s=interval_s,
+            timeouts=timeouts or {
+                "propose": (3.0, 1.0),
+                "prevote": (2.0, 0.5),
+                "precommit": (2.0, 0.5),
+            },
+            latency_s=LATENCY_S,
+        )
+        servers.append(serve(node, port=0, block_interval_s=None))
+        nodes.append(node)
+    for i, node in enumerate(nodes):
+        node.peer_urls = [s.url for j, s in enumerate(servers) if j != i]
+    return keys, nodes, servers
+
+
+@pytest.mark.slow
+class TestChaosScale:
+    def test_sustained_fill_under_latency(self):
+        from celestia_app_tpu.da.eds import warmup
+
+        warmup([1, 2, 4, 8, 16])  # compiles off the block path
+        # interval 2.5 s: at the flood's natural ~1 s/block cadence the
+        # loader (which must sign + CheckTx cap/blob txs against every
+        # node per wave, all on the same core) cannot refill between
+        # blocks and fills sag to ~0.3 — the goal-block-time model has
+        # 15 s between blocks precisely so producers ingest meanwhile.
+        keys, nodes, servers = _cluster(8, interval_s=2.5)
+        stop = threading.Event()
+        loader_err: list = []
+
+        def loader():
+            """Keep every mempool saturated: cap/blob + slack PFBs per
+            block, submitted to all nodes in sequence order."""
+            from celestia_app_tpu.state.accounts import AuthKeeper
+
+            rng = np.random.default_rng(11)
+            signer = Signer(nodes[0].chain_id)
+            acc = AuthKeeper(nodes[0].app.cms.working).get_account(
+                keys[0].public_key().address()
+            )
+            signer.add_account(keys[0], acc.account_number, acc.sequence)
+            addr = signer.addresses()[0]
+            per_wave = max_block_bytes(GOV_SQUARE) // BLOB_BYTES + 2
+            try:
+                while not stop.is_set():
+                    with nodes[0].lock:
+                        pool_bytes = nodes[0].mempool.size_bytes()
+                    if pool_bytes > 2 * max_block_bytes(GOV_SQUARE):
+                        time.sleep(0.05)
+                        continue
+                    for _ in range(per_wave):
+                        ns = Namespace.v0(
+                            rng.integers(1, 256, 10, dtype=np.uint8).tobytes()
+                        )
+                        blob = Blob(
+                            ns,
+                            rng.integers(0, 256, BLOB_BYTES, dtype=np.uint8)
+                            .tobytes(),
+                        )
+                        gas = estimate_gas([BLOB_BYTES])
+                        raw = signer.create_pay_for_blobs(addr, [blob], gas, gas)
+                        signer.increment_sequence(addr)
+                        for node in nodes:
+                            node.broadcast(raw, relay=False)
+                    # Post-commit recheck keeps the check state aware of
+                    # resident txs, so pipelined sequences just work; only
+                    # heal if committed state ran AHEAD of the signer.
+                    with nodes[0].lock:
+                        acc = AuthKeeper(nodes[0].app.cms.working).get_account(addr)
+                    if signer.account(addr).sequence < acc.sequence:
+                        signer.set_sequence(addr, acc.sequence)
+                    time.sleep(0.02)
+            except Exception as e:  # pragma: no cover — surfaced below
+                loader_err.append(e)
+
+        t = threading.Thread(target=loader, daemon=True)
+        t.start()
+        try:
+            for n in nodes:
+                n.consensus_driver.start()
+            cap = max_block_bytes(GOV_SQUARE)
+            deadline = time.monotonic() + 900
+            fills: dict[int, float] = {}
+            streak_start = None
+            while time.monotonic() < deadline:
+                with nodes[0].lock:
+                    h = nodes[0].app.height
+                    for height in range(1, h + 1):
+                        if height in fills:
+                            continue
+                        entry = nodes[0]._blocks_by_height.get(height)
+                        if entry is None:
+                            continue
+                        data = entry[0]
+                        fills[height] = sum(len(t_) for t_ in data.txs) / cap
+                # A run of BLOCKS_REQUIRED consecutive >=90% blocks passes
+                # (the first heights fill while the loader primes).
+                heights = sorted(fills)
+                run = 0
+                for height in heights:
+                    run = run + 1 if fills[height] >= 0.9 else 0
+                    if run >= BLOCKS_REQUIRED:
+                        streak_start = height - BLOCKS_REQUIRED + 1
+                        break
+                if streak_start is not None:
+                    break
+                time.sleep(0.25)
+            assert not loader_err, loader_err[0]
+            assert streak_start is not None, (
+                f"no {BLOCKS_REQUIRED}-block >=90% streak; fills="
+                f"{[(h, round(f, 2)) for h, f in sorted(fills.items())]}"
+            )
+            # All validators agree at the streak's end.
+            h = streak_start + BLOCKS_REQUIRED - 1
+            hashes = set()
+            for node in nodes:
+                with node.lock:
+                    if node.app.height >= h:
+                        hashes.add(node.app.cms.app_hash_at(h))
+            assert len(hashes) == 1
+            print(
+                f"\nchaos fill: {len(nodes)} validators, {LATENCY_S*1000:.0f}ms "
+                f"latency, >=90% fill blocks {streak_start}..{h}"
+            )
+        finally:
+            stop.set()
+            for s in servers:
+                s.stop()
+
+    def test_twenty_validators_agree_under_latency(self):
+        """The scale dimension: 20 validators' flood (70 ms per send)
+        commits blocks that every node agrees on."""
+        from celestia_app_tpu.da.eds import warmup
+
+        warmup([1, 2])
+        keys, nodes, servers = _cluster(
+            20, interval_s=0.05,
+            timeouts={
+                "propose": (6.0, 2.0),
+                "prevote": (4.0, 1.0),
+                "precommit": (4.0, 1.0),
+            },
+        )
+        try:
+            for n in nodes:
+                n.consensus_driver.start()
+            deadline = time.monotonic() + 900
+            target = 5
+            while time.monotonic() < deadline:
+                if min(n.app.height for n in nodes) >= target:
+                    break
+                time.sleep(0.25)
+            hts = [n.app.height for n in nodes]
+            assert min(hts) >= target, f"heights: {hts}"
+            h = min(hts)
+            assert len({n.app.cms.app_hash_at(h) for n in nodes}) == 1
+            rounds = {n._commits[h].round for n in nodes if h in n._commits}
+            print(
+                f"\nchaos scale: 20 validators, {LATENCY_S*1000:.0f}ms latency, "
+                f"height {h} committed (rounds seen: {sorted(rounds)})"
+            )
+        finally:
+            for s in servers:
+                s.stop()

@@ -1,0 +1,223 @@
+"""Consensus WAL: double-sign protection and lock recovery across
+restarts (celestia-core persists a WAL for exactly this — VERDICT r2
+§2.2 noted its absence).
+
+The property under test: a validator that crashes after signing a vote
+must NEVER sign a conflicting vote for the same (height, round, type)
+when it comes back — that pair is the equivocation x/slashing tombstones
+for — and it must resume holding any polka lock it had taken.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from celestia_app_tpu.consensus.machine import (
+    BroadcastVote,
+    Locked,
+    Proposal,
+    RoundMachine,
+)
+from celestia_app_tpu.consensus.votes import NIL, PREVOTE
+from celestia_app_tpu.consensus.wal import VoteWAL
+from celestia_app_tpu.crypto.keys import PrivateKey
+
+CHAIN = "wal-test"
+BLOCK_A = b"\xaa" * 32
+BLOCK_B = b"\xbb" * 32
+
+
+class TestVoteWAL:
+    def test_conflicting_vote_refused_same_value_allowed(self, tmp_path):
+        wal = VoteWAL(str(tmp_path / "wal.jsonl"))
+        assert wal.may_sign(5, 0, PREVOTE, BLOCK_A)
+        assert wal.may_sign(5, 0, PREVOTE, BLOCK_A)  # idempotent re-sign
+        assert not wal.may_sign(5, 0, PREVOTE, BLOCK_B)  # equivocation
+        assert wal.may_sign(5, 1, PREVOTE, BLOCK_B)  # new round: fine
+        assert wal.may_sign(6, 0, PREVOTE, BLOCK_B)  # new height: fine
+
+    def test_survives_restart(self, tmp_path):
+        path = str(tmp_path / "wal.jsonl")
+        wal = VoteWAL(path)
+        assert wal.may_sign(7, 2, PREVOTE, BLOCK_A)
+        wal.record_lock(7, 2, BLOCK_A)
+        wal.close()
+        # Reboot: the journal is the memory.
+        wal2 = VoteWAL(path)
+        assert not wal2.may_sign(7, 2, PREVOTE, BLOCK_B)
+        assert wal2.lock_for(7) == (2, BLOCK_A)
+
+    def test_prune_drops_old_heights_only(self, tmp_path):
+        path = str(tmp_path / "wal.jsonl")
+        wal = VoteWAL(path)
+        wal.may_sign(3, 0, PREVOTE, BLOCK_A)
+        wal.may_sign(9, 0, PREVOTE, BLOCK_A)
+        wal.record_lock(9, 0, BLOCK_A)
+        wal.prune(below_height=5)
+        wal.close()
+        wal2 = VoteWAL(path)
+        assert wal2.may_sign(3, 0, PREVOTE, BLOCK_B)  # pruned: free again
+        assert not wal2.may_sign(9, 0, PREVOTE, BLOCK_B)  # kept
+        assert wal2.lock_for(9) == (0, BLOCK_A)
+
+    def test_torn_tail_line_ignored(self, tmp_path):
+        path = str(tmp_path / "wal.jsonl")
+        wal = VoteWAL(path)
+        wal.may_sign(4, 0, PREVOTE, BLOCK_A)
+        wal.close()
+        with open(path, "a") as f:
+            f.write('{"k":"vote","h":5,"r":0')  # crash mid-write
+        wal2 = VoteWAL(path)
+        assert not wal2.may_sign(4, 0, PREVOTE, BLOCK_B)
+        assert wal2.may_sign(5, 0, PREVOTE, BLOCK_A)  # torn record: absent
+
+
+def _machines(tmp_path, n=4):
+    keys = [PrivateKey.from_seed(f"wal-val-{i}".encode()) for i in range(n)]
+    addrs = [k.public_key().address() for k in keys]
+    validators = {
+        a: (k.public_key(), 100) for a, k in zip(addrs, keys)
+    }
+    return keys, addrs, validators
+
+
+class TestMachineWithGuard:
+    def test_restarted_machine_cannot_equivocate(self, tmp_path):
+        """Machine signs a prevote for A, 'crashes', and the rebuilt
+        machine (fresh memory, same WAL) emits NO vote when pushed
+        toward B at the same coordinates."""
+        keys, addrs, validators = _machines(tmp_path)
+        path = str(tmp_path / "wal.jsonl")
+        wal = VoteWAL(path)
+        m = RoundMachine(
+            CHAIN, 1, validators, list(addrs),
+            my_address=addrs[3], my_key=keys[3], sign_guard=wal.may_sign,
+        )
+        m.start()
+        prop_a = Proposal(1, 0, BLOCK_A, -1, addrs[0])
+        prop_a = Proposal(
+            1, 0, BLOCK_A, -1, addrs[0],
+            keys[0].sign(prop_a.sign_bytes(CHAIN)),
+        )
+        effects = m.on_proposal(prop_a, valid=True)
+        votes = [e.vote for e in effects if isinstance(e, BroadcastVote)]
+        assert votes and votes[0].block_hash == BLOCK_A
+        wal.close()
+
+        # Crash + restart: new machine, empty memory, same journal.  A
+        # different proposal for the SAME round must draw no signature
+        # (not even nil — these coordinates are spent).
+        wal2 = VoteWAL(path)
+        m2 = RoundMachine(
+            CHAIN, 1, validators, list(addrs),
+            my_address=addrs[3], my_key=keys[3], sign_guard=wal2.may_sign,
+        )
+        m2.start()
+        prop_b = Proposal(1, 0, BLOCK_B, -1, addrs[0])
+        prop_b = Proposal(
+            1, 0, BLOCK_B, -1, addrs[0],
+            keys[0].sign(prop_b.sign_bytes(CHAIN)),
+        )
+        effects = m2.on_proposal(prop_b, valid=True)
+        assert not any(isinstance(e, BroadcastVote) for e in effects)
+
+    def test_lock_restored_after_restart(self, tmp_path):
+        """A validator that locked A pre-crash refuses a fresh proposal
+        of B in a later round post-crash (the WAL restores the lock)."""
+        from celestia_app_tpu.consensus.votes import Vote
+
+        keys, addrs, validators = _machines(tmp_path)
+        path = str(tmp_path / "wal.jsonl")
+        wal = VoteWAL(path)
+        m = RoundMachine(
+            CHAIN, 1, validators, list(addrs),
+            my_address=addrs[3], my_key=keys[3], sign_guard=wal.may_sign,
+        )
+        m.start()
+        prop_a = Proposal(1, 0, BLOCK_A, -1, addrs[0])
+        prop_a = Proposal(
+            1, 0, BLOCK_A, -1, addrs[0],
+            keys[0].sign(prop_a.sign_bytes(CHAIN)),
+        )
+        m.on_proposal(prop_a, valid=True)
+        locked = []
+        for i in (0, 1, 2):
+            effects = m.on_vote(Vote.sign(
+                keys[i], CHAIN, 1, PREVOTE, BLOCK_A,
+                validator=addrs[i], round=0,
+            ))
+            locked += [e for e in effects if isinstance(e, Locked)]
+        assert m.locked_value == BLOCK_A and locked
+        wal.record_lock(1, locked[0].round, locked[0].block_hash)
+        wal.close()
+
+        wal2 = VoteWAL(path)
+        restored = wal2.lock_for(1)
+        assert restored == (0, BLOCK_A)
+        m2 = RoundMachine(
+            CHAIN, 1, validators, list(addrs),
+            my_address=addrs[3], my_key=keys[3], sign_guard=wal2.may_sign,
+            locked_round=restored[0], locked_value=restored[1],
+        )
+        m2.start()
+        # Catch up to round 1 and show it a fresh B proposal: the
+        # restored lock forces a nil prevote.
+        for i in (0, 1):
+            m2.on_vote(Vote.sign(
+                keys[i], CHAIN, 1, PREVOTE, NIL, validator=addrs[i], round=1,
+            ))
+        assert m2.round == 1
+        prop_b = Proposal(1, 1, BLOCK_B, -1, addrs[1])
+        prop_b = Proposal(
+            1, 1, BLOCK_B, -1, addrs[1],
+            keys[1].sign(prop_b.sign_bytes(CHAIN)),
+        )
+        effects = m2.on_proposal(prop_b, valid=True)
+        votes = [e.vote for e in effects if isinstance(e, BroadcastVote)]
+        prevotes = [v for v in votes if v.vote_type == PREVOTE]
+        assert prevotes and prevotes[0].is_nil
+        assert m2.locked_value == BLOCK_A
+
+
+class TestDriverWAL:
+    def test_gossip_cluster_with_wal_advances(self, tmp_path):
+        """End to end: a 3-validator gossip cluster with WALs enabled
+        commits normally (the guard never blocks honest single-signing),
+        and the journals fill with each validator's votes."""
+        import time
+
+        from celestia_app_tpu.rpc.server import ServingNode, serve
+        from celestia_app_tpu.testutil.testnode import (
+            deterministic_genesis,
+            funded_keys,
+        )
+
+        keys = funded_keys(2)
+        nodes, servers = [], []
+        for i in range(3):
+            node = ServingNode(
+                genesis=deterministic_genesis(keys, n_validators=3),
+                keys=keys, validator_index=i, n_validators=3,
+            )
+            node.enable_gossip_consensus(
+                interval_s=0.1, wal_path=str(tmp_path / f"wal-{i}.jsonl")
+            )
+            servers.append(serve(node, port=0, block_interval_s=None))
+            nodes.append(node)
+        for i, node in enumerate(nodes):
+            node.peer_urls = [s.url for j, s in enumerate(servers) if j != i]
+        try:
+            for n in nodes:
+                n.consensus_driver.start()
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if all(n.app.height >= 3 for n in nodes):
+                    break
+                time.sleep(0.05)
+            assert all(n.app.height >= 3 for n in nodes)
+            for i in range(3):
+                assert (tmp_path / f"wal-{i}.jsonl").exists()
+                assert (tmp_path / f"wal-{i}.jsonl").stat().st_size > 0
+        finally:
+            for s in servers:
+                s.stop()

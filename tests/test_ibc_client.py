@@ -231,6 +231,43 @@ class TestVerifiedRelay:
         # Escrow refunded (minus the two tx fees paid on a).
         assert a.balance(sender.public_key().address()) == before - 20_000
 
+    def test_timestamp_timeout_verified_against_attested_time(self):
+        """Timestamp timeouts verify against the counterparty's
+        +2/3-attested consensus time (the time inside the signed block
+        id), never the local clock (VERDICT r2 item 7; previously a
+        lagging receiver could accept a packet the sender had already
+        refunded).  A timeout relay BEFORE the counterparty's attested
+        clock passes the deadline must fail even with a valid non-receipt
+        proof; after the counterparty provably moves past it, it
+        succeeds and refunds."""
+        from celestia_app_tpu.testutil.testnode import BLOCK_INTERVAL_NS
+
+        chains = VerifiedChains()
+        chains.handshake()
+        a, b = chains.a, chains.b
+        sender = a.keys[0]
+        before = a.balance(sender.public_key().address())
+        # Deadline 3 b-blocks ahead of b's current attested time: the
+        # first timeout attempt (which lands 2 b-blocks of sync) still
+        # sits BEFORE it; no height timeout at all.
+        deadline = b.node.app.last_block_time_ns + 3 * BLOCK_INTERVAL_NS
+        packet, res = chains.transfer(
+            a, b, sender, b.keys[0].public_key().address(), "utia", 700,
+            timeout_timestamp_ns=deadline,
+        )
+        assert res.code == 0, res.log
+        result, _ = chains.relay_timeout(packet, a, b)
+        assert result.code != 0 and "not timed out" in result.log
+        # b's chain provably advances past the deadline; now it verifies.
+        for _ in range(3):
+            b.produce()
+        result, _ = chains.relay_timeout(packet, a, b)
+        assert result.code == 0, result.log
+        # Escrow refunded; only the transfer's own fee left the sender
+        # (the timeout relays are fee-paid by the relayer account).
+        assert a.balance(sender.public_key().address()) == before - 20_000
+
+
 class TestHalfOpenChannel:
     def test_tryopen_channel_rejects_packets(self):
         """A TRYOPEN channel awaiting open_confirm must not accept

@@ -119,3 +119,27 @@ class TestBlobstreamDigests:
         assert evm_address_bytes(addr) == payload.rjust(20, b"\x00")
         # Registered 0x addresses pass through.
         assert evm_address_bytes("0x" + "ab" * 20) == bytes.fromhex("ab" * 20)
+
+    def test_registered_evm_address_overrides_default(self):
+        """A validator that registered an EVM address via
+        MsgRegisterEVMAddress must appear in valset digests under THAT
+        address (the contract's stored valset uses it), not the
+        operator-bytes default — and the registration must survive the
+        valset snapshot's wire round trip."""
+        from celestia_app_tpu.crypto.keys import PrivateKey
+        from celestia_app_tpu.modules.blobstream.keeper import (
+            Valset,
+            _unmarshal_attestation,
+        )
+
+        op = PrivateKey.from_seed(b"evm-reg").public_key().address()
+        registered = "0x" + "cd" * 20
+        default_member = BridgeValidator(op, 100)
+        registered_member = BridgeValidator(op, 100, registered)
+        assert valset_hash((default_member,)) != valset_hash((registered_member,))
+        assert evm_address_bytes(registered) == bytes.fromhex("cd" * 20)
+        # Wire round trip keeps the registration.
+        vs = Valset(3, 7, 1_000, (registered_member,))
+        back = _unmarshal_attestation(vs.marshal())
+        assert back.members[0].evm_address == registered
+        assert valset_hash(back.members) == valset_hash((registered_member,))
