@@ -101,6 +101,12 @@ class GasKVStore:
         return self._inner.has(key)
 
     def iterate(self, prefix: bytes) -> list[tuple[bytes, bytes]]:
+        # The flat-dict store scans the prefix eagerly (unlike the sdk's
+        # lazy IAVL iterator), so the scan itself cannot be interrupted
+        # mid-way; gas is still charged per entry so OutOfGas fires at
+        # the same consumption point and the tx is rejected
+        # deterministically — the meter bounds what a tx can COMMIT, the
+        # store's own cost model bounds the scan.
         out = self._inner.iterate(prefix)
         for k, v in out:
             self._meter.consume(

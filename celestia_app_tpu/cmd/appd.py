@@ -217,6 +217,13 @@ def cmd_start(args) -> int:
               flush=True)
     node = None
     peers = [u for u in (getattr(args, "peers", "") or "").split(",") if u]
+    if peers and not getattr(args, "n_validators", 0):
+        # A peer list without the network size would quietly run a
+        # single-validator valset that self-commits with a quorum of one
+        # and forks from the network it was told to join.
+        print("FATAL: --peers requires --n-validators (the network's "
+              "total validator count)", file=sys.stderr)
+        return 1
     if getattr(args, "serve", False) or peers:
         from celestia_app_tpu.rpc.server import ServingNode, serve as rpc_serve
 

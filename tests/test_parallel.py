@@ -37,3 +37,51 @@ def test_device_count_must_divide():
     mesh = default_mesh(8)
     with pytest.raises(ValueError):
         sharded_extend_and_dah(random_ods(4, 0), mesh)
+
+
+class TestShardedRepair:
+    """Sharded repair == single-chip repair == the original square, bit
+    for bit (VERDICT r3 item 6's sharded variant: decode sweeps split
+    line-wise across the mesh, verification on the sharded pipeline)."""
+
+    @pytest.mark.parametrize("k,n", [(8, 8), (8, 4), (4, 2)])
+    def test_quadrant_erasure_matches(self, k, n):
+        from celestia_app_tpu.da.dah import DataAvailabilityHeader
+        from celestia_app_tpu.parallel.sharded_repair import sharded_repair
+
+        mesh = default_mesh(n)
+        ods = random_ods(k, seed=k * 7 + n)
+        ref = ExtendedDataSquare.compute(ods)
+        full = ref.squared()
+        dah = DataAvailabilityHeader.from_eds(ref)
+        present = np.ones((2 * k, 2 * k), dtype=bool)
+        present[k:, k:] = False  # Q3 gone
+        damaged = full.copy()
+        damaged[~present] = 0
+        out = sharded_repair(damaged, present, mesh, dah)
+        np.testing.assert_array_equal(out.squared(), full)
+        assert out.data_root() == ref.data_root()
+
+    def test_crossword_and_corruption(self):
+        from celestia_app_tpu.da.repair import RootMismatch
+        from celestia_app_tpu.parallel.sharded_repair import sharded_repair
+
+        mesh = default_mesh(4)
+        k = 4
+        ods = random_ods(k, seed=99)
+        ref = ExtendedDataSquare.compute(ods)
+        full = ref.squared()
+        # A pattern needing alternating row/col sweeps: kill most of two
+        # rows AND two columns.
+        present = np.ones((2 * k, 2 * k), dtype=bool)
+        present[1, 1:] = False
+        present[:, 2] = False
+        present[5, :k] = False
+        damaged = np.where(present[..., None], full, 0).astype(np.uint8)
+        out = sharded_repair(damaged, present, mesh)
+        np.testing.assert_array_equal(out.squared(), full)
+        # A corrupted survivor is rejected (survivors stay authoritative).
+        bad = damaged.copy()
+        bad[0, 0, 100] ^= 0xFF
+        with pytest.raises(RootMismatch):
+            sharded_repair(bad, present, mesh)
