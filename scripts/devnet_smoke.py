@@ -36,8 +36,11 @@ try:
 
     h0 = c.status()["height"]
     net.procs[0].send_signal(signal.SIGKILL); net.procs[0].wait(timeout=10)
-    c.wait_for_height(h0 + 5, timeout_s=120)
-    print("survived proposer kill:", c.status()["height"], ">=", h0 + 5, flush=True)
+    # +6 so the checked window [h0+2, h] spans >= 4 heights — with 4
+    # validators that guarantees at least one height whose round-0
+    # proposer is the dead node.
+    c.wait_for_height(h0 + 6, timeout_s=150)
+    print("survived proposer kill:", c.status()["height"], ">=", h0 + 6, flush=True)
 
     from celestia_app_tpu.consensus import verify_commit, block_id
     h = c.status()["height"] - 1
@@ -53,13 +56,29 @@ try:
     assert ok and rec.time_ns > 0
     assert rec.block_hash == block_id(rec.data_root, rec.prev_app_hash, rec.time_ns)
     dead = PK.from_seed(b"validator-0").public_key().address()
+    # Start at h0+2: consensus for h0+1 was in flight when the SIGKILL
+    # landed, so a precommit the dead node broadcast moments earlier can
+    # legitimately appear in that height's record.
     rounds = set()
-    for hh in range(h0 + 1, h + 1):
+    dead_proposer_heights = []
+    for hh in range(h0 + 2, h + 1):
         r = c.commit(hh)
-        if r is None: continue
+        assert r is not None, f"node lost the commit record for {hh}"
         rounds.add(r.round)
         assert all(v.validator != dead for v in r.precommits), hh
-    print("post-kill commit rounds seen:", sorted(rounds), flush=True)
+        # THE property this drive exists to prove: a height whose
+        # round-0 proposer is the dead validator must have committed in
+        # a later round (rotation: sorted addrs shifted by height-1).
+        order = sorted(vals)
+        if order[(hh - 1) % len(order)] == dead:
+            dead_proposer_heights.append(hh)
+            assert r.round >= 1, (
+                f"height {hh} had the dead round-0 proposer but "
+                f"committed in round {r.round}"
+            )
+    print("post-kill commit rounds seen:", sorted(rounds),
+          "dead-proposer heights:", dead_proposer_heights, flush=True)
+    assert dead_proposer_heights, "window missed every dead-proposer height"
     print("VERIFY OK", flush=True)
 finally:
     net.stop()

@@ -34,14 +34,18 @@ def test_fill_ratio_sane():
 
 @pytest.mark.slow
 def test_sustained_90pct_fill_gov_square_64():
-    """The reference's own pass bar at the mainnet default square size."""
+    """The reference's own pass bar at the mainnet default square size,
+    over the 5-minute-equivalent block count: throughput.go:110-128
+    sustains >= 90% of MaxBlockBytes for a 5-minute run, which at the
+    15 s goal block time is 20 consecutive blocks — every one of the 20
+    must pass (the round-2 review called 5 blocks statistically weak)."""
     keys = funded_keys(2)
     node = TestNode(deterministic_genesis(keys, gov_max_square_size=64), keys)
-    res = run_throughput(node, blocks=5, blob_size=50_000, target_fill=0.9)
+    res = run_throughput(node, blocks=20, blob_size=50_000, target_fill=0.9)
     assert res.sustained(0.9), (res.fills, res.mean_fill)
     assert res.blocks_per_second > 0, res
     print(
-        f"\nthroughput k=64: mean_fill={res.mean_fill:.3f} "
+        f"\nthroughput k=64 x20 blocks: mean_fill={res.mean_fill:.3f} "
         f"bytes/block={res.mean_block_bytes:.0f} "
         f"blocks/s={res.blocks_per_second:.3f}"
     )
