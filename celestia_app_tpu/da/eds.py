@@ -21,6 +21,7 @@ from celestia_app_tpu.constants import (
     PARITY_NAMESPACE_BYTES,
     SHARE_SIZE,
 )
+from celestia_app_tpu.gf.rs import active_construction
 from celestia_app_tpu.kernels.merkle import merkle_root_pow2
 from celestia_app_tpu.kernels.nmt import leaf_digests, tree_roots_from_digests
 from celestia_app_tpu.kernels.rs import extend_square_fn
@@ -44,9 +45,9 @@ def leaf_namespaces(eds: jnp.ndarray, k: int) -> tuple[jnp.ndarray, jnp.ndarray]
     return row_ns, col_ns
 
 
-def _pipeline(k: int):
+def _pipeline(k: int, construction: str):
     """ods (k,k,512) -> (eds, row_roots (2k,90), col_roots (2k,90), droot (32,))."""
-    extend = extend_square_fn(k)
+    extend = extend_square_fn(k, construction)
 
     def run(ods: jnp.ndarray):
         eds = extend(ods)
@@ -69,11 +70,23 @@ def _pipeline(k: int):
 
 
 @lru_cache(maxsize=None)
-def jit_pipeline(k: int):
-    return jax.jit(_pipeline(k))
+def _jit_pipeline(k: int, construction: str):
+    return jax.jit(_pipeline(k, construction))
 
 
-def warmup(square_sizes: list[int] | None = None, upto: int | None = None) -> list[int]:
+def jit_pipeline(k: int, construction: str | None = None):
+    """Cached fused pipeline, keyed on (k, RS construction) so an env-var
+    flip mid-process never serves a stale-generator compile.  Callers that
+    must stay on one construction across several dispatches (repair's
+    decode/verify pair, a live BlockPipeline) pass it explicitly."""
+    return _jit_pipeline(k, construction or active_construction())
+
+
+def warmup(
+    square_sizes: list[int] | None = None,
+    upto: int | None = None,
+    constructions: tuple[str, ...] | None = None,
+) -> list[int]:
     """AOT-compile the fused pipeline for the given square sizes.
 
     Servers call this at startup so no block ever pays a compile on the
@@ -81,15 +94,22 @@ def warmup(square_sizes: list[int] | None = None, upto: int | None = None) -> li
     block production; reference TimeoutPropose is 10s). Pass either an
     explicit list or `upto` for every power of two 1..upto. Returns the
     warmed sizes.
+
+    Only the given `constructions` (default: the active one) are warmed —
+    flipping $CELESTIA_RS_CONSTRUCTION after warmup puts the next block's
+    compile back on the critical path unless the flip target was listed.
     """
     if square_sizes is None:
         assert upto is not None, "pass square_sizes or upto"
         square_sizes = [1 << i for i in range((upto).bit_length())]
         square_sizes = [k for k in square_sizes if k <= upto]
-    for k in square_sizes:
-        ods = np.zeros((k, k, SHARE_SIZE), dtype=np.uint8)
-        out = jit_pipeline(k)(jnp.asarray(ods))
-        jax.block_until_ready(out)
+    if constructions is None:
+        constructions = (active_construction(),)
+    for construction in constructions:
+        for k in square_sizes:
+            ods = np.zeros((k, k, SHARE_SIZE), dtype=np.uint8)
+            out = jit_pipeline(k, construction)(jnp.asarray(ods))
+            jax.block_until_ready(out)
     return list(square_sizes)
 
 

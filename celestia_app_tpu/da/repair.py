@@ -17,7 +17,7 @@ stage through the host and ran 10x slower than the extend path):
     MXU (kernels/rs.py encode_axis with the group's R_bits as input — no
     recompile per pattern, one compile per (k, axis));
   * R_bits and the host-side Gaussian elimination behind it are cached
-    per (k, pattern), so repeated repairs of the same erasure shape (the
+    per (k, pattern, construction), so repeated repairs of the same erasure shape (the
     benchmark loop, retrying light nodes) skip both the O(k^3) host solve
     and the h2d upload of the expanded matrix.
 
@@ -39,6 +39,7 @@ from celestia_app_tpu.constants import SHARE_SIZE
 from celestia_app_tpu.da.dah import DataAvailabilityHeader
 from celestia_app_tpu.da.eds import ExtendedDataSquare, jit_pipeline
 from celestia_app_tpu.gf import codec_for_width
+from celestia_app_tpu.gf.rs import active_construction
 from celestia_app_tpu.kernels.rs import encode_axis
 
 
@@ -50,13 +51,28 @@ class RootMismatch(ValueError):
     """Repaired square does not match the DataAvailabilityHeader."""
 
 
+def _put_private(x: np.ndarray, sharding=None):
+    """device_put from a PRIVATE host copy.
+
+    The CPU backend may zero-copy alias suitably-aligned numpy buffers
+    into device arrays, and repair() mutates `present_host` in place while
+    async dispatches are still in flight — uploading the live buffer is a
+    data race (the round-3 nondeterministic RootMismatch).  A fresh copy
+    is owned solely by the returned device array.
+    """
+    arr = np.array(x, copy=True)
+    if sharding is not None:
+        return jax.device_put(arr, sharding)
+    return jax.device_put(arr)
+
+
 @lru_cache(maxsize=64)
-def _recover_bits_device(k: int, pattern: bytes):
+def _recover_bits_device(k: int, pattern: bytes, construction: str):
     """Device-resident bit-expanded recover matrix for one erasure
-    pattern of a width-2k axis line.  Cached: the host Gaussian
-    elimination is O(k^3) and the expanded matrix is the largest h2d
-    transfer of a repair."""
-    codec = codec_for_width(k)
+    pattern of a width-2k axis line.  Cached per (k, pattern,
+    construction): the host Gaussian elimination is O(k^3) and the
+    expanded matrix is the largest h2d transfer of a repair."""
+    codec = codec_for_width(k, construction)
     mask = np.frombuffer(pattern, dtype=bool)
     known_pos = np.nonzero(mask)[0][:k]
     R = codec.recover_matrix(known_pos)
@@ -66,32 +82,33 @@ def _recover_bits_device(k: int, pattern: bytes):
 
 
 @lru_cache(maxsize=None)
-def _jit_sweep(k: int, axis: int):
+def _jit_sweep(k: int, axis: int, construction: str):
     """One decode of up to 2k same-pattern lines along `axis`.
 
     data: (2k, 2k, S) uint8 (device); present: (2k, 2k) bool;
-    line_idx: (2k,) int32 — group lines, padded by REPEATING a group
-    member (duplicate scatter writes carry identical values, so the
-    padding is harmless); known_idx: (k,) int32; R_bits: (2k*m, k*m).
+    line_idx: (2k,) int32 — group lines, padded with the out-of-range
+    sentinel 2k (gathers clamp, and the scatter drops the padded writes
+    via mode="drop", so padding lanes never touch the square);
+    known_idx: (k,) int32; R_bits: (2k*m, k*m).
     Returns data with the group's lines decoded, survivors untouched.
     """
-    codec = codec_for_width(k)
+    codec = codec_for_width(k, construction)
     m = codec.field.m
 
     def sweep(data, present, line_idx, known_idx, R_bits):
         if axis == 0:
-            rows = data[line_idx]  # (L, 2k, S)
+            rows = data[line_idx]  # (L, 2k, S); padded lanes clamp
             known = jnp.take(rows, known_idx, axis=1)  # (L, k, S)
             full = encode_axis(known, R_bits, m, contract_axis=1)  # (L, 2k, S)
-            pm = present[line_idx][..., None]  # (L, 2k, 1)
+            pm = present[jnp.clip(line_idx, 0, 2 * k - 1)][..., None]
             mixed = jnp.where(pm, rows, full)
-            return data.at[line_idx].set(mixed)
+            return data.at[line_idx].set(mixed, mode="drop")
         cols = data[:, line_idx]  # (2k, L, S)
         known = jnp.take(data, known_idx, axis=0)[:, line_idx]  # (k, L, S)
         full = encode_axis(known, R_bits, m, contract_axis=0)  # (2k, L, S)
-        pm = present[:, line_idx][..., None]  # (2k, L, 1)
+        pm = present[:, jnp.clip(line_idx, 0, 2 * k - 1)][..., None]
         mixed = jnp.where(pm, cols, full)
-        return data.at[:, line_idx].set(mixed)
+        return data.at[:, line_idx].set(mixed, mode="drop")
 
     return jax.jit(sweep)
 
@@ -114,9 +131,13 @@ def repair(
     if shares.shape != (n, n, SHARE_SIZE) or n % 2:
         raise ValueError(f"bad EDS shape {shares.shape}")
     k = n // 2
+    construction = active_construction()
 
+    # `shares` is never mutated here and repair() blocks on the consistency
+    # check before returning, so a plain (possibly zero-copy) upload is
+    # safe; only the in-place-mutated masks need private copies.
     damaged = jax.device_put(jnp.asarray(shares))
-    present_orig = jax.device_put(jnp.asarray(present_host))
+    present_orig = _put_private(present_host)
     data = damaged
 
     # Alternate row/column sweeps until complete: a line solved along one
@@ -135,12 +156,12 @@ def repair(
             patterns: dict[bytes, list[int]] = {}
             for i in np.nonzero(solvable)[0]:
                 patterns.setdefault(pm[i].tobytes(), []).append(int(i))
-            present_dev = jax.device_put(jnp.asarray(present_host))
+            present_dev = _put_private(present_host)
             for pat, lines in patterns.items():
-                R_bits, known_idx = _recover_bits_device(k, pat)
-                padded = lines + [lines[0]] * (2 * k - len(lines))
+                R_bits, known_idx = _recover_bits_device(k, pat, construction)
+                padded = lines + [2 * k] * (2 * k - len(lines))
                 line_idx = jnp.asarray(padded, dtype=jnp.int32)
-                data = _jit_sweep(k, axis)(
+                data = _jit_sweep(k, axis, construction)(
                     data, present_dev, line_idx, known_idx, R_bits
                 )
                 if axis == 0:
@@ -156,7 +177,10 @@ def repair(
     # Re-run the fused extension+roots pipeline on the recovered ODS: this
     # both re-derives parity and yields the roots for DAH verification.
     ods = data[:k, :k]
-    eds, rr, cr, droot = jit_pipeline(k)(ods)
+    # Use the construction captured at entry: re-resolving the env var here
+    # would let a mid-repair flip decode with one generator and verify with
+    # another.
+    eds, rr, cr, droot = jit_pipeline(k, construction)(ods)
     # Survivors are authoritative: the recomputed codeword must reproduce
     # every share that was present in the input (device-side check; only
     # one bool crosses back to the host).

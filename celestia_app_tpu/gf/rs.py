@@ -148,14 +148,22 @@ def _codec_cached(k: int, construction: str) -> RSCodec:
     return RSCodec(k, construction)
 
 
+def active_construction() -> str:
+    """The process-wide RS construction selected by $CELESTIA_RS_CONSTRUCTION.
+
+    Every cached device program that bakes a generator in (da/eds.py,
+    da/repair.py, kernels/rs.py, parallel/sharded_*.py) keys its cache on
+    this value, so flipping the env var mid-process selects a different
+    cache entry instead of silently serving stale compiles (the round-3
+    nondeterministic RootMismatch hazard)."""
+    return os.environ.get("CELESTIA_RS_CONSTRUCTION", "vandermonde")
+
+
 def codec_for_width(k: int, construction: str | None = None) -> RSCodec:
     """Cached codec for square size k (codewords are 2k wide).
 
     `construction` defaults to $CELESTIA_RS_CONSTRUCTION (or "vandermonde").
-    Note device pipelines (da/eds.py jit_pipeline, parallel/sharded_eds.py)
-    bake the generator in at first compile, so the env knob must be set
-    before the first square of a given size is extended in a process.
     """
     if construction is None:
-        construction = os.environ.get("CELESTIA_RS_CONSTRUCTION", "vandermonde")
+        construction = active_construction()
     return _codec_cached(k, construction)

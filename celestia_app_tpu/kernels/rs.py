@@ -35,7 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from celestia_app_tpu.gf.rs import codec_for_width
+from celestia_app_tpu.gf.rs import active_construction, codec_for_width
 
 # int8 feeds the MXU's integer path on TPU; exactness: 0/1 products with
 # <= 8192-term sums, far inside int32.
@@ -87,14 +87,15 @@ def encode_axis(
     return jnp.moveaxis(by.reshape(P, batch, S), 0, contract_axis)
 
 
-def extend_square_fn(k: int):
+def extend_square_fn(k: int, construction: str | None = None):
     """Returns eds = f(ods) for a fixed square size k.
 
     ods: (k, k, SHARE_SIZE) uint8 -> eds: (2k, 2k, SHARE_SIZE) uint8 with
     quadrants [[Q0, Q1], [Q2, Q3]] (row-parity right, column-parity below),
-    matching rsmt2d's quadrant layout.
+    matching rsmt2d's quadrant layout.  The RS construction is resolved at
+    build time; callers caching the result must key on it.
     """
-    codec = codec_for_width(k)
+    codec = codec_for_width(k, construction)
     m = codec.field.m
     G_bits = jnp.asarray(codec.generator_bits())
 
@@ -112,9 +113,14 @@ def extend_square_fn(k: int):
 
 
 @lru_cache(maxsize=None)
+def _jit_extend_square(k: int, construction: str):
+    return jax.jit(extend_square_fn(k, construction))
+
+
 def jit_extend_square(k: int):
-    """Cached jitted extension for square size k (one compile per k)."""
-    return jax.jit(extend_square_fn(k))
+    """Cached jitted extension for square size k (one compile per
+    (k, active RS construction))."""
+    return _jit_extend_square(k, active_construction())
 
 
 def extend_square(ods: np.ndarray) -> np.ndarray:
@@ -124,14 +130,14 @@ def extend_square(ods: np.ndarray) -> np.ndarray:
     return np.asarray(jit_extend_square(k)(jnp.asarray(ods, dtype=jnp.uint8)))
 
 
-def decode_axis_fn(k: int):
+def decode_axis_fn(k: int, construction: str | None = None):
     """Erasure decode along an axis as a constant matmul.
 
     Returns f(shares, R_bits) where shares is (R, k, S) holding the k known
     shares (already gathered) and R_bits the bit-expanded (2k*m, k*m) recovery
     matrix from RSCodec.recover_matrix - output is the full (R, 2k, S).
     """
-    codec = codec_for_width(k)
+    codec = codec_for_width(k, construction)
     m = codec.field.m
 
     def decode(known: jnp.ndarray, R_bits: jnp.ndarray) -> jnp.ndarray:

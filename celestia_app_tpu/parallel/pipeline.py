@@ -29,6 +29,7 @@ import jax
 import numpy as np
 
 from celestia_app_tpu.da.eds import ExtendedDataSquare, jit_pipeline
+from celestia_app_tpu.gf.rs import active_construction
 from celestia_app_tpu.trace import traced
 
 _SENTINEL = object()
@@ -49,7 +50,11 @@ class BlockPipeline:
             raise ValueError("depth must be >= 1")
         self.k = k
         self.depth = depth
-        self._pipe = jit_pipeline(k)
+        # A pipeline is bound to the RS construction active at creation:
+        # every block it streams uses this one generator, even if
+        # $CELESTIA_RS_CONSTRUCTION flips while blocks are in flight.
+        self.construction = active_construction()
+        self._pipe = jit_pipeline(k, self.construction)
         # submit -> _tasks -> [feeder thread: transfer + dispatch] -> _done
         # Both queues bounded by depth: at most `depth` squares in flight
         # on the device and `depth` ODS buffers waiting to transfer.

@@ -36,7 +36,7 @@ from celestia_app_tpu.constants import (
     PARITY_NAMESPACE_BYTES,
     SHARE_SIZE,
 )
-from celestia_app_tpu.gf.rs import codec_for_width
+from celestia_app_tpu.gf.rs import active_construction, codec_for_width
 from celestia_app_tpu.kernels.merkle import merkle_root_pow2
 from celestia_app_tpu.kernels.nmt import leaf_digests, tree_roots_from_digests
 from celestia_app_tpu.kernels.rs import encode_axis
@@ -46,7 +46,9 @@ def _parity_ns() -> jnp.ndarray:
     return jnp.frombuffer(PARITY_NAMESPACE_BYTES, dtype=jnp.uint8)
 
 
-def make_sharded_pipeline(k: int, mesh: Mesh, axis: str = "data"):
+def make_sharded_pipeline(
+    k: int, mesh: Mesh, axis: str = "data", construction: str | None = None
+):
     """Build the jitted multi-device pipeline for square size k.
 
     Returns f(ods) -> (eds, row_roots, col_roots, data_root) where ods is
@@ -58,7 +60,7 @@ def make_sharded_pipeline(k: int, mesh: Mesh, axis: str = "data"):
     n = mesh.shape[axis]
     if k % n:
         raise ValueError(f"device count {n} must divide square size {k}")
-    codec = codec_for_width(k)
+    codec = codec_for_width(k, construction)
     m = codec.field.m
     G_bits = jnp.asarray(codec.generator_bits())
 
@@ -154,12 +156,19 @@ def default_mesh(n: int | None = None, axis: str = "data") -> Mesh:
 def sharded_extend_and_dah(ods, mesh: Mesh, axis: str = "data"):
     """Host convenience: place a numpy ODS on the mesh and run the pipeline."""
     k = ods.shape[0]
-    fn = _cached_pipeline(k, mesh, axis)
+    fn = cached_pipeline(k, mesh, axis)
     sh = NamedSharding(mesh, P(axis, None, None))
     ods_dev = jax.device_put(jnp.asarray(ods, dtype=jnp.uint8), sh)
     return fn(ods_dev)
 
 
 @lru_cache(maxsize=None)
-def _cached_pipeline(k: int, mesh: Mesh, axis: str):
-    return make_sharded_pipeline(k, mesh, axis)
+def _cached_pipeline(k: int, mesh: Mesh, axis: str, construction: str):
+    return make_sharded_pipeline(k, mesh, axis, construction)
+
+
+def cached_pipeline(
+    k: int, mesh: Mesh, axis: str = "data", construction: str | None = None
+):
+    """Cached sharded pipeline keyed on (k, mesh, axis, RS construction)."""
+    return _cached_pipeline(k, mesh, axis, construction or active_construction())

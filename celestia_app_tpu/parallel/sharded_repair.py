@@ -32,15 +32,19 @@ from celestia_app_tpu.da.eds import ExtendedDataSquare
 from celestia_app_tpu.da.repair import (
     IrrecoverableSquare,
     RootMismatch,
+    _put_private,
     _recover_bits_device,
 )
 from celestia_app_tpu.gf import codec_for_width
+from celestia_app_tpu.gf.rs import active_construction
 from celestia_app_tpu.kernels.rs import encode_axis
-from celestia_app_tpu.parallel.sharded_eds import make_sharded_pipeline
+from celestia_app_tpu.parallel.sharded_eds import cached_pipeline
 
 
 @lru_cache(maxsize=None)
-def _sharded_sweep(k: int, axis_dim: int, mesh: Mesh, axis: str = "data"):
+def _sharded_sweep(
+    k: int, axis_dim: int, mesh: Mesh, axis: str, construction: str
+):
     """One decode of up to 2k same-pattern lines along `axis_dim`,
     line-sharded: each device decodes (2k)/n lines against the replicated
     square and the group's recover matrix.
@@ -49,23 +53,24 @@ def _sharded_sweep(k: int, axis_dim: int, mesh: Mesh, axis: str = "data"):
     the group's lines decoded (survivors authoritative), exactly like
     da/repair._jit_sweep but with the line batch split across the mesh.
     """
-    codec = codec_for_width(k)
+    codec = codec_for_width(k, construction)
     m = codec.field.m
 
     def local(data, present, line_idx_local, known_idx, R_bits):
         # data/present replicated; line_idx_local: this device's (2k)/n
-        # group lines (padded by repeating a member, so duplicate scatter
-        # writes carry identical values).
+        # group lines, padded with the out-of-range sentinel 2k (gathers
+        # clamp; the outer scatter drops padded writes via mode="drop").
+        clamped = jnp.clip(line_idx_local, 0, 2 * k - 1)
         if axis_dim == 0:
-            rows = data[line_idx_local]  # (L/n, 2k, S)
+            rows = data[clamped]  # (L/n, 2k, S)
             known = jnp.take(rows, known_idx, axis=1)
             full = encode_axis(known, R_bits, m, contract_axis=1)
-            pm = present[line_idx_local][..., None]
+            pm = present[clamped][..., None]
             return jnp.where(pm, rows, full)  # (L/n, 2k, S)
-        cols = data[:, line_idx_local]  # (2k, L/n, S)
-        known = jnp.take(data, known_idx, axis=0)[:, line_idx_local]
+        cols = data[:, clamped]  # (2k, L/n, S)
+        known = jnp.take(data, known_idx, axis=0)[:, clamped]
         full = encode_axis(known, R_bits, m, contract_axis=0)
-        pm = present[:, line_idx_local][..., None]
+        pm = present[:, clamped][..., None]
         mixed = jnp.where(pm, cols, full)  # (2k, L/n, S)
         return mixed.transpose(1, 0, 2)  # line-major for the out spec
 
@@ -80,8 +85,8 @@ def _sharded_sweep(k: int, axis_dim: int, mesh: Mesh, axis: str = "data"):
     def sweep(data, present, line_idx, known_idx, R_bits):
         mixed = sharded(data, present, line_idx, known_idx, R_bits)
         if axis_dim == 0:
-            return data.at[line_idx].set(mixed)
-        return data.at[:, line_idx].set(mixed.transpose(1, 0, 2))
+            return data.at[line_idx].set(mixed, mode="drop")
+        return data.at[:, line_idx].set(mixed.transpose(1, 0, 2), mode="drop")
 
     rep = NamedSharding(mesh, P())
     return jax.jit(
@@ -117,10 +122,13 @@ def sharded_repair(
 
     # Everything lives ON THE MESH from the start (replicated): mixing
     # single-device-committed arrays with mesh-sharded jit outputs in the
-    # final comparison is exactly the cross-sharding footgun.
+    # final comparison is exactly the cross-sharding footgun.  Uploads go
+    # through private copies — present_host is mutated in place below
+    # while dispatches are in flight (see da/repair._put_private).
+    construction = active_construction()
     rep = NamedSharding(mesh, P())
     damaged = jax.device_put(jnp.asarray(shares), rep)
-    present_orig = jax.device_put(jnp.asarray(present_host), rep)
+    present_orig = _put_private(present_host, rep)
     data = damaged
 
     while not present_host.all():
@@ -134,12 +142,12 @@ def sharded_repair(
             patterns: dict[bytes, list[int]] = {}
             for i in np.nonzero(solvable)[0]:
                 patterns.setdefault(pm[i].tobytes(), []).append(int(i))
-            present_dev = jax.device_put(jnp.asarray(present_host), rep)
+            present_dev = _put_private(present_host, rep)
             for pat, lines in patterns.items():
-                R_bits, known_idx = _recover_bits_device(k, pat)
-                padded = lines + [lines[0]] * (2 * k - len(lines))
+                R_bits, known_idx = _recover_bits_device(k, pat, construction)
+                padded = lines + [2 * k] * (2 * k - len(lines))
                 line_idx = jnp.asarray(padded, dtype=jnp.int32)
-                data = _sharded_sweep(k, axis_dim, mesh, axis)(
+                data = _sharded_sweep(k, axis_dim, mesh, axis, construction)(
                     data, present_dev, line_idx, known_idx, R_bits
                 )
                 if axis_dim == 0:
@@ -153,8 +161,9 @@ def sharded_repair(
             )
 
     # Verification on the SHARDED pipeline: re-extend the recovered ODS
-    # across the mesh and check survivors + DAH.
-    pipe = make_sharded_pipeline(k, mesh, axis)
+    # across the mesh and check survivors + DAH, with the construction
+    # captured at entry (a mid-repair env flip must not split decode/verify).
+    pipe = cached_pipeline(k, mesh, axis, construction)
     ods = jax.device_put(
         data[:k, :k], NamedSharding(mesh, P(axis, None, None))
     )

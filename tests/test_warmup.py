@@ -17,7 +17,7 @@ import time
 import numpy as np
 
 from celestia_app_tpu.constants import SHARE_SIZE
-from celestia_app_tpu.da.eds import jit_pipeline, warmup
+from celestia_app_tpu.da.eds import _jit_pipeline, jit_pipeline, warmup
 
 
 class TestWarmupBudget:
@@ -30,7 +30,7 @@ class TestWarmupBudget:
             compile_s[k] = time.perf_counter() - t0
         # Every size is resident in the jit cache now.
         for k in sizes:
-            assert jit_pipeline.cache_info().currsize >= len(sizes)
+            assert _jit_pipeline.cache_info().currsize >= len(sizes)
         # The block path's cost after warmup: dispatch + execute only.
         # It must be far under the first-call cost (which contains the
         # compile) — the margin that keeps compiles off TimeoutPropose.
