@@ -39,6 +39,7 @@ from celestia_app_tpu.app.gas import (
     MAX_MEMO_CHARACTERS,
     OutOfGas,
     SIG_VERIFY_COST_SECP256K1,
+    TX_SIG_LIMIT,
     TX_SIZE_COST_PER_BYTE,
 )
 from celestia_app_tpu.constants import CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
@@ -146,8 +147,11 @@ def run_ante(
         )
     except AnteError:
         raise
-    except OutOfGas as e:  # SetUpContextDecorator's recovery: out of gas -> reject
-        raise AnteError(str(e)) from e
+    except OutOfGas:
+        # Gas exhaustion keeps its type: baseapp runTx returns sdk
+        # ErrOutOfGas (code 11) whether the meter ran out in the ante
+        # chain or in execution — check/deliver map it to code 11 there.
+        raise
     except Exception as e:  # HandlePanicDecorator: panic -> reject, not crash
         raise AnteError(f"internal error in ante chain: {e!r}") from e
     ctx.store.write_back(tx_ctx.store)
@@ -242,10 +246,21 @@ def _run(
     priority = gas_price.mul_int(PRIORITY_SCALING_FACTOR).truncate_int()
 
     # Resolve the signer before moving money (DeductFee needs the fee payer —
-    # the first signer, pkg/user single-signer rule).
+    # the first signer, pkg/user single-signer rule).  The one signer may
+    # be a threshold multisig (LegacyAminoPubKey): the sdk default ante
+    # admits <= TxSigLimit = 7 sub-keys (NewValidateSigCountDecorator,
+    # app/ante/ante.go:15-82).
+    from celestia_app_tpu.tx.multisig import MultisigPubKey
+
     if len(auth.signer_infos) != 1 or len(tx.signatures) != 1:
         raise AnteError("exactly one signer required")
     info = auth.signer_infos[0]
+    is_multisig = isinstance(info.public_key, MultisigPubKey)
+    sub_keys = len(info.public_key.public_keys) if is_multisig else 1
+    if sub_keys > TX_SIG_LIMIT:
+        raise AnteError(
+            f"signatures: {sub_keys}, limit: {TX_SIG_LIMIT}"
+        )
     signer_addr = info.public_key.address()
     acc = ctx.auth.get_account(signer_addr)
     if acc is None:
@@ -292,7 +307,14 @@ def _run(
         )
         if expected and expected != signer_addr:
             raise AnteError(f"message signer {expected} != tx signer {signer_addr}")
-    meter.consume(SIG_VERIFY_COST_SECP256K1, "ante verify: secp256k1")
+    # Sig gas per participating sub-signature (the sdk's
+    # ConsumeMultisignatureVerificationGas; 1 for a plain key).
+    n_sigs = (
+        sum(1 for b in (info.mode_bits or ()) if b) if is_multisig else 1
+    )
+    meter.consume(
+        SIG_VERIFY_COST_SECP256K1 * max(n_sigs, 1), "ante verify: secp256k1"
+    )
     if info.sequence != acc.sequence:
         raise AnteError(
             f"account sequence mismatch, expected {acc.sequence}, got {info.sequence}"
@@ -317,7 +339,13 @@ def _run(
 
     # --- 18: sequence increment + pubkey persistence -------------------------
     if acc.pubkey == b"":
-        acc.pubkey = info.public_key.bytes
+        # Multisig keys persist their proto value bytes (sdk stores the
+        # whole LegacyAminoPubKey on the account the same way).
+        acc.pubkey = (
+            info.public_key.value_bytes()
+            if is_multisig
+            else info.public_key.bytes
+        )
     acc.sequence += 1
     ctx.auth.set_account(acc)
 

@@ -25,6 +25,7 @@ from celestia_app_tpu.constants import (
     SQUARE_SIZE_UPPER_BOUND,
 )
 from celestia_app_tpu.app.ante import AnteError, run_ante
+from celestia_app_tpu.app.gas import OutOfGas
 from celestia_app_tpu.da import DataAvailabilityHeader, extend_shares, min_data_availability_header
 from celestia_app_tpu.modules.blob.types import (
     BlobTxError,
@@ -286,6 +287,9 @@ class App:
         try:
             tx = Tx.unmarshal(inner)
             res = run_ante(self, ctx, tx, is_check_tx=True, tx_bytes=inner)
+        except OutOfGas as e:
+            checked.inc(result="rejected")
+            return TxResult(code=11, log=str(e))  # sdk ErrOutOfGas
         except (AnteError, ValueError) as e:
             checked.inc(result="rejected")
             return TxResult(code=1, log=str(e))
@@ -347,7 +351,7 @@ class App:
                     continue  # PFB outside a BlobTx is invalid
                 run_ante(self, ctx, tx, is_check_tx=False, tx_bytes=raw)
                 normal.append(raw)
-            except (AnteError, ValueError):
+            except (AnteError, ValueError, OutOfGas):
                 continue
         blob_entries = [(raw, btx) for raw, btx in classified if btx is not None]
         validated = validate_blob_txs_batched([b for _, b in blob_entries])
@@ -359,7 +363,7 @@ class App:
                     self, ctx, Tx.unmarshal(btx.tx), is_check_tx=False, tx_bytes=btx.tx
                 )
                 blob.append(raw)
-            except (AnteError, ValueError):
+            except (AnteError, ValueError, OutOfGas):
                 continue
         return normal + blob
 
@@ -525,6 +529,8 @@ class App:
         try:
             tx = Tx.unmarshal(inner)
             ante_res = run_ante(self, tx_ctx, tx, is_check_tx=False, tx_bytes=inner)
+        except OutOfGas as e:
+            return TxResult(code=11, log=str(e))  # sdk ErrOutOfGas, either phase
         except (AnteError, ValueError) as e:
             return TxResult(code=1, log=str(e))
 
@@ -574,6 +580,10 @@ class App:
         if isinstance(msg, MsgSend):
             total = sum(c.amount for c in msg.amount if c.denom == "utia")
             ctx.send_spendable(msg.from_address, msg.to_address, total)
+            # The sdk bank keeper creates the recipient account on first
+            # receive (x/bank SendCoins -> SetAccount): a freshly funded
+            # address — a multisig, say — must exist before it can sign.
+            ctx.auth.get_or_create(msg.to_address)
             return 0, [("transfer", msg.from_address, msg.to_address, total)]
         if isinstance(msg, MsgAuthzExec):
             return self._handle_authz_exec(ctx, msg, gas_remaining)

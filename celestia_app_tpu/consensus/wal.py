@@ -88,37 +88,50 @@ class VoteWAL:
         return got[0], bytes.fromhex(got[1])
 
     # --- maintenance --------------------------------------------------------
-    def prune(self, below_height: int) -> None:
-        """Drop records for long-committed heights (rewrite in place)."""
+    def prune(self, below_height: int) -> bool:
+        """Drop records for long-committed heights (rewrite in place).
+
+        Best-effort: a failed rewrite (disk full, EIO) leaves the on-disk
+        journal with its pre-prune content — superset of the in-memory
+        state, so double-sign protection is intact — and returns False.
+        The append handle is reopened in a finally either way: a failed
+        prune must never crash may_sign()/record_lock() on a running
+        validator, which is the vote-signing path.
+        """
         self.votes = {k: v for k, v in self.votes.items() if k[0] >= below_height}
         self.locks = {h: v for h, v in self.locks.items() if h >= below_height}
         self._fh.close()
         tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            for (h, r, t), b in sorted(self.votes.items()):
-                f.write(json.dumps(
-                    {"k": "vote", "h": h, "r": r, "t": t, "b": b},
-                    separators=(",", ":"),
-                ) + "\n")
-            for h, (r, b) in sorted(self.locks.items()):
-                f.write(json.dumps(
-                    {"k": "lock", "h": h, "r": r, "b": b},
-                    separators=(",", ":"),
-                ) + "\n")
-            # The retained records still guard against double-signing:
-            # fsync BEFORE the rename (and the directory after), or a
-            # crash can persist the rename with an empty file and lose
-            # exactly the durability the journal exists for.
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path)
         try:
-            dfd = os.open(os.path.dirname(self.path) or ".", os.O_RDONLY)
-            os.fsync(dfd)
-            os.close(dfd)
+            with open(tmp, "w") as f:
+                for (h, r, t), b in sorted(self.votes.items()):
+                    f.write(json.dumps(
+                        {"k": "vote", "h": h, "r": r, "t": t, "b": b},
+                        separators=(",", ":"),
+                    ) + "\n")
+                for h, (r, b) in sorted(self.locks.items()):
+                    f.write(json.dumps(
+                        {"k": "lock", "h": h, "r": r, "b": b},
+                        separators=(",", ":"),
+                    ) + "\n")
+                # The retained records still guard against double-signing:
+                # fsync BEFORE the rename (and the directory after), or a
+                # crash can persist the rename with an empty file and lose
+                # exactly the durability the journal exists for.
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            try:
+                dfd = os.open(os.path.dirname(self.path) or ".", os.O_RDONLY)
+                os.fsync(dfd)
+                os.close(dfd)
+            except OSError:
+                pass  # directory fsync is best-effort on odd filesystems
         except OSError:
-            pass  # directory fsync is best-effort on odd filesystems
-        self._fh = open(self.path, "a", buffering=1)
+            return False
+        finally:
+            self._fh = open(self.path, "a", buffering=1)
+        return True
 
     def close(self) -> None:
         try:

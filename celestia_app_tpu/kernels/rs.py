@@ -87,6 +87,55 @@ def encode_axis(
     return jnp.moveaxis(by.reshape(P, batch, S), 0, contract_axis)
 
 
+def _use_fft(k: int) -> bool:
+    """Whether the additive-FFT encode (kernels/fft.py) serves size k.
+
+    $CELESTIA_RS_FFT: "on" / "off" / "auto" (default).  Auto switches to
+    the FFT at k >= 64, where the grouped-butterfly op count pulls ahead
+    of the dense generator matmul.  Both paths produce identical bytes
+    (tests/test_fft.py pins it), so a stale cached choice is a perf
+    detail, never a correctness hazard — caches key on (k, construction)
+    only.
+    """
+    import os
+
+    mode = os.environ.get("CELESTIA_RS_FFT", "auto")
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    return k >= 64
+
+
+def encode_fn(k: int, construction: str | None = None):
+    """The encode-path selector: f(data, contract_axis) -> parity shares.
+
+    ONE owner for the FFT-vs-dense policy — both the single-chip square
+    extension and the sharded pipeline build their encode through here, so
+    the selection (and any future threshold/env change) cannot diverge
+    between them.  Large squares ride the additive FFT (see _use_fft),
+    small ones the dense generator matmul; identical bytes either way.
+    """
+    from celestia_app_tpu.gf.rs import active_construction as _active
+
+    codec = codec_for_width(k, construction)
+    m = codec.field.m
+    resolved = construction or _active()
+
+    if _use_fft(k):
+        from celestia_app_tpu.kernels.fft import encode_axis_fft
+
+        def encode(data: jnp.ndarray, contract_axis: int = 1) -> jnp.ndarray:
+            return encode_axis_fft(data, k, resolved, contract_axis)
+    else:
+        G_bits = jnp.asarray(codec.generator_bits())
+
+        def encode(data: jnp.ndarray, contract_axis: int = 1) -> jnp.ndarray:
+            return encode_axis(data, G_bits, m, contract_axis)
+
+    return encode
+
+
 def extend_square_fn(k: int, construction: str | None = None):
     """Returns eds = f(ods) for a fixed square size k.
 
@@ -95,18 +144,16 @@ def extend_square_fn(k: int, construction: str | None = None):
     matching rsmt2d's quadrant layout.  The RS construction is resolved at
     build time; callers caching the result must key on it.
     """
-    codec = codec_for_width(k, construction)
-    m = codec.field.m
-    G_bits = jnp.asarray(codec.generator_bits())
+    encode = encode_fn(k, construction)
 
     def extend(ods: jnp.ndarray) -> jnp.ndarray:
         # Row phase: each of the k rows is a codeword batch along cols.
-        q1 = encode_axis(ods, G_bits, m, contract_axis=1)  # (k, k, S)
+        q1 = encode(ods, 1)  # (k, k, S)
         top = jnp.concatenate([ods, q1], axis=1)  # (k, 2k, S)
         # Column phase: contract over the row axis directly - Q2 and Q3
         # arrive as the bottom rows with no transpose (row/col encodes
         # commute: EDS = [[Q0, Q0 G^T], [G Q0, G Q0 G^T]]).
-        bottom = encode_axis(top, G_bits, m, contract_axis=0)  # (k, 2k, S)
+        bottom = encode(top, 0)  # (k, 2k, S)
         return jnp.concatenate([top, bottom], axis=0)  # (2k, 2k, S)
 
     return extend

@@ -36,10 +36,9 @@ from celestia_app_tpu.constants import (
     PARITY_NAMESPACE_BYTES,
     SHARE_SIZE,
 )
-from celestia_app_tpu.gf.rs import active_construction, codec_for_width
+from celestia_app_tpu.gf.rs import active_construction
 from celestia_app_tpu.kernels.merkle import merkle_root_pow2
 from celestia_app_tpu.kernels.nmt import leaf_digests, tree_roots_from_digests
-from celestia_app_tpu.kernels.rs import encode_axis
 
 
 def _parity_ns() -> jnp.ndarray:
@@ -60,9 +59,9 @@ def make_sharded_pipeline(
     n = mesh.shape[axis]
     if k % n:
         raise ValueError(f"device count {n} must divide square size {k}")
-    codec = codec_for_width(k, construction)
-    m = codec.field.m
-    G_bits = jnp.asarray(codec.generator_bits())
+    from celestia_app_tpu.kernels.rs import encode_fn
+
+    _encode = encode_fn(k, construction)
 
     def local_step(ods_local: jnp.ndarray):
         # ods_local: (k/n, k, S) — this device's row block of the ODS.
@@ -70,7 +69,7 @@ def make_sharded_pipeline(
         i = lax.axis_index(axis)
 
         # Row phase: extend local rows. (k/n, k, S) -> (k/n, 2k, S)
-        q1 = encode_axis(ods_local, G_bits, m)
+        q1 = _encode(ods_local)
         top_local = jnp.concatenate([ods_local, q1], axis=1)
         # Materialize before the collective: XLA otherwise forwards the two
         # concat operands into a tuple all-to-all with mismatched layouts
@@ -86,7 +85,7 @@ def make_sharded_pipeline(
 
         # Column phase: extend every local column of the top half, yielding
         # Q2 and Q3 at once (row/col encodes commute).
-        bottom_cols = encode_axis(cols_local, G_bits, m)  # (2k/n, k, S)
+        bottom_cols = _encode(cols_local)  # (2k/n, k, S)
         full_cols = jnp.concatenate([cols_local, bottom_cols], axis=1)
         # full_cols: (2k/n, 2k, S) — column-sharded full EDS.
 

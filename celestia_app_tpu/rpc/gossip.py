@@ -90,7 +90,10 @@ class ConsensusDriver:
         # block_hash -> {"data": BlockData, "time_ns": int,
         #                "last_commit": dict|None, "evidence": list}
         self.payloads: dict[bytes, dict] = {}
-        self.seen: set = set()  # msg dedup (flood termination)
+        # msg dedup (flood termination): id -> height, pruned by height so
+        # the bound never wholesale-forgets in-flight heights (a clear()
+        # would let the current height's messages re-flood).
+        self.seen: dict[tuple, int] = {}
         # Messages that arrived between heights (machine torn down) or for
         # a near-future height: replayed when the next machine starts —
         # dedup marks them seen on arrival, so without this they'd be lost.
@@ -338,21 +341,90 @@ class ConsensusDriver:
 
     # --- ingress -----------------------------------------------------------
     def handle(self, msg: dict) -> dict:
-        """rpc_consensus: dedup, relay, process.  Returns a small ack."""
+        """rpc_consensus: dedup, authenticate, relay, process."""
         msg_id = self._msg_id(msg)
         with self.node.lock:
             if msg_id in self.seen:
                 return {"ok": True, "dup": True}
-            self.seen.add(msg_id)
+            self.seen[msg_id] = int(msg.get("height", 0) or 0)
             if len(self.seen) > 100_000:
-                self.seen.clear()  # crude bound; dedup re-warms quickly
-        # Relay FIRST and outside the lock (flood; dedup terminates it).
-        self.node.gossip_pool.submit(self._relay, msg)
+                cur = self.machine.height if self.machine else self.node.app.height
+                # Normal case: drop long-committed heights.  The claimed
+                # height is attacker-controlled, so this alone is not a
+                # bound — if a flood pins heights inside the live window,
+                # fall back to the hard clear() (dedup re-warms quickly);
+                # without it every further message pays an O(n) rebuild
+                # under the lock and memory grows without limit.
+                pruned = {
+                    i: h for i, h in self.seen.items() if cur - 2 <= h <= cur + 64
+                }
+                self.seen = pruned if len(pruned) <= 90_000 else {msg_id: 0}
+        # Relay outside the lock (flood; dedup terminates it) — but only
+        # AFTER wire-level authentication: dedup cannot bound an
+        # unauthenticated sender (every mutated junk copy hashes to a
+        # fresh id), so unverified bytes must never fan out mesh-wide.
+        if self._wire_verify(msg):
+            self.node.gossip_pool.submit(self._relay, msg)
         try:
             self._process(msg)
         except ConsensusError:
             return {"ok": False}
         return {"ok": True}
+
+    def _wire_verify(self, msg: dict) -> bool:
+        """Authenticate a message against the best-known validator set
+        WITHOUT applying it — the relay admission check.  A message that
+        fails (malformed, unknown signer, bad signature) is still handed
+        to _process (a backlogged future-height message may verify once
+        the valset catches up) but is not re-relayed by THIS node; the
+        originator already sent it to every peer directly."""
+        try:
+            with self.node.lock:
+                m = self.machine
+                vals = (
+                    dict(m.validators)
+                    if m is not None
+                    else self.node._validator_set()
+                )
+            kind = msg.get("kind")
+            if kind == "vote":
+                vote = Vote.unmarshal(bytes.fromhex(msg["vote"]))
+                entry = vals.get(vote.validator)
+                return entry is not None and vote.verify(
+                    entry[0], self.node.chain_id
+                )
+            if kind == "proposal":
+                prop = Proposal(
+                    int(msg["height"]), int(msg["round"]),
+                    bytes.fromhex(msg["block_hash"]), int(msg["pol_round"]),
+                    msg["proposer"], bytes.fromhex(msg["signature"]),
+                )
+                entry = vals.get(prop.proposer)
+                if entry is None or not entry[0].verify(
+                    prop.sign_bytes(self.node.chain_id), prop.signature
+                ):
+                    return False
+                # The proposal signature does NOT cover the block payload
+                # (only the signed block id binds it): without this check a
+                # tampered-payload copy of one honest proposal hashes to a
+                # fresh msg id yet still carries a valid signature — an
+                # unbounded relay flood of full block bytes.  Conservative
+                # on purpose: proposals for heights whose prev app hash we
+                # don't hold locally are not re-relayed (the originator
+                # already reached every peer one hop).
+                block = msg.get("block") or {}
+                try:
+                    bid = block_id(
+                        bytes.fromhex(block["data_hash"]),
+                        self.node.app.cms.last_app_hash,
+                        int(block["time_ns"]),
+                    )
+                except (KeyError, ValueError):
+                    return False
+                return bid == prop.block_hash
+            return False
+        except (KeyError, ValueError, TypeError):
+            return False
 
     @staticmethod
     def _msg_id(msg: dict) -> tuple:
@@ -476,8 +548,16 @@ class ConsensusDriver:
                 return False
             prev_vals = self.valsets.get(prop.height - 1)
             if prev_vals is None:
-                # No machine ran at H-1 here (catch-up gap): the current
-                # bonded set is the best available approximation.
+                # No machine ran at H-1 here (catch-up gap): the block
+                # store keeps the set every committed height ran under, so
+                # a freshly caught-up node verifies the H-1 precommits
+                # against the right set even across a jailing boundary.
+                prev_vals = getattr(node, "_valsets_by_height", {}).get(
+                    prop.height - 1
+                )
+            if prev_vals is None:
+                # Height H-1 predates this node entirely (state sync): the
+                # current bonded set is the last-resort approximation.
                 prev_vals = self.machine.validators
             if not verify_commit(prev_vals, node.chain_id, rec):
                 return False

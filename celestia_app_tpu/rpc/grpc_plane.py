@@ -1,0 +1,335 @@
+"""gRPC serving plane: the cosmos service surface ecosystem clients speak.
+
+The reference node serves gRPC alongside RPC/API
+(/root/reference/app/app.go:712-735; testnode wires all three,
+test/util/testnode/network.go:38-43).  This plane exposes the same service
+shapes over real gRPC (grpcio, generic byte-level handlers — no codegen;
+message codecs are hand-rolled on encoding/proto like the rest of the wire
+layer, protoc-cross-validated by tests/test_proto_wire.py):
+
+  cosmos.tx.v1beta1.Service/BroadcastTx            submit a signed TxRaw
+  cosmos.tx.v1beta1.Service/GetTx                  confirmation lookup
+  cosmos.auth.v1beta1.Query/Account                number/sequence for signing
+  cosmos.bank.v1beta1.Query/Balance                spot balance
+  cosmos.staking.v1beta1.Query/Validators          bonded set (txsim stake)
+  cosmos.base.tendermint.v1beta1.Service/GetLatestBlock   chain id + height
+
+`GrpcNode` is the client half: it implements the node surface TxClient
+consumes (broadcast / query_account / tx_status / validators / chain_id),
+so txsim and user.TxClient run unchanged against a gRPC endpoint — the
+done-criterion of VERDICT r3 next-step #6.
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+from dataclasses import dataclass
+
+from celestia_app_tpu.encoding.proto import (
+    WIRE_LEN,
+    WIRE_VARINT,
+    decode_fields,
+    encode_bytes_field,
+    encode_varint_field,
+)
+
+# --- message codecs (cosmos protos, standard field numbers) ----------------
+
+
+def _tx_response(height: int, txhash: str, code: int, raw_log: str,
+                 gas_wanted: int = 0, gas_used: int = 0) -> bytes:
+    """cosmos.base.abci.v1beta1.TxResponse {height=1, txhash=2, code=4,
+    raw_log=6, gas_wanted=10, gas_used=11}."""
+    out = b""
+    if height:
+        out += encode_varint_field(1, height)
+    out += encode_bytes_field(2, txhash.encode())
+    if code:
+        out += encode_varint_field(4, code)
+    if raw_log:
+        out += encode_bytes_field(6, raw_log.encode())
+    if gas_wanted:
+        out += encode_varint_field(10, gas_wanted)
+    if gas_used:
+        out += encode_varint_field(11, gas_used)
+    return out
+
+
+def _parse_tx_response(raw: bytes) -> dict:
+    out = {"height": 0, "txhash": "", "code": 0, "raw_log": "",
+           "gas_wanted": 0, "gas_used": 0}
+    for num, wt, val in decode_fields(raw):
+        if num == 1 and wt == WIRE_VARINT:
+            out["height"] = val
+        elif num == 2 and wt == WIRE_LEN:
+            out["txhash"] = val.decode()
+        elif num == 4 and wt == WIRE_VARINT:
+            out["code"] = val
+        elif num == 6 and wt == WIRE_LEN:
+            out["raw_log"] = val.decode()
+        elif num == 10 and wt == WIRE_VARINT:
+            out["gas_wanted"] = val
+        elif num == 11 and wt == WIRE_VARINT:
+            out["gas_used"] = val
+    return out
+
+
+def _field_str(raw: bytes, num: int) -> str:
+    for n, wt, val in decode_fields(raw):
+        if n == num and wt == WIRE_LEN:
+            return val.decode()
+    return ""
+
+
+def _field_bytes(raw: bytes, num: int) -> bytes:
+    for n, wt, val in decode_fields(raw):
+        if n == num and wt == WIRE_LEN:
+            return val
+    return b""
+
+
+def _field_int(raw: bytes, num: int) -> int:
+    for n, wt, val in decode_fields(raw):
+        if n == num and wt == WIRE_VARINT:
+            return val
+    return 0
+
+
+# --- server ----------------------------------------------------------------
+
+
+def _handlers(node) -> dict:
+    """method path suffix -> unary handler(bytes) -> bytes."""
+
+    def broadcast_tx(req: bytes) -> bytes:
+        # BroadcastTxRequest {tx_bytes=1, mode=2}; mode BROADCAST_MODE_SYNC
+        # semantics: CheckTx result, inclusion async (the only mode the
+        # reference chain's clients rely on; pkg/user polls GetTx after).
+        tx_bytes = _field_bytes(req, 1)
+        res = node.broadcast(tx_bytes)
+        import hashlib
+
+        txhash = hashlib.sha256(tx_bytes).hexdigest().upper()
+        return encode_bytes_field(
+            1,
+            _tx_response(0, txhash, res.code, res.log, res.gas_wanted,
+                         getattr(res, "gas_used", 0)),
+        )
+
+    def get_tx(req: bytes) -> bytes:
+        # GetTxRequest {hash=1 (hex)}; NotFound -> empty response (the
+        # client treats an absent tx_response as "not yet included").
+        txhash = _field_str(req, 1)
+        status = node.tx_status(bytes.fromhex(txhash))
+        if status is None:
+            return b""
+        height, code, log = status
+        return encode_bytes_field(2, _tx_response(height, txhash, code, log))
+
+    def query_account(req: bytes) -> bytes:
+        # QueryAccountRequest {address=1} -> {account=1 Any(BaseAccount)}.
+        addr = _field_str(req, 1)
+        acc = node.query_account(addr)
+        if acc is None:
+            return b""
+        base = (
+            encode_bytes_field(1, acc.address.encode())
+            + encode_varint_field(3, acc.account_number)
+            + encode_varint_field(4, acc.sequence)
+        )
+        any_acc = encode_bytes_field(
+            1, b"/cosmos.auth.v1beta1.BaseAccount"
+        ) + encode_bytes_field(2, base)
+        return encode_bytes_field(1, any_acc)
+
+    def query_balance(req: bytes) -> bytes:
+        # QueryBalanceRequest {address=1, denom=2} -> {balance=1 Coin}.
+        from celestia_app_tpu.state.accounts import BankKeeper
+
+        addr = _field_str(req, 1)
+        denom = _field_str(req, 2) or "utia"
+        amount = BankKeeper(node.app.cms.working).balance(addr, denom)
+        coin = encode_bytes_field(1, denom.encode()) + encode_bytes_field(
+            2, str(amount).encode()
+        )
+        return encode_bytes_field(1, coin)
+
+    def query_validators(req: bytes) -> bytes:
+        # QueryValidatorsRequest -> {validators=1 repeated Validator
+        # {operator_address=1, tokens=5}} — the fields txsim's stake
+        # sequence reads.
+        out = b""
+        for v in node.validators():
+            val = encode_bytes_field(
+                1, v["address"].encode()
+            ) + encode_bytes_field(5, str(v.get("power", 0)).encode())
+            out += encode_bytes_field(1, val)
+        return out
+
+    def get_latest_block(req: bytes) -> bytes:
+        # GetLatestBlockResponse {block=2 {header=1 {chain_id=2, height=3}}}.
+        header = encode_bytes_field(2, node.chain_id.encode()) + encode_varint_field(
+            3, node.app.height
+        )
+        return encode_bytes_field(2, encode_bytes_field(1, header))
+
+    return {
+        "cosmos.tx.v1beta1.Service": {
+            "BroadcastTx": broadcast_tx,
+            "GetTx": get_tx,
+        },
+        "cosmos.auth.v1beta1.Query": {"Account": query_account},
+        "cosmos.bank.v1beta1.Query": {"Balance": query_balance},
+        "cosmos.staking.v1beta1.Query": {"Validators": query_validators},
+        "cosmos.base.tendermint.v1beta1.Service": {
+            "GetLatestBlock": get_latest_block,
+        },
+    }
+
+
+@dataclass
+class GrpcPlane:
+    server: object
+    port: int
+
+    @property
+    def target(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def stop(self, grace: float = 0.5) -> None:
+        self.server.stop(grace)
+
+
+def serve_grpc(node, port: int = 0, max_workers: int = 8) -> GrpcPlane:
+    """Start the gRPC plane for a node; returns the live server + port."""
+    import grpc
+
+    ident = lambda b: b  # byte-level (de)serialization; codecs above
+
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    for service, methods in _handlers(node).items():
+        rpc_handlers = {
+            name: grpc.unary_unary_rpc_method_handler(
+                (lambda fn: lambda req, ctx: fn(req))(fn),
+                request_deserializer=ident,
+                response_serializer=ident,
+            )
+            for name, fn in methods.items()
+        }
+        server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(service, rpc_handlers),)
+        )
+    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    server.start()
+    return GrpcPlane(server, bound)
+
+
+# --- client ----------------------------------------------------------------
+
+
+class GrpcNode:
+    """TxClient-compatible node surface over a gRPC channel.
+
+    Implements broadcast / query_account / tx_status / validators /
+    chain_id — the exact interface user.TxClient and txsim consume — so
+    they run against a gRPC endpoint unchanged.
+    """
+
+    def __init__(self, target: str):
+        import grpc
+
+        self._channel = grpc.insecure_channel(target)
+        ident = lambda b: b
+        self._call = {
+            name: self._channel.unary_unary(
+                path, request_serializer=ident, response_deserializer=ident
+            )
+            for name, path in {
+                "broadcast": "/cosmos.tx.v1beta1.Service/BroadcastTx",
+                "get_tx": "/cosmos.tx.v1beta1.Service/GetTx",
+                "account": "/cosmos.auth.v1beta1.Query/Account",
+                "balance": "/cosmos.bank.v1beta1.Query/Balance",
+                "validators": "/cosmos.staking.v1beta1.Query/Validators",
+                "latest": "/cosmos.base.tendermint.v1beta1.Service/GetLatestBlock",
+            }.items()
+        }
+
+    def close(self) -> None:
+        self._channel.close()
+
+    # --- TxClient surface ---------------------------------------------------
+    @property
+    def chain_id(self) -> str:
+        hdr = _field_bytes(_field_bytes(self._call["latest"](b""), 2), 1)
+        return _field_str(hdr, 2)
+
+    def height(self) -> int:
+        hdr = _field_bytes(_field_bytes(self._call["latest"](b""), 2), 1)
+        return _field_int(hdr, 3)
+
+    def broadcast(self, raw_tx: bytes):
+        from celestia_app_tpu.app.app import TxResult
+
+        resp = _parse_tx_response(
+            _field_bytes(self._call["broadcast"](encode_bytes_field(1, raw_tx)), 1)
+        )
+        return TxResult(
+            code=resp["code"], log=resp["raw_log"],
+            gas_wanted=resp["gas_wanted"], gas_used=resp["gas_used"],
+        )
+
+    def query_account(self, address: str):
+        from celestia_app_tpu.state.accounts import Account
+
+        resp = self._call["account"](encode_bytes_field(1, address.encode()))
+        any_acc = _field_bytes(resp, 1)
+        if not any_acc:
+            return None
+        base = _field_bytes(any_acc, 2)
+        return Account(
+            address=_field_str(base, 1), pubkey=b"",
+            account_number=_field_int(base, 3), sequence=_field_int(base, 4),
+        )
+
+    def tx_status(self, tx_hash: bytes):
+        resp = self._call["get_tx"](
+            encode_bytes_field(1, tx_hash.hex().upper().encode())
+        )
+        tr = _field_bytes(resp, 2)
+        if not tr:
+            return None
+        parsed = _parse_tx_response(tr)
+        return parsed["height"], parsed["code"], parsed["raw_log"]
+
+    def balance(self, address: str, denom: str = "utia") -> int:
+        resp = self._call["balance"](
+            encode_bytes_field(1, address.encode())
+            + encode_bytes_field(2, denom.encode())
+        )
+        return int(_field_str(_field_bytes(resp, 1), 2) or 0)
+
+    def produce_block(self, timeout_s: float = 15.0):
+        """The cosmos gRPC surface has no dev produce-block hook; wait for
+        the served node's proposer loop to commit the next height (txsim's
+        per-round block barrier), shaped like TestNode.produce_block."""
+        import time
+
+        start = self.height()
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.height() > start:
+                return {"height": self.height()}, []
+            time.sleep(0.05)
+        raise TimeoutError(f"no block committed past height {start}")
+
+    def validators(self) -> list[dict]:
+        out = []
+        for num, wt, val in decode_fields(self._call["validators"](b"")):
+            if num == 1 and wt == WIRE_LEN:
+                # "address"/"power" match the in-process node surface so
+                # txsim's sequences stay node-agnostic.
+                out.append({
+                    "address": _field_str(val, 1),
+                    "power": int(_field_str(val, 5) or 0),
+                })
+        return out

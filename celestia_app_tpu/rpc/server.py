@@ -78,6 +78,10 @@ class ServingNode(TestNode):
         # and feeds peer catch-up — signers/evidence MUST replicate with
         # the block or x/slashing state diverges across nodes.
         self._blocks_by_height: dict[int, tuple] = {}
+        # height -> validator set (addr -> (PublicKey, power)) the height's
+        # consensus ran under; kept alongside the block store so catch-up
+        # can verify historic LastCommits across jailing boundaries.
+        self._valsets_by_height: dict[int, dict] = {}
         # App version per height (the block header's Version.App in the
         # reference): clients reconstructing historical squares need the
         # hard cap in force then, not the current gov param.
@@ -242,11 +246,17 @@ class ServingNode(TestNode):
         Signers/evidence are stored with the block so catch-up replays the
         exact x/slashing inputs every live node executed."""
         proposal_version = self.app.app_version  # pre-end-block upgrades
+        # The set THIS height's consensus ran under (bonded set after H-1),
+        # captured before the block applies: gossip catch-up restores it to
+        # verify height-H LastCommits — the post-H set has already dropped
+        # anyone block H jailed, whose legitimate precommit must still count.
+        vals_pre_apply = self._validator_set()
         results = super()._commit_block_data(
             data, time_ns,
             last_commit_signers=last_commit_signers, evidence=evidence,
         )
         height = self.app.height
+        self._valsets_by_height[height] = vals_pre_apply
         evidence_wire = self._evidence_to_wire(evidence)
         self._blocks_by_height[height] = (
             data, time_ns,
@@ -752,6 +762,14 @@ class ServingNode(TestNode):
             self, timeouts=timeouts, interval_s=interval_s,
             latency_s=latency_s, jitter_s=jitter_s, wal_path=wal_path,
         )
+        # The shared gossip pool may already exist (a broadcast before this
+        # call) sized without knowledge of chaos latency; injected sleeps
+        # would then serialize a block's worth of sends behind 8 parked
+        # workers.  Drop it so the next access re-sizes for the driver.
+        pool = getattr(self, "_gossip_pool", None)
+        if pool is not None and (latency_s or jitter_s):
+            pool.shutdown(wait=True, cancel_futures=False)
+            self._gossip_pool = None
         return self.consensus_driver
 
     def rpc_consensus(self, msg: dict) -> dict:

@@ -63,12 +63,20 @@ class Fee:
         return cls(tuple(coins), gas, payer, granter)
 
 
-def _marshal_pubkey(pk: PublicKey) -> bytes:
+def _marshal_pubkey(pk) -> bytes:
+    from celestia_app_tpu.tx.multisig import MultisigPubKey
+
+    if isinstance(pk, MultisigPubKey):
+        return pk.to_any().marshal()
     return Any(URL_SECP256K1_PUBKEY, encode_bytes_field(1, pk.bytes)).marshal()
 
 
-def _unmarshal_pubkey(raw: bytes) -> PublicKey:
+def _unmarshal_pubkey(raw: bytes):
+    from celestia_app_tpu.tx.multisig import URL_MULTISIG_PUBKEY, MultisigPubKey
+
     a = Any.unmarshal(raw)
+    if a.type_url == URL_MULTISIG_PUBKEY:
+        return MultisigPubKey.from_value(a.value)
     if a.type_url != URL_SECP256K1_PUBKEY:
         raise ValueError(f"unsupported pubkey type {a.type_url}")
     for num, wt, val in decode_fields(a.value):
@@ -81,30 +89,58 @@ def _marshal_mode_info_single(mode: int) -> bytes:
     return encode_bytes_field(1, encode_varint_field(1, mode))
 
 
+def _marshal_mode_info_multi(bits: tuple[bool, ...]) -> bytes:
+    from celestia_app_tpu.tx.multisig import marshal_bitarray
+
+    inner = encode_bytes_field(1, marshal_bitarray(bits))
+    for b in bits:
+        if b:
+            inner += encode_bytes_field(2, _marshal_mode_info_single(SIGN_MODE_DIRECT))
+    return encode_bytes_field(2, inner)  # ModeInfo.multi = field 2
+
+
 @dataclass(frozen=True)
 class SignerInfo:
-    public_key: PublicKey
+    """One tx signer.  `public_key` is a PublicKey or a MultisigPubKey;
+    `mode_bits` (multisig only) marks which sub-keys participated."""
+
+    public_key: object
     sequence: int
+    mode_bits: tuple[bool, ...] | None = None
 
     def marshal(self) -> bytes:
+        mode = (
+            _marshal_mode_info_multi(self.mode_bits)
+            if self.mode_bits is not None
+            else _marshal_mode_info_single(SIGN_MODE_DIRECT)
+        )
         return (
             encode_bytes_field(1, _marshal_pubkey(self.public_key))
-            + encode_bytes_field(2, _marshal_mode_info_single(SIGN_MODE_DIRECT))
+            + encode_bytes_field(2, mode)
             + encode_varint_field(3, self.sequence)
         )
 
     @classmethod
     def unmarshal(cls, raw: bytes) -> "SignerInfo":
+        from celestia_app_tpu.tx.multisig import unmarshal_bitarray
+
         pk = None
         seq = 0
+        mode_bits = None
         for num, wt, val in decode_fields(raw):
             if num == 1 and wt == WIRE_LEN:
                 pk = _unmarshal_pubkey(val)
+            elif num == 2 and wt == WIRE_LEN:
+                for n2, w2, v2 in decode_fields(val):
+                    if n2 == 2 and w2 == WIRE_LEN:  # ModeInfo.multi
+                        for n3, w3, v3 in decode_fields(v2):
+                            if n3 == 1 and w3 == WIRE_LEN:
+                                mode_bits = unmarshal_bitarray(v3)
             elif num == 3 and wt == WIRE_VARINT:
                 seq = val
         if pk is None:
             raise ValueError("signer info missing public key")
-        return cls(pk, seq)
+        return cls(pk, seq, mode_bits)
 
 
 @dataclass(frozen=True)
@@ -231,14 +267,28 @@ class Tx:
         return [decode_msg(m) for m in self.body.messages]
 
     def verify_signature(self, chain_id: str, account_number: int) -> bool:
-        """Verify the (single) signer's SIGN_MODE_DIRECT signature."""
+        """Verify the (single) signer's SIGN_MODE_DIRECT signature — a
+        plain secp256k1 key, or a threshold multisig (every sub-signature
+        signs the same SignDoc)."""
+        from celestia_app_tpu.tx.multisig import (
+            MultisigPubKey,
+            unmarshal_multisignature,
+        )
+
         info = self.auth_info
         if len(info.signer_infos) != 1 or len(self.signatures) != 1:
             return False
+        signer = info.signer_infos[0]
         doc = sign_doc_bytes(
             self.body_bytes, self.auth_info_bytes, chain_id, account_number
         )
-        return info.signer_infos[0].public_key.verify(doc, self.signatures[0])
+        if isinstance(signer.public_key, MultisigPubKey):
+            if signer.mode_bits is None:
+                return False
+            return signer.public_key.verify_multi(
+                doc, signer.mode_bits, unmarshal_multisignature(self.signatures[0])
+            )
+        return signer.public_key.verify(doc, self.signatures[0])
 
 
 def build_and_sign(
@@ -258,3 +308,36 @@ def build_and_sign(
     auth_bytes = auth.marshal()
     doc = sign_doc_bytes(body_bytes, auth_bytes, chain_id, account_number)
     return Tx(body_bytes, auth_bytes, (key.sign(doc),)).marshal()
+
+
+def build_and_sign_multisig(
+    msgs: list,
+    multisig_pk,
+    signing_keys: dict[int, PrivateKey],
+    chain_id: str,
+    account_number: int,
+    sequence: int,
+    fee: Fee,
+    memo: str = "",
+    timeout_height: int = 0,
+) -> bytes:
+    """Construct a t-of-n multisig tx.  `signing_keys` maps sub-key index
+    -> PrivateKey for each participant; every participant signs the same
+    SIGN_MODE_DIRECT SignDoc and the signatures travel as one
+    MultiSignature in set-bit order."""
+    from celestia_app_tpu.tx.multisig import marshal_multisignature
+
+    bits = tuple(
+        i in signing_keys for i in range(len(multisig_pk.public_keys))
+    )
+    body = TxBody(tuple(m.to_any() for m in msgs), memo, timeout_height)
+    auth = AuthInfo((SignerInfo(multisig_pk, sequence, bits),), fee)
+    body_bytes = body.marshal()
+    auth_bytes = auth.marshal()
+    doc = sign_doc_bytes(body_bytes, auth_bytes, chain_id, account_number)
+    sigs = tuple(
+        signing_keys[i].sign(doc)
+        for i in range(len(multisig_pk.public_keys))
+        if i in signing_keys
+    )
+    return Tx(body_bytes, auth_bytes, (marshal_multisignature(sigs),)).marshal()

@@ -1,0 +1,98 @@
+"""Additive-FFT encode: identity with the dense generator path.
+
+The FFT (gf/fft.py host, kernels/fft.py device) is the reference codec's
+algorithm (rsmt2d.NewLeoRSCodec's LCH butterflies —
+/root/reference/pkg/appconsts/global_consts.go:92); these tests pin that it
+computes EXACTLY the same linear map as the generator matmul for both RS
+constructions, so switching encode paths can never change parity bytes,
+DAH roots, or golden vectors.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from celestia_app_tpu.gf.fft import encode_fft, fft, ifft
+from celestia_app_tpu.gf.leopard import cantor_basis, leopard_field
+from celestia_app_tpu.gf.rs import RSCodec, codec_for_width
+from celestia_app_tpu.kernels.fft import encode_axis_fft
+from celestia_app_tpu.kernels.rs import encode_axis, extend_square_fn
+
+RNG = np.random.default_rng(11)
+
+
+@pytest.mark.parametrize("construction", ["vandermonde", "leopard"])
+@pytest.mark.parametrize("k", [1, 2, 4, 8, 16, 32, 64, 128])
+def test_host_fft_encode_equals_generator(construction, k):
+    codec = RSCodec(k, construction)
+    data = RNG.integers(0, codec.field.order, (k, 9)).astype(codec.field.dtype)
+    want = codec.field.matmul(codec.generator, data)
+    assert np.array_equal(encode_fft(codec, data), want)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("construction", ["vandermonde", "leopard"])
+def test_host_fft_encode_equals_generator_gf16(construction):
+    k = 256  # the GF(2^16) regime
+    codec = RSCodec(k, construction)
+    data = RNG.integers(0, codec.field.order, (k, 3)).astype(codec.field.dtype)
+    want = codec.field.matmul(codec.generator, data)
+    assert np.array_equal(encode_fft(codec, data), want)
+
+
+@pytest.mark.parametrize("m", [8, 16])
+def test_fft_ifft_roundtrip_any_coset(m):
+    """Property: ifft(fft(x, s), s) == x for random coset shifts — the
+    butterfly pair is an exact inverse at every stage structure."""
+    field = leopard_field(m)
+    basis = cantor_basis(m)
+    for r in (1, 3, 5):
+        n = 1 << r
+        x = RNG.integers(0, field.order, (n, 4)).astype(field.dtype)
+        for shift in (0, int(basis[r]), 0x17 % field.order):
+            y = fft(field, basis[:r], x, shift)
+            back = ifft(field, basis[:r], y, shift)
+            assert np.array_equal(back, x), (m, r, shift)
+
+
+def test_fft_is_linear():
+    field = leopard_field(8)
+    basis = cantor_basis(8)
+    a = RNG.integers(0, 256, (8, 5)).astype(np.uint8)
+    b = RNG.integers(0, 256, (8, 5)).astype(np.uint8)
+    assert np.array_equal(
+        fft(field, basis[:3], a ^ b, 7),
+        fft(field, basis[:3], a, 7) ^ fft(field, basis[:3], b, 7),
+    )
+
+
+@pytest.mark.parametrize("construction", ["vandermonde", "leopard"])
+@pytest.mark.parametrize("k", [2, 8, 64])
+def test_device_fft_equals_dense_both_axes(construction, k):
+    codec = codec_for_width(k, construction)
+    m = codec.field.m
+    G_bits = jnp.asarray(codec.generator_bits())
+    data = RNG.integers(0, 256, (3, k, 64), dtype=np.uint8)
+    want = np.asarray(encode_axis(jnp.asarray(data), G_bits, m, contract_axis=1))
+    got = np.asarray(encode_axis_fft(jnp.asarray(data), k, construction, 1))
+    assert np.array_equal(got, want)
+    d0 = np.ascontiguousarray(data.transpose(1, 0, 2))
+    want0 = np.asarray(encode_axis(jnp.asarray(d0), G_bits, m, contract_axis=0))
+    got0 = np.asarray(encode_axis_fft(jnp.asarray(d0), k, construction, 0))
+    assert np.array_equal(got0, want0)
+
+
+@pytest.mark.parametrize("k", [16, 64])
+def test_extend_square_identical_under_both_paths(monkeypatch, k):
+    """The full square extension is byte-identical whether the FFT or the
+    dense matmul encodes it — DAH roots and golden vectors cannot move."""
+    from celestia_app_tpu.constants import SHARE_SIZE
+
+    ods = RNG.integers(0, 256, (k, k, SHARE_SIZE), dtype=np.uint8)
+    monkeypatch.setenv("CELESTIA_RS_FFT", "off")
+    dense = np.asarray(extend_square_fn(k)(jnp.asarray(ods)))
+    monkeypatch.setenv("CELESTIA_RS_FFT", "on")
+    fft_out = np.asarray(extend_square_fn(k)(jnp.asarray(ods)))
+    assert np.array_equal(dense, fft_out)
