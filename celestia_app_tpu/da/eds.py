@@ -45,12 +45,12 @@ def leaf_namespaces(eds: jnp.ndarray, k: int) -> tuple[jnp.ndarray, jnp.ndarray]
     return row_ns, col_ns
 
 
-def _pipeline(k: int, construction: str):
-    """ods (k,k,512) -> (eds, row_roots (2k,90), col_roots (2k,90), droot (32,))."""
-    extend = extend_square_fn(k, construction)
+def roots_fn(k: int):
+    """The hashing half of the pipeline: eds (2k,2k,S) -> (row_roots,
+    col_roots, droot).  Factored out so the bench decomposition can time
+    NMT+DAH separately from the RS extension."""
 
-    def run(ods: jnp.ndarray):
-        eds = extend(ods)
+    def roots(eds: jnp.ndarray):
         row_ns, _ = leaf_namespaces(eds, k)
         # The leaf digest at (i, j) is identical for the row-i tree and the
         # col-j tree (same namespace, same share), so hash the (2k, 2k) leaf
@@ -64,6 +64,19 @@ def _pipeline(k: int, construction: str):
             hashes.transpose(1, 0, 2),
         )
         droot = merkle_root_pow2(jnp.concatenate([row_roots, col_roots], axis=0))
+        return row_roots, col_roots, droot
+
+    return roots
+
+
+def _pipeline(k: int, construction: str):
+    """ods (k,k,512) -> (eds, row_roots (2k,90), col_roots (2k,90), droot (32,))."""
+    extend = extend_square_fn(k, construction)
+    roots = roots_fn(k)
+
+    def run(ods: jnp.ndarray):
+        eds = extend(ods)
+        row_roots, col_roots, droot = roots(eds)
         return eds, row_roots, col_roots, droot
 
     return run

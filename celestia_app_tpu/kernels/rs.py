@@ -37,8 +37,9 @@ from jax import lax
 
 from celestia_app_tpu.gf.rs import active_construction, codec_for_width
 
-# int8 feeds the MXU's integer path on TPU; exactness: 0/1 products with
-# <= 8192-term sums, far inside int32.
+# int8 feeds the MXU's integer path on TPU; exactness: 0/1 products
+# accumulated mod 256 (int8 wraparound) keep bit 0 — the only bit the
+# mod-2 result reads — exact at any contraction depth.
 _DOT_DTYPE = jnp.int8
 
 
@@ -54,6 +55,9 @@ def _mod2_matmul_planes(G_bits: jnp.ndarray, x: jnp.ndarray, m: int) -> jnp.ndar
     n, bps, cols = x.shape
     bits = (x[:, :, None, :] >> jnp.arange(8, dtype=jnp.uint8)[None, None, :, None]) & 1
     B = bits.reshape(n * m, cols).astype(_DOT_DTYPE)
+    # int32 accumulation: int8 accumulation would be exact too (parity
+    # survives mod-256 wraparound) but measured ~100x slower on the axon
+    # TPU backend — XLA has no fast int8-accumulate MXU path there.
     acc = lax.dot_general(
         G_bits.astype(_DOT_DTYPE),
         B,
@@ -90,21 +94,19 @@ def encode_axis(
 def _use_fft(k: int) -> bool:
     """Whether the additive-FFT encode (kernels/fft.py) serves size k.
 
-    $CELESTIA_RS_FFT: "on" / "off" / "auto" (default).  Auto switches to
-    the FFT at k >= 64, where the grouped-butterfly op count pulls ahead
-    of the dense generator matmul.  Both paths produce identical bytes
-    (tests/test_fft.py pins it), so a stale cached choice is a perf
-    detail, never a correctness hazard — caches key on (k, construction)
-    only.
+    $CELESTIA_RS_FFT: "on" / "off" / "auto" (default).  Auto currently
+    selects the DENSE path everywhere: on the axon TPU the grouped
+    butterflies measured 0.359 s vs 0.255 s dense at k=512 — the ~10x MAC
+    saving is eaten by the bit-plane relayouts between stage groups, so
+    the FFT is kept as the structural-parity oracle (and the future perf
+    path once the relayouts are fused) rather than the default.  Both
+    paths produce identical bytes (tests/test_fft.py pins it), so a stale
+    cached choice is a perf detail, never a correctness hazard — caches
+    key on (k, construction) only.
     """
     import os
 
-    mode = os.environ.get("CELESTIA_RS_FFT", "auto")
-    if mode == "on":
-        return True
-    if mode == "off":
-        return False
-    return k >= 64
+    return os.environ.get("CELESTIA_RS_FFT", "auto") == "on"
 
 
 def encode_fn(k: int, construction: str | None = None):
@@ -113,8 +115,9 @@ def encode_fn(k: int, construction: str | None = None):
     ONE owner for the FFT-vs-dense policy — both the single-chip square
     extension and the sharded pipeline build their encode through here, so
     the selection (and any future threshold/env change) cannot diverge
-    between them.  Large squares ride the additive FFT (see _use_fft),
-    small ones the dense generator matmul; identical bytes either way.
+    between them.  The dense generator matmul is the default everywhere
+    (see _use_fft for the measured rationale); CELESTIA_RS_FFT=on selects
+    the additive-FFT butterflies — identical bytes either way.
     """
     from celestia_app_tpu.gf.rs import active_construction as _active
 

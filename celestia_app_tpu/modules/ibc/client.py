@@ -20,11 +20,11 @@ block_id(data_root_H, app_hash_{H-1}), state rooted in an SMT
   * Misbehaviour: two verified commits for the same height with different
     block ids freeze the client (07-tendermint's CheckMisbehaviour).
 
-Scope note (PARITY.md): validator-set rotation inside a client's lifetime
-follows Tendermint's ADJACENT verification only — every update must carry
-+2/3 of the ORIGINALLY trusted set's power; clients of chains whose
-valset drifts past that must be recreated (no trusting-period /
-bisection).
+Valset rotation: sequential UpdateClient calls may carry a new validator
+set (07-tendermint trusting-period semantics) — accepted when the commit
+has +2/3 of the NEW set and >1/3 of the TRUSTED set's power in valid
+precommits, so a chain can rotate 100% of its set across several hops
+without the client being recreated (closes round-3 PARITY gap #2).
 """
 
 from __future__ import annotations
@@ -157,21 +157,79 @@ class ClientKeeper:
     def _save(self, cs: ClientState) -> None:
         self.store.set(_CLIENT_PREFIX + cs.client_id.encode(), cs.marshal())
 
-    def update_client(self, client_id: str, commit) -> ConsensusState:
-        """MsgUpdateClient: verify the Commit with the trusted set, store
-        the consensus state it pins.  A conflicting verified commit for an
-        already-known height is misbehaviour: the client freezes
-        (07-tendermint CheckForMisbehaviour + frozen clients reject
-        everything)."""
+    def update_client(
+        self, client_id: str, commit, new_validators=None
+    ) -> ConsensusState:
+        """MsgUpdateClient: verify the Commit, store the consensus state it
+        pins.  A conflicting verified commit for an already-known height is
+        misbehaviour: the client freezes (07-tendermint CheckForMisbehaviour
+        + frozen clients reject everything).
+
+        Valset rotation (07-tendermint trusting-period semantics, the rule
+        ibc-go's VerifyClientMessage applies through sequential headers):
+        pass `new_validators` (addr -> (PublicKey, power)) to rotate trust.
+        The commit must then carry +2/3 of the NEW set's power AND valid
+        precommits from MORE THAN 1/3 of the currently TRUSTED set's power
+        — forging a rotation requires corrupting >1/3 of the trusted
+        validators, Tendermint's light-client security bound.  Chains can
+        rotate 100% of their set across several such hops.
+        """
         from celestia_app_tpu.consensus import verify_commit
+        from celestia_app_tpu.consensus.votes import PRECOMMIT
 
         cs = self.client_state(client_id)
         if cs.frozen:
             raise IBCError(f"client {client_id} is frozen")
-        if not verify_commit(cs.validator_map(), cs.chain_id, commit):
-            raise IBCError(
-                f"commit at height {commit.height} fails verification "
-                f"against client {client_id}"
+        if new_validators is None:
+            if not verify_commit(cs.validator_map(), cs.chain_id, commit):
+                raise IBCError(
+                    f"commit at height {commit.height} fails verification "
+                    f"against client {client_id}"
+                )
+        else:
+            if commit.height <= cs.latest_height:
+                raise IBCError(
+                    "valset rotation must move the client forward "
+                    f"(height {commit.height} <= {cs.latest_height})"
+                )
+            if not verify_commit(dict(new_validators), cs.chain_id, commit):
+                raise IBCError(
+                    f"rotation commit at height {commit.height} lacks +2/3 "
+                    "of the proposed validator set"
+                )
+            trusted = cs.validator_map()
+            total = sum(p for _, p in trusted.values())
+            counted: set[str] = set()
+            overlap = 0
+            for vote in commit.precommits:
+                entry = trusted.get(vote.validator)
+                if entry is None or vote.validator in counted:
+                    continue
+                pub, power = entry
+                if (
+                    vote.height == commit.height
+                    and vote.round == commit.round
+                    and vote.vote_type == PRECOMMIT
+                    and vote.block_hash == commit.block_hash
+                    and vote.verify(pub, cs.chain_id)
+                ):
+                    counted.add(vote.validator)
+                    overlap += power
+            if 3 * overlap <= total:
+                raise IBCError(
+                    f"rotation commit at height {commit.height} carries only "
+                    f"{overlap}/{total} trusted power; need > 1/3"
+                )
+            # No save here: rotation requires height > latest_height, so
+            # the latest-height save below always persists this rebuilt
+            # state (validators rotated, height advanced) in one write.
+            cs = ClientState(
+                cs.client_id, cs.chain_id,
+                tuple(
+                    (addr, pk.bytes, power)
+                    for addr, (pk, power) in sorted(dict(new_validators).items())
+                ),
+                cs.latest_height, cs.frozen,
             )
         new = ConsensusState(
             commit.height, commit.data_root, commit.prev_app_hash,

@@ -103,10 +103,12 @@ class ChainEnd:
             if v.pubkey
         }
 
-    def commit_for(self, height: int):
+    def commit_for(self, height: int, keys: list | None = None):
         """A real +2/3 Commit for `height`, signed by the genesis
         validators' consensus keys (what the serving plane's voting round
-        produces; TestNode has no vote plane, so the harness signs)."""
+        produces; TestNode has no vote plane, so the harness signs).
+        `keys` overrides the signer set — rotation tests model a chain
+        whose validators changed by signing later commits with new keys."""
         from celestia_app_tpu.consensus import PRECOMMIT, Commit, Vote, block_id
 
         data_root = self.node.blocks[height - 1].hash
@@ -115,7 +117,7 @@ class ChainEnd:
         bid = block_id(data_root, prev_hash, time_ns)
         votes = tuple(
             Vote.sign(k, self.chain_id, height, PRECOMMIT, bid)
-            for k in self.val_keys
+            for k in (keys if keys is not None else self.val_keys)
         )
         return Commit(height, bid, votes, data_root, prev_hash, time_ns=time_ns)
 

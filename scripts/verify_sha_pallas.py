@@ -1,0 +1,93 @@
+"""Standalone TPU drive for the Pallas SHA-256 kernel.
+
+Run on a machine with the real chip (the bench/driver box):
+
+    PYTHONPATH=/root/repo python scripts/verify_sha_pallas.py
+
+It (1) pins the Pallas digests against the fused-jnp path and hashlib for
+every message geometry the NMT pipeline uses, across the lane-pad
+boundary; (2) times the k=512 NMT+DAH phase with the kernel off and on;
+(3) times the full fused pipeline.  Exits non-zero on any mismatch.
+
+This is the TPU-side complement of tests/test_sha_pallas.py (which skips
+off-TPU: Pallas has no compiled CPU path and interpret mode is
+minutes-slow per geometry).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    platform = jax.devices()[0].platform
+    print(f"platform: {platform}", flush=True)
+    if platform != "tpu":
+        print("need the TPU backend; aborting", file=sys.stderr)
+        return 2
+
+    from celestia_app_tpu.kernels.sha256 import _sha256_jnp, _sha256_pallas
+
+    rng = np.random.default_rng(7)
+    for length in (65, 91, 181, 542):
+        for n in (7, 1024, 1030):
+            msgs = rng.integers(0, 256, (n, length), dtype=np.uint8)
+            want = np.asarray(_sha256_jnp(jnp.asarray(msgs)))
+            got = np.asarray(_sha256_pallas(jnp.asarray(msgs)))
+            assert np.array_equal(got, want), f"mismatch at L={length} N={n}"
+            assert bytes(want[0]) == hashlib.sha256(msgs[0].tobytes()).digest()
+    print("equality OK across geometries", flush=True)
+
+    from celestia_app_tpu.constants import NAMESPACE_SIZE, SHARE_SIZE
+    from celestia_app_tpu.da.eds import jit_pipeline, roots_fn
+    from celestia_app_tpu.kernels.rs import extend_square_fn
+
+    k = 512
+    ods = rng.integers(0, 256, (k * k, SHARE_SIZE), dtype=np.uint8)
+    ods[:, :NAMESPACE_SIZE] = 0
+    ods = ods.reshape(k, k, SHARE_SIZE)
+    x = jax.device_put(jnp.asarray(ods))
+
+    def med(fn, arg, iters=5):
+        ts = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(arg))
+            ts.append(time.perf_counter() - t0)
+        return sorted(ts)[len(ts) // 2]
+
+    ext = jax.jit(extend_square_fn(k))
+    eds = ext(x)
+    jax.block_until_ready(eds)
+
+    results = {}
+    roots_out = {}
+    for flag in ("off", "on"):
+        os.environ["CELESTIA_SHA_PALLAS"] = flag
+        fn = jax.jit(roots_fn(k))
+        out = fn(eds)
+        jax.block_until_ready(out)
+        roots_out[flag] = [np.asarray(o) for o in out]
+        results[flag] = med(fn, eds)
+        print(f"nmt_dah sha_pallas={flag}: {results[flag]:.4f}s", flush=True)
+    for a, b in zip(roots_out["off"], roots_out["on"]):
+        assert np.array_equal(a, b), "roots diverge between sha paths"
+    print("roots identical jnp vs pallas", flush=True)
+
+    os.environ.pop("CELESTIA_SHA_PALLAS", None)
+    pipe = jit_pipeline(k)
+    jax.block_until_ready(pipe(x))
+    print(f"full pipeline steady: {med(pipe, x):.4f}s", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

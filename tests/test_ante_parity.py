@@ -173,7 +173,10 @@ class TestDecoratorRejections:
         assert len(results) == 1 and results[0].code == 0
         floor = len(raw) * TX_SIZE_COST_PER_BYTE + SIG_VERIFY_COST_SECP256K1
         assert results[0].gas_used > floor  # store gas is charged on top
-        assert results[0].gas_used == 35728  # MsgSend determinism pin
+        # MsgSend determinism pin.  Re-pinned in round 4: bank send now
+        # reads (and creates if absent) the recipient account, like the
+        # sdk bank keeper — one extra gaskv read on this path.
+        assert results[0].gas_used == 37154
 
     def test_8b_store_gas_schedule(self):
         """The gaskv schedule itself (sdk store/types/gas.go KVGasConfig):
