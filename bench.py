@@ -182,21 +182,25 @@ def _parts_seconds(ods: np.ndarray, iters: int) -> dict:
     out: dict[str, float] = {}
     eds = None
     saved_flag = os.environ.get("CELESTIA_RS_FFT")
-    for label, flag in (("rs_fft", "on"), ("rs_dense", "off")):
-        os.environ["CELESTIA_RS_FFT"] = flag
-        fn = jax.jit(extend_square_fn(k))
-        eds = fn(x)
-        jax.block_until_ready(eds)
-        times = []
-        for _ in range(iters):
-            t0 = time.perf_counter()
-            jax.block_until_ready(fn(x))
-            times.append(time.perf_counter() - t0)
-        out[label] = _median(times)
-    if saved_flag is None:
-        os.environ.pop("CELESTIA_RS_FFT", None)
-    else:
-        os.environ["CELESTIA_RS_FFT"] = saved_flag
+    try:
+        for label, flag in (("rs_fft", "on"), ("rs_dense", "off")):
+            os.environ["CELESTIA_RS_FFT"] = flag
+            fn = jax.jit(extend_square_fn(k))
+            eds = fn(x)
+            jax.block_until_ready(eds)
+            times = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn(x))
+                times.append(time.perf_counter() - t0)
+            out[label] = _median(times)
+    finally:
+        # Restore even when a stage raises: a leaked =on would silently
+        # flip every later bench stage onto the non-default FFT path.
+        if saved_flag is None:
+            os.environ.pop("CELESTIA_RS_FFT", None)
+        else:
+            os.environ["CELESTIA_RS_FFT"] = saved_flag
     hash_fn = jax.jit(roots_fn(k))
     jax.block_until_ready(hash_fn(eds))
     times = []
@@ -527,6 +531,8 @@ def main() -> None:
             out["parts"] = {
                 "k": parts_only["k"], "seconds": parts_only["parts_seconds"],
             }
+            if errors:  # rate stages may still have failed — say so
+                out["errors"] = errors
         else:
             out["error"] = "; ".join(errors) or "no stage completed"
         print(json.dumps(out))

@@ -163,6 +163,7 @@ class App:
         node_min_gas_price: Dec | None = None,
         v2_upgrade_height: int | None = None,
         ibc_token_filter: bool = True,
+        square_size_upper_bound: int | None = None,
     ):
         self.cms = CommitStore()
         self.chain_id = ""
@@ -178,6 +179,16 @@ class App:
         # False models a non-celestia counterparty chain (the reference's
         # test/pfm/simapp.go) in IBC tests; celestia itself always filters.
         self.ibc_token_filter = ibc_token_filter
+        # The versioned protocol hard cap (128 for v1/v2).  The reference's
+        # big-block benchmark manifests override MaxSquareSize up to 512
+        # (test/e2e/benchmark/throughput.go:15-54); this knob is that
+        # override, clamped to what the DA kernels support.
+        from celestia_app_tpu.constants import MAX_CODEC_SQUARE_SIZE
+
+        self.square_size_upper_bound = min(
+            square_size_upper_bound or SQUARE_SIZE_UPPER_BOUND,
+            MAX_CODEC_SQUARE_SIZE,
+        )
         self._check_state: KVStore | None = None
 
     # --- keeper views over committed state ---------------------------------
@@ -204,7 +215,7 @@ class App:
 
     def max_effective_square_size(self) -> int:
         """min(gov, hard cap) — reference app/square_size.go:9-23."""
-        return min(self.gov_max_square_size, SQUARE_SIZE_UPPER_BOUND)
+        return min(self.gov_max_square_size, self.square_size_upper_bound)
 
     # --- genesis ------------------------------------------------------------
     def init_chain(self, genesis: Genesis) -> None:

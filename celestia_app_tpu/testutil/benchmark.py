@@ -80,6 +80,12 @@ def run_throughput(
 
     cap_bytes = max_block_bytes(app.gov_max_square_size)
     per_block = max(1, -(-cap_bytes // blob_size) + oversubmit)
+    # Pay fees at a realistic gas price, not 1 utia/gas: a saturating
+    # gov-256 run is ~65 multi-million-gas PFBs per block, and fee=gas
+    # drains a funded test account inside one block (observed as fills
+    # collapsing to ~0.24 at k=256 while the builder sat half empty).
+    min_price = float(str(app.node_min_gas_price)) if app.node_min_gas_price else 0.0
+    price = max(min_price * 10, 0.00001)
 
     fills: list[float] = []
     sizes: list[int] = []
@@ -90,7 +96,8 @@ def run_throughput(
             ns = Namespace.v0(rng.integers(1, 256, 10, dtype=np.uint8).tobytes())
             blob = Blob(ns, rng.integers(0, 256, blob_size, dtype=np.uint8).tobytes())
             gas = estimate_gas([blob_size])
-            txs.append(signer.create_pay_for_blobs(addr, [blob], gas, gas))
+            fee = max(1, int(gas * price) + 1)
+            txs.append(signer.create_pay_for_blobs(addr, [blob], gas, fee))
             signer.increment_sequence(addr)
         t0 = time.perf_counter()
         data = app.prepare_proposal(txs)

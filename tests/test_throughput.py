@@ -63,3 +63,61 @@ def test_sustained_90pct_fill_hard_cap_128():
         f"bytes/block={res.mean_block_bytes:.0f} "
         f"blocks/s={res.blocks_per_second:.3f}"
     )
+
+
+@pytest.mark.slow
+def test_sustained_90pct_fill_gov_square_256():
+    """The big-block app-path tier (round-4 VERDICT #5): the FULL
+    Prepare -> Process -> finalize -> commit loop at gov-256 — the
+    32 MB-block manifest shape of the reference benchmark
+    (test/e2e/benchmark/throughput.go:15-54) — sustaining >= 90% fills
+    over 5 consecutive blocks.  On TPU hardware every block must also fit
+    the 15 s block budget end to end (goal block time,
+    benchmark.go:172-189); CPU runs record times without the bound (the
+    suite's backend is not the target hardware)."""
+    import jax
+
+    from celestia_app_tpu.app import App
+    from celestia_app_tpu.state.dec import Dec
+
+    keys = funded_keys(2)
+    # The raised hard cap models the reference benchmark's
+    # MaxSquareSize: 512 manifest override (the v1/v2 protocol cap is 128).
+    app = App(
+        node_min_gas_price=Dec.from_str("0.000001"),
+        square_size_upper_bound=512,
+    )
+    app.init_chain(deterministic_genesis(keys, gov_max_square_size=256))
+    node = TestNode(keys=keys, app=app)
+    res = run_throughput(node, blocks=5, blob_size=500_000, target_fill=0.9)
+    assert res.sustained(0.9), (res.fills, res.mean_fill)
+    if jax.default_backend() == "tpu":
+        assert res.mean_block_seconds < 15.0, res
+    print(
+        f"\nthroughput k=256 x5 blocks: mean_fill={res.mean_fill:.3f} "
+        f"bytes/block={res.mean_block_bytes:.0f} "
+        f"s/block={res.mean_block_seconds:.2f}"
+    )
+
+
+@pytest.mark.slow
+def test_big_block_smoke_gov_square_512():
+    """One full app-path block at gov-512 (the 64 MB-class manifest,
+    throughput.go:15-54 big-block rows): the square builds, extends, and
+    commits with >= 90% fill — the hard-cap smoke above the 256 tier."""
+    from celestia_app_tpu.app import App
+    from celestia_app_tpu.state.dec import Dec
+
+    keys = funded_keys(2)
+    app = App(
+        node_min_gas_price=Dec.from_str("0.000001"),
+        square_size_upper_bound=512,
+    )
+    app.init_chain(deterministic_genesis(keys, gov_max_square_size=512))
+    node = TestNode(keys=keys, app=app)
+    res = run_throughput(node, blocks=1, blob_size=1_000_000, target_fill=0.9)
+    assert res.sustained(0.9), (res.fills, res.mean_fill)
+    print(
+        f"\nthroughput k=512 smoke: fill={res.fills[0]:.3f} "
+        f"s/block={res.mean_block_seconds:.2f}"
+    )
