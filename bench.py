@@ -51,7 +51,14 @@ BASELINE_NOTE = (
     "the host<->device link, which in this environment is a network "
     "tunnel of varying quality; the `compute` rows isolate the on-chip "
     "pipeline rate. compute@512 runs twice (stability_pct = spread "
-    "between the two medians)."
+    "between the two medians). Since round 4, every extend iteration "
+    "uploads a DISTINCT array — jax dedup-caches repeat transfers of the "
+    "same buffer, which previously made extend measure the relay's cache "
+    "while stream (distinct buffers) paid the real link; extend and "
+    "stream are now like-for-like, and on a serializing tunnel stream's "
+    "ceiling is the link rate, not transfer/compute overlap. The `parts` "
+    "row decomposes compute@512 into rs_fft / rs_dense / nmt_dah device "
+    "seconds."
 )
 
 

@@ -22,6 +22,7 @@ from dataclasses import dataclass, field
 from celestia_app_tpu.constants import (
     DEFAULT_GOV_MAX_SQUARE_SIZE,
     LATEST_VERSION,
+    MAX_CODEC_SQUARE_SIZE,
     SQUARE_SIZE_UPPER_BOUND,
 )
 from celestia_app_tpu.app.ante import AnteError, run_ante
@@ -183,8 +184,6 @@ class App:
         # big-block benchmark manifests override MaxSquareSize up to 512
         # (test/e2e/benchmark/throughput.go:15-54); this knob is that
         # override, clamped to what the DA kernels support.
-        from celestia_app_tpu.constants import MAX_CODEC_SQUARE_SIZE
-
         self.square_size_upper_bound = min(
             square_size_upper_bound or SQUARE_SIZE_UPPER_BOUND,
             MAX_CODEC_SQUARE_SIZE,

@@ -13,13 +13,21 @@ from celestia_app_tpu.square import builder as square
 
 
 def extend_block(
-    raw_txs: list[bytes], gov_max_square_size: int = SQUARE_SIZE_UPPER_BOUND
+    raw_txs: list[bytes],
+    gov_max_square_size: int = SQUARE_SIZE_UPPER_BOUND,
+    square_size_upper_bound: int = SQUARE_SIZE_UPPER_BOUND,
 ) -> ExtendedDataSquare | None:
-    """coretypes.Data -> EDS (extend_block.go:14-26); None for empty blocks."""
+    """coretypes.Data -> EDS (extend_block.go:14-26); None for empty blocks.
+
+    `square_size_upper_bound` must match the chain's hard cap: a chain run
+    under the benchmark-manifest override (App(square_size_upper_bound=512))
+    commits squares wider than the versioned 128 default, and a clamp here
+    would rebuild a DIFFERENT square with a different data root.
+    """
     if is_empty_block(raw_txs):
         return None
     sq = square.construct(
-        raw_txs, min(gov_max_square_size, SQUARE_SIZE_UPPER_BOUND)
+        raw_txs, min(gov_max_square_size, square_size_upper_bound)
     )
     return extend_shares(sq.share_bytes())
 

@@ -37,7 +37,11 @@ class RemoteTxResult:
 class RemoteNode:
     """A client handle to a ServingNode's JSON-RPC endpoint."""
 
-    def __init__(self, url: str, timeout: float = 30.0, defer_status: bool = False):
+    # Socket timeout must exceed a worst-case cold jit compile inside the
+    # served node (35-50 s measured for a first-ever square size on this
+    # box): produce_block legitimately blocks that long once per size,
+    # and a 30 s cap made the devnet txsim test flake exactly there.
+    def __init__(self, url: str, timeout: float = 120.0, defer_status: bool = False):
         self.url = url
         parsed = urlparse(url)
         self._host = parsed.hostname

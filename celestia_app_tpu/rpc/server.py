@@ -100,7 +100,14 @@ class ServingNode(TestNode):
         if len(self._peers) != len(self.peer_urls):
             from celestia_app_tpu.rpc.client import RemoteNode
 
-            self._peers = [RemoteNode(u, defer_status=True) for u in self.peer_urls]
+            # Peer handles keep the OLD 30 s cap: replication holds the
+            # produce lock, and the long default (sized for a client
+            # waiting out a cold jit in produce_block) would stall block
+            # production 4x longer per blackholed peer.
+            self._peers = [
+                RemoteNode(u, timeout=30.0, defer_status=True)
+                for u in self.peer_urls
+            ]
         return self._peers
 
     def is_proposer(self, height: int) -> bool:
@@ -594,6 +601,10 @@ class ServingNode(TestNode):
             # or its app hash diverges from the nodes that were live.
             "last_commit_signers": signers,
             "evidence": evidence_wire,
+            # Clients reconstructing the square (blobstream verify) need
+            # the hard cap the block was BUILT under — the versioned 128
+            # default, or the benchmark-manifest override if one is set.
+            "square_size_upper_bound": self.app.square_size_upper_bound,
         }
 
     def rpc_produce_block(self) -> dict:
@@ -854,7 +865,7 @@ class ServingNode(TestNode):
         with self.lock:
             trusted = trusted_validators or self._validator_set()
             trusted_chain_id = self.chain_id
-        peer = RemoteNode(peer_url, defer_status=True)
+        peer = RemoteNode(peer_url, timeout=30.0, defer_status=True)
         metas = peer.snapshots()
         if not metas:
             raise ValueError(f"peer {peer_url} serves no snapshots")

@@ -84,7 +84,7 @@ def run_throughput(
     # gov-256 run is ~65 multi-million-gas PFBs per block, and fee=gas
     # drains a funded test account inside one block (observed as fills
     # collapsing to ~0.24 at k=256 while the builder sat half empty).
-    min_price = float(str(app.node_min_gas_price)) if app.node_min_gas_price else 0.0
+    min_price = float(str(app.node_min_gas_price))
     price = max(min_price * 10, 0.00001)
 
     fills: list[float] = []
