@@ -188,10 +188,26 @@ def _parts_seconds(ods: np.ndarray, iters: int) -> dict:
     x = jax.device_put(jnp.asarray(ods))
     out: dict[str, float] = {}
     eds = None
-    saved_flag = os.environ.get("CELESTIA_RS_FFT")
+    saved = {
+        var: os.environ.get(var)
+        for var in ("CELESTIA_RS_FFT", "CELESTIA_RS_FFT_MD")
+    }
     try:
-        for label, flag in (("rs_fft", "on"), ("rs_dense", "off")):
-            os.environ["CELESTIA_RS_FFT"] = flag
+        # Each variant builds a FRESH jax.jit around extend_square_fn, so
+        # the env flags are re-read at trace time (the lru-cached module
+        # wrappers key on (k, construction) only and must not be used for
+        # an A/B like this — they would serve the first trace twice).
+        variants = (
+            ("rs_fft", {"CELESTIA_RS_FFT": "on", "CELESTIA_RS_FFT_MD": ""}),
+            ("rs_fft_md", {"CELESTIA_RS_FFT": "on", "CELESTIA_RS_FFT_MD": "1"}),
+            ("rs_dense", {"CELESTIA_RS_FFT": "off", "CELESTIA_RS_FFT_MD": ""}),
+        )
+        for label, flags in variants:
+            for var, val in flags.items():
+                if val:
+                    os.environ[var] = val
+                else:
+                    os.environ.pop(var, None)
             fn = jax.jit(extend_square_fn(k))
             eds = fn(x)
             jax.block_until_ready(eds)
@@ -204,10 +220,11 @@ def _parts_seconds(ods: np.ndarray, iters: int) -> dict:
     finally:
         # Restore even when a stage raises: a leaked =on would silently
         # flip every later bench stage onto the non-default FFT path.
-        if saved_flag is None:
-            os.environ.pop("CELESTIA_RS_FFT", None)
-        else:
-            os.environ["CELESTIA_RS_FFT"] = saved_flag
+        for var, val in saved.items():
+            if val is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = val
     hash_fn = jax.jit(roots_fn(k))
     jax.block_until_ready(hash_fn(eds))
     times = []

@@ -104,22 +104,45 @@ def _apply_groups(bits: jnp.ndarray, groups: tuple, m: int) -> jnp.ndarray:
 
     bits: (k, m, cols) int8 in {0,1} — symbol-major bit layout (bit b of
     symbol i at [i, b, :]).  Returns the transformed (k, m, cols).
+
+    Two lowerings, byte-identical ($CELESTIA_RS_FFT_MD selects):
+      * default — explicit transpose to (hi, B, lo*cols) then a batched
+        2D matmul per group;
+      * md — one dot_general contracting over BOTH the mid and bit axes
+        in their natural positions, no explicit bit-plane transposes:
+        the suspected cost of the measured FFT slowdown (0.359 s vs
+        0.255 s dense at k=512) is exactly those relayouts, so this
+        variant hands the layout problem to XLA instead.  Unmeasured on
+        hardware so far — kept selectable until a chip run decides.
     """
+    import os
+
+    md = os.environ.get("CELESTIA_RS_FFT_MD") == "1"
     k = bits.shape[0]
     cols = bits.shape[2]
     for j0, j1, M in groups:
         mid = 1 << (j1 - j0)
         lo = 1 << j0
         hi = k // (mid * lo)
-        B = mid * m
         x = bits.reshape(hi, mid, lo, m, cols)
-        x = x.transpose(0, 1, 3, 2, 4).reshape(hi, B, lo * cols)
-        acc = lax.dot_general(
-            jnp.asarray(M, dtype=_DOT_DTYPE), x,
-            (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.int32,
-        )  # (hi, B, lo*cols)
-        y = (acc & 1).astype(_DOT_DTYPE).reshape(hi, mid, m, lo, cols)
+        if md:
+            # M5: (hi, mid, m, mid', m') against x dims (mid'=1, m'=3).
+            M5 = jnp.asarray(M, dtype=_DOT_DTYPE).reshape(hi, mid, m, mid, m)
+            acc = lax.dot_general(
+                M5, x,
+                (((3, 4), (1, 3)), ((0,), (0,))),
+                preferred_element_type=jnp.int32,
+            )  # (hi, mid, m, lo, cols)
+            y = (acc & 1).astype(_DOT_DTYPE)
+        else:
+            B = mid * m
+            x2 = x.transpose(0, 1, 3, 2, 4).reshape(hi, B, lo * cols)
+            acc = lax.dot_general(
+                jnp.asarray(M, dtype=_DOT_DTYPE), x2,
+                (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.int32,
+            )  # (hi, B, lo*cols)
+            y = (acc & 1).astype(_DOT_DTYPE).reshape(hi, mid, m, lo, cols)
         bits = y.transpose(0, 1, 3, 2, 4).reshape(k, m, cols)
     return bits
 

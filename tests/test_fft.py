@@ -96,3 +96,16 @@ def test_extend_square_identical_under_both_paths(monkeypatch, k):
     monkeypatch.setenv("CELESTIA_RS_FFT", "on")
     fft_out = np.asarray(extend_square_fn(k)(jnp.asarray(ods)))
     assert np.array_equal(dense, fft_out)
+
+
+@pytest.mark.parametrize("construction", ["vandermonde", "leopard"])
+def test_md_lowering_identical(monkeypatch, construction):
+    """The transpose-free multi-dim-contraction lowering produces the
+    same bytes as the default batched-2D one (CELESTIA_RS_FFT_MD)."""
+    k = 64
+    data = RNG.integers(0, 256, (2, k, 64), dtype=np.uint8)
+    monkeypatch.delenv("CELESTIA_RS_FFT_MD", raising=False)
+    base = np.asarray(encode_axis_fft(jnp.asarray(data), k, construction, 1))
+    monkeypatch.setenv("CELESTIA_RS_FFT_MD", "1")
+    md = np.asarray(encode_axis_fft(jnp.asarray(data), k, construction, 1))
+    assert np.array_equal(base, md)
