@@ -186,3 +186,55 @@ class TestWALPruneFailure:
         assert wal.prune(5) is True
         assert (1, 0, PREVOTE) not in wal.votes
         assert (9, 0, PREVOTE) in wal.votes
+
+
+class TestProposalRelayBinding:
+    """_wire_verify's proposal rule: the signature alone does not cover
+    the block payload, so relay admission also requires the payload to
+    hash to the SIGNED block id — otherwise one honest proposal yields
+    unbounded mutated relayable copies (each a fresh dedup id)."""
+
+    def _signed_proposal(self, node):
+        from celestia_app_tpu.consensus.machine import Proposal
+        from celestia_app_tpu.consensus.votes import block_id
+
+        # No driver.start(): _wire_verify's production path for an idle
+        # node is the bonded-set fallback, and start() would build this
+        # node's own h1r0 proposal + timers for nothing.
+        driver = node.consensus_driver
+        data_root = b"\x11" * 32
+        time_ns = 1_700_000_000_000_000_000
+        bid = block_id(data_root, node.app.cms.last_app_hash, time_ns)
+        prop = Proposal(
+            1, 0, bid, -1,
+            node._operator_address(),
+            node.validator_key.sign(
+                Proposal(1, 0, bid, -1, node._operator_address(), b"")
+                .sign_bytes(node.chain_id)
+            ),
+        )
+        msg = {
+            "kind": "proposal", "height": 1, "round": 0,
+            "block_hash": bid.hex(), "pol_round": -1,
+            "proposer": prop.proposer, "signature": prop.signature.hex(),
+            "block": {
+                "txs": [], "square_size": 1,
+                "data_hash": data_root.hex(), "time_ns": time_ns,
+            },
+        }
+        return driver, msg
+
+    def test_bound_payload_is_relayable(self):
+        node = _gossip_node()
+        driver, msg = self._signed_proposal(node)
+        assert driver._wire_verify(msg)
+
+    def test_tampered_payload_not_relayed(self):
+        node = _gossip_node()
+        driver, msg = self._signed_proposal(node)
+        # Valid signature, mutated payload: fresh dedup id, must NOT relay.
+        msg["block"]["data_hash"] = (b"\x22" * 32).hex()
+        assert not driver._wire_verify(msg)
+        msg2 = dict(msg)
+        msg2["block"] = {}
+        assert not driver._wire_verify(msg2)
