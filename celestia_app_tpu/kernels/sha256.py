@@ -243,10 +243,14 @@ def _use_pallas(n: int) -> bool:
     if mode == "on":
         return True
     try:
-        backend = jax.default_backend()
+        # Device platform, not jax.default_backend(): the axon TPU plugin
+        # registers under its own backend name while its devices report
+        # platform "tpu" — keying on the backend name would silently leave
+        # the Pallas kernel disabled on the real chip.
+        platform = jax.devices()[0].platform
     except Exception:  # noqa: BLE001 — no backend: host-side tracing only
         return False
-    return backend == "tpu" and n >= 4 * _LANE_TILE
+    return platform == "tpu" and n >= 4 * _LANE_TILE
 
 
 def sha256(msgs: jnp.ndarray) -> jnp.ndarray:
