@@ -61,8 +61,12 @@ BASELINE_NOTE = (
     "the relay has been observed short-circuiting repeat (executable, "
     "args) executions (a parts run returned 0.0s for a 128 MB-output "
     "program), so reusing one buffer can measure the relay's memo instead "
-    "of the chip. The `parts` row decomposes compute@512 into rs_fft / "
-    "rs_dense / nmt_dah device seconds."
+    "of the chip. The `parts` row decomposes compute@512 into rs_dense / "
+    "rs_fft / rs_fft_md and nmt_dah_{jnp,pallas} device seconds, and "
+    "doubles as the autotuner: it runs first and every later row rides "
+    "the fastest measured RS and SHA lowerings (defaults keep the seat "
+    "unless a challenger is >3% faster; the chosen config is recorded in "
+    "the parts row's `tuned` field)."
 )
 
 
@@ -189,9 +193,15 @@ def _host_seconds_per_block(ods: np.ndarray) -> float:
 
 def _parts_seconds(ods: np.ndarray, iters: int) -> dict:
     """Decomposition of the fused pipeline at one k: device-resident times
-    for the RS extension under BOTH encode paths (additive FFT vs dense
-    generator matmul) and for the NMT+DAH hashing half — where the next
-    perf dollar goes (VERDICT r3 next-step #3's bench row)."""
+    for the RS extension under all three encode lowerings (dense generator
+    matmul, additive-FFT stage groups, transpose-free FFT) and for the
+    NMT+DAH hashing half under both SHA paths (fused-jnp vs Pallas).
+
+    Doubles as the AUTOTUNER: the returned dict carries a "tuned" entry
+    naming the fastest RS and SHA variants; the bench child applies those
+    to every later stage, so the headline compute rows always ride the
+    best lowering this chip measured (a >3% margin is required to leave
+    the defaults — noise must not flip the config)."""
     import jax
     import jax.numpy as jnp
 
@@ -240,24 +250,56 @@ def _parts_seconds(ods: np.ndarray, iters: int) -> dict:
                 os.environ.pop(var, None)
             else:
                 os.environ[var] = val
-    hash_fn = jax.jit(roots_fn(k))
-    jax.block_until_ready(hash_fn(eds))
-    # Distinct EDS per iteration (extend the distinct inputs on the restored
-    # default path), produced one at a time so only one extra (2k,2k,S)
-    # square is ever live in HBM alongside the one being hashed.  Release
-    # the warmup square and the A/B input before the loop.
+    # SHA A/B over the hashing half.  Distinct EDS per iteration (extend
+    # the distinct inputs on the restored default path), produced one at a
+    # time so only one extra (2k,2k,S) square is ever live in HBM
+    # alongside the one being hashed.  Release the warmup square and the
+    # A/B input before the loop.
     del eds
     del x
     ext = jax.jit(extend_square_fn(k))
-    times = []
-    for i in range(iters):
-        eds_i = ext(xs[i])
-        jax.block_until_ready(eds_i)
-        t0 = time.perf_counter()
-        jax.block_until_ready(hash_fn(eds_i))
-        times.append(time.perf_counter() - t0)
-        del eds_i
-    out["nmt_dah"] = _median(times)
+    try:
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except Exception:  # noqa: BLE001
+        on_tpu = False
+    sha_rows = [("nmt_dah_jnp", "off")]
+    if on_tpu:  # the Pallas kernel has no compiled CPU path
+        sha_rows.append(("nmt_dah_pallas", "on"))
+    saved_sha = os.environ.get("CELESTIA_SHA_PALLAS")
+    try:
+        for label, flag in sha_rows:
+            os.environ["CELESTIA_SHA_PALLAS"] = flag
+            hash_fn = jax.jit(roots_fn(k))
+            warm_eds = ext(xs[0])
+            jax.block_until_ready(hash_fn(warm_eds))
+            del warm_eds
+            times = []
+            for i in range(iters):
+                eds_i = ext(xs[i])
+                jax.block_until_ready(eds_i)
+                t0 = time.perf_counter()
+                jax.block_until_ready(hash_fn(eds_i))
+                times.append(time.perf_counter() - t0)
+                del eds_i
+            out[label] = _median(times)
+    finally:
+        if saved_sha is None:
+            os.environ.pop("CELESTIA_SHA_PALLAS", None)
+        else:
+            os.environ["CELESTIA_SHA_PALLAS"] = saved_sha
+    # Winner selection with hysteresis: the incumbents — rs_dense, and the
+    # path sha auto would pick on this platform (Pallas on TPU, jnp
+    # elsewhere) — keep the seat unless a challenger is >3% faster.
+    rs_best = "rs_dense"
+    for label in ("rs_fft", "rs_fft_md"):
+        if out[label] < 0.97 * out[rs_best]:
+            rs_best = label
+    sha_best = "pallas" if on_tpu else "jnp"
+    if on_tpu and out["nmt_dah_jnp"] < 0.97 * out["nmt_dah_pallas"]:
+        sha_best = "jnp"
+    # The headline nmt_dah figure is the time of the path later rows run.
+    out["nmt_dah"] = out[f"nmt_dah_{sha_best}"]
+    out["tuned"] = {"rs": rs_best, "sha": sha_best}
     return out
 
 
@@ -307,17 +349,23 @@ def _stream_seconds(ods: np.ndarray, iters: int) -> float:
     k = ods.shape[0]
     jax.block_until_ready(jit_pipeline(k)(jnp.asarray(ods)))  # warmup/compile
 
-    def feed(n, base):
-        # Every streamed block is DISTINCT (see _variant): a cyclic reuse
-        # of a few buffers would repeat (executable, args) pairs that the
-        # relay memo can short-circuit, understating the link cost.
-        for i in range(n):
-            yield i, _variant(ods, base + i, axis=0)
-
+    # Every streamed block is DISTINCT (see _variant): a cyclic reuse of a
+    # few buffers would repeat (executable, args) pairs that the relay
+    # memo can short-circuit, understating the link cost.  All variants
+    # are materialized BEFORE the timed window so the feeder never charges
+    # host roll/copy work to the stream measurement (device timings
+    # collapse badly under concurrent host load on this box).
     n = 4 * iters
-    list(stream_blocks(feed(2, base=n), k))  # warm the feeder path
+    warm_blocks = [_variant(ods, n + i, axis=0) for i in range(2)]
+    blocks = [_variant(ods, i, axis=0) for i in range(n)]
+
+    def feed(blist):
+        for i, b in enumerate(blist):
+            yield i, b
+
+    list(stream_blocks(feed(warm_blocks), k))  # warm the feeder path
     t0 = time.perf_counter()
-    for _tag, eds in stream_blocks(feed(n, base=0), k):
+    for _tag, eds in stream_blocks(feed(blocks), k):
         eds.data_root()  # host sync per block, as a server would
     return (time.perf_counter() - t0) / n
 
@@ -339,13 +387,16 @@ def _stage_plan() -> list[dict]:
         return plan
     # Device rows run FIRST and the CPU-heavy host baseline LAST: round 2's
     # driver bench showed device timings collapse ~25x under concurrent
-    # host load, so nothing CPU-bound may precede them.  compute@512 runs
-    # twice (start and end of the device block) as a stability check.
+    # host load, so nothing CPU-bound may precede them.  parts@512 leads:
+    # it doubles as the autotuner, so every later row (incl. the headline
+    # compute rows) runs on the fastest measured RS/SHA lowerings.
+    # compute@512 runs twice (early and end of the device block) as a
+    # stability check.
     plan = [
+        {"mode": "parts", "k": 512},
         {"mode": "compute", "k": 512},
         {"mode": "compute", "k": 256},
         {"mode": "compute", "k": 128},
-        {"mode": "parts", "k": 512},
         {"mode": "extend", "k": 128},
         {"mode": "extend", "k": 256},
         {"mode": "extend", "k": 512},
@@ -413,13 +464,34 @@ def _run_child() -> None:
             ods_mb = ods.nbytes / 1e6
             if mode == "parts":
                 parts = _parts_seconds(ods, max(iters, 3))
+                tuned = parts.pop("tuned", None)
                 emit({
                     "stage": name, "mode": mode, "k": k,
                     "parts_seconds": {p: round(s, 4) for p, s in parts.items()},
+                    "tuned": tuned,
                     "mb": ods_mb,
                     "wall_s": round(time.monotonic() - t_start, 1),
                     "loadavg": round(la, 2), "platform": platform,
                 })
+                if tuned is not None:
+                    # Autotune: every later stage (incl. the headline
+                    # compute rows) rides the fastest measured lowerings.
+                    # Safe because nothing has built jit_pipeline yet —
+                    # parts runs FIRST in the device block and uses fresh
+                    # jax.jit wrappers, so the process-wide pipeline cache
+                    # traces under this env.
+                    if tuned["rs"] == "rs_dense":
+                        os.environ["CELESTIA_RS_FFT"] = "off"
+                        os.environ.pop("CELESTIA_RS_FFT_MD", None)
+                    else:
+                        os.environ["CELESTIA_RS_FFT"] = "on"
+                        if tuned["rs"] == "rs_fft_md":
+                            os.environ["CELESTIA_RS_FFT_MD"] = "1"
+                        else:
+                            os.environ.pop("CELESTIA_RS_FFT_MD", None)
+                    os.environ["CELESTIA_SHA_PALLAS"] = (
+                        "on" if tuned["sha"] == "pallas" else "off"
+                    )
                 gc.collect()
                 continue
             if mode == "host":
@@ -593,6 +665,7 @@ def main() -> None:
         if parts_only is not None:  # diagnostic BENCH_MODE=parts run
             out["parts"] = {
                 "k": parts_only["k"], "seconds": parts_only["parts_seconds"],
+                **({"tuned": parts_only["tuned"]} if parts_only.get("tuned") else {}),
             }
             if errors:  # rate stages may still have failed — say so
                 out["errors"] = errors
@@ -648,6 +721,7 @@ def main() -> None:
     if parts_only is not None:
         out["parts"] = {
             "k": parts_only["k"], "seconds": parts_only["parts_seconds"],
+            **({"tuned": parts_only["tuned"]} if parts_only.get("tuned") else {}),
         }
     if stability_pct is not None:
         out["stability_pct"] = stability_pct

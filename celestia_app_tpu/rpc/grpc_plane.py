@@ -99,7 +99,16 @@ def _field_int(raw: bytes, num: int) -> int:
 
 
 def _handlers(node) -> dict:
-    """method path suffix -> unary handler(bytes) -> bytes."""
+    """method path suffix -> unary handler(bytes) -> bytes.
+
+    State reads hold `node.lock` (when the node has one): gRPC workers run
+    concurrently with the proposer loop, and the unlocked TestNode query
+    methods read `cms.working` mid-commit — the JSON-RPC plane's rpc_*
+    wrappers take the same lock (rpc/server.py:581,946)."""
+    from contextlib import nullcontext
+
+    def node_lock():
+        return getattr(node, "lock", None) or nullcontext()
 
     def broadcast_tx(req: bytes) -> bytes:
         # BroadcastTxRequest {tx_bytes=1, mode=2}; mode BROADCAST_MODE_SYNC
@@ -129,7 +138,8 @@ def _handlers(node) -> dict:
     def query_account(req: bytes) -> bytes:
         # QueryAccountRequest {address=1} -> {account=1 Any(BaseAccount)}.
         addr = _field_str(req, 1)
-        acc = node.query_account(addr)
+        with node_lock():
+            acc = node.query_account(addr)
         if acc is None:
             return b""
         base = (
@@ -148,7 +158,8 @@ def _handlers(node) -> dict:
 
         addr = _field_str(req, 1)
         denom = _field_str(req, 2) or "utia"
-        amount = BankKeeper(node.app.cms.working).balance(addr, denom)
+        with node_lock():
+            amount = BankKeeper(node.app.cms.working).balance(addr, denom)
         coin = encode_bytes_field(1, denom.encode()) + encode_bytes_field(
             2, str(amount).encode()
         )
@@ -158,8 +169,10 @@ def _handlers(node) -> dict:
         # QueryValidatorsRequest -> {validators=1 repeated Validator
         # {operator_address=1, tokens=5}} — the fields txsim's stake
         # sequence reads.
+        with node_lock():
+            vals = node.validators()
         out = b""
-        for v in node.validators():
+        for v in vals:
             val = encode_bytes_field(
                 1, v["address"].encode()
             ) + encode_bytes_field(5, str(v.get("power", 0)).encode())
@@ -308,10 +321,16 @@ class GrpcNode:
         )
         return int(_field_str(_field_bytes(resp, 1), 2) or 0)
 
-    def produce_block(self, timeout_s: float = 15.0):
+    def produce_block(self, timeout_s: float = 120.0):
         """The cosmos gRPC surface has no dev produce-block hook; wait for
         the served node's proposer loop to commit the next height (txsim's
-        per-round block barrier), shaped like TestNode.produce_block."""
+        per-round block barrier), shaped like TestNode.produce_block.
+
+        Default waits out a worst-case first-ever-square-size jit compile
+        inside the proposer loop (35-50 s on the 1-core box — the same
+        cold-compile allowance RemoteNode's socket timeout makes,
+        rpc/client.py:40-44); steady-state blocks commit in well under a
+        second."""
         import time
 
         start = self.height()

@@ -1,0 +1,150 @@
+"""One-session TPU measurement sweep: RS variants + SHA paths at one k.
+
+The axon tunnel holds a single session grant and has been observed to wedge
+when clients overlap or die mid-grant, so this script does EVERYTHING in one
+process, serially, and uses a DISTINCT input per timed iteration (the relay
+can short-circuit repeat (executable, args) executions — see bench.py's
+`_variant`).
+
+    PYTHONPATH=/root/repo python scripts/tpu_measure.py [k] [iters]
+
+Prints one JSON line:
+    {"platform": ..., "default_backend": ..., "k": ...,
+     "rs": {"dense": s, "fft": s, "fft_md": s},
+     "sha": {"jnp": s, "pallas": s}, "pipeline": s}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    k = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+
+    import jax
+    import jax.numpy as jnp
+
+    from celestia_app_tpu.constants import NAMESPACE_SIZE, SHARE_SIZE
+
+    out: dict = {
+        "platform": jax.devices()[0].platform,
+        "default_backend": jax.default_backend(),
+        "k": k,
+        "iters": iters,
+    }
+    print(f"# backend up: {out['platform']}/{out['default_backend']}", flush=True)
+
+    rng = np.random.default_rng(3)
+    n = k * k
+    ns = np.sort(rng.integers(0, 200, n).astype(np.uint8))
+    ods = rng.integers(0, 256, (n, SHARE_SIZE), dtype=np.uint8)
+    ods[:, :NAMESPACE_SIZE] = 0
+    ods[:, NAMESPACE_SIZE - 1] = ns
+    ods = ods.reshape(k, k, SHARE_SIZE)
+
+    def variants(count: int, base: int = 0):
+        return [
+            jax.device_put(jnp.asarray(np.ascontiguousarray(np.roll(ods, base + i + 1, axis=1))))
+            for i in range(count)
+        ]
+
+    def timed(fn, args_list):
+        ts = []
+        for a in args_list:
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(a))
+            ts.append(time.perf_counter() - t0)
+        return sorted(ts)[len(ts) // 2], ts
+
+    from celestia_app_tpu.kernels.rs import extend_square_fn
+
+    warm = jax.device_put(jnp.asarray(ods))
+
+    # --- RS variants (fresh jit per variant; env read at trace time) ---
+    out["rs"] = {}
+    out["rs_all"] = {}
+    rs_flags = (
+        ("dense", {"CELESTIA_RS_FFT": "off"}),
+        ("fft", {"CELESTIA_RS_FFT": "on"}),
+        ("fft_md", {"CELESTIA_RS_FFT": "on", "CELESTIA_RS_FFT_MD": "1"}),
+    )
+    checksums = {}
+    for label, flags in rs_flags:
+        for var in ("CELESTIA_RS_FFT", "CELESTIA_RS_FFT_MD"):
+            os.environ.pop(var, None)
+        os.environ.update(flags)
+        fn = jax.jit(extend_square_fn(k))
+        t0 = time.perf_counter()
+        eds_w = fn(warm)
+        jax.block_until_ready(eds_w)
+        compile_s = time.perf_counter() - t0
+        checksums[label] = int(np.asarray(eds_w[k:, k:, :4]).astype(np.uint64).sum())
+        del eds_w
+        med, ts = timed(fn, variants(iters, base=10))
+        out["rs"][label] = round(med, 4)
+        out["rs_all"][label] = [round(t, 4) for t in ts]
+        print(f"# rs {label}: median {med:.4f}s (compile+first {compile_s:.1f}s) {ts}", flush=True)
+    for var in ("CELESTIA_RS_FFT", "CELESTIA_RS_FFT_MD"):
+        os.environ.pop(var, None)
+    out["rs_checksums_equal"] = len(set(checksums.values())) == 1
+    assert out["rs_checksums_equal"], f"RS variants disagree: {checksums}"
+
+    # --- SHA paths over the NMT+DAH half ---
+    from celestia_app_tpu.da.eds import roots_fn
+
+    ext = jax.jit(extend_square_fn(k))
+    out["sha"] = {}
+    roots_got = {}
+    sha_rows = (("jnp", "off"), ("pallas", "on"))
+    if out["platform"] != "tpu":
+        sha_rows = (("jnp", "off"),)  # pallas has no compiled CPU path
+    for label, flag in sha_rows:
+        os.environ["CELESTIA_SHA_PALLAS"] = flag
+        fn = jax.jit(roots_fn(k))
+        eds_w = ext(warm)
+        o = fn(eds_w)
+        jax.block_until_ready(o)
+        roots_got[label] = [np.asarray(x) for x in o]
+        ts = []
+        for i in range(iters):
+            eds_i = ext(variants(1, base=20 + i)[0])
+            jax.block_until_ready(eds_i)
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(eds_i))
+            ts.append(time.perf_counter() - t0)
+            del eds_i
+        med = sorted(ts)[len(ts) // 2]
+        out["sha"][label] = round(med, 4)
+        print(f"# sha {label}: median {med:.4f}s {ts}", flush=True)
+    os.environ.pop("CELESTIA_SHA_PALLAS", None)
+    if "pallas" in roots_got:
+        for a, b in zip(roots_got["jnp"], roots_got["pallas"]):
+            assert np.array_equal(a, b), "roots diverge between sha paths"
+        out["sha_roots_equal"] = True
+
+    # --- full fused pipeline on defaults ---
+    from celestia_app_tpu.da.eds import jit_pipeline
+
+    pipe = jit_pipeline(k)
+    jax.block_until_ready(pipe(warm))
+    med, ts = timed(lambda x: pipe(x)[3], variants(iters, base=30))
+    out["pipeline"] = round(med, 4)
+    mb = (k * k * SHARE_SIZE) / 1e6
+    out["pipeline_mb_s"] = round(mb / med, 1)
+    print(f"# pipeline: {med:.4f}s = {mb / med:.1f} MB/s", flush=True)
+
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
