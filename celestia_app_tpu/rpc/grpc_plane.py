@@ -129,7 +129,8 @@ def _handlers(node) -> dict:
         # GetTxRequest {hash=1 (hex)}; NotFound -> empty response (the
         # client treats an absent tx_response as "not yet included").
         txhash = _field_str(req, 1)
-        status = node.tx_status(bytes.fromhex(txhash))
+        with node_lock():
+            status = node.tx_status(bytes.fromhex(txhash))
         if status is None:
             return b""
         height, code, log = status

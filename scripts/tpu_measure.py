@@ -33,6 +33,7 @@ def main() -> int:
     import jax
     import jax.numpy as jnp
 
+    from bench import _median, _variant  # shared distinct-input discipline
     from celestia_app_tpu.constants import NAMESPACE_SIZE, SHARE_SIZE
 
     out: dict = {
@@ -53,7 +54,7 @@ def main() -> int:
 
     def variants(count: int, base: int = 0):
         return [
-            jax.device_put(jnp.asarray(np.ascontiguousarray(np.roll(ods, base + i + 1, axis=1))))
+            jax.device_put(jnp.asarray(_variant(ods, base + i)))
             for i in range(count)
         ]
 
@@ -63,7 +64,7 @@ def main() -> int:
             t0 = time.perf_counter()
             jax.block_until_ready(fn(a))
             ts.append(time.perf_counter() - t0)
-        return sorted(ts)[len(ts) // 2], ts
+        return _median(ts), ts
 
     from celestia_app_tpu.kernels.rs import extend_square_fn
 

@@ -1,0 +1,56 @@
+"""bench.py autotune selection: hysteresis keeps incumbents unless a
+challenger is >3% faster (reference perf bar: the driver's end-of-round
+bench must ride the fastest measured lowering without noise flips)."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+
+_spec = importlib.util.spec_from_file_location(
+    "bench", os.path.join(os.path.dirname(__file__), "..", "bench.py")
+)
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+
+def _seconds(dense=1.0, fft=1.0, fft_md=1.0, jnp=1.0, pallas=1.0):
+    return {
+        "rs_dense": dense, "rs_fft": fft, "rs_fft_md": fft_md,
+        "nmt_dah_jnp": jnp, "nmt_dah_pallas": pallas,
+    }
+
+
+class TestPickTuned:
+    def test_defaults_hold_on_ties(self):
+        nmt, tuned = bench._pick_tuned(_seconds(), on_tpu=True)
+        assert tuned == {"rs": "rs_dense", "sha": "pallas"}
+        assert nmt == 1.0
+
+    def test_small_margins_do_not_flip(self):
+        # 2% faster challengers stay benched (noise guard).
+        s = _seconds(fft=0.98, fft_md=0.985, jnp=0.98)
+        _, tuned = bench._pick_tuned(s, on_tpu=True)
+        assert tuned == {"rs": "rs_dense", "sha": "pallas"}
+
+    def test_clear_winners_take_the_seat(self):
+        s = _seconds(fft=0.5, fft_md=0.6, jnp=0.4, pallas=1.0)
+        nmt, tuned = bench._pick_tuned(s, on_tpu=True)
+        assert tuned == {"rs": "rs_fft", "sha": "jnp"}
+        assert nmt == 0.4  # headline reports the path later rows run
+
+    def test_fft_md_must_beat_fft_not_just_dense(self):
+        # fft takes the seat first; md must then beat FFT by >3%.
+        s = _seconds(fft=0.5, fft_md=0.49)
+        _, tuned = bench._pick_tuned(s, on_tpu=True)
+        assert tuned["rs"] == "rs_fft"
+        s = _seconds(fft=0.5, fft_md=0.4)
+        _, tuned = bench._pick_tuned(s, on_tpu=True)
+        assert tuned["rs"] == "rs_fft_md"
+
+    def test_off_tpu_incumbent_is_jnp(self):
+        # No Pallas path off-TPU: sha stays jnp and the headline is jnp's.
+        s = _seconds(jnp=0.7)
+        nmt, tuned = bench._pick_tuned(s, on_tpu=False)
+        assert tuned["sha"] == "jnp"
+        assert nmt == 0.7
