@@ -302,8 +302,9 @@ def _pick_tuned(seconds: dict, on_tpu: bool) -> tuple[float, dict]:
     The incumbents — rs_dense, and the path sha auto would pick on this
     platform (Pallas on TPU, jnp elsewhere) — keep the seat unless a
     challenger is >3% faster, so measurement noise cannot flip the
-    config.  Returns (nmt_dah headline seconds — the time of the SHA path
-    later rows actually run, tuned choices dict)."""
+    config.  Returns (nmt_dah headline seconds — the tuner's SHA pick;
+    the child's "tuned-applied" record says what later rows actually ran
+    once operator-set knobs are honored, tuned choices dict)."""
     rs_best = "rs_dense"
     for label in ("rs_fft", "rs_fft_md"):
         if seconds[label] < 0.97 * seconds[rs_best]:
@@ -497,17 +498,37 @@ def _run_child() -> None:
                     # CELESTIA_RS_FFT=on is measuring that path on
                     # purpose (parts saves/restores, so presence here
                     # means the operator set it).
-                    if "CELESTIA_RS_FFT" not in os.environ:
-                        if tuned["rs"] == "rs_dense":
-                            os.environ["CELESTIA_RS_FFT"] = "off"
-                        else:
+                    if (
+                        "CELESTIA_RS_FFT" not in os.environ
+                        and "CELESTIA_RS_FFT_MD" not in os.environ
+                    ):
+                        if tuned["rs"] != "rs_dense":
                             os.environ["CELESTIA_RS_FFT"] = "on"
                             if tuned["rs"] == "rs_fft_md":
                                 os.environ["CELESTIA_RS_FFT_MD"] = "1"
+                        else:
+                            os.environ["CELESTIA_RS_FFT"] = "off"
                     if "CELESTIA_SHA_PALLAS" not in os.environ:
                         os.environ["CELESTIA_SHA_PALLAS"] = (
                             "on" if tuned["sha"] == "pallas" else "off"
                         )
+                    # What later rows ACTUALLY run (operator knobs win
+                    # over the tuner) — derived from the final env so the
+                    # record can never contradict the headline rows.
+                    fft_env = os.environ.get("CELESTIA_RS_FFT", "auto")
+                    applied_rs = "rs_dense" if fft_env != "on" else (
+                        "rs_fft_md"
+                        if os.environ.get("CELESTIA_RS_FFT_MD") == "1"
+                        else "rs_fft"
+                    )
+                    sha_env = os.environ.get("CELESTIA_SHA_PALLAS", "auto")
+                    applied_sha = {"on": "pallas", "off": "jnp"}.get(
+                        sha_env, "auto"
+                    )
+                    emit({
+                        "stage": "tuned-applied",
+                        "applied": {"rs": applied_rs, "sha": applied_sha},
+                    })
                 gc.collect()
                 continue
             if mode == "host":
@@ -735,9 +756,14 @@ def main() -> None:
         "baseline_note": BASELINE_NOTE,
     }
     if parts_only is not None:
+        applied = next(
+            (r["applied"] for r in recs if r.get("stage") == "tuned-applied"),
+            None,
+        )
         out["parts"] = {
             "k": parts_only["k"], "seconds": parts_only["parts_seconds"],
             **({"tuned": parts_only["tuned"]} if parts_only.get("tuned") else {}),
+            **({"applied": applied} if applied else {}),
         }
     if stability_pct is not None:
         out["stability_pct"] = stability_pct

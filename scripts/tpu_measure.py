@@ -123,7 +123,7 @@ def main() -> int:
             jax.block_until_ready(fn(eds_i))
             ts.append(time.perf_counter() - t0)
             del eds_i
-        med = sorted(ts)[len(ts) // 2]
+        med = _median(ts)
         out["sha"][label] = round(med, 4)
         print(f"# sha {label}: median {med:.4f}s {ts}", flush=True)
     os.environ.pop("CELESTIA_SHA_PALLAS", None)

@@ -83,6 +83,46 @@ class TestGrpcServices:
         res = client.broadcast(b"\x00garbage")
         assert res.code != 0
 
+    def test_queries_race_the_proposer_loop(self, served):
+        """Race tier: gRPC workers read state under node.lock while the
+        proposer loop commits concurrently (the JSON-RPC plane's rpc_*
+        wrappers take the same lock — rpc/server.py:581,946).  Every
+        query must return a coherent value, never an exception from a
+        mid-commit read of cms.working."""
+        import threading
+
+        node, client = served
+        addr = node.keys[0].public_key().address()
+        errors: list[BaseException] = []
+        stop = threading.Event()
+
+        def hammer():
+            try:
+                while not stop.is_set():
+                    acc = client.query_account(addr)
+                    assert acc is not None and acc.address == addr
+                    assert client.balance(addr) > 0
+                    vals = client.validators()
+                    assert vals and vals[0]["power"] > 0
+                    assert client.tx_status(b"\x00" * 32) is None
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        h0 = client.height()
+        deadline = time.monotonic() + 20
+        # Require >= 3 commits under fire, then stop hammering.
+        while client.height() < h0 + 3 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        committed = client.height() - h0
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors, errors[:3]
+        assert committed >= 3, "proposer loop starved under query load"
+
 
 @pytest.mark.slow
 class TestTxsimOverGrpc:
