@@ -52,6 +52,7 @@ from celestia_app_tpu.tx.messages import (
     MsgAuthzGrant,
     MsgAuthzRevoke,
     MsgBeginRedelegate,
+    MsgCancelUnbondingDelegation,
     MsgCreateValidator,
     MsgDelegate,
     MsgDeposit,
@@ -92,6 +93,7 @@ _V1_MSGS = {
     MsgSend, MsgPayForBlobs, MsgSubmitProposal, MsgVote, MsgVoteWeighted, MsgDeposit,
     MsgTransfer, MsgRecvPacket, MsgAcknowledgement, MsgTimeout,
     MsgDelegate, MsgUndelegate, MsgBeginRedelegate,
+    MsgCancelUnbondingDelegation,
     MsgCreateValidator, MsgEditValidator,
     MsgWithdrawDelegatorReward, MsgWithdrawValidatorCommission,
     MsgSetWithdrawAddress, MsgFundCommunityPool, MsgUnjail,

@@ -50,6 +50,7 @@ from celestia_app_tpu.tx.messages import (
     MsgAuthzGrant,
     MsgAuthzRevoke,
     MsgBeginRedelegate,
+    MsgCancelUnbondingDelegation,
     MsgCreateValidator,
     MsgDelegate,
     MsgDeposit,
@@ -727,7 +728,7 @@ class App:
                 # account's liquid funds for the whole unbonding window.
                 completion = ctx.staking.undelegate(
                     ctx.bank, msg.delegator_address, msg.validator_address,
-                    amount, ctx.time_ns,
+                    amount, ctx.time_ns, height=ctx.height,
                 )
                 # An operator undelegating below its declared
                 # min_self_delegation is jailed (sdk Undelegate's
@@ -763,6 +764,26 @@ class App:
                 ctx.staking.jail(msg.validator_address)
             return 0, [("cosmos.staking.v1beta1.EventRedelegate",
                         msg.validator_address, msg.validator_dst_address, amount)]
+        if isinstance(msg, MsgCancelUnbondingDelegation):
+            from celestia_app_tpu.modules.distribution import DistributionKeeper
+            from celestia_app_tpu.state.staking import StakingError
+
+            # Settle pending rewards before shares change (the same
+            # BeforeDelegationSharesModified hook the delegate path runs).
+            DistributionKeeper(ctx.store).settle(
+                ctx.staking, msg.delegator_address, msg.validator_address
+            )
+            try:
+                ctx.staking.cancel_unbonding(
+                    ctx.bank, msg.delegator_address, msg.validator_address,
+                    msg.amount.amount, msg.creation_height, ctx.time_ns,
+                )
+            except StakingError as e:
+                raise ValueError(str(e)) from e
+            return 0, [(
+                "cosmos.staking.v1beta1.EventCancelUnbondingDelegation",
+                msg.validator_address, msg.amount.amount, msg.creation_height,
+            )]
         if isinstance(msg, MsgUnjail):
             from celestia_app_tpu.modules.slashing import (
                 SlashingError,

@@ -39,13 +39,14 @@ _ACCOUNT_PREFIX = b"ica/account/"
 _PARAMS_KEY = b"ica/host_params"
 
 # The celestia whitelist (app/ica_host.go:3-17), minus msg types this
-# framework doesn't implement (MsgCancelUnbondingDelegation, gov v1).
+# framework doesn't implement (gov v1).
 DEFAULT_ALLOW_MESSAGES = (
     "/ibc.applications.transfer.v1.MsgTransfer",
     "/cosmos.bank.v1beta1.MsgSend",
     "/cosmos.staking.v1beta1.MsgDelegate",
     "/cosmos.staking.v1beta1.MsgBeginRedelegate",
     "/cosmos.staking.v1beta1.MsgUndelegate",
+    "/cosmos.staking.v1beta1.MsgCancelUnbondingDelegation",
     "/cosmos.distribution.v1beta1.MsgSetWithdrawAddress",
     "/cosmos.distribution.v1beta1.MsgWithdrawDelegatorReward",
     "/cosmos.distribution.v1beta1.MsgFundCommunityPool",

@@ -193,13 +193,14 @@ class StakingKeeper:
         # Unbonding entries for this validator are slashed too, or an
         # undelegation racing the evidence would dodge the burn and shift
         # the whole loss onto the delegators who stayed (the sdk slashes
-        # unbonding delegations for the same reason; without per-entry
-        # creation heights this cuts ALL of the validator's entries — a
-        # strict superset of the sdk's created-after-infraction rule).
+        # unbonding delegations for the same reason; entries carry
+        # creation heights, but this deliberately cuts ALL of the
+        # validator's entries — a strict superset of the sdk's
+        # created-after-infraction rule, since slash() is not told the
+        # infraction height).
         burned_unbonding = 0
-        suffix = b"/" + validator.encode()
         for key, val in list(self.store.iterate(_UBD_PREFIX)):
-            if not key.endswith(suffix):
+            if self._ubd_parse(key)[2] != validator:
                 continue
             amount = int.from_bytes(val, "big")
             cut = amount * fraction_raw // precision
@@ -252,11 +253,17 @@ class StakingKeeper:
         self._set_tokens(validator, self.tokens(validator) + amount)
 
     def undelegate(
-        self, bank, delegator: str, validator: str, amount: int, time_ns: int
+        self, bank, delegator: str, validator: str, amount: int, time_ns: int,
+        height: int = 0,
     ) -> int:
         """MsgUndelegate: tokens leave the bonded pool now, the delegator
         gets them back at completion (3-week unbonding).  Returns the
-        completion time."""
+        completion time.
+
+        `height` is the entry's creation height (sdk UnbondingDelegationEntry
+        .CreationHeight) — the handle MsgCancelUnbondingDelegation names an
+        entry by.  Undelegations in one block aggregate into one entry
+        (same completion time, same height), as in the sdk."""
         held = self.delegation(delegator, validator)
         if amount <= 0 or amount > held:
             raise StakingError(
@@ -266,14 +273,90 @@ class StakingKeeper:
         self._set_tokens(validator, self.tokens(validator) - amount)
         bank.send(BONDED_POOL, NOT_BONDED_POOL, amount)
         completion_ns = time_ns + UNBONDING_TIME_NS
-        key = (
-            _UBD_PREFIX + completion_ns.to_bytes(12, "big") + b"/"
-            + delegator.encode() + b"/" + validator.encode()
-        )
+        key = self._ubd_key(completion_ns, delegator, validator, height)
         prev = self.store.get(key)
         total = (int.from_bytes(prev, "big") if prev else 0) + amount
         self.store.set(key, total.to_bytes(16, "big"))
         return completion_ns
+
+    @staticmethod
+    def _ubd_key(
+        completion_ns: int, delegator: str, validator: str, height: int
+    ) -> bytes:
+        """Unbonding entry key: completion-ordered, then addressed by
+        (delegator, validator, creation height).  The height rides as
+        ASCII decimal so every segment stays b"/"-split-safe."""
+        return (
+            _UBD_PREFIX + completion_ns.to_bytes(12, "big") + b"/"
+            + delegator.encode() + b"/" + validator.encode() + b"/"
+            + str(height).encode()
+        )
+
+    @staticmethod
+    def _ubd_parse(key: bytes) -> tuple[int, str, str, int]:
+        """(completion_ns, delegator, validator, creation_height) of an
+        unbonding entry key."""
+        completion_ns = int.from_bytes(
+            key[len(_UBD_PREFIX): len(_UBD_PREFIX) + 12], "big"
+        )
+        parts = key[len(_UBD_PREFIX) + 13:].split(b"/")
+        return (
+            completion_ns, parts[0].decode(), parts[1].decode(),
+            int(parts[2]),
+        )
+
+    def cancel_unbonding(
+        self, bank, delegator: str, validator: str, amount: int,
+        creation_height: int, time_ns: int,
+    ) -> None:
+        """MsgCancelUnbondingDelegation (sdk v0.46 x/staking): re-bond
+        `amount` from the unbonding entry created at `creation_height`
+        back to the SAME validator — the entry shrinks (or disappears)
+        and the tokens return to the bonded pool immediately.
+
+        sdk guards reproduced: a jailed validator refuses re-bonds
+        (ErrValidatorJailed — a tombstoned double-signer must not regain
+        power this way), and an entry whose completion time has passed is
+        no longer cancellable even though the end blocker releases it
+        later in the same block (messages run before end block)."""
+        if amount <= 0:
+            raise StakingError("cancel amount must be positive")
+        if not self.has_validator(validator):
+            raise StakingError(f"no validator {validator}")
+        if self.is_jailed(validator):
+            raise StakingError(f"validator {validator} is jailed")
+        entry_key = None
+        entry_amount = 0
+        for key, val in self.store.iterate(_UBD_PREFIX):
+            completion_ns, d, v, h = self._ubd_parse(key)
+            if (d, v, h) == (delegator, validator, creation_height):
+                if completion_ns <= time_ns:
+                    raise StakingError(
+                        "unbonding delegation is no longer pending "
+                        f"(completed at {completion_ns})"
+                    )
+                entry_key = key
+                entry_amount = int.from_bytes(val, "big")
+                break
+        if entry_key is None:
+            raise StakingError(
+                f"no unbonding entry for {delegator}/{validator} at "
+                f"height {creation_height}"
+            )
+        if amount > entry_amount:
+            raise StakingError(
+                f"cancel amount {amount} exceeds unbonding entry "
+                f"{entry_amount}"
+            )
+        if amount == entry_amount:
+            self.store.delete(entry_key)
+        else:
+            self.store.set(entry_key, (entry_amount - amount).to_bytes(16, "big"))
+        bank.send(NOT_BONDED_POOL, BONDED_POOL, amount)
+        self._set_delegation(
+            delegator, validator, self.delegation(delegator, validator) + amount
+        )
+        self._set_tokens(validator, self.tokens(validator) + amount)
 
     def begin_redelegate(
         self, delegator: str, src: str, dst: str, amount: int
@@ -338,12 +421,9 @@ class StakingKeeper:
         (delegator, amount) payouts."""
         released = []
         for key, val in self.store.iterate(_UBD_PREFIX):
-            completion_ns = int.from_bytes(
-                key[len(_UBD_PREFIX): len(_UBD_PREFIX) + 12], "big"
-            )
+            completion_ns, delegator, _, _ = self._ubd_parse(key)
             if completion_ns > time_ns:
                 continue
-            delegator = key[len(_UBD_PREFIX) + 13:].split(b"/")[0].decode()
             amount = int.from_bytes(val, "big")
             bank.send(NOT_BONDED_POOL, delegator, amount)
             self.store.delete(key)

@@ -40,6 +40,7 @@ URL_MSG_TIMEOUT = "/ibc.core.channel.v1.MsgTimeout"
 URL_MSG_DELEGATE = "/cosmos.staking.v1beta1.MsgDelegate"
 URL_MSG_UNDELEGATE = "/cosmos.staking.v1beta1.MsgUndelegate"
 URL_MSG_BEGIN_REDELEGATE = "/cosmos.staking.v1beta1.MsgBeginRedelegate"
+URL_MSG_CANCEL_UNBONDING = "/cosmos.staking.v1beta1.MsgCancelUnbondingDelegation"
 URL_MSG_WITHDRAW_DELEGATOR_REWARD = (
     "/cosmos.distribution.v1beta1.MsgWithdrawDelegatorReward"
 )
@@ -818,6 +819,60 @@ MsgBeginRedelegate = _staking_msg(URL_MSG_BEGIN_REDELEGATE, has_dst=True)
 
 
 @dataclass(frozen=True)
+class MsgCancelUnbondingDelegation:
+    """cosmos.staking.v1beta1.MsgCancelUnbondingDelegation (sdk v0.46)
+    {delegator_address=1, validator_address=2, amount=3 Coin,
+    creation_height=4 int64}: re-bond tokens from the unbonding entry
+    created at `creation_height` back to the same validator."""
+
+    delegator_address: str
+    validator_address: str
+    amount: Coin
+    creation_height: int
+
+    TYPE_URL = URL_MSG_CANCEL_UNBONDING
+
+    def marshal(self) -> bytes:
+        out = encode_bytes_field(1, self.delegator_address.encode())
+        out += encode_bytes_field(2, self.validator_address.encode())
+        out += encode_bytes_field(3, self.amount.marshal())
+        if self.creation_height:
+            out += encode_varint_field(4, self.creation_height)
+        return out
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgCancelUnbondingDelegation":
+        f = {num: val for num, wt, val in decode_fields(raw) if wt == WIRE_LEN}
+        ints = {num: val for num, wt, val in decode_fields(raw) if wt == WIRE_VARINT}
+        return cls(
+            f.get(1, b"").decode(), f.get(2, b"").decode(),
+            Coin.unmarshal(f.get(3, b"")), ints.get(4, 0),
+        )
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+    @property
+    def signer(self) -> str:
+        return self.delegator_address
+
+    def validate_basic(self) -> None:
+        from celestia_app_tpu.crypto.keys import validate_address
+
+        validate_address(self.delegator_address)
+        if not self.validator_address:
+            raise ValueError("validator address must not be empty")
+        if self.amount.denom != "utia":
+            raise ValueError(
+                f"invalid bond denom {self.amount.denom!r}, expected utia"
+            )
+        if self.amount.amount <= 0:
+            raise ValueError("cancel amount must be positive")
+        if self.creation_height < 0:
+            raise ValueError("creation height must be non-negative")
+
+
+@dataclass(frozen=True)
 class MsgCreateValidator:
     """cosmos.staking.v1beta1.MsgCreateValidator {description=1
     {moniker=1}, commission=2 {rate=1, max_rate=2, max_change_rate=3 —
@@ -1384,6 +1439,7 @@ MSG_DECODERS = {
     URL_MSG_DELEGATE: MsgDelegate.unmarshal,
     URL_MSG_UNDELEGATE: MsgUndelegate.unmarshal,
     URL_MSG_BEGIN_REDELEGATE: MsgBeginRedelegate.unmarshal,
+    URL_MSG_CANCEL_UNBONDING: MsgCancelUnbondingDelegation.unmarshal,
     URL_MSG_PAY_FOR_BLOBS: MsgPayForBlobs.unmarshal,
     URL_MSG_SEND: MsgSend.unmarshal,
     URL_MSG_SIGNAL_VERSION: MsgSignalVersion.unmarshal,

@@ -228,6 +228,20 @@ class TestStakingWire:
             amount=pb["coin"].Coin(denom="utia", amount="9"),
         ).SerializeToString()
 
+        from celestia_app_tpu.tx.messages import MsgCancelUnbondingDelegation
+
+        c = MsgCancelUnbondingDelegation(
+            "celestia1del", "celestiavaloper1x", Coin("utia", 4), 37
+        )
+        ref_c = staking.MsgCancelUnbondingDelegation(
+            delegator_address="celestia1del", validator_address="celestiavaloper1x",
+            amount=pb["coin"].Coin(denom="utia", amount="4"), creation_height=37,
+        )
+        assert c.marshal() == ref_c.SerializeToString()
+        assert (
+            MsgCancelUnbondingDelegation.unmarshal(ref_c.SerializeToString()) == c
+        )
+
     def test_create_edit_validator_msgs(self, pb):
         import importlib
 

@@ -23,8 +23,10 @@ import pytest
 
 from celestia_app_tpu.kernels.sha256 import _sha256_jnp, _sha256_pallas
 
+# Device platform, not jax.default_backend(): the axon TPU plugin registers
+# under its own backend name while its devices report platform "tpu".
 pytestmark = pytest.mark.skipif(
-    jax.default_backend() != "tpu",
+    jax.devices()[0].platform != "tpu",
     reason="Pallas SHA-256 compiles only for TPU (interpret mode is minutes-slow)",
 )
 

@@ -86,6 +86,32 @@ class TestDelegation:
         with pytest.raises(StakingError, match="same validator"):
             sk.begin_redelegate("alice", "v1", "v1", POWER_REDUCTION)
 
+    def test_cancel_unbonding_guards(self):
+        """sdk CancelUnbondingDelegation guards: jailed validators refuse
+        re-bonds (ErrValidatorJailed), and a matured entry is no longer
+        cancellable even before the end blocker releases it."""
+        sk, bank = _keeper()
+        sk.delegate(bank, "alice", "v1", 5 * POWER_REDUCTION)
+        completion = sk.undelegate(
+            bank, "alice", "v1", 2 * POWER_REDUCTION, time_ns=1000, height=7
+        )
+        sk.jail("v1")
+        with pytest.raises(StakingError, match="jailed"):
+            sk.cancel_unbonding(
+                bank, "alice", "v1", POWER_REDUCTION, 7, time_ns=2000
+            )
+        sk.unjail("v1")
+        with pytest.raises(StakingError, match="no longer pending"):
+            sk.cancel_unbonding(
+                bank, "alice", "v1", POWER_REDUCTION, 7, time_ns=completion
+            )
+        # Still pending + unjailed: the cancel goes through.
+        sk.cancel_unbonding(
+            bank, "alice", "v1", POWER_REDUCTION, 7, time_ns=2000
+        )
+        assert sk.delegation("alice", "v1") == 4 * POWER_REDUCTION
+        assert bank.balance(NOT_BONDED_POOL) == POWER_REDUCTION
+
     def test_direct_power_reset_refused_once_delegated(self):
         """set_validator must not erase delegated-token backing (the
         invariant guard from review)."""
@@ -172,6 +198,58 @@ class TestStakingOverTheWire:
         # alice: -3 TIA delegated, +1 TIA released, -2 fees.
         assert bank.balance(addr) == bal0 - 2 * POWER_REDUCTION - 2 * 20_000
         assert bank.balance(NOT_BONDED_POOL) == 0
+
+    def test_cancel_unbonding_rebonds_before_completion(self):
+        """MsgCancelUnbondingDelegation (sdk v0.46 x/staking): re-bond
+        tokens from a pending unbonding entry, addressed by creation
+        height; a wrong height or an over-amount is rejected, and the
+        remaining entry still pays out at completion."""
+        from celestia_app_tpu.tx.messages import MsgCancelUnbondingDelegation
+
+        node = self._chain()
+        key = node.keys[0]
+        addr = key.public_key().address()
+        sk = StakingKeeper(node.app.cms.working)
+        val = sk.validators()[0].address
+
+        self._submit(node, key, MsgDelegate(addr, val, Coin("utia", 3 * POWER_REDUCTION)))
+        res = self._submit(node, key, MsgUndelegate(addr, val, Coin("utia", 2 * POWER_REDUCTION)))
+        assert res.code == 0, res.log
+        unbond_height = node.app.height
+        assert StakingKeeper(node.app.cms.working).get_power(val) == 101
+
+        # Wrong creation height: no entry there -> tx fails.
+        res = self._submit(node, key, MsgCancelUnbondingDelegation(
+            addr, val, Coin("utia", POWER_REDUCTION), unbond_height + 5
+        ))
+        assert res.code != 0 and "no unbonding entry" in res.log
+
+        # Over-cancel: entry holds 2 TIA.
+        res = self._submit(node, key, MsgCancelUnbondingDelegation(
+            addr, val, Coin("utia", 3 * POWER_REDUCTION), unbond_height
+        ))
+        assert res.code != 0 and "exceeds" in res.log
+
+        # Cancel 1 of the 2 unbonding TIA: power returns immediately.
+        res = self._submit(node, key, MsgCancelUnbondingDelegation(
+            addr, val, Coin("utia", POWER_REDUCTION), unbond_height
+        ))
+        assert res.code == 0, res.log
+        assert StakingKeeper(node.app.cms.working).get_power(val) == 102
+        bank = BankKeeper(node.app.cms.working)
+        bal_before_completion = bank.balance(addr)
+
+        # The remaining 1 TIA still matures and pays out.
+        node.produce_block(
+            time_ns=node.app.last_block_time_ns + UNBONDING_TIME_NS + 1
+        )
+        bank = BankKeeper(node.app.cms.working)
+        assert bank.balance(addr) == bal_before_completion + POWER_REDUCTION
+        assert bank.balance(NOT_BONDED_POOL) == 0
+        # And the cancelled TIA is delegated stake again, not liquid.
+        assert StakingKeeper(node.app.cms.working).delegation(addr, val) == (
+            2 * POWER_REDUCTION
+        )
 
     def test_redelegate_shifts_blobstream_valset(self):
         """A big redelegation ripples into a new blobstream valset

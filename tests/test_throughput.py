@@ -91,7 +91,9 @@ def test_sustained_90pct_fill_gov_square_256():
     node = TestNode(keys=keys, app=app)
     res = run_throughput(node, blocks=5, blob_size=500_000, target_fill=0.9)
     assert res.sustained(0.9), (res.fills, res.mean_fill)
-    if jax.default_backend() == "tpu":
+    # Device platform, not jax.default_backend(): the axon TPU plugin
+    # registers under its own backend name while devices report "tpu".
+    if jax.devices()[0].platform == "tpu":
         assert res.mean_block_seconds < 15.0, res
     print(
         f"\nthroughput k=256 x5 blocks: mean_fill={res.mean_fill:.3f} "
