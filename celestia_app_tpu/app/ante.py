@@ -59,6 +59,7 @@ from celestia_app_tpu.tx.messages import (
     MsgEditValidator,
     MsgFundCommunityPool,
     MsgGrantAllowance,
+    MsgMultiSend,
     MsgRevokeAllowance,
     MsgPayForBlobs,
     MsgRecvPacket,
@@ -90,7 +91,8 @@ class AnteError(ValueError):
 # exist in every version, as x/gov and ibc are wired for v1 and v2 in
 # app/modules.go:96-189).
 _V1_MSGS = {
-    MsgSend, MsgPayForBlobs, MsgSubmitProposal, MsgVote, MsgVoteWeighted, MsgDeposit,
+    MsgSend, MsgMultiSend, MsgPayForBlobs, MsgSubmitProposal, MsgVote,
+    MsgVoteWeighted, MsgDeposit,
     MsgTransfer, MsgRecvPacket, MsgAcknowledgement, MsgTimeout,
     MsgDelegate, MsgUndelegate, MsgBeginRedelegate,
     MsgCancelUnbondingDelegation,

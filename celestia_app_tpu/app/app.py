@@ -51,6 +51,7 @@ from celestia_app_tpu.tx.messages import (
     MsgAuthzRevoke,
     MsgBeginRedelegate,
     MsgCancelUnbondingDelegation,
+    MsgMultiSend,
     MsgCreateValidator,
     MsgDelegate,
     MsgDeposit,
@@ -596,6 +597,18 @@ class App:
             # address — a multisig, say — must exist before it can sign.
             ctx.auth.get_or_create(msg.to_address)
             return 0, [("transfer", msg.from_address, msg.to_address, total)]
+        if isinstance(msg, MsgMultiSend):
+            # Single input (enforced by ValidateBasic, see tx/messages.py),
+            # fanned out to every output; recipients are created on first
+            # receive like the MsgSend path.
+            src = msg.inputs[0].address
+            events = []
+            for out in msg.outputs:
+                total = sum(c.amount for c in out.coins if c.denom == "utia")
+                ctx.send_spendable(src, out.address, total)
+                ctx.auth.get_or_create(out.address)
+                events.append(("transfer", src, out.address, total))
+            return 0, events
         if isinstance(msg, MsgAuthzExec):
             return self._handle_authz_exec(ctx, msg, gas_remaining)
         if isinstance(msg, (MsgAuthzGrant, MsgAuthzRevoke)):

@@ -23,6 +23,7 @@ from celestia_app_tpu.encoding.proto import (
 
 URL_MSG_PAY_FOR_BLOBS = "/celestia.blob.v1.MsgPayForBlobs"
 URL_MSG_SEND = "/cosmos.bank.v1beta1.MsgSend"
+URL_MSG_MULTI_SEND = "/cosmos.bank.v1beta1.MsgMultiSend"
 URL_MSG_SIGNAL_VERSION = "/celestia.signal.v1.MsgSignalVersion"
 URL_MSG_TRY_UPGRADE = "/celestia.signal.v1.MsgTryUpgrade"
 URL_MSG_SUBMIT_PROPOSAL = "/cosmos.gov.v1beta1.MsgSubmitProposal"
@@ -220,6 +221,100 @@ class MsgSend:
         for c in self.amount:
             if c.amount <= 0:
                 raise ValueError(f"send amount must be positive, got {c.amount}")
+
+
+@dataclass(frozen=True)
+class BankIO:
+    """cosmos.bank.v1beta1 Input / Output {address=1, coins=2 repeated}."""
+
+    address: str
+    coins: tuple[Coin, ...]
+
+    def marshal(self) -> bytes:
+        out = encode_bytes_field(1, self.address.encode())
+        for c in self.coins:
+            out += encode_bytes_field(2, c.marshal())
+        return out
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "BankIO":
+        addr = ""
+        coins: list[Coin] = []
+        for num, wt, val in decode_fields(raw):
+            if num == 1 and wt == WIRE_LEN:
+                addr = val.decode()
+            elif num == 2 and wt == WIRE_LEN:
+                coins.append(Coin.unmarshal(val))
+        return cls(addr, tuple(coins))
+
+
+@dataclass(frozen=True)
+class MsgMultiSend:
+    """cosmos.bank.v1beta1.MsgMultiSend {inputs=1, outputs=2}.
+
+    Deviation from sdk v0.46, aligned with v0.47+: exactly ONE input.
+    Multi-input MultiSends require a signature from every input address,
+    and this chain's ante admits one signer per tx (PARITY §ante row 11)
+    — accepting unsigned inputs would let one signer move other
+    accounts' funds, so the single-input rule is enforced statelessly."""
+
+    inputs: tuple[BankIO, ...]
+    outputs: tuple[BankIO, ...]
+
+    TYPE_URL = URL_MSG_MULTI_SEND
+
+    def marshal(self) -> bytes:
+        out = b""
+        for i in self.inputs:
+            out += encode_bytes_field(1, i.marshal())
+        for o in self.outputs:
+            out += encode_bytes_field(2, o.marshal())
+        return out
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgMultiSend":
+        ins: list[BankIO] = []
+        outs: list[BankIO] = []
+        for num, wt, val in decode_fields(raw):
+            if num == 1 and wt == WIRE_LEN:
+                ins.append(BankIO.unmarshal(val))
+            elif num == 2 and wt == WIRE_LEN:
+                outs.append(BankIO.unmarshal(val))
+        return cls(tuple(ins), tuple(outs))
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+    @property
+    def signer(self) -> str:
+        return self.inputs[0].address if self.inputs else ""
+
+    def validate_basic(self) -> None:
+        """sdk bank MsgMultiSend.ValidateBasic + the single-input rule:
+        no inputs/outputs -> ErrNoInputs/ErrNoOutputs; per-denom sums
+        must match (ErrInputOutputMismatch); coins positive."""
+        from celestia_app_tpu.crypto.keys import validate_address
+
+        if not self.inputs:
+            raise ValueError("no inputs to send transaction")
+        if len(self.inputs) != 1:
+            raise ValueError("multiple senders not allowed")
+        if not self.outputs:
+            raise ValueError("no outputs to send transaction")
+        sums: dict[str, int] = {}
+        for io, sign in ((self.inputs, 1), (self.outputs, -1)):
+            for entry in io:
+                validate_address(entry.address)
+                if not entry.coins:
+                    raise ValueError("empty coins in multi-send entry")
+                for c in entry.coins:
+                    if c.amount <= 0:
+                        raise ValueError(
+                            f"send amount must be positive, got {c.amount}"
+                        )
+                    sums[c.denom] = sums.get(c.denom, 0) + sign * c.amount
+        if any(v != 0 for v in sums.values()):
+            raise ValueError("sum inputs != sum outputs")
 
 
 @dataclass(frozen=True)
@@ -842,11 +937,12 @@ class MsgCancelUnbondingDelegation:
 
     @classmethod
     def unmarshal(cls, raw: bytes) -> "MsgCancelUnbondingDelegation":
-        f = {num: val for num, wt, val in decode_fields(raw) if wt == WIRE_LEN}
-        ints = {num: val for num, wt, val in decode_fields(raw) if wt == WIRE_VARINT}
+        f = {(num, wt): val for num, wt, val in decode_fields(raw)}
         return cls(
-            f.get(1, b"").decode(), f.get(2, b"").decode(),
-            Coin.unmarshal(f.get(3, b"")), ints.get(4, 0),
+            f.get((1, WIRE_LEN), b"").decode(),
+            f.get((2, WIRE_LEN), b"").decode(),
+            Coin.unmarshal(f.get((3, WIRE_LEN), b"")),
+            f.get((4, WIRE_VARINT), 0),
         )
 
     def to_any(self) -> Any:
@@ -1442,6 +1538,7 @@ MSG_DECODERS = {
     URL_MSG_CANCEL_UNBONDING: MsgCancelUnbondingDelegation.unmarshal,
     URL_MSG_PAY_FOR_BLOBS: MsgPayForBlobs.unmarshal,
     URL_MSG_SEND: MsgSend.unmarshal,
+    URL_MSG_MULTI_SEND: MsgMultiSend.unmarshal,
     URL_MSG_SIGNAL_VERSION: MsgSignalVersion.unmarshal,
     URL_MSG_TRY_UPGRADE: MsgTryUpgrade.unmarshal,
     URL_MSG_SUBMIT_PROPOSAL: MsgSubmitProposal.unmarshal,

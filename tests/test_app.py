@@ -205,3 +205,69 @@ class TestFilterTxs:
         bad_sig = good[:-10] + rand_bytes(10)
         data = node.app.prepare_proposal([bad_sig, good, rand_bytes(80)])
         assert data.txs == (good,)
+
+
+class TestMultiSend:
+    """MsgMultiSend (sdk bank): single input fanned to many outputs in one
+    tx; sum mismatches and multi-input msgs reject statelessly (the
+    single-input rule — this chain's ante admits one signer per tx)."""
+
+    def _submit(self, node, key, msg, seq):
+        addr = key.public_key().address()
+        acct = _account(node, addr)
+        raw = build_and_sign(
+            [msg], key, node.chain_id, acct.account_number, seq,
+            Fee((Coin("utia", 20_000),), 200_000),
+        )
+        return node.broadcast(raw), raw
+
+    def test_multisend_fans_out_one_block(self, node):
+        from celestia_app_tpu.state.accounts import BankKeeper
+        from celestia_app_tpu.tx.messages import BankIO, MsgMultiSend
+
+        key = node.keys[0]
+        src = key.public_key().address()
+        a = node.keys[1].public_key().address()
+        b = PrivateKey.from_seed(b"fresh-multisend").public_key().address()
+        msg = MsgMultiSend(
+            inputs=(BankIO(src, (Coin("utia", 1_000),)),),
+            outputs=(
+                BankIO(a, (Coin("utia", 700),)),
+                BankIO(b, (Coin("utia", 300),)),
+            ),
+        )
+        bank0 = BankKeeper(node.app.cms.working)
+        bal_a = bank0.balance(a)
+        res, _ = self._submit(node, key, msg, seq=0)
+        assert res.code == 0, res.log
+        node.produce_block()
+        bank = BankKeeper(node.app.cms.working)
+        assert bank.balance(a) == bal_a + 700
+        assert bank.balance(b) == 300
+        # The fresh recipient exists as an account (create-on-receive).
+        from celestia_app_tpu.state.accounts import AuthKeeper
+
+        assert AuthKeeper(node.app.cms.working).get_account(b) is not None
+
+    def test_multisend_rejections(self, node):
+        from celestia_app_tpu.tx.messages import BankIO, MsgMultiSend
+
+        key = node.keys[0]
+        src = key.public_key().address()
+        to = node.keys[1].public_key().address()
+        mismatch = MsgMultiSend(
+            inputs=(BankIO(src, (Coin("utia", 10),)),),
+            outputs=(BankIO(to, (Coin("utia", 9),)),),
+        )
+        res, _ = self._submit(node, key, mismatch, seq=0)
+        assert res.code != 0 and "sum inputs" in res.log
+
+        two_senders = MsgMultiSend(
+            inputs=(
+                BankIO(src, (Coin("utia", 5),)),
+                BankIO(to, (Coin("utia", 5),)),
+            ),
+            outputs=(BankIO(to, (Coin("utia", 10),)),),
+        )
+        res, _ = self._submit(node, key, two_senders, seq=0)
+        assert res.code != 0 and "multiple senders" in res.log

@@ -124,6 +124,35 @@ class TestTxEnvelopeWire:
         )
         return key, msg, body, auth
 
+    def test_multisend_wire(self, pb):
+        from celestia_app_tpu.tx.messages import BankIO, Coin, MsgMultiSend
+
+        ours = MsgMultiSend(
+            inputs=(BankIO("celestia1from", (Coin("utia", 10),)),),
+            outputs=(
+                BankIO("celestia1a", (Coin("utia", 7),)),
+                BankIO("celestia1b", (Coin("utia", 3),)),
+            ),
+        )
+        ref = pb["bank"].MsgMultiSend(
+            inputs=[pb["bank"].Input(
+                address="celestia1from",
+                coins=[pb["coin"].Coin(denom="utia", amount="10")],
+            )],
+            outputs=[
+                pb["bank"].Output(
+                    address="celestia1a",
+                    coins=[pb["coin"].Coin(denom="utia", amount="7")],
+                ),
+                pb["bank"].Output(
+                    address="celestia1b",
+                    coins=[pb["coin"].Coin(denom="utia", amount="3")],
+                ),
+            ],
+        )
+        assert ours.marshal() == ref.SerializeToString()
+        assert MsgMultiSend.unmarshal(ref.SerializeToString()) == ours
+
     def test_body_and_auth_info(self, pb):
         from google.protobuf import any_pb2
 
