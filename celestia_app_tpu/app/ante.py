@@ -58,6 +58,7 @@ from celestia_app_tpu.tx.messages import (
     MsgDeposit,
     MsgEditValidator,
     MsgFundCommunityPool,
+    MsgCreateVestingAccount,
     MsgGrantAllowance,
     MsgMultiSend,
     MsgRevokeAllowance,
@@ -101,6 +102,7 @@ _V1_MSGS = {
     MsgSetWithdrawAddress, MsgFundCommunityPool, MsgUnjail,
     MsgGrantAllowance, MsgRevokeAllowance,
     MsgAuthzGrant, MsgAuthzExec, MsgAuthzRevoke,
+    MsgCreateVestingAccount,
 }
 _V2_MSGS = _V1_MSGS | {MsgSignalVersion, MsgTryUpgrade}
 

@@ -51,6 +51,7 @@ from celestia_app_tpu.tx.messages import (
     MsgAuthzRevoke,
     MsgBeginRedelegate,
     MsgCancelUnbondingDelegation,
+    MsgCreateVestingAccount,
     MsgMultiSend,
     MsgCreateValidator,
     MsgDelegate,
@@ -597,6 +598,33 @@ class App:
             # address — a multisig, say — must exist before it can sign.
             ctx.auth.get_or_create(msg.to_address)
             return 0, [("transfer", msg.from_address, msg.to_address, total)]
+        if isinstance(msg, MsgCreateVestingAccount):
+            from celestia_app_tpu.state.accounts import (
+                VESTING_CONTINUOUS,
+                VESTING_DELAYED,
+            )
+
+            if ctx.auth.get_account(msg.to_address) is not None:
+                # sdk vesting msg server: the target must be brand new.
+                raise ValueError(f"account {msg.to_address} already exists")
+            total = sum(c.amount for c in msg.amount if c.denom == "utia")
+            end_ns = msg.end_time * 10**9
+            acc = ctx.auth.get_or_create(msg.to_address)
+            acc.vesting_type = (
+                VESTING_DELAYED if msg.delayed else VESTING_CONTINUOUS
+            )
+            acc.original_vesting = total
+            # Continuous vesting starts at the block time (sdk
+            # NewContinuousVestingAccount with ctx.BlockTime); delayed
+            # ignores the start.
+            acc.vesting_start_ns = ctx.time_ns
+            acc.vesting_end_ns = end_ns
+            ctx.auth.set_account(acc)
+            ctx.send_spendable(msg.from_address, msg.to_address, total)
+            return 0, [(
+                "cosmos.vesting.v1beta1.EventCreateVestingAccount",
+                msg.to_address, total, msg.end_time,
+            )]
         if isinstance(msg, MsgMultiSend):
             # Single input (enforced by ValidateBasic, see tx/messages.py),
             # fanned out to every output; recipients are created on first

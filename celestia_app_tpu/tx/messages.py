@@ -24,6 +24,7 @@ from celestia_app_tpu.encoding.proto import (
 URL_MSG_PAY_FOR_BLOBS = "/celestia.blob.v1.MsgPayForBlobs"
 URL_MSG_SEND = "/cosmos.bank.v1beta1.MsgSend"
 URL_MSG_MULTI_SEND = "/cosmos.bank.v1beta1.MsgMultiSend"
+URL_MSG_CREATE_VESTING_ACCOUNT = "/cosmos.vesting.v1beta1.MsgCreateVestingAccount"
 URL_MSG_SIGNAL_VERSION = "/celestia.signal.v1.MsgSignalVersion"
 URL_MSG_TRY_UPGRADE = "/celestia.signal.v1.MsgTryUpgrade"
 URL_MSG_SUBMIT_PROPOSAL = "/cosmos.gov.v1beta1.MsgSubmitProposal"
@@ -315,6 +316,79 @@ class MsgMultiSend:
                     sums[c.denom] = sums.get(c.denom, 0) + sign * c.amount
         if any(v != 0 for v in sums.values()):
             raise ValueError("sum inputs != sum outputs")
+
+
+@dataclass(frozen=True)
+class MsgCreateVestingAccount:
+    """cosmos.vesting.v1beta1.MsgCreateVestingAccount {from_address=1,
+    to_address=2, amount=3 repeated Coin, end_time=4 int64 unix SECONDS,
+    delayed=5 bool}: fund a brand-new vesting account.  delayed=false ->
+    ContinuousVestingAccount starting at the block time; delayed=true ->
+    DelayedVestingAccount (everything releases at end_time)."""
+
+    from_address: str
+    to_address: str
+    amount: tuple[Coin, ...]
+    end_time: int
+    delayed: bool = False
+
+    TYPE_URL = URL_MSG_CREATE_VESTING_ACCOUNT
+
+    def marshal(self) -> bytes:
+        out = encode_bytes_field(1, self.from_address.encode())
+        out += encode_bytes_field(2, self.to_address.encode())
+        for c in self.amount:
+            out += encode_bytes_field(3, c.marshal())
+        if self.end_time:
+            # int64: negatives ride as 10-byte two's-complement varints.
+            out += encode_varint_field(4, self.end_time & ((1 << 64) - 1))
+        if self.delayed:
+            out += encode_varint_field(5, 1)
+        return out
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgCreateVestingAccount":
+        f, t = "", ""
+        coins: list[Coin] = []
+        ints: dict[int, int] = {}
+        for num, wt, val in decode_fields(raw):
+            if num == 1 and wt == WIRE_LEN:
+                f = val.decode()
+            elif num == 2 and wt == WIRE_LEN:
+                t = val.decode()
+            elif num == 3 and wt == WIRE_LEN:
+                coins.append(Coin.unmarshal(val))
+            elif wt == WIRE_VARINT:
+                ints[num] = val
+        from celestia_app_tpu.encoding.proto import sint64
+
+        return cls(
+            f, t, tuple(coins), sint64(ints.get(4, 0)), bool(ints.get(5, 0))
+        )
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+    @property
+    def signer(self) -> str:
+        return self.from_address
+
+    def validate_basic(self) -> None:
+        """sdk vesting MsgCreateVestingAccount.ValidateBasic: valid
+        addresses, positive coins, end_time > 0."""
+        from celestia_app_tpu.crypto.keys import validate_address
+
+        validate_address(self.from_address)
+        validate_address(self.to_address)
+        if not self.amount:
+            raise ValueError("vesting amount must not be empty")
+        for c in self.amount:
+            if c.amount <= 0:
+                raise ValueError(
+                    f"vesting amount must be positive, got {c.amount}"
+                )
+        if self.end_time <= 0:
+            raise ValueError("invalid end time")
 
 
 @dataclass(frozen=True)
@@ -932,17 +1006,20 @@ class MsgCancelUnbondingDelegation:
         out += encode_bytes_field(2, self.validator_address.encode())
         out += encode_bytes_field(3, self.amount.marshal())
         if self.creation_height:
-            out += encode_varint_field(4, self.creation_height)
+            # int64: negatives ride as 10-byte two's-complement varints.
+            out += encode_varint_field(4, self.creation_height & ((1 << 64) - 1))
         return out
 
     @classmethod
     def unmarshal(cls, raw: bytes) -> "MsgCancelUnbondingDelegation":
+        from celestia_app_tpu.encoding.proto import sint64
+
         f = {(num, wt): val for num, wt, val in decode_fields(raw)}
         return cls(
             f.get((1, WIRE_LEN), b"").decode(),
             f.get((2, WIRE_LEN), b"").decode(),
             Coin.unmarshal(f.get((3, WIRE_LEN), b"")),
-            f.get((4, WIRE_VARINT), 0),
+            sint64(f.get((4, WIRE_VARINT), 0)),
         )
 
     def to_any(self) -> Any:
@@ -1539,6 +1616,7 @@ MSG_DECODERS = {
     URL_MSG_PAY_FOR_BLOBS: MsgPayForBlobs.unmarshal,
     URL_MSG_SEND: MsgSend.unmarshal,
     URL_MSG_MULTI_SEND: MsgMultiSend.unmarshal,
+    URL_MSG_CREATE_VESTING_ACCOUNT: MsgCreateVestingAccount.unmarshal,
     URL_MSG_SIGNAL_VERSION: MsgSignalVersion.unmarshal,
     URL_MSG_TRY_UPGRADE: MsgTryUpgrade.unmarshal,
     URL_MSG_SUBMIT_PROPOSAL: MsgSubmitProposal.unmarshal,

@@ -153,6 +153,60 @@ class TestTxEnvelopeWire:
         assert ours.marshal() == ref.SerializeToString()
         assert MsgMultiSend.unmarshal(ref.SerializeToString()) == ours
 
+    def test_create_vesting_account_wire(self, pb):
+        import importlib
+
+        from celestia_app_tpu.tx.messages import Coin, MsgCreateVestingAccount
+
+        vesting = importlib.import_module("cosmos.vesting.v1beta1.tx_pb2")
+        ours = MsgCreateVestingAccount(
+            "celestia1from", "celestia1new", (Coin("utia", 123),),
+            1_700_000_999, delayed=True,
+        )
+        ref = vesting.MsgCreateVestingAccount(
+            from_address="celestia1from", to_address="celestia1new",
+            amount=[pb["coin"].Coin(denom="utia", amount="123")],
+            end_time=1_700_000_999, delayed=True,
+        )
+        assert ours.marshal() == ref.SerializeToString()
+        assert MsgCreateVestingAccount.unmarshal(ref.SerializeToString()) == ours
+        # delayed=False omits field 5 exactly as proto3 does.
+        ours2 = MsgCreateVestingAccount(
+            "celestia1from", "celestia1new", (Coin("utia", 1),), 7
+        )
+        ref2 = vesting.MsgCreateVestingAccount(
+            from_address="celestia1from", to_address="celestia1new",
+            amount=[pb["coin"].Coin(denom="utia", amount="1")], end_time=7,
+        )
+        assert ours2.marshal() == ref2.SerializeToString()
+        # int64 wire parity for NEGATIVE values: the sdk rejects
+        # end_time=-1 in ValidateBasic; an unsigned decode would turn it
+        # into ~2^64 and dodge that check, freezing funds forever.
+        neg = vesting.MsgCreateVestingAccount(
+            from_address="celestia1from", to_address="celestia1new",
+            amount=[pb["coin"].Coin(denom="utia", amount="1")], end_time=-1,
+        )
+        parsed = MsgCreateVestingAccount.unmarshal(neg.SerializeToString())
+        assert parsed.end_time == -1
+        assert parsed.marshal() == neg.SerializeToString()
+        from celestia_app_tpu.crypto.keys import PrivateKey
+        from dataclasses import replace as _dc_replace
+
+        real = PrivateKey.from_seed(b"wire-neg").public_key().address()
+        with pytest.raises(ValueError, match="invalid end time"):
+            _dc_replace(parsed, from_address=real, to_address=real).validate_basic()
+
+        staking = importlib.import_module("cosmos.staking.v1beta1.tx_pb2")
+        from celestia_app_tpu.tx.messages import MsgCancelUnbondingDelegation
+
+        neg_c = staking.MsgCancelUnbondingDelegation(
+            delegator_address="celestia1d", validator_address="celestiavaloper1v",
+            amount=pb["coin"].Coin(denom="utia", amount="1"), creation_height=-5,
+        )
+        parsed_c = MsgCancelUnbondingDelegation.unmarshal(neg_c.SerializeToString())
+        assert parsed_c.creation_height == -5
+        assert parsed_c.marshal() == neg_c.SerializeToString()
+
     def test_body_and_auth_info(self, pb):
         from google.protobuf import any_pb2
 
