@@ -61,6 +61,7 @@ from celestia_app_tpu.tx.messages import (
     MsgCreateVestingAccount,
     MsgGrantAllowance,
     MsgMultiSend,
+    MsgVerifyInvariant,
     MsgRevokeAllowance,
     MsgPayForBlobs,
     MsgRecvPacket,
@@ -102,7 +103,7 @@ _V1_MSGS = {
     MsgSetWithdrawAddress, MsgFundCommunityPool, MsgUnjail,
     MsgGrantAllowance, MsgRevokeAllowance,
     MsgAuthzGrant, MsgAuthzExec, MsgAuthzRevoke,
-    MsgCreateVestingAccount,
+    MsgCreateVestingAccount, MsgVerifyInvariant,
 }
 _V2_MSGS = _V1_MSGS | {MsgSignalVersion, MsgTryUpgrade}
 

@@ -53,6 +53,7 @@ from celestia_app_tpu.tx.messages import (
     MsgCancelUnbondingDelegation,
     MsgCreateVestingAccount,
     MsgMultiSend,
+    MsgVerifyInvariant,
     MsgCreateValidator,
     MsgDelegate,
     MsgDeposit,
@@ -577,6 +578,13 @@ class App:
                 gas_used=meter.consumed,
             )
         except Exception as e:
+            from celestia_app_tpu.modules.crisis import InvariantBroken
+
+            if isinstance(e, InvariantBroken):
+                # x/crisis: a broken invariant HALTS the chain (the sdk
+                # panics in the crisis msg server) — converting it into a
+                # failed tx would let a corrupted state keep committing.
+                raise
             block_ctx.store.write_back(tx_ctx.store)  # ante effects persist
             return TxResult(
                 code=2, log=str(e), gas_wanted=ante_res.gas_wanted,
@@ -598,6 +606,31 @@ class App:
             # address — a multisig, say — must exist before it can sign.
             ctx.auth.get_or_create(msg.to_address)
             return 0, [("transfer", msg.from_address, msg.to_address, total)]
+        if isinstance(msg, MsgVerifyInvariant):
+            from celestia_app_tpu.modules.crisis import INVARIANTS
+
+            name = f"{msg.invariant_module_name}/{msg.invariant_route}"
+            check = next((c for n, c in INVARIANTS if n == name), None)
+            if check is None:
+                raise ValueError(f"unknown invariant {name}")
+            # ConstantFee: 1000utia to the fee collector (reference
+            # default_overrides.go:120) — on-chain invariant checks are
+            # priced so they cannot be spammed for free.
+            ctx.send_spendable(msg.sender, FEE_COLLECTOR, 1000)
+            # On an UNMETERED branch: the sdk runs AssertInvariants under
+            # an infinite gas meter (a full-state audit must not die on
+            # the tx's gas limit), and some checks settle intermediate
+            # state that must not leak into consensus state.  A broken
+            # invariant raises InvariantBroken, which deliver()
+            # deliberately does NOT convert to a tx error — the chain
+            # halts (sdk panic).
+            store = (
+                ctx.store.unwrap() if hasattr(ctx.store, "unwrap") else ctx.store
+            )
+            check(store.branch())
+            return 0, [(
+                "cosmos.crisis.v1beta1.EventInvariantChecked", name,
+            )]
         if isinstance(msg, MsgCreateVestingAccount):
             from celestia_app_tpu.state.accounts import (
                 VESTING_CONTINUOUS,

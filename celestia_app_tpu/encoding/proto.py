@@ -71,11 +71,12 @@ def encode_packed_uint32_field(field_number: int, values: list[int]) -> bytes:
     return encode_bytes_field(field_number, payload)
 
 
-def sint64(v: int) -> int:
+def int64_from_uvarint(v: int) -> int:
     """Interpret an unsigned varint as a proto int64 (two's complement):
     values >= 2^63 are negative.  Decoders for int64 fields must apply
     this, or a negative wire value (10-byte varint) silently becomes a
-    huge positive and dodges < 0 / <= 0 validation."""
+    huge positive and dodges < 0 / <= 0 validation.  NOT for proto
+    `sint64` fields — those are zigzag-encoded."""
     return v - (1 << 64) if v >= (1 << 63) else v
 
 

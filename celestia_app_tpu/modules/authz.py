@@ -31,7 +31,6 @@ _GRANT_PREFIX = b"authz/"
 URL_GENERIC_AUTHORIZATION = "/cosmos.authz.v1beta1.GenericAuthorization"
 URL_SEND_AUTHORIZATION = "/cosmos.bank.v1beta1.SendAuthorization"
 URL_MSG_SEND = "/cosmos.bank.v1beta1.MsgSend"
-URL_MSG_MULTI_SEND = "/cosmos.bank.v1beta1.MsgMultiSend"
 
 
 class AuthzError(ValueError):
@@ -110,17 +109,15 @@ class AuthzKeeper:
         if g.expiration_ns and time_ns >= g.expiration_ns:
             self.store.delete(self._key(granter, grantee, url))
             raise AuthzError("authorization expired")
-        if g.spend_limit and url in (URL_MSG_SEND, URL_MSG_MULTI_SEND):
-            if url == URL_MSG_SEND:
-                total = sum(c.amount for c in msg.amount if c.denom == "utia")
-            else:
-                # MultiSend spends its (single) input's total.
-                total = sum(
-                    c.amount
-                    for inp in msg.inputs
-                    for c in inp.coins
-                    if c.denom == "utia"
-                )
+        # SendAuthorization (spend_limit) covers MsgSend ONLY, as in the
+        # sdk: its Accept() rejects every other msg type, and the wire
+        # shape carries no msg-type field.  A MsgMultiSend under authz
+        # needs a GenericAuthorization of the MultiSend URL — unlimited,
+        # exactly the sdk's semantics (MsgAuthzGrant.validate_basic
+        # refuses spend_limit on non-MsgSend grants, so a limited
+        # MultiSend grant cannot exist on the wire).
+        if g.spend_limit and url == URL_MSG_SEND:
+            total = sum(c.amount for c in msg.amount if c.denom == "utia")
             if total > g.spend_limit:
                 raise AuthzError(
                     f"send of {total} exceeds authorization limit {g.spend_limit}"

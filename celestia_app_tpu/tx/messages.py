@@ -25,6 +25,7 @@ URL_MSG_PAY_FOR_BLOBS = "/celestia.blob.v1.MsgPayForBlobs"
 URL_MSG_SEND = "/cosmos.bank.v1beta1.MsgSend"
 URL_MSG_MULTI_SEND = "/cosmos.bank.v1beta1.MsgMultiSend"
 URL_MSG_CREATE_VESTING_ACCOUNT = "/cosmos.vesting.v1beta1.MsgCreateVestingAccount"
+URL_MSG_VERIFY_INVARIANT = "/cosmos.crisis.v1beta1.MsgVerifyInvariant"
 URL_MSG_SIGNAL_VERSION = "/celestia.signal.v1.MsgSignalVersion"
 URL_MSG_TRY_UPGRADE = "/celestia.signal.v1.MsgTryUpgrade"
 URL_MSG_SUBMIT_PROPOSAL = "/cosmos.gov.v1beta1.MsgSubmitProposal"
@@ -313,9 +314,57 @@ class MsgMultiSend:
                         raise ValueError(
                             f"send amount must be positive, got {c.amount}"
                         )
+                    if c.denom != "utia":
+                        # TIA-only chain: the handler moves utia; a
+                        # foreign-denom output would be silently dropped.
+                        raise ValueError(
+                            f"invalid send denom {c.denom!r}, expected utia"
+                        )
                     sums[c.denom] = sums.get(c.denom, 0) + sign * c.amount
         if any(v != 0 for v in sums.values()):
             raise ValueError("sum inputs != sum outputs")
+
+
+@dataclass(frozen=True)
+class MsgVerifyInvariant:
+    """cosmos.crisis.v1beta1.MsgVerifyInvariant {sender=1,
+    invariant_module_name=2, invariant_route=3}: run one registered
+    invariant on-chain.  A broken invariant HALTS the chain (the sdk
+    panics); a passing check just costs the ConstantFee."""
+
+    sender: str
+    invariant_module_name: str
+    invariant_route: str
+
+    TYPE_URL = URL_MSG_VERIFY_INVARIANT
+
+    def marshal(self) -> bytes:
+        out = encode_bytes_field(1, self.sender.encode())
+        out += encode_bytes_field(2, self.invariant_module_name.encode())
+        out += encode_bytes_field(3, self.invariant_route.encode())
+        return out
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgVerifyInvariant":
+        f = {num: val for num, wt, val in decode_fields(raw) if wt == WIRE_LEN}
+        return cls(
+            f.get(1, b"").decode(), f.get(2, b"").decode(),
+            f.get(3, b"").decode(),
+        )
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+    @property
+    def signer(self) -> str:
+        return self.sender
+
+    def validate_basic(self) -> None:
+        from celestia_app_tpu.crypto.keys import validate_address
+
+        validate_address(self.sender)
+        if not self.invariant_module_name or not self.invariant_route:
+            raise ValueError("invariant module and route must be set")
 
 
 @dataclass(frozen=True)
@@ -360,10 +409,10 @@ class MsgCreateVestingAccount:
                 coins.append(Coin.unmarshal(val))
             elif wt == WIRE_VARINT:
                 ints[num] = val
-        from celestia_app_tpu.encoding.proto import sint64
+        from celestia_app_tpu.encoding.proto import int64_from_uvarint
 
         return cls(
-            f, t, tuple(coins), sint64(ints.get(4, 0)), bool(ints.get(5, 0))
+            f, t, tuple(coins), int64_from_uvarint(ints.get(4, 0)), bool(ints.get(5, 0))
         )
 
     def to_any(self) -> Any:
@@ -386,6 +435,13 @@ class MsgCreateVestingAccount:
             if c.amount <= 0:
                 raise ValueError(
                     f"vesting amount must be positive, got {c.amount}"
+                )
+            if c.denom != "utia":
+                # TIA-only chain (tokenfilter): the handler vests utia;
+                # silently dropping a foreign denom would report code 0
+                # while locking nothing.
+                raise ValueError(
+                    f"invalid vesting denom {c.denom!r}, expected utia"
                 )
         if self.end_time <= 0:
             raise ValueError("invalid end time")
@@ -1012,14 +1068,14 @@ class MsgCancelUnbondingDelegation:
 
     @classmethod
     def unmarshal(cls, raw: bytes) -> "MsgCancelUnbondingDelegation":
-        from celestia_app_tpu.encoding.proto import sint64
+        from celestia_app_tpu.encoding.proto import int64_from_uvarint
 
         f = {(num, wt): val for num, wt, val in decode_fields(raw)}
         return cls(
             f.get((1, WIRE_LEN), b"").decode(),
             f.get((2, WIRE_LEN), b"").decode(),
             Coin.unmarshal(f.get((3, WIRE_LEN), b"")),
-            sint64(f.get((4, WIRE_VARINT), 0)),
+            int64_from_uvarint(f.get((4, WIRE_VARINT), 0)),
         )
 
     def to_any(self) -> Any:
@@ -1503,9 +1559,12 @@ class MsgAuthzGrant:
         if not self.msg_type_url:
             raise ValueError("authorization needs a msg type url")
         if self.spend_limit and self.msg_type_url != URL_MSG_SEND:
-            # spend_limit>0 encodes a SendAuthorization; combining it with
-            # another msg type would sign a different authority than this
-            # object declares.
+            # spend_limit>0 encodes a SendAuthorization, whose wire shape
+            # carries no msg-type field and whose sdk Accept() covers
+            # MsgSend ONLY — combining it with another msg type (incl.
+            # MsgMultiSend) would sign a different authority than this
+            # object declares and be wire-lossy.  MultiSend under authz
+            # is a GenericAuthorization (unlimited), as in the sdk.
             raise ValueError(
                 "spend_limit applies only to a MsgSend authorization"
             )
@@ -1617,6 +1676,7 @@ MSG_DECODERS = {
     URL_MSG_SEND: MsgSend.unmarshal,
     URL_MSG_MULTI_SEND: MsgMultiSend.unmarshal,
     URL_MSG_CREATE_VESTING_ACCOUNT: MsgCreateVestingAccount.unmarshal,
+    URL_MSG_VERIFY_INVARIANT: MsgVerifyInvariant.unmarshal,
     URL_MSG_SIGNAL_VERSION: MsgSignalVersion.unmarshal,
     URL_MSG_TRY_UPGRADE: MsgTryUpgrade.unmarshal,
     URL_MSG_SUBMIT_PROPOSAL: MsgSubmitProposal.unmarshal,

@@ -207,6 +207,20 @@ class TestTxEnvelopeWire:
         assert parsed_c.creation_height == -5
         assert parsed_c.marshal() == neg_c.SerializeToString()
 
+    def test_verify_invariant_wire(self, pb):
+        import importlib
+
+        from celestia_app_tpu.tx.messages import MsgVerifyInvariant
+
+        crisis = importlib.import_module("cosmos.crisis.v1beta1.tx_pb2")
+        ours = MsgVerifyInvariant("celestia1s", "bank", "total-supply")
+        ref = crisis.MsgVerifyInvariant(
+            sender="celestia1s", invariant_module_name="bank",
+            invariant_route="total-supply",
+        )
+        assert ours.marshal() == ref.SerializeToString()
+        assert MsgVerifyInvariant.unmarshal(ref.SerializeToString()) == ours
+
     def test_body_and_auth_info(self, pb):
         from google.protobuf import any_pb2
 
