@@ -459,7 +459,23 @@ def _run_child() -> None:
             la = loadavg()
         return la
 
-    for stage in _stage_plan():
+    plan = _stage_plan()
+    if platform == "cpu" and not (
+        os.environ.get("BENCH_K") or os.environ.get("BENCH_MODE")
+    ):
+        # CPU fallback (wedged tunnel / no accelerator): k=512 device rows
+        # take minutes per ITERATION on the 1-core host and would eat the
+        # whole budget before the informative small-k rows run.  Scale the
+        # default plan down; the emitted records carry platform="cpu" so
+        # the run is never mistaken for a chip measurement.
+        scaled = []
+        for s in plan:
+            t = dict(s, k=min(s["k"], 128))
+            if t not in scaled:
+                scaled.append(t)
+        plan = scaled
+        emit({"stage": "plan", "note": "cpu fallback: k capped at 128"})
+    for stage in plan:
         mode, k = stage["mode"], stage["k"]
         name = f"{mode}@{k}" + ("#2" if stage.get("rerun") else "")
         remaining = deadline - time.monotonic()
