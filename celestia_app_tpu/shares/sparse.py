@@ -55,26 +55,46 @@ def sparse_shares_needed(blob_len: int) -> int:
 
 
 def split_blob(blob: Blob) -> list[Share]:
-    """Split one blob into its share sequence."""
-    shares: list[Share] = []
+    """Split one blob into its share sequence.
+
+    Vectorized: all continuation shares are one numpy reshape over the
+    blob bytes instead of a per-share Python loop — share splitting is
+    the square builder's dominant HOST cost at big squares (measured
+    ~10s per k=512 block byte-by-byte, which alone would eat most of the
+    15 s block budget on a TPU where the device half takes ~0.4s)."""
+    import numpy as np
+
     data = blob.data
-    pos = 0
-    first = True
-    while first or pos < len(data):
-        buf = _build_prefix(
-            blob.namespace,
-            blob.share_version,
-            first,
-            len(data) if first else None,
-        )
-        room = SHARE_SIZE - len(buf)
-        chunk = data[pos : pos + room]
-        pos += len(chunk)
-        buf += chunk
-        buf += bytes(SHARE_SIZE - len(buf))
-        shares.append(Share(bytes(buf)))
-        first = False
-    return shares
+    n = len(data)
+    first_prefix = bytes(
+        _build_prefix(blob.namespace, blob.share_version, True, n)
+    )
+    first_room = SHARE_SIZE - len(first_prefix)
+    if n <= first_room:
+        buf = first_prefix + data
+        return [Share(buf + bytes(SHARE_SIZE - len(buf)))]
+
+    cont_prefix = bytes(
+        _build_prefix(blob.namespace, blob.share_version, False, None)
+    )
+    cont_room = SHARE_SIZE - len(cont_prefix)
+    rest = np.frombuffer(data, dtype=np.uint8)[first_room:]
+    n_cont = -(-rest.size // cont_room)
+    arr = np.zeros((1 + n_cont, SHARE_SIZE), dtype=np.uint8)
+    arr[0, : len(first_prefix)] = np.frombuffer(first_prefix, dtype=np.uint8)
+    arr[0, len(first_prefix):] = np.frombuffer(
+        data[:first_room], dtype=np.uint8
+    )
+    arr[1:, : len(cont_prefix)] = np.frombuffer(cont_prefix, dtype=np.uint8)
+    pad = (-rest.size) % cont_room
+    if pad:
+        rest = np.concatenate([rest, np.zeros(pad, dtype=np.uint8)])
+    arr[1:, len(cont_prefix):] = rest.reshape(n_cont, cont_room)
+    share_bytes = arr.tobytes()
+    return [
+        Share(share_bytes[i * SHARE_SIZE : (i + 1) * SHARE_SIZE])
+        for i in range(1 + n_cont)
+    ]
 
 
 class SparseShareSplitter:
