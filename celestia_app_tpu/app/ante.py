@@ -61,6 +61,7 @@ from celestia_app_tpu.tx.messages import (
     MsgCreateVestingAccount,
     MsgGrantAllowance,
     MsgMultiSend,
+    MsgSubmitEvidence,
     MsgVerifyInvariant,
     MsgRevokeAllowance,
     MsgPayForBlobs,
@@ -103,7 +104,7 @@ _V1_MSGS = {
     MsgSetWithdrawAddress, MsgFundCommunityPool, MsgUnjail,
     MsgGrantAllowance, MsgRevokeAllowance,
     MsgAuthzGrant, MsgAuthzExec, MsgAuthzRevoke,
-    MsgCreateVestingAccount, MsgVerifyInvariant,
+    MsgCreateVestingAccount, MsgVerifyInvariant, MsgSubmitEvidence,
 }
 _V2_MSGS = _V1_MSGS | {MsgSignalVersion, MsgTryUpgrade}
 

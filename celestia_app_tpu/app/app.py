@@ -53,6 +53,7 @@ from celestia_app_tpu.tx.messages import (
     MsgCancelUnbondingDelegation,
     MsgCreateVestingAccount,
     MsgMultiSend,
+    MsgSubmitEvidence,
     MsgVerifyInvariant,
     MsgCreateValidator,
     MsgDelegate,
@@ -606,6 +607,18 @@ class App:
             # address — a multisig, say — must exist before it can sign.
             ctx.auth.get_or_create(msg.to_address)
             return 0, [("transfer", msg.from_address, msg.to_address, total)]
+        if isinstance(msg, MsgSubmitEvidence):
+            # Reference behavior: the evidence keeper has NO router
+            # (app/app.go:348-353 never calls SetRouter), so tx-submitted
+            # evidence never succeeds — equivocation evidence arrives via
+            # ABCI ByzantineValidators, not txs.  Error text follows the
+            # sdk's registered ErrNoEvidenceHandlerExists ("unregistered
+            # handler for evidence type"); the reference's exact
+            # nil-router failure shape is unverifiable in-image.
+            raise ValueError(
+                "unregistered handler for evidence type: "
+                f"{msg.evidence.type_url}"
+            )
         if isinstance(msg, MsgVerifyInvariant):
             from celestia_app_tpu.modules.crisis import INVARIANTS
 

@@ -26,6 +26,7 @@ URL_MSG_SEND = "/cosmos.bank.v1beta1.MsgSend"
 URL_MSG_MULTI_SEND = "/cosmos.bank.v1beta1.MsgMultiSend"
 URL_MSG_CREATE_VESTING_ACCOUNT = "/cosmos.vesting.v1beta1.MsgCreateVestingAccount"
 URL_MSG_VERIFY_INVARIANT = "/cosmos.crisis.v1beta1.MsgVerifyInvariant"
+URL_MSG_SUBMIT_EVIDENCE = "/cosmos.evidence.v1beta1.MsgSubmitEvidence"
 URL_MSG_SIGNAL_VERSION = "/celestia.signal.v1.MsgSignalVersion"
 URL_MSG_TRY_UPGRADE = "/celestia.signal.v1.MsgTryUpgrade"
 URL_MSG_SUBMIT_PROPOSAL = "/cosmos.gov.v1beta1.MsgSubmitProposal"
@@ -323,6 +324,50 @@ class MsgMultiSend:
                     sums[c.denom] = sums.get(c.denom, 0) + sign * c.amount
         if any(v != 0 for v in sums.values()):
             raise ValueError("sum inputs != sum outputs")
+
+
+@dataclass(frozen=True)
+class MsgSubmitEvidence:
+    """cosmos.evidence.v1beta1.MsgSubmitEvidence {submitter=1,
+    evidence=2 Any}.
+
+    Reference behavior: the evidence keeper is wired WITHOUT a router
+    (/root/reference/app/app.go:348-353 — no SetRouter call), so a
+    tx-submitted evidence never succeeds; equivocation evidence reaches
+    the chain through the consensus plane (ABCI ByzantineValidators),
+    never through this tx.  This framework reproduces the outcome — the
+    msg decodes, validates, and always fails (with the sdk's registered
+    ErrNoEvidenceHandlerExists text; the reference's exact nil-router
+    failure shape is unverifiable in-image)."""
+
+    submitter: str
+    evidence: Any
+
+    TYPE_URL = URL_MSG_SUBMIT_EVIDENCE
+
+    def marshal(self) -> bytes:
+        out = encode_bytes_field(1, self.submitter.encode())
+        out += encode_bytes_field(2, self.evidence.marshal())
+        return out
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgSubmitEvidence":
+        f = {num: val for num, wt, val in decode_fields(raw) if wt == WIRE_LEN}
+        return cls(f.get(1, b"").decode(), Any.unmarshal(f.get(2, b"")))
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+    @property
+    def signer(self) -> str:
+        return self.submitter
+
+    def validate_basic(self) -> None:
+        from celestia_app_tpu.crypto.keys import validate_address
+
+        validate_address(self.submitter)
+        if not self.evidence.type_url:
+            raise ValueError("missing evidence")
 
 
 @dataclass(frozen=True)
@@ -1677,6 +1722,7 @@ MSG_DECODERS = {
     URL_MSG_MULTI_SEND: MsgMultiSend.unmarshal,
     URL_MSG_CREATE_VESTING_ACCOUNT: MsgCreateVestingAccount.unmarshal,
     URL_MSG_VERIFY_INVARIANT: MsgVerifyInvariant.unmarshal,
+    URL_MSG_SUBMIT_EVIDENCE: MsgSubmitEvidence.unmarshal,
     URL_MSG_SIGNAL_VERSION: MsgSignalVersion.unmarshal,
     URL_MSG_TRY_UPGRADE: MsgTryUpgrade.unmarshal,
     URL_MSG_SUBMIT_PROPOSAL: MsgSubmitProposal.unmarshal,

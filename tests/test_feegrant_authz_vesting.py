@@ -628,3 +628,23 @@ class TestVerifyInvariantMsg:
         assert node.broadcast(raw).code == 0
         with pytest.raises(InvariantBroken):
             node.produce_block()
+
+
+class TestSubmitEvidenceMsg:
+    def test_always_rejects_like_the_reference(self):
+        """Reference parity: the evidence keeper is wired without a
+        router (app/app.go:348-353), so MsgSubmitEvidence always fails
+        with ErrNoEvidenceHandlerExists — equivocation evidence arrives
+        via the consensus plane, never a tx."""
+        from celestia_app_tpu.tx.messages import Any as AnyMsg
+        from celestia_app_tpu.tx.messages import MsgSubmitEvidence
+
+        harness = TestThroughTheApp()
+        node, keys = harness._node()
+        s_addr = keys[0].public_key().address()
+        res = harness._submit(node, keys[0], [MsgSubmitEvidence(
+            s_addr,
+            AnyMsg("/cosmos.evidence.v1beta1.Equivocation", b"\x08\x07"),
+        )])
+        assert res.code != 0
+        assert "unregistered handler for evidence type" in res.log

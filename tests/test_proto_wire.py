@@ -207,6 +207,25 @@ class TestTxEnvelopeWire:
         assert parsed_c.creation_height == -5
         assert parsed_c.marshal() == neg_c.SerializeToString()
 
+    def test_submit_evidence_wire(self, pb):
+        import importlib
+
+        from google.protobuf import any_pb2
+
+        from celestia_app_tpu.tx.messages import Any, MsgSubmitEvidence
+
+        evidence = importlib.import_module("cosmos.evidence.v1beta1.tx_pb2")
+        inner = Any("/cosmos.evidence.v1beta1.Equivocation", b"\x08\x07")
+        ours = MsgSubmitEvidence("celestia1s", inner)
+        ref = evidence.MsgSubmitEvidence(
+            submitter="celestia1s",
+            evidence=any_pb2.Any(
+                type_url="/cosmos.evidence.v1beta1.Equivocation", value=b"\x08\x07"
+            ),
+        )
+        assert ours.marshal() == ref.SerializeToString()
+        assert MsgSubmitEvidence.unmarshal(ref.SerializeToString()) == ours
+
     def test_verify_invariant_wire(self, pb):
         import importlib
 
