@@ -59,10 +59,14 @@ from celestia_app_tpu.tx.messages import (
     MsgEditValidator,
     MsgFundCommunityPool,
     MsgCreateVestingAccount,
+    MsgDepositV1,
     MsgGrantAllowance,
     MsgMultiSend,
     MsgSubmitEvidence,
+    MsgSubmitProposalV1,
     MsgVerifyInvariant,
+    MsgVoteV1,
+    MsgVoteWeightedV1,
     MsgRevokeAllowance,
     MsgPayForBlobs,
     MsgRecvPacket,
@@ -105,6 +109,7 @@ _V1_MSGS = {
     MsgGrantAllowance, MsgRevokeAllowance,
     MsgAuthzGrant, MsgAuthzExec, MsgAuthzRevoke,
     MsgCreateVestingAccount, MsgVerifyInvariant, MsgSubmitEvidence,
+    MsgSubmitProposalV1, MsgVoteV1, MsgVoteWeightedV1, MsgDepositV1,
 }
 _V2_MSGS = _V1_MSGS | {MsgSignalVersion, MsgTryUpgrade}
 
@@ -368,7 +373,10 @@ def _run(
 
 def _check_gov_proposals(msgs: list) -> None:
     """GovProposalDecorator (app/ante/gov.go): a MsgSubmitProposal with no
-    inner messages is rejected before it can reach the gov keeper."""
+    inner messages is rejected before it can reach the gov keeper.  The
+    v1 msg needs no branch here: MsgSubmitProposalV1.validate_basic (ante
+    step 5) already rejects anything but exactly one legacy-content
+    message, which subsumes the empty case."""
     for m in msgs:
         if (
             isinstance(m, MsgSubmitProposal)

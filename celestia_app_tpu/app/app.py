@@ -52,9 +52,13 @@ from celestia_app_tpu.tx.messages import (
     MsgBeginRedelegate,
     MsgCancelUnbondingDelegation,
     MsgCreateVestingAccount,
+    MsgDepositV1,
     MsgMultiSend,
     MsgSubmitEvidence,
+    MsgSubmitProposalV1,
     MsgVerifyInvariant,
+    MsgVoteV1,
+    MsgVoteWeightedV1,
     MsgCreateValidator,
     MsgDelegate,
     MsgDeposit,
@@ -927,10 +931,28 @@ class App:
                 )]
             except DistributionError as e:
                 raise ValueError(str(e)) from e
-        if isinstance(msg, (MsgSubmitProposal, MsgVote, MsgVoteWeighted, MsgDeposit)):
+        if isinstance(msg, (
+            MsgSubmitProposal, MsgSubmitProposalV1,
+            MsgVote, MsgVoteV1, MsgVoteWeighted, MsgVoteWeightedV1,
+            MsgDeposit, MsgDepositV1,
+        )):
             from celestia_app_tpu.modules.gov import GovKeeper, ParamChange
 
             gov = GovKeeper(ctx.store, ctx.staking, ctx.bank)
+            if isinstance(msg, MsgSubmitProposalV1):
+                # gov v1: the single MsgExecLegacyContent's Content maps
+                # onto the same proposal shape the v1beta1 surface takes
+                # (the gov router executes legacy Content only).
+                from celestia_app_tpu.tx.messages import _parse_gov_content
+
+                exec_msg = msg.legacy_content()
+                (
+                    _title, _desc, v1_changes, spend_recipient, spend_amount,
+                ) = _parse_gov_content(exec_msg.content)
+                msg = MsgSubmitProposal(
+                    _title, _desc, v1_changes, msg.initial_deposit,
+                    msg.proposer, spend_recipient, spend_amount,
+                )
             if isinstance(msg, MsgSubmitProposal):
                 deposit = sum(c.amount for c in msg.initial_deposit if c.denom == "utia")
                 ctx.assert_spendable(msg.proposer, deposit)
@@ -948,10 +970,10 @@ class App:
                     spend=spend,
                 )
                 return 0, [("cosmos.gov.v1beta1.EventSubmitProposal", pid)]
-            if isinstance(msg, MsgVote):
+            if isinstance(msg, (MsgVote, MsgVoteV1)):
                 gov.vote(msg.proposal_id, msg.voter, msg.option, ctx.time_ns)
                 return 0, [("cosmos.gov.v1beta1.EventVote", msg.proposal_id, msg.voter)]
-            if isinstance(msg, MsgVoteWeighted):
+            if isinstance(msg, (MsgVoteWeighted, MsgVoteWeightedV1)):
                 from celestia_app_tpu.modules.gov import VoteOption
                 from celestia_app_tpu.state.dec import Dec
 

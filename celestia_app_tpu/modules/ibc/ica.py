@@ -38,8 +38,8 @@ EXECUTE_TX = 1
 _ACCOUNT_PREFIX = b"ica/account/"
 _PARAMS_KEY = b"ica/host_params"
 
-# The celestia whitelist (app/ica_host.go:3-17), minus msg types this
-# framework doesn't implement (gov v1).
+# The celestia whitelist, now matching app/ica_host.go:3-17 row for row
+# (gov votes ride the v1 url there, implemented since round 4).
 DEFAULT_ALLOW_MESSAGES = (
     "/ibc.applications.transfer.v1.MsgTransfer",
     "/cosmos.bank.v1beta1.MsgSend",
@@ -50,7 +50,7 @@ DEFAULT_ALLOW_MESSAGES = (
     "/cosmos.distribution.v1beta1.MsgSetWithdrawAddress",
     "/cosmos.distribution.v1beta1.MsgWithdrawDelegatorReward",
     "/cosmos.distribution.v1beta1.MsgFundCommunityPool",
-    "/cosmos.gov.v1beta1.MsgVote",
+    "/cosmos.gov.v1.MsgVote",
     "/cosmos.feegrant.v1beta1.MsgGrantAllowance",
     "/cosmos.feegrant.v1beta1.MsgRevokeAllowance",
 )

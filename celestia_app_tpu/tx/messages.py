@@ -34,6 +34,11 @@ URL_MSG_VOTE = "/cosmos.gov.v1beta1.MsgVote"
 URL_MSG_VOTE_WEIGHTED = "/cosmos.gov.v1beta1.MsgVoteWeighted"
 URL_MSG_DEPOSIT = "/cosmos.gov.v1beta1.MsgDeposit"
 URL_PARAM_CHANGE_PROPOSAL = "/cosmos.params.v1beta1.ParameterChangeProposal"
+URL_MSG_GOV_V1_SUBMIT_PROPOSAL = "/cosmos.gov.v1.MsgSubmitProposal"
+URL_MSG_GOV_V1_EXEC_LEGACY_CONTENT = "/cosmos.gov.v1.MsgExecLegacyContent"
+URL_MSG_GOV_V1_VOTE = "/cosmos.gov.v1.MsgVote"
+URL_MSG_GOV_V1_VOTE_WEIGHTED = "/cosmos.gov.v1.MsgVoteWeighted"
+URL_MSG_GOV_V1_DEPOSIT = "/cosmos.gov.v1.MsgDeposit"
 URL_COMMUNITY_POOL_SPEND_PROPOSAL = (
     "/cosmos.distribution.v1beta1.CommunityPoolSpendProposal"
 )
@@ -575,6 +580,38 @@ class ProposalParamChange:
         )
 
 
+def _parse_gov_content(
+    content: Any,
+) -> tuple[str, str, tuple, str, tuple]:
+    """Decode a gov Content Any -> (title, description, changes,
+    spend_recipient, spend_amount).  Supported contents:
+    ParameterChangeProposal {title=1, description=2, changes=3} and
+    CommunityPoolSpendProposal {title=1, description=2, recipient=3,
+    amount=4} (the distrclient.ProposalHandler the reference registers,
+    default_overrides.go:207).  Shared by the v1beta1 MsgSubmitProposal
+    and gov v1's MsgExecLegacyContent."""
+    if content.type_url not in (
+        URL_PARAM_CHANGE_PROPOSAL, URL_COMMUNITY_POOL_SPEND_PROPOSAL
+    ):
+        raise ValueError(f"unsupported proposal content {content.type_url}")
+    is_spend = content.type_url == URL_COMMUNITY_POOL_SPEND_PROPOSAL
+    title, description, spend_recipient = "", "", ""
+    changes: list[ProposalParamChange] = []
+    spend_amount: list[Coin] = []
+    for cn, cwt, cval in decode_fields(content.value):
+        if cn == 1 and cwt == WIRE_LEN:
+            title = cval.decode()
+        elif cn == 2 and cwt == WIRE_LEN:
+            description = cval.decode()
+        elif cn == 3 and cwt == WIRE_LEN and not is_spend:
+            changes.append(ProposalParamChange.unmarshal(cval))
+        elif cn == 3 and cwt == WIRE_LEN:
+            spend_recipient = cval.decode()
+        elif cn == 4 and cwt == WIRE_LEN and is_spend:
+            spend_amount.append(Coin.unmarshal(cval))
+    return title, description, tuple(changes), spend_recipient, tuple(spend_amount)
+
+
 @dataclass(frozen=True)
 class MsgSubmitProposal:
     """cosmos.gov.v1beta1.MsgSubmitProposal {content=1 (Any),
@@ -617,39 +654,23 @@ class MsgSubmitProposal:
     @classmethod
     def unmarshal(cls, raw: bytes) -> "MsgSubmitProposal":
         title, description = "", ""
-        changes: list[ProposalParamChange] = []
+        changes: tuple[ProposalParamChange, ...] = ()
         deposit: list[Coin] = []
         proposer = ""
         spend_recipient = ""
-        spend_amount: list[Coin] = []
+        spend_amount: tuple[Coin, ...] = ()
         for num, wt, val in decode_fields(raw):
             if num == 1 and wt == WIRE_LEN:
-                content = Any.unmarshal(val)
-                if content.type_url not in (
-                    URL_PARAM_CHANGE_PROPOSAL, URL_COMMUNITY_POOL_SPEND_PROPOSAL
-                ):
-                    raise ValueError(
-                        f"unsupported proposal content {content.type_url}"
-                    )
-                is_spend = content.type_url == URL_COMMUNITY_POOL_SPEND_PROPOSAL
-                for cn, cwt, cval in decode_fields(content.value):
-                    if cn == 1 and cwt == WIRE_LEN:
-                        title = cval.decode()
-                    elif cn == 2 and cwt == WIRE_LEN:
-                        description = cval.decode()
-                    elif cn == 3 and cwt == WIRE_LEN and not is_spend:
-                        changes.append(ProposalParamChange.unmarshal(cval))
-                    elif cn == 3 and cwt == WIRE_LEN:
-                        spend_recipient = cval.decode()
-                    elif cn == 4 and cwt == WIRE_LEN and is_spend:
-                        spend_amount.append(Coin.unmarshal(cval))
+                (
+                    title, description, changes, spend_recipient, spend_amount,
+                ) = _parse_gov_content(Any.unmarshal(val))
             elif num == 2 and wt == WIRE_LEN:
                 deposit.append(Coin.unmarshal(val))
             elif num == 3 and wt == WIRE_LEN:
                 proposer = val.decode()
         return cls(
-            title, description, tuple(changes), tuple(deposit), proposer,
-            spend_recipient, tuple(spend_amount),
+            title, description, changes, tuple(deposit), proposer,
+            spend_recipient, spend_amount,
         )
 
     def to_any(self) -> Any:
@@ -851,6 +872,264 @@ class MsgDeposit:
             raise ValueError("invalid proposal id")
         if not self.amount or any(c.amount <= 0 for c in self.amount):
             raise ValueError("deposit must be positive")
+
+
+# --- gov v1 (cosmos.gov.v1, sdk v0.46) -------------------------------------
+#
+# The reference chain serves BOTH gov msg servers (the sdk wires v1 and
+# v1beta1 side by side); modern clients speak v1, where a proposal carries
+# arbitrary messages and legacy Content rides inside MsgExecLegacyContent.
+# Field numbers are the v1beta1 ones plus a trailing `metadata` string.
+
+
+def gov_module_address() -> str:
+    """The sdk-canonical gov module account address:
+    bech32(hrp, sha256("gov")[:20]) (authtypes.NewModuleAddress) — the
+    `authority` v1 clients put on MsgExecLegacyContent."""
+    import hashlib
+
+    from celestia_app_tpu.crypto import bech32
+    from celestia_app_tpu.crypto.keys import ACCOUNT_HRP
+
+    return bech32.encode(ACCOUNT_HRP, hashlib.sha256(b"gov").digest()[:20])
+
+
+@dataclass(frozen=True)
+class MsgExecLegacyContent:
+    """cosmos.gov.v1.MsgExecLegacyContent {content=1 Any, authority=2}:
+    the v1 wrapper carrying a v1beta1 Content inside a v1 proposal.  Not
+    a tx msg — only the gov module account may execute it, so it appears
+    exclusively inside MsgSubmitProposalV1.messages."""
+
+    content: Any
+    authority: str
+
+    TYPE_URL = URL_MSG_GOV_V1_EXEC_LEGACY_CONTENT
+
+    def marshal(self) -> bytes:
+        return encode_bytes_field(1, self.content.marshal()) + encode_bytes_field(
+            2, self.authority.encode()
+        )
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgExecLegacyContent":
+        f = {num: val for num, wt, val in decode_fields(raw) if wt == WIRE_LEN}
+        return cls(Any.unmarshal(f.get(1, b"")), f.get(2, b"").decode())
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+
+@dataclass(frozen=True)
+class MsgSubmitProposalV1:
+    """cosmos.gov.v1.MsgSubmitProposal {messages=1 repeated Any,
+    initial_deposit=2 repeated Coin, proposer=3, metadata=4}.
+
+    Deviation (documented): this chain's gov router executes legacy
+    Content only, so exactly ONE message is accepted and it must be a
+    MsgExecLegacyContent wrapping a supported Content — the same set the
+    v1beta1 surface takes.  `metadata` rides the wire but is not
+    persisted (tallying never reads it)."""
+
+    messages: tuple[Any, ...]
+    initial_deposit: tuple[Coin, ...]
+    proposer: str
+    metadata: str = ""
+
+    TYPE_URL = URL_MSG_GOV_V1_SUBMIT_PROPOSAL
+
+    def marshal(self) -> bytes:
+        out = b""
+        for m in self.messages:
+            out += encode_bytes_field(1, m.marshal())
+        for c in self.initial_deposit:
+            out += encode_bytes_field(2, c.marshal())
+        out += encode_bytes_field(3, self.proposer.encode())
+        if self.metadata:
+            out += encode_bytes_field(4, self.metadata.encode())
+        return out
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgSubmitProposalV1":
+        msgs: list[Any] = []
+        deposit: list[Coin] = []
+        proposer, metadata = "", ""
+        for num, wt, val in decode_fields(raw):
+            if num == 1 and wt == WIRE_LEN:
+                msgs.append(Any.unmarshal(val))
+            elif num == 2 and wt == WIRE_LEN:
+                deposit.append(Coin.unmarshal(val))
+            elif num == 3 and wt == WIRE_LEN:
+                proposer = val.decode()
+            elif num == 4 and wt == WIRE_LEN:
+                metadata = val.decode()
+        return cls(tuple(msgs), tuple(deposit), proposer, metadata)
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+    @property
+    def signer(self) -> str:
+        return self.proposer
+
+    def legacy_content(self) -> MsgExecLegacyContent:
+        """The single MsgExecLegacyContent this proposal carries; raises
+        on anything else (this chain's gov router executes legacy
+        Content only)."""
+        if len(self.messages) != 1:
+            raise ValueError(
+                "gov v1 proposals carry exactly one message on this chain"
+            )
+        m = self.messages[0]
+        if m.type_url != URL_MSG_GOV_V1_EXEC_LEGACY_CONTENT:
+            raise ValueError(
+                f"proposal message {m.type_url} not supported by the gov "
+                "router (only MsgExecLegacyContent)"
+            )
+        exec_msg = MsgExecLegacyContent.unmarshal(m.value)
+        from celestia_app_tpu.modules.gov import GOV_MODULE
+
+        if exec_msg.authority not in (GOV_MODULE, gov_module_address()):
+            raise ValueError(
+                f"invalid authority {exec_msg.authority!r}: expected the "
+                "gov module account"
+            )
+        return exec_msg
+
+    def validate_basic(self) -> None:
+        from celestia_app_tpu.crypto.keys import validate_address
+
+        validate_address(self.proposer)
+        for c in self.initial_deposit:
+            if c.amount < 0:
+                raise ValueError("negative deposit")
+        # Statelessly pin the router rule + authority so a bad proposal
+        # never escrows a deposit.
+        self.legacy_content()
+
+
+@dataclass(frozen=True)
+class MsgVoteV1:
+    """cosmos.gov.v1.MsgVote {proposal_id=1, voter=2, option=3,
+    metadata=4} — v1beta1 numbering plus metadata."""
+
+    proposal_id: int
+    voter: str
+    option: int
+    metadata: str = ""
+
+    TYPE_URL = URL_MSG_GOV_V1_VOTE
+
+    def marshal(self) -> bytes:
+        out = (
+            encode_varint_field(1, self.proposal_id)
+            + encode_bytes_field(2, self.voter.encode())
+            + encode_varint_field(3, self.option)
+        )
+        if self.metadata:
+            out += encode_bytes_field(4, self.metadata.encode())
+        return out
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgVoteV1":
+        pid, voter, option, metadata = 0, "", 0, ""
+        for num, wt, val in decode_fields(raw):
+            if num == 1 and wt == WIRE_VARINT:
+                pid = val
+            elif num == 2 and wt == WIRE_LEN:
+                voter = val.decode()
+            elif num == 3 and wt == WIRE_VARINT:
+                option = val
+            elif num == 4 and wt == WIRE_LEN:
+                metadata = val.decode()
+        return cls(pid, voter, option, metadata)
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+    @property
+    def signer(self) -> str:
+        return self.voter
+
+    def validate_basic(self) -> None:
+        if self.proposal_id <= 0:
+            raise ValueError("invalid proposal id")
+        if self.option not in (1, 2, 3, 4):
+            raise ValueError(f"invalid vote option {self.option}")
+
+
+@dataclass(frozen=True)
+class MsgVoteWeightedV1:
+    """cosmos.gov.v1.MsgVoteWeighted {proposal_id=1, voter=2, options=3
+    repeated WeightedVoteOption, metadata=4}."""
+
+    proposal_id: int
+    voter: str
+    options: tuple[tuple[int, str], ...]  # (option, Dec-string weight)
+    metadata: str = ""
+
+    TYPE_URL = URL_MSG_GOV_V1_VOTE_WEIGHTED
+
+    def marshal(self) -> bytes:
+        out = encode_varint_field(1, self.proposal_id)
+        out += encode_bytes_field(2, self.voter.encode())
+        for opt, weight in self.options:
+            out += encode_bytes_field(3, encode_weighted_option(opt, weight))
+        if self.metadata:
+            out += encode_bytes_field(4, self.metadata.encode())
+        return out
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgVoteWeightedV1":
+        pid, voter, metadata = 0, "", ""
+        options: list[tuple[int, str]] = []
+        for num, wt, val in decode_fields(raw):
+            if num == 1 and wt == WIRE_VARINT:
+                pid = val
+            elif num == 2 and wt == WIRE_LEN:
+                voter = val.decode()
+            elif num == 3 and wt == WIRE_LEN:
+                options.append(decode_weighted_option(val))
+            elif num == 4 and wt == WIRE_LEN:
+                metadata = val.decode()
+        return cls(pid, voter, tuple(options), metadata)
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+    @property
+    def signer(self) -> str:
+        return self.voter
+
+    # Same stateless weight rules as the v1beta1 surface (non-empty, each
+    # weight in (0, 1], no duplicates, total exactly 1): an invalid
+    # weighted vote must die at CheckTx on either url.
+    validate_basic = MsgVoteWeighted.validate_basic
+
+
+@dataclass(frozen=True)
+class MsgDepositV1:
+    """cosmos.gov.v1.MsgDeposit — same shape as v1beta1 {proposal_id=1,
+    depositor=2, amount=3} under the v1 type url."""
+
+    proposal_id: int
+    depositor: str
+    amount: tuple[Coin, ...]
+
+    TYPE_URL = URL_MSG_GOV_V1_DEPOSIT
+
+    marshal = MsgDeposit.marshal
+    to_any = MsgDeposit.to_any
+    validate_basic = MsgDeposit.validate_basic
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgDepositV1":
+        base = MsgDeposit.unmarshal(raw)
+        return cls(base.proposal_id, base.depositor, base.amount)
+
+    @property
+    def signer(self) -> str:
+        return self.depositor
 
 
 @dataclass(frozen=True)
@@ -1729,6 +2008,10 @@ MSG_DECODERS = {
     URL_MSG_VOTE: MsgVote.unmarshal,
     URL_MSG_VOTE_WEIGHTED: MsgVoteWeighted.unmarshal,
     URL_MSG_DEPOSIT: MsgDeposit.unmarshal,
+    URL_MSG_GOV_V1_SUBMIT_PROPOSAL: MsgSubmitProposalV1.unmarshal,
+    URL_MSG_GOV_V1_VOTE: MsgVoteV1.unmarshal,
+    URL_MSG_GOV_V1_VOTE_WEIGHTED: MsgVoteWeightedV1.unmarshal,
+    URL_MSG_GOV_V1_DEPOSIT: MsgDepositV1.unmarshal,
     URL_MSG_TRANSFER: MsgTransfer.unmarshal,
     URL_MSG_RECV_PACKET: MsgRecvPacket.unmarshal,
     URL_MSG_ACKNOWLEDGEMENT: MsgAcknowledgement.unmarshal,

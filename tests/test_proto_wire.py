@@ -207,6 +207,90 @@ class TestTxEnvelopeWire:
         assert parsed_c.creation_height == -5
         assert parsed_c.marshal() == neg_c.SerializeToString()
 
+    def test_gov_v1_wire(self, pb):
+        import importlib
+
+        from google.protobuf import any_pb2
+
+        from celestia_app_tpu.tx.messages import (
+            Any,
+            Coin,
+            MsgExecLegacyContent,
+            MsgDepositV1,
+            MsgSubmitProposal,
+            MsgSubmitProposalV1,
+            MsgVoteV1,
+            MsgVoteWeightedV1,
+        )
+
+        govv1 = importlib.import_module("cosmos.gov.v1.tx_pb2")
+        # Content Any reused from the v1beta1 codec (ParamChange proposal).
+        content = MsgSubmitProposal(
+            "t", "d", (), (), "celestia1p"
+        )._content()
+        exec_msg = MsgExecLegacyContent(content, "celestia1gov")
+        ref_exec = govv1.MsgExecLegacyContent(
+            content=any_pb2.Any(
+                type_url=content.type_url, value=content.value
+            ),
+            authority="celestia1gov",
+        )
+        assert exec_msg.marshal() == ref_exec.SerializeToString()
+        assert (
+            MsgExecLegacyContent.unmarshal(ref_exec.SerializeToString())
+            == exec_msg
+        )
+
+        sp = MsgSubmitProposalV1(
+            (exec_msg.to_any(),), (Coin("utia", 1000),), "celestia1p", "meta",
+        )
+        ref_sp = govv1.MsgSubmitProposal(
+            messages=[any_pb2.Any(
+                type_url=exec_msg.TYPE_URL,
+                value=ref_exec.SerializeToString(),
+            )],
+            initial_deposit=[pb["coin"].Coin(denom="utia", amount="1000")],
+            proposer="celestia1p", metadata="meta",
+        )
+        assert sp.marshal() == ref_sp.SerializeToString()
+        assert MsgSubmitProposalV1.unmarshal(ref_sp.SerializeToString()) == sp
+
+        v = MsgVoteV1(7, "celestia1v", 3, "why")
+        ref_v = govv1.MsgVote(
+            proposal_id=7, voter="celestia1v",
+            option=govv1.VOTE_OPTION_NO, metadata="why",
+        )
+        assert v.marshal() == ref_v.SerializeToString()
+        assert MsgVoteV1.unmarshal(ref_v.SerializeToString()) == v
+
+        w = MsgVoteWeightedV1(
+            7, "celestia1v",
+            ((1, "0.700000000000000000"), (2, "0.300000000000000000")),
+        )
+        ref_w = govv1.MsgVoteWeighted(
+            proposal_id=7, voter="celestia1v",
+            options=[
+                govv1.WeightedVoteOption(
+                    option=govv1.VOTE_OPTION_YES,
+                    weight="0.700000000000000000",
+                ),
+                govv1.WeightedVoteOption(
+                    option=govv1.VOTE_OPTION_ABSTAIN,
+                    weight="0.300000000000000000",
+                ),
+            ],
+        )
+        assert w.marshal() == ref_w.SerializeToString()
+        assert MsgVoteWeightedV1.unmarshal(ref_w.SerializeToString()) == w
+
+        d = MsgDepositV1(7, "celestia1d", (Coin("utia", 50),))
+        ref_d = govv1.MsgDeposit(
+            proposal_id=7, depositor="celestia1d",
+            amount=[pb["coin"].Coin(denom="utia", amount="50")],
+        )
+        assert d.marshal() == ref_d.SerializeToString()
+        assert MsgDepositV1.unmarshal(ref_d.SerializeToString()) == d
+
     def test_submit_evidence_wire(self, pb):
         import importlib
 
