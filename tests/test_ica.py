@@ -308,3 +308,42 @@ class TestOrderedChannel:
 
         with _pytest.raises(IBCError, match="not open"):
             ck_b.send_packet(OWNER_PORT, "channel-7", encode_packet_data(msgs))
+
+
+class TestGovV1VoteFromICA:
+    def test_ica_votes_v1_on_live_proposal(self):
+        """The reference allowlist admits /cosmos.gov.v1.MsgVote from an
+        interchain account (app/ica_host.go:14); drive it end to end: a
+        local proposal reaches VOTING_PERIOD, the ICA casts a v1 vote via
+        EXECUTE_TX, and the gov keeper records it for the ICA address."""
+        from celestia_app_tpu.modules.gov import GovKeeper, ProposalStatus
+        from celestia_app_tpu.state.accounts import BankKeeper as BK
+        from celestia_app_tpu.state.staking import StakingKeeper
+        from celestia_app_tpu.tx.messages import (
+            MsgSubmitProposal,
+            MsgVoteV1,
+            ProposalParamChange,
+        )
+
+        chains, a, b, ica = _ica_chains()
+        proposer = a.keys[0]
+        res, _ = a.submit(proposer, MsgSubmitProposal(
+            "t", "d", (ProposalParamChange("blob", "GasPerBlobByte", "16"),),
+            (Coin("utia", 10_000_000_000),), proposer.public_key().address(),
+        ))
+        assert res.code == 0, res.log
+        gov = GovKeeper(
+            a.store, StakingKeeper(a.store), BK(a.store)
+        )
+        pid = gov.proposals()[-1].pid
+        assert gov.get_proposal(pid).status == ProposalStatus.VOTING_PERIOD
+
+        vote = MsgVoteV1(pid, ica, 1)
+        res, results = a.submit(a.relayer, MsgRecvPacket(
+            _ica_packet(b, [vote]).marshal(),
+            a.relayer.public_key().address(),
+        ))
+        assert res.code == 0, res.log
+        assert chains._written_ack(results) == b'{"result":"AQ=="}'
+        raw = a.store.get(f"gov/vote/{pid}/{ica}".encode())
+        assert raw is not None, "ICA vote not recorded"
