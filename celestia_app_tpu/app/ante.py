@@ -58,6 +58,8 @@ from celestia_app_tpu.tx.messages import (
     MsgDeposit,
     MsgEditValidator,
     MsgFundCommunityPool,
+    MsgCreatePeriodicVestingAccount,
+    MsgCreatePermanentLockedAccount,
     MsgCreateVestingAccount,
     MsgDepositV1,
     MsgGrantAllowance,
@@ -109,6 +111,7 @@ _V1_MSGS = {
     MsgGrantAllowance, MsgRevokeAllowance,
     MsgAuthzGrant, MsgAuthzExec, MsgAuthzRevoke,
     MsgCreateVestingAccount, MsgVerifyInvariant, MsgSubmitEvidence,
+    MsgCreatePeriodicVestingAccount, MsgCreatePermanentLockedAccount,
     MsgSubmitProposalV1, MsgVoteV1, MsgVoteWeightedV1, MsgDepositV1,
 }
 _V2_MSGS = _V1_MSGS | {MsgSignalVersion, MsgTryUpgrade}

@@ -51,6 +51,8 @@ from celestia_app_tpu.tx.messages import (
     MsgAuthzRevoke,
     MsgBeginRedelegate,
     MsgCancelUnbondingDelegation,
+    MsgCreatePeriodicVestingAccount,
+    MsgCreatePermanentLockedAccount,
     MsgCreateVestingAccount,
     MsgDepositV1,
     MsgMultiSend,
@@ -648,32 +650,55 @@ class App:
             return 0, [(
                 "cosmos.crisis.v1beta1.EventInvariantChecked", name,
             )]
-        if isinstance(msg, MsgCreateVestingAccount):
+        if isinstance(msg, (
+            MsgCreateVestingAccount,
+            MsgCreatePeriodicVestingAccount,
+            MsgCreatePermanentLockedAccount,
+        )):
             from celestia_app_tpu.state.accounts import (
                 VESTING_CONTINUOUS,
                 VESTING_DELAYED,
+                VESTING_PERIODIC,
+                VESTING_PERMANENT,
             )
 
             if ctx.auth.get_account(msg.to_address) is not None:
                 # sdk vesting msg server: the target must be brand new.
                 raise ValueError(f"account {msg.to_address} already exists")
-            total = sum(c.amount for c in msg.amount if c.denom == "utia")
-            end_ns = msg.end_time * 10**9
             acc = ctx.auth.get_or_create(msg.to_address)
-            acc.vesting_type = (
-                VESTING_DELAYED if msg.delayed else VESTING_CONTINUOUS
-            )
+            if isinstance(msg, MsgCreateVestingAccount):
+                total = sum(c.amount for c in msg.amount if c.denom == "utia")
+                acc.vesting_type = (
+                    VESTING_DELAYED if msg.delayed else VESTING_CONTINUOUS
+                )
+                # Continuous vesting starts at the block time (sdk
+                # NewContinuousVestingAccount with ctx.BlockTime); delayed
+                # ignores the start.
+                acc.vesting_start_ns = ctx.time_ns
+                acc.vesting_end_ns = msg.end_time * 10**9
+            elif isinstance(msg, MsgCreatePeriodicVestingAccount):
+                total = msg.total()
+                acc.vesting_type = VESTING_PERIODIC
+                # Periodic vesting starts at the MSG's start_time (sdk
+                # NewPeriodicVestingAccount takes it verbatim).
+                acc.vesting_start_ns = msg.start_time * 10**9
+                acc.vesting_periods = tuple(
+                    (p.length * 10**9,
+                     sum(c.amount for c in p.amount if c.denom == "utia"))
+                    for p in msg.vesting_periods
+                )
+                acc.vesting_end_ns = acc.vesting_start_ns + sum(
+                    length for length, _ in acc.vesting_periods
+                )
+            else:
+                total = sum(c.amount for c in msg.amount if c.denom == "utia")
+                acc.vesting_type = VESTING_PERMANENT
             acc.original_vesting = total
-            # Continuous vesting starts at the block time (sdk
-            # NewContinuousVestingAccount with ctx.BlockTime); delayed
-            # ignores the start.
-            acc.vesting_start_ns = ctx.time_ns
-            acc.vesting_end_ns = end_ns
             ctx.auth.set_account(acc)
             ctx.send_spendable(msg.from_address, msg.to_address, total)
             return 0, [(
                 "cosmos.vesting.v1beta1.EventCreateVestingAccount",
-                msg.to_address, total, msg.end_time,
+                msg.to_address, total, acc.vesting_type,
             )]
         if isinstance(msg, MsgMultiSend):
             # Single input (enforced by ValidateBasic, see tx/messages.py),

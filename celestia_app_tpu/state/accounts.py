@@ -39,6 +39,8 @@ _GLOBAL_ACC_NUM = b"auth/global_account_number"
 VESTING_NONE = 0
 VESTING_CONTINUOUS = 1  # linear release between start and end
 VESTING_DELAYED = 2  # everything releases at end
+VESTING_PERIODIC = 3  # stepwise release per (length, amount) period
+VESTING_PERMANENT = 4  # never releases (sdk PermanentLockedAccount)
 
 
 @dataclass
@@ -58,6 +60,9 @@ class Account:
     # out of the balance, so the lock must not double-count them or
     # later-received liquid funds would freeze.
     delegated_vesting: int = 0
+    # Periodic schedule (sdk PeriodicVestingAccount.VestingPeriods):
+    # (length_ns, amount) steps releasing cumulatively from start.
+    vesting_periods: tuple[tuple[int, int], ...] = ()
 
     def marshal(self) -> bytes:
         out = (
@@ -74,28 +79,54 @@ class Account:
                 + encode_varint_field(8, self.vesting_end_ns)
                 + encode_varint_field(9, self.delegated_vesting)
             )
+            for length_ns, amount in self.vesting_periods:
+                out += encode_bytes_field(
+                    10,
+                    encode_varint_field(1, length_ns)
+                    + encode_varint_field(2, amount),
+                )
         return out
 
     @classmethod
     def unmarshal(cls, raw: bytes) -> "Account":
         addr, pk = "", b""
         ints = {}
+        periods: list[tuple[int, int]] = []
         for fnum, wt, val in decode_fields(raw):
             if fnum == 1 and wt == WIRE_LEN:
                 addr = val.decode()
             elif fnum == 2 and wt == WIRE_LEN:
                 pk = val
+            elif fnum == 10 and wt == WIRE_LEN:
+                p = {n: v for n, w, v in decode_fields(val) if w == WIRE_VARINT}
+                periods.append((p.get(1, 0), p.get(2, 0)))
             elif wt == WIRE_VARINT:
                 ints[fnum] = val
         return cls(
             addr, pk, ints.get(3, 0), ints.get(4, 0),
             ints.get(5, 0), ints.get(6, 0), ints.get(7, 0), ints.get(8, 0),
-            ints.get(9, 0),
+            ints.get(9, 0), tuple(periods),
         )
 
     def _schedule_locked(self, time_ns: int) -> int:
         if self.vesting_type == VESTING_NONE or self.original_vesting == 0:
             return 0
+        if self.vesting_type == VESTING_PERMANENT:
+            # sdk PermanentLockedAccount: never vests.
+            return self.original_vesting
+        if self.vesting_type == VESTING_PERIODIC:
+            # Stepwise: each period's amount releases when its cumulative
+            # length elapses past start (sdk periodic_vesting_account.go).
+            if time_ns <= self.vesting_start_ns:
+                return self.original_vesting
+            vested = 0
+            t = self.vesting_start_ns
+            for length_ns, amount in self.vesting_periods:
+                t += length_ns
+                if time_ns < t:
+                    break
+                vested += amount
+            return max(0, self.original_vesting - vested)
         if time_ns >= self.vesting_end_ns:
             return 0
         if self.vesting_type == VESTING_DELAYED:

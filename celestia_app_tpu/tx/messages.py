@@ -25,6 +25,12 @@ URL_MSG_PAY_FOR_BLOBS = "/celestia.blob.v1.MsgPayForBlobs"
 URL_MSG_SEND = "/cosmos.bank.v1beta1.MsgSend"
 URL_MSG_MULTI_SEND = "/cosmos.bank.v1beta1.MsgMultiSend"
 URL_MSG_CREATE_VESTING_ACCOUNT = "/cosmos.vesting.v1beta1.MsgCreateVestingAccount"
+URL_MSG_CREATE_PERIODIC_VESTING_ACCOUNT = (
+    "/cosmos.vesting.v1beta1.MsgCreatePeriodicVestingAccount"
+)
+URL_MSG_CREATE_PERMANENT_LOCKED_ACCOUNT = (
+    "/cosmos.vesting.v1beta1.MsgCreatePermanentLockedAccount"
+)
 URL_MSG_VERIFY_INVARIANT = "/cosmos.crisis.v1beta1.MsgVerifyInvariant"
 URL_MSG_SUBMIT_EVIDENCE = "/cosmos.evidence.v1beta1.MsgSubmitEvidence"
 URL_MSG_SIGNAL_VERSION = "/celestia.signal.v1.MsgSignalVersion"
@@ -479,22 +485,175 @@ class MsgCreateVestingAccount:
 
         validate_address(self.from_address)
         validate_address(self.to_address)
-        if not self.amount:
-            raise ValueError("vesting amount must not be empty")
-        for c in self.amount:
-            if c.amount <= 0:
-                raise ValueError(
-                    f"vesting amount must be positive, got {c.amount}"
-                )
-            if c.denom != "utia":
-                # TIA-only chain (tokenfilter): the handler vests utia;
-                # silently dropping a foreign denom would report code 0
-                # while locking nothing.
-                raise ValueError(
-                    f"invalid vesting denom {c.denom!r}, expected utia"
-                )
+        # TIA-only chain (tokenfilter): the handler vests utia; silently
+        # dropping a foreign denom would report code 0 while locking
+        # nothing.
+        _validate_utia_coins(self.amount, "vesting amount")
         if self.end_time <= 0:
             raise ValueError("invalid end time")
+
+
+def _validate_utia_coins(coins: tuple[Coin, ...], what: str) -> None:
+    if not coins:
+        raise ValueError(f"{what} must not be empty")
+    for c in coins:
+        if c.amount <= 0:
+            raise ValueError(f"{what} must be positive, got {c.amount}")
+        if c.denom != "utia":
+            raise ValueError(f"invalid {what} denom {c.denom!r}, expected utia")
+
+
+@dataclass(frozen=True)
+class VestingPeriod:
+    """cosmos.vesting.v1beta1.Period {length=1 int64 SECONDS, amount=2
+    repeated Coin}."""
+
+    length: int  # seconds
+    amount: tuple[Coin, ...]
+
+    def marshal(self) -> bytes:
+        out = b""
+        if self.length:
+            out += encode_varint_field(1, self.length & ((1 << 64) - 1))
+        for c in self.amount:
+            out += encode_bytes_field(2, c.marshal())
+        return out
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "VestingPeriod":
+        from celestia_app_tpu.encoding.proto import int64_from_uvarint
+
+        length = 0
+        coins: list[Coin] = []
+        for num, wt, val in decode_fields(raw):
+            if num == 1 and wt == WIRE_VARINT:
+                length = int64_from_uvarint(val)
+            elif num == 2 and wt == WIRE_LEN:
+                coins.append(Coin.unmarshal(val))
+        return cls(length, tuple(coins))
+
+
+@dataclass(frozen=True)
+class MsgCreatePeriodicVestingAccount:
+    """cosmos.vesting.v1beta1.MsgCreatePeriodicVestingAccount
+    {from_address=1, to_address=2, start_time=3 int64, vesting_periods=4
+    repeated Period}: fund a brand-new account releasing stepwise — each
+    period's amount unlocks when its cumulative length elapses past
+    start_time."""
+
+    from_address: str
+    to_address: str
+    start_time: int  # unix seconds
+    vesting_periods: tuple[VestingPeriod, ...]
+
+    TYPE_URL = URL_MSG_CREATE_PERIODIC_VESTING_ACCOUNT
+
+    def marshal(self) -> bytes:
+        out = encode_bytes_field(1, self.from_address.encode())
+        out += encode_bytes_field(2, self.to_address.encode())
+        if self.start_time:
+            out += encode_varint_field(3, self.start_time & ((1 << 64) - 1))
+        for p in self.vesting_periods:
+            out += encode_bytes_field(4, p.marshal())
+        return out
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgCreatePeriodicVestingAccount":
+        from celestia_app_tpu.encoding.proto import int64_from_uvarint
+
+        f, t, start = "", "", 0
+        periods: list[VestingPeriod] = []
+        for num, wt, val in decode_fields(raw):
+            if num == 1 and wt == WIRE_LEN:
+                f = val.decode()
+            elif num == 2 and wt == WIRE_LEN:
+                t = val.decode()
+            elif num == 3 and wt == WIRE_VARINT:
+                start = int64_from_uvarint(val)
+            elif num == 4 and wt == WIRE_LEN:
+                periods.append(VestingPeriod.unmarshal(val))
+        return cls(f, t, start, tuple(periods))
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+    @property
+    def signer(self) -> str:
+        return self.from_address
+
+    def total(self) -> int:
+        return sum(
+            c.amount
+            for p in self.vesting_periods
+            for c in p.amount
+            if c.denom == "utia"
+        )
+
+    def validate_basic(self) -> None:
+        """sdk ValidateBasic: valid addresses, start_time >= 1, at least
+        one period, each period length > 0 with valid positive coins."""
+        from celestia_app_tpu.crypto.keys import validate_address
+
+        validate_address(self.from_address)
+        validate_address(self.to_address)
+        if self.start_time < 1:
+            # sdk v0.46 rejects a zero/negative anchor — the proto
+            # default of 0 would vest everything at epoch.
+            raise ValueError(f"invalid start time of {self.start_time}")
+        if not self.vesting_periods:
+            raise ValueError("vesting periods must not be empty")
+        for i, p in enumerate(self.vesting_periods):
+            if p.length <= 0:
+                raise ValueError(f"invalid period length of {p.length} in period {i}")
+            _validate_utia_coins(p.amount, "vesting amount")
+
+
+@dataclass(frozen=True)
+class MsgCreatePermanentLockedAccount:
+    """cosmos.vesting.v1beta1.MsgCreatePermanentLockedAccount
+    {from_address=1, to_address=2, amount=3 repeated Coin}: fund a
+    brand-new account whose tokens never vest (delegatable, never
+    spendable)."""
+
+    from_address: str
+    to_address: str
+    amount: tuple[Coin, ...]
+
+    TYPE_URL = URL_MSG_CREATE_PERMANENT_LOCKED_ACCOUNT
+
+    def marshal(self) -> bytes:
+        out = encode_bytes_field(1, self.from_address.encode())
+        out += encode_bytes_field(2, self.to_address.encode())
+        for c in self.amount:
+            out += encode_bytes_field(3, c.marshal())
+        return out
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MsgCreatePermanentLockedAccount":
+        f, t = "", ""
+        coins: list[Coin] = []
+        for num, wt, val in decode_fields(raw):
+            if num == 1 and wt == WIRE_LEN:
+                f = val.decode()
+            elif num == 2 and wt == WIRE_LEN:
+                t = val.decode()
+            elif num == 3 and wt == WIRE_LEN:
+                coins.append(Coin.unmarshal(val))
+        return cls(f, t, tuple(coins))
+
+    def to_any(self) -> Any:
+        return Any(self.TYPE_URL, self.marshal())
+
+    @property
+    def signer(self) -> str:
+        return self.from_address
+
+    def validate_basic(self) -> None:
+        from celestia_app_tpu.crypto.keys import validate_address
+
+        validate_address(self.from_address)
+        validate_address(self.to_address)
+        _validate_utia_coins(self.amount, "locked amount")
 
 
 @dataclass(frozen=True)
@@ -2000,6 +2159,12 @@ MSG_DECODERS = {
     URL_MSG_SEND: MsgSend.unmarshal,
     URL_MSG_MULTI_SEND: MsgMultiSend.unmarshal,
     URL_MSG_CREATE_VESTING_ACCOUNT: MsgCreateVestingAccount.unmarshal,
+    URL_MSG_CREATE_PERIODIC_VESTING_ACCOUNT: (
+        MsgCreatePeriodicVestingAccount.unmarshal
+    ),
+    URL_MSG_CREATE_PERMANENT_LOCKED_ACCOUNT: (
+        MsgCreatePermanentLockedAccount.unmarshal
+    ),
     URL_MSG_VERIFY_INVARIANT: MsgVerifyInvariant.unmarshal,
     URL_MSG_SUBMIT_EVIDENCE: MsgSubmitEvidence.unmarshal,
     URL_MSG_SIGNAL_VERSION: MsgSignalVersion.unmarshal,

@@ -648,3 +648,78 @@ class TestSubmitEvidenceMsg:
         )])
         assert res.code != 0
         assert "unregistered handler for evidence type" in res.log
+
+
+class TestPeriodicAndPermanentVesting:
+    def test_periodic_releases_stepwise(self):
+        from celestia_app_tpu.state.accounts import VESTING_PERIODIC
+        from celestia_app_tpu.tx.messages import (
+            MsgCreatePeriodicVestingAccount,
+            VestingPeriod,
+        )
+        from celestia_app_tpu.crypto import PrivateKey
+
+        harness = TestThroughTheApp()
+        node, keys = harness._node()
+        funder = keys[0]
+        f_addr = funder.public_key().address()
+        v_addr = PrivateKey.from_seed(b"periodic").public_key().address()
+        start_s = node.app.genesis_time_ns // 10**9
+        res = harness._submit(node, funder, [MsgCreatePeriodicVestingAccount(
+            f_addr, v_addr, start_s,
+            (
+                VestingPeriod(100, (Coin("utia", 400),)),
+                VestingPeriod(200, (Coin("utia", 600),)),
+            ),
+        )])
+        assert res.code == 0, res.log
+        acc = AuthKeeper(node.app.cms.working).get_account(v_addr)
+        assert acc.vesting_type == VESTING_PERIODIC
+        assert acc.original_vesting == 1000
+        start_ns = start_s * 10**9
+        # Before the first period elapses: everything locked.
+        assert acc.locked(start_ns + 99 * 10**9) == 1000
+        # After period 1 (100s): 400 released.
+        assert acc.locked(start_ns + 100 * 10**9) == 600
+        # After period 2 (cumulative 300s): fully vested.
+        assert acc.locked(start_ns + 300 * 10**9) == 0
+        assert acc.vesting_end_ns == start_ns + 300 * 10**9
+
+    def test_permanent_locked_never_vests_but_delegates(self):
+        from celestia_app_tpu.state.accounts import VESTING_PERMANENT
+        from celestia_app_tpu.state.staking import StakingKeeper
+        from celestia_app_tpu.tx.messages import (
+            MsgCreatePermanentLockedAccount,
+            MsgDelegate,
+        )
+        from celestia_app_tpu.crypto import PrivateKey
+
+        harness = TestThroughTheApp()
+        node, keys = harness._node()
+        funder = keys[0]
+        f_addr = funder.public_key().address()
+        vkey = PrivateKey.from_seed(b"permanent")
+        v_addr = vkey.public_key().address()
+        res = harness._submit(node, funder, [MsgCreatePermanentLockedAccount(
+            f_addr, v_addr, (Coin("utia", 10**9),)
+        )])
+        assert res.code == 0, res.log
+        acc = AuthKeeper(node.app.cms.working).get_account(v_addr)
+        assert acc.vesting_type == VESTING_PERMANENT
+        # Locked at any horizon.
+        assert acc.locked(10**30) == 10**9
+        # Fund fees, then: spending fails forever, delegating works
+        # (sdk PermanentLockedAccount semantics).
+        harness._submit(node, funder, [MsgSend(
+            f_addr, v_addr, (Coin("utia", 100_000),)
+        )])
+        res = harness._submit(node, vkey, [MsgSend(
+            v_addr, f_addr, (Coin("utia", 10**8),)
+        )])
+        assert res.code != 0 and "still vesting" in res.log
+        val = StakingKeeper(node.app.cms.working).validators()[0].address
+        res = harness._submit(node, vkey, [MsgDelegate(
+            v_addr, val, Coin("utia", 5 * 10**8)
+        )])
+        assert res.code == 0, res.log
+        assert StakingKeeper(node.app.cms.working).delegation(v_addr, val) == 5 * 10**8

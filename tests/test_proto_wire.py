@@ -196,6 +196,52 @@ class TestTxEnvelopeWire:
         with pytest.raises(ValueError, match="invalid end time"):
             _dc_replace(parsed, from_address=real, to_address=real).validate_basic()
 
+        from celestia_app_tpu.tx.messages import (
+            MsgCreatePeriodicVestingAccount,
+            MsgCreatePermanentLockedAccount,
+            VestingPeriod,
+        )
+
+        pv = MsgCreatePeriodicVestingAccount(
+            "celestia1from", "celestia1new", 1_700_000_000,
+            (
+                VestingPeriod(3600, (Coin("utia", 40),)),
+                VestingPeriod(7200, (Coin("utia", 60),)),
+            ),
+        )
+        ref_pv = vesting.MsgCreatePeriodicVestingAccount(
+            from_address="celestia1from", to_address="celestia1new",
+            start_time=1_700_000_000,
+            vesting_periods=[
+                vesting.Period(
+                    length=3600,
+                    amount=[pb["coin"].Coin(denom="utia", amount="40")],
+                ),
+                vesting.Period(
+                    length=7200,
+                    amount=[pb["coin"].Coin(denom="utia", amount="60")],
+                ),
+            ],
+        )
+        assert pv.marshal() == ref_pv.SerializeToString()
+        assert (
+            MsgCreatePeriodicVestingAccount.unmarshal(ref_pv.SerializeToString())
+            == pv
+        )
+
+        pl = MsgCreatePermanentLockedAccount(
+            "celestia1from", "celestia1new", (Coin("utia", 99),)
+        )
+        ref_pl = vesting.MsgCreatePermanentLockedAccount(
+            from_address="celestia1from", to_address="celestia1new",
+            amount=[pb["coin"].Coin(denom="utia", amount="99")],
+        )
+        assert pl.marshal() == ref_pl.SerializeToString()
+        assert (
+            MsgCreatePermanentLockedAccount.unmarshal(ref_pl.SerializeToString())
+            == pl
+        )
+
         staking = importlib.import_module("cosmos.staking.v1beta1.tx_pb2")
         from celestia_app_tpu.tx.messages import MsgCancelUnbondingDelegation
 
