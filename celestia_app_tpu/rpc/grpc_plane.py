@@ -187,6 +187,71 @@ def _handlers(node) -> dict:
         )
         return encode_bytes_field(2, encode_bytes_field(1, header))
 
+    def query_delegation(req: bytes) -> bytes:
+        # QueryDelegationRequest {delegator_addr=1, validator_addr=2} ->
+        # {delegation_response=1 {delegation=1 {delegator_address=1,
+        # validator_address=2, shares=3}, balance=2 Coin}} — the fields
+        # staking dashboards read; shares reported 1:1 with tokens (this
+        # framework's delegation records are token-denominated).
+        from celestia_app_tpu.state.staking import StakingKeeper
+
+        delegator = _field_str(req, 1)
+        validator = _field_str(req, 2)
+        with node_lock():
+            amount = StakingKeeper(node.app.cms.working).delegation(
+                delegator, validator
+            )
+        if amount == 0:
+            return b""
+        # shares: gogoproto Dec wire format is the 10^18-scaled integer's
+        # plain digits (big.Int text), NOT a human decimal string — a dot
+        # would fail Go clients' Dec.Unmarshal.  Shares track tokens 1:1.
+        delegation = (
+            encode_bytes_field(1, delegator.encode())
+            + encode_bytes_field(2, validator.encode())
+            + encode_bytes_field(3, str(amount * 10**18).encode())
+        )
+        balance = encode_bytes_field(1, b"utia") + encode_bytes_field(
+            2, str(amount).encode()
+        )
+        return encode_bytes_field(
+            1,
+            encode_bytes_field(1, delegation) + encode_bytes_field(2, balance),
+        )
+
+    def query_proposals(req: bytes) -> bytes:
+        # QueryProposalsRequest -> {proposals=1 repeated Proposal
+        # {proposal_id=1, status=3}} — the id/status pair explorers poll
+        # (field 2 is the content Any in cosmos.gov.v1beta1.Proposal and
+        # must not be squatted by a varint).
+        from celestia_app_tpu.modules.gov import GovKeeper
+        from celestia_app_tpu.state.staking import StakingKeeper
+
+        with node_lock():
+            store = node.app.cms.working
+            from celestia_app_tpu.state.accounts import BankKeeper
+
+            props = GovKeeper(
+                store, StakingKeeper(store), BankKeeper(store)
+            ).proposals()
+        out = b""
+        for p in props:
+            out += encode_bytes_field(
+                1,
+                encode_varint_field(1, p.pid)
+                + encode_varint_field(3, int(p.status)),
+            )
+        return out
+
+    def query_blob_params(req: bytes) -> bytes:
+        # celestia.blob.v1 QueryParamsResponse {params=1 {
+        # gas_per_blob_byte=1, gov_max_square_size=2}}.
+        with node_lock():
+            params = encode_varint_field(
+                1, node.app.gas_per_blob_byte
+            ) + encode_varint_field(2, node.app.gov_max_square_size)
+        return encode_bytes_field(1, params)
+
     return {
         "cosmos.tx.v1beta1.Service": {
             "BroadcastTx": broadcast_tx,
@@ -194,7 +259,12 @@ def _handlers(node) -> dict:
         },
         "cosmos.auth.v1beta1.Query": {"Account": query_account},
         "cosmos.bank.v1beta1.Query": {"Balance": query_balance},
-        "cosmos.staking.v1beta1.Query": {"Validators": query_validators},
+        "cosmos.staking.v1beta1.Query": {
+            "Validators": query_validators,
+            "Delegation": query_delegation,
+        },
+        "cosmos.gov.v1beta1.Query": {"Proposals": query_proposals},
+        "celestia.blob.v1.Query": {"Params": query_blob_params},
         "cosmos.base.tendermint.v1beta1.Service": {
             "GetLatestBlock": get_latest_block,
         },
@@ -264,6 +334,9 @@ class GrpcNode:
                 "account": "/cosmos.auth.v1beta1.Query/Account",
                 "balance": "/cosmos.bank.v1beta1.Query/Balance",
                 "validators": "/cosmos.staking.v1beta1.Query/Validators",
+                "delegation": "/cosmos.staking.v1beta1.Query/Delegation",
+                "proposals": "/cosmos.gov.v1beta1.Query/Proposals",
+                "blob_params": "/celestia.blob.v1.Query/Params",
                 "latest": "/cosmos.base.tendermint.v1beta1.Service/GetLatestBlock",
             }.items()
         }
@@ -353,3 +426,33 @@ class GrpcNode:
                     "power": int(_field_str(val, 5) or 0),
                 })
         return out
+
+    def delegation(self, delegator: str, validator: str) -> int:
+        """Delegated utia of (delegator, validator); 0 if none."""
+        resp = self._call["delegation"](
+            encode_bytes_field(1, delegator.encode())
+            + encode_bytes_field(2, validator.encode())
+        )
+        dr = _field_bytes(resp, 1)
+        if not dr:
+            return 0
+        return int(_field_str(_field_bytes(dr, 2), 2) or 0)
+
+    def proposals(self) -> list[dict]:
+        """[{id, status}] of every proposal on chain."""
+        out = []
+        for num, wt, val in decode_fields(self._call["proposals"](b"")):
+            if num == 1 and wt == WIRE_LEN:
+                out.append({
+                    "id": _field_int(val, 1),
+                    "status": _field_int(val, 3),
+                })
+        return out
+
+    def blob_params(self) -> dict:
+        """{gas_per_blob_byte, gov_max_square_size} (celestia.blob.v1)."""
+        p = _field_bytes(self._call["blob_params"](b""), 1)
+        return {
+            "gas_per_blob_byte": _field_int(p, 1),
+            "gov_max_square_size": _field_int(p, 2),
+        }

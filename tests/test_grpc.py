@@ -83,6 +83,42 @@ class TestGrpcServices:
         res = client.broadcast(b"\x00garbage")
         assert res.code != 0
 
+    def test_query_surface_delegation_proposals_blob_params(self, served):
+        """The wider query plane (staking Delegation, gov Proposals,
+        celestia.blob.v1 Params) — the endpoints relayers/explorers poll."""
+        from celestia_app_tpu.state.staking import StakingKeeper
+        from celestia_app_tpu.tx.messages import (
+            MsgDelegate,
+            MsgSubmitProposal,
+            ProposalParamChange,
+        )
+
+        node, client = served
+        tx_client = TxClient(client, node.keys[:2])
+        addr = node.keys[0].public_key().address()
+        val = StakingKeeper(node.app.cms.working).validators()[0].address
+
+        assert client.delegation(addr, val) == 0
+        resp = tx_client.submit_tx(
+            [MsgDelegate(addr, val, Coin("utia", 2_000_000))]
+        )
+        assert resp.code == 0, resp.log
+        assert client.delegation(addr, val) == 2_000_000
+
+        params = client.blob_params()
+        assert params["gas_per_blob_byte"] == node.app.gas_per_blob_byte
+        assert params["gov_max_square_size"] == node.app.gov_max_square_size
+
+        assert client.proposals() == []
+        resp = tx_client.submit_tx([MsgSubmitProposal(
+            "t", "d", (ProposalParamChange("blob", "GasPerBlobByte", "9"),),
+            (Coin("utia", 1_000),), addr,
+        )])
+        assert resp.code == 0, resp.log
+        props = client.proposals()
+        assert len(props) == 1 and props[0]["id"] >= 1
+        assert props[0]["status"] >= 1
+
     def test_queries_race_the_proposer_loop(self, served):
         """Race tier: gRPC workers read state under node.lock while the
         proposer loop commits concurrently (the JSON-RPC plane's rpc_*
