@@ -201,7 +201,10 @@ def _run(
     # --- 3: gas meter setup (SetUpContextDecorator) --------------------------
     auth = tx.auth_info
     fee = auth.fee
-    if fee.gas_limit == 0:
+    if fee.gas_limit == 0 and not simulate:
+        # Simulate waives the limit entirely (sdk SetUpContextDecorator
+        # installs an infinite meter): cosmjs's simulate() sends
+        # gasLimit=0 by construction.
         raise AnteError("gas limit must be positive")
     meter = GasMeter(None if simulate else fee.gas_limit)
     # Every store access from here on is charged the sdk KVStore gas
@@ -243,7 +246,12 @@ def _run(
 
     # --- 9: fee checks (ValidateTxFee) + deduction ---------------------------
     fee_utia = sum(c.amount for c in fee.amount if c.denom == "utia")
-    gas_price = Dec.from_fraction(fee_utia, fee.gas_limit)
+    # gas_limit can be 0 only under Simulate (checked at step 3), where
+    # the min-gas-price comparisons are skipped anyway.
+    gas_price = (
+        Dec(0) if fee.gas_limit == 0
+        else Dec.from_fraction(fee_utia, fee.gas_limit)
+    )
     # Error strings follow the sdk wording so clients can parse the required
     # fee and retry (app/errors/insufficient_gas_price.go:23).
     net_min = app.minfee.network_min_gas_price()
@@ -341,7 +349,11 @@ def _run(
     # --- 14-16: x/blob ante --------------------------------------------------
     for m in msgs:
         if isinstance(m, MsgPayForBlobs):
-            _check_pfb_gas(m, fee.gas_limit, app.gas_per_blob_byte)
+            if not simulate:
+                # MinGasPFBDecorator reads the meter's limit, which is
+                # infinite under Simulate — a placeholder fee gas limit
+                # must not fail the estimation call.
+                _check_pfb_gas(m, fee.gas_limit, app.gas_per_blob_byte)
             _check_blob_shares(m, app.gov_max_square_size, ctx.app_version)
 
     # --- 17: gov proposals ---------------------------------------------------

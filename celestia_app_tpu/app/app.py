@@ -543,13 +543,36 @@ class App:
                         v.address, v.address in last_commit_signers, time_ns,
                     )
 
-    def _deliver_tx(self, block_ctx: Ctx, raw: bytes) -> TxResult:
+    def simulate_tx(self, raw: bytes) -> TxResult:
+        """cosmos.tx.v1beta1.Service/Simulate: run the tx (ante + msgs)
+        against a throwaway branch of committed state at the next height
+        — signature verification and the gas limit are waived (sdk
+        Simulate), gas_used is the real metered consumption, and no state
+        survives."""
+        ctx = Ctx(
+            self.cms.working.branch(), self.height + 1,
+            self.last_block_time_ns, self.app_version,
+        )
+        return self._deliver_tx(ctx, raw, simulate=True)
+
+    def _deliver_tx(
+        self, block_ctx: Ctx, raw: bytes, simulate: bool = False
+    ) -> TxResult:
+        # Imported BEFORE the first try: a function-level import makes the
+        # name local for the WHOLE function, so the first `except OutOfGas`
+        # would otherwise raise UnboundLocalError whenever the ante phase
+        # fails (latent until Simulate started feeding garbage txs here).
+        from celestia_app_tpu.app.gas import GasKVStore, OutOfGas
+
         btx = unmarshal_blob_tx(raw)
         inner = btx.tx if btx is not None else raw
         tx_ctx = block_ctx.branch()
         try:
             tx = Tx.unmarshal(inner)
-            ante_res = run_ante(self, tx_ctx, tx, is_check_tx=False, tx_bytes=inner)
+            ante_res = run_ante(
+                self, tx_ctx, tx, is_check_tx=False, tx_bytes=inner,
+                simulate=simulate,
+            )
         except OutOfGas as e:
             return TxResult(code=11, log=str(e))  # sdk ErrOutOfGas, either phase
         except (AnteError, ValueError) as e:
@@ -559,8 +582,6 @@ class App:
         # into execution: store access during message handling is charged
         # the KVStore schedule, and blob gas consumes against the same
         # limit (closes the round-2 store-gas PARITY deviation).
-        from celestia_app_tpu.app.gas import GasKVStore, OutOfGas
-
         meter = ante_res.meter
         events: list = []
         # Messages run on their own branch (baseapp runMsgs' cache): a failed
@@ -571,9 +592,14 @@ class App:
         exec_ctx = msg_ctx.with_store(GasKVStore(msg_ctx.store, meter))
         try:
             for msg in tx.msgs():
-                used, evts = self._handle_msg(
-                    exec_ctx, msg, ante_res.gas_wanted - meter.consumed
+                # Simulate runs on an infinite meter, so consumption can
+                # legitimately exceed the fee's nominal gas_wanted — the
+                # remaining-gas argument must not go negative there.
+                remaining = (
+                    (1 << 62) if simulate
+                    else ante_res.gas_wanted - meter.consumed
                 )
+                used, evts = self._handle_msg(exec_ctx, msg, remaining)
                 if used:
                     meter.consume(used, "execution")
                 events.extend(evts)

@@ -187,6 +187,36 @@ def _handlers(node) -> dict:
         )
         return encode_bytes_field(2, encode_bytes_field(1, header))
 
+    def simulate(req: bytes) -> bytes:
+        # SimulateRequest {tx_bytes=2} -> SimulateResponse {gas_info=1
+        # {gas_wanted=1, gas_used=2}}: the gas-estimation endpoint
+        # cosmjs/TxClient call before signing for real (sig verification
+        # and the gas limit waived, state discarded).
+        tx_bytes = _field_bytes(req, 2)
+        with node_lock():
+            res = node.app.simulate_tx(tx_bytes)
+        if res.code != 0:
+            # Keep the unary shape and report failure through an absent
+            # gas_info + Result.log (cosmos.base.abci.v1beta1.Result
+            # {data=1, log=2, events=3}).
+            return encode_bytes_field(
+                2, encode_bytes_field(2, res.log.encode())
+            )
+        gas_info = encode_varint_field(1, res.gas_wanted) + encode_varint_field(
+            2, res.gas_used
+        )
+        return encode_bytes_field(1, gas_info)
+
+    def get_node_info(req: bytes) -> bytes:
+        # GetNodeInfoResponse {default_node_info=1 {network=4, version=5,
+        # moniker=7}} — the fields cosmjs reads on connect.
+        info = (
+            encode_bytes_field(4, node.chain_id.encode())
+            + encode_bytes_field(5, b"celestia-app-tpu")
+            + encode_bytes_field(7, b"tpu-node")
+        )
+        return encode_bytes_field(1, info)
+
     def query_delegation(req: bytes) -> bytes:
         # QueryDelegationRequest {delegator_addr=1, validator_addr=2} ->
         # {delegation_response=1 {delegation=1 {delegator_address=1,
@@ -256,6 +286,7 @@ def _handlers(node) -> dict:
         "cosmos.tx.v1beta1.Service": {
             "BroadcastTx": broadcast_tx,
             "GetTx": get_tx,
+            "Simulate": simulate,
         },
         "cosmos.auth.v1beta1.Query": {"Account": query_account},
         "cosmos.bank.v1beta1.Query": {"Balance": query_balance},
@@ -267,6 +298,7 @@ def _handlers(node) -> dict:
         "celestia.blob.v1.Query": {"Params": query_blob_params},
         "cosmos.base.tendermint.v1beta1.Service": {
             "GetLatestBlock": get_latest_block,
+            "GetNodeInfo": get_node_info,
         },
     }
 
@@ -331,6 +363,8 @@ class GrpcNode:
             for name, path in {
                 "broadcast": "/cosmos.tx.v1beta1.Service/BroadcastTx",
                 "get_tx": "/cosmos.tx.v1beta1.Service/GetTx",
+                "simulate": "/cosmos.tx.v1beta1.Service/Simulate",
+                "node_info": "/cosmos.base.tendermint.v1beta1.Service/GetNodeInfo",
                 "account": "/cosmos.auth.v1beta1.Query/Account",
                 "balance": "/cosmos.bank.v1beta1.Query/Balance",
                 "validators": "/cosmos.staking.v1beta1.Query/Validators",
@@ -455,4 +489,23 @@ class GrpcNode:
         return {
             "gas_per_blob_byte": _field_int(p, 1),
             "gov_max_square_size": _field_int(p, 2),
+        }
+
+    def simulate(self, raw_tx: bytes) -> tuple[int, int, str]:
+        """(gas_wanted, gas_used, log) of simulating `raw_tx`; gas_used 0
+        with a log on failure."""
+        resp = self._call["simulate"](encode_bytes_field(2, raw_tx))
+        gas_info = _field_bytes(resp, 1)
+        if gas_info:
+            return _field_int(gas_info, 1), _field_int(gas_info, 2), ""
+        return 0, 0, _field_str(_field_bytes(resp, 2), 2)
+
+    def node_info(self) -> dict:
+        """{network, version, moniker} (GetNodeInfo, the cosmjs connect
+        handshake)."""
+        info = _field_bytes(self._call["node_info"](b""), 1)
+        return {
+            "network": _field_str(info, 4),
+            "version": _field_str(info, 5),
+            "moniker": _field_str(info, 7),
         }

@@ -119,6 +119,44 @@ class TestGrpcServices:
         assert len(props) == 1 and props[0]["id"] >= 1
         assert props[0]["status"] >= 1
 
+    def test_simulate_and_node_info(self, served):
+        """Simulate waives signatures and the gas limit, returns real
+        metered gas, and commits nothing; GetNodeInfo serves the cosmjs
+        connect handshake fields."""
+        from celestia_app_tpu.state.accounts import AuthKeeper
+        from celestia_app_tpu.tx.sign import Fee, build_and_sign
+
+        node, client = served
+        info = client.node_info()
+        assert info["network"] == node.chain_id and info["moniker"]
+
+        key = node.keys[0]
+        addr = key.public_key().address()
+        to = node.keys[1].public_key().address()
+        acct = AuthKeeper(node.app.cms.working).get_account(addr)
+        raw = build_and_sign(
+            [MsgSend(addr, to, (Coin("utia", 500),))], key, node.chain_id,
+            acct.account_number, acct.sequence,
+            Fee((Coin("utia", 20_000),), 200_000),
+        )
+        wanted, used, log = client.simulate(raw)
+        assert used > 0, log
+        assert used < 200_000
+        assert wanted == 200_000  # gas_wanted echoes the fee's limit
+        # Nothing committed: same sequence, balances untouched.
+        assert client.query_account(addr).sequence == acct.sequence
+        # A garbage tx simulates to a log, not an exception.
+        _, used_bad, log_bad = client.simulate(b"\x00garbage")
+        assert used_bad == 0 and log_bad
+        # cosmjs shape: gasLimit=0 placeholder fee must still estimate
+        # (the limit is waived in simulate).
+        raw0 = build_and_sign(
+            [MsgSend(addr, to, (Coin("utia", 500),))], key, node.chain_id,
+            acct.account_number, acct.sequence, Fee((), 0),
+        )
+        _, used0, log0 = client.simulate(raw0)
+        assert used0 > 0, log0
+
     def test_queries_race_the_proposer_loop(self, served):
         """Race tier: gRPC workers read state under node.lock while the
         proposer loop commits concurrently (the JSON-RPC plane's rpc_*
