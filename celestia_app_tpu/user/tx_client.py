@@ -79,6 +79,27 @@ class TxClient:
             resp = self._broadcast_pfb(blobs, address or self.default_address)
         return self._confirm(resp)
 
+    def simulate_gas(self, msgs: list, address: str | None = None) -> int | None:
+        """Gas for `msgs` via the node's Simulate endpoint: simulated
+        gas_used scaled by this client's gas_multiplier (the pkg/user
+        estimation recipe).  Returns None only when the node doesn't
+        expose simulation (in-process TestNode surfaces); a FAILED
+        simulation raises with the node's log — silently falling back on
+        a tx that would fail on-chain helps nobody.  The simulated tx
+        carries a placeholder zero fee (simulate waives the limit) and
+        does not bump the sequence."""
+        sim = getattr(self._node, "simulate", None)
+        if sim is None:
+            return None
+        with self._lock:
+            addr = address or self.default_address
+            raw = self.signer.create_tx(addr, msgs, 0, 0)
+            _, used, log = sim(raw)
+        if used == 0:
+            raise ValueError(f"simulation failed: {log}")
+        m = self.gas_multiplier
+        return used * m.numerator // m.denominator
+
     def submit_tx(self, msgs: list, address: str | None = None, gas: int = 200_000) -> TxResponse:
         with self._lock:
             resp = self._broadcast_msgs(msgs, address or self.default_address, gas)

@@ -130,6 +130,7 @@ class TestGrpcServices:
         info = client.node_info()
         assert info["network"] == node.chain_id and info["moniker"]
 
+        tx_client = TxClient(client, node.keys[:2])
         key = node.keys[0]
         addr = key.public_key().address()
         to = node.keys[1].public_key().address()
@@ -156,6 +157,19 @@ class TestGrpcServices:
         )
         _, used0, log0 = client.simulate(raw0)
         assert used0 > 0, log0
+        # TxClient rides the endpoint for estimation (scaled by its
+        # gas_multiplier) and leaves the sequence untouched.
+        est = tx_client.simulate_gas(
+            [MsgSend(addr, to, (Coin("utia", 500),))]
+        )
+        assert est is not None and est > used0
+        assert client.query_account(addr).sequence == acct.sequence
+        # A simulation that FAILS raises with the node's log instead of
+        # silently falling back.
+        with pytest.raises(ValueError, match="simulation failed"):
+            tx_client.simulate_gas(
+                [MsgSend(addr, to, (Coin("utia", 10**30),))]
+            )
 
     def test_queries_race_the_proposer_loop(self, served):
         """Race tier: gRPC workers read state under node.lock while the
