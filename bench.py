@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import json
 import os
+import socket
 import subprocess
 import sys
 import tempfile
@@ -601,8 +602,33 @@ def _terminate_gently(proc: subprocess.Popen, grace: float = 30.0) -> str:
         return "abandoned"
 
 
+def _tunnel_listening() -> bool:
+    """Fast pre-check of the loopback accelerator tunnel.
+
+    The axon backend dials 127.0.0.1 relay ports; when the relay process is
+    down, a JAX client retries the dead ports indefinitely (observed: the
+    probe hangs until its timeout). A plain TCP connect distinguishes
+    "relay down" (fail fast, no JAX client spawned at all) from "relay up
+    but wedged" (probe with timeout as before).
+    """
+    if os.environ.get("AXON_LOOPBACK_RELAY") != "1":
+        return True  # not tunnel-backed; nothing to pre-check
+    ports_env = os.environ.get("BENCH_RELAY_PORTS", "8082,8083")
+    for port in (int(p) for p in ports_env.split(",") if p.strip()):
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=2.0):
+                return True
+        except OSError:
+            continue
+    return False
+
+
 def _probe_backend(timeout: float) -> str | None:
     """Return the default env's platform name, or None if unusable."""
+    if not _tunnel_listening():
+        print("bench: accelerator tunnel not listening; skipping backend "
+              "probe (no JAX client spawned)", file=sys.stderr)
+        return None
     proc = subprocess.Popen(
         [sys.executable, "-c",
          "import jax; d = jax.devices(); print('PLATFORM=' + d[0].platform)"],
@@ -670,6 +696,20 @@ def main() -> None:
 
     errors: list[str] = []
     platform = _probe_backend(probe_timeout)
+    retry_ok = (os.environ.get("AXON_LOOPBACK_RELAY") == "1"
+                and budget - (time.monotonic() - t0) > 60 + probe_timeout + 300)
+    if platform is None and retry_ok and _tunnel_listening():
+        # Bounded retry: the relay is up but the first probe failed — a
+        # transient grant wedge sometimes clears after the stale client's
+        # session lapses. One retry after a cool-down, then give up (the
+        # relay is stdio-driven by the orchestrator; it cannot be reset
+        # from inside this sandbox).
+        errors.append("first backend probe failed with tunnel up; "
+                      "retrying once after 60s cool-down")
+        print("bench: tunnel up but probe failed; one retry in 60s",
+              file=sys.stderr)
+        time.sleep(60)
+        platform = _probe_backend(probe_timeout)
     env = dict(os.environ)
     if platform is None:
         errors.append("default backend unusable; fell back to scrubbed CPU env")
