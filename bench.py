@@ -614,7 +614,13 @@ def _tunnel_listening() -> bool:
     if os.environ.get("AXON_LOOPBACK_RELAY") != "1":
         return True  # not tunnel-backed; nothing to pre-check
     ports_env = os.environ.get("BENCH_RELAY_PORTS", "8082,8083")
-    for port in (int(p) for p in ports_env.split(",") if p.strip()):
+    try:
+        ports = [int(p) for p in ports_env.split(",") if p.strip()]
+    except ValueError:
+        print(f"bench: ignoring malformed BENCH_RELAY_PORTS={ports_env!r}",
+              file=sys.stderr)
+        ports = [8082, 8083]
+    for port in ports:
         try:
             with socket.create_connection(("127.0.0.1", port), timeout=2.0):
                 return True
