@@ -76,7 +76,9 @@ def _unhex(s: str) -> bytes:
 def _evidence_field(ev: dict, k: int) -> int:
     """The GF(2^m) the evidence was produced in. Defaults to leopard's own
     width rule (ff8 up to 256 shards, ff16 above) when the key is absent."""
-    m = int(ev.get("field", 8 if 2 * k <= 256 else 16))
+    from celestia_app_tpu.gf.rs import field_for_width
+
+    m = int(ev.get("field", field_for_width(2 * k).m))
     if m not in (8, 16):
         raise ValueError(f"field must be 8 or 16, got {m}")
     if 2 * k > (1 << m):
@@ -148,28 +150,46 @@ def check_encode_vectors(ev: dict) -> dict:
     return out
 
 
-def _candidate_bases(m: int) -> "itertools.product":
-    """Every Cantor chain b_0=1, b_{j+1} in {r, r+1} with r^2+r=b_j.
+def _candidate_bases(m: int, r: int):
+    """Every DISTINCT length-r Cantor chain prefix b_0=1, b_{j+1} in
+    {x, x+1} with x^2+x=b_j, in GF(2^m).
 
-    2^(m-1) chains: 128 for GF(2^8). For GF(2^16) the full 32768-chain sweep
-    at small k is still bounded (the tool caps total work below).
+    Only the first r basis elements touch a 2k-point grid (r = ceil(log2
+    2k)), so enumerating full length-m chains would re-test one effective
+    prefix 2^(m-r) times; 2^(r-1) distinct prefixes is the whole space.
     """
     from celestia_app_tpu.gf.leopard import _solve_artin_schreier, leopard_field
 
     f = leopard_field(m)
 
     def chains(prefix: tuple[int, ...]):
-        if len(prefix) == m:
+        if len(prefix) == r:
             yield prefix
             return
-        r = _solve_artin_schreier(f, prefix[-1])
-        if r < 0:
+        x = _solve_artin_schreier(f, prefix[-1])
+        if x < 0:
             return
-        for cand in (r, r ^ 1):
+        for cand in (x, x ^ 1):
             if cand != 0:
                 yield from chains(prefix + (cand,))
 
     return chains((1,))
+
+
+def _extend_chain(m: int, prefix: tuple[int, ...]) -> tuple[int, ...]:
+    """Deterministically continue a chain prefix to full length m (smallest
+    root each step) — the grid never sees elements past the prefix, so any
+    valid continuation serves for a FORCED_CANTOR_BASIS pin."""
+    from celestia_app_tpu.gf.leopard import _solve_artin_schreier, leopard_field
+
+    f = leopard_field(m)
+    chain = list(prefix)
+    while len(chain) < m:
+        x = _solve_artin_schreier(f, chain[-1])
+        if x <= 0:
+            break  # chain cannot continue; a short pin still fixes the grid
+        chain.append(x)
+    return tuple(chain)
 
 
 def _search_leopard_constants(
@@ -187,7 +207,7 @@ def _search_leopard_constants(
     tried = 0
     budget = int(ev.get("search_budget", 4096))
     r = max(1, (2 * k - 1).bit_length())
-    for basis in _candidate_bases(m):
+    for basis in _candidate_bases(m, r):
         for bitrev, data_low in itertools.product((False, True), repeat=2):
             tried += 1
             if tried > budget:
@@ -211,12 +231,14 @@ def _search_leopard_constants(
             except Exception:
                 continue
             if np.array_equal(f.matmul(G, sym), want):
+                full = _extend_chain(m, basis)
                 return {"hit": True, "tried": tried,
-                        "cantor_basis": [int(b) for b in basis[:r]],
-                        "full_chain": [int(b) for b in basis],
+                        "cantor_basis": [int(b) for b in basis],
+                        "full_chain": [int(b) for b in full],
                         "index_bit_reversed": bitrev, "data_half": "low" if data_low else "high",
                         "pin": f"gf/leopard.py: FORCED_CANTOR_BASIS[{m}] = "
-                               f"{tuple(int(b) for b in basis)}"
+                               f"{tuple(int(b) for b in full)}  "
+                               f"# first {r} elements evidence-determined"
                                + (" + flip index bit order" if bitrev else "")
                                + (" + data on LOW grid half" if data_low else "")}
     return {"hit": False, "tried": tried, "exhausted": True,
@@ -304,12 +326,13 @@ def selftest() -> dict:
         chain[j] = leo._solve_artin_schreier(f8, chain[j - 1])
         assert chain[j] > 0, chain
     foreign = tuple(chain)
+    orig_pin = leo.FORCED_CANTOR_BASIS[8]
     leo.FORCED_CANTOR_BASIS[8] = foreign
     leo.cantor_basis.cache_clear()
     try:
         parity2 = RSCodec(k, "leopard").encode(data)
     finally:
-        leo.FORCED_CANTOR_BASIS[8] = None
+        leo.FORCED_CANTOR_BASIS[8] = orig_pin
         leo.cantor_basis.cache_clear()
     ev2 = dict(ev, parity=[p.tobytes().hex() for p in parity2])
     got2 = check_encode_vectors(ev2)
