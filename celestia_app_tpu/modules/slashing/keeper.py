@@ -113,6 +113,14 @@ class SlashingKeeper:
         raw = self.store.get(_INFO_PREFIX + validator.encode())
         return SigningInfo.unmarshal(raw) if raw else SigningInfo()
 
+    def signing_infos(self) -> list[tuple[str, SigningInfo]]:
+        """Every recorded (validator, SigningInfo), address-ordered — the
+        sdk SigningInfos query's walk of the info prefix."""
+        return [
+            (key[len(_INFO_PREFIX):].decode(), SigningInfo.unmarshal(raw))
+            for key, raw in self.store.iterate(_INFO_PREFIX)
+        ]
+
     def _set_info(self, validator: str, info: SigningInfo) -> None:
         self.store.set(_INFO_PREFIX + validator.encode(), info.marshal())
 

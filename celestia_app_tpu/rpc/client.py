@@ -94,6 +94,15 @@ class RemoteNode:
             return None
         return (res["height"], res["code"], res["log"])
 
+    def wait_tx(self, tx_hash: bytes, timeout_s: float = 30.0):
+        """Subscription confirm: one long-poll call that parks server-side
+        on the commit event (rpc_subscribe_tx) instead of hammering
+        tx_status; (height, code, log) or None on timeout."""
+        res = self.call("subscribe_tx", hash=tx_hash.hex(), timeout_s=timeout_s)
+        if res is None:
+            return None
+        return (res["height"], res["code"], res["log"])
+
     def produce_block(self):
         """Trigger one block on the served node (dev/test surface); returns
         (block-info dict, results) shaped like TestNode.produce_block."""

@@ -7,12 +7,25 @@ shapes over real gRPC (grpcio, generic byte-level handlers — no codegen;
 message codecs are hand-rolled on encoding/proto like the rest of the wire
 layer, protoc-cross-validated by tests/test_proto_wire.py):
 
-  cosmos.tx.v1beta1.Service/BroadcastTx            submit a signed TxRaw
-  cosmos.tx.v1beta1.Service/GetTx                  confirmation lookup
+  cosmos.tx.v1beta1.Service/BroadcastTx|GetTx|Simulate    tx lifecycle
   cosmos.auth.v1beta1.Query/Account                number/sequence for signing
   cosmos.bank.v1beta1.Query/Balance                spot balance
-  cosmos.staking.v1beta1.Query/Validators          bonded set (txsim stake)
-  cosmos.base.tendermint.v1beta1.Service/GetLatestBlock   chain id + height
+  cosmos.staking.v1beta1.Query/Validators|Delegation      bonded set (paged)
+  cosmos.gov.v1beta1.Query/Proposals               paged proposal list
+  cosmos.distribution.v1beta1.Query/DelegationRewards|CommunityPool
+  cosmos.slashing.v1beta1.Query/SigningInfo|SigningInfos|Params
+  celestia.blob.v1.Query/Params                    blob module params
+  celestia.minfee.v1.Query/NetworkMinGasPrice      network fee floor
+  celestia.signal.v1.Query/VersionTally            upgrade signal tally
+  celestia.qgb.v1.Query/AttestationRequestByNonce|LatestAttestationNonce|
+      EVMAddress                                   blobstream relayer reads
+  cosmos.base.tendermint.v1beta1.Service/GetLatestBlock|GetNodeInfo
+  celestia.tpu.subscription.v1.Subscription/WaitTx long-poll tx commit
+      (this framework's analog of Tendermint's websocket /subscribe —
+      the reference serves that from celestia-core RPC, not gRPC)
+
+List queries speak cosmos.base.query.v1beta1 PageRequest/PageResponse
+(offset/limit/count_total/reverse; next_key is an opaque offset cursor).
 
 `GrpcNode` is the client half: it implements the node surface TxClient
 consumes (broadcast / query_account / tx_status / validators / chain_id),
@@ -95,7 +108,78 @@ def _field_int(raw: bytes, num: int) -> int:
     return 0
 
 
+# --- pagination (cosmos.base.query.v1beta1) --------------------------------
+
+
+def _parse_page_request(req: bytes, field_num: int) -> dict:
+    """PageRequest {key=1, offset=2, limit=3, count_total=4, reverse=5}
+    embedded at `field_num` of the enclosing query request. The `key`
+    cursor is this plane's next_key from the previous page (an opaque
+    offset, as the sdk's store keys are opaque to clients)."""
+    page = _field_bytes(req, field_num)
+    out = {"offset": 0, "limit": 0, "count_total": False, "reverse": False}
+    if not page:
+        return out
+    for n, wt, val in decode_fields(page):
+        if n == 1 and wt == WIRE_LEN and val:
+            try:
+                out["offset"] = int(val.decode())
+            except ValueError:
+                pass
+        elif n == 2 and wt == WIRE_VARINT:
+            out["offset"] = val
+        elif n == 3 and wt == WIRE_VARINT:
+            out["limit"] = val
+        elif n == 4 and wt == WIRE_VARINT:
+            out["count_total"] = bool(val)
+        elif n == 5 and wt == WIRE_VARINT:
+            out["reverse"] = bool(val)
+    return out
+
+
+def _paginate(items: list, page: dict) -> tuple[list, bytes]:
+    """Apply a parsed PageRequest; returns (page_items, PageResponse bytes
+    {next_key=1, total=2})."""
+    if page["reverse"]:
+        items = list(reversed(items))
+    total = len(items)
+    start = min(max(page["offset"], 0), total)  # clamp hostile cursors
+    end = total if not page["limit"] else min(start + page["limit"], total)
+    resp = b""
+    if end < total:
+        resp += encode_bytes_field(1, str(end).encode())
+    if page["count_total"]:
+        resp += encode_varint_field(2, total)
+    return items[start:end], resp
+
+
+def encode_page_request(offset: int = 0, limit: int = 0,
+                        count_total: bool = False, reverse: bool = False,
+                        key: bytes = b"") -> bytes:
+    out = b""
+    if key:
+        out += encode_bytes_field(1, key)
+    if offset:
+        out += encode_varint_field(2, offset)
+    if limit:
+        out += encode_varint_field(3, limit)
+    if count_total:
+        out += encode_varint_field(4, 1)
+    if reverse:
+        out += encode_varint_field(5, 1)
+    return out
+
+
+def _parse_page_response(raw: bytes) -> dict:
+    return {"next_key": _field_bytes(raw, 1), "total": _field_int(raw, 2)}
+
+
 # --- server ----------------------------------------------------------------
+
+# Cap on concurrently PARKED WaitTx long-polls (see wait_tx handler); kept
+# below serve_grpc's worker-pool size so subscriptions can never starve
+# the unary queries sharing the pool.
+_WAIT_TX_MAX_PARKED = 8
 
 
 def _handlers(node) -> dict:
@@ -167,17 +251,20 @@ def _handlers(node) -> dict:
         return encode_bytes_field(1, coin)
 
     def query_validators(req: bytes) -> bytes:
-        # QueryValidatorsRequest -> {validators=1 repeated Validator
-        # {operator_address=1, tokens=5}} — the fields txsim's stake
-        # sequence reads.
+        # QueryValidatorsRequest {status=1, pagination=2} -> {validators=1
+        # repeated Validator {operator_address=1, tokens=5}, pagination=2}
+        # — the fields txsim's stake sequence reads, paged.
         with node_lock():
             vals = node.validators()
+        page_vals, page_resp = _paginate(vals, _parse_page_request(req, 2))
         out = b""
-        for v in vals:
+        for v in page_vals:
             val = encode_bytes_field(
                 1, v["address"].encode()
             ) + encode_bytes_field(5, str(v.get("power", 0)).encode())
             out += encode_bytes_field(1, val)
+        if page_resp:
+            out += encode_bytes_field(2, page_resp)
         return out
 
     def get_latest_block(req: bytes) -> bytes:
@@ -264,13 +351,17 @@ def _handlers(node) -> dict:
             props = GovKeeper(
                 store, StakingKeeper(store), BankKeeper(store)
             ).proposals()
+        # gov v1beta1 QueryProposalsRequest carries pagination at field 4.
+        page_props, page_resp = _paginate(props, _parse_page_request(req, 4))
         out = b""
-        for p in props:
+        for p in page_props:
             out += encode_bytes_field(
                 1,
                 encode_varint_field(1, p.pid)
                 + encode_varint_field(3, int(p.status)),
             )
+        if page_resp:
+            out += encode_bytes_field(2, page_resp)
         return out
 
     def query_blob_params(req: bytes) -> bytes:
@@ -281,6 +372,205 @@ def _handlers(node) -> dict:
                 1, node.app.gas_per_blob_byte
             ) + encode_varint_field(2, node.app.gov_max_square_size)
         return encode_bytes_field(1, params)
+
+    def query_min_gas_price(req: bytes) -> bytes:
+        # celestia.minfee.v1 QueryNetworkMinGasPriceResponse
+        # {network_min_gas_price=1 Dec} (x/minfee/query.proto). Dec rides
+        # the wire as the 10^18-scaled integer's digits (gogoproto Dec).
+        from celestia_app_tpu.modules.minfee import MinFeeKeeper
+
+        with node_lock():
+            price = MinFeeKeeper(node.app.cms.working).network_min_gas_price()
+        return encode_bytes_field(1, str(price.raw).encode())
+
+    def query_version_tally(req: bytes) -> bytes:
+        # celestia.signal.v1 QueryVersionTallyRequest {version=1} ->
+        # {voting_power=1, threshold_power=2, total_voting_power=3}
+        # (x/signal/query.proto).
+        from celestia_app_tpu.modules.signal.keeper import SignalKeeper
+        from celestia_app_tpu.state.staking import StakingKeeper
+
+        version = _field_int(req, 1)
+        with node_lock():
+            store = node.app.cms.working
+            power, threshold, total = SignalKeeper(
+                store, StakingKeeper(store)
+            ).version_tally(version)
+        return (
+            encode_varint_field(1, power)
+            + encode_varint_field(2, threshold)
+            + encode_varint_field(3, total)
+        )
+
+    def _blobstream_keeper(store):
+        from celestia_app_tpu.modules.blobstream.keeper import BlobstreamKeeper
+        from celestia_app_tpu.state.staking import StakingKeeper
+
+        return BlobstreamKeeper(store, StakingKeeper(store))
+
+    def query_attestation_by_nonce(req: bytes) -> bytes:
+        # celestia.qgb.v1 QueryAttestationRequestByNonceRequest {nonce=1}
+        # -> {attestation=1 Any{type_url=1, value=2}}; empty when unknown.
+        nonce = _field_int(req, 1)
+        with node_lock():
+            att = _blobstream_keeper(node.app.cms.working).get_attestation(nonce)
+        if att is None:
+            return b""
+        type_url = ("/celestia.qgb.v1.Valset" if att.KIND == 1
+                    else "/celestia.qgb.v1.DataCommitment")
+        any_att = encode_bytes_field(1, type_url.encode()) + encode_bytes_field(
+            2, att.marshal()
+        )
+        return encode_bytes_field(1, any_att)
+
+    def query_latest_attestation_nonce(req: bytes) -> bytes:
+        # celestia.qgb.v1 QueryLatestAttestationNonceResponse {nonce=1}.
+        with node_lock():
+            nonce = _blobstream_keeper(node.app.cms.working).latest_nonce()
+        return encode_varint_field(1, nonce) if nonce else b""
+
+    def query_evm_address(req: bytes) -> bytes:
+        # celestia.qgb.v1 QueryEVMAddressRequest {validator_address=1} ->
+        # {evm_address=1}; empty when unregistered.
+        validator = _field_str(req, 1)
+        with node_lock():
+            evm = _blobstream_keeper(node.app.cms.working).evm_address(validator)
+        return encode_bytes_field(1, evm.encode()) if evm else b""
+
+    def query_delegation_rewards(req: bytes) -> bytes:
+        # cosmos.distribution.v1beta1 QueryDelegationRewardsRequest
+        # {delegator_address=1, validator_address=2} -> {rewards=1 repeated
+        # DecCoin {denom=1, amount=2 Dec}}.
+        from celestia_app_tpu.modules.distribution.keeper import (
+            DistributionKeeper,
+        )
+        from celestia_app_tpu.state.staking import StakingKeeper
+
+        delegator = _field_str(req, 1)
+        validator = _field_str(req, 2)
+        with node_lock():
+            store = node.app.cms.working
+            pending = DistributionKeeper(store).pending_rewards(
+                StakingKeeper(store), delegator, validator
+            )
+        if not pending:
+            return b""
+        coin = encode_bytes_field(1, b"utia") + encode_bytes_field(
+            2, str(pending * 10**18).encode()
+        )
+        return encode_bytes_field(1, coin)
+
+    def query_community_pool(req: bytes) -> bytes:
+        # QueryCommunityPoolResponse {pool=1 repeated DecCoin}.
+        from celestia_app_tpu.modules.distribution.keeper import (
+            DistributionKeeper,
+        )
+
+        with node_lock():
+            pool = DistributionKeeper(node.app.cms.working).community_pool()
+        if not pool.raw:
+            return b""
+        coin = encode_bytes_field(1, b"utia") + encode_bytes_field(
+            2, str(pool.raw).encode()
+        )
+        return encode_bytes_field(1, coin)
+
+    def _signing_info_msg(addr: str, info) -> bytes:
+        # cosmos.slashing.v1beta1 ValidatorSigningInfo {address=1,
+        # index_offset=3, jailed_until=4 Timestamp{seconds=1, nanos=2},
+        # tombstoned=5, missed_blocks_counter=6}.
+        out = encode_bytes_field(1, addr.encode())
+        if info.index_offset:
+            out += encode_varint_field(3, info.index_offset)
+        if info.jailed_until_ns:
+            ts = encode_varint_field(1, info.jailed_until_ns // 10**9)
+            nanos = info.jailed_until_ns % 10**9
+            if nanos:
+                ts += encode_varint_field(2, nanos)
+            out += encode_bytes_field(4, ts)
+        if info.tombstoned:
+            out += encode_varint_field(5, 1)
+        if info.missed_blocks:
+            out += encode_varint_field(6, info.missed_blocks)
+        return out
+
+    def query_signing_info(req: bytes) -> bytes:
+        # QuerySigningInfoRequest {cons_address=1} -> {val_signing_info=1}.
+        from celestia_app_tpu.modules.slashing.keeper import SlashingKeeper
+
+        addr = _field_str(req, 1)
+        with node_lock():
+            info = SlashingKeeper(node.app.cms.working).signing_info(addr)
+        return encode_bytes_field(1, _signing_info_msg(addr, info))
+
+    def query_signing_infos(req: bytes) -> bytes:
+        # QuerySigningInfosRequest {pagination=1} -> {info=1 repeated,
+        # pagination=2}.
+        from celestia_app_tpu.modules.slashing.keeper import SlashingKeeper
+
+        with node_lock():
+            infos = SlashingKeeper(node.app.cms.working).signing_infos()
+        page_infos, page_resp = _paginate(infos, _parse_page_request(req, 1))
+        out = b""
+        for addr, info in page_infos:
+            out += encode_bytes_field(1, _signing_info_msg(addr, info))
+        if page_resp:
+            out += encode_bytes_field(2, page_resp)
+        return out
+
+    def query_slashing_params(req: bytes) -> bytes:
+        # QueryParamsResponse {params=1 {signed_blocks_window=1,
+        # min_signed_per_window=2 Dec, downtime_jail_duration=3
+        # Duration{seconds=1, nanos=2}, slash_fraction_double_sign=4 Dec,
+        # slash_fraction_downtime=5 Dec}}.
+        from celestia_app_tpu.modules.slashing.keeper import SlashingKeeper
+
+        with node_lock():
+            p = SlashingKeeper(node.app.cms.working).params()
+        dur = encode_varint_field(1, p.downtime_jail_duration_ns // 10**9)
+        nanos = p.downtime_jail_duration_ns % 10**9
+        if nanos:
+            dur += encode_varint_field(2, nanos)
+        params = (
+            encode_varint_field(1, p.signed_blocks_window)
+            + encode_bytes_field(2, str(p.min_signed_per_window.raw).encode())
+            + encode_bytes_field(3, dur)
+            + encode_bytes_field(4, str(p.slash_fraction_double_sign.raw).encode())
+            + encode_bytes_field(5, str(p.slash_fraction_downtime.raw).encode())
+        )
+        return encode_bytes_field(1, params)
+
+    # Parked WaitTx waiters are capped below the worker-pool size so
+    # long-polls can never starve the unary queries sharing the pool;
+    # past the cap a waiter degrades to an immediate status check (the
+    # client sees a fast not-yet-committed answer and may re-subscribe).
+    import threading
+
+    wait_slots = threading.Semaphore(_WAIT_TX_MAX_PARKED)
+
+    def wait_tx(req: bytes) -> bytes:
+        # Subscription service (this framework's long-poll analog of the
+        # Tendermint websocket /subscribe tm.event='Tx'; the reference
+        # serves that from celestia-core's RPC, not gRPC). Request
+        # {hash=1 hex, timeout_ms=2}; response {tx_response=2 TxResponse}
+        # mirroring GetTxResponse so clients share parsing; empty on
+        # timeout. Deliberately NOT under node_lock — the wait parks on
+        # the commit event and would deadlock the proposer loop.
+        txhash = _field_str(req, 1)
+        timeout_ms = _field_int(req, 2) or 25_000
+        if wait_slots.acquire(blocking=False):
+            try:
+                status = node.wait_tx(
+                    bytes.fromhex(txhash), min(timeout_ms, 110_000) / 1000.0
+                )
+            finally:
+                wait_slots.release()
+        else:  # all park slots busy: degrade to a poll-style check
+            status = node.tx_status(bytes.fromhex(txhash))
+        if status is None:
+            return b""
+        height, code, log = status
+        return encode_bytes_field(2, _tx_response(height, txhash, code, log))
 
     return {
         "cosmos.tx.v1beta1.Service": {
@@ -296,10 +586,29 @@ def _handlers(node) -> dict:
         },
         "cosmos.gov.v1beta1.Query": {"Proposals": query_proposals},
         "celestia.blob.v1.Query": {"Params": query_blob_params},
+        "celestia.minfee.v1.Query": {
+            "NetworkMinGasPrice": query_min_gas_price,
+        },
+        "celestia.signal.v1.Query": {"VersionTally": query_version_tally},
+        "celestia.qgb.v1.Query": {
+            "AttestationRequestByNonce": query_attestation_by_nonce,
+            "LatestAttestationNonce": query_latest_attestation_nonce,
+            "EVMAddress": query_evm_address,
+        },
+        "cosmos.distribution.v1beta1.Query": {
+            "DelegationRewards": query_delegation_rewards,
+            "CommunityPool": query_community_pool,
+        },
+        "cosmos.slashing.v1beta1.Query": {
+            "SigningInfo": query_signing_info,
+            "SigningInfos": query_signing_infos,
+            "Params": query_slashing_params,
+        },
         "cosmos.base.tendermint.v1beta1.Service": {
             "GetLatestBlock": get_latest_block,
             "GetNodeInfo": get_node_info,
         },
+        "celestia.tpu.subscription.v1.Subscription": {"WaitTx": wait_tx},
     }
 
 
@@ -316,7 +625,7 @@ class GrpcPlane:
         self.server.stop(grace)
 
 
-def serve_grpc(node, port: int = 0, max_workers: int = 8) -> GrpcPlane:
+def serve_grpc(node, port: int = 0, max_workers: int = 16) -> GrpcPlane:
     """Start the gRPC plane for a node; returns the live server + port."""
     import grpc
 
@@ -372,6 +681,19 @@ class GrpcNode:
                 "proposals": "/cosmos.gov.v1beta1.Query/Proposals",
                 "blob_params": "/celestia.blob.v1.Query/Params",
                 "latest": "/cosmos.base.tendermint.v1beta1.Service/GetLatestBlock",
+                "min_gas_price": "/celestia.minfee.v1.Query/NetworkMinGasPrice",
+                "version_tally": "/celestia.signal.v1.Query/VersionTally",
+                "attestation": "/celestia.qgb.v1.Query/AttestationRequestByNonce",
+                "latest_nonce": "/celestia.qgb.v1.Query/LatestAttestationNonce",
+                "evm_address": "/celestia.qgb.v1.Query/EVMAddress",
+                "delegation_rewards":
+                    "/cosmos.distribution.v1beta1.Query/DelegationRewards",
+                "community_pool":
+                    "/cosmos.distribution.v1beta1.Query/CommunityPool",
+                "signing_info": "/cosmos.slashing.v1beta1.Query/SigningInfo",
+                "signing_infos": "/cosmos.slashing.v1beta1.Query/SigningInfos",
+                "slashing_params": "/cosmos.slashing.v1beta1.Query/Params",
+                "wait_tx": "/celestia.tpu.subscription.v1.Subscription/WaitTx",
             }.items()
         }
 
@@ -508,4 +830,159 @@ class GrpcNode:
             "network": _field_str(info, 4),
             "version": _field_str(info, 5),
             "moniker": _field_str(info, 7),
+        }
+
+    def wait_tx(self, tx_hash: bytes, timeout_s: float = 30.0):
+        """Subscription confirm: parks server-side on the commit event
+        (WaitTx long-poll) instead of polling GetTx; (height, code, log)
+        or None on timeout. TxClient._confirm rides this automatically.
+
+        Re-subscribes while deadline remains: when the server's park slots
+        are exhausted it degrades to an immediate status check, so a
+        single call returning empty does not mean the timeout elapsed."""
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            req = encode_bytes_field(1, tx_hash.hex().upper().encode())
+            req += encode_varint_field(2, int(remaining * 1000))
+            t0 = time.monotonic()
+            resp = self._call["wait_tx"](req, timeout=remaining + 10.0)
+            tr = _field_bytes(resp, 2)
+            if tr:
+                parsed = _parse_tx_response(tr)
+                return parsed["height"], parsed["code"], parsed["raw_log"]
+            if time.monotonic() - t0 < 0.5:
+                time.sleep(0.2)  # degraded to poll mode: pace re-subscribes
+
+    def validators_page(self, offset: int = 0, limit: int = 0,
+                        count_total: bool = False) -> tuple[list[dict], dict]:
+        """One page of the validator set; returns (validators, {next_key,
+        total})."""
+        req = encode_bytes_field(
+            2, encode_page_request(offset, limit, count_total)
+        )
+        resp = self._call["validators"](req)
+        out = []
+        for num, wt, val in decode_fields(resp):
+            if num == 1 and wt == WIRE_LEN:
+                out.append({
+                    "address": _field_str(val, 1),
+                    "power": int(_field_str(val, 5) or 0),
+                })
+        return out, _parse_page_response(_field_bytes(resp, 2))
+
+    def proposals_page(self, offset: int = 0, limit: int = 0,
+                       count_total: bool = False) -> tuple[list[dict], dict]:
+        """One page of proposals; returns (proposals, {next_key, total})."""
+        req = encode_bytes_field(
+            4, encode_page_request(offset, limit, count_total)
+        )
+        resp = self._call["proposals"](req)
+        out = []
+        for num, wt, val in decode_fields(resp):
+            if num == 1 and wt == WIRE_LEN:
+                out.append({"id": _field_int(val, 1),
+                            "status": _field_int(val, 3)})
+        return out, _parse_page_response(_field_bytes(resp, 2))
+
+    def network_min_gas_price(self) -> int:
+        """The x/minfee network min gas price as the 10^18-scaled raw
+        integer (gogoproto Dec wire form)."""
+        return int(_field_str(self._call["min_gas_price"](b""), 1) or 0)
+
+    def version_tally(self, version: int) -> dict:
+        """{voting_power, threshold_power, total_voting_power} for an
+        app version (x/signal)."""
+        resp = self._call["version_tally"](encode_varint_field(1, version))
+        return {
+            "voting_power": _field_int(resp, 1),
+            "threshold_power": _field_int(resp, 2),
+            "total_voting_power": _field_int(resp, 3),
+        }
+
+    def attestation(self, nonce: int):
+        """The blobstream attestation at `nonce` (Valset or
+        DataCommitment), or None."""
+        from celestia_app_tpu.modules.blobstream.keeper import (
+            _unmarshal_attestation,
+        )
+
+        resp = self._call["attestation"](encode_varint_field(1, nonce))
+        any_att = _field_bytes(resp, 1)
+        if not any_att:
+            return None
+        return _unmarshal_attestation(_field_bytes(any_att, 2))
+
+    def latest_attestation_nonce(self) -> int:
+        return _field_int(self._call["latest_nonce"](b""), 1)
+
+    def evm_address(self, validator: str) -> str | None:
+        resp = self._call["evm_address"](
+            encode_bytes_field(1, validator.encode())
+        )
+        addr = _field_str(resp, 1)
+        return addr or None
+
+    def delegation_rewards(self, delegator: str, validator: str) -> int:
+        """Pending utia rewards of (delegator, validator); whole-utia
+        floor of the Dec amount."""
+        resp = self._call["delegation_rewards"](
+            encode_bytes_field(1, delegator.encode())
+            + encode_bytes_field(2, validator.encode())
+        )
+        coin = _field_bytes(resp, 1)
+        if not coin:
+            return 0
+        return int(_field_str(coin, 2) or 0) // 10**18
+
+    def community_pool(self) -> int:
+        """Community pool balance as the 10^18-scaled raw integer."""
+        coin = _field_bytes(self._call["community_pool"](b""), 1)
+        return int(_field_str(coin, 2) or 0)
+
+    def signing_info(self, validator: str) -> dict:
+        resp = self._call["signing_info"](
+            encode_bytes_field(1, validator.encode())
+        )
+        return self._parse_signing_info(_field_bytes(resp, 1))
+
+    def signing_infos(self, offset: int = 0, limit: int = 0,
+                      count_total: bool = False) -> tuple[list[dict], dict]:
+        req = encode_bytes_field(
+            1, encode_page_request(offset, limit, count_total)
+        )
+        resp = self._call["signing_infos"](req)
+        infos = [
+            self._parse_signing_info(val)
+            for num, wt, val in decode_fields(resp)
+            if num == 1 and wt == WIRE_LEN
+        ]
+        return infos, _parse_page_response(_field_bytes(resp, 2))
+
+    @staticmethod
+    def _parse_signing_info(raw: bytes) -> dict:
+        ts = _field_bytes(raw, 4)
+        jailed_until_ns = _field_int(ts, 1) * 10**9 + _field_int(ts, 2)
+        return {
+            "address": _field_str(raw, 1),
+            "index_offset": _field_int(raw, 3),
+            "jailed_until_ns": jailed_until_ns,
+            "tombstoned": bool(_field_int(raw, 5)),
+            "missed_blocks": _field_int(raw, 6),
+        }
+
+    def slashing_params(self) -> dict:
+        p = _field_bytes(self._call["slashing_params"](b""), 1)
+        dur = _field_bytes(p, 3)
+        return {
+            "signed_blocks_window": _field_int(p, 1),
+            "min_signed_per_window": int(_field_str(p, 2) or 0),
+            "downtime_jail_duration_ns":
+                _field_int(dur, 1) * 10**9 + _field_int(dur, 2),
+            "slash_fraction_double_sign": int(_field_str(p, 4) or 0),
+            "slash_fraction_downtime": int(_field_str(p, 5) or 0),
         }

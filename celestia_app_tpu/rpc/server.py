@@ -577,6 +577,21 @@ class ServingNode(TestNode):
             return None
         return {"height": st[0], "code": st[1], "log": st[2]}
 
+    def rpc_subscribe_tx(self, hash: str, timeout_s: float = 25.0) -> dict | None:
+        """Long-poll subscription: block until `hash` commits (or timeout).
+
+        The Tendermint websocket `/subscribe tm.event='Tx'` analog over
+        JSON-RPC: the server parks the request on the node's commit event
+        — one wakeup per block, no client-side polling. Deliberately NOT
+        under self.lock (the wait would deadlock the proposer loop);
+        tx_index reads are safe against concurrent commit.
+        """
+        timeout_s = min(float(timeout_s), 110.0)  # stay under socket timeout
+        st = self.wait_tx(bytes.fromhex(hash), timeout_s)
+        if st is None:
+            return None
+        return {"height": st[0], "code": st[1], "log": st[2]}
+
     def rpc_account(self, address: str) -> dict | None:
         with self.lock:
             acc = self.query_account(address)

@@ -77,11 +77,18 @@ class TestNode:
             self.keys = keys if keys is not None else funded_keys(4)
             self.app = App(node_min_gas_price=Dec.from_str("0.000001"))
             self.app.init_chain(genesis or deterministic_genesis(self.keys))
+        import threading
+
         self.mempool = PriorityMempool()
         self.blocks: list[BlockData] = []
         self.block_times: dict[int, int] = {}  # height -> block time
         # tx hash -> (height, code, log): the RPC `tx` query's index.
         self.tx_index: dict[bytes, tuple[int, int, str]] = {}
+        # Event bus: commit-time notification for tx/block subscribers —
+        # the in-process analog of Tendermint's websocket /subscribe
+        # (tm.event='Tx'): long-poll waiters block here instead of polling
+        # the index.
+        self.commit_event = threading.Condition()
 
     @property
     def chain_id(self) -> str:
@@ -164,6 +171,8 @@ class TestNode:
 
         for raw, res in zip(txs, results):
             self.tx_index[tx_hash(raw)] = (height, res.code, res.log)
+        with self.commit_event:
+            self.commit_event.notify_all()
 
     def query_account(self, address: str):
         """(account_number, sequence, pubkey) or None — the auth query."""
@@ -174,6 +183,25 @@ class TestNode:
     def tx_status(self, tx_hash: bytes) -> tuple[int, int, str] | None:
         """(height, code, log) for a committed tx, None if unknown."""
         return self.tx_index.get(tx_hash)
+
+    def wait_tx(self, tx_hash: bytes, timeout_s: float = 30.0):
+        """Block until `tx_hash` is committed; (height, code, log) or None.
+
+        The subscription path (Tendermint /subscribe tm.event='Tx' analog):
+        waiters sleep on the commit event instead of polling tx_status in a
+        loop — one wakeup per committed block, zero queries in between.
+        """
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        with self.commit_event:
+            while True:
+                status = self.tx_index.get(tx_hash)
+                if status is not None:
+                    return status
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self.commit_event.wait(remaining):
+                    return self.tx_index.get(tx_hash)
 
     def validators(self) -> list[dict]:
         """The validator set, shaped like RemoteNode.validators() so

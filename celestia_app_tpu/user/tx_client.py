@@ -173,6 +173,16 @@ class TxClient:
             return TxResponse(
                 height=self._node.app.height, code=0, gas_wanted=resp.gas_wanted
             )
+        if hasattr(self._node, "wait_tx"):
+            # Subscription path: one call that parks on the node's commit
+            # event (the /subscribe analog) — no polling.
+            status = self._node.wait_tx(resp.tx_hash, timeout_s)
+            if status is None:
+                raise TxSubmissionError(-1, "timed out waiting for tx inclusion")
+            height, code, log = status
+            if code != 0:
+                raise TxSubmissionError(code, log)
+            return TxResponse(height=height, code=0, gas_wanted=resp.gas_wanted)
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             status = self._node.tx_status(resp.tx_hash)

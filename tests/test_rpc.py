@@ -232,3 +232,35 @@ class TestServedTxsim:
             # validator proposed at least once by height 4.
         finally:
             net.stop()
+
+
+class TestSubscribeTx:
+    """JSON-RPC long-poll subscription (the websocket /subscribe analog):
+    RemoteNode.wait_tx parks server-side on the commit event."""
+
+    def test_subscribe_roundtrip_and_timeout(self, served, remote):
+        import time as _time
+
+        node, _, keys = served
+        from celestia_app_tpu.tx import tx_hash as compute_hash
+        from celestia_app_tpu.tx.messages import Coin, MsgSend
+        from celestia_app_tpu.tx.sign import Fee, build_and_sign
+
+        acc = remote.query_account(keys[0].public_key().address())
+        raw = build_and_sign(
+            [MsgSend(
+                keys[0].public_key().address(),
+                keys[1].public_key().address(),
+                (Coin("utia", 31),),
+            )],
+            keys[0], node.chain_id, acc.account_number, acc.sequence,
+            Fee((Coin("utia", 200_000),), 200_000),
+        )
+        res = remote.broadcast(raw)
+        assert res.code == 0, res.log
+        status = remote.wait_tx(compute_hash(raw), timeout_s=30.0)
+        assert status is not None and status[1] == 0 and status[0] >= 1
+
+        t0 = _time.monotonic()
+        assert remote.wait_tx(b"\x02" * 32, timeout_s=1.2) is None
+        assert _time.monotonic() - t0 >= 1.0, "server must park the waiter"
