@@ -13,6 +13,16 @@ import os
 import struct
 import sys
 
+# The worker runs ALONGSIDE the host process's own JAX runtime, and some
+# accelerator transports (single-session loopback tunnels) wedge when two
+# clients attach concurrently. Default the worker to the CPU backend —
+# the pipeline is integer-only, so its output is bit-identical on any
+# platform; set CELESTIA_BRIDGE_PLATFORM to opt a deployment into device
+# execution when the host is NOT also a device client.
+os.environ["JAX_PLATFORMS"] = os.environ.get("CELESTIA_BRIDGE_PLATFORM", "cpu")
+if os.environ["JAX_PLATFORMS"] == "cpu":
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
 REQ_MAGIC = 0x31515343  # "CSQ1"
 RESP_MAGIC = 0x52515343  # "CSQR"
 OP_EXTEND = 1
@@ -55,6 +65,12 @@ def _warmup(k: int) -> None:
 
 
 def main() -> int:
+    # A sitecustomize may pre-register an accelerator platform; pin the
+    # live config too — the env var alone does not take.
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
     stdin = sys.stdin.buffer
     stdout = sys.stdout.buffer
     # Anything the runtime prints must not corrupt the protocol stream.

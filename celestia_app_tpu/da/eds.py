@@ -181,6 +181,12 @@ def extend_shares(shares: list[bytes]) -> ExtendedDataSquare:
 
     shares: row-major flattened ODS; length must be a square of a power of
     two within bounds.
+
+    $CELESTIA_SQUARE_BACKEND=bridge routes the extension through the C ABI
+    worker (bridge/, the reference's wrapper/nmt_wrapper.go:73-86 seam for
+    a host-language consensus daemon); any bridge fault falls back to the
+    in-process device pipeline — the node must keep committing, and both
+    paths are bit-identical, so the fallback never forks consensus.
     """
     n = len(shares)
     k = int(round(n ** 0.5))
@@ -192,4 +198,81 @@ def extend_shares(shares: list[bytes]) -> ExtendedDataSquare:
         if len(s) != SHARE_SIZE:
             raise ValueError(f"share {i} has length {len(s)}, want {SHARE_SIZE}")
     ods = np.frombuffer(b"".join(shares), dtype=np.uint8).reshape(k, k, SHARE_SIZE)
+    if square_backend() == "bridge":
+        result = _try_bridge_extend(ods)
+        if result is not None:
+            return result
     return ExtendedDataSquare.compute(ods)
+
+
+# --- bridge backend (C ABI worker) -----------------------------------------
+
+import threading as _threading
+
+_BRIDGE_CLIENT = None
+_BRIDGE_LOCK = _threading.Lock()  # created at import: first-use is racy
+
+
+def square_backend() -> str:
+    """The active square-extension backend: "device" (in-process jit, the
+    default) or "bridge" ($CELESTIA_SQUARE_BACKEND)."""
+    import os
+
+    return os.environ.get("CELESTIA_SQUARE_BACKEND", "device")
+
+
+def _bridge_lib_path() -> str:
+    import os
+
+    default = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        "bridge", "build", "libcelestia_square_bridge.so",
+    )
+    return os.environ.get("CELESTIA_BRIDGE_LIB", default)
+
+
+def _bridge_client():
+    """Process-wide BridgeClient, created on first use (spawns the
+    persistent worker). Raises on init failure — the caller falls back."""
+    global _BRIDGE_CLIENT
+
+    with _BRIDGE_LOCK:
+        if _BRIDGE_CLIENT is None:
+            from celestia_app_tpu.bridge.client import BridgeClient
+
+            _BRIDGE_CLIENT = BridgeClient(_bridge_lib_path())
+        return _BRIDGE_CLIENT
+
+
+def _reset_bridge() -> None:
+    """Drop the (possibly dead) client so a later block can retry init."""
+    global _BRIDGE_CLIENT
+    client, _BRIDGE_CLIENT = _BRIDGE_CLIENT, None
+    if client is not None:
+        try:
+            client.shutdown()
+        except Exception:
+            pass
+
+
+def _try_bridge_extend(ods: np.ndarray) -> ExtendedDataSquare | None:
+    """One bridge round-trip; None on any fault (caller falls back).
+
+    The fallback contract: a killed/hung worker must cost one failed call,
+    not the block — the client is reset so the NEXT block retries a fresh
+    worker while this one rides the device path.
+    """
+    import sys
+
+    k = ods.shape[0]
+    try:
+        eds, rr, cr, droot = _bridge_client().extend_and_dah(ods)
+        return ExtendedDataSquare(
+            eds, rr, cr, np.frombuffer(droot, dtype=np.uint8), k
+        )
+    except Exception as e:  # noqa: BLE001 — any bridge fault -> device path
+        print(f"square bridge fault ({e}); falling back to device pipeline",
+              file=sys.stderr)
+        _reset_bridge()
+        return None

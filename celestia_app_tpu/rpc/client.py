@@ -95,13 +95,20 @@ class RemoteNode:
         return (res["height"], res["code"], res["log"])
 
     def wait_tx(self, tx_hash: bytes, timeout_s: float = 30.0):
-        """Subscription confirm: one long-poll call that parks server-side
-        on the commit event (rpc_subscribe_tx) instead of hammering
-        tx_status; (height, code, log) or None on timeout."""
-        res = self.call("subscribe_tx", hash=tx_hash.hex(), timeout_s=timeout_s)
-        if res is None:
-            return None
-        return (res["height"], res["code"], res["log"])
+        """Subscription confirm: long-poll calls that park server-side on
+        the commit event (rpc_subscribe_tx) instead of hammering tx_status;
+        (height, code, log) or None on timeout. Re-subscribes while the
+        deadline remains — the server caps one park at 110 s."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            res = self.call(
+                "subscribe_tx", hash=tx_hash.hex(), timeout_s=remaining
+            )
+            if res is not None:
+                return (res["height"], res["code"], res["log"])
 
     def produce_block(self):
         """Trigger one block on the served node (dev/test surface); returns

@@ -557,7 +557,16 @@ def _handlers(node) -> dict:
         # timeout. Deliberately NOT under node_lock — the wait parks on
         # the commit event and would deadlock the proposer loop.
         txhash = _field_str(req, 1)
-        timeout_ms = _field_int(req, 2) or 25_000
+        timeout_ms = _field_int(req, 2)
+        if timeout_ms <= 0:
+            # Absent/zero timeout: immediate status check, no park (proto3
+            # cannot distinguish the two, so 0 must not mean "default").
+            status = node.tx_status(bytes.fromhex(txhash))
+            if status is None:
+                return b""
+            height, code, log = status
+            return encode_bytes_field(
+                2, _tx_response(height, txhash, code, log))
         if wait_slots.acquire(blocking=False):
             try:
                 status = node.wait_tx(
@@ -842,15 +851,20 @@ class GrpcNode:
         single call returning empty does not mean the timeout elapsed."""
         import time
 
+        import grpc
+
         deadline = time.monotonic() + timeout_s
         while True:
             remaining = deadline - time.monotonic()
-            if remaining <= 0:
+            if remaining < 0.05:  # sub-50ms: not worth another round-trip
                 return None
             req = encode_bytes_field(1, tx_hash.hex().upper().encode())
             req += encode_varint_field(2, int(remaining * 1000))
             t0 = time.monotonic()
-            resp = self._call["wait_tx"](req, timeout=remaining + 10.0)
+            try:
+                resp = self._call["wait_tx"](req, timeout=remaining + 10.0)
+            except grpc.RpcError:
+                return None  # deadline/transport fault == timed out
             tr = _field_bytes(resp, 2)
             if tr:
                 parsed = _parse_tx_response(tr)
