@@ -22,8 +22,6 @@ from celestia_app_tpu.crypto import bech32
 
 ACCOUNT_HRP = "celestia"
 _ORDER = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
-
-
 def _sha256(b: bytes) -> bytes:
     return hashlib.sha256(b).digest()
 
@@ -48,8 +46,19 @@ class PrivateKey:
         return PublicKey.from_cryptography(self._key.public_key())
 
     def sign(self, msg: bytes) -> bytes:
-        """64-byte r||s signature over sha256(msg), low-S normalized."""
-        der = self._key.sign(_sha256(msg), ec.ECDSA(Prehashed(hashes.SHA256())))
+        """64-byte r||s signature over sha256(msg), low-S normalized.
+
+        Deterministic (RFC 6979) like the reference's cosmos-sdk/btcec
+        signer: identical (key, msg) always yields identical bytes —
+        identical txs -> identical data roots across runs, a
+        consensus-layer equivalence OpenSSL's randomized nonces broke.
+        Pinned against the public secp256k1 RFC 6979 vector in
+        tests/test_deterministic_signing.py.
+        """
+        der = self._key.sign(
+            _sha256(msg),
+            ec.ECDSA(Prehashed(hashes.SHA256()), deterministic_signing=True),
+        )
         r, s = decode_dss_signature(der)
         if s > _ORDER // 2:
             s = _ORDER - s

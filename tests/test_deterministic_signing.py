@@ -1,0 +1,60 @@
+"""RFC 6979 deterministic ECDSA: reference-parity account signing.
+
+The reference signs with cosmos-sdk secp256k1 (btcec/decred), which is
+RFC 6979 deterministic: identical (key, msg) -> identical signature ->
+identical tx bytes -> identical data roots across runs — a consensus-layer
+equivalence, not hygiene. Until round 5 this repo signed through
+OpenSSL's randomized-nonce ECDSA, so two runs of the same chain committed
+different data hashes. Pinned here: the public secp256k1 RFC 6979 vector,
+cross-run determinism, and verifier compatibility.
+"""
+
+from cryptography.hazmat.primitives.asymmetric import ec
+
+from celestia_app_tpu.crypto.keys import _ORDER, PrivateKey
+
+
+def test_rfc6979_public_vector():
+    """d=1, msg="Satoshi Nakamoto" (Trezor / python-ecdsa suites): the
+    64-byte signature must be the published (r, low-S s) pair."""
+    key = PrivateKey(ec.derive_private_key(1, ec.SECP256K1()))
+    sig = key.sign(b"Satoshi Nakamoto")
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:], "big")
+    assert r == 0x934B1EA10A4B3C1757E2B0C017D0B6143CE3C9A7E6A4A49860D7A6AB210EE3D8
+    assert s == 0x2442CE9D2B916064108014783E923EC36B49743E2FFA1C4496F01A512AAFD9E5
+
+
+def test_sign_is_deterministic_and_verifiable():
+    key = PrivateKey.from_seed(b"determinism")
+    msg = b"the same message"
+    sig = key.sign(msg)
+    assert sig == key.sign(msg)
+    assert key.public_key().verify(msg, sig)
+    assert not key.public_key().verify(b"another message", sig)
+    # low-S (transaction malleability rule, cosmos/bitcoin convention)
+    assert int.from_bytes(sig[32:], "big") <= _ORDER // 2
+
+
+def test_chain_runs_commit_identical_data_roots():
+    """The property the randomized nonce broke: two fresh chains fed the
+    same txs commit identical block data hashes."""
+    from celestia_app_tpu.shares import Blob, Namespace
+    from celestia_app_tpu.testutil import (
+        TestNode,
+        deterministic_genesis,
+        funded_keys,
+    )
+    from celestia_app_tpu.user import TxClient
+
+    def one_block():
+        keys = funded_keys(2)
+        node = TestNode(genesis=deterministic_genesis(keys))
+        client = TxClient(node, keys[:1])
+        resp = client.submit_pay_for_blob(
+            [Blob(Namespace.v0(bytes([7]) * 10), b"payload" * 64)]
+        )
+        assert resp.code == 0, resp.log
+        return node.blocks[-1].hash, node.app.cms.last_app_hash
+
+    assert one_block() == one_block()
