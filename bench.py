@@ -63,7 +63,8 @@ BASELINE_NOTE = (
     "args) executions (a parts run returned 0.0s for a 128 MB-output "
     "program), so reusing one buffer can measure the relay's memo instead "
     "of the chip. The `parts` row decomposes compute@512 into rs_dense / "
-    "rs_fft / rs_fft_md and nmt_dah_{jnp,pallas} device seconds, and "
+    "rs_fft / rs_fft_md / rs_dense_pl (fused Pallas dense, TPU only) and "
+    "nmt_dah_{jnp,pallas} device seconds, and "
     "doubles as the autotuner: it runs first and every later row rides "
     "the fastest measured RS and SHA lowerings (defaults keep the seat "
     "unless a challenger is >3% faster; the chosen config is recorded in "
@@ -214,21 +215,36 @@ def _parts_seconds(ods: np.ndarray, iters: int) -> dict:
     xs = [jax.device_put(jnp.asarray(_variant(ods, i))) for i in range(iters)]
     out: dict[str, float] = {}
     eds = None
+    try:
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except Exception:  # noqa: BLE001
+        on_tpu = False
     saved = {
         var: os.environ.get(var)
-        for var in ("CELESTIA_RS_FFT", "CELESTIA_RS_FFT_MD")
+        for var in ("CELESTIA_RS_FFT", "CELESTIA_RS_FFT_MD",
+                    "CELESTIA_RS_PALLAS")
     }
     try:
         # Each variant builds a FRESH jax.jit around extend_square_fn, so
         # the env flags are re-read at trace time (the lru-cached module
         # wrappers key on (k, construction) only and must not be used for
         # an A/B like this — they would serve the first trace twice).
-        variants = (
+        variants = [
             ("rs_fft", {"CELESTIA_RS_FFT": "on", "CELESTIA_RS_FFT_MD": ""}),
             ("rs_fft_md", {"CELESTIA_RS_FFT": "on", "CELESTIA_RS_FFT_MD": "1"}),
             ("rs_dense", {"CELESTIA_RS_FFT": "off", "CELESTIA_RS_FFT_MD": ""}),
-        )
+        ]
+        if on_tpu:  # the fused Pallas kernel has no compiled CPU path
+            from celestia_app_tpu.gf.rs import codec_for_width
+            from celestia_app_tpu.kernels.rs_pallas import pallas_supported
+
+            if pallas_supported(k, codec_for_width(k).field.m):
+                variants.append(
+                    ("rs_dense_pl",
+                     {"CELESTIA_RS_FFT": "off", "CELESTIA_RS_FFT_MD": "",
+                      "CELESTIA_RS_PALLAS": "on"}))
         for label, flags in variants:
+            os.environ.pop("CELESTIA_RS_PALLAS", None)
             for var, val in flags.items():
                 if val:
                     os.environ[var] = val
@@ -259,10 +275,6 @@ def _parts_seconds(ods: np.ndarray, iters: int) -> dict:
     del eds
     del x
     ext = jax.jit(extend_square_fn(k))
-    try:
-        on_tpu = jax.devices()[0].platform == "tpu"
-    except Exception:  # noqa: BLE001
-        on_tpu = False
     sha_rows = [("nmt_dah_jnp", "off")]
     if on_tpu:  # the Pallas kernel has no compiled CPU path
         sha_rows.append(("nmt_dah_pallas", "on"))
@@ -307,8 +319,8 @@ def _pick_tuned(seconds: dict, on_tpu: bool) -> tuple[float, dict]:
     the child's "tuned-applied" record says what later rows actually ran
     once operator-set knobs are honored, tuned choices dict)."""
     rs_best = "rs_dense"
-    for label in ("rs_fft", "rs_fft_md"):
-        if seconds[label] < 0.97 * seconds[rs_best]:
+    for label in ("rs_fft", "rs_fft_md", "rs_dense_pl"):
+        if label in seconds and seconds[label] < 0.97 * seconds[rs_best]:
             rs_best = label
     sha_best = "pallas" if on_tpu else "jnp"
     if on_tpu and seconds["nmt_dah_jnp"] < 0.97 * seconds["nmt_dah_pallas"]:
@@ -518,13 +530,16 @@ def _run_child() -> None:
                     if (
                         "CELESTIA_RS_FFT" not in os.environ
                         and "CELESTIA_RS_FFT_MD" not in os.environ
+                        and "CELESTIA_RS_PALLAS" not in os.environ
                     ):
-                        if tuned["rs"] != "rs_dense":
+                        if tuned["rs"] in ("rs_fft", "rs_fft_md"):
                             os.environ["CELESTIA_RS_FFT"] = "on"
                             if tuned["rs"] == "rs_fft_md":
                                 os.environ["CELESTIA_RS_FFT_MD"] = "1"
                         else:
                             os.environ["CELESTIA_RS_FFT"] = "off"
+                            if tuned["rs"] == "rs_dense_pl":
+                                os.environ["CELESTIA_RS_PALLAS"] = "on"
                     if "CELESTIA_SHA_PALLAS" not in os.environ:
                         os.environ["CELESTIA_SHA_PALLAS"] = (
                             "on" if tuned["sha"] == "pallas" else "off"
@@ -533,11 +548,16 @@ def _run_child() -> None:
                     # over the tuner) — derived from the final env so the
                     # record can never contradict the headline rows.
                     fft_env = os.environ.get("CELESTIA_RS_FFT", "auto")
-                    applied_rs = "rs_dense" if fft_env != "on" else (
-                        "rs_fft_md"
-                        if os.environ.get("CELESTIA_RS_FFT_MD") == "1"
-                        else "rs_fft"
-                    )
+                    if fft_env == "on":
+                        applied_rs = (
+                            "rs_fft_md"
+                            if os.environ.get("CELESTIA_RS_FFT_MD") == "1"
+                            else "rs_fft"
+                        )
+                    elif os.environ.get("CELESTIA_RS_PALLAS") == "on":
+                        applied_rs = "rs_dense_pl"
+                    else:
+                        applied_rs = "rs_dense"
                     sha_env = os.environ.get("CELESTIA_SHA_PALLAS", "auto")
                     applied_sha = {"on": "pallas", "off": "jnp"}.get(
                         sha_env, "auto"

@@ -109,15 +109,32 @@ def _use_fft(k: int) -> bool:
     return os.environ.get("CELESTIA_RS_FFT", "auto") == "on"
 
 
+def _use_pallas_rs(k: int, m: int) -> bool:
+    """$CELESTIA_RS_PALLAS: "on" / "off" (default).  The fused Pallas
+    dense kernel (kernels/rs_pallas.py) keeps the 8x bit planes in VMEM —
+    unmeasured on hardware yet, so it is opt-in until a chip run (the
+    bench autotuner measures it as the rs_dense_pl candidate and flips
+    the env for the rows it wins). Requires MXU-tileable dims."""
+    import os
+
+    if os.environ.get("CELESTIA_RS_PALLAS", "off") != "on":
+        return False
+    from celestia_app_tpu.kernels.rs_pallas import pallas_supported
+
+    return pallas_supported(k, m)
+
+
 def encode_fn(k: int, construction: str | None = None):
     """The encode-path selector: f(data, contract_axis) -> parity shares.
 
-    ONE owner for the FFT-vs-dense policy — both the single-chip square
-    extension and the sharded pipeline build their encode through here, so
-    the selection (and any future threshold/env change) cannot diverge
-    between them.  The dense generator matmul is the default everywhere
-    (see _use_fft for the measured rationale); CELESTIA_RS_FFT=on selects
-    the additive-FFT butterflies — identical bytes either way.
+    ONE owner for the FFT-vs-dense-vs-pallas policy — both the single-chip
+    square extension and the sharded pipeline build their encode through
+    here, so the selection (and any future threshold/env change) cannot
+    diverge between them.  The dense generator matmul is the default
+    everywhere (see _use_fft for the measured rationale);
+    CELESTIA_RS_FFT=on selects the additive-FFT butterflies and
+    CELESTIA_RS_PALLAS=on the fused Pallas dense kernel — identical bytes
+    any way.
     """
     from celestia_app_tpu.gf.rs import active_construction as _active
 
@@ -130,6 +147,13 @@ def encode_fn(k: int, construction: str | None = None):
 
         def encode(data: jnp.ndarray, contract_axis: int = 1) -> jnp.ndarray:
             return encode_axis_fft(data, k, resolved, contract_axis)
+    elif _use_pallas_rs(k, m):
+        from celestia_app_tpu.kernels.rs_pallas import encode_axis_pallas
+
+        G_bits_pl = jnp.asarray(codec.generator_bits())
+
+        def encode(data: jnp.ndarray, contract_axis: int = 1) -> jnp.ndarray:
+            return encode_axis_pallas(data, G_bits_pl, m, contract_axis)
     else:
         G_bits = jnp.asarray(codec.generator_bits())
 

@@ -73,14 +73,23 @@ def main() -> int:
     # --- RS variants (fresh jit per variant; env read at trace time) ---
     out["rs"] = {}
     out["rs_all"] = {}
-    rs_flags = (
+    rs_flags = [
         ("dense", {"CELESTIA_RS_FFT": "off"}),
         ("fft", {"CELESTIA_RS_FFT": "on"}),
         ("fft_md", {"CELESTIA_RS_FFT": "on", "CELESTIA_RS_FFT_MD": "1"}),
-    )
+    ]
+    if out["platform"] == "tpu":
+        from celestia_app_tpu.gf.rs import codec_for_width
+        from celestia_app_tpu.kernels.rs_pallas import pallas_supported
+
+        if pallas_supported(k, codec_for_width(k).field.m):
+            rs_flags.append(
+                ("dense_pl",
+                 {"CELESTIA_RS_FFT": "off", "CELESTIA_RS_PALLAS": "on"}))
     checksums = {}
     for label, flags in rs_flags:
-        for var in ("CELESTIA_RS_FFT", "CELESTIA_RS_FFT_MD"):
+        for var in ("CELESTIA_RS_FFT", "CELESTIA_RS_FFT_MD",
+                    "CELESTIA_RS_PALLAS"):
             os.environ.pop(var, None)
         os.environ.update(flags)
         fn = jax.jit(extend_square_fn(k))
@@ -94,7 +103,8 @@ def main() -> int:
         out["rs"][label] = round(med, 4)
         out["rs_all"][label] = [round(t, 4) for t in ts]
         print(f"# rs {label}: median {med:.4f}s (compile+first {compile_s:.1f}s) {ts}", flush=True)
-    for var in ("CELESTIA_RS_FFT", "CELESTIA_RS_FFT_MD"):
+    for var in ("CELESTIA_RS_FFT", "CELESTIA_RS_FFT_MD",
+                "CELESTIA_RS_PALLAS"):
         os.environ.pop(var, None)
     out["rs_checksums_equal"] = len(set(checksums.values())) == 1
     assert out["rs_checksums_equal"], f"RS variants disagree: {checksums}"

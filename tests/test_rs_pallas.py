@@ -1,0 +1,55 @@
+"""Fused Pallas dense-RS kernel: bit-identity with the XLA dense path.
+
+The kernel (kernels/rs_pallas.py) keeps the 8x bit planes in VMEM; its
+contract is byte-for-byte equality with kernels/rs.encode_axis. Off-TPU it
+runs in interpret mode — slow, so shapes are minimal (k*m = 128, one MXU
+tile). Hardware timing is bench.py's job (rs_dense_pl candidate).
+"""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from celestia_app_tpu.gf.rs import RSCodec
+from celestia_app_tpu.kernels.rs import encode_axis
+from celestia_app_tpu.kernels.rs_pallas import (
+    encode_axis_pallas,
+    pallas_supported,
+)
+
+
+@pytest.mark.parametrize("construction", ["vandermonde", "leopard"])
+def test_bit_identity_k16(construction):
+    k, width = 16, 64  # k*m = 128: the smallest MXU-tileable square
+    codec = RSCodec(k, construction)
+    m = codec.field.m
+    assert pallas_supported(k, m)
+    G_bits = jnp.asarray(codec.generator_bits())
+    rng = np.random.default_rng(23)
+    data = jnp.asarray(
+        rng.integers(0, 256, (3, k, width), dtype=np.uint8)
+    )
+    for axis in (0, 1):
+        d = jnp.moveaxis(data, 1, axis)
+        want = encode_axis(d, G_bits, m, axis)
+        got = encode_axis_pallas(d, G_bits, m, axis, interpret=True)
+        assert np.array_equal(np.asarray(got), np.asarray(want)), (
+            construction, axis)
+
+
+def test_unaligned_cols_are_padded():
+    """cols not a multiple of the lane tile: padded in, sliced out."""
+    k = 16
+    codec = RSCodec(k, "vandermonde")
+    G_bits = jnp.asarray(codec.generator_bits())
+    rng = np.random.default_rng(5)
+    # batch=1, width 72 -> cols = 72, far below the 256-lane tile
+    data = jnp.asarray(rng.integers(0, 256, (1, k, 72), dtype=np.uint8))
+    want = encode_axis(data, G_bits, codec.field.m, 1)
+    got = encode_axis_pallas(data, G_bits, codec.field.m, 1, interpret=True)
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_small_k_not_supported():
+    assert not pallas_supported(8, 8)  # 64 bit-rows < one MXU tile
