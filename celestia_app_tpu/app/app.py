@@ -200,6 +200,15 @@ class App:
             MAX_CODEC_SQUARE_SIZE,
         )
         self._check_state: KVStore | None = None
+        # Own-root memo: (square_size, sha256(square bytes)) -> DAH hash.
+        # A data root is a pure function of the square bytes, and this
+        # node recomputes the SAME square's root up to twice per block
+        # (PrepareProposal, then ProcessProposal rebuilding the square
+        # from the txs itself). Only self-computed results enter the memo
+        # and Process still rebuilds the square from the raw txs, so the
+        # proposer's claims are never trusted — identical bytes simply
+        # skip the identical device pipeline. Bounded FIFO.
+        self._own_roots: dict[tuple[int, bytes], bytes] = {}
 
     # --- keeper views over committed state ---------------------------------
     @property
@@ -328,9 +337,8 @@ class App:
                 dah = min_data_availability_header()
                 return BlockData(tuple(kept), 1, dah.hash())
             with traced().span("square_pipeline", k=sq.size, phase="prepare"):
-                eds = extend_shares(sq.share_bytes())
-                dah = DataAvailabilityHeader.from_eds(eds)
-            return BlockData(tuple(kept), sq.size, dah.hash())
+                root = self._square_root(sq.size, sq.share_bytes())
+            return BlockData(tuple(kept), sq.size, root)
 
     def _cap_block_bytes(self, raw_txs: list[bytes]) -> list[bytes]:
         """Keep the prefix of candidate txs fitting the on-chain
@@ -447,9 +455,28 @@ class App:
             return False  # square-size equality (:133)
         if sq.is_empty():
             return min_data_availability_header().hash() == data.hash
-        eds = extend_shares(sq.share_bytes())
-        dah = DataAvailabilityHeader.from_eds(eds)
-        return dah.hash() == data.hash  # root equality (:152)
+        # Root equality (:152) over the square REBUILT from the raw txs
+        # above — the own-root memo only skips re-running the pipeline on
+        # bytes this node already extended (its own Prepare, usually).
+        return self._square_root(sq.size, sq.share_bytes()) == data.hash
+
+    def _square_root(self, size: int, share_bytes: list[bytes]) -> bytes:
+        """DAH hash of a built square, memoized on the square's content."""
+        import hashlib
+
+        digest = hashlib.sha256()
+        for s in share_bytes:
+            digest.update(s)
+        key = (size, digest.digest())
+        cached = self._own_roots.get(key)
+        if cached is not None:
+            return cached
+        eds = extend_shares(share_bytes)
+        root = DataAvailabilityHeader.from_eds(eds).hash()
+        while len(self._own_roots) >= 4:
+            self._own_roots.pop(next(iter(self._own_roots)))
+        self._own_roots[key] = root
+        return root
 
     # --- block execution ----------------------------------------------------
     def finalize_block(

@@ -235,6 +235,16 @@ def cmd_start(args) -> int:
         )
         server = rpc_serve(node, port=args.rpc_port, block_interval_s=None)
         print(f"RPC serving on {server.url}", flush=True)
+        if getattr(args, "grpc", False):
+            from celestia_app_tpu.rpc.grpc_plane import serve_grpc
+
+            grpc_plane = serve_grpc(node, port=getattr(args, "grpc_port", 0))
+            print(f"gRPC serving on {grpc_plane.target}", flush=True)
+        if getattr(args, "api", False):
+            from celestia_app_tpu.rpc.api_gateway import serve_api
+
+            api_gw = serve_api(node, port=getattr(args, "api_port", 0))
+            print(f"API serving on {api_gw.url}", flush=True)
     if peers:
         # Multi-validator mode: consensus runs through the gossip round
         # machine (rpc/gossip.py) — this daemon is one validator of a
@@ -474,6 +484,15 @@ def main(argv: list[str] | None = None) -> int:
                    help="node min gas price in utia (tier-1 override)")
     p.add_argument("--serve", action="store_true",
                    help="serve the JSON-RPC endpoint (broadcast/query/proofs)")
+    p.add_argument("--grpc", action="store_true",
+                   help="with --serve: also serve the cosmos gRPC plane")
+    p.add_argument("--grpc-port", type=int, default=0,
+                   help="gRPC port (0 = ephemeral)")
+    p.add_argument("--api", action="store_true",
+                   help="with --serve: also serve the REST API gateway "
+                        "(the grpc-gateway plane, reference port 1317)")
+    p.add_argument("--api-port", type=int, default=0,
+                   help="API gateway port (0 = ephemeral)")
     p.add_argument("--peers", default="",
                    help="comma-separated peer RPC URLs: join as one gossip "
                         "validator of a network (implies --serve)")

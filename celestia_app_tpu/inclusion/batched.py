@@ -32,17 +32,70 @@ def _jit_tree_roots(n: int, leaves: int):
     return jax.jit(tree_roots)
 
 
+# Commitment memo: a commitment is a pure function of (namespace, data,
+# share_version, threshold), and the SAME blob is validated up to three
+# times per inclusion (CheckTx admission, PrepareProposal filter,
+# ProcessProposal validation — x/blob/types/blob_tx.go:98 runs each time).
+# Keying on the blob's content hash collapses those to one device pass.
+# Bounded FIFO so a flood of distinct blobs cannot grow it unboundedly.
+_COMMIT_MEMO: dict[tuple, bytes] = {}
+_COMMIT_MEMO_MAX = 2048
+# The memo is shared across every node in the process (in-process
+# clusters validate concurrently from relay/loader threads): all reads
+# and evictions happen under this lock. Device hashing for misses runs
+# OUTSIDE it — holding a lock across a jit dispatch would serialize the
+# very work the batching exists to parallelize.
+import threading as _threading
+
+_COMMIT_MEMO_LOCK = _threading.Lock()
+
+
+def _memo_key(blob: Blob, threshold: int) -> tuple:
+    import hashlib
+
+    return (
+        blob.namespace.to_bytes(),
+        hashlib.sha256(blob.data).digest(),
+        blob.share_version,
+        threshold,
+    )
+
+
 def create_commitments_batched(
     blobs: list[Blob], subtree_root_threshold: int = SUBTREE_ROOT_THRESHOLD
 ) -> list[bytes]:
     """Commitments for many blobs with all hashing batched on device.
 
     Bit-identical to inclusion.create_commitment per blob (tested), just
-    scheduled as one device call per distinct chunk size.
+    scheduled as one device call per distinct chunk size. Results are
+    memoized by blob content, so revalidation of an already-seen blob
+    (Prepare/Process after CheckTx) costs one sha256 of its data.
     """
     if not blobs:
         return []
 
+    keys = [_memo_key(b, subtree_root_threshold) for b in blobs]
+    with _COMMIT_MEMO_LOCK:
+        have = {k: _COMMIT_MEMO[k] for k in keys if k in _COMMIT_MEMO}
+    missing = [i for i, k in enumerate(keys) if k not in have]
+    if not missing:
+        return [have[k] for k in keys]
+    fresh = _create_commitments_uncached(
+        [blobs[i] for i in missing], subtree_root_threshold
+    )
+    with _COMMIT_MEMO_LOCK:
+        while (len(_COMMIT_MEMO) + len(missing) > _COMMIT_MEMO_MAX
+               and _COMMIT_MEMO):
+            _COMMIT_MEMO.pop(next(iter(_COMMIT_MEMO)))
+        for i, c in zip(missing, fresh):
+            _COMMIT_MEMO[keys[i]] = c
+            have[keys[i]] = c
+    return [have[k] for k in keys]
+
+
+def _create_commitments_uncached(
+    blobs: list[Blob], subtree_root_threshold: int = SUBTREE_ROOT_THRESHOLD
+) -> list[bytes]:
     # Chunk every blob: (blob_idx, chunk_order, size, share_range).
     blob_shares: list[np.ndarray] = []
     blob_ns: list[bytes] = []

@@ -105,9 +105,17 @@ def validate_blob_tx(
     version match, and commitment equality (the expensive recompute).
     Returns the validated message.
     """
+    from celestia_app_tpu.inclusion.batched import create_commitments_batched
+
     msg = _structural_checks(btx)
-    for i, blob in enumerate(btx.blobs):
-        if create_commitment(blob, subtree_root_threshold) != msg.share_commitments[i]:
+    # Through the batched path for its content memo: the same blob is
+    # re-validated at Prepare/Process after CheckTx admission, and the
+    # memo collapses those recomputes to one device pass.
+    commitments = create_commitments_batched(
+        list(btx.blobs), subtree_root_threshold
+    )
+    for i, commitment in enumerate(commitments):
+        if commitment != msg.share_commitments[i]:
             raise BlobTxError(f"blob {i} share commitment mismatch")
     return msg
 

@@ -1,0 +1,316 @@
+"""REST API gateway: the cosmos gRPC-gateway surface over HTTP+JSON.
+
+The reference node serves three planes — Tendermint RPC, gRPC, and a
+REST "API" gateway (grpc-gateway routes registered in
+/root/reference/app/app.go:712-735; testnode wires all three,
+test/util/testnode/network.go:38-43, default port 1317). This module is
+the third plane: the standard cosmos REST routes mapped onto the same
+node surface the other planes consume, JSON field names in snake_case as
+the sdk's gateway emits them.
+
+    GET  /cosmos/base/tendermint/v1beta1/node_info
+    GET  /cosmos/base/tendermint/v1beta1/blocks/latest
+    GET  /cosmos/auth/v1beta1/accounts/{address}
+    GET  /cosmos/bank/v1beta1/balances/{address}
+    GET  /cosmos/bank/v1beta1/balances/{address}/by_denom?denom=
+    GET  /cosmos/staking/v1beta1/validators[?pagination.offset=&pagination.limit=&pagination.count_total=]
+    GET  /cosmos/gov/v1beta1/proposals
+    GET  /cosmos/slashing/v1beta1/params
+    GET  /celestia/minfee/v1/min_gas_price
+    GET  /celestia/blob/v1/params
+    GET  /cosmos/tx/v1beta1/txs/{hash}
+    POST /cosmos/tx/v1beta1/txs        {"tx_bytes": base64, "mode": ...}
+
+Errors follow the gateway shape: {"code": grpc-code, "message": ...}
+with HTTP 404 / 400 / 501 as the sdk maps them.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import re
+import threading
+from contextlib import nullcontext
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+
+def _node_lock(node):
+    return getattr(node, "lock", None) or nullcontext()
+
+
+def _routes(node):
+    """[(method, compiled path regex, handler(match, query, body) -> dict)]"""
+
+    def node_info(m, q, body):
+        return {
+            "default_node_info": {
+                "network": node.chain_id,
+                "version": "celestia-app-tpu",
+                "moniker": "tpu-node",
+            },
+            "application_version": {
+                "app_name": "celestia-app-tpu",
+                "version": str(node.app.app_version),
+            },
+        }
+
+    def latest_block(m, q, body):
+        with _node_lock(node):
+            height = node.app.height
+        return {
+            "block": {
+                "header": {"chain_id": node.chain_id, "height": str(height)}
+            },
+        }
+
+    def account(m, q, body):
+        with _node_lock(node):
+            acc = node.query_account(m.group("address"))
+        if acc is None:
+            raise _NotFound(f"account {m.group('address')} not found")
+        return {
+            "account": {
+                "@type": "/cosmos.auth.v1beta1.BaseAccount",
+                "address": acc.address,
+                "account_number": str(acc.account_number),
+                "sequence": str(acc.sequence),
+            }
+        }
+
+    def balances(m, q, body):
+        from celestia_app_tpu.state.accounts import BankKeeper
+
+        with _node_lock(node):
+            amount = BankKeeper(node.app.cms.working).balance(
+                m.group("address"), "utia"
+            )
+        coins = [{"denom": "utia", "amount": str(amount)}] if amount else []
+        return {"balances": coins, "pagination": {"total": str(len(coins))}}
+
+    def balance_by_denom(m, q, body):
+        from celestia_app_tpu.state.accounts import BankKeeper
+
+        denom = (q.get("denom") or ["utia"])[0]
+        with _node_lock(node):
+            amount = BankKeeper(node.app.cms.working).balance(
+                m.group("address"), denom
+            )
+        return {"balance": {"denom": denom, "amount": str(amount)}}
+
+    def validators(m, q, body):
+        with _node_lock(node):
+            vals = node.validators()
+        try:
+            offset = max(int((q.get("pagination.offset") or ["0"])[0]), 0)
+            limit = max(int((q.get("pagination.limit") or ["0"])[0]), 0)
+        except ValueError as e:
+            raise _BadRequest(f"invalid pagination: {e}") from e
+        total = len(vals)
+        end = total if not limit else min(offset + limit, total)
+        page = vals[offset:end]
+        out = {
+            "validators": [
+                {
+                    "operator_address": v["address"],
+                    "status": "BOND_STATUS_BONDED",
+                    "tokens": str(v.get("power", 0) * 10**6),
+                }
+                for v in page
+            ],
+            "pagination": {},
+        }
+        if end < total:
+            out["pagination"]["next_key"] = base64.b64encode(
+                str(end).encode()
+            ).decode()
+        if (q.get("pagination.count_total") or ["false"])[0] == "true":
+            out["pagination"]["total"] = str(total)
+        return out
+
+    def proposals(m, q, body):
+        from celestia_app_tpu.modules.gov import GovKeeper
+        from celestia_app_tpu.state.accounts import BankKeeper
+        from celestia_app_tpu.state.staking import StakingKeeper
+
+        with _node_lock(node):
+            store = node.app.cms.working
+            props = GovKeeper(
+                store, StakingKeeper(store), BankKeeper(store)
+            ).proposals()
+        return {
+            "proposals": [
+                {"proposal_id": str(p.pid), "status": int(p.status)}
+                for p in props
+            ],
+            "pagination": {"total": str(len(props))},
+        }
+
+    def slashing_params(m, q, body):
+        from celestia_app_tpu.modules.slashing.keeper import SlashingKeeper
+
+        with _node_lock(node):
+            p = SlashingKeeper(node.app.cms.working).params()
+        return {
+            "params": {
+                "signed_blocks_window": str(p.signed_blocks_window),
+                "min_signed_per_window": str(p.min_signed_per_window),
+                "downtime_jail_duration":
+                    f"{p.downtime_jail_duration_ns / 1e9:.9f}s",
+                "slash_fraction_double_sign":
+                    str(p.slash_fraction_double_sign),
+                "slash_fraction_downtime": str(p.slash_fraction_downtime),
+            }
+        }
+
+    def min_gas_price(m, q, body):
+        from celestia_app_tpu.modules.minfee import MinFeeKeeper
+
+        with _node_lock(node):
+            price = MinFeeKeeper(node.app.cms.working).network_min_gas_price()
+        return {"network_min_gas_price": str(price)}
+
+    def blob_params(m, q, body):
+        with _node_lock(node):
+            return {
+                "params": {
+                    "gas_per_blob_byte": node.app.gas_per_blob_byte,
+                    "gov_max_square_size":
+                        str(node.app.gov_max_square_size),
+                }
+            }
+
+    def get_tx(m, q, body):
+        txhash = m.group("hash")
+        try:
+            raw = bytes.fromhex(txhash)
+        except ValueError as e:
+            raise _BadRequest(f"invalid tx hash: {e}") from e
+        with _node_lock(node):
+            status = node.tx_status(raw)
+        if status is None:
+            raise _NotFound(f"tx not found: {txhash}")
+        height, code, log = status
+        return {
+            "tx_response": {
+                "height": str(height),
+                "txhash": txhash.upper(),
+                "code": code,
+                "raw_log": log,
+            }
+        }
+
+    def broadcast_tx(m, q, body):
+        try:
+            tx_bytes = base64.b64decode(body["tx_bytes"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise _BadRequest(f"invalid tx_bytes: {e}") from e
+        from celestia_app_tpu.tx import tx_hash
+
+        res = node.broadcast(tx_bytes)
+        return {
+            "tx_response": {
+                "txhash": tx_hash(tx_bytes).hex().upper(),
+                "code": res.code,
+                "raw_log": res.log,
+                "gas_wanted": str(res.gas_wanted),
+            }
+        }
+
+    return [
+        ("GET", re.compile(r"^/cosmos/base/tendermint/v1beta1/node_info$"), node_info),
+        ("GET", re.compile(r"^/cosmos/base/tendermint/v1beta1/blocks/latest$"), latest_block),
+        ("GET", re.compile(r"^/cosmos/auth/v1beta1/accounts/(?P<address>[^/]+)$"), account),
+        ("GET", re.compile(r"^/cosmos/bank/v1beta1/balances/(?P<address>[^/]+)$"), balances),
+        ("GET", re.compile(r"^/cosmos/bank/v1beta1/balances/(?P<address>[^/]+)/by_denom$"), balance_by_denom),
+        ("GET", re.compile(r"^/cosmos/staking/v1beta1/validators$"), validators),
+        ("GET", re.compile(r"^/cosmos/gov/v1beta1/proposals$"), proposals),
+        ("GET", re.compile(r"^/cosmos/slashing/v1beta1/params$"), slashing_params),
+        ("GET", re.compile(r"^/celestia/minfee/v1/min_gas_price$"), min_gas_price),
+        ("GET", re.compile(r"^/celestia/blob/v1/params$"), blob_params),
+        ("GET", re.compile(r"^/cosmos/tx/v1beta1/txs/(?P<hash>[0-9a-fA-F]+)$"), get_tx),
+        ("POST", re.compile(r"^/cosmos/tx/v1beta1/txs$"), broadcast_tx),
+    ]
+
+
+class _NotFound(Exception):
+    pass
+
+
+class _BadRequest(Exception):
+    pass
+
+
+class _ApiHandler(BaseHTTPRequestHandler):
+    routes: list = []
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _respond(self, status: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _dispatch(self, method: str, body: dict | None) -> None:
+        url = urlparse(self.path)
+        query = parse_qs(url.query)
+        for verb, pattern, handler in self.routes:
+            if verb != method:
+                continue
+            m = pattern.match(url.path)
+            if m is None:
+                continue
+            try:
+                self._respond(200, handler(m, query, body))
+            except _NotFound as e:
+                self._respond(404, {"code": 5, "message": str(e)})
+            except _BadRequest as e:
+                self._respond(400, {"code": 3, "message": str(e)})
+            except Exception as e:  # noqa: BLE001 — gateway internal error
+                self._respond(500, {"code": 13,
+                                    "message": f"{type(e).__name__}: {e}"})
+            return
+        self._respond(501, {"code": 12,
+                            "message": f"Not Implemented: {url.path}"})
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        self._dispatch("GET", None)
+
+    def do_POST(self):  # noqa: N802
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(length)) if length else {}
+        except (ValueError, json.JSONDecodeError):
+            self._respond(400, {"code": 3, "message": "invalid JSON body"})
+            return
+        self._dispatch("POST", body)
+
+
+@dataclass
+class ApiGateway:
+    httpd: ThreadingHTTPServer
+    port: int
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def serve_api(node, host: str = "127.0.0.1", port: int = 0) -> ApiGateway:
+    """Start the REST gateway for `node`; returns the live server."""
+    handler = type("BoundApiHandler", (_ApiHandler,),
+                   {"routes": _routes(node)})
+    httpd = ThreadingHTTPServer((host, port), handler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    return ApiGateway(httpd, httpd.server_address[1])

@@ -1,0 +1,183 @@
+"""REST API gateway: the reference's third serving plane over HTTP+JSON.
+
+Reference: grpc-gateway routes registered in app.go:712-735; testnode
+serves RPC + gRPC + API together (test/util/testnode/network.go:38-43).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import urllib.request
+
+import pytest
+
+from celestia_app_tpu.rpc.api_gateway import serve_api
+from celestia_app_tpu.rpc.server import ServingNode, serve
+from celestia_app_tpu.testutil import deterministic_genesis, funded_keys
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get_err(url: str):
+    try:
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _post(url: str, body: dict):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+def _post_err(url: str, body: dict):
+    try:
+        return _post(url, body)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture(scope="module")
+def api():
+    keys = funded_keys(3)
+    node = ServingNode(
+        genesis=deterministic_genesis(keys, n_validators=3),
+        keys=keys, validator_index=0, n_validators=1,
+    )
+    node.peer_urls = []
+    server = serve(node, port=0, block_interval_s=None)
+    gw = serve_api(node)
+    yield node, gw, keys
+    gw.stop()
+    server.stop()
+
+
+class TestApiGateway:
+    def test_node_info_and_latest_block(self, api):
+        node, gw, _ = api
+        status, info = _get(f"{gw.url}/cosmos/base/tendermint/v1beta1/node_info")
+        assert status == 200
+        assert info["default_node_info"]["network"] == node.chain_id
+        status, blk = _get(f"{gw.url}/cosmos/base/tendermint/v1beta1/blocks/latest")
+        assert status == 200
+        assert blk["block"]["header"]["chain_id"] == node.chain_id
+
+    def test_account_and_balances(self, api):
+        node, gw, keys = api
+        addr = keys[0].public_key().address()
+        status, acc = _get(f"{gw.url}/cosmos/auth/v1beta1/accounts/{addr}")
+        assert status == 200
+        assert acc["account"]["address"] == addr
+        assert acc["account"]["@type"] == "/cosmos.auth.v1beta1.BaseAccount"
+        status, bal = _get(f"{gw.url}/cosmos/bank/v1beta1/balances/{addr}")
+        assert status == 200
+        assert bal["balances"][0]["denom"] == "utia"
+        assert int(bal["balances"][0]["amount"]) > 0
+        status, one = _get(
+            f"{gw.url}/cosmos/bank/v1beta1/balances/{addr}/by_denom?denom=utia"
+        )
+        assert one["balance"]["amount"] == bal["balances"][0]["amount"]
+        status, missing = _get_err(
+            f"{gw.url}/cosmos/auth/v1beta1/accounts/celestia1nobody"
+        )
+        assert status == 404 and missing["code"] == 5
+
+    def test_validators_paged(self, api):
+        node, gw, _ = api
+        status, page = _get(
+            f"{gw.url}/cosmos/staking/v1beta1/validators"
+            "?pagination.limit=2&pagination.count_total=true"
+        )
+        assert status == 200
+        assert len(page["validators"]) == 2
+        assert page["pagination"]["total"] == "3"
+        next_off = int(base64.b64decode(page["pagination"]["next_key"]))
+        status, rest = _get(
+            f"{gw.url}/cosmos/staking/v1beta1/validators"
+            f"?pagination.offset={next_off}"
+        )
+        assert len(rest["validators"]) == 1
+        assert rest["validators"][0]["status"] == "BOND_STATUS_BONDED"
+
+    def test_module_params(self, api):
+        node, gw, _ = api
+        status, fee = _get(f"{gw.url}/celestia/minfee/v1/min_gas_price")
+        assert status == 200 and float(fee["network_min_gas_price"]) > 0
+        status, blob = _get(f"{gw.url}/celestia/blob/v1/params")
+        assert blob["params"]["gas_per_blob_byte"] == node.app.gas_per_blob_byte
+        status, sl = _get(f"{gw.url}/cosmos/slashing/v1beta1/params")
+        assert int(sl["params"]["signed_blocks_window"]) > 0
+        status, props = _get(f"{gw.url}/cosmos/gov/v1beta1/proposals")
+        assert status == 200 and props["proposals"] == []
+
+    def test_broadcast_and_get_tx(self, api):
+        from celestia_app_tpu.tx import tx_hash
+        from celestia_app_tpu.tx.messages import Coin, MsgSend
+        from celestia_app_tpu.tx.sign import Fee, build_and_sign
+
+        node, gw, keys = api
+        acc = node.query_account(keys[0].public_key().address())
+        raw = build_and_sign(
+            [MsgSend(
+                keys[0].public_key().address(),
+                keys[1].public_key().address(),
+                (Coin("utia", 19),),
+            )],
+            keys[0], node.chain_id, acc.account_number, acc.sequence,
+            Fee((Coin("utia", 200_000),), 200_000),
+        )
+        status, res = _post(
+            f"{gw.url}/cosmos/tx/v1beta1/txs",
+            {"tx_bytes": base64.b64encode(raw).decode(), "mode":
+             "BROADCAST_MODE_SYNC"},
+        )
+        assert status == 200 and res["tx_response"]["code"] == 0, res
+        txhash = res["tx_response"]["txhash"]
+        assert txhash == tx_hash(raw).hex().upper()
+        status, pending = _get_err(f"{gw.url}/cosmos/tx/v1beta1/txs/{txhash}")
+        assert status == 404  # not yet committed
+        node.produce_block()
+        status, done = _get(f"{gw.url}/cosmos/tx/v1beta1/txs/{txhash}")
+        assert status == 200
+        assert done["tx_response"]["code"] == 0
+        assert int(done["tx_response"]["height"]) >= 1
+
+    def test_unknown_route_is_gateway_shaped(self, api):
+        _, gw, _ = api
+        status, err = _get_err(f"{gw.url}/cosmos/unknown/v1/thing")
+        assert status == 501 and err["code"] == 12
+
+    def test_bad_requests_are_400(self, api):
+        _, gw, _ = api
+        # Unknown (valid-hex) tx hash: NotFound with the grpc code.
+        status, err = _get_err(f"{gw.url}/cosmos/tx/v1beta1/txs/" + "ab" * 32)
+        assert status == 404 and err["code"] == 5
+        # Malformed JSON body on POST: 400 InvalidArgument, not a 500.
+        req = urllib.request.Request(
+            f"{gw.url}/cosmos/tx/v1beta1/txs", data=b"not json{{",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            raise AssertionError("malformed body must not succeed")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400 and json.loads(e.read())["code"] == 3
+        # Bad tx_bytes base64 inside valid JSON: also 400.
+        status, err = _post_err(
+            f"{gw.url}/cosmos/tx/v1beta1/txs", {"tx_bytes": 12345}
+        )
+        assert status == 400 and err["code"] == 3
+        # Malformed pagination params: 400, not an internal error.
+        status, err = _get_err(
+            f"{gw.url}/cosmos/staking/v1beta1/validators?pagination.limit=abc"
+        )
+        assert status == 400 and err["code"] == 3
