@@ -224,6 +224,12 @@ def cmd_start(args) -> int:
         print("FATAL: --peers requires --n-validators (the network's "
               "total validator count)", file=sys.stderr)
         return 1
+    if (getattr(args, "grpc", False) or getattr(args, "api", False)) and not (
+        getattr(args, "serve", False) or peers
+    ):
+        print("FATAL: --grpc/--api require --serve (the planes share the "
+              "serving node)", file=sys.stderr)
+        return 1
     if getattr(args, "serve", False) or peers:
         from celestia_app_tpu.rpc.server import ServingNode, serve as rpc_serve
 

@@ -81,14 +81,25 @@ def _routes(node):
         }
 
     def balances(m, q, body):
+        # Every denom the address holds (the bank store is multi-denom:
+        # IBC voucher denoms live beside utia), denom-sorted as the sdk
+        # pages them.
         from celestia_app_tpu.state.accounts import BankKeeper
 
+        addr = m.group("address")
         with _node_lock(node):
-            amount = BankKeeper(node.app.cms.working).balance(
-                m.group("address"), "utia"
-            )
-        coins = [{"denom": "utia", "amount": str(amount)}] if amount else []
-        return {"balances": coins, "pagination": {"total": str(len(coins))}}
+            all_bals = BankKeeper(node.app.cms.working).balances()
+        coins = sorted(
+            (denom, amount)
+            for (holder, denom), amount in all_bals.items()
+            if holder == addr and amount
+        )
+        return {
+            "balances": [
+                {"denom": d, "amount": str(a)} for d, a in coins
+            ],
+            "pagination": {"total": str(len(coins))},
+        }
 
     def balance_by_denom(m, q, body):
         from celestia_app_tpu.state.accounts import BankKeeper
@@ -101,16 +112,30 @@ def _routes(node):
         return {"balance": {"denom": denom, "amount": str(amount)}}
 
     def validators(m, q, body):
+        # Same pagination engine as the gRPC plane (_paginate): honors the
+        # sdk cursor contract — clients resend next_key as pagination.key.
+        from celestia_app_tpu.rpc.grpc_plane import (
+            _paginate,
+            _parse_page_response,
+        )
+
         with _node_lock(node):
             vals = node.validators()
         try:
-            offset = max(int((q.get("pagination.offset") or ["0"])[0]), 0)
-            limit = max(int((q.get("pagination.limit") or ["0"])[0]), 0)
+            key = base64.b64decode((q.get("pagination.key") or [""])[0])
+            page_req = {
+                "offset": int(key.decode()) if key else max(
+                    int((q.get("pagination.offset") or ["0"])[0]), 0),
+                "limit": max(int((q.get("pagination.limit") or ["0"])[0]), 0),
+                "count_total":
+                    (q.get("pagination.count_total") or ["false"])[0]
+                    == "true",
+                "reverse":
+                    (q.get("pagination.reverse") or ["false"])[0] == "true",
+            }
         except ValueError as e:
             raise _BadRequest(f"invalid pagination: {e}") from e
-        total = len(vals)
-        end = total if not limit else min(offset + limit, total)
-        page = vals[offset:end]
+        page, page_resp = _paginate(vals, page_req)
         out = {
             "validators": [
                 {
@@ -122,12 +147,13 @@ def _routes(node):
             ],
             "pagination": {},
         }
-        if end < total:
+        parsed = _parse_page_response(page_resp)
+        if parsed["next_key"]:
             out["pagination"]["next_key"] = base64.b64encode(
-                str(end).encode()
+                parsed["next_key"]
             ).decode()
-        if (q.get("pagination.count_total") or ["false"])[0] == "true":
-            out["pagination"]["total"] = str(total)
+        if page_req["count_total"]:
+            out["pagination"]["total"] = str(parsed["total"])
         return out
 
     def proposals(m, q, body):

@@ -100,13 +100,17 @@ class TestApiGateway:
         assert status == 200
         assert len(page["validators"]) == 2
         assert page["pagination"]["total"] == "3"
-        next_off = int(base64.b64decode(page["pagination"]["next_key"]))
+        # The sdk cursor contract: resend next_key as pagination.key.
+        next_key = page["pagination"]["next_key"]
         status, rest = _get(
             f"{gw.url}/cosmos/staking/v1beta1/validators"
-            f"?pagination.offset={next_off}"
+            f"?pagination.key={next_key}&pagination.limit=2"
         )
         assert len(rest["validators"]) == 1
         assert rest["validators"][0]["status"] == "BOND_STATUS_BONDED"
+        assert "next_key" not in rest["pagination"]
+        first = page["validators"][0]["operator_address"]
+        assert rest["validators"][0]["operator_address"] != first
 
     def test_module_params(self, api):
         node, gw, _ = api

@@ -18,7 +18,10 @@ def random_ods(k: int, seed: int) -> np.ndarray:
     return ods
 
 
-@pytest.mark.parametrize("k,n", [(8, 8), (8, 4), (16, 8), (4, 2), (2, 2)])
+# (8, 8) dropped from the sweep: (16, 8) covers the 8-device mesh and
+# (8, 4) covers k=8 — the row-per-device edge it added is exercised by
+# (2, 2), and dryrun_multichip certifies k=32/128 on 8 devices besides.
+@pytest.mark.parametrize("k,n", [(8, 4), (16, 8), (4, 2), (2, 2)])
 def test_sharded_matches_single_chip(k, n):
     assert len(jax.devices()) >= n, "conftest must provide 8 virtual devices"
     mesh = default_mesh(n)
