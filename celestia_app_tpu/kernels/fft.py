@@ -99,25 +99,31 @@ def _expand_blocks(field, M: np.ndarray) -> np.ndarray:
     return np.stack([field.expand_bit_matrix(M[h]) for h in range(M.shape[0])])
 
 
-def _apply_groups(bits: jnp.ndarray, groups: tuple, m: int) -> jnp.ndarray:
+def _apply_groups(
+    bits: jnp.ndarray, groups: tuple, m: int, md: bool | None = None
+) -> jnp.ndarray:
     """Run the encode program on bit planes.
 
     bits: (k, m, cols) int8 in {0,1} — symbol-major bit layout (bit b of
     symbol i at [i, b, :]).  Returns the transformed (k, m, cols).
 
-    Two lowerings, byte-identical ($CELESTIA_RS_FFT_MD selects):
+    Two lowerings, byte-identical ($CELESTIA_RS_FFT_MD selects when `md`
+    is None; callers may force one):
       * default — explicit transpose to (hi, B, lo*cols) then a batched
         2D matmul per group;
       * md — one dot_general contracting over BOTH the mid and bit axes
         in their natural positions, no explicit bit-plane transposes:
-        the suspected cost of the measured FFT slowdown (0.359 s vs
+        the suspected cost of the measured TPU FFT slowdown (0.359 s vs
         0.255 s dense at k=512) is exactly those relayouts, so this
-        variant hands the layout problem to XLA instead.  Unmeasured on
-        hardware so far — kept selectable until a chip run decides.
+        variant hands the layout problem to XLA.  On CPU at k=512 it
+        beats dense 2.3x (60.4 s vs 138.1 s steady, 2026-07-31) — the
+        auto policy in kernels/rs.py rides that; on TPU it is still
+        unmeasured and stays an autotune candidate.
     """
     import os
 
-    md = os.environ.get("CELESTIA_RS_FFT_MD") == "1"
+    if md is None:
+        md = os.environ.get("CELESTIA_RS_FFT_MD") == "1"
     k = bits.shape[0]
     cols = bits.shape[2]
     for j0, j1, M in groups:
@@ -148,7 +154,11 @@ def _apply_groups(bits: jnp.ndarray, groups: tuple, m: int) -> jnp.ndarray:
 
 
 def encode_axis_fft(
-    data: jnp.ndarray, k: int, construction: str, contract_axis: int = 1
+    data: jnp.ndarray,
+    k: int,
+    construction: str,
+    contract_axis: int = 1,
+    md: bool | None = None,
 ) -> jnp.ndarray:
     """FFT-encode over `contract_axis` of (A, B, S) uint8 byte shares.
 
@@ -173,7 +183,7 @@ def encode_axis_fft(
             (planes[:, :, None, :] >> jnp.arange(8, dtype=jnp.uint8)[None, None, :, None])
             & 1
         ).astype(_DOT_DTYPE).reshape(n, m, cols)
-        tbits = _apply_groups(bits, groups, m)
+        tbits = _apply_groups(bits, groups, m, md=md)
         pb = tbits.astype(jnp.uint32).reshape(n, bps, 8, cols)
         weights = (jnp.uint32(1) << jnp.arange(8, dtype=jnp.uint32))[None, None, :, None]
         out = (pb * weights).sum(axis=2).astype(jnp.uint8)  # (n, bps, cols)

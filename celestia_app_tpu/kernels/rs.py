@@ -91,22 +91,39 @@ def encode_axis(
     return jnp.moveaxis(by.reshape(P, batch, S), 0, contract_axis)
 
 
-def _use_fft(k: int) -> bool:
-    """Whether the additive-FFT encode (kernels/fft.py) serves size k.
+def _fft_choice(k: int) -> tuple[bool, bool | None]:
+    """(use_fft, force_md) for size k.
 
-    $CELESTIA_RS_FFT: "on" / "off" / "auto" (default).  Auto currently
-    selects the DENSE path everywhere: on the axon TPU the grouped
-    butterflies measured 0.359 s vs 0.255 s dense at k=512 — the ~10x MAC
-    saving is eaten by the bit-plane relayouts between stage groups, so
-    the FFT is kept as the structural-parity oracle (and the future perf
-    path once the relayouts are fused) rather than the default.  Both
-    paths produce identical bytes (tests/test_fft.py pins it), so a stale
-    cached choice is a perf detail, never a correctness hazard — caches
-    key on (k, construction) only.
+    $CELESTIA_RS_FFT: "on" / "off" / "auto" (default). "on" honors
+    $CELESTIA_RS_FFT_MD as before (force_md None = env-controlled).
+
+    Auto is platform- and size-aware, from measurement:
+      * TPU — dense everywhere: the grouped butterflies measured 0.359 s
+        vs 0.255 s dense at k=512 (r3); the transpose-free md variant is
+        unmeasured on the chip, so it stays an autotune candidate
+        (bench parts row) rather than the default;
+      * elsewhere — the md FFT at k >= 512, where dense's O(k^3) MACs
+        overwhelm a CPU: measured 60.4 s vs 138.1 s dense steady-state
+        at k=512 (2026-07-31, this image), dead heat at k=256 (11.7 vs
+        11.6 s), dense faster below.
+    Both paths produce identical bytes (tests/test_fft.py pins it), so a
+    stale cached choice is a perf detail, never a correctness hazard —
+    caches key on (k, construction) only.
     """
     import os
 
-    return os.environ.get("CELESTIA_RS_FFT", "auto") == "on"
+    mode = os.environ.get("CELESTIA_RS_FFT", "auto")
+    if mode == "on":
+        return True, None
+    if mode != "auto":
+        return False, None
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:  # noqa: BLE001 — no backend: tracing only
+        return False, None
+    if platform != "tpu" and k >= 512:
+        return True, True
+    return False, None
 
 
 def _use_pallas_rs(k: int, m: int) -> bool:
@@ -130,11 +147,11 @@ def encode_fn(k: int, construction: str | None = None):
     ONE owner for the FFT-vs-dense-vs-pallas policy — both the single-chip
     square extension and the sharded pipeline build their encode through
     here, so the selection (and any future threshold/env change) cannot
-    diverge between them.  The dense generator matmul is the default
-    everywhere (see _use_fft for the measured rationale);
-    CELESTIA_RS_FFT=on selects the additive-FFT butterflies and
-    CELESTIA_RS_PALLAS=on the fused Pallas dense kernel — identical bytes
-    any way.
+    diverge between them.  Auto picks per platform and size (see
+    _fft_choice for the measured rationale: dense on TPU, md-FFT on other
+    platforms at k >= 512); CELESTIA_RS_FFT=on forces the additive-FFT
+    butterflies and CELESTIA_RS_PALLAS=on the fused Pallas dense kernel —
+    identical bytes any way.
     """
     from celestia_app_tpu.gf.rs import active_construction as _active
 
@@ -142,11 +159,13 @@ def encode_fn(k: int, construction: str | None = None):
     m = codec.field.m
     resolved = construction or _active()
 
-    if _use_fft(k):
+    use_fft, force_md = _fft_choice(k)
+    if use_fft:
         from celestia_app_tpu.kernels.fft import encode_axis_fft
 
         def encode(data: jnp.ndarray, contract_axis: int = 1) -> jnp.ndarray:
-            return encode_axis_fft(data, k, resolved, contract_axis)
+            return encode_axis_fft(data, k, resolved, contract_axis,
+                                   md=force_md)
     elif _use_pallas_rs(k, m):
         from celestia_app_tpu.kernels.rs_pallas import encode_axis_pallas
 

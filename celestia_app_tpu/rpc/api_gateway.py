@@ -83,17 +83,15 @@ def _routes(node):
     def balances(m, q, body):
         # Every denom the address holds (the bank store is multi-denom:
         # IBC voucher denoms live beside utia), denom-sorted as the sdk
-        # pages them.
+        # pages them. Address-scoped prefix walk — the global supply walk
+        # would hold the node lock for O(all accounts).
         from celestia_app_tpu.state.accounts import BankKeeper
 
-        addr = m.group("address")
         with _node_lock(node):
-            all_bals = BankKeeper(node.app.cms.working).balances()
-        coins = sorted(
-            (denom, amount)
-            for (holder, denom), amount in all_bals.items()
-            if holder == addr and amount
-        )
+            bals = BankKeeper(node.app.cms.working).balances_of(
+                m.group("address")
+            )
+        coins = sorted((d, a) for d, a in bals.items() if a)
         return {
             "balances": [
                 {"denom": d, "amount": str(a)} for d, a in coins

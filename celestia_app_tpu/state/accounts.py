@@ -190,6 +190,15 @@ class BankKeeper:
         raw = self.store.get(self._key(address, denom))
         return int.from_bytes(raw, "big") if raw else 0
 
+    def balances_of(self, address: str) -> dict[str, int]:
+        """denom -> amount for one address (the bank AllBalances query):
+        an address-scoped prefix walk, not the global supply walk."""
+        prefix = _BAL_PREFIX + address.encode() + b"/"
+        return {
+            key[len(prefix):].decode(): int.from_bytes(val, "big")
+            for key, val in self.store.iterate(prefix)
+        }
+
     def _set_balance(self, address: str, denom: str, amount: int) -> None:
         if amount < 0:
             raise ValueError("negative balance")
@@ -224,10 +233,15 @@ class BankKeeper:
 
     def balances(self) -> dict[tuple[str, str], int]:
         """(address, denom) -> amount over all accounts — the x/crisis
-        supply invariant walks this."""
+        supply invariant walks this.
+
+        Split at the FIRST '/': bech32 addresses cannot contain one, but
+        IBC voucher denoms do ("port/channel/denom") — an rsplit parsed
+        "addr/transfer/channel-0/uatom" as address "addr/transfer/
+        channel-0" holding "uatom", corrupting the supply walk."""
         out = {}
         for key, val in self.store.iterate(_BAL_PREFIX):
-            addr, denom = key[len(_BAL_PREFIX):].rsplit(b"/", 1)
+            addr, denom = key[len(_BAL_PREFIX):].split(b"/", 1)
             out[(addr.decode(), denom.decode())] = int.from_bytes(val, "big")
         return out
 

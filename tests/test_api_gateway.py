@@ -91,6 +91,23 @@ class TestApiGateway:
         )
         assert status == 404 and missing["code"] == 5
 
+    def test_balances_lists_every_denom(self, api):
+        """The all-balances route walks the multi-denom bank store (IBC
+        voucher denoms live beside utia)."""
+        from celestia_app_tpu.state.accounts import BankKeeper
+
+        node, gw, keys = api
+        addr = keys[2].public_key().address()
+        voucher = "transfer/channel-0/uatom"
+        with node.lock:
+            BankKeeper(node.app.cms.working).mint(addr, 777, denom=voucher)
+        status, bal = _get(f"{gw.url}/cosmos/bank/v1beta1/balances/{addr}")
+        assert status == 200
+        got = {c["denom"]: c["amount"] for c in bal["balances"]}
+        assert got[voucher] == "777"
+        assert int(got["utia"]) > 0
+        assert bal["pagination"]["total"] == "2"
+
     def test_validators_paged(self, api):
         node, gw, _ = api
         status, page = _get(
