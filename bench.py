@@ -275,13 +275,20 @@ def _parts_seconds(ods: np.ndarray, iters: int) -> dict:
     del eds
     del x
     ext = jax.jit(extend_square_fn(k))
-    sha_rows = [("nmt_dah_jnp", "off")]
-    if on_tpu:  # the Pallas kernel has no compiled CPU path
-        sha_rows.append(("nmt_dah_pallas", "on"))
-    saved_sha = os.environ.get("CELESTIA_SHA_PALLAS")
+    sha_rows = [("nmt_dah_jnp", {"CELESTIA_SHA_PALLAS": "off",
+                                 "CELESTIA_SHA_FUSED": "off"})]
+    if on_tpu:  # the Pallas kernels have no compiled CPU path
+        sha_rows.append(("nmt_dah_pallas", {"CELESTIA_SHA_PALLAS": "on",
+                                            "CELESTIA_SHA_FUSED": "off"}))
+        # plf: fused-leaf kernel (message construction in VMEM) for the
+        # leaf level + the lane-parallel kernel for node levels.
+        sha_rows.append(("nmt_dah_plf", {"CELESTIA_SHA_PALLAS": "on",
+                                         "CELESTIA_SHA_FUSED": "on"}))
+    saved_sha = {v: os.environ.get(v)
+                 for v in ("CELESTIA_SHA_PALLAS", "CELESTIA_SHA_FUSED")}
     try:
-        for row_i, (label, flag) in enumerate(sha_rows):
-            os.environ["CELESTIA_SHA_PALLAS"] = flag
+        for row_i, (label, flags) in enumerate(sha_rows):
+            os.environ.update(flags)
             hash_fn = jax.jit(roots_fn(k))
             # Warm on an input DISTINCT from every timed xs[i] (base past
             # the timed range, one per row) — warming on xs[0] would make
@@ -301,10 +308,11 @@ def _parts_seconds(ods: np.ndarray, iters: int) -> dict:
                 del eds_i
             out[label] = _median(times)
     finally:
-        if saved_sha is None:
-            os.environ.pop("CELESTIA_SHA_PALLAS", None)
-        else:
-            os.environ["CELESTIA_SHA_PALLAS"] = saved_sha
+        for var, val in saved_sha.items():
+            if val is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = val
     out["nmt_dah"], out["tuned"] = _pick_tuned(out, on_tpu)
     return out
 
@@ -323,8 +331,10 @@ def _pick_tuned(seconds: dict, on_tpu: bool) -> tuple[float, dict]:
         if label in seconds and seconds[label] < 0.97 * seconds[rs_best]:
             rs_best = label
     sha_best = "pallas" if on_tpu else "jnp"
-    if on_tpu and seconds["nmt_dah_jnp"] < 0.97 * seconds["nmt_dah_pallas"]:
-        sha_best = "jnp"
+    for label in ("jnp", "plf"):
+        key = f"nmt_dah_{label}"
+        if key in seconds and seconds[key] < 0.97 * seconds[f"nmt_dah_{sha_best}"]:
+            sha_best = label
     return seconds[f"nmt_dah_{sha_best}"], {"rs": rs_best, "sha": sha_best}
 
 
@@ -540,9 +550,16 @@ def _run_child() -> None:
                             os.environ["CELESTIA_RS_FFT"] = "off"
                             if tuned["rs"] == "rs_dense_pl":
                                 os.environ["CELESTIA_RS_PALLAS"] = "on"
-                    if "CELESTIA_SHA_PALLAS" not in os.environ:
+                    if (
+                        "CELESTIA_SHA_PALLAS" not in os.environ
+                        and "CELESTIA_SHA_FUSED" not in os.environ
+                    ):
                         os.environ["CELESTIA_SHA_PALLAS"] = (
-                            "on" if tuned["sha"] == "pallas" else "off"
+                            "on" if tuned["sha"] in ("pallas", "plf")
+                            else "off"
+                        )
+                        os.environ["CELESTIA_SHA_FUSED"] = (
+                            "on" if tuned["sha"] == "plf" else "off"
                         )
                     # What later rows ACTUALLY run (operator knobs win
                     # over the tuner) — derived from the final env so the
@@ -562,6 +579,9 @@ def _run_child() -> None:
                     applied_sha = {"on": "pallas", "off": "jnp"}.get(
                         sha_env, "auto"
                     )
+                    if (applied_sha == "pallas"
+                            and os.environ.get("CELESTIA_SHA_FUSED") == "on"):
+                        applied_sha = "plf"
                     emit({
                         "stage": "tuned-applied",
                         "applied": {"rs": applied_rs, "sha": applied_sha},

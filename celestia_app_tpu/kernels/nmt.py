@@ -33,8 +33,24 @@ def leaf_digests(ns: jnp.ndarray, data: jnp.ndarray):
 
     ns: (T, L, 29) uint8, data: (T, L, D) uint8 (the raw shares).
     Returns (mins, maxs, hashes): (T, L, 29), (T, L, 29), (T, L, 32).
+
+    $CELESTIA_SHA_FUSED=on routes full-share leaves through the fused
+    Pallas kernel (message construction + padding in VMEM,
+    kernels/sha256.sha256_leaves_pallas) — identical digests either way.
     """
+    from celestia_app_tpu.kernels.sha256 import (
+        _use_pallas_fused_leaves,
+        sha256_leaves_pallas,
+    )
+
+    from celestia_app_tpu.constants import SHARE_SIZE
+
     t, l, d = data.shape
+    if d == SHARE_SIZE and _use_pallas_fused_leaves(t * l):
+        hashes = sha256_leaves_pallas(
+            ns.reshape(t * l, NAMESPACE_SIZE), data.reshape(t * l, d)
+        ).reshape(t, l, 32)
+        return ns, ns, hashes
     prefix = jnp.zeros((t * l, 1), dtype=jnp.uint8)
     msgs = jnp.concatenate(
         [prefix, ns.reshape(t * l, NAMESPACE_SIZE), data.reshape(t * l, d)], axis=1

@@ -121,7 +121,10 @@ def _fft_choice(k: int) -> tuple[bool, bool | None]:
         platform = jax.devices()[0].platform
     except Exception:  # noqa: BLE001 — no backend: tracing only
         return False, None
-    if platform != "tpu" and k >= 512:
+    if platform == "cpu" and k >= 512:
+        # Only CPU was measured; other accelerators stay on dense until
+        # a measurement says otherwise (GPUs in particular excel at the
+        # dense matmul the FFT avoids).
         return True, True
     return False, None
 

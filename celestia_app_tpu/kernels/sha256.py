@@ -230,6 +230,165 @@ def _sha256_pallas(msgs: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
     return _digest_bytes(out.T[:n])
 
 
+# --------------------------------------------------------------------------
+# Fused NMT-leaf kernel: message construction + padding + packing in VMEM
+# --------------------------------------------------------------------------
+
+from celestia_app_tpu.constants import NAMESPACE_SIZE as _NS, SHARE_SIZE as _SS
+
+_LEAF_LEN = 1 + _NS + _SS  # 0x00 || ns(29) || share(512) = 542
+_LEAF_BLOCKS = 9  # padded to 576 bytes
+
+
+def _leaf_tile_compute(ns_tile, share_tile, tn: int):
+    """The fused per-tile computation: (TN, 29) + (TN, 512) uint8 ->
+    (8, TN) uint32 digest words of 0x00 || ns || share.
+
+    Pure jnp — the pallas kernel wraps exactly this function, and the
+    off-TPU tests jit it directly (interpret mode cannot execute the
+    ~7k-op unrolled round structure in reasonable time)."""
+    k_chunks = _K.reshape(4, 16)
+    # 34 tail bytes (0x80, zeros, bit length) as python ints: a captured
+    # constant ARRAY would have to be a pallas input; scalar fulls go
+    # straight into the kernel as immediates.
+    tail = [int(v) for v in _pad_tail(_LEAF_LEN)]
+
+    def message_block(b: int) -> jnp.ndarray:
+        """(TN, 64) uint8: bytes [64b, 64b+64) of the padded leaf."""
+        if b == 0:
+            prefix = jnp.zeros((tn, 1), dtype=jnp.uint8)
+            return jnp.concatenate(
+                [prefix, ns_tile, share_tile[:, :34]], axis=1
+            )
+        if b < 8:
+            lo = 34 + 64 * (b - 1)
+            return share_tile[:, lo:lo + 64]
+        pad = jnp.concatenate(
+            [jnp.full((tn, 1), v, dtype=jnp.uint8) for v in tail],
+            axis=1,
+        )
+        return jnp.concatenate([share_tile[:, 482:], pad], axis=1)
+
+    a, bb, cc, d, e, f, g, h = (
+        jnp.full((tn,), v, dtype=jnp.uint32) for v in _H0
+    )
+    for b in range(_LEAF_BLOCKS):  # static: shapes fixed per block
+        by = message_block(b).astype(jnp.uint32).reshape(tn, 16, 4)
+        words = (
+            (by[:, :, 0] << np.uint32(24))
+            | (by[:, :, 1] << np.uint32(16))
+            | (by[:, :, 2] << np.uint32(8))
+            | by[:, :, 3]
+        )  # (TN, 16)
+        ws0 = words.T  # tile-local transpose: lanes = messages
+        sa, sb, sc, sd, se, sf, sg, sh = a, bb, cc, d, e, f, g, h
+        ws = [ws0[r] for r in range(16)]
+        for c in range(4):
+            kc = k_chunks[c]
+            for r in range(16):
+                s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+                ch = (e & f) ^ (~e & g)
+                t1 = h + s1 + ch + np.uint32(kc[r]) + ws[r]
+                s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+                maj = (a & bb) ^ (a & cc) ^ (bb & cc)
+                t2 = s0 + maj
+                h, g, f, e, d, cc, bb, a = g, f, e, d + t1, cc, bb, a, t1 + t2
+            if c < 3:
+                for r in range(16):
+                    x15 = ws[(r + 1) % 16]
+                    x2 = ws[(r + 14) % 16]
+                    s0 = _rotr(x15, 7) ^ _rotr(x15, 18) ^ (x15 >> np.uint32(3))
+                    s1 = _rotr(x2, 17) ^ _rotr(x2, 19) ^ (x2 >> np.uint32(10))
+                    ws[r] = ws[r] + s0 + ws[(r + 9) % 16] + s1
+        a, bb, cc, d = sa + a, sb + bb, sc + cc, sd + d
+        e, f, g, h = se + e, sf + f, sg + g, sh + h
+    return jnp.stack((a, bb, cc, d, e, f, g, h), axis=0)
+
+
+def _leaf_kernel(tn: int):
+    """ns_ref (TN, 29) + share_ref (TN, 512) uint8 -> out_ref (8, TN).
+
+    The unfused path materializes every leaf's padded 576-byte message
+    AND its lane-major transpose in HBM (~2.3 GB each way at k=512)
+    before the rounds read them; here each block's 64-byte slice is
+    assembled from the natural-layout refs in VMEM — the prefix byte,
+    namespace, share window, and the constant SHA padding — packed to
+    big-endian words and transposed tile-locally, so HBM sees only the
+    raw shares in and 32-byte digests out.
+    """
+
+    def kernel(ns_ref, share_ref, out_ref):
+        out_ref[...] = _leaf_tile_compute(ns_ref[...], share_ref[...], tn)
+
+    return kernel
+
+
+def sha256_leaves_pallas(
+    ns: jnp.ndarray,
+    shares: jnp.ndarray,
+    interpret: bool = False,
+    tile: int = _LANE_TILE,
+) -> jnp.ndarray:
+    """NMT leaf digests with fused message construction.
+
+    ns: (N, 29) uint8, shares: (N, 512) uint8 -> (N, 32) digests of
+    0x00 || ns || share. Bit-identical to sha256(concat(...)) — pinned
+    by tests/test_sha_fused.py.
+    """
+    from jax.experimental import pallas as pl
+
+    from celestia_app_tpu.constants import NAMESPACE_SIZE
+
+    n = shares.shape[0]
+    assert ns.shape == (n, NAMESPACE_SIZE) and shares.shape[1] == 512, (
+        ns.shape, shares.shape)
+    pad = (-n) % tile
+    if pad:
+        ns = jnp.concatenate(
+            [ns, jnp.zeros((pad, NAMESPACE_SIZE), jnp.uint8)], axis=0)
+        shares = jnp.concatenate(
+            [shares, jnp.zeros((pad, 512), jnp.uint8)], axis=0)
+    total = n + pad
+    out = pl.pallas_call(
+        _leaf_kernel(tile),
+        grid=(total // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, NAMESPACE_SIZE), lambda i: (i, 0)),
+            pl.BlockSpec((tile, 512), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((8, tile), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((8, total), jnp.uint32),
+        interpret=interpret,
+    )(ns, shares)
+    return _digest_bytes(out.T[:n])
+
+
+def _use_pallas_fused_leaves(n: int) -> bool:
+    """$CELESTIA_SHA_FUSED: on / off / auto (default). Auto keeps it OFF
+    everywhere — unmeasured on hardware; the bench parts stage measures
+    it as the nmt_dah_plf candidate and flips this env for the rows it
+    wins. Even when on, tiny batches stay on the jnp path (same
+    4-tile gate as _use_pallas: a near-empty lane tile wastes the
+    kernel)."""
+    import os
+
+    mode = os.environ.get("CELESTIA_SHA_FUSED", "auto")
+    if mode == "off" or pl_missing():
+        return False
+    if mode == "on":
+        return n >= 4 * _LANE_TILE
+    return False
+
+
+def pl_missing() -> bool:
+    try:
+        from jax.experimental import pallas  # noqa: F401
+
+        return False
+    except Exception:  # pragma: no cover
+        return True
+
+
 def _use_pallas(n: int) -> bool:
     """$CELESTIA_SHA_PALLAS: on / off / auto (default).  Auto uses the
     Pallas kernel on TPU for batches big enough to fill the lane tiles;

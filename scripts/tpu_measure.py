@@ -115,11 +115,15 @@ def main() -> int:
     ext = jax.jit(extend_square_fn(k))
     out["sha"] = {}
     roots_got = {}
-    sha_rows = (("jnp", "off"), ("pallas", "on"))
+    sha_rows = (
+        ("jnp", {"CELESTIA_SHA_PALLAS": "off", "CELESTIA_SHA_FUSED": "off"}),
+        ("pallas", {"CELESTIA_SHA_PALLAS": "on", "CELESTIA_SHA_FUSED": "off"}),
+        ("plf", {"CELESTIA_SHA_PALLAS": "on", "CELESTIA_SHA_FUSED": "on"}),
+    )
     if out["platform"] != "tpu":
-        sha_rows = (("jnp", "off"),)  # pallas has no compiled CPU path
-    for label, flag in sha_rows:
-        os.environ["CELESTIA_SHA_PALLAS"] = flag
+        sha_rows = sha_rows[:1]  # pallas kernels have no compiled CPU path
+    for label, sha_flags in sha_rows:
+        os.environ.update(sha_flags)
         fn = jax.jit(roots_fn(k))
         eds_w = ext(warm)
         o = fn(eds_w)
@@ -136,11 +140,13 @@ def main() -> int:
         med = _median(ts)
         out["sha"][label] = round(med, 4)
         print(f"# sha {label}: median {med:.4f}s {ts}", flush=True)
-    os.environ.pop("CELESTIA_SHA_PALLAS", None)
-    if "pallas" in roots_got:
-        for a, b in zip(roots_got["jnp"], roots_got["pallas"]):
-            assert np.array_equal(a, b), "roots diverge between sha paths"
-        out["sha_roots_equal"] = True
+    for var in ("CELESTIA_SHA_PALLAS", "CELESTIA_SHA_FUSED"):
+        os.environ.pop(var, None)
+    for other in ("pallas", "plf"):
+        if other in roots_got:
+            for a, b in zip(roots_got["jnp"], roots_got[other]):
+                assert np.array_equal(a, b), f"roots diverge: jnp vs {other}"
+            out["sha_roots_equal"] = True
 
     # --- full fused pipeline on defaults ---
     from celestia_app_tpu.da.eds import jit_pipeline
