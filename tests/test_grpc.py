@@ -438,3 +438,59 @@ class TestWaitTxSubscription:
         )])
         assert resp.code == 0 and resp.height >= 1
         assert polled == [], "confirm polled tx_status despite wait_tx"
+
+    def test_wait_tx_degrades_to_poll_when_slots_exhausted(
+        self, served, monkeypatch
+    ):
+        """With zero park slots every WaitTx degrades to an immediate
+        status check; the client's re-subscribe loop must still confirm
+        within its deadline (the under-load contract)."""
+        import threading
+
+        from celestia_app_tpu.rpc import grpc_plane as gp
+        from celestia_app_tpu.tx import tx_hash as compute_hash
+        from celestia_app_tpu.tx.sign import Fee, build_and_sign
+
+        node, _ = served
+        monkeypatch.setattr(gp, "_WAIT_TX_MAX_PARKED", 0)
+        # Pin that the degrade path actually runs: with zero slots the
+        # server must consult tx_status (the poll fallback), never park
+        # in node.wait_tx.
+        parked: list = []
+        orig_wait = node.wait_tx
+        monkeypatch.setattr(
+            node, "wait_tx",
+            lambda h, t: parked.append(h) or orig_wait(h, t),
+        )
+        polled: list = []
+        orig_status = node.tx_status
+        monkeypatch.setattr(
+            node, "tx_status",
+            lambda h: polled.append(h) or orig_status(h),
+        )
+        plane = gp.serve_grpc(node)
+        client = gp.GrpcNode(plane.target)
+        try:
+            acc = client.query_account(node.keys[0].public_key().address())
+            raw = build_and_sign(
+                [MsgSend(
+                    node.keys[0].public_key().address(),
+                    node.keys[1].public_key().address(),
+                    (Coin("utia", 11),),
+                )],
+                node.keys[0], node.chain_id, acc.account_number, acc.sequence,
+                Fee((Coin("utia", 200_000),), 200_000),
+            )
+            res = client.broadcast(raw)
+            assert res.code == 0, res.log
+            status = client.wait_tx(compute_hash(raw), timeout_s=30.0)
+            assert status is not None and status[1] == 0
+            assert polled and not parked, (
+                "zero slots must force the tx_status degrade path")
+            # and a hash that never commits still times out cleanly
+            t0 = time.monotonic()
+            assert client.wait_tx(b"\x03" * 32, timeout_s=1.0) is None
+            assert time.monotonic() - t0 < 5.0
+        finally:
+            client.close()
+            plane.stop()
