@@ -171,6 +171,26 @@ class TestProcessProposal:
         bad = BlockData(data.txs, data.square_size, bytes(32))
         assert not node.app.process_proposal(bad)
 
+    def test_own_root_memo_skips_pipeline_but_still_validates(self, node, monkeypatch):
+        """Process on bytes this node just prepared must NOT re-run the
+        device pipeline (the round-5 own-root memo), yet a wrong claimed
+        hash over those same bytes is still rejected — the memo serves
+        OUR computed root for comparison, never the proposer's claim."""
+        from celestia_app_tpu.app import app as app_mod
+
+        data = self._valid_proposal(node)  # prepare warmed the memo
+        calls = []
+        orig = app_mod.extend_shares
+        monkeypatch.setattr(
+            app_mod, "extend_shares",
+            lambda shares: calls.append(len(shares)) or orig(shares),
+        )
+        assert node.app.process_proposal(data)
+        assert calls == [], "memo hit must skip the device pipeline"
+        bad = BlockData(data.txs, data.square_size, b"\x13" * 32)
+        assert not node.app.process_proposal(bad)
+        assert calls == [], "rejection rides the same memoized root"
+
     def test_rejects_wrong_square_size(self, node):
         data = self._valid_proposal(node)
         bad = BlockData(data.txs, data.square_size * 2, data.hash)

@@ -54,3 +54,36 @@ class TestPickTuned:
         nmt, tuned = bench._pick_tuned(s, on_tpu=False)
         assert tuned["sha"] == "jnp"
         assert nmt == 0.7
+
+
+class TestRound5Candidates:
+    """rs_dense_pl (fused Pallas dense) and nmt_dah_plf (fused-leaf SHA)
+    join the A/B: same hysteresis discipline as the older candidates."""
+
+    def test_pallas_dense_takes_seat_on_clear_win(self):
+        s = _seconds()
+        s["rs_dense_pl"] = 0.5
+        _, tuned = bench._pick_tuned(s, on_tpu=True)
+        assert tuned["rs"] == "rs_dense_pl"
+
+    def test_pallas_dense_noise_margin_holds(self):
+        s = _seconds()
+        s["rs_dense_pl"] = 0.98
+        _, tuned = bench._pick_tuned(s, on_tpu=True)
+        assert tuned["rs"] == "rs_dense"
+
+    def test_plf_must_beat_the_pallas_incumbent(self):
+        s = _seconds(pallas=0.5)
+        s["nmt_dah_plf"] = 0.49  # 2%: stays benched
+        nmt, tuned = bench._pick_tuned(s, on_tpu=True)
+        assert tuned["sha"] == "pallas" and nmt == 0.5
+        s["nmt_dah_plf"] = 0.4
+        nmt, tuned = bench._pick_tuned(s, on_tpu=True)
+        assert tuned["sha"] == "plf" and nmt == 0.4
+
+    def test_absent_candidates_never_crash(self):
+        # CPU fallback rows carry neither pallas RS nor plf keys.
+        s = {"rs_dense": 1.0, "rs_fft": 1.2, "rs_fft_md": 1.1,
+             "nmt_dah_jnp": 0.5}
+        nmt, tuned = bench._pick_tuned(s, on_tpu=False)
+        assert tuned == {"rs": "rs_dense", "sha": "jnp"} and nmt == 0.5
