@@ -55,12 +55,11 @@ def _run_chain(keys, n_blocks: int) -> tuple[list[bytes], list]:
     """Serve a validator, push one PFB per block; returns (app hashes,
     committed BlockData) per height.
 
-    App hashes are the cross-run comparison quantity: tx BYTES differ
-    between runs (OpenSSL ECDSA nonces are randomized, unlike the
-    reference's RFC6979), so data roots legitimately differ across runs —
-    but the state machine they execute is identical, hence app-hash
-    equality. Data-root correctness is pinned separately by
-    device-recomputation from each run's own committed txs."""
+    Since round 5's RFC 6979 deterministic signing, tx bytes — hence
+    data roots — are byte-identical across runs too, so both app hashes
+    AND block data hashes are cross-run comparison quantities; bridge
+    output is additionally pinned by device-recomputation from each
+    run's own committed txs."""
     node = ServingNode(
         genesis=deterministic_genesis(keys, n_validators=1),
         keys=keys, validator_index=0, n_validators=1,
@@ -102,7 +101,7 @@ def test_bridge_backend_matches_device_and_survives_worker_kill(
 
     # --- reference chain on the device backend ---
     monkeypatch.delenv("CELESTIA_SQUARE_BACKEND", raising=False)
-    device_hashes, _ = _run_chain(keys, 4)
+    device_hashes, device_blocks = _run_chain(keys, 4)
 
     # --- same chain under the bridge backend, with a mid-run worker kill ---
     monkeypatch.setenv("CELESTIA_SQUARE_BACKEND", "bridge")
@@ -145,6 +144,10 @@ def test_bridge_backend_matches_device_and_survives_worker_kill(
     assert bridge_hashes == device_hashes, (
         "bridge-backed chain's app hashes diverged from the device chain"
     )
+    # Deterministic signing makes data roots cross-run comparable too:
+    # the bridge chain's committed blocks must be byte-identical to the
+    # device chain's.
+    assert [b.hash for b in bridge_blocks] == [b.hash for b in device_blocks]
     # Bridge-produced data roots must be device-identical for the actual
     # committed squares (including the fallback block at i=2).
     monkeypatch.delenv("CELESTIA_SQUARE_BACKEND")
@@ -171,3 +174,31 @@ def test_bridge_fault_falls_back_within_one_call(bridge_lib, monkeypatch):
     want = eds_mod.extend_shares(shares)
     assert got.row_roots() == want.row_roots()
     assert got.data_root() == want.data_root()
+
+
+def test_worker_pins_cpu_under_accelerator_env(bridge_lib, monkeypatch):
+    """The spawned worker must run on the CPU backend even when the
+    parent env carries an accelerator platform: single-session loopback
+    tunnels wedge under two concurrent clients, so the worker defaults
+    to CPU (celestia_app_tpu/bridge/worker.py). Regression guard: if the
+    pin is lost, the worker dials the (dead) tunnel when the extend
+    imports jax, and extend_and_dah below hangs into the harness timeout
+    (ping alone never touches a backend)."""
+    import numpy as np
+
+    from celestia_app_tpu.bridge.client import BridgeClient
+    from celestia_app_tpu.constants import SHARE_SIZE
+
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    # an ambient deployment opt-in would defeat the very pin under test
+    monkeypatch.delenv("CELESTIA_BRIDGE_PLATFORM", raising=False)
+    client = BridgeClient(bridge_lib)
+    try:
+        assert client.ping()
+        rng = np.random.default_rng(2)
+        ods = rng.integers(0, 256, (2, 2, SHARE_SIZE), dtype=np.uint8)
+        eds, _, _, droot = client.extend_and_dah(ods)
+        assert eds.shape == (4, 4, SHARE_SIZE) and len(droot) == 32
+    finally:
+        client.shutdown()
