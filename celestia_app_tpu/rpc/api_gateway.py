@@ -20,6 +20,7 @@ the sdk's gateway emits them.
     GET  /celestia/blob/v1/params
     GET  /cosmos/tx/v1beta1/txs/{hash}
     POST /cosmos/tx/v1beta1/txs        {"tx_bytes": base64, "mode": ...}
+    POST /cosmos/tx/v1beta1/simulate   {"tx_bytes": base64}
 
 Errors follow the gateway shape: {"code": grpc-code, "message": ...}
 with HTTP 404 / 400 / 501 as the sdk maps them.
@@ -226,6 +227,27 @@ def _routes(node):
             }
         }
 
+    def simulate_tx(m, q, body):
+        # POST /cosmos/tx/v1beta1/simulate {"tx_bytes": base64} ->
+        # {"gas_info": {...}} on success or a gateway error with the
+        # node's log; sdk-waiver semantics (signatures/limits waived,
+        # state discarded) via the same App.simulate_tx the gRPC
+        # Simulate serves.
+        try:
+            tx_bytes = base64.b64decode(body["tx_bytes"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise _BadRequest(f"invalid tx_bytes: {e}") from e
+        with _node_lock(node):
+            res = node.app.simulate_tx(tx_bytes)
+        if res.code != 0:
+            raise _BadRequest(f"simulation failed: {res.log}")
+        return {
+            "gas_info": {
+                "gas_wanted": str(res.gas_wanted),
+                "gas_used": str(res.gas_used),
+            }
+        }
+
     def broadcast_tx(m, q, body):
         try:
             tx_bytes = base64.b64decode(body["tx_bytes"])
@@ -256,6 +278,7 @@ def _routes(node):
         ("GET", re.compile(r"^/celestia/blob/v1/params$"), blob_params),
         ("GET", re.compile(r"^/cosmos/tx/v1beta1/txs/(?P<hash>[0-9a-fA-F]+)$"), get_tx),
         ("POST", re.compile(r"^/cosmos/tx/v1beta1/txs$"), broadcast_tx),
+        ("POST", re.compile(r"^/cosmos/tx/v1beta1/simulate$"), simulate_tx),
     ]
 
 

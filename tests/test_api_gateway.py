@@ -202,3 +202,39 @@ class TestApiGateway:
             f"{gw.url}/cosmos/staking/v1beta1/validators?pagination.limit=abc"
         )
         assert status == 400 and err["code"] == 3
+
+    def test_simulate_route(self, api):
+        """POST /cosmos/tx/v1beta1/simulate: sdk-waiver gas estimation
+        over REST, nothing committed."""
+        from celestia_app_tpu.tx.messages import Coin, MsgSend
+        from celestia_app_tpu.tx.sign import Fee, build_and_sign
+
+        node, gw, keys = api
+        addr = keys[0].public_key().address()
+        acc = node.query_account(addr)
+        raw = build_and_sign(
+            [MsgSend(addr, keys[1].public_key().address(),
+                     (Coin("utia", 9),))],
+            keys[0], node.chain_id, acc.account_number, acc.sequence,
+            Fee((Coin("utia", 200_000),), 200_000),
+        )
+        status, res = _post(
+            f"{gw.url}/cosmos/tx/v1beta1/simulate",
+            {"tx_bytes": base64.b64encode(raw).decode()},
+        )
+        assert status == 200
+        used = int(res["gas_info"]["gas_used"])
+        assert 0 < used < 200_000
+        assert node.query_account(addr).sequence == acc.sequence
+        # an over-balance send fails simulation as a 400 with the log
+        bad = build_and_sign(
+            [MsgSend(addr, keys[1].public_key().address(),
+                     (Coin("utia", 10**30),))],
+            keys[0], node.chain_id, acc.account_number, acc.sequence,
+            Fee((Coin("utia", 200_000),), 200_000),
+        )
+        status, err = _post_err(
+            f"{gw.url}/cosmos/tx/v1beta1/simulate",
+            {"tx_bytes": base64.b64encode(bad).decode()},
+        )
+        assert status == 400 and "simulation failed" in err["message"]
