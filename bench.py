@@ -64,11 +64,18 @@ BASELINE_NOTE = (
     "program), so reusing one buffer can measure the relay's memo instead "
     "of the chip. The `parts` row decomposes compute@512 into rs_dense / "
     "rs_fft / rs_fft_md / rs_dense_pl (fused Pallas dense, TPU only) and "
-    "nmt_dah_{jnp,pallas} device seconds, and "
+    "nmt_dah_{jnp,pallas} device seconds, plus a `fused` row: the "
+    "single-dispatch extend_and_dah program (kernels/fused, ODS buffer "
+    "donated) timed under the tuned RS/SHA picks and A/B'd against the "
+    "seated staged extend+hash pair. The parts row "
     "doubles as the autotuner: it runs first and every later row rides "
-    "the fastest measured RS and SHA lowerings (defaults keep the seat "
+    "the fastest measured RS and SHA lowerings and the winning "
+    "fused-vs-staged pipeline (defaults keep the seat "
     "unless a challenger is >3% faster; the chosen config is recorded in "
-    "the parts row's `tuned` field)."
+    "the parts row's `tuned` field). Stream mode double-buffers with a "
+    "dedicated uploader thread and a separate dispatcher (block N+1 "
+    "uploads while block N computes), each streamed block a distinct "
+    "buffer so relay memoization is never what gets measured."
 )
 
 
@@ -308,13 +315,105 @@ def _parts_seconds(ods: np.ndarray, iters: int) -> dict:
                 del eds_i
             out[label] = _median(times)
     finally:
-        for var, val in saved_sha.items():
-            if val is None:
-                os.environ.pop(var, None)
-            else:
-                os.environ[var] = val
-    out["nmt_dah"], out["tuned"] = _pick_tuned(out, on_tpu)
+        _apply_env(saved_sha)
+    out["nmt_dah"], tuned = _pick_tuned(out, on_tpu)
+    # Fused single-dispatch candidate: the whole extend+NMT+DAH program as
+    # ONE executable with the ODS buffer donated (kernels/fused), timed
+    # under the tuner's RS/SHA picks so the A/B against the seated staged
+    # pair is like-for-like.  A fused-only fault must not discard the
+    # completed staged rows, so it degrades to a note instead of raising.
+    try:
+        out["fused"] = _fused_seconds(ods, iters, tuned)
+        tuned["pipe"] = _pick_pipe(out, tuned)
+    except Exception as e:  # noqa: BLE001 — keep the staged measurement
+        out["fused_error"] = f"{type(e).__name__}: {e}"[:200]
+    out["tuned"] = tuned
     return out
+
+
+_TUNE_VARS = (
+    "CELESTIA_RS_FFT", "CELESTIA_RS_FFT_MD", "CELESTIA_RS_PALLAS",
+    "CELESTIA_SHA_PALLAS", "CELESTIA_SHA_FUSED", "CELESTIA_PIPE_FUSED",
+)
+
+
+def _env_for_tuned(tuned: dict) -> dict:
+    """Env assignment that makes the library run the tuner's picks.
+
+    Values of None mean "remove the var".  Shared by the in-parts fused
+    timing and the child's apply step so the two can never disagree about
+    what a pick means."""
+    env: dict = {"CELESTIA_RS_FFT": "off", "CELESTIA_RS_FFT_MD": None,
+                 "CELESTIA_RS_PALLAS": None}
+    if tuned["rs"] in ("rs_fft", "rs_fft_md"):
+        env["CELESTIA_RS_FFT"] = "on"
+        if tuned["rs"] == "rs_fft_md":
+            env["CELESTIA_RS_FFT_MD"] = "1"
+    elif tuned["rs"] == "rs_dense_pl":
+        env["CELESTIA_RS_PALLAS"] = "on"
+    env["CELESTIA_SHA_PALLAS"] = (
+        "on" if tuned["sha"] in ("pallas", "plf") else "off"
+    )
+    env["CELESTIA_SHA_FUSED"] = "on" if tuned["sha"] == "plf" else "off"
+    if "pipe" in tuned:
+        env["CELESTIA_PIPE_FUSED"] = (
+            "off" if tuned["pipe"] == "staged" else "on"
+        )
+    return env
+
+
+def _apply_env(env: dict) -> None:
+    for var, val in env.items():
+        if val is None:
+            os.environ.pop(var, None)
+        else:
+            os.environ[var] = val
+
+
+def _fused_seconds(ods: np.ndarray, iters: int, tuned: dict) -> float:
+    """Device seconds for the fused extend_and_dah program with the ODS
+    donated.  Fresh jax.jit (not the lru-cached module wrapper) so the
+    tuned env flags are re-read at trace time; a DISTINCT pre-uploaded
+    input per iteration (donation consumes each buffer, which also keeps
+    the relay memo hazard away — see _variant)."""
+    import jax
+    import jax.numpy as jnp
+
+    from celestia_app_tpu.kernels.fused import (
+        _silence_unusable_donation_warning,
+        extend_and_dah_fn,
+    )
+
+    k = ods.shape[0]
+    _silence_unusable_donation_warning()  # CPU: donation noise, not signal
+    saved = {v: os.environ.get(v) for v in _TUNE_VARS}
+    try:
+        _apply_env(_env_for_tuned(tuned))
+        fn = jax.jit(extend_and_dah_fn(k), donate_argnums=(0,))
+        warm = jax.device_put(jnp.asarray(_variant(ods, iters)))
+        jax.block_until_ready(fn(warm))  # warmup / compile (consumes warm)
+        times = []
+        for i in range(iters):
+            x = jax.device_put(jnp.asarray(_variant(ods, i)))
+            jax.block_until_ready(x)
+            t0 = time.perf_counter()
+            out = fn(x)
+            jax.block_until_ready(out)
+            times.append(time.perf_counter() - t0)
+            del out  # one EDS live at a time
+        return _median(times)
+    finally:
+        _apply_env(saved)
+
+
+def _pick_pipe(seconds: dict, tuned: dict) -> str:
+    """Fused-vs-staged seat with the same >3% hysteresis as _pick_tuned.
+
+    The fused single-dispatch program is the incumbent (the library
+    default); the staged extend+hash pair — at its own tuned-best RS and
+    SHA lowerings — must beat it by >3% to take the seat."""
+    staged = seconds[tuned["rs"]] + seconds["nmt_dah"]
+    return "staged" if staged < 0.97 * seconds["fused"] else "fused"
 
 
 def _pick_tuned(seconds: dict, on_tpu: bool) -> tuple[float, dict]:
@@ -372,17 +471,15 @@ def _repair_seconds(ods: np.ndarray, iters: int) -> float:
 
 
 def _stream_seconds(ods: np.ndarray, iters: int) -> float:
-    """BASELINE config 5: pipelined block stream — the feeder thread
-    transfers block i+1 while the device computes block i, so steady state
-    approaches max(transfer, compute) instead of their sum."""
-    import jax
-    import jax.numpy as jnp
-
-    from celestia_app_tpu.da.eds import jit_pipeline
+    """BASELINE config 5: pipelined block stream — double-buffered async
+    dispatch.  The pipeline's uploader thread transfers block i+1 while
+    the device computes block i (a separate dispatcher thread keeps the
+    upload lane free of dispatch round-trips), so steady state approaches
+    max(transfer, compute) instead of their sum, and with the fused
+    lowering each uploaded ODS buffer is donated to its dispatch."""
     from celestia_app_tpu.parallel.pipeline import stream_blocks
 
     k = ods.shape[0]
-    jax.block_until_ready(jit_pipeline(k)(jnp.asarray(ods)))  # warmup/compile
 
     # Every streamed block is DISTINCT (see _variant): a cyclic reuse of a
     # few buffers would repeat (executable, args) pairs that the relay
@@ -400,9 +497,9 @@ def _stream_seconds(ods: np.ndarray, iters: int) -> float:
         for i, b in enumerate(blist):
             yield i, b
 
-    list(stream_blocks(feed(warm_blocks), k))  # warm the feeder path
+    list(stream_blocks(feed(warm_blocks), k, depth=2))  # warm the pipeline
     t0 = time.perf_counter()
-    for _tag, eds in stream_blocks(feed(blocks), k):
+    for _tag, eds in stream_blocks(feed(blocks), k, depth=2):
         eds.data_root()  # host sync per block, as a server would
     return (time.perf_counter() - t0) / n
 
@@ -518,9 +615,11 @@ def _run_child() -> None:
             if mode == "parts":
                 parts = _parts_seconds(ods, max(iters, 3))
                 tuned = parts.pop("tuned", None)
+                fused_err = parts.pop("fused_error", None)
                 emit({
                     "stage": name, "mode": mode, "k": k,
                     "parts_seconds": {p: round(s, 4) for p, s in parts.items()},
+                    **({"fused_error": fused_err} if fused_err else {}),
                     "tuned": tuned,
                     "mb": ods_mb,
                     "wall_s": round(time.monotonic() - t_start, 1),
@@ -528,39 +627,26 @@ def _run_child() -> None:
                 })
                 if tuned is not None:
                     # Autotune: every later stage (incl. the headline
-                    # compute rows) rides the fastest measured lowerings.
-                    # Safe because nothing has built jit_pipeline yet —
-                    # parts runs FIRST in the device block and uses fresh
+                    # compute rows) rides the fastest measured lowerings
+                    # and the winning fused-vs-staged pipeline.  Safe
+                    # because nothing has built jit_pipeline yet — parts
+                    # runs FIRST in the device block and uses fresh
                     # jax.jit wrappers, so the process-wide pipeline cache
                     # traces under this env.  An OPERATOR-set knob wins
                     # over the tuner: someone running the bench with
                     # CELESTIA_RS_FFT=on is measuring that path on
                     # purpose (parts saves/restores, so presence here
                     # means the operator set it).
-                    if (
-                        "CELESTIA_RS_FFT" not in os.environ
-                        and "CELESTIA_RS_FFT_MD" not in os.environ
-                        and "CELESTIA_RS_PALLAS" not in os.environ
+                    target = _env_for_tuned(tuned)
+                    for group in (
+                        ("CELESTIA_RS_FFT", "CELESTIA_RS_FFT_MD",
+                         "CELESTIA_RS_PALLAS"),
+                        ("CELESTIA_SHA_PALLAS", "CELESTIA_SHA_FUSED"),
+                        ("CELESTIA_PIPE_FUSED",),
                     ):
-                        if tuned["rs"] in ("rs_fft", "rs_fft_md"):
-                            os.environ["CELESTIA_RS_FFT"] = "on"
-                            if tuned["rs"] == "rs_fft_md":
-                                os.environ["CELESTIA_RS_FFT_MD"] = "1"
-                        else:
-                            os.environ["CELESTIA_RS_FFT"] = "off"
-                            if tuned["rs"] == "rs_dense_pl":
-                                os.environ["CELESTIA_RS_PALLAS"] = "on"
-                    if (
-                        "CELESTIA_SHA_PALLAS" not in os.environ
-                        and "CELESTIA_SHA_FUSED" not in os.environ
-                    ):
-                        os.environ["CELESTIA_SHA_PALLAS"] = (
-                            "on" if tuned["sha"] in ("pallas", "plf")
-                            else "off"
-                        )
-                        os.environ["CELESTIA_SHA_FUSED"] = (
-                            "on" if tuned["sha"] == "plf" else "off"
-                        )
+                        if any(v in os.environ for v in group):
+                            continue  # operator-set knob wins
+                        _apply_env({v: target.get(v) for v in group})
                     # What later rows ACTUALLY run (operator knobs win
                     # over the tuner) — derived from the final env so the
                     # record can never contradict the headline rows.
@@ -582,9 +668,15 @@ def _run_child() -> None:
                     if (applied_sha == "pallas"
                             and os.environ.get("CELESTIA_SHA_FUSED") == "on"):
                         applied_sha = "plf"
+                    applied_pipe = (
+                        "staged"
+                        if os.environ.get("CELESTIA_PIPE_FUSED") == "off"
+                        else "fused"
+                    )
                     emit({
                         "stage": "tuned-applied",
-                        "applied": {"rs": applied_rs, "sha": applied_sha},
+                        "applied": {"rs": applied_rs, "sha": applied_sha,
+                                    "pipe": applied_pipe},
                     })
                 gc.collect()
                 continue

@@ -16,6 +16,7 @@ def extend_block(
     raw_txs: list[bytes],
     gov_max_square_size: int = SQUARE_SIZE_UPPER_BOUND,
     square_size_upper_bound: int = SQUARE_SIZE_UPPER_BOUND,
+    construction: str | None = None,
 ) -> ExtendedDataSquare | None:
     """coretypes.Data -> EDS (extend_block.go:14-26); None for empty blocks.
 
@@ -23,13 +24,19 @@ def extend_block(
     under the benchmark-manifest override (App(square_size_upper_bound=512))
     commits squares wider than the versioned 128 default, and a clamp here
     would rebuild a DIFFERENT square with a different data root.
+
+    The extension rides the fused/staged device seam (kernels/fused) and
+    the RS construction seam: a consensus caller passes `construction` to
+    pin the generator for the block's lifetime, so a mid-block
+    $CELESTIA_RS_CONSTRUCTION flip can never extend with one generator and
+    verify with another.  Outputs are byte-identical on every path.
     """
     if is_empty_block(raw_txs):
         return None
     sq = square.construct(
         raw_txs, min(gov_max_square_size, square_size_upper_bound)
     )
-    return extend_shares(sq.share_bytes())
+    return extend_shares(sq.share_bytes(), construction)
 
 
 def is_empty_block(raw_txs: list[bytes]) -> bool:

@@ -70,7 +70,10 @@ def roots_fn(k: int):
 
 
 def _pipeline(k: int, construction: str):
-    """ods (k,k,512) -> (eds, row_roots (2k,90), col_roots (2k,90), droot (32,))."""
+    """Staged lowering: ods (k,k,512) -> (eds, row_roots (2k,90),
+    col_roots (2k,90), droot (32,)) as extend-then-hash.  Kept as the
+    bench A/B partner of kernels/fused.extend_and_dah_fn (bit-identical;
+    the `parts` autotuner row measures both and seats the winner)."""
     extend = extend_square_fn(k, construction)
     roots = roots_fn(k)
 
@@ -88,11 +91,35 @@ def _jit_pipeline(k: int, construction: str):
 
 
 def jit_pipeline(k: int, construction: str | None = None):
-    """Cached fused pipeline, keyed on (k, RS construction) so an env-var
-    flip mid-process never serves a stale-generator compile.  Callers that
-    must stay on one construction across several dispatches (repair's
-    decode/verify pair, a live BlockPipeline) pass it explicitly."""
-    return _jit_pipeline(k, construction or active_construction())
+    """Cached single-dispatch pipeline, keyed on (k, RS construction) so an
+    env-var flip mid-process never serves a stale-generator compile.
+    Callers that must stay on one construction across several dispatches
+    (repair's decode/verify pair, a live BlockPipeline) pass it explicitly.
+
+    Routes through the fused/staged seam (kernels/fused.pipeline_mode —
+    $CELESTIA_PIPE_FUSED): both lowerings are bit-identical, so the choice
+    is a perf detail, never a correctness hazard.  This entry never
+    donates its argument — callers that own their upload use
+    jit_extend_and_dah(..., donate=True) directly (compute(), the block
+    pipeline's feeder)."""
+    from celestia_app_tpu.kernels.fused import jit_extend_and_dah, pipeline_mode
+
+    construction = construction or active_construction()
+    if pipeline_mode() == "fused":
+        return jit_extend_and_dah(k, construction)
+    return _jit_pipeline(k, construction)
+
+
+def _owned_input_pipeline(k: int, construction: str | None = None):
+    """The pipeline for a caller that OWNS its input buffer (a fresh
+    upload): the donating fused program when the seam says fused, the
+    staged jit otherwise.  compute() and warmup() both resolve through
+    here so a server's warmed compile is exactly the one its blocks run."""
+    from celestia_app_tpu.kernels.fused import jit_extend_and_dah, pipeline_mode
+
+    if pipeline_mode() == "fused":
+        return jit_extend_and_dah(k, construction, donate=True)
+    return jit_pipeline(k, construction)
 
 
 def warmup(
@@ -121,8 +148,16 @@ def warmup(
     for construction in constructions:
         for k in square_sizes:
             ods = np.zeros((k, k, SHARE_SIZE), dtype=np.uint8)
-            out = jit_pipeline(k, construction)(jnp.asarray(ods))
-            jax.block_until_ready(out)
+            # Warm BOTH entries a server dispatches: the donating program
+            # (compute(), the block pipeline's feeder) and the undonated
+            # jit_pipeline (repair's re-extend, which re-reads its input
+            # and must not donate).  Warming only one would leave the
+            # other's first dispatch paying a compile on the block path.
+            owned = _owned_input_pipeline(k, construction)
+            jax.block_until_ready(owned(jnp.asarray(ods)))
+            pipe = jit_pipeline(k, construction)
+            if pipe is not owned:  # staged mode: both entries are one jit
+                jax.block_until_ready(pipe(jnp.asarray(ods)))
     return list(square_sizes)
 
 
@@ -142,12 +177,24 @@ class ExtendedDataSquare:
         return 2 * self.k
 
     @classmethod
-    def compute(cls, ods: np.ndarray) -> "ExtendedDataSquare":
+    def compute(
+        cls, ods: np.ndarray, construction: str | None = None
+    ) -> "ExtendedDataSquare":
         k = ods.shape[0]
         if k & (k - 1) or not 1 <= k <= MAX_CODEC_SQUARE_SIZE:
             raise ValueError(f"invalid square size {k}")
         assert ods.shape == (k, k, SHARE_SIZE), ods.shape
-        eds, rr, cr, droot = jit_pipeline(k)(jnp.asarray(ods, dtype=jnp.uint8))
+        if isinstance(ods, jax.Array):
+            # jnp.asarray is a no-copy pass-through for a device array, so
+            # donating here would invalidate the CALLER'S buffer.  Their
+            # array, their lifetime: take the non-donating pipeline.
+            eds, rr, cr, droot = jit_pipeline(k, construction)(ods)
+        else:
+            # The upload below is this call's own buffer, never read again
+            # — the donating pipeline may reuse it as extension scratch.
+            eds, rr, cr, droot = _owned_input_pipeline(k, construction)(
+                jnp.asarray(ods, dtype=jnp.uint8)
+            )
         return cls(eds, rr, cr, droot, k)
 
     # --- rsmt2d-surface accessors (host copies) ---------------------------
@@ -176,11 +223,15 @@ class ExtendedDataSquare:
         return np.asarray(self._data_root).tobytes()
 
 
-def extend_shares(shares: list[bytes]) -> ExtendedDataSquare:
+def extend_shares(
+    shares: list[bytes], construction: str | None = None
+) -> ExtendedDataSquare:
     """Reference pkg/da/data_availability_header.go:65 ExtendShares parity.
 
     shares: row-major flattened ODS; length must be a square of a power of
-    two within bounds.
+    two within bounds.  `construction` pins the RS generator for callers
+    that must hold one across several calls (a consensus loop mid-block);
+    default resolves the active construction per call.
 
     $CELESTIA_SQUARE_BACKEND=bridge routes the extension through the C ABI
     worker (bridge/, the reference's wrapper/nmt_wrapper.go:73-86 seam for
@@ -202,7 +253,7 @@ def extend_shares(shares: list[bytes]) -> ExtendedDataSquare:
         result = _try_bridge_extend(ods)
         if result is not None:
             return result
-    return ExtendedDataSquare.compute(ods)
+    return ExtendedDataSquare.compute(ods, construction)
 
 
 # --- bridge backend (C ABI worker) -----------------------------------------

@@ -84,12 +84,18 @@ def create_commitments_batched(
         [blobs[i] for i in missing], subtree_root_threshold
     )
     with _COMMIT_MEMO_LOCK:
-        while (len(_COMMIT_MEMO) + len(missing) > _COMMIT_MEMO_MAX
-               and _COMMIT_MEMO):
-            _COMMIT_MEMO.pop(next(iter(_COMMIT_MEMO)))
         for i, c in zip(missing, fresh):
-            _COMMIT_MEMO[keys[i]] = c
             have[keys[i]] = c
+            # FIFO-evict one per insert, so the memo can NEVER exceed its
+            # bound: the old bulk pre-eviction emptied the whole dict when
+            # len(missing) > _COMMIT_MEMO_MAX and then inserted past the
+            # cap anyway (a single oversized batch left the memo holding
+            # the entire flood).
+            if keys[i] in _COMMIT_MEMO:
+                continue
+            while len(_COMMIT_MEMO) >= _COMMIT_MEMO_MAX:
+                _COMMIT_MEMO.pop(next(iter(_COMMIT_MEMO)))
+            _COMMIT_MEMO[keys[i]] = c
     return [have[k] for k in keys]
 
 

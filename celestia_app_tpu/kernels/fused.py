@@ -1,0 +1,162 @@
+"""Fused single-dispatch extend+DAH device pipeline.
+
+One jitted program takes the k x k ODS as a single uint8 array and returns
+the EDS, the 4k row/col NMT roots, and the final DAH data root with no
+intermediate host transfer: RS row-extend -> RS col-extend -> share-to-leaf
+namespace prefixing -> batched SHA-256 tree reduction, all inside one XLA
+dispatch (reference hot path app/prepare_proposal.go:61-71 ->
+pkg/da/data_availability_header.go:44-108).
+
+Differences from the staged composition in da/eds.py's `_pipeline` (which
+chains kernels/rs.extend_square_fn and da/eds.roots_fn):
+
+  * `jit_extend_and_dah(..., donate=True)` donates the ODS argument, so
+    XLA may reuse the caller's share buffer as scratch for the 4x
+    extension instead of holding both live (the HBM high-water mark at
+    k=512 drops by one 134 MB ODS);
+  * a `roots_only` lowering drops the EDS from the outputs entirely —
+    a DAH-only caller (block production needs just the roots once the
+    shares are gossiped elsewhere) lets XLA free every share buffer
+    before the tree reduction finishes;
+  * one compile cache entry and one dispatch own the whole block path, so
+    the autotuner can A/B it as a unit against the staged pair (whose
+    extend/hash halves are also what the `parts` bench decomposes).
+    The leaf schedule itself deliberately matches the staged path — all
+    4k^2 leaves hash in ONE batched call; hashing the two square halves
+    separately (to overlap with the column encode) was tried and measured
+    slower on a serial schedule (smaller SHA batches, no real overlap).
+
+Bit-identity with the staged path is pinned by tests/test_fused_pipeline.py
+on the reference golden vectors; the bench autotuner (bench.py `parts` row)
+measures `fused` against the seated staged RS + NMT pair and keeps
+whichever wins.
+
+Selection seam: $CELESTIA_PIPE_FUSED = "on" / "off" / "auto" (default:
+fused).  da/eds.jit_pipeline routes through `pipeline_mode()`, so every
+caller — ExtendedDataSquare, extend_block, BlockPipeline, repair's
+re-extend — flips together and none can diverge.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+
+from celestia_app_tpu.constants import NAMESPACE_SIZE, PARITY_NAMESPACE_BYTES
+from celestia_app_tpu.gf.rs import active_construction
+from celestia_app_tpu.kernels.merkle import merkle_root_pow2
+from celestia_app_tpu.kernels.nmt import leaf_digests, tree_roots_from_digests
+from celestia_app_tpu.kernels.rs import encode_fn
+
+@lru_cache(maxsize=None)
+def _silence_unusable_donation_warning() -> None:
+    """On backends without donation support (CPU), every donated dispatch
+    warns and keeps the copy — expected, not actionable, so filter it the
+    first time a donating program is built there.  Donation-capable
+    backends keep the warning live: a donation that silently stops taking
+    effect is a real perf regression someone should see."""
+    if jax.default_backend() == "cpu":
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable"
+        )
+
+
+def pipeline_mode() -> str:
+    """The active extend+DAH lowering: "fused" (default) or "staged".
+
+    $CELESTIA_PIPE_FUSED: "on" / "off" / "auto" (default).  Auto is fused —
+    the fused program is bit-identical to the staged pair (pinned on the
+    golden vectors) and at worst matches it, so the staged path exists as a
+    bench A/B candidate and an escape hatch, not a default.  The bench
+    autotuner flips this env for the rows the staged pair wins.
+    """
+    return "staged" if os.environ.get("CELESTIA_PIPE_FUSED", "auto") == "off" else "fused"
+
+
+def extend_and_dah_fn(
+    k: int, construction: str | None = None, roots_only: bool = False
+):
+    """Build the fused program for square size k.
+
+    Returns f(ods) where ods is (k, k, SHARE_SIZE) uint8:
+      roots_only=False -> (eds, row_roots, col_roots, droot)
+      roots_only=True  -> (row_roots, col_roots, droot)
+    with eds (2k, 2k, S), roots (2k, 90), droot (32,).  The RS construction
+    is resolved at build time; callers caching the result must key on it.
+    """
+    encode = encode_fn(k, construction)
+
+    def run(ods: jnp.ndarray):
+        parity = jnp.frombuffer(PARITY_NAMESPACE_BYTES, dtype=jnp.uint8)
+        # Row phase: each of the k rows is a codeword batch along columns.
+        q1 = encode(ods, 1)  # (k, k, S)
+        top = jnp.concatenate([ods, q1], axis=1)  # (k, 2k, S)
+        # Column phase contracts over the row axis directly — Q2/Q3 arrive
+        # as the bottom rows with no transpose (row/col encodes commute).
+        bottom = encode(top, 0)  # (k, 2k, S)
+        eds = jnp.concatenate([top, bottom], axis=0)  # (2k, 2k, S)
+
+        # Q0 leaves carry the share's own namespace, every parity leaf the
+        # parity namespace (pkg/wrapper/nmt_wrapper.go:93-114).  All 4k^2
+        # leaves hash in ONE batched call — splitting by half measured
+        # slower (smaller SHA batches, same serial schedule).
+        idx = jnp.arange(2 * k)
+        q0 = (idx[:, None] < k) & (idx[None, :] < k)
+        row_ns = jnp.where(q0[..., None], eds[..., :NAMESPACE_SIZE], parity)
+
+        # The digest at (i, j) serves both the row-i and col-j trees, so
+        # each leaf is hashed exactly once and the column reduction runs on
+        # the transpose (leaf hashing is 9 SHA-256 blocks vs 3 for nodes).
+        mins, maxs, hashes = leaf_digests(row_ns, eds)
+        row_roots = tree_roots_from_digests(mins, maxs, hashes)  # (2k, 90)
+        col_roots = tree_roots_from_digests(
+            mins.transpose(1, 0, 2),
+            maxs.transpose(1, 0, 2),
+            hashes.transpose(1, 0, 2),
+        )
+        droot = merkle_root_pow2(
+            jnp.concatenate([row_roots, col_roots], axis=0)
+        )
+        if roots_only:
+            return row_roots, col_roots, droot
+        return eds, row_roots, col_roots, droot
+
+    return run
+
+
+@lru_cache(maxsize=None)
+def _jit_extend_and_dah(
+    k: int, construction: str, donate: bool, roots_only: bool
+):
+    if donate:
+        _silence_unusable_donation_warning()
+    return jax.jit(
+        extend_and_dah_fn(k, construction, roots_only),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def jit_extend_and_dah(
+    k: int,
+    construction: str | None = None,
+    *,
+    donate: bool = False,
+    roots_only: bool = False,
+):
+    """Cached jitted fused pipeline, keyed on (k, RS construction, donate,
+    roots_only).
+
+    donate=True invalidates the caller's ODS device buffer — only pass it
+    for a buffer the pipeline owns (a fresh `jnp.asarray` upload, a feeder
+    thread's `device_put`), never a view of state the caller reads after
+    the call (repair's survivor check re-reads its input, so it must not
+    donate).  Backends without donation support (this image's CPU) ignore
+    the hint and keep the copy — semantics are unchanged either way.
+    """
+    return _jit_extend_and_dah(
+        k, construction or active_construction(), donate, roots_only
+    )

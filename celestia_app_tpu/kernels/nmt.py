@@ -76,6 +76,21 @@ def reduce_level(mins, maxs, hashes):
     return lm, pmax, ph
 
 
+def reduce_to_width(mins, maxs, hashes, width: int = 1):
+    """Reduce T trees' digest levels (T, L, .) down to (T, width, .).
+
+    L and width must be powers of two with width <= L.  width > 1 yields
+    the subtree nodes at that level — the multi-chip row-tree path reduces
+    each device's aligned column block to one node per row, all-gathers
+    the 90-byte nodes, and finishes the top log2(n_devices) levels with a
+    second call (parallel/sharded_eds.py), so only roots cross the
+    interconnect, never shares.
+    """
+    while hashes.shape[1] > width:
+        mins, maxs, hashes = reduce_level(mins, maxs, hashes)
+    return mins, maxs, hashes
+
+
 def tree_levels_from_digests(mins, maxs, hashes):
     """Reduce T trees level-by-level starting from precomputed leaf digests.
 

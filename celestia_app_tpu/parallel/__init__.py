@@ -2,8 +2,14 @@
 
 from celestia_app_tpu.parallel.sharded_eds import (
     default_mesh,
+    make_sharded_dah_pipeline,
     make_sharded_pipeline,
     sharded_extend_and_dah,
 )
 
-__all__ = ["default_mesh", "make_sharded_pipeline", "sharded_extend_and_dah"]
+__all__ = [
+    "default_mesh",
+    "make_sharded_dah_pipeline",
+    "make_sharded_pipeline",
+    "sharded_extend_and_dah",
+]

@@ -1,0 +1,34 @@
+"""jax version compatibility for the multi-chip code.
+
+`shard_map` graduated from jax.experimental to the top-level namespace
+(and its replication-check kwarg was renamed check_rep -> check_vma)
+across the jax versions this package meets; resolve both here so the
+sharded pipelines import one symbol with one signature.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.5
+    from jax import shard_map as _shard_map
+except ImportError:  # this image's 0.4.x: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map(f, *, mesh, in_specs, out_specs):
+    """shard_map with the replication check disabled, any jax version.
+
+    The check is disabled because the pipelines emit replicated outputs
+    produced via all_gather inside the body, which the static checker
+    cannot always prove replicated (it is — every device computes the
+    same reduction of the same gathered bytes).
+    """
+    try:
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    except TypeError:  # older kwarg name
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )

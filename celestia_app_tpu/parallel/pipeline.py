@@ -2,21 +2,31 @@
 
 The reference processes blocks serially per height; the mainnet-replay
 benchmark config instead streams consecutive blocks through the device.
-Two overlaps compose here:
+Three overlaps compose here:
 
   * device-side: JAX dispatch is asynchronous, so the fused
     extend/NMT/DAH program for block i+1 queues behind block i without
     host involvement;
   * host-side: the host->device share transfer is driven by a dedicated
-    feeder thread, so block i+1's ODS streams in WHILE block i computes.
+    uploader thread, so block i+1's ODS streams in WHILE block i computes.
     This is the part async dispatch alone cannot give: `device_put` of a
     fresh buffer blocks the calling thread for the full transfer (the
     dominant cost when the device sits behind a network tunnel —
-    measured ~0.25s vs ~0.08s compute at k=128), so without the feeder
-    the pipeline degrades to transfer+compute serial time.
+    measured ~0.25s vs ~0.08s compute at k=128), so without the uploader
+    the pipeline degrades to transfer+compute serial time;
+  * upload/dispatch split: transfer and program dispatch run on SEPARATE
+    threads (double-buffered hand-off through a bounded queue), so the
+    uploader starts block i+1's transfer the moment its slot frees instead
+    of first waiting out block i's dispatch call — on a tunnel-backed
+    device a dispatch round-trip is milliseconds of dead link time per
+    block that the split reclaims.
 
 `BlockPipeline` bounds in-flight blocks (double buffering by default) so
-HBM holds at most `depth` extended squares.
+HBM holds at most `depth` extended squares.  When the fused lowering is
+active (kernels/fused.pipeline_mode), each uploaded ODS buffer is DONATED
+to its dispatch — the pipeline owns the upload, nothing re-reads it, and
+XLA may reuse it as extension scratch, which is what keeps depth>1
+affordable at k=512 (one 134 MB scratch saved per in-flight block).
 """
 
 from __future__ import annotations
@@ -28,7 +38,7 @@ from dataclasses import dataclass
 import jax
 import numpy as np
 
-from celestia_app_tpu.da.eds import ExtendedDataSquare, jit_pipeline
+from celestia_app_tpu.da.eds import ExtendedDataSquare, _owned_input_pipeline
 from celestia_app_tpu.gf.rs import active_construction
 from celestia_app_tpu.trace import traced
 
@@ -43,7 +53,8 @@ class _InFlight:
 
 
 class BlockPipeline:
-    """Bounded-depth asynchronous square pipeline with a transfer feeder."""
+    """Bounded-depth asynchronous square pipeline with a transfer uploader
+    and a separate dispatcher (double-buffered upload/compute overlap)."""
 
     def __init__(self, k: int, depth: int = 2):
         if depth < 1:
@@ -54,32 +65,62 @@ class BlockPipeline:
         # every block it streams uses this one generator, even if
         # $CELESTIA_RS_CONSTRUCTION flips while blocks are in flight.
         self.construction = active_construction()
-        self._pipe = jit_pipeline(k, self.construction)
-        # submit -> _tasks -> [feeder thread: transfer + dispatch] -> _done
-        # Both queues bounded by depth: at most `depth` squares in flight
-        # on the device and `depth` ODS buffers waiting to transfer.
+        # The pipeline owns each uploaded buffer and uses it exactly once,
+        # so it rides the owned-input entry: the donating fused program by
+        # default, the staged jit when the seam says staged.
+        self._pipe = _owned_input_pipeline(k, self.construction)
+        # submit -> _tasks -> [uploader: device_put] -> _staged
+        #        -> [dispatcher: program dispatch] -> _done
+        # _tasks/_done bounded by depth: at most `depth` squares in flight
+        # on the device and `depth` host buffers waiting to transfer.
+        # _staged is a SINGLE-slot hand-off — dispatch is a cheap async
+        # enqueue, so one transferred-but-undispatched ODS is all the
+        # overlap needs, and the device high-water mark stays at the
+        # documented `depth` squares instead of depth + staged uploads.
         self._tasks: queue.Queue = queue.Queue(maxsize=depth)
+        self._staged: queue.Queue = queue.Queue(maxsize=1)
         self._done: queue.Queue = queue.Queue(maxsize=depth)
         self._error: BaseException | None = None
         self._stopping = False
         self._closed = False
-        self._feeder = threading.Thread(target=self._feed, daemon=True)
-        self._feeder.start()
+        self._finished = False  # a _done sentinel has been consumed
+        self._uploader = threading.Thread(target=self._upload, daemon=True)
+        self._dispatcher = threading.Thread(target=self._dispatch, daemon=True)
+        self._uploader.start()
+        self._dispatcher.start()
 
-    def _feed(self) -> None:
+    def _upload(self) -> None:
         failed = False
         while True:
             item = self._tasks.get()
             if item is _SENTINEL:
-                self._done.put(_SENTINEL)
+                self._staged.put(_SENTINEL)
                 return
             if failed or self._stopping:
                 continue  # keep consuming so no producer blocks forever
             ods, tag = item
             try:
                 x = jax.device_put(np.ascontiguousarray(ods))
-                out = self._pipe(x)
             except BaseException as e:  # surfaced on the next drain
+                self._error = e
+                self._staged.put(_SENTINEL)
+                failed = True
+                continue
+            self._staged.put((x, tag))
+
+    def _dispatch(self) -> None:
+        failed = False
+        while True:
+            item = self._staged.get()
+            if item is _SENTINEL:
+                self._done.put(_SENTINEL)
+                return
+            if failed or self._stopping:
+                continue
+            x, tag = item
+            try:
+                out = self._pipe(x)
+            except BaseException as e:
                 self._error = e
                 self._done.put(_SENTINEL)
                 failed = True
@@ -104,6 +145,7 @@ class BlockPipeline:
     def _drain_one(self) -> tuple[object, ExtendedDataSquare]:
         inflight = self._done.get()
         if inflight is _SENTINEL:
+            self._finished = True
             if self._error is not None:
                 raise RuntimeError("pipeline feeder failed") from self._error
             raise RuntimeError("pipeline is closed")
@@ -113,38 +155,48 @@ class BlockPipeline:
         """Close the intake and yield (tag, ExtendedDataSquare) for every
         remaining block, in order."""
         self._closed = True
-        self._tasks.put(_SENTINEL)  # feeder always consumes: cannot block
+        self._tasks.put(_SENTINEL)  # both stages always consume: cannot block
         while True:
             inflight = self._done.get()
             if inflight is _SENTINEL:
+                self._finished = True
                 if self._error is not None:
                     raise RuntimeError("pipeline feeder failed") from self._error
                 return
             yield self._materialize(inflight)
 
     def close(self) -> None:
-        """Abandon the pipeline: stop the feeder and drop pending results
-        (early-exit path — device buffers held by _done are released)."""
-        if self._closed:
+        """Abandon the pipeline: stop both stages and drop pending results
+        (early-exit path — device buffers held by _done are released).
+
+        Keyed on _finished, NOT _closed: abandoning a drain() mid-stream
+        leaves _closed set with results still queued, and an early return
+        there would strand the dispatcher blocked on a full _done holding
+        `depth` extended squares for the process lifetime."""
+        if self._finished:
             return
-        self._closed = True
-        self._stopping = True  # feeder discards anything still queued
-        self._tasks.put(_SENTINEL)
-        # Unblock the feeder if _done is full, and drop held outputs.
+        self._stopping = True  # stages discard anything still queued
+        if not self._closed:
+            self._closed = True
+            self._tasks.put(_SENTINEL)
+        # Unblock the stages if their output queues are full, and drop
+        # held outputs.
         while True:
             item = self._done.get()
             if item is _SENTINEL:
                 break
-        self._feeder.join(timeout=5)
+        self._finished = True
+        self._uploader.join(timeout=5)
+        self._dispatcher.join(timeout=5)
 
 
 def stream_blocks(ods_iter, k: int, depth: int = 2):
     """Stream squares through the device with `depth`-deep overlap.
 
     Yields (tag, ExtendedDataSquare) in submission order; with depth=2 the
-    feeder transfers block i+1 while the device computes block i and the
+    uploader transfers block i+1 while the device computes block i and the
     caller consumes block i-1 (the v5e-4 double-buffering shape of
-    BASELINE config 5).  Abandoning the generator early stops the feeder
+    BASELINE config 5).  Abandoning the generator early stops the stages
     and releases in-flight device buffers."""
     pipe = BlockPipeline(k, depth)
     finished = False

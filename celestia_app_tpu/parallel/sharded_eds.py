@@ -5,14 +5,24 @@ TPU-native mapping of the reference's per-axis parallelism (SURVEY §2.4):
   P2  row/column axis parallelism  -> the ODS is sharded row-wise across the
       mesh; each device RS-extends and NMT-hashes only its row block.
   P4  transpose between phases     -> one `all_to_all` over ICI re-shards the
-      row-extended top half column-wise for the column encode, and a second
-      one brings the finished EDS back to row sharding for the row trees.
-      This is the ring-attention / context-parallel analog for this workload
+      row-extended top half column-wise for the column encode.  This is the
+      ring-attention / context-parallel analog for this workload
       (reference: implicit transpose inside rsmt2d, goroutines per axis;
       pkg/da/data_availability_header.go:74).
 
-Root gathering is left to the outer jit: per-device root blocks (2k/n x 90
-bytes) are tiny, and XLA inserts the all_gather for the final DAH merkle
+Row trees never move shares back: each device's column block is a
+CONTIGUOUS, ALIGNED power-of-two slice of every row, so its leaf digests
+reduce locally to ONE subtree node per row; a single `all_gather` of those
+90-byte nodes (2k x 90 per device — vs 2k x 2k/n x 512 of shares) feeds the
+top log2(n) levels, computed replicated.  Shares cross the interconnect
+exactly once, in the column-phase reshard; everything after ships only
+roots.  `make_sharded_dah_pipeline` drops the EDS output entirely for
+DAH-only callers, so no share ever re-crosses the ICI (the second share
+`all_to_all` in `make_sharded_pipeline` exists purely to hand the caller a
+row-sharded EDS).
+
+Per-device column-root blocks (2k/n x 90 bytes) stay sharded out of the
+shard_map; XLA inserts the tiny all_gather for the final DAH merkle
 (pkg/da/data_availability_header.go:92-108) wherever it is cheapest.
 
 All arithmetic is integer (uint8/int32 matmuls + SHA-256), so the sharded
@@ -28,8 +38,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from celestia_app_tpu.parallel._compat import shard_map
 
 from celestia_app_tpu.constants import (
     NAMESPACE_SIZE,
@@ -38,30 +49,26 @@ from celestia_app_tpu.constants import (
 )
 from celestia_app_tpu.gf.rs import active_construction
 from celestia_app_tpu.kernels.merkle import merkle_root_pow2
-from celestia_app_tpu.kernels.nmt import leaf_digests, tree_roots_from_digests
+from celestia_app_tpu.kernels.nmt import (
+    leaf_digests,
+    reduce_to_width,
+    tree_roots_from_digests,
+)
 
 
 def _parity_ns() -> jnp.ndarray:
     return jnp.frombuffer(PARITY_NAMESPACE_BYTES, dtype=jnp.uint8)
 
 
-def make_sharded_pipeline(
-    k: int, mesh: Mesh, axis: str = "data", construction: str | None = None
-):
-    """Build the jitted multi-device pipeline for square size k.
+def _local_extend_and_roots(k: int, n: int, axis: str, _encode):
+    """The shared per-device body: row-sharded ODS block in ->
+    (full_cols, row_roots, col_roots_local).
 
-    Returns f(ods) -> (eds, row_roots, col_roots, data_root) where ods is
-    (k, k, SHARE_SIZE) uint8 sharded P(axis, None, None); eds comes back
-    row-sharded, roots and data root replicated.
-
-    Requires n | k (each device owns k/n ODS rows and 2k/n EDS rows/cols).
+    full_cols is this device's column block of the finished EDS
+    ((2k/n, 2k, S), column-major); row_roots (2k, 90) are REPLICATED —
+    finished from a 90-byte subtree all_gather, never a share reshard;
+    col_roots_local (2k/n, 90) stay sharded.
     """
-    n = mesh.shape[axis]
-    if k % n:
-        raise ValueError(f"device count {n} must divide square size {k}")
-    from celestia_app_tpu.kernels.rs import encode_fn
-
-    _encode = encode_fn(k, construction)
 
     def local_step(ods_local: jnp.ndarray):
         # ods_local: (k/n, k, S) — this device's row block of the ODS.
@@ -77,7 +84,7 @@ def make_sharded_pipeline(
         top_local = lax.optimization_barrier(top_local)
 
         # P4: re-shard column-wise. Device j ends up with all k top rows of
-        # its 2k/n-column block.
+        # its 2k/n-column block.  The ONLY collective that moves shares.
         cols_blk = lax.all_to_all(
             top_local, axis, split_axis=1, concat_axis=0, tiled=True
         )  # (k, 2k/n, S)
@@ -100,36 +107,73 @@ def make_sharded_pipeline(
             col_q0[..., None], full_cols[..., :NAMESPACE_SIZE], parity
         )
         # The leaf digest at grid position (row, col) is identical for the
-        # row tree and the col tree, so hash each leaf exactly once (here,
-        # column-sharded) and ship the 61-byte (ns, digest) pairs — not the
-        # 512-byte shares — through the resharding all_to_all for the row
-        # reduction. Leaf hashing is 9 SHA-256 blocks/leaf vs 3 for inner
-        # nodes; this halves the dominant hash cost per device.
+        # row tree and the col tree, so hash each leaf exactly once.  Leaf
+        # hashing is 9 SHA-256 blocks/leaf vs 3 for inner nodes; hashing on
+        # the column-sharded layout halves the dominant cost per device.
         lmins, _, lhash = leaf_digests(col_ns, full_cols)
         col_roots_local = tree_roots_from_digests(lmins, lmins, lhash)
 
-        # P4 again: back to row sharding for the row trees and the output.
-        # Shares and leaf digests ride one fused all_to_all: concatenate the
-        # 61-byte (ns, digest) packs onto the 512-byte shares so the reshard
-        # is a single ICI collective instead of two.
-        leaf_pack = jnp.concatenate([full_cols, lmins, lhash], axis=2)
-        row_pack = lax.all_to_all(
-            leaf_pack.transpose(1, 0, 2), axis, split_axis=0, concat_axis=1,
-            tiled=True,
-        )  # (2k/n, 2k, S+61) — this device's EDS row block + leaf digests.
-        rows_blk = row_pack[..., :SHARE_SIZE]
-        rmins = row_pack[..., SHARE_SIZE : SHARE_SIZE + NAMESPACE_SIZE]
-        rhash = row_pack[..., SHARE_SIZE + NAMESPACE_SIZE :]
-        row_roots_local = tree_roots_from_digests(rmins, rmins, rhash)
+        # Row trees WITHOUT re-sharding shares: this device's 2k/n columns
+        # are a contiguous, aligned power-of-two slice of every row tree's
+        # leaves, so they reduce locally to one subtree node per row.  Only
+        # those 90-byte nodes cross the ICI; the top log2(n) levels run
+        # replicated on every device.
+        rmins_l = lmins.transpose(1, 0, 2)  # (2k, 2k/n, 29): T=rows
+        rhash_l = lhash.transpose(1, 0, 2)
+        smin, smax, shash = reduce_to_width(rmins_l, rmins_l, rhash_l, 1)
+        sub = jnp.concatenate(
+            [smin[:, 0], smax[:, 0], shash[:, 0]], axis=1
+        )  # (2k, 90) — this device's per-row subtree node
+        gathered = lax.all_gather(sub, axis)  # (n, 2k, 90), replicated
+        g = gathered.transpose(1, 0, 2)  # (2k, n, 90): L=device blocks
+        gm = g[..., :NAMESPACE_SIZE]
+        gx = g[..., NAMESPACE_SIZE : 2 * NAMESPACE_SIZE]
+        gh = g[..., 2 * NAMESPACE_SIZE :]
+        tm, tx, th = reduce_to_width(gm, gx, gh, 1)
+        row_roots = jnp.concatenate(
+            [tm[:, 0], tx[:, 0], th[:, 0]], axis=1
+        )  # (2k, 90), replicated
 
-        return rows_blk, row_roots_local, col_roots_local
+        return full_cols, row_roots, col_roots_local
+
+    return local_step
+
+
+def make_sharded_pipeline(
+    k: int, mesh: Mesh, axis: str = "data", construction: str | None = None
+):
+    """Build the jitted multi-device pipeline for square size k.
+
+    Returns f(ods) -> (eds, row_roots, col_roots, data_root) where ods is
+    (k, k, SHARE_SIZE) uint8 sharded P(axis, None, None); eds comes back
+    row-sharded, roots and data root replicated.
+
+    Requires n | k (each device owns k/n ODS rows and 2k/n EDS rows/cols).
+    """
+    n = mesh.shape[axis]
+    if k % n:
+        raise ValueError(f"device count {n} must divide square size {k}")
+    from celestia_app_tpu.kernels.rs import encode_fn
+
+    _encode = encode_fn(k, construction)
+    body = _local_extend_and_roots(k, n, axis, _encode)
+
+    def local_step(ods_local: jnp.ndarray):
+        full_cols, row_roots, col_roots_local = body(ods_local)
+        # Hand the caller a ROW-sharded EDS: one more share all_to_all,
+        # existing purely for the output layout (roots are already done).
+        full_cols = lax.optimization_barrier(full_cols)
+        rows_blk = lax.all_to_all(
+            full_cols.transpose(1, 0, 2), axis, split_axis=0, concat_axis=1,
+            tiled=True,
+        )  # (2k/n, 2k, S) — this device's EDS row block.
+        return rows_blk, row_roots, col_roots_local
 
     sharded = shard_map(
         local_step,
         mesh=mesh,
         in_specs=P(axis, None, None),
-        out_specs=(P(axis, None, None), P(axis, None), P(axis, None)),
-        check_vma=False,
+        out_specs=(P(axis, None, None), P(), P(axis, None)),
     )
 
     def pipeline(ods: jnp.ndarray):
@@ -141,6 +185,50 @@ def make_sharded_pipeline(
     rep = NamedSharding(mesh, P())
     return jax.jit(
         pipeline, in_shardings=in_sh, out_shardings=(in_sh, rep, rep, rep)
+    )
+
+
+def make_sharded_dah_pipeline(
+    k: int, mesh: Mesh, axis: str = "data", construction: str | None = None
+):
+    """DAH-only multi-device pipeline: f(ods) -> (row_roots, col_roots,
+    data_root), all replicated — no EDS output.
+
+    Shares cross the ICI exactly once (the column-phase all_to_all);
+    everything gathered afterwards is 90-byte roots.  This is the MULTICHIP
+    bench row's lowering and the right entry for a DAH-only caller (block
+    production where shares are gossiped from the builder, light-client
+    header service); when the square itself is needed, use
+    make_sharded_pipeline.  Bit-identical roots to the single-chip path.
+    """
+    n = mesh.shape[axis]
+    if k % n:
+        raise ValueError(f"device count {n} must divide square size {k}")
+    from celestia_app_tpu.kernels.rs import encode_fn
+
+    _encode = encode_fn(k, construction)
+    body = _local_extend_and_roots(k, n, axis, _encode)
+
+    def local_step(ods_local: jnp.ndarray):
+        _full_cols, row_roots, col_roots_local = body(ods_local)
+        return row_roots, col_roots_local
+
+    sharded = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=P(axis, None, None),
+        out_specs=(P(), P(axis, None)),
+    )
+
+    def pipeline(ods: jnp.ndarray):
+        row_roots, col_roots = sharded(ods)
+        droot = merkle_root_pow2(jnp.concatenate([row_roots, col_roots], axis=0))
+        return row_roots, col_roots, droot
+
+    in_sh = NamedSharding(mesh, P(axis, None, None))
+    rep = NamedSharding(mesh, P())
+    return jax.jit(
+        pipeline, in_shardings=in_sh, out_shardings=(rep, rep, rep)
     )
 
 

@@ -23,8 +23,9 @@ from functools import lru_cache
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from celestia_app_tpu.parallel._compat import shard_map
 
 from celestia_app_tpu.constants import SHARE_SIZE
 from celestia_app_tpu.da.dah import DataAvailabilityHeader
@@ -79,7 +80,6 @@ def _sharded_sweep(
         mesh=mesh,
         in_specs=(P(), P(), P(axis), P(), P()),
         out_specs=P(axis, None, None),
-        check_vma=False,
     )
 
     def sweep(data, present, line_idx, known_idx, R_bits):

@@ -13,7 +13,21 @@ JSON-RPC over HTTP. This package is that wire for the TPU framework:
     rotating proposer exchanging proposals over the wire.
 """
 
-from celestia_app_tpu.rpc.client import RemoteNode
-from celestia_app_tpu.rpc.server import ServingNode, serve
-
+# Lazy exports: ServingNode pulls in the full app stack (and through it
+# the signing backend's optional `cryptography` dependency).  The wire
+# planes in this package (grpc_plane, api_gateway, codec) are importable
+# without any of that — a client-only or handler-level consumer (tests in
+# a slim image included) must not pay the app import to reach them.
 __all__ = ["RemoteNode", "ServingNode", "serve"]
+
+
+def __getattr__(name: str):
+    if name == "RemoteNode":
+        from celestia_app_tpu.rpc.client import RemoteNode
+
+        return RemoteNode
+    if name in ("ServingNode", "serve"):
+        from celestia_app_tpu.rpc import server
+
+        return getattr(server, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

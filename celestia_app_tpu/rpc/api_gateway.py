@@ -42,6 +42,45 @@ def _node_lock(node):
     return getattr(node, "lock", None) or nullcontext()
 
 
+def _power_reduction() -> int:
+    from celestia_app_tpu.state.staking import POWER_REDUCTION
+
+    return POWER_REDUCTION
+
+
+def _rest_page_request(q) -> dict:
+    """Parse the gateway's pagination.* query params into the shared
+    _paginate request shape (same cursor contract as the gRPC plane:
+    clients resend next_key as pagination.key).  Raises _BadRequest on
+    malformed values."""
+    try:
+        key = base64.b64decode((q.get("pagination.key") or [""])[0])
+        return {
+            "offset": int(key.decode()) if key else max(
+                int((q.get("pagination.offset") or ["0"])[0]), 0),
+            "limit": max(int((q.get("pagination.limit") or ["0"])[0]), 0),
+            "count_total":
+                (q.get("pagination.count_total") or ["false"])[0] == "true",
+            "reverse":
+                (q.get("pagination.reverse") or ["false"])[0] == "true",
+        }
+    except ValueError as e:
+        raise _BadRequest(f"invalid pagination: {e}") from e
+
+
+def _rest_page_response(page_req: dict, page_resp: bytes) -> dict:
+    """PageResponse bytes -> the gateway's JSON pagination object."""
+    from celestia_app_tpu.rpc.grpc_plane import _parse_page_response
+
+    out: dict = {}
+    parsed = _parse_page_response(page_resp)
+    if parsed["next_key"]:
+        out["next_key"] = base64.b64encode(parsed["next_key"]).decode()
+    if page_req["count_total"]:
+        out["total"] = str(parsed["total"])
+    return out
+
+
 def _routes(node):
     """[(method, compiled path regex, handler(match, query, body) -> dict)]"""
 
@@ -113,50 +152,33 @@ def _routes(node):
     def validators(m, q, body):
         # Same pagination engine as the gRPC plane (_paginate): honors the
         # sdk cursor contract — clients resend next_key as pagination.key.
-        from celestia_app_tpu.rpc.grpc_plane import (
-            _paginate,
-            _parse_page_response,
-        )
+        from celestia_app_tpu.rpc.grpc_plane import _paginate
 
         with _node_lock(node):
             vals = node.validators()
-        try:
-            key = base64.b64decode((q.get("pagination.key") or [""])[0])
-            page_req = {
-                "offset": int(key.decode()) if key else max(
-                    int((q.get("pagination.offset") or ["0"])[0]), 0),
-                "limit": max(int((q.get("pagination.limit") or ["0"])[0]), 0),
-                "count_total":
-                    (q.get("pagination.count_total") or ["false"])[0]
-                    == "true",
-                "reverse":
-                    (q.get("pagination.reverse") or ["false"])[0] == "true",
-            }
-        except ValueError as e:
-            raise _BadRequest(f"invalid pagination: {e}") from e
+        page_req = _rest_page_request(q)
         page, page_resp = _paginate(vals, page_req)
-        out = {
+        return {
             "validators": [
                 {
                     "operator_address": v["address"],
                     "status": "BOND_STATUS_BONDED",
-                    "tokens": str(v.get("power", 0) * 10**6),
+                    # sdk convention shared with the gRPC plane:
+                    # tokens = power x PowerReduction.
+                    "tokens": str(v.get("power", 0) * _power_reduction()),
                 }
                 for v in page
             ],
-            "pagination": {},
+            "pagination": _rest_page_response(page_req, page_resp),
         }
-        parsed = _parse_page_response(page_resp)
-        if parsed["next_key"]:
-            out["pagination"]["next_key"] = base64.b64encode(
-                parsed["next_key"]
-            ).decode()
-        if page_req["count_total"]:
-            out["pagination"]["total"] = str(parsed["total"])
-        return out
 
     def proposals(m, q, body):
+        # Paged like the validators route (shared _paginate engine) and
+        # status emitted as the PROPOSAL_STATUS_* enum NAME — the
+        # grpc-gateway JSON convention; a bare int here broke clients
+        # switch-ing on the string values the sdk emits.
         from celestia_app_tpu.modules.gov import GovKeeper
+        from celestia_app_tpu.rpc.grpc_plane import _paginate
         from celestia_app_tpu.state.accounts import BankKeeper
         from celestia_app_tpu.state.staking import StakingKeeper
 
@@ -165,12 +187,17 @@ def _routes(node):
             props = GovKeeper(
                 store, StakingKeeper(store), BankKeeper(store)
             ).proposals()
+        page_req = _rest_page_request(q)
+        page, page_resp = _paginate(props, page_req)
         return {
             "proposals": [
-                {"proposal_id": str(p.pid), "status": int(p.status)}
-                for p in props
+                {
+                    "proposal_id": str(p.pid),
+                    "status": f"PROPOSAL_STATUS_{p.status.name}",
+                }
+                for p in page
             ],
-            "pagination": {"total": str(len(props))},
+            "pagination": _rest_page_response(page_req, page_resp),
         }
 
     def slashing_params(m, q, body):

@@ -182,6 +182,36 @@ def _parse_page_response(raw: bytes) -> dict:
 _WAIT_TX_MAX_PARKED = 8
 
 
+class _Abort(Exception):
+    """Handler-raised gRPC failure: carries the StatusCode NAME (the grpc
+    module is imported lazily — serve_grpc resolves the name to the real
+    code when it aborts the RPC) plus human-readable details.  Without
+    this, a malformed client input surfaced as an opaque UNKNOWN wrapping
+    a Python traceback."""
+
+    def __init__(self, code: str, details: str):
+        self.code = code
+        self.details = details
+        super().__init__(details)
+
+
+def _tx_hash_bytes(txhash: str) -> bytes:
+    """Validate and decode a client-supplied hex tx hash, stripping
+    whitespace and accepting either case; INVALID_ARGUMENT on anything
+    else (empty, odd length, non-hex) instead of a ValueError-backed
+    opaque gRPC error."""
+    cleaned = txhash.strip()
+    if not cleaned:
+        raise _Abort("INVALID_ARGUMENT", "empty tx hash")
+    try:
+        return bytes.fromhex(cleaned)
+    except ValueError:
+        raise _Abort(
+            "INVALID_ARGUMENT",
+            f"malformed tx hash {cleaned[:80]!r}: expected hex",
+        ) from None
+
+
 def _handlers(node) -> dict:
     """method path suffix -> unary handler(bytes) -> bytes.
 
@@ -212,9 +242,12 @@ def _handlers(node) -> dict:
     def get_tx(req: bytes) -> bytes:
         # GetTxRequest {hash=1 (hex)}; NotFound -> empty response (the
         # client treats an absent tx_response as "not yet included").
-        txhash = _field_str(req, 1)
+        # Same up-front hash validation as WaitTx: malformed hex answers
+        # INVALID_ARGUMENT, never an opaque ValueError-backed error.
+        txhash = _field_str(req, 1).strip()
+        raw_hash = _tx_hash_bytes(txhash)
         with node_lock():
-            status = node.tx_status(bytes.fromhex(txhash))
+            status = node.tx_status(raw_hash)
         if status is None:
             return b""
         height, code, log = status
@@ -253,15 +286,21 @@ def _handlers(node) -> dict:
     def query_validators(req: bytes) -> bytes:
         # QueryValidatorsRequest {status=1, pagination=2} -> {validators=1
         # repeated Validator {operator_address=1, tokens=5}, pagination=2}
-        # — the fields txsim's stake sequence reads, paged.
+        # — the fields txsim's stake sequence reads, paged.  tokens uses
+        # the sdk convention (power x PowerReduction), matching the REST
+        # plane; the two previously disagreed (REST utia vs gRPC raw
+        # power), which skewed any client mixing the planes by 10^6.
+        from celestia_app_tpu.state.staking import POWER_REDUCTION
+
         with node_lock():
             vals = node.validators()
         page_vals, page_resp = _paginate(vals, _parse_page_request(req, 2))
         out = b""
         for v in page_vals:
+            tokens = v.get("power", 0) * POWER_REDUCTION
             val = encode_bytes_field(
                 1, v["address"].encode()
-            ) + encode_bytes_field(5, str(v.get("power", 0)).encode())
+            ) + encode_bytes_field(5, str(tokens).encode())
             out += encode_bytes_field(1, val)
         if page_resp:
             out += encode_bytes_field(2, page_resp)
@@ -556,12 +595,15 @@ def _handlers(node) -> dict:
         # mirroring GetTxResponse so clients share parsing; empty on
         # timeout. Deliberately NOT under node_lock — the wait parks on
         # the commit event and would deadlock the proposer loop.
-        txhash = _field_str(req, 1)
+        # Validate the client hex BEFORE any fromhex: malformed hashes
+        # answer INVALID_ARGUMENT, not an opaque ValueError-backed error.
+        txhash = _field_str(req, 1).strip()
+        raw_hash = _tx_hash_bytes(txhash)
         timeout_ms = _field_int(req, 2)
         if timeout_ms <= 0:
             # Absent/zero timeout: immediate status check, no park (proto3
             # cannot distinguish the two, so 0 must not mean "default").
-            status = node.tx_status(bytes.fromhex(txhash))
+            status = node.tx_status(raw_hash)
             if status is None:
                 return b""
             height, code, log = status
@@ -570,12 +612,12 @@ def _handlers(node) -> dict:
         if wait_slots.acquire(blocking=False):
             try:
                 status = node.wait_tx(
-                    bytes.fromhex(txhash), min(timeout_ms, 110_000) / 1000.0
+                    raw_hash, min(timeout_ms, 110_000) / 1000.0
                 )
             finally:
                 wait_slots.release()
         else:  # all park slots busy: degrade to a poll-style check
-            status = node.tx_status(bytes.fromhex(txhash))
+            status = node.tx_status(raw_hash)
         if status is None:
             return b""
         height, code, log = status
@@ -640,11 +682,20 @@ def serve_grpc(node, port: int = 0, max_workers: int = 16) -> GrpcPlane:
 
     ident = lambda b: b  # byte-level (de)serialization; codecs above
 
+    def wrap(fn):
+        def handler(req, ctx):
+            try:
+                return fn(req)
+            except _Abort as e:  # typed handler failure -> proper status
+                ctx.abort(grpc.StatusCode[e.code], e.details)
+
+        return handler
+
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     for service, methods in _handlers(node).items():
         rpc_handlers = {
             name: grpc.unary_unary_rpc_method_handler(
-                (lambda fn: lambda req, ctx: fn(req))(fn),
+                wrap(fn),
                 request_deserializer=ident,
                 response_serializer=ident,
             )
@@ -781,14 +832,17 @@ class GrpcNode:
         raise TimeoutError(f"no block committed past height {start}")
 
     def validators(self) -> list[dict]:
+        from celestia_app_tpu.state.staking import POWER_REDUCTION
+
         out = []
         for num, wt, val in decode_fields(self._call["validators"](b"")):
             if num == 1 and wt == WIRE_LEN:
                 # "address"/"power" match the in-process node surface so
-                # txsim's sequences stay node-agnostic.
+                # txsim's sequences stay node-agnostic; the wire carries
+                # tokens (power x PowerReduction, the sdk convention).
                 out.append({
                     "address": _field_str(val, 1),
-                    "power": int(_field_str(val, 5) or 0),
+                    "power": int(_field_str(val, 5) or 0) // POWER_REDUCTION,
                 })
         return out
 
@@ -876,6 +930,8 @@ class GrpcNode:
                         count_total: bool = False) -> tuple[list[dict], dict]:
         """One page of the validator set; returns (validators, {next_key,
         total})."""
+        from celestia_app_tpu.state.staking import POWER_REDUCTION
+
         req = encode_bytes_field(
             2, encode_page_request(offset, limit, count_total)
         )
@@ -885,7 +941,7 @@ class GrpcNode:
             if num == 1 and wt == WIRE_LEN:
                 out.append({
                     "address": _field_str(val, 1),
-                    "power": int(_field_str(val, 5) or 0),
+                    "power": int(_field_str(val, 5) or 0) // POWER_REDUCTION,
                 })
         return out, _parse_page_response(_field_bytes(resp, 2))
 

@@ -87,3 +87,68 @@ class TestRound5Candidates:
              "nmt_dah_jnp": 0.5}
         nmt, tuned = bench._pick_tuned(s, on_tpu=False)
         assert tuned == {"rs": "rs_dense", "sha": "jnp"} and nmt == 0.5
+
+
+class TestFusedPipeSeat:
+    """The fused single-dispatch extend_and_dah program joins the A/B as
+    the pipeline incumbent: the staged pair (at its own tuned-best RS and
+    SHA) must beat it by >3% to take the seat."""
+
+    def test_fused_keeps_seat_on_tie(self):
+        s = _seconds_base(1.0, 0.5)
+        s["fused"] = 1.5  # exactly the staged sum
+        tuned = {"rs": "rs_dense", "sha": "jnp"}
+        assert bench._pick_pipe(s, tuned) == "fused"
+
+    def test_staged_needs_three_percent(self):
+        tuned = {"rs": "rs_dense", "sha": "jnp"}
+        s = _seconds_base(1.0, 0.5)
+        s["fused"] = 1.53  # staged 2% faster: stays benched
+        assert bench._pick_pipe(s, tuned) == "fused"
+        s["fused"] = 1.60  # staged >3% faster: takes the seat
+        assert bench._pick_pipe(s, tuned) == "staged"
+
+    def test_fused_clear_win(self):
+        tuned = {"rs": "rs_dense", "sha": "jnp"}
+        s = _seconds_base(1.0, 0.5)
+        s["fused"] = 0.9
+        assert bench._pick_pipe(s, tuned) == "fused"
+
+    def test_staged_sum_uses_the_tuned_picks(self):
+        # The staged side is the SEATED rs + the nmt_dah headline, not
+        # whatever rs_dense did.
+        s = {"rs_dense": 2.0, "rs_fft": 1.0, "nmt_dah": 0.5, "fused": 1.6}
+        tuned = {"rs": "rs_fft", "sha": "jnp"}
+        assert bench._pick_pipe(s, tuned) == "staged"  # 1.5 < 0.97*1.6
+
+
+def _seconds_base(rs=1.0, sha=0.5):
+    return {"rs_dense": rs, "nmt_dah": sha}
+
+
+class TestEnvForTuned:
+    """_env_for_tuned is the single mapping from tuner picks to env; the
+    in-parts fused timing and the child's apply step both ride it."""
+
+    def test_dense_jnp_staged(self):
+        env = bench._env_for_tuned(
+            {"rs": "rs_dense", "sha": "jnp", "pipe": "staged"})
+        assert env["CELESTIA_RS_FFT"] == "off"
+        assert env["CELESTIA_RS_PALLAS"] is None
+        assert env["CELESTIA_SHA_PALLAS"] == "off"
+        assert env["CELESTIA_PIPE_FUSED"] == "off"
+
+    def test_fft_md_plf_fused(self):
+        env = bench._env_for_tuned(
+            {"rs": "rs_fft_md", "sha": "plf", "pipe": "fused"})
+        assert env["CELESTIA_RS_FFT"] == "on"
+        assert env["CELESTIA_RS_FFT_MD"] == "1"
+        assert env["CELESTIA_SHA_PALLAS"] == "on"
+        assert env["CELESTIA_SHA_FUSED"] == "on"
+        assert env["CELESTIA_PIPE_FUSED"] == "on"
+
+    def test_pallas_dense_without_pipe(self):
+        env = bench._env_for_tuned({"rs": "rs_dense_pl", "sha": "pallas"})
+        assert env["CELESTIA_RS_PALLAS"] == "on"
+        assert env["CELESTIA_RS_FFT"] == "off"
+        assert "CELESTIA_PIPE_FUSED" not in env

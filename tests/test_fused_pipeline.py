@@ -1,0 +1,167 @@
+"""Fused extend_and_dah == staged path, bit for bit.
+
+The fused single-dispatch lowering (kernels/fused) must reproduce the
+staged extend-then-hash composition (da/eds._pipeline) exactly — roots,
+data root, and EDS bytes — on golden vectors and random squares, across
+the donated-buffer path and the multi-chip DAH-only path.  These pins are
+what make the bench autotuner's fused/staged seat a pure perf choice.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from celestia_app_tpu.constants import NAMESPACE_SIZE, SHARE_SIZE
+from celestia_app_tpu.da.eds import ExtendedDataSquare, _pipeline, extend_shares
+from celestia_app_tpu.gf.rs import active_construction
+from celestia_app_tpu.kernels.fused import jit_extend_and_dah, pipeline_mode
+
+# Reference golden DAH hashes (pkg/da/data_availability_header_test.go;
+# same constants as tests/test_golden_vectors.py — the fused path must
+# reproduce them through its own lowering).
+K2_HASH = bytes.fromhex(
+    "b56e4d251ac266f4b91cc5464b3fc7efcbdc888064647496d13133f0dc65ac25"
+)
+K128_HASH = bytes.fromhex(
+    "0bd3abeeacfbb0b92dfbdac4a154868e3c4e79666f7fcf6c620bb90dd3a0dcf0"
+)
+
+
+def _golden_share() -> bytes:
+    ns = bytes([0x00]) + bytes(18) + bytes([0x01]) * 10
+    assert len(ns) == NAMESPACE_SIZE
+    return ns + b"\xff" * (SHARE_SIZE - NAMESPACE_SIZE)
+
+
+def random_ods(k: int, seed: int) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    ods = rng.integers(0, 256, size=(k, k, SHARE_SIZE), dtype=np.uint8)
+    ods[..., 0] = 0  # namespaces below the parity namespace
+    return ods
+
+
+def _staged(k: int, ods: np.ndarray):
+    fn = jax.jit(_pipeline(k, active_construction()))
+    return [np.asarray(x) for x in fn(jnp.asarray(ods, dtype=jnp.uint8))]
+
+
+class TestFusedParity:
+    # k=128 is covered by the golden-vector test below (same compile);
+    # the random-content sweep stays small enough for the CPU image.
+    @pytest.mark.parametrize("k", [2, 8, 32])
+    def test_fused_matches_staged(self, k):
+        ods = random_ods(k, seed=k * 13 + 1)
+        ref = _staged(k, ods)
+        got = jit_extend_and_dah(k)(jnp.asarray(ods, dtype=jnp.uint8))
+        for name, a, b in zip(("eds", "row_roots", "col_roots", "droot"),
+                              ref, got):
+            assert np.array_equal(a, np.asarray(b)), (k, name)
+
+    @pytest.mark.parametrize("k", [2, 8])
+    def test_donated_buffer_path(self, k):
+        """donate=True must not change a byte; the input buffer is consumed
+        on backends that honor donation and silently kept elsewhere."""
+        ods = random_ods(k, seed=k * 17 + 2)
+        ref = _staged(k, ods)
+        x = jnp.asarray(ods, dtype=jnp.uint8)
+        got = jit_extend_and_dah(k, donate=True)(x)
+        for name, a, b in zip(("eds", "row_roots", "col_roots", "droot"),
+                              ref, got):
+            assert np.array_equal(a, np.asarray(b)), (k, name)
+
+    @pytest.mark.parametrize("k", [2, 8])
+    def test_roots_only_lowering(self, k):
+        ods = random_ods(k, seed=k * 19 + 3)
+        _, rr, cr, droot = _staged(k, ods)
+        got = jit_extend_and_dah(k, roots_only=True)(
+            jnp.asarray(ods, dtype=jnp.uint8)
+        )
+        assert np.array_equal(rr, np.asarray(got[0])), k
+        assert np.array_equal(cr, np.asarray(got[1])), k
+        assert np.array_equal(droot, np.asarray(got[2])), k
+
+    def test_golden_vectors_through_fused(self):
+        """The reference golden DAH hashes via an explicitly-fused, donated
+        dispatch (k=2 and k=128 — the two pinned reference sizes)."""
+        from celestia_app_tpu.da.dah import DataAvailabilityHeader
+
+        for k, want in ((2, K2_HASH), (128, K128_HASH)):
+            shares = [_golden_share()] * (k * k)
+            n = len(shares)
+            ods = np.frombuffer(b"".join(shares), dtype=np.uint8).reshape(
+                k, k, SHARE_SIZE
+            )
+            _, rr, cr, _ = jit_extend_and_dah(k, donate=True)(
+                jnp.asarray(ods, dtype=jnp.uint8)
+            )
+            dah = DataAvailabilityHeader(
+                row_roots=[bytes(r) for r in np.asarray(rr)],
+                column_roots=[bytes(r) for r in np.asarray(cr)],
+            )
+            assert dah.hash() == want, k
+            assert n == k * k
+
+    def test_default_route_is_fused_and_env_flips_it(self, monkeypatch):
+        """ExtendedDataSquare.compute rides the seam: default fused,
+        $CELESTIA_PIPE_FUSED=off forces staged, outputs byte-identical."""
+        monkeypatch.delenv("CELESTIA_PIPE_FUSED", raising=False)
+        assert pipeline_mode() == "fused"
+        k = 8
+        ods = random_ods(k, seed=99)
+        fused = ExtendedDataSquare.compute(ods)
+        monkeypatch.setenv("CELESTIA_PIPE_FUSED", "off")
+        assert pipeline_mode() == "staged"
+        staged = ExtendedDataSquare.compute(ods)
+        assert fused.data_root() == staged.data_root()
+        assert fused.row_roots() == staged.row_roots()
+        assert fused.col_roots() == staged.col_roots()
+        np.testing.assert_array_equal(fused.squared(), staged.squared())
+
+    def test_extend_shares_construction_pin(self):
+        """The construction seam threads through extend_shares: pinning the
+        active construction explicitly must be byte-identical to default
+        resolution."""
+        k = 2
+        shares = [_golden_share()] * (k * k)
+        a = extend_shares(shares)
+        b = extend_shares(shares, active_construction())
+        assert a.data_root() == b.data_root()
+
+
+class TestFusedMultiChip:
+    """Multi-chip paths under the conftest 8-device CPU mesh: the DAH-only
+    pipeline all-gathers only 90-byte roots (never shares) and must stay
+    bit-identical to the single-chip fused program."""
+
+    @pytest.mark.parametrize("k,n", [(8, 4), (4, 2), (16, 8)])
+    def test_sharded_dah_only_matches(self, k, n):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from celestia_app_tpu.parallel import (
+            default_mesh,
+            make_sharded_dah_pipeline,
+        )
+
+        assert len(jax.devices()) >= n, "conftest must provide 8 devices"
+        mesh = default_mesh(n)
+        ods = random_ods(k, seed=k * 5 + n)
+        ref = ExtendedDataSquare.compute(ods)
+        fn = make_sharded_dah_pipeline(k, mesh)
+        sh = NamedSharding(mesh, P("data", None, None))
+        rr, cr, droot = fn(jax.device_put(jnp.asarray(ods), sh))
+        assert [bytes(r) for r in np.asarray(rr)] == ref.row_roots()
+        assert [bytes(r) for r in np.asarray(cr)] == ref.col_roots()
+        assert np.asarray(droot).tobytes() == ref.data_root()
+
+    def test_dah_pipeline_rejects_indivisible_mesh(self):
+        from celestia_app_tpu.parallel import (
+            default_mesh,
+            make_sharded_dah_pipeline,
+        )
+
+        with pytest.raises(ValueError):
+            make_sharded_dah_pipeline(4, default_mesh(8))

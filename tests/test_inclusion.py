@@ -87,3 +87,26 @@ class TestCommitmentFromSquare:
         b1 = Blob(user_ns(1), b"x" * 1000)
         b2 = Blob(user_ns(1), b"x" * 999 + b"y")
         assert create_commitment(b1) != create_commitment(b2)
+
+
+class TestCommitmentMemoCap:
+    def test_memo_never_exceeds_cap(self, monkeypatch):
+        """Regression: a batch with more distinct blobs than
+        _COMMIT_MEMO_MAX used to evict the WHOLE memo and then insert
+        past the cap anyway; the insert loop must keep the dict bounded."""
+        from celestia_app_tpu.inclusion import batched as mod
+
+        monkeypatch.setattr(mod, "_COMMIT_MEMO_MAX", 4)
+        monkeypatch.setattr(mod, "_COMMIT_MEMO", {})
+        blobs = [
+            Blob(user_ns(30 + i), RNG.integers(0, 256, 64 + i,
+                                               dtype=np.uint8).tobytes())
+            for i in range(7)  # 7 distinct > cap 4
+        ]
+        out = mod.create_commitments_batched(blobs)
+        assert out == [create_commitment(b) for b in blobs]
+        assert len(mod._COMMIT_MEMO) <= 4
+        # Survivors are the most recent inserts and still serve hits.
+        again = mod.create_commitments_batched(blobs[-4:])
+        assert again == out[-4:]
+        assert len(mod._COMMIT_MEMO) <= 4

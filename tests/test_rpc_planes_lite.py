@@ -1,0 +1,219 @@
+"""Serving-plane contracts that run without the app/signing stack.
+
+The full-node gRPC/REST tests (tests/test_grpc.py, tests/test_api_gateway.py)
+need the signing backend's `cryptography` dependency; these pin the same
+wire-level contracts against a stub node so they hold in a slim image too:
+
+  * validators `tokens` uses ONE convention on both planes —
+    tokens = power x PowerReduction (sdk DefaultPowerReduction 10^6); the
+    planes previously disagreed (REST utia vs gRPC raw power);
+  * WaitTx validates the client hex up front: malformed hashes answer
+    INVALID_ARGUMENT, not an opaque ValueError-backed UNKNOWN;
+  * the REST proposals route speaks the gateway JSON conventions: status
+    as the PROPOSAL_STATUS_* enum name, pagination via the shared
+    _paginate engine (same cursor contract as the validators route).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+from celestia_app_tpu.encoding.proto import (
+    WIRE_LEN,
+    decode_fields,
+    encode_bytes_field,
+    encode_varint_field,
+)
+from celestia_app_tpu.modules.gov import GovKeeper, Proposal, ProposalStatus
+from celestia_app_tpu.rpc.grpc_plane import (
+    GrpcNode,
+    _Abort,
+    _tx_hash_bytes,
+    serve_grpc,
+)
+from celestia_app_tpu.state.accounts import BankKeeper
+from celestia_app_tpu.state.staking import POWER_REDUCTION, StakingKeeper
+from celestia_app_tpu.state.store import KVStore
+
+
+class _StubApp:
+    def __init__(self, store):
+        class _CMS:
+            working = store
+
+        self.cms = _CMS()
+        self.height = 1
+
+
+class _StubNode:
+    """The minimal node surface the handlers under test touch."""
+
+    chain_id = "stub-0"
+
+    def __init__(self):
+        self.store = KVStore()
+        self.app = _StubApp(self.store)
+
+    def validators(self):
+        return [
+            {"address": "celestiavaloper1aaa", "power": 100},
+            {"address": "celestiavaloper1bbb", "power": 7},
+        ]
+
+    def tx_status(self, raw):
+        return None  # nothing ever commits on the stub
+
+    def wait_tx(self, raw, timeout_s):
+        return None
+
+
+@pytest.fixture()
+def grpc_plane():
+    node = _StubNode()
+    plane = serve_grpc(node)
+    client = GrpcNode(plane.target)
+    try:
+        yield node, plane, client
+    finally:
+        client.close()
+        plane.stop()
+
+
+class TestTxHashValidation:
+    def test_valid_hex_round_trips(self):
+        assert _tx_hash_bytes("ab" * 32) == b"\xab" * 32
+        assert _tx_hash_bytes("  AB12  ") == b"\xab\x12"  # strip + case
+
+    @pytest.mark.parametrize("bad", ["", "   ", "xyz", "abc", "0x12"])
+    def test_malformed_raises_typed_abort(self, bad):
+        with pytest.raises(_Abort) as exc:
+            _tx_hash_bytes(bad)
+        assert exc.value.code == "INVALID_ARGUMENT"
+
+
+class TestGrpcPlaneLite:
+    def test_tokens_wire_convention_and_client_round_trip(self, grpc_plane):
+        node, plane, client = grpc_plane
+        # Client surface: power round-trips through the tokens encoding.
+        vals = client.validators()
+        assert [v["power"] for v in vals] == [100, 7]
+        # Wire surface: field 5 carries tokens = power x PowerReduction.
+        raw = client._call["validators"](b"")
+        tokens = [
+            int(
+                next(
+                    v
+                    for n, wt, v in decode_fields(val)
+                    if n == 5 and wt == WIRE_LEN
+                )
+            )
+            for num, wt, val in decode_fields(raw)
+            if num == 1 and wt == WIRE_LEN
+        ]
+        assert tokens == [100 * POWER_REDUCTION, 7 * POWER_REDUCTION]
+
+    def test_wait_tx_malformed_hash_is_invalid_argument(self, grpc_plane):
+        import grpc
+
+        _, plane, client = grpc_plane
+        req = encode_bytes_field(1, b"not-hex!") + encode_varint_field(2, 0)
+        with pytest.raises(grpc.RpcError) as exc:
+            client._call["wait_tx"](req)
+        assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert "hex" in exc.value.details()
+
+    def test_wait_tx_empty_hash_is_invalid_argument(self, grpc_plane):
+        import grpc
+
+        _, plane, client = grpc_plane
+        with pytest.raises(grpc.RpcError) as exc:
+            client._call["wait_tx"](encode_varint_field(2, 0))
+        assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    def test_wait_tx_valid_unknown_hash_answers_empty(self, grpc_plane):
+        _, plane, client = grpc_plane
+        req = encode_bytes_field(1, ("ab" * 32).encode())
+        req += encode_varint_field(2, 0)  # immediate status check
+        assert client._call["wait_tx"](req) == b""
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+@pytest.fixture()
+def rest_node():
+    from celestia_app_tpu.rpc.api_gateway import serve_api
+
+    node = _StubNode()
+    gk = GovKeeper(node.store, StakingKeeper(node.store), BankKeeper(node.store))
+    for pid, status in (
+        (1, ProposalStatus.DEPOSIT_PERIOD),
+        (2, ProposalStatus.VOTING_PERIOD),
+        (3, ProposalStatus.PASSED),
+        (4, ProposalStatus.REJECTED),
+    ):
+        gk._save(
+            Proposal(
+                pid=pid, proposer="celestia1prop", changes=(), status=status,
+                submit_time_ns=0, deposit_end_ns=0, voting_start_ns=0,
+                voting_end_ns=0, total_deposit=0,
+            )
+        )
+    gw = serve_api(node)
+    try:
+        yield node, gw
+    finally:
+        gw.stop()
+
+
+class TestRestGatewayLite:
+    def test_validators_tokens_match_grpc_convention(self, rest_node):
+        node, gw = rest_node
+        status, out = _get(f"{gw.url}/cosmos/staking/v1beta1/validators")
+        assert status == 200
+        assert [v["tokens"] for v in out["validators"]] == [
+            str(100 * POWER_REDUCTION), str(7 * POWER_REDUCTION)
+        ]
+
+    def test_proposals_status_enum_names(self, rest_node):
+        node, gw = rest_node
+        status, out = _get(f"{gw.url}/cosmos/gov/v1beta1/proposals")
+        assert status == 200
+        assert [p["status"] for p in out["proposals"]] == [
+            "PROPOSAL_STATUS_DEPOSIT_PERIOD",
+            "PROPOSAL_STATUS_VOTING_PERIOD",
+            "PROPOSAL_STATUS_PASSED",
+            "PROPOSAL_STATUS_REJECTED",
+        ]
+
+    def test_proposals_pagination_shared_engine(self, rest_node):
+        node, gw = rest_node
+        base = f"{gw.url}/cosmos/gov/v1beta1/proposals"
+        status, page = _get(
+            f"{base}?pagination.limit=2&pagination.count_total=true"
+        )
+        assert status == 200
+        assert [p["proposal_id"] for p in page["proposals"]] == ["1", "2"]
+        assert page["pagination"]["total"] == "4"
+        next_key = page["pagination"]["next_key"]
+        # The sdk cursor contract: resend next_key as pagination.key.
+        status, page2 = _get(
+            f"{base}?pagination.key={next_key}&pagination.limit=2"
+        )
+        assert status == 200
+        assert [p["proposal_id"] for p in page2["proposals"]] == ["3", "4"]
+        assert "next_key" not in page2["pagination"]
+
+    def test_proposals_reverse(self, rest_node):
+        node, gw = rest_node
+        status, out = _get(
+            f"{gw.url}/cosmos/gov/v1beta1/proposals"
+            "?pagination.reverse=true&pagination.limit=1"
+        )
+        assert status == 200
+        assert [p["proposal_id"] for p in out["proposals"]] == ["4"]
