@@ -26,6 +26,13 @@ Env knobs:
   BENCH_BASELINE_S   skip the host-baseline run, use the given seconds/block
   BENCH_TOTAL_BUDGET wall-clock budget in seconds (default 1500)
   BENCH_PROBE_TIMEOUT backend probe timeout in seconds (default 120)
+
+Observability: every completed stage row is also written into the trace
+layer's tables (table "bench_rows", the same tracer the serving planes
+export over GET /trace_tables), and `--metrics-out <dir>` (or
+BENCH_METRICS_OUT) additionally writes `bench_metrics.prom` — a Prometheus
+textfile-collector exposition of the per-row rates — plus
+`bench_rows.jsonl` next to the BENCH_*.json summary.
 """
 
 from __future__ import annotations
@@ -554,6 +561,15 @@ def _run_child() -> None:
             f.write(json.dumps(rec) + "\n")
             f.flush()
             os.fsync(f.fileno())
+        # Same rows into the trace layer (a served node embedding the
+        # bench exports them over GET /trace_tables; here they also feed
+        # the parent's --metrics-out files).
+        try:
+            from celestia_app_tpu.trace import traced
+
+            traced().write("bench_rows", **rec)
+        except Exception:  # noqa: BLE001 — tracing never blocks a bench row
+            pass
 
     import gc
 
@@ -823,10 +839,83 @@ def _read_results(path: str) -> list[dict]:
     return recs
 
 
+def _parse_metrics_out(argv: list[str]) -> str | None:
+    """`--metrics-out <dir>` (or BENCH_METRICS_OUT): where the Prometheus
+    textfile + JSONL tables land.  Hand-rolled so the no-flag invocation
+    stays byte-compatible with every existing driver."""
+    out = os.environ.get("BENCH_METRICS_OUT") or None
+    args = list(argv)
+    while "--metrics-out" in args:
+        i = args.index("--metrics-out")
+        if i + 1 >= len(args):
+            print("bench: --metrics-out requires a directory", file=sys.stderr)
+            break
+        out = args[i + 1]
+        del args[i : i + 2]
+    return out
+
+
+def _write_metrics_out(out_dir: str, recs: list[dict], summary: dict) -> None:
+    """Write the bench's observability artifacts into `out_dir`:
+
+      bench_metrics.prom  Prometheus textfile-collector exposition
+                          (celestia_bench_* gauges/counters per row)
+      bench_rows.jsonl    the tracer-table rows (one JSON object per
+                          completed stage, the /trace_tables shape)
+
+    Built from a PRIVATE registry/tracer: the files reflect this run only,
+    never whatever else the process-wide registry accumulated.
+    """
+    from celestia_app_tpu.trace.metrics import Registry
+    from celestia_app_tpu.trace.tracer import Tracer
+
+    os.makedirs(out_dir, exist_ok=True)
+    reg = Registry()
+    # env_gated=False: these artifacts were explicitly requested; a
+    # CELESTIA_TRACE=off perf run must not come back with empty files.
+    tracer = Tracer(env_gated=False)
+    rate = reg.gauge("celestia_bench_mb_per_s",
+                     "per-stage ODS MB/s extended+DAH-hashed")
+    secs = reg.gauge("celestia_bench_seconds_per_block",
+                     "per-stage median seconds per block")
+    errors = reg.counter("celestia_bench_errors_total",
+                         "bench stages that raised")
+    skipped = reg.counter("celestia_bench_stages_skipped_total",
+                          "bench stages skipped (budget)")
+    for rec in recs:
+        if rec.get("stage") in ("probe", "plan", "done", "tuned-applied"):
+            continue
+        tracer.write("bench_rows", **rec)
+        if "error" in rec:
+            errors.inc(stage=str(rec.get("stage", "?")))
+            continue
+        if "skipped" in rec:
+            skipped.inc(stage=str(rec.get("stage", "?")))
+            continue
+        # stage is part of the key: the compute@512 stability rerun ("#2")
+        # shares {mode, k} with the primary and must not overwrite it.
+        labels = {"mode": str(rec.get("mode", "?")), "k": str(rec.get("k", 0)),
+                  "stage": str(rec.get("stage", "?"))}
+        if "mb_per_s" in rec:
+            rate.set(rec["mb_per_s"], **labels)
+        if "seconds_per_block" in rec:
+            secs.set(rec["seconds_per_block"], **labels)
+    reg.gauge(
+        "celestia_bench_headline_mb_per_s", "the summary line's headline rate"
+    ).set(summary.get("value", 0))
+    with open(os.path.join(out_dir, "bench_metrics.prom"), "w") as f:
+        f.write(reg.render())
+    with open(os.path.join(out_dir, "bench_rows.jsonl"), "w") as f:
+        jsonl = tracer.export_jsonl("bench_rows")
+        f.write(jsonl + "\n" if jsonl else "")
+
+
 def main() -> None:
     if os.environ.get("_BENCH_CHILD") == "1":
         _run_child()
         return
+
+    metrics_out = _parse_metrics_out(sys.argv[1:])
 
     budget = float(os.environ.get("BENCH_TOTAL_BUDGET", "1500"))
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
@@ -902,6 +991,8 @@ def main() -> None:
                 out["errors"] = errors
         else:
             out["error"] = "; ".join(errors) or "no stage completed"
+        if metrics_out:
+            _write_metrics_out(metrics_out, recs, out)
         print(json.dumps(out))
         return
 
@@ -973,6 +1064,8 @@ def main() -> None:
         out["stability_pct"] = stability_pct
     if errors:
         out["errors"] = errors
+    if metrics_out:
+        _write_metrics_out(metrics_out, recs, out)
     print(json.dumps(out))
 
 

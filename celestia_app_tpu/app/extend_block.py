@@ -36,7 +36,13 @@ def extend_block(
     sq = square.construct(
         raw_txs, min(gov_max_square_size, square_size_upper_bound)
     )
-    return extend_shares(sq.share_bytes(), construction)
+    from celestia_app_tpu.trace import traced
+
+    # The span records the host-side cost of the whole rebuild+extend (the
+    # journal row for the device half comes from ExtendedDataSquare.compute
+    # inside extend_shares); no sync beyond what compute already does.
+    with traced().span("extend_block", k=sq.size, n_txs=len(raw_txs)):
+        return extend_shares(sq.share_bytes(), construction)
 
 
 def is_empty_block(raw_txs: list[bytes]) -> bool:

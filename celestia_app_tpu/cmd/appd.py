@@ -245,7 +245,8 @@ def cmd_start(args) -> int:
             from celestia_app_tpu.rpc.grpc_plane import serve_grpc
 
             grpc_plane = serve_grpc(node, port=getattr(args, "grpc_port", 0))
-            print(f"gRPC serving on {grpc_plane.target}", flush=True)
+            print(f"gRPC serving on {grpc_plane.target} "
+                  f"(debug {grpc_plane.debug_url})", flush=True)
         if getattr(args, "api", False):
             from celestia_app_tpu.rpc.api_gateway import serve_api
 

@@ -85,9 +85,30 @@ def _pipeline(k: int, construction: str):
     return run
 
 
+_STAGED_BUILT: set[tuple] = set()
+
+
 @lru_cache(maxsize=None)
 def _jit_pipeline(k: int, construction: str):
+    _STAGED_BUILT.add((k, construction))
+    from celestia_app_tpu.trace.journal import note_jit_build
+
+    note_jit_build("staged_pipeline")
     return jax.jit(_pipeline(k, construction))
+
+
+def pipeline_cache_state(
+    k: int, construction: str | None = None, *, owned: bool = False
+) -> str:
+    """"hit" when the jit wrapper the active seam would dispatch for
+    (k, construction) is already built this process, else "miss" — the
+    block journal's compile column, readable without building anything."""
+    from celestia_app_tpu.kernels.fused import is_built, pipeline_mode
+
+    construction = construction or active_construction()
+    if pipeline_mode() == "fused":
+        return "hit" if is_built(k, construction, donate=owned) else "miss"
+    return "hit" if (k, construction) in _STAGED_BUILT else "miss"
 
 
 def jit_pipeline(k: int, construction: str | None = None):
@@ -145,6 +166,11 @@ def warmup(
         square_sizes = [k for k in square_sizes if k <= upto]
     if constructions is None:
         constructions = (active_construction(),)
+    import time
+
+    from celestia_app_tpu.kernels.fused import pipeline_mode
+    from celestia_app_tpu.trace import journal
+
     for construction in constructions:
         for k in square_sizes:
             ods = np.zeros((k, k, SHARE_SIZE), dtype=np.uint8)
@@ -153,11 +179,18 @@ def warmup(
             # jit_pipeline (repair's re-extend, which re-reads its input
             # and must not donate).  Warming only one would leave the
             # other's first dispatch paying a compile on the block path.
+            state = pipeline_cache_state(k, construction, owned=True)
+            t0 = time.perf_counter()
             owned = _owned_input_pipeline(k, construction)
             jax.block_until_ready(owned(jnp.asarray(ods)))
             pipe = jit_pipeline(k, construction)
             if pipe is not owned:  # staged mode: both entries are one jit
                 jax.block_until_ready(pipe(jnp.asarray(ods)))
+            journal.record(
+                "warmup", k, mode=pipeline_mode(), compile=state,
+                construction=construction,
+                warm_ms=(time.perf_counter() - t0) * 1e3,
+            )
     return list(square_sizes)
 
 
@@ -180,20 +213,39 @@ class ExtendedDataSquare:
     def compute(
         cls, ods: np.ndarray, construction: str | None = None
     ) -> "ExtendedDataSquare":
+        import time
+
+        from celestia_app_tpu.kernels.fused import pipeline_mode
+        from celestia_app_tpu.trace import journal
+
         k = ods.shape[0]
         if k & (k - 1) or not 1 <= k <= MAX_CODEC_SQUARE_SIZE:
             raise ValueError(f"invalid square size {k}")
         assert ods.shape == (k, k, SHARE_SIZE), ods.shape
+        mode = pipeline_mode()
         if isinstance(ods, jax.Array):
             # jnp.asarray is a no-copy pass-through for a device array, so
             # donating here would invalidate the CALLER'S buffer.  Their
             # array, their lifetime: take the non-donating pipeline.
+            state = pipeline_cache_state(k, construction)
+            t0 = time.perf_counter()
             eds, rr, cr, droot = jit_pipeline(k, construction)(ods)
+            journal.record(
+                "compute", k, mode=mode, compile=state,
+                dispatch_ms=(time.perf_counter() - t0) * 1e3,
+            )
         else:
             # The upload below is this call's own buffer, never read again
             # — the donating pipeline may reuse it as extension scratch.
-            eds, rr, cr, droot = _owned_input_pipeline(k, construction)(
-                jnp.asarray(ods, dtype=jnp.uint8)
+            state = pipeline_cache_state(k, construction, owned=True)
+            t0 = time.perf_counter()
+            x = jnp.asarray(ods, dtype=jnp.uint8)
+            t1 = time.perf_counter()
+            eds, rr, cr, droot = _owned_input_pipeline(k, construction)(x)
+            journal.record(
+                "compute", k, mode=mode, compile=state,
+                upload_ms=(t1 - t0) * 1e3,
+                dispatch_ms=(time.perf_counter() - t1) * 1e3,
             )
         return cls(eds, rr, cr, droot, k)
 

@@ -128,12 +128,35 @@ def extend_and_dah_fn(
     return run
 
 
+# Keys whose jit wrapper has been built this process — the journal's
+# compile hit/miss signal (a miss means the next dispatch traces and
+# compiles; a hit reuses the cached executable).
+_BUILT_KEYS: set[tuple] = set()
+
+
+def is_built(
+    k: int,
+    construction: str | None = None,
+    *,
+    donate: bool = False,
+    roots_only: bool = False,
+) -> bool:
+    key = (k, construction or active_construction(), donate, roots_only)
+    return key in _BUILT_KEYS
+
+
 @lru_cache(maxsize=None)
 def _jit_extend_and_dah(
     k: int, construction: str, donate: bool, roots_only: bool
 ):
     if donate:
         _silence_unusable_donation_warning()
+    # Body runs on cache miss only: note the build for the journal's
+    # hit/miss column and the celestia_jit_builds_total counter.
+    _BUILT_KEYS.add((k, construction, donate, roots_only))
+    from celestia_app_tpu.trace.journal import note_jit_build
+
+    note_jit_build("extend_and_dah")
     return jax.jit(
         extend_and_dah_fn(k, construction, roots_only),
         donate_argnums=(0,) if donate else (),

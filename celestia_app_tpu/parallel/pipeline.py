@@ -21,6 +21,12 @@ Three overlaps compose here:
     device a dispatch round-trip is milliseconds of dead link time per
     block that the split reclaims.
 
+Every drained block writes one `block_journal` row (trace/journal.py):
+upload/dispatch/drain ms plus the two queue stalls (uploader blocked on
+the depth-bounded hand-off, dispatcher starved of staged uploads), all
+host perf_counter deltas around calls the pipeline already makes — the
+only device sync remains the drain's existing block_until_ready.
+
 `BlockPipeline` bounds in-flight blocks (double buffering by default) so
 HBM holds at most `depth` extended squares.  When the fused lowering is
 active (kernels/fused.pipeline_mode), each uploaded ODS buffer is DONATED
@@ -33,16 +39,30 @@ from __future__ import annotations
 
 import queue
 import threading
-from dataclasses import dataclass
+import time
+from dataclasses import dataclass, field
 
 import jax
 import numpy as np
 
-from celestia_app_tpu.da.eds import ExtendedDataSquare, _owned_input_pipeline
+from celestia_app_tpu.da.eds import (
+    ExtendedDataSquare,
+    _owned_input_pipeline,
+    pipeline_cache_state,
+)
 from celestia_app_tpu.gf.rs import active_construction
-from celestia_app_tpu.trace import traced
+from celestia_app_tpu.trace import journal
 
 _SENTINEL = object()
+
+
+def _queue_depth_gauge():
+    from celestia_app_tpu.trace.metrics import registry
+
+    return registry().gauge(
+        "celestia_pipeline_queue_depth",
+        "blocks resident per block-pipeline hand-off queue",
+    )
 
 
 @dataclass
@@ -50,6 +70,7 @@ class _InFlight:
     tag: object
     outputs: tuple  # (eds, row_roots, col_roots, droot) device arrays
     k: int
+    meta: dict = field(default_factory=dict)  # stage timings for the journal
 
 
 class BlockPipeline:
@@ -65,6 +86,16 @@ class BlockPipeline:
         # every block it streams uses this one generator, even if
         # $CELESTIA_RS_CONSTRUCTION flips while blocks are in flight.
         self.construction = active_construction()
+        # Journal context: pipeline mode + whether this (k, construction)
+        # pays a jit build, both pinned before the wrapper is built.  The
+        # first journaled block carries the init-time compile state; every
+        # later row is by definition a hit.
+        from celestia_app_tpu.kernels.fused import pipeline_mode
+
+        self._mode = pipeline_mode()
+        self._compile_state = pipeline_cache_state(
+            k, self.construction, owned=True
+        )
         # The pipeline owns each uploaded buffer and uses it exactly once,
         # so it rides the owned-input entry: the donating fused program by
         # default, the staged jit when the seam says staged.
@@ -100,37 +131,69 @@ class BlockPipeline:
                 continue  # keep consuming so no producer blocks forever
             ods, tag = item
             try:
+                t0 = time.perf_counter()
                 x = jax.device_put(np.ascontiguousarray(ods))
+                t1 = time.perf_counter()
             except BaseException as e:  # surfaced on the next drain
                 self._error = e
                 self._staged.put(_SENTINEL)
                 failed = True
                 continue
-            self._staged.put((x, tag))
+            # Stage timings ride the hand-off in `meta`; the put-stall
+            # (uploader blocked because `depth` squares are already in
+            # flight downstream) is written the instant put() returns.
+            # The consolidated journal row is built at drain time, a full
+            # dispatch later, so the read always sees the value in
+            # practice — and the row falls back to 0.0, never a missing
+            # field, if this thread were descheduled that whole time.
+            meta = {"upload_ms": (t1 - t0) * 1e3}
+            self._staged.put((x, tag, meta))
+            meta["upload_stall_ms"] = (time.perf_counter() - t1) * 1e3
 
     def _dispatch(self) -> None:
         failed = False
         while True:
+            t0 = time.perf_counter()
             item = self._staged.get()
+            starve_ms = (time.perf_counter() - t0) * 1e3
             if item is _SENTINEL:
                 self._done.put(_SENTINEL)
                 return
             if failed or self._stopping:
                 continue
-            x, tag = item
+            x, tag, meta = item
             try:
-                out = self._pipe(x)
+                t1 = time.perf_counter()
+                out = self._pipe(x)  # async enqueue; no sync added here
+                meta["dispatch_ms"] = (time.perf_counter() - t1) * 1e3
+                meta["dispatch_starve_ms"] = starve_ms
             except BaseException as e:
                 self._error = e
                 self._done.put(_SENTINEL)
                 failed = True
                 continue
-            self._done.put(_InFlight(tag, out, self.k))
+            self._done.put(_InFlight(tag, out, self.k, meta))
 
     def _materialize(self, inflight: _InFlight) -> tuple[object, ExtendedDataSquare]:
         eds, rr, cr, droot = inflight.outputs
-        jax.block_until_ready(droot)
-        traced().write("block_pipeline", k=inflight.k, tag=str(inflight.tag))
+        t0 = time.perf_counter()
+        jax.block_until_ready(droot)  # the pipeline's one existing sync
+        meta = inflight.meta
+        journal.record(
+            "stream", inflight.k, mode=self._mode,
+            compile=self._compile_state, tag=str(inflight.tag),
+            depth=self.depth,
+            upload_ms=meta.get("upload_ms", 0.0),
+            upload_stall_ms=meta.get("upload_stall_ms", 0.0),
+            dispatch_ms=meta.get("dispatch_ms", 0.0),
+            dispatch_starve_ms=meta.get("dispatch_starve_ms", 0.0),
+            drain_ms=(time.perf_counter() - t0) * 1e3,
+        )
+        self._compile_state = "hit"  # paid (or confirmed) on the first row
+        gauge = _queue_depth_gauge()
+        for name, q in (("tasks", self._tasks), ("staged", self._staged),
+                        ("done", self._done)):
+            gauge.set(q.qsize(), queue=name)
         return inflight.tag, ExtendedDataSquare(eds, rr, cr, droot, inflight.k)
 
     def submit(self, ods: np.ndarray, tag: object = None) -> None:

@@ -154,7 +154,9 @@ def make_sharded_pipeline(
     if k % n:
         raise ValueError(f"device count {n} must divide square size {k}")
     from celestia_app_tpu.kernels.rs import encode_fn
+    from celestia_app_tpu.trace.journal import note_jit_build
 
+    note_jit_build("sharded_pipeline")
     _encode = encode_fn(k, construction)
     body = _local_extend_and_roots(k, n, axis, _encode)
 
@@ -205,7 +207,9 @@ def make_sharded_dah_pipeline(
     if k % n:
         raise ValueError(f"device count {n} must divide square size {k}")
     from celestia_app_tpu.kernels.rs import encode_fn
+    from celestia_app_tpu.trace.journal import note_jit_build
 
+    note_jit_build("sharded_dah_pipeline")
     _encode = encode_fn(k, construction)
     body = _local_extend_and_roots(k, n, axis, _encode)
 
@@ -241,16 +245,38 @@ def default_mesh(n: int | None = None, axis: str = "data") -> Mesh:
 
 
 def sharded_extend_and_dah(ods, mesh: Mesh, axis: str = "data"):
-    """Host convenience: place a numpy ODS on the mesh and run the pipeline."""
+    """Host convenience: place a numpy ODS on the mesh and run the pipeline.
+
+    Journals one block_journal row (source="sharded"): upload is the mesh
+    placement, dispatch the async shard_map enqueue — no sync added."""
+    import time
+
+    from celestia_app_tpu.gf.rs import active_construction as _active
+    from celestia_app_tpu.trace import journal
+
     k = ods.shape[0]
+    state = "hit" if (k, mesh, axis, _active()) in _SHARDED_BUILT else "miss"
     fn = cached_pipeline(k, mesh, axis)
     sh = NamedSharding(mesh, P(axis, None, None))
+    t0 = time.perf_counter()
     ods_dev = jax.device_put(jnp.asarray(ods, dtype=jnp.uint8), sh)
-    return fn(ods_dev)
+    t1 = time.perf_counter()
+    out = fn(ods_dev)
+    journal.record(
+        "sharded", k, mode="sharded", compile=state,
+        devices=mesh.shape[axis],
+        upload_ms=(t1 - t0) * 1e3,
+        dispatch_ms=(time.perf_counter() - t1) * 1e3,
+    )
+    return out
+
+
+_SHARDED_BUILT: set[tuple] = set()
 
 
 @lru_cache(maxsize=None)
 def _cached_pipeline(k: int, mesh: Mesh, axis: str, construction: str):
+    _SHARDED_BUILT.add((k, mesh, axis, construction))
     return make_sharded_pipeline(k, mesh, axis, construction)
 
 

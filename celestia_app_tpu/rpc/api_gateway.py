@@ -22,6 +22,11 @@ the sdk's gateway emits them.
     POST /cosmos/tx/v1beta1/txs        {"tx_bytes": base64, "mode": ...}
     POST /cosmos/tx/v1beta1/simulate   {"tx_bytes": base64}
 
+plus the shared observability surface every serving plane mounts
+(trace/exposition.py): GET /metrics (byte-identical Prometheus exposition
+across the JSON-RPC, REST, and gRPC-debug ports), /trace_tables[/<name>],
+and /healthz.
+
 Errors follow the gateway shape: {"code": grpc-code, "message": ...}
 with HTTP 404 / 400 / 501 as the sdk maps them.
 """
@@ -354,6 +359,18 @@ class _ApiHandler(BaseHTTPRequestHandler):
                             "message": f"Not Implemented: {url.path}"})
 
     def do_GET(self):  # noqa: N802 — http.server API
+        # Observability first: /metrics must serve the SAME bytes as the
+        # other planes (shared handler), and none of these paths collide
+        # with the cosmos route space.
+        from celestia_app_tpu.trace.exposition import (
+            handle_observability_get,
+            send_observability_response,
+        )
+
+        resp = handle_observability_get(self.path)
+        if resp is not None:
+            send_observability_response(self, resp)
+            return
         self._dispatch("GET", None)
 
     def do_POST(self):  # noqa: N802

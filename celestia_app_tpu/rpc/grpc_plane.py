@@ -27,6 +27,11 @@ layer, protoc-cross-validated by tests/test_proto_wire.py):
 List queries speak cosmos.base.query.v1beta1 PageRequest/PageResponse
 (offset/limit/count_total/reverse; next_key is an opaque offset cursor).
 
+Alongside the gRPC listener, `serve_grpc` starts a health/debug HTTP
+sidecar (GrpcPlane.debug_url) mounting the shared observability handler —
+GET /metrics, /trace_tables[/<name>], /healthz — byte-identical to the
+JSON-RPC and REST planes' exposition (trace/exposition.py).
+
 `GrpcNode` is the client half: it implements the node surface TxClient
 consumes (broadcast / query_account / tx_status / validators / chain_id),
 so txsim and user.TxClient run unchanged against a gRPC endpoint — the
@@ -667,17 +672,61 @@ def _handlers(node) -> dict:
 class GrpcPlane:
     server: object
     port: int
+    debug_httpd: object = None
+    debug_port: int = 0
 
     @property
     def target(self) -> str:
         return f"127.0.0.1:{self.port}"
 
+    @property
+    def debug_url(self) -> str:
+        return f"http://127.0.0.1:{self.debug_port}"
+
     def stop(self, grace: float = 0.5) -> None:
         self.server.stop(grace)
+        if self.debug_httpd is not None:
+            self.debug_httpd.shutdown()
+            self.debug_httpd.server_close()
 
 
-def serve_grpc(node, port: int = 0, max_workers: int = 16) -> GrpcPlane:
-    """Start the gRPC plane for a node; returns the live server + port."""
+def _serve_debug_port(host: str, port: int):
+    """The gRPC plane's health/debug sidecar: gRPC has no GET surface, so
+    the shared observability handler (trace/exposition.py — /metrics,
+    /trace_tables, /healthz) rides a tiny HTTP server next to it, the same
+    bytes the JSON-RPC and REST planes serve."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from celestia_app_tpu.trace.exposition import (
+        handle_observability_get,
+        send_observability_response,
+    )
+
+    class _DebugHandler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+        def do_GET(self):  # noqa: N802 — http.server API
+            resp = handle_observability_get(self.path)
+            if resp is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            send_observability_response(self, resp)
+
+    httpd = ThreadingHTTPServer((host, port), _DebugHandler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd
+
+
+def serve_grpc(node, port: int = 0, max_workers: int = 16,
+               debug_port: int | None = 0) -> GrpcPlane:
+    """Start the gRPC plane for a node; returns the live server + port.
+
+    `debug_port` (default: ephemeral) also starts the plane's health/debug
+    HTTP sidecar serving the shared /metrics, /trace_tables, and /healthz;
+    pass None to disable it."""
     import grpc
 
     ident = lambda b: b  # byte-level (de)serialization; codecs above
@@ -706,7 +755,12 @@ def serve_grpc(node, port: int = 0, max_workers: int = 16) -> GrpcPlane:
         )
     bound = server.add_insecure_port(f"127.0.0.1:{port}")
     server.start()
-    return GrpcPlane(server, bound)
+    debug_httpd = None
+    debug_bound = 0
+    if debug_port is not None:
+        debug_httpd = _serve_debug_port("127.0.0.1", debug_port)
+        debug_bound = debug_httpd.server_address[1]
+    return GrpcPlane(server, bound, debug_httpd, debug_bound)
 
 
 # --- client ----------------------------------------------------------------

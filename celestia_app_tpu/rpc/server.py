@@ -1090,20 +1090,22 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def do_GET(self):
-        """GET /metrics: Prometheus text exposition (the Tendermint
-        instrumentation analog, test/e2e/testnet/setup.go:24)."""
-        if self.path.rstrip("/") != "/metrics":
+        """GET /metrics + /trace_tables[/<name>] + /healthz: the shared
+        observability surface (trace/exposition.py — the Tendermint
+        instrumentation analog, test/e2e/testnet/setup.go:24, and the
+        pkg/trace table puller, node.go:52-74).  All three serving planes
+        mount the same handler, so the exposition is byte-identical."""
+        from celestia_app_tpu.trace.exposition import (
+            handle_observability_get,
+            send_observability_response,
+        )
+
+        resp = handle_observability_get(self.path)
+        if resp is None:
             self.send_response(404)
             self.end_headers()
             return
-        from celestia_app_tpu.trace.metrics import registry
-
-        payload = registry().render().encode()
-        self.send_response(200)
-        self.send_header("Content-Type", "text/plain; version=0.0.4")
-        self.send_header("Content-Length", str(len(payload)))
-        self.end_headers()
-        self.wfile.write(payload)
+        send_observability_response(self, resp)
 
     def do_POST(self):
         try:

@@ -1,3 +1,3 @@
-from celestia_app_tpu.trace.tracer import Tracer, traced
+from celestia_app_tpu.trace.tracer import Tracer, trace_enabled, traced
 
-__all__ = ["Tracer", "traced"]
+__all__ = ["Tracer", "trace_enabled", "traced"]

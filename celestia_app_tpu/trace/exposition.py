@@ -1,0 +1,70 @@
+"""Shared observability HTTP surface for every serving plane.
+
+The reference exposes Tendermint's Prometheus endpoint from the node and
+lets the e2e harness pull pkg/trace's columnar tables off it
+(test/e2e/testnet/setup.go:24, node.go:52-74).  Here one handler serves
+both, and all three planes mount it — the JSON-RPC server, the REST
+api_gateway, and the gRPC plane's debug port — so the exposition is
+byte-identical for the same registry state no matter which port a scraper
+hits:
+
+    GET /metrics                 Prometheus text exposition (version 0.0.4)
+    GET /trace_tables            {"tables": {name: row_count}}
+    GET /trace_tables/<name>     the table as JSONL (application/x-ndjson)
+    GET /healthz                 {"status": "SERVING"} liveness probe
+"""
+
+from __future__ import annotations
+
+import json
+
+METRICS_CONTENT_TYPE = "text/plain; version=0.0.4"
+
+
+def metrics_payload() -> bytes:
+    """The Prometheus exposition bytes — THE single renderer every plane
+    serves, which is what makes cross-plane byte-identity structural
+    rather than a test invariant."""
+    from celestia_app_tpu.trace.metrics import registry
+
+    return registry().render().encode()
+
+
+def handle_observability_get(path: str):
+    """Route an HTTP GET path; returns (status, content_type, body-bytes)
+    or None when the path is not an observability endpoint (the caller
+    falls through to its own routes / 404)."""
+    from celestia_app_tpu.trace.tracer import traced
+
+    p = path.split("?", 1)[0]
+    if p != "/":
+        p = p.rstrip("/")
+    if p == "/metrics":
+        return 200, METRICS_CONTENT_TYPE, metrics_payload()
+    if p == "/healthz":
+        return 200, "application/json", json.dumps({"status": "SERVING"}).encode()
+    if p == "/trace_tables":
+        return 200, "application/json", json.dumps(
+            {"tables": traced().row_counts()}
+        ).encode()
+    if p.startswith("/trace_tables/"):
+        name = p[len("/trace_tables/"):]
+        tracer = traced()
+        if name not in tracer.tables():
+            return 404, "application/json", json.dumps(
+                {"error": f"no trace table {name!r}"}
+            ).encode()
+        body = tracer.export_jsonl(name)
+        return 200, "application/x-ndjson", (body + "\n").encode()
+    return None
+
+
+def send_observability_response(handler, resp) -> None:
+    """Write a handle_observability_get result through a
+    BaseHTTPRequestHandler (the shape all three planes' handlers share)."""
+    status, content_type, body = resp
+    handler.send_response(status)
+    handler.send_header("Content-Type", content_type)
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)

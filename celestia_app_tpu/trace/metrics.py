@@ -14,6 +14,16 @@ from __future__ import annotations
 import threading
 from collections import defaultdict
 
+# Explicit bucket tuple for DEVICE timings: the default request-scale
+# buckets (5 ms floor) collapse every sub-millisecond kernel dispatch into
+# one bucket.  Spans/journal rows measuring device work pass these
+# explicitly at the call site; the floor is 100 µs — below the cheapest
+# observed dispatch — and the ceiling covers a cold k=512 transfer.
+DEVICE_SECONDS_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0,
+)
+
 
 def _fmt_value(v: float) -> str:
     """Full-precision exposition (prometheus_client style): integers stay
@@ -44,7 +54,9 @@ class Counter:
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         with self._lock:
-            items = list(self._values.items()) or [((), 0.0)]
+            # Sorted by label set: the exposition is a stable function of
+            # the registry STATE, never of sample arrival order.
+            items = sorted(self._values.items()) or [((), 0.0)]
         for key, val in items:
             out.append(f"{self.name}{_fmt_labels(dict(key))} {_fmt_value(val)}")
         return out
@@ -63,37 +75,60 @@ class Gauge(Counter):
 
 
 class Histogram:
-    """Cumulative-bucket histogram (Prometheus semantics)."""
+    """Cumulative-bucket histogram (Prometheus semantics), labeled: each
+    distinct label set is its own child series with its own bucket counts,
+    `le` merged into the labels on _bucket lines."""
 
     def __init__(self, name: str, help_text: str, buckets: tuple[float, ...]):
         self.name = name
         self.help = help_text
         self.buckets = tuple(sorted(buckets))
-        self._counts = [0] * (len(self.buckets) + 1)  # +Inf tail
-        self._sum = 0.0
+        # label key tuple -> [per-bucket counts (+Inf tail), sum]
+        self._children: dict[tuple, list] = {}
         self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, **labels) -> None:
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
         with self._lock:
-            self._sum += value
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = [
+                    [0] * (len(self.buckets) + 1), 0.0
+                ]
+            child[1] += value
+            counts = child[0]
             for i, b in enumerate(self.buckets):
                 if value <= b:
-                    self._counts[i] += 1
+                    counts[i] += 1
                     break
             else:
-                self._counts[-1] += 1
+                counts[-1] += 1
 
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         with self._lock:
+            children = [
+                (key, (list(child[0]), child[1]))
+                for key, child in sorted(self._children.items())
+            ] or [((), ([0] * (len(self.buckets) + 1), 0.0))]
+        for key, (counts, total) in children:
+            labels = dict(key)
             cumulative = 0
-            for b, c in zip(self.buckets, self._counts):
+            for b, c in zip(self.buckets, counts):
                 cumulative += c
-                out.append(f'{self.name}_bucket{{le="{b:g}"}} {cumulative}')
-            cumulative += self._counts[-1]
-            out.append(f'{self.name}_bucket{{le="+Inf"}} {cumulative}')
-            out.append(f"{self.name}_sum {_fmt_value(self._sum)}")
-            out.append(f"{self.name}_count {cumulative}")
+                out.append(
+                    f"{self.name}_bucket"
+                    f"{_fmt_labels({**labels, 'le': f'{b:g}'})} {cumulative}"
+                )
+            cumulative += counts[-1]
+            out.append(
+                f"{self.name}_bucket"
+                f"{_fmt_labels({**labels, 'le': '+Inf'})} {cumulative}"
+            )
+            out.append(
+                f"{self.name}_sum{_fmt_labels(labels)} {_fmt_value(total)}"
+            )
+            out.append(f"{self.name}_count{_fmt_labels(labels)} {cumulative}")
         return out
 
 

@@ -1,0 +1,141 @@
+"""JAX profiler + HBM accounting hooks (env-gated, off the hot path).
+
+Two device-side instruments the journal funnel drives per block:
+
+  * an N-block `jax.profiler.start_trace`/`stop_trace` window:
+    $CELESTIA_PROFILE_BLOCKS=N arms it; the trace starts on the first
+    journaled block and stops after N, writing the TensorBoard-loadable
+    trace under $CELESTIA_PROFILE_DIR (default /tmp/celestia_jax_trace).
+    One window per process — profiling is a measurement run, not a
+    steady-state cost;
+  * an HBM high-water gauge from `device.memory_stats()`:
+    celestia_hbm_peak_bytes{point=...,k=...}, refreshed per journaled
+    dispatch.  CPU backends return no stats — the gauge simply never
+    appears there (guarded None, never an exception on the block path).
+
+This is the instrument for the ROADMAP TODO "measure whether donation
+moves the k=512 HBM high-water mark enough to deepen the stream pipeline
+past depth 2": run the stream bench once with $CELESTIA_PIPE_FUSED=auto
+and once =off, diff the gauge.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+
+def profile_blocks_target() -> int:
+    """$CELESTIA_PROFILE_BLOCKS: how many journaled blocks the jax
+    profiler window spans (0 = disabled)."""
+    try:
+        return int(os.environ.get("CELESTIA_PROFILE_BLOCKS", "0") or "0")
+    except ValueError:
+        return 0
+
+
+def profile_dir() -> str:
+    return os.environ.get("CELESTIA_PROFILE_DIR", "/tmp/celestia_jax_trace")
+
+
+class BlockProfiler:
+    """One env-gated profiler window per process, advanced per block."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._active = False
+        self._remaining = 0
+        self._done = False
+
+    def note_block(self) -> None:
+        target = profile_blocks_target()
+        if target <= 0 or self._done:
+            return
+        with self._lock:
+            if self._done:
+                return
+            if not self._active:
+                if not self._start(target):
+                    return
+            self._remaining -= 1
+            if self._remaining <= 0:
+                self._stop()
+
+    def _start(self, target: int) -> bool:
+        from celestia_app_tpu.trace.tracer import traced
+
+        logdir = profile_dir()
+        try:
+            import jax
+
+            os.makedirs(logdir, exist_ok=True)
+            jax.profiler.start_trace(logdir)
+        except Exception as e:  # noqa: BLE001 — profiling must never take
+            # down the block path; record the failure once and disarm.
+            self._done = True
+            traced().write("profiler", event="start_failed",
+                           error=f"{type(e).__name__}: {e}"[:200])
+            return False
+        self._active = True
+        self._remaining = target
+        traced().write("profiler", event="started", blocks=target,
+                       logdir=logdir)
+        return True
+
+    def _stop(self) -> None:
+        from celestia_app_tpu.trace.tracer import traced
+
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+            traced().write("profiler", event="stopped", logdir=profile_dir())
+        except Exception as e:  # noqa: BLE001
+            traced().write("profiler", event="stop_failed",
+                           error=f"{type(e).__name__}: {e}"[:200])
+        self._active = False
+        self._done = True  # one window per process
+
+
+_PROFILER = BlockProfiler()
+
+
+def block_profiler() -> BlockProfiler:
+    return _PROFILER
+
+
+def hbm_high_water(device=None) -> int | None:
+    """Peak device-memory bytes from the allocator, or None when the
+    backend keeps no stats (CPU).  A stats read, never a device sync."""
+    try:
+        import jax
+
+        device = device or jax.devices()[0]
+        stats = device.memory_stats()
+    except Exception:  # noqa: BLE001 — absent API / uninitialized backend
+        return None
+    if not stats:
+        return None
+    peak = stats.get("peak_bytes_in_use", stats.get("bytes_in_use"))
+    return int(peak) if peak else None
+
+
+def record_hbm_high_water(point: str = "dispatch",
+                          k: int | None = None) -> int | None:
+    """Refresh celestia_hbm_peak_bytes{point,k} and journal the sample;
+    returns the peak (None on CPU, where the gauge never appears)."""
+    peak = hbm_high_water()
+    if peak is None:
+        return None
+    from celestia_app_tpu.trace.metrics import registry
+    from celestia_app_tpu.trace.tracer import traced
+
+    labels = {"point": point}
+    if k is not None:
+        labels["k"] = str(k)
+    registry().gauge(
+        "celestia_hbm_peak_bytes",
+        "device memory high-water mark (allocator peak_bytes_in_use)",
+    ).set(peak, **labels)
+    traced().write("hbm_high_water", point=point, k=k, peak_bytes=peak)
+    return peak

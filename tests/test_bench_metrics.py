@@ -1,0 +1,104 @@
+"""bench.py --metrics-out: Prometheus textfile + JSONL tables, no device.
+
+Drives the writer with stub stage records (the shapes _run_child emits) so
+the tier-1 suite pins the artifact format without ever touching a backend;
+importing bench must stay jax-free for the same reason.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+
+
+def _import_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    return bench
+
+
+STUB_RECS = [
+    {"stage": "probe", "platform": "cpu", "n_devices": 8},
+    {"stage": "parts@128", "mode": "parts", "k": 128,
+     "parts_seconds": {"rs_dense": 0.5}, "tuned": None, "mb": 8.4,
+     "wall_s": 3.0, "loadavg": 0.5, "platform": "cpu"},
+    {"stage": "compute@128", "mode": "compute", "k": 128,
+     "seconds_per_block": 0.0842, "mb": 8.4, "mb_per_s": 99.76,
+     "wall_s": 2.0, "loadavg": 0.4, "platform": "cpu"},
+    {"stage": "compute@128#2", "mode": "compute", "k": 128,
+     "seconds_per_block": 0.088, "mb": 8.4, "mb_per_s": 95.45,
+     "wall_s": 2.0, "loadavg": 0.4, "platform": "cpu"},
+    {"stage": "stream@128", "mode": "stream", "k": 128,
+     "seconds_per_block": 0.12, "mb": 8.4, "mb_per_s": 70.0,
+     "wall_s": 2.5, "loadavg": 0.4, "platform": "cpu"},
+    {"stage": "repair@256", "error": "RuntimeError: boom"},
+    {"stage": "extend@512", "skipped": "budget", "remaining_s": 10.0},
+    {"stage": "done"},
+]
+
+
+class TestMetricsOut:
+    def test_writes_textfile_and_jsonl(self, tmp_path):
+        bench = _import_bench()
+        out_dir = tmp_path / "metrics"
+        bench._write_metrics_out(
+            str(out_dir), STUB_RECS, {"value": 99.76, "unit": "MB/s"}
+        )
+        prom = (out_dir / "bench_metrics.prom").read_text()
+        assert '# TYPE celestia_bench_mb_per_s gauge' in prom
+        assert ('celestia_bench_mb_per_s'
+                '{k="128",mode="compute",stage="compute@128"} 99.76') in prom
+        assert ('celestia_bench_mb_per_s'
+                '{k="128",mode="stream",stage="stream@128"} 70') in prom
+        assert ('celestia_bench_seconds_per_block'
+                '{k="128",mode="compute",stage="compute@128"} 0.0842') in prom
+        # the stability rerun keeps its own sample instead of overwriting
+        assert ('celestia_bench_mb_per_s'
+                '{k="128",mode="compute",stage="compute@128#2"} 95.45') in prom
+        assert 'celestia_bench_errors_total{stage="repair@256"} 1' in prom
+        assert 'celestia_bench_stages_skipped_total{stage="extend@512"} 1' in prom
+        assert "celestia_bench_headline_mb_per_s 99.76" in prom
+        rows = [
+            json.loads(line)
+            for line in (out_dir / "bench_rows.jsonl").read_text().splitlines()
+        ]
+        # probe/done bookkeeping rows are filtered; stage rows all land.
+        assert {r["stage"] for r in rows} == {
+            "parts@128", "compute@128", "compute@128#2", "stream@128",
+            "repair@256", "extend@512",
+        }
+        assert all("ts_ns" in r for r in rows)
+
+    def test_artifacts_survive_trace_off(self, tmp_path, monkeypatch):
+        """--metrics-out is an explicit request: $CELESTIA_TRACE=off mutes
+        the global layer, never these files."""
+        bench = _import_bench()
+        monkeypatch.setenv("CELESTIA_TRACE", "off")
+        out_dir = tmp_path / "gated"
+        bench._write_metrics_out(str(out_dir), STUB_RECS, {"value": 1.0})
+        rows = (out_dir / "bench_rows.jsonl").read_text().strip().splitlines()
+        assert len(rows) == 6
+
+    def test_empty_run_still_writes_valid_files(self, tmp_path):
+        bench = _import_bench()
+        out_dir = tmp_path / "empty"
+        bench._write_metrics_out(str(out_dir), [], {"value": 0})
+        prom = (out_dir / "bench_metrics.prom").read_text()
+        assert "celestia_bench_headline_mb_per_s 0" in prom
+        assert (out_dir / "bench_rows.jsonl").read_text() == ""
+
+    def test_metrics_out_flag_parsing(self, monkeypatch):
+        bench = _import_bench()
+        monkeypatch.delenv("BENCH_METRICS_OUT", raising=False)
+        assert bench._parse_metrics_out([]) is None
+        assert bench._parse_metrics_out(["--metrics-out", "/tmp/x"]) == "/tmp/x"
+        monkeypatch.setenv("BENCH_METRICS_OUT", "/tmp/env")
+        assert bench._parse_metrics_out([]) == "/tmp/env"
+        # flag wins over env
+        assert bench._parse_metrics_out(["--metrics-out", "/tmp/x"]) == "/tmp/x"
+        # trailing flag without a value: fall back, don't crash
+        assert bench._parse_metrics_out(["--metrics-out"]) == "/tmp/env"

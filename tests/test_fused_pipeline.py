@@ -121,6 +121,29 @@ class TestFusedParity:
         assert fused.col_roots() == staged.col_roots()
         np.testing.assert_array_equal(fused.squared(), staged.squared())
 
+    def test_golden_vectors_unaffected_by_tracing(self, monkeypatch):
+        """Observability regression pin: the golden DAH hash is identical
+        with tracing explicitly enabled and disabled — spans/journal rows
+        must never perturb the device pipeline's bytes."""
+        from celestia_app_tpu.da.dah import DataAvailabilityHeader
+        from celestia_app_tpu.trace import journal, traced
+
+        k = 2
+        shares = [_golden_share()] * (k * k)
+        ods = np.frombuffer(b"".join(shares), dtype=np.uint8).reshape(
+            k, k, SHARE_SIZE
+        )
+        for gate in ("on", "off"):
+            monkeypatch.setenv("CELESTIA_TRACE", gate)
+            before = len(traced().table(journal.TABLE))
+            eds = ExtendedDataSquare.compute(ods.copy())
+            dah = DataAvailabilityHeader(
+                row_roots=eds.row_roots(), column_roots=eds.col_roots()
+            )
+            assert dah.hash() == K2_HASH, gate
+            journaled = len(traced().table(journal.TABLE)) - before
+            assert journaled == (1 if gate == "on" else 0)
+
     def test_extend_shares_construction_pin(self):
         """The construction seam threads through extend_shares: pinning the
         active construction explicitly must be byte-identical to default

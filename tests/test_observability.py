@@ -1,0 +1,354 @@
+"""Device-pipeline observability: thread-safe tracer, exposition goldens,
+block journal, profiler gating, and the unified /metrics surface.
+
+Runs without the signing stack (no `cryptography`) so the layer is pinned
+even in slim images; the JSON-RPC-plane leg of the byte-identity check
+importorskips onto it where available.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.constants import SHARE_SIZE
+from celestia_app_tpu.trace import journal
+from celestia_app_tpu.trace.exposition import handle_observability_get
+from celestia_app_tpu.trace.metrics import (
+    DEVICE_SECONDS_BUCKETS,
+    Registry,
+    registry,
+)
+from celestia_app_tpu.trace.tracer import Tracer, traced
+
+
+class TestTracerThreadSafety:
+    def test_threaded_writers_and_readers(self):
+        """Uploader/dispatcher-shaped load: concurrent writes, spans, and
+        exports on one tracer must neither raise nor lose in-buffer rows."""
+        tracer = Tracer(buffer_size=100_000)
+        errors: list[Exception] = []
+        n_threads, n_rows = 8, 500
+
+        def writer(tid: int):
+            try:
+                for i in range(n_rows):
+                    tracer.write("stress", tid=tid, i=i)
+                    if i % 50 == 0:
+                        with tracer.span("stress_span", k=tid):
+                            pass
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        def reader():
+            try:
+                for _ in range(200):
+                    tracer.export_jsonl("stress")
+                    tracer.table("stress")
+                    tracer.tables()
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=writer, args=(t,)) for t in range(n_threads)
+        ] + [threading.Thread(target=reader) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert len(tracer.table("stress")) == n_threads * n_rows
+
+    def test_eviction_counts_dropped_rows(self):
+        tracer = Tracer(buffer_size=10)
+        before = _counter_value("celestia_trace_rows_dropped", table="evict_me")
+        for i in range(25):
+            tracer.write("evict_me", i=i)
+        rows = tracer.table("evict_me")
+        assert len(rows) == 10
+        assert [r["i"] for r in rows] == list(range(15, 25))  # oldest evicted
+        assert _counter_value(
+            "celestia_trace_rows_dropped", table="evict_me"
+        ) == before + 15
+
+    def test_trace_env_gate(self, monkeypatch):
+        tracer = Tracer()
+        monkeypatch.setenv("CELESTIA_TRACE", "off")
+        tracer.write("gated", x=1)
+        with tracer.span("gated_span"):
+            pass
+        assert tracer.tables() == []
+        monkeypatch.setenv("CELESTIA_TRACE", "on")
+        tracer.write("gated", x=2)
+        assert len(tracer.table("gated")) == 1
+
+
+def _counter_value(name: str, **labels) -> float:
+    """Read one labeled sample back out of the global exposition."""
+    for line in registry().render().splitlines():
+        if line.startswith(name) and all(
+            f'{k}="{v}"' in line for k, v in labels.items()
+        ):
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+class TestSpanLabels:
+    def test_low_cardinality_attrs_become_labels(self):
+        with traced().span("obs_span_label_test", buckets=DEVICE_SECONDS_BUCKETS,
+                           k=8, height=123):
+            pass
+        text = registry().render()
+        series = [
+            line for line in text.splitlines()
+            if line.startswith("celestia_obs_span_label_test_seconds_count")
+        ]
+        assert series == ['celestia_obs_span_label_test_seconds_count{k="8"} 1']
+        # height stays table-only: unbounded cardinality never reaches
+        # the registry, but the event row keeps every attr.
+        assert "height" not in " ".join(
+            line for line in text.splitlines()
+            if "obs_span_label_test" in line
+        )
+        row = traced().table("obs_span_label_test")[-1]
+        assert row["height"] == 123 and row["k"] == 8
+        assert row["duration_ms"] >= 0
+
+    def test_explicit_device_buckets(self):
+        with traced().span("obs_span_bucket_test",
+                           buckets=DEVICE_SECONDS_BUCKETS):
+            pass
+        text = registry().render()
+        assert 'celestia_obs_span_bucket_test_seconds_bucket{le="0.0001"}' in text
+        assert 'celestia_obs_span_bucket_test_seconds_bucket{le="+Inf"}' in text
+
+
+class TestExpositionGolden:
+    def test_full_exposition_golden(self):
+        """Byte-exact golden: counter/gauge/histogram incl. labels, with
+        cumulative le buckets and +Inf == _count == sum of observations."""
+        r = Registry()
+        c = r.counter("jobs_total", "jobs seen")
+        c.inc(result="ok")
+        c.inc(result="ok")
+        c.inc(result="err")
+        r.gauge("depth", "queue depth").set(3, queue="tasks")
+        h = r.histogram("lat_seconds", "latency", buckets=(0.001, 0.01, 0.1))
+        for v in (0.0005, 0.0005, 0.05, 5.0):
+            h.observe(v, k="8")
+        assert r.render() == (
+            "# HELP depth queue depth\n"
+            "# TYPE depth gauge\n"
+            'depth{queue="tasks"} 3\n'
+            "# HELP jobs_total jobs seen\n"
+            "# TYPE jobs_total counter\n"
+            'jobs_total{result="err"} 1\n'
+            'jobs_total{result="ok"} 2\n'
+            "# HELP lat_seconds latency\n"
+            "# TYPE lat_seconds histogram\n"
+            'lat_seconds_bucket{k="8",le="0.001"} 2\n'
+            'lat_seconds_bucket{k="8",le="0.01"} 2\n'
+            'lat_seconds_bucket{k="8",le="0.1"} 3\n'
+            'lat_seconds_bucket{k="8",le="+Inf"} 4\n'
+            'lat_seconds_sum{k="8"} 5.051\n'
+            'lat_seconds_count{k="8"} 4\n'
+        )
+
+    def test_unlabeled_histogram_renders_like_before(self):
+        r = Registry()
+        h = r.histogram("plain_seconds", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        text = r.render()
+        assert 'plain_seconds_bucket{le="0.1"} 1' in text
+        assert "plain_seconds_sum 0.05" in text
+        assert "plain_seconds_count 1" in text
+
+
+class TestObservabilityHandler:
+    def test_trace_tables_listing_and_jsonl(self):
+        traced().write("obs_handler_table", a=1, b="x")
+        status, ctype, body = handle_observability_get("/trace_tables")
+        assert status == 200 and ctype == "application/json"
+        assert json.loads(body)["tables"]["obs_handler_table"] >= 1
+        status, ctype, body = handle_observability_get(
+            "/trace_tables/obs_handler_table"
+        )
+        assert status == 200 and ctype == "application/x-ndjson"
+        rows = [json.loads(l) for l in body.decode().strip().splitlines()]
+        assert rows[-1]["a"] == 1 and rows[-1]["b"] == "x"
+        assert "ts_ns" in rows[-1]
+
+    def test_unknown_table_404_and_non_observability_none(self):
+        status, _, _ = handle_observability_get("/trace_tables/no_such_table")
+        assert status == 404
+        assert handle_observability_get("/cosmos/whatever") is None
+
+    def test_healthz(self):
+        status, _, body = handle_observability_get("/healthz")
+        assert status == 200 and json.loads(body) == {"status": "SERVING"}
+
+
+class TestBlockJournal:
+    def test_streamed_run_writes_rows_with_stage_timings(self):
+        """Acceptance: a streamed CPU run produces block-journal rows with
+        upload/dispatch/stall timings."""
+        from celestia_app_tpu.parallel.pipeline import stream_blocks
+
+        k = 4
+        before = len(traced().table(journal.TABLE))
+        blocks = [
+            (f"obsjournal-{i}", np.zeros((k, k, SHARE_SIZE), dtype=np.uint8))
+            for i in range(3)
+        ]
+        out = list(stream_blocks(iter(blocks), k, depth=2))
+        assert len(out) == 3
+        rows = [
+            r for r in traced().table(journal.TABLE)[before:]
+            if str(r.get("tag", "")).startswith("obsjournal-")
+        ]
+        assert len(rows) == 3
+        for row in rows:
+            assert row["source"] == "stream" and row["k"] == k
+            assert row["mode"] in ("fused", "staged")
+            assert row["compile"] in ("hit", "miss")
+            assert row["depth"] == 2
+            for field in ("upload_ms", "upload_stall_ms", "dispatch_ms",
+                          "dispatch_starve_ms", "drain_ms"):
+                assert isinstance(row[field], float) and row[field] >= 0, field
+        # compile state is paid at most once per pipeline.
+        assert [r["compile"] for r in rows[1:]] == ["hit", "hit"]
+        # The same timings landed on the device-bucketed histograms.
+        text = registry().render()
+        assert 'celestia_block_upload_seconds_bucket{k="4",le="0.0001",source="stream"}' in text
+        assert "celestia_pipeline_queue_depth" in text
+
+    def test_warmup_journals_rows(self):
+        from celestia_app_tpu.da.eds import warmup
+
+        before = len(traced().table(journal.TABLE))
+        warmup(square_sizes=[2])
+        rows = [
+            r for r in traced().table(journal.TABLE)[before:]
+            if r["source"] == "warmup"
+        ]
+        assert rows and rows[-1]["k"] == 2
+        assert rows[-1]["warm_ms"] >= 0
+        assert rows[-1]["compile"] in ("hit", "miss")
+
+    def test_compute_path_journals_with_compile_state(self):
+        from celestia_app_tpu.da.eds import ExtendedDataSquare
+
+        k = 4
+        before = len(traced().table(journal.TABLE))
+        ods = np.zeros((k, k, SHARE_SIZE), dtype=np.uint8)
+        ExtendedDataSquare.compute(ods)
+        rows = [
+            r for r in traced().table(journal.TABLE)[before:]
+            if r["source"] == "compute" and r["k"] == k
+        ]
+        assert rows, "compute() must journal one row"
+        assert rows[-1]["compile"] in ("hit", "miss")
+        assert rows[-1]["dispatch_ms"] >= 0
+        assert rows[-1]["upload_ms"] >= 0  # numpy input: upload measured
+
+
+class TestProfilerHooks:
+    def test_hbm_gauge_is_none_on_cpu(self):
+        from celestia_app_tpu.trace import profiler
+
+        assert profiler.hbm_high_water() is None
+        assert profiler.record_hbm_high_water(point="test") is None
+
+    def test_profiler_window_gated_and_bounded(self, monkeypatch, tmp_path):
+        from celestia_app_tpu.trace.profiler import BlockProfiler
+
+        prof = BlockProfiler()
+        monkeypatch.delenv("CELESTIA_PROFILE_BLOCKS", raising=False)
+        prof.note_block()
+        assert not prof._active and not prof._done  # ungated: no-op
+
+        monkeypatch.setenv("CELESTIA_PROFILE_BLOCKS", "2")
+        monkeypatch.setenv("CELESTIA_PROFILE_DIR", str(tmp_path))
+        before = len(traced().table("profiler"))
+        prof.note_block()
+        prof.note_block()
+        prof.note_block()  # past the window: no restart (one per process)
+        events = [r["event"] for r in traced().table("profiler")[before:]]
+        assert prof._done
+        if events and events[0] == "started":
+            assert events == ["started", "stopped"]
+            assert any(tmp_path.iterdir()), "trace files under the logdir"
+        else:  # images without profiler deps: failure recorded, disarmed
+            assert events == ["start_failed"]
+
+
+class _StubNode:
+    """The minimal surface the REST/gRPC planes need at build time."""
+
+    chain_id = "obs-test"
+
+
+class TestUnifiedMetrics:
+    def test_rest_and_grpc_debug_expositions_are_byte_identical(self):
+        pytest.importorskip("grpc")
+        from celestia_app_tpu.rpc.api_gateway import serve_api
+        from celestia_app_tpu.rpc.grpc_plane import serve_grpc
+
+        gw = serve_api(_StubNode())
+        plane = serve_grpc(_StubNode())
+        try:
+            assert plane.debug_port
+            registry().counter(
+                "obs_unified_probe_total", "cross-plane identity probe"
+            ).inc(plane="any")
+            bodies = {}
+            for name, url in (("rest", gw.url), ("grpc", plane.debug_url)):
+                with urllib.request.urlopen(url + "/metrics", timeout=10) as resp:
+                    assert resp.status == 200
+                    assert resp.headers["Content-Type"].startswith("text/plain")
+                    bodies[name] = resp.read()
+            assert bodies["rest"] == bodies["grpc"]
+            assert b"obs_unified_probe_total" in bodies["rest"]
+            # /trace_tables and /healthz ride the same handler everywhere.
+            with urllib.request.urlopen(gw.url + "/trace_tables", timeout=10) as resp:
+                assert resp.status == 200
+            with urllib.request.urlopen(plane.debug_url + "/healthz", timeout=10) as resp:
+                assert json.loads(resp.read()) == {"status": "SERVING"}
+        finally:
+            gw.stop()
+            plane.stop()
+
+    def test_all_three_planes_byte_identical(self):
+        """The full acceptance check; needs the signing stack + grpc."""
+        pytest.importorskip("cryptography")
+        pytest.importorskip("grpc")
+        from celestia_app_tpu.rpc.api_gateway import serve_api
+        from celestia_app_tpu.rpc.grpc_plane import serve_grpc
+        from celestia_app_tpu.rpc.server import ServingNode, serve
+        from celestia_app_tpu.testutil.testnode import (
+            deterministic_genesis,
+            funded_keys,
+        )
+
+        keys = funded_keys(2)
+        node = ServingNode(genesis=deterministic_genesis(keys), keys=keys)
+        server = serve(node, port=0, block_interval_s=None)
+        gw = serve_api(node)
+        plane = serve_grpc(node)
+        try:
+            node.produce_block()
+            bodies = []
+            for url in (server.url, gw.url, plane.debug_url):
+                with urllib.request.urlopen(url + "/metrics", timeout=10) as resp:
+                    bodies.append(resp.read())
+            assert bodies[0] == bodies[1] == bodies[2]
+            assert b"celestia_block_height" in bodies[0]
+        finally:
+            server.stop()
+            gw.stop()
+            plane.stop()
