@@ -84,7 +84,7 @@ from celestia_app_tpu.tx.messages import (
     MsgWithdrawDelegatorReward,
     MsgWithdrawValidatorCommission,
 )
-from celestia_app_tpu.trace import traced
+from celestia_app_tpu.trace import trace_span, traced
 from celestia_app_tpu.tx.sign import Tx
 
 
@@ -328,15 +328,18 @@ class App:
 
     # --- PrepareProposal (app/prepare_proposal.go:22-91) --------------------
     def prepare_proposal(self, raw_txs: list[bytes]) -> BlockData:
-        # telemetry.MeasureSince parity (prepare_proposal.go:23).
-        with traced().span("prepare_proposal", height=self.height + 1, n_txs=len(raw_txs)):
+        # telemetry.MeasureSince parity (prepare_proposal.go:23); joins
+        # the block's trace when the caller set one (trace/context.py).
+        with trace_span("prepare_proposal", layer="app",
+                        height=self.height + 1, n_txs=len(raw_txs)):
             raw_txs = self._cap_block_bytes(raw_txs)
             filtered = self._filter_txs(raw_txs)
             sq, kept = square.build(filtered, self.max_effective_square_size())
             if sq.is_empty():
                 dah = min_data_availability_header()
                 return BlockData(tuple(kept), 1, dah.hash())
-            with traced().span("square_pipeline", k=sq.size, phase="prepare"):
+            with trace_span("square_pipeline", layer="device", e2e="dispatch",
+                            k=sq.size, phase="prepare"):
                 root = self._square_root(sq.size, sq.share_bytes())
             return BlockData(tuple(kept), sq.size, root)
 
@@ -403,7 +406,8 @@ class App:
         outcomes = registry().counter(
             "celestia_process_proposal_total", "ProcessProposal verdicts"
         )
-        with traced().span("process_proposal", height=self.height + 1, n_txs=len(data.txs)):
+        with trace_span("process_proposal", layer="app",
+                        height=self.height + 1, n_txs=len(data.txs)):
             try:
                 ok = self._process_proposal(data)
             except Exception:

@@ -42,6 +42,11 @@ from celestia_app_tpu.consensus.votes import (
     Vote,
 )
 
+# Round observability (one round_journal trace row per (height, round)).
+# Defined under trace/ so slim images load it without the signing stack;
+# re-exported here because it is part of the machine's construction API.
+from celestia_app_tpu.trace.round_journal import RoundJournal
+
 # Steps within a round.
 PROPOSE, PREVOTE_STEP, PRECOMMIT_STEP = "propose", "prevote", "precommit"
 
@@ -266,6 +271,7 @@ class RoundMachine:
         sign_guard=None,  # f(height, round, type, block_hash) -> bool (WAL)
         locked_value: bytes | None = None,
         locked_round: int = -1,
+        journal: RoundJournal | None = None,
     ):
         self.chain_id = chain_id
         self.height = height
@@ -278,6 +284,9 @@ class RoundMachine:
         # own signature; False => this validator already signed something
         # conflicting for these coordinates (possibly before a restart).
         self.sign_guard = sign_guard
+        # Round observability (one round_journal row per (height, round));
+        # None keeps the machine journal-free for pure-logic tests.
+        self.journal = journal
 
         self.round = 0
         self.step = PROPOSE
@@ -315,6 +324,11 @@ class RoundMachine:
         base, delta = self.timeouts[step]
         return ScheduleTimeout(round, step, base + delta * round)
 
+    def _set_step(self, step: str) -> None:
+        self.step = step
+        if self.journal is not None:
+            self.journal.record_step(self, step)
+
     def _vote(self, vote_type: int, block_hash: bytes, effects: list) -> None:
         """Sign, self-count, and broadcast a vote (no-op for observers;
         refused by the sign guard if these coordinates were already
@@ -339,8 +353,13 @@ class RoundMachine:
         return self._start_round(0)
 
     def _start_round(self, round: int) -> list:
+        if self.journal is not None and round > self.round:
+            # The previous round failed to decide; journal it on the way out.
+            self.journal.close_round(self, "round_bump")
         self.round = round
         self.step = PROPOSE
+        if self.journal is not None:
+            self.journal.open_round(self)
         effects: list = []
         if self.my_address == self.proposer(round) and self.my_key is not None:
             effects.append(
@@ -428,18 +447,25 @@ class RoundMachine:
         effects: list = []
         if step == PROPOSE and round == self.round and self.step == PROPOSE:
             # No acceptable proposal in time: prevote nil (paper line 57).
+            self._journal_timeout(round, step)
             self._vote(PREVOTE, NIL, effects)
-            self.step = PREVOTE_STEP
+            self._set_step(PREVOTE_STEP)
             effects += self._check_rules()
         elif step == PREVOTE_STEP and round == self.round and self.step == PREVOTE_STEP:
             # Prevotes diverged (no polka in time): precommit nil (line 61).
+            self._journal_timeout(round, step)
             self._vote(PRECOMMIT, NIL, effects)
-            self.step = PRECOMMIT_STEP
+            self._set_step(PRECOMMIT_STEP)
             effects += self._check_rules()
         elif step == PRECOMMIT_STEP and round == self.round:
             # The round failed to commit: move on (line 65).
+            self._journal_timeout(round, step)
             effects += self._start_round(round + 1)
         return effects
+
+    def _journal_timeout(self, round: int, step: str) -> None:
+        if self.journal is not None:
+            self.journal.record_timeout(self, round, step)
 
     # --- standing rules ----------------------------------------------------
     def _enter_prevote(self, effects: list) -> None:
@@ -451,7 +477,7 @@ class RoundMachine:
             if r in self._invalid_rounds:
                 # Proposal arrived but its block failed validation.
                 self._vote(PREVOTE, NIL, effects)
-                self.step = PREVOTE_STEP
+                self._set_step(PREVOTE_STEP)
             return
         if prop.pol_round == -1:
             acceptable = (
@@ -470,7 +496,7 @@ class RoundMachine:
         else:
             return  # malformed pol_round (>= own round): let the timeout run
         self._vote(PREVOTE, prop.block_hash if acceptable else NIL, effects)
-        self.step = PREVOTE_STEP
+        self._set_step(PREVOTE_STEP)
 
     def _check_rules(self) -> list:
         """The paper's standing 'upon' clauses.  Idempotent: fire-once
@@ -510,14 +536,14 @@ class RoundMachine:
                     self.locked_round = r
                     effects.append(Locked(r, prop.block_hash))
                     self._vote(PRECOMMIT, prop.block_hash, effects)
-                    self.step = PRECOMMIT_STEP
+                    self._set_step(PRECOMMIT_STEP)
                 self.valid_value = prop.block_hash
                 self.valid_round = r
 
         # Line 44: polka for nil while at prevote step => precommit nil.
         if self.step == PREVOTE_STEP and prevotes.has_two_thirds_for(NIL):
             self._vote(PRECOMMIT, NIL, effects)
-            self.step = PRECOMMIT_STEP
+            self._set_step(PRECOMMIT_STEP)
 
         # Line 47: +2/3 precommits (any mix) => schedule precommit timeout.
         key = ("precommit-any", r)
@@ -536,5 +562,7 @@ class RoundMachine:
             if prop_r is not None and prop_r.block_hash == value:
                 self.decided = Decided(round_r, value, tally.votes_for(value))
                 effects.append(self.decided)
+                if self.journal is not None:
+                    self.journal.close_round(self, "decided", round=round_r)
                 break
         return effects

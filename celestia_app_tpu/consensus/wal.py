@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 
 
 class VoteWAL:
@@ -32,6 +33,9 @@ class VoteWAL:
         self.votes: dict[tuple[int, int, int], str] = {}
         # height -> (locked_round, locked_value hex)
         self.locks: dict[int, tuple[int, str]] = {}
+        # Cumulative append+fsync wall time: the round journal reads the
+        # delta per round (consensus/machine.RoundJournal.fsync_ms_source).
+        self.fsync_ms_total = 0.0
         self._load()
         self._fh = open(path, "a", buffering=1)
 
@@ -54,9 +58,21 @@ class VoteWAL:
                     self.locks[rec["h"]] = (rec["r"], rec["b"])
 
     def _append(self, rec: dict) -> None:
+        t0 = time.perf_counter()
         self._fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
         self._fh.flush()
         os.fsync(self._fh.fileno())
+        elapsed = time.perf_counter() - t0
+        self.fsync_ms_total += elapsed * 1e3
+        # The fsync sits on the vote-signing path: its latency is a direct
+        # input to round time, so it gets its own histogram.
+        from celestia_app_tpu.trace.metrics import DEVICE_SECONDS_BUCKETS, registry
+
+        registry().histogram(
+            "celestia_wal_fsync_seconds",
+            "consensus WAL append+fsync wall time",
+            buckets=DEVICE_SECONDS_BUCKETS,
+        ).observe(elapsed)
 
     # --- the sign guard -----------------------------------------------------
     def may_sign(self, height: int, round: int, vote_type: int,

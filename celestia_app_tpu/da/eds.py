@@ -194,6 +194,96 @@ def warmup(
     return list(square_sizes)
 
 
+# --- fused-vs-staged parity sentinel ---------------------------------------
+#
+# $CELESTIA_PARITY_SENTINEL=N re-runs every Nth computed block's DAH through
+# the STAGED pipeline off the hot path (a daemon thread) and compares data
+# roots, ticking celestia_parity_checks_total{result=match|mismatch|error}.
+# A mismatch also writes a `parity_mismatch` trace row.  Nothing here ever
+# raises into a serving plane, and the hot path only enqueues handles (the
+# staged re-run and both host reads happen on the sentinel thread).
+
+import threading as _sentinel_threading
+
+_PARITY_LOCK = _sentinel_threading.Lock()
+_PARITY_COUNT = 0
+_PARITY_THREADS: list = []
+
+
+def parity_sentinel_every() -> int:
+    """$CELESTIA_PARITY_SENTINEL: check every Nth block (0 = disabled)."""
+    import os
+
+    try:
+        return int(os.environ.get("CELESTIA_PARITY_SENTINEL", "0") or "0")
+    except ValueError:
+        return 0
+
+
+def _maybe_parity_check(ods_host, k: int, construction: str, droot) -> None:
+    """Hot-path side: count the block and, every Nth, hand the (immutable)
+    ODS + fused root handles to a background checker."""
+    every = parity_sentinel_every()
+    if every <= 0:
+        return
+    from celestia_app_tpu.kernels.fused import pipeline_mode
+
+    if pipeline_mode() != "fused":
+        # Staged mode already IS the reference lowering: re-running it
+        # against itself would burn a duplicate dispatch to report a
+        # meaningless "match".
+        return
+    global _PARITY_COUNT
+    with _PARITY_LOCK:
+        _PARITY_COUNT += 1
+        if _PARITY_COUNT % every:
+            return
+        _PARITY_THREADS[:] = [t for t in _PARITY_THREADS if t.is_alive()]
+    t = _sentinel_threading.Thread(
+        target=_parity_check, args=(ods_host, k, construction, droot),
+        daemon=True, name="parity-sentinel",
+    )
+    with _PARITY_LOCK:
+        _PARITY_THREADS.append(t)
+    t.start()
+
+
+def _parity_check(ods_host, k: int, construction: str, droot) -> None:
+    from celestia_app_tpu.trace.metrics import registry
+    from celestia_app_tpu.trace.tracer import traced
+
+    checks = registry().counter(
+        "celestia_parity_checks_total",
+        "fused-vs-staged DAH parity sentinel verdicts",
+    )
+    try:
+        staged = _jit_pipeline(k, construction)(jnp.asarray(np.asarray(ods_host)))
+        staged_root = np.asarray(staged[3]).tobytes()
+        served_root = np.asarray(droot).tobytes()
+        if staged_root == served_root:
+            checks.inc(result="match")
+            return
+        checks.inc(result="mismatch")
+        traced().write(
+            "parity_mismatch", k=k, construction=construction,
+            served=served_root.hex(), staged=staged_root.hex(),
+        )
+    except Exception as e:  # noqa: BLE001 — the sentinel must never raise
+        checks.inc(result="error")
+        traced().write(
+            "parity_mismatch", k=k, construction=construction,
+            error=f"{type(e).__name__}: {e}"[:200],
+        )
+
+
+def drain_parity_checks(timeout_s: float = 30.0) -> None:
+    """Wait out in-flight sentinel checks (tests / orderly shutdown)."""
+    with _PARITY_LOCK:
+        threads = list(_PARITY_THREADS)
+    for t in threads:
+        t.join(timeout_s)
+
+
 class ExtendedDataSquare:
     """Host handle to a device-computed EDS with its NMT roots."""
 
@@ -223,6 +313,7 @@ class ExtendedDataSquare:
             raise ValueError(f"invalid square size {k}")
         assert ods.shape == (k, k, SHARE_SIZE), ods.shape
         mode = pipeline_mode()
+        sentinel_input = None  # a buffer still valid AFTER the dispatch
         if isinstance(ods, jax.Array):
             # jnp.asarray is a no-copy pass-through for a device array, so
             # donating here would invalidate the CALLER'S buffer.  Their
@@ -234,6 +325,7 @@ class ExtendedDataSquare:
                 "compute", k, mode=mode, compile=state,
                 dispatch_ms=(time.perf_counter() - t0) * 1e3,
             )
+            sentinel_input = ods  # undonated: still live and immutable
         else:
             # The upload below is this call's own buffer, never read again
             # — the donating pipeline may reuse it as extension scratch.
@@ -247,6 +339,10 @@ class ExtendedDataSquare:
                 upload_ms=(t1 - t0) * 1e3,
                 dispatch_ms=(time.perf_counter() - t1) * 1e3,
             )
+            sentinel_input = ods  # the host copy (x may be donated away)
+        _maybe_parity_check(
+            sentinel_input, k, construction or active_construction(), droot
+        )
         return cls(eds, rr, cr, droot, k)
 
     # --- rsmt2d-surface accessors (host copies) ---------------------------

@@ -6,12 +6,22 @@ the biggest square (128^2 x 478).  Admission runs CheckTx first (the app
 sets the priority = gas price x 1e6, app/ante/fee_checker.go:17); reaping
 returns txs in priority order under a byte budget, the order PrepareProposal
 receives them.
+
+Observability: every entry stores the submitting request's TraceContext
+(trace/context.py), so the insert span, the reap row, and the block built
+from the reap all share the submission's trace_id.  Pool health lives on
+three Prometheus families — `celestia_mempool_txs` /
+`celestia_mempool_size_bytes` gauges refreshed on every mutation, and
+`celestia_mempool_evictions_total{reason=priority|ttl|recheck}` counting
+every non-commit removal — and the lifecycle histogram gets the
+`mempool_wait` (insert -> reap) and `total` (submit -> commit) phases.
 """
 
 from __future__ import annotations
 
 import hashlib
-from dataclasses import dataclass
+import time
+from dataclasses import dataclass, field
 
 DEFAULT_TTL_NUM_BLOCKS = 5
 DEFAULT_MAX_TX_BYTES = 128 * 128 * 478  # ~7.8 MB
@@ -24,6 +34,9 @@ class _Entry:
     priority: int
     height: int  # admission height (for TTL)
     seq: int  # FIFO tiebreak
+    ctx: object | None = None  # submitting request's TraceContext
+    t_ins: float = field(default=0.0)  # perf_counter at admission
+    reaped: bool = False  # mempool_wait observed (first reap only)
 
 
 class PriorityMempool:
@@ -54,9 +67,52 @@ class PriorityMempool:
         """Is this exact tx resident? (gossip relay dedup)."""
         return self.tx_key(tx) in self._entries
 
-    def insert(self, tx: bytes, priority: int, height: int) -> bool:
+    def ctx_for(self, tx: bytes):
+        """The TraceContext a resident tx was submitted under, if any —
+        how a block adopts the trace of the request that fed it."""
+        e = self._entries.get(self.tx_key(tx))
+        return e.ctx if e is not None else None
+
+    # --- metrics plumbing ---------------------------------------------------
+    def _refresh_gauges(self) -> None:
+        from celestia_app_tpu.trace.metrics import registry
+
+        reg = registry()
+        reg.gauge("celestia_mempool_txs", "resident mempool txs").set(
+            len(self._entries)
+        )
+        reg.gauge(
+            "celestia_mempool_size_bytes", "resident mempool bytes"
+        ).set(self._bytes)
+
+    @staticmethod
+    def _tick_eviction(reason: str, n: int = 1) -> None:
+        from celestia_app_tpu.trace.metrics import registry
+
+        registry().counter(
+            "celestia_mempool_evictions_total",
+            "mempool removals that were not block inclusion",
+        ).inc(n, reason=reason)
+
+    # --- mutation -----------------------------------------------------------
+    def insert(self, tx: bytes, priority: int, height: int, ctx=None) -> bool:
         """Admit a checked tx; False if duplicate, oversized, or the pool is
-        full of higher-priority txs."""
+        full of higher-priority txs.  `ctx` is the submitting request's
+        TraceContext (defaults to the thread's current one)."""
+        from celestia_app_tpu.trace.context import current_context, trace_span
+
+        if ctx is None:
+            ctx = current_context()
+        with trace_span(
+            "mempool_insert", ctx=ctx, layer="mempool",
+            tx_bytes=len(tx), height=height,
+        ) as sp:
+            ok = self._insert(tx, priority, height, ctx)
+            sp["result"] = "inserted" if ok else "rejected"
+        self._refresh_gauges()
+        return ok
+
+    def _insert(self, tx: bytes, priority: int, height: int, ctx) -> bool:
         if len(tx) > self.max_tx_bytes:
             return False
         key = self.tx_key(tx)
@@ -70,7 +126,10 @@ class PriorityMempool:
             if victim.priority >= priority:
                 return False  # everything resident outranks the newcomer
             self._remove(victim_key)
-        self._entries[key] = _Entry(tx, priority, height, self._seq)
+            self._tick_eviction("priority")
+        self._entries[key] = _Entry(
+            tx, priority, height, self._seq, ctx, time.perf_counter()
+        )
         self._seq += 1
         self._bytes += len(tx)
         return True
@@ -81,28 +140,91 @@ class PriorityMempool:
             self._bytes -= len(e.tx)
 
     def reap(self, max_bytes: int | None = None) -> list[bytes]:
-        """Txs by (priority desc, FIFO) under a byte budget."""
+        """Txs by (priority desc, FIFO) under a byte budget.
+
+        Journaled: one `mempool_reap` span per call (count/bytes/skips,
+        joined to the first reaped tx's trace), plus one `mempool_wait`
+        e2e observation per reaped tx (insert -> reap residency).
+        """
+        from celestia_app_tpu.trace.context import export_span, new_context
+        from celestia_app_tpu.trace.spans import observe_e2e
+        from celestia_app_tpu.trace.tracer import trace_enabled
+
+        start_unix_ns = time.time_ns()
+        t0 = time.perf_counter_ns()
         ordered = sorted(
             self._entries.values(), key=lambda e: (-e.priority, e.seq)
         )
         out: list[bytes] = []
-        total = 0
+        reaped_entries: list[_Entry] = []
+        total = skipped = 0
         for e in ordered:
             if max_bytes is not None and total + len(e.tx) > max_bytes:
+                skipped += 1
                 continue
             out.append(e.tx)
+            reaped_entries.append(e)
             total += len(e.tx)
+        elapsed_ns = time.perf_counter_ns() - t0
+        if trace_enabled():
+            # The span joins the trace of the first REAPED tx — the same
+            # trace the block built from this reap adopts
+            # (_block_trace_context), so the reap leg is never orphaned
+            # onto a budget-skipped tx's trace.
+            first_ctx = next(
+                (e.ctx for e in reaped_entries if e.ctx is not None), None
+            )
+            ctx = first_ctx.child() if first_ctx is not None else new_context()
+            export_span(
+                "mempool_reap", ctx, start_unix_ns, elapsed_ns,
+                {"layer": "mempool", "n_txs": len(out), "reap_bytes": total,
+                 "skipped": skipped, "resident": len(ordered)},
+                e2e="reap",
+            )
+        now = time.perf_counter()
+        for e in reaped_entries:
+            # First reap only: a tx the proposer reaps but drops (filter
+            # rejection, square overflow) is reaped again every block
+            # until TTL, and re-observing its growing residency would let
+            # duplicates dominate the histogram's tail.
+            if e.t_ins and not e.reaped:
+                observe_e2e("mempool_wait", now - e.t_ins)
+            e.reaped = True
         return out
 
     def update(self, height: int, committed_txs: list[bytes]) -> None:
-        """Post-commit maintenance: drop included txs, expire TTLs."""
+        """Post-commit maintenance: drop included txs, expire TTLs.
+
+        Journaled (`mempool_update` row): committed drops and TTL expiries
+        were previously silent.  Each committed tx with a known submission
+        context closes its lifecycle on the e2e `total` phase
+        (submit wall-clock -> this commit)."""
+        from celestia_app_tpu.trace.spans import observe_e2e
+        from celestia_app_tpu.trace.tracer import traced
+
+        now_ns = time.time_ns()
+        committed = 0
         for tx in committed_txs:
-            self._remove(self.tx_key(tx))
+            key = self.tx_key(tx)
+            e = self._entries.get(key)
+            if e is None:
+                continue
+            committed += 1
+            if e.ctx is not None and getattr(e.ctx, "start_unix_ns", 0):
+                observe_e2e("total", (now_ns - e.ctx.start_unix_ns) / 1e9)
+            self._remove(key)
         expired = [
             k for k, e in self._entries.items() if height - e.height >= self.ttl
         ]
         for k in expired:
             self._remove(k)
+        if expired:
+            self._tick_eviction("ttl", len(expired))
+        traced().write(
+            "mempool_update", height=height, committed=committed,
+            expired=len(expired), resident=len(self._entries),
+        )
+        self._refresh_gauges()
 
     def resident_txs(self) -> list[bytes]:
         """All resident txs in (priority desc, FIFO) order — the order a
@@ -114,4 +236,10 @@ class PriorityMempool:
         ]
 
     def remove_tx(self, tx: bytes) -> None:
-        self._remove(self.tx_key(tx))
+        """Evict one tx (the post-commit recheck path): counted like every
+        other non-commit removal so the gauges reconcile."""
+        key = self.tx_key(tx)
+        if key in self._entries:
+            self._remove(key)
+            self._tick_eviction("recheck")
+            self._refresh_gauges()

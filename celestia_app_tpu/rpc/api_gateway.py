@@ -285,9 +285,13 @@ def _routes(node):
             tx_bytes = base64.b64decode(body["tx_bytes"])
         except (KeyError, TypeError, ValueError) as e:
             raise _BadRequest(f"invalid tx_bytes: {e}") from e
+        from celestia_app_tpu.trace.context import new_context, use_context
         from celestia_app_tpu.tx import tx_hash
 
-        res = node.broadcast(tx_bytes)
+        # Request entry: issue the trace the tx carries through the
+        # mempool and into the block that commits it (trace/context.py).
+        with use_context(new_context(layer="rpc", plane="rest")):
+            res = node.broadcast(tx_bytes)
         return {
             "tx_response": {
                 "txhash": tx_hash(tx_bytes).hex().upper(),

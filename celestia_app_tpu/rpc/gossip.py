@@ -37,6 +37,7 @@ from celestia_app_tpu.consensus.machine import (
     Locked,
     Proposal,
     RequestProposal,
+    RoundJournal,
     RoundMachine,
     ScheduleTimeout,
 )
@@ -161,6 +162,14 @@ class ConsensusDriver:
             sign_guard=sign_guard,
             locked_value=locked_value,
             locked_round=locked_round,
+            # One round_journal row per (height, round), fsync time from
+            # the WAL's cumulative counter (trace/ pulls the table).
+            journal=RoundJournal(
+                fsync_ms_source=(
+                    (lambda: self.wal.fsync_ms_total)
+                    if self.wal is not None else None
+                ),
+            ),
         )
         self.valsets[height] = validators
         for h in [h for h in self.valsets if h < height - 128]:
@@ -246,13 +255,23 @@ class ConsensusDriver:
             bid = req.block_hash
         else:
             from celestia_app_tpu.testutil.testnode import BLOCK_INTERVAL_NS
+            from celestia_app_tpu.trace.context import trace_span, use_context
 
             time_ns = node.app.last_block_time_ns + BLOCK_INTERVAL_NS
-            data = node.app.prepare_proposal(
-                node.mempool.reap(node.block_max_bytes())
-            )
-            if not node.app.process_proposal(data):
-                raise AssertionError("node rejected its own proposal")
+            reaped = node.mempool.reap(node.block_max_bytes())
+            # The block adopts the first reaped tx's submission trace so
+            # one trace_id spans submit -> ... -> DAH -> commit; the round
+            # journal rows for this height carry it too.
+            block_ctx = node._block_trace_context(reaped, height)
+            if self.machine.journal is not None:
+                self.machine.journal.trace_id = block_ctx.trace_id
+            with use_context(block_ctx), trace_span(
+                "block_propose", layer="consensus", e2e="propose",
+                height=height, round=req.round, n_txs=len(reaped),
+            ):
+                data = node.app.prepare_proposal(reaped)
+                if not node.app.process_proposal(data):
+                    raise AssertionError("node rejected its own proposal")
             prev_commit = node._commits.get(height - 1)
             evidence = [
                 eq for eq in self.evidence_pool
@@ -342,9 +361,19 @@ class ConsensusDriver:
     # --- ingress -----------------------------------------------------------
     def handle(self, msg: dict) -> dict:
         """rpc_consensus: dedup, authenticate, relay, process."""
+        from celestia_app_tpu.trace.metrics import registry
+
+        kind = str(msg.get("kind", "unknown"))
+        registry().counter(
+            "celestia_gossip_msgs_total", "consensus gossip messages"
+        ).inc(kind=kind, direction="in")
         msg_id = self._msg_id(msg)
         with self.node.lock:
             if msg_id in self.seen:
+                registry().counter(
+                    "celestia_gossip_dedup_hits_total",
+                    "gossip messages dropped as already-seen (flood termination)",
+                ).inc(kind=kind)
                 return {"ok": True, "dup": True}
             self.seen[msg_id] = int(msg.get("height", 0) or 0)
             if len(self.seen) > 100_000:
@@ -610,6 +639,11 @@ class ConsensusDriver:
         if not msgs:
             return
         peers = self.node.peers()
+        from celestia_app_tpu.trace.metrics import registry
+
+        registry().gauge(
+            "celestia_gossip_peers", "configured gossip peer count"
+        ).set(len(peers))
         if self.latency_s or self.jitter_s:
             # Per-peer fan-out so injected latency costs one delay, not
             # one per link (a real network delays links in parallel).
@@ -622,7 +656,13 @@ class ConsensusDriver:
     def _send_to(self, peer, msgs: list) -> None:
         import time as _time
 
+        from celestia_app_tpu.trace.metrics import registry
+
+        sent = registry().counter(
+            "celestia_gossip_msgs_total", "consensus gossip messages"
+        )
         for msg in msgs:
+            sent.inc(kind=str(msg.get("kind", "unknown")), direction="out")
             if self.latency_s or self.jitter_s:
                 jitter = 0.0
                 if self.jitter_s:

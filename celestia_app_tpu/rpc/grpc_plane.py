@@ -233,8 +233,14 @@ def _handlers(node) -> dict:
         # BroadcastTxRequest {tx_bytes=1, mode=2}; mode BROADCAST_MODE_SYNC
         # semantics: CheckTx result, inclusion async (the only mode the
         # reference chain's clients rely on; pkg/user polls GetTx after).
+        from celestia_app_tpu.trace.context import new_context, use_context
+
         tx_bytes = _field_bytes(req, 1)
-        res = node.broadcast(tx_bytes)
+        # Request entry: the trace the tx carries to the block that
+        # commits it (trace/context.py; resolvable via /trace_tables/spans
+        # on the debug sidecar).
+        with use_context(new_context(layer="rpc", plane="grpc")):
+            res = node.broadcast(tx_bytes)
         import hashlib
 
         txhash = hashlib.sha256(tx_bytes).hexdigest().upper()

@@ -24,6 +24,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from celestia_app_tpu.app import BlockData
+from celestia_app_tpu.trace.context import trace_span, use_context
 from celestia_app_tpu.tx import tx_hash
 from celestia_app_tpu.rpc.codec import to_jsonable
 from celestia_app_tpu.testutil.testnode import BLOCK_INTERVAL_NS, TestNode
@@ -114,7 +115,7 @@ class ServingNode(TestNode):
         return (height - 1) % self.n_validators == self.validator_index
 
     # --- tx admission + gossip ----------------------------------------------
-    def broadcast(self, raw_tx: bytes, relay: bool = True):
+    def broadcast(self, raw_tx: bytes, relay: bool = True, ctx=None):
         """Mempool gossip: multi-hop flood with mempool-insert dedup.
 
         A tx relays onward only when it was NEWLY admitted here, so the
@@ -122,10 +123,12 @@ class ServingNode(TestNode):
         crosses partial topologies hop by hop — a tx submitted anywhere
         reaches the proposer without the submitter knowing who that is
         (reference: mempool v1 gossip, app/default_overrides.go:258-284).
+        `ctx` is the request's TraceContext (threaded into the mempool
+        entry; see trace/context.py).
         """
         with self.lock:
             known = self.mempool.has_tx(raw_tx)
-            res = super().broadcast(raw_tx)
+            res = super().broadcast(raw_tx, ctx=ctx)
             inserted = not known and res.code == 0 and self.mempool.has_tx(raw_tx)
         if inserted and relay:
             def _relay():
@@ -353,9 +356,18 @@ class ServingNode(TestNode):
                 else None
             )
             evidence = tuple(self._pending_evidence())
-            data = self.app.prepare_proposal(self.mempool.reap(self.block_max_bytes()))
-            if not self.app.process_proposal(data):
-                raise AssertionError("node rejected its own proposal")
+            reaped = self.mempool.reap(self.block_max_bytes())
+            # One trace from the submitting request down to the DAH root:
+            # the block adopts the first reaped tx's trace (threaded
+            # explicitly through the mempool entry, trace/context.py).
+            block_ctx = self._block_trace_context(reaped, height)
+            with use_context(block_ctx), trace_span(
+                "block_propose", layer="consensus", e2e="propose",
+                height=height, n_txs=len(reaped),
+            ):
+                data = self.app.prepare_proposal(reaped)
+                if not self.app.process_proposal(data):
+                    raise AssertionError("node rejected its own proposal")
             # Votes commit to block_id(data root, prev app hash, time): a
             # peer whose state diverged computes a DIFFERENT id, so its
             # prevote misses this vote set and divergence blocks quorum
@@ -373,14 +385,19 @@ class ServingNode(TestNode):
                 pass
         # Unreachable or refusing peers are tolerated — BFT advances as
         # long as +2/3 answers; they catch up from the block store later.
-        for peer in peers:
-            try:
-                reply = peer.propose(height, time_ns, data)
-                vote = Vote.unmarshal(bytes.fromhex(reply["prevote"]))
-                self._witness_vote(vote, validators)
-                prevotes.add(vote)
-            except Exception:
-                continue
+        with use_context(block_ctx), trace_span(
+            "block_prevotes", layer="consensus", e2e="prevote", height=height,
+        ) as sp:
+            for peer in peers:
+                try:
+                    reply = peer.propose(height, time_ns, data)
+                    vote = Vote.unmarshal(bytes.fromhex(reply["prevote"]))
+                    self._witness_vote(vote, validators)
+                    prevotes.add(vote)
+                except Exception:
+                    continue
+            sp["power"] = prevotes.signed_power()
+            sp["total_power"] = prevotes.total_power()
         # Quorum is enforced when replicating to peers; a solo dev node
         # (one process, however many genesis validators) commits alone.
         if peers and not prevotes.has_two_thirds():
@@ -392,18 +409,24 @@ class ServingNode(TestNode):
 
         # Phase 2: precommits — still no state committed anywhere.
         precommits = VoteSet(self.chain_id, height, PRECOMMIT, bid, validators)
-        try:
-            precommits.add(self._sign_vote(height, PRECOMMIT, bid))
-        except ConsensusError:
-            pass
-        for peer in peers:
+        with use_context(block_ctx), trace_span(
+            "block_precommits", layer="consensus", e2e="precommit",
+            height=height,
+        ) as sp:
             try:
-                reply = peer.precommit(height, bid, prevotes_wire)
-                vote = Vote.unmarshal(bytes.fromhex(reply["precommit"]))
-                self._witness_vote(vote, validators)
-                precommits.add(vote)
-            except Exception:
-                continue
+                precommits.add(self._sign_vote(height, PRECOMMIT, bid))
+            except ConsensusError:
+                pass
+            for peer in peers:
+                try:
+                    reply = peer.precommit(height, bid, prevotes_wire)
+                    vote = Vote.unmarshal(bytes.fromhex(reply["precommit"]))
+                    self._witness_vote(vote, validators)
+                    precommits.add(vote)
+                except Exception:
+                    continue
+            sp["power"] = precommits.signed_power()
+            sp["total_power"] = precommits.total_power()
         if peers and not precommits.has_two_thirds():
             raise ConsensusError(
                 f"no +2/3 precommits at height {height}: "
@@ -418,7 +441,9 @@ class ServingNode(TestNode):
         # Commit record so every node serves it.
         signers_wire = sorted(last_signers) if last_signers is not None else None
         evidence_wire = self._evidence_to_wire(evidence)
-        with self.lock:
+        with self.lock, use_context(block_ctx), trace_span(
+            "block_commit", layer="consensus", e2e="commit", height=height,
+        ):
             results = self._commit_block_data(
                 data, time_ns, last_commit_signers=last_signers, evidence=evidence
             )
@@ -552,6 +577,46 @@ class ServingNode(TestNode):
             else:
                 raise ValueError(f"cannot catch up: no peer serves block {h}")
 
+    # --- /healthz layer snapshot ---------------------------------------------
+    def health_snapshot(self) -> dict:
+        """Per-layer staleness for /healthz (trace/exposition.py): last
+        block height and wall-clock age, mempool depth, peer count, and
+        (when gossip consensus runs) the live round coordinates.
+
+        The probe must never hang behind block production — a cold jit
+        compile can hold the node lock for tens of seconds, which is
+        exactly when an orchestrator most needs the probe to answer — so
+        the lock is taken with a short timeout and contention itself
+        becomes the report (best-effort unlocked reads are safe: ints and
+        container sizes, no invariants)."""
+        import time
+
+        out: dict = {
+            "height": self.app.height,
+            "block_age_s": (
+                round(time.time() - self.last_commit_walltime, 3)
+                if self.last_commit_walltime is not None else None
+            ),
+            "mempool": {
+                "txs": len(self.mempool),
+                "bytes": self.mempool.size_bytes(),
+            },
+            "peers": len(self.peer_urls),
+        }
+        if not self.lock.acquire(timeout=0.25):
+            out["lock_contended"] = True
+            return out
+        try:
+            driver = getattr(self, "consensus_driver", None)
+            if driver is not None and driver.machine is not None:
+                m = driver.machine
+                out["consensus"] = {
+                    "height": m.height, "round": m.round, "step": m.step,
+                }
+        finally:
+            self.lock.release()
+        return out
+
     # --- JSON-safe RPC methods (the HTTP surface) -----------------------------
     def rpc_status(self) -> dict:
         with self.lock:
@@ -566,9 +631,19 @@ class ServingNode(TestNode):
             }
 
     def rpc_broadcast_tx(self, tx: str, relay: bool = True) -> dict:
-        res = self.broadcast(bytes.fromhex(tx), relay=relay)
+        """Tx submission — the trace root.  The issued trace_id is
+        returned to the client and follows the tx through the mempool,
+        the square build, the device dispatch, and consensus
+        (GET /trace_tables/spans filters on it)."""
+        from celestia_app_tpu.trace.context import new_context, use_context
+
+        raw = bytes.fromhex(tx)
+        ctx = new_context(layer="rpc", plane="jsonrpc")
+        with use_context(ctx):
+            res = self.broadcast(raw, relay=relay, ctx=ctx)
         return {"code": res.code, "log": res.log,
-                "hash": tx_hash(bytes.fromhex(tx)).hex()}
+                "hash": tx_hash(raw).hex(),
+                "trace_id": ctx.trace_id}
 
     def rpc_tx_status(self, hash: str) -> dict | None:
         with self.lock:
@@ -1143,6 +1218,17 @@ class NodeServer:
         self.url = f"http://{host}:{self.port}"
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
+        # One stable bound-method object: unregistration compares by
+        # identity, and attribute access mints a fresh bound method.  The
+        # name carries the port so a multi-node process (the standard
+        # multi-validator test topology) reports every node, not just the
+        # last one constructed.
+        self._health_provider = getattr(node, "health_snapshot", None)
+        self._health_name = f"node:{self.port}"
+        if self._health_provider is not None:
+            from celestia_app_tpu.trace.exposition import register_health_provider
+
+            register_health_provider(self._health_name, self._health_provider)
 
     def start(self, block_interval_s: float | None = None):
         t = threading.Thread(target=self.httpd.serve_forever, daemon=True)
@@ -1168,6 +1254,10 @@ class NodeServer:
 
     def stop(self):
         self._stop.set()
+        if self._health_provider is not None:
+            from celestia_app_tpu.trace.exposition import unregister_health_provider
+
+            unregister_health_provider(self._health_name, self._health_provider)
         driver = getattr(self.node, "consensus_driver", None)
         if driver is not None:
             driver.stop()

@@ -174,6 +174,7 @@ class Builder:
         self.subtree_root_threshold = subtree_root_threshold
         self._txs: list[bytes] = []
         self._blob_txs: list[BlobTx] = []
+        self._solves = 0  # layout fixpoint runs (fit checks + exports)
 
     # --- append (greedy fit checks) ---------------------------------------
     def append_tx(self, tx: bytes) -> bool:
@@ -199,6 +200,7 @@ class Builder:
 
     # --- layout -----------------------------------------------------------
     def _solve(self) -> _Layout:
+        self._solves += 1
         tx_shares = compact_shares_needed(tx_sequence_len(self._txs))
 
         # All blobs in placement order: sorted by namespace, stable in
@@ -314,17 +316,30 @@ def build(
     dropping the rest.  Returns (square, kept_txs) where kept_txs are the
     original bytes in block order (normal txs, then BlobTxs).
     """
-    builder = Builder(max_square_size, subtree_root_threshold)
-    kept_normal: list[bytes] = []
-    kept_blob: list[bytes] = []
-    for raw, btx in _classify(raw_txs):
-        if btx is None:
-            if builder.append_tx(raw):
-                kept_normal.append(raw)
-        else:
-            if builder.append_blob_tx(btx):
-                kept_blob.append(raw)
-    return builder.export(), kept_normal + kept_blob
+    from celestia_app_tpu.trace.context import trace_span
+
+    with trace_span(
+        "square_build", layer="square", e2e="square_build",
+        n_candidates=len(raw_txs),
+    ) as sp:
+        builder = Builder(max_square_size, subtree_root_threshold)
+        kept_normal: list[bytes] = []
+        kept_blob: list[bytes] = []
+        for raw, btx in _classify(raw_txs):
+            if btx is None:
+                if builder.append_tx(raw):
+                    kept_normal.append(raw)
+            else:
+                if builder.append_blob_tx(btx):
+                    kept_blob.append(raw)
+        sq = builder.export()
+        sp["n_txs"] = len(kept_normal)
+        sp["n_blob_txs"] = len(kept_blob)
+        sp["n_blobs"] = len(sq.placements)
+        sp["dropped"] = len(raw_txs) - len(kept_normal) - len(kept_blob)
+        sp["layout_solves"] = builder._solves
+        sp["k"] = sq.size
+    return sq, kept_normal + kept_blob
 
 
 def construct(
@@ -336,9 +351,18 @@ def construct(
 
     Every tx must fit; raises SquareOverflow otherwise.
     """
-    builder = Builder(max_square_size, subtree_root_threshold)
-    for raw, btx in _classify(raw_txs):
-        ok = builder.append_tx(raw) if btx is None else builder.append_blob_tx(btx)
-        if not ok:
-            raise SquareOverflow("proposal txs overflow the maximum square size")
-    return builder.export()
+    from celestia_app_tpu.trace.context import trace_span
+
+    with trace_span(
+        "square_construct", layer="square", n_candidates=len(raw_txs),
+    ) as sp:
+        builder = Builder(max_square_size, subtree_root_threshold)
+        for raw, btx in _classify(raw_txs):
+            ok = builder.append_tx(raw) if btx is None else builder.append_blob_tx(btx)
+            if not ok:
+                raise SquareOverflow("proposal txs overflow the maximum square size")
+        sq = builder.export()
+        sp["n_blobs"] = len(sq.placements)
+        sp["layout_solves"] = builder._solves
+        sp["k"] = sq.size
+    return sq

@@ -82,6 +82,8 @@ class TestNode:
         self.mempool = PriorityMempool()
         self.blocks: list[BlockData] = []
         self.block_times: dict[int, int] = {}  # height -> block time
+        # Wall clock of the last commit (the /healthz block-age input).
+        self.last_commit_walltime: float | None = None
         # tx hash -> (height, code, log): the RPC `tx` query's index.
         self.tx_index: dict[bytes, tuple[int, int, str]] = {}
         # Event bus: commit-time notification for tx/block subscribers —
@@ -94,14 +96,50 @@ class TestNode:
     def chain_id(self) -> str:
         return self.app.chain_id
 
-    def broadcast(self, raw_tx: bytes) -> TxResult:
-        res = self.app.check_tx(raw_tx)
-        if res.code == 0:
-            priority = next(
-                (e[1] for e in res.events if e[0] == "priority"), 0
-            )
-            self.mempool.insert(raw_tx, priority, self.app.height)
+    def broadcast(self, raw_tx: bytes, ctx=None) -> TxResult:
+        """CheckTx + mempool admission under a request trace: `ctx` (or
+        the thread's current context, or a fresh local root) follows the
+        tx into the mempool entry, so the block that later reaps it — and
+        everything below, down to the DAH dispatch — shares its trace_id.
+        """
+        from celestia_app_tpu.trace.context import (
+            current_context,
+            trace_span,
+            use_context,
+        )
+
+        if ctx is None:
+            ctx = current_context()
+        if ctx is None:
+            from celestia_app_tpu.trace.context import new_context
+
+            ctx = new_context(layer="rpc", source="local")
+        with use_context(ctx), trace_span(
+            "tx_submit", layer="rpc", e2e="submit", tx_bytes=len(raw_tx),
+        ) as sp:
+            res = self.app.check_tx(raw_tx)
+            sp["result"] = str(res.code)
+            if res.code == 0:
+                priority = next(
+                    (e[1] for e in res.events if e[0] == "priority"), 0
+                )
+                self.mempool.insert(
+                    raw_tx, priority, self.app.height, ctx=current_context()
+                )
         return res
+
+    def _block_trace_context(self, reaped: list[bytes], height: int):
+        """The block's TraceContext: adopt the FIRST reaped tx's
+        submission trace (reap order is deterministic, so every proposer
+        picks the same one) so a single trace_id runs from BroadcastTx to
+        the DAH root; an empty block roots a fresh trace."""
+        from celestia_app_tpu.trace.context import new_context
+
+        for raw in reaped:
+            ctx = self.mempool.ctx_for(raw)
+            if ctx is not None:
+                return ctx.child(height=height)
+        return new_context(layer="block", height=height)
 
     def produce_block(
         self,
@@ -117,14 +155,28 @@ class TestNode:
         it).  `last_commit_signers`/`evidence` feed x/slashing liveness and
         x/evidence (ABCI LastCommitInfo / ByzantineValidators).
         """
+        from celestia_app_tpu.trace.context import trace_span, use_context
+
         if time_ns is None:
             time_ns = self.app.last_block_time_ns + BLOCK_INTERVAL_NS
-        data = self.app.prepare_proposal(self.mempool.reap(self.block_max_bytes()))
-        if not self.app.process_proposal(data):
-            raise AssertionError("node rejected its own proposal")
-        results = self._commit_block_data(
-            data, time_ns, last_commit_signers=last_commit_signers, evidence=evidence
-        )
+        reaped = self.mempool.reap(self.block_max_bytes())
+        block_ctx = self._block_trace_context(reaped, self.app.height + 1)
+        with use_context(block_ctx):
+            with trace_span(
+                "block_propose", layer="consensus", e2e="propose",
+                height=self.app.height + 1, n_txs=len(reaped),
+            ):
+                data = self.app.prepare_proposal(reaped)
+                if not self.app.process_proposal(data):
+                    raise AssertionError("node rejected its own proposal")
+            with trace_span(
+                "block_commit", layer="consensus", e2e="commit",
+                height=self.app.height + 1,
+            ):
+                results = self._commit_block_data(
+                    data, time_ns,
+                    last_commit_signers=last_commit_signers, evidence=evidence,
+                )
         return data, results
 
     def block_max_bytes(self) -> int:
@@ -169,8 +221,11 @@ class TestNode:
     def index_block(self, height: int, txs: list[bytes], results: list[TxResult]) -> None:
         from celestia_app_tpu.tx import tx_hash
 
+        import time
+
         for raw, res in zip(txs, results):
             self.tx_index[tx_hash(raw)] = (height, res.code, res.log)
+        self.last_commit_walltime = time.time()
         with self.commit_event:
             self.commit_event.notify_all()
 

@@ -1,3 +1,19 @@
+from celestia_app_tpu.trace.context import (
+    TraceContext,
+    current_context,
+    new_context,
+    trace_span,
+    use_context,
+)
 from celestia_app_tpu.trace.tracer import Tracer, trace_enabled, traced
 
-__all__ = ["Tracer", "trace_enabled", "traced"]
+__all__ = [
+    "TraceContext",
+    "Tracer",
+    "current_context",
+    "new_context",
+    "trace_enabled",
+    "trace_span",
+    "traced",
+    "use_context",
+]

@@ -1,0 +1,173 @@
+"""Request/block-scoped trace context: one trace_id from RPC submission
+to DAH root.
+
+PR 2 made the device pipeline legible; this layer makes everything above
+it attributable: a `TraceContext` is issued at request entry (the three
+serving planes' BroadcastTx handlers, or locally by `TestNode.broadcast`)
+and threaded EXPLICITLY through the layers — mempool entries store the
+submitting request's context, the block built from a reap adopts the
+first reaped tx's trace_id, and every span below (square build, device
+dispatch, consensus round, commit) joins that trace.  The contextvar here
+is an in-thread convenience so deep call stacks (square.build inside
+App.prepare_proposal) pick up the active context without threading a
+parameter through every signature; across threads the context object
+itself is passed (mempool entry -> proposer thread), never the
+thread-local.
+
+`trace_span` is the measurement primitive: it opens a child context,
+makes it current for the body, and on exit exports the span THREE ways —
+
+  * a row in the per-name event table (same shape tracer.span wrote, plus
+    trace_id/span_id/parent_span_id columns), keeping the existing
+    `celestia_<name>_seconds` histogram families alive;
+  * an OTLP-shaped row in the `spans` table (trace/spans.py), pulled via
+    GET /trace_tables/spans or mirrored to $CELESTIA_SPANS_OUT JSONL —
+    the whole-block lifecycle tree reconstructs from this one table;
+  * optionally one observation on the end-to-end phase histogram
+    `celestia_e2e_seconds{phase=...}` (the `e2e=` argument).
+
+$CELESTIA_TRACE=off mutes every export; context PROPAGATION still runs so
+explicit threading (mempool-entry contexts, block adoption) never breaks
+when tracing is muted.  No device syncs anywhere: spans time host calls
+the layers already make.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from contextlib import contextmanager
+from contextvars import ContextVar
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """Identity + baggage of one request or block trace.
+
+    `trace_id` is stable for the whole tree; each span gets its own
+    `span_id` with `parent_id` linking it to its creator.  `baggage`
+    carries low-volume attribution (height, round, k, source) copied onto
+    every descendant span's attributes.  `start_unix_ns` is the wall
+    clock at trace issue — the anchor the e2e `total` phase measures
+    from.
+    """
+
+    trace_id: str
+    span_id: str
+    parent_id: str | None = None
+    baggage: dict = field(default_factory=dict)
+    start_unix_ns: int = 0
+
+    def child(self, **baggage) -> "TraceContext":
+        """A child context: same trace, fresh span id, merged baggage."""
+        return TraceContext(
+            trace_id=self.trace_id,
+            span_id=_new_span_id(),
+            parent_id=self.span_id,
+            baggage={**self.baggage, **baggage},
+            start_unix_ns=self.start_unix_ns,
+        )
+
+
+def _new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+def new_context(**baggage) -> TraceContext:
+    """Issue a fresh root context (a new trace_id) — request entry."""
+    return TraceContext(
+        trace_id=os.urandom(16).hex(),
+        span_id=_new_span_id(),
+        baggage=baggage,
+        start_unix_ns=time.time_ns(),
+    )
+
+
+_CURRENT: ContextVar[TraceContext | None] = ContextVar(
+    "celestia_trace_context", default=None
+)
+
+
+def current_context() -> TraceContext | None:
+    """The context active on THIS thread/task, or None outside a trace."""
+    return _CURRENT.get()
+
+
+@contextmanager
+def use_context(ctx: TraceContext | None):
+    """Make `ctx` current for the body — the explicit hand-off point when
+    a context crosses a thread boundary (block production adopting a
+    mempool entry's context)."""
+    token = _CURRENT.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _CURRENT.reset(token)
+
+
+@contextmanager
+def trace_span(
+    name: str,
+    ctx: TraceContext | None = None,
+    e2e: str | None = None,
+    buckets: tuple[float, ...] | None = None,
+    **attrs,
+):
+    """Measure one span of trace `ctx` (explicit, else the current one,
+    else a fresh root).  Yields a mutable attr dict so results discovered
+    inside the body (square size, vote power) land on the span.  `e2e`
+    names the celestia_e2e_seconds phase this span feeds, if any.
+    """
+    from celestia_app_tpu.trace.tracer import trace_enabled
+
+    parent = ctx if ctx is not None else current_context()
+    child = parent.child() if parent is not None else new_context()
+    token = _CURRENT.set(child)
+    if not trace_enabled():
+        try:
+            yield dict(attrs)
+        finally:
+            _CURRENT.reset(token)
+        return
+    mutable = dict(attrs)
+    start_unix_ns = time.time_ns()
+    t0 = time.perf_counter_ns()
+    try:
+        yield mutable
+    finally:
+        elapsed_ns = time.perf_counter_ns() - t0
+        _CURRENT.reset(token)
+        export_span(name, child, start_unix_ns, elapsed_ns, mutable,
+                    buckets=buckets, e2e=e2e)
+
+
+def export_span(name, ctx, start_unix_ns, elapsed_ns, attrs,
+                buckets=None, e2e=None) -> None:
+    """The span's three exports (event table + histogram + OTLP row) plus
+    the optional e2e phase — all off the timed region.  Public for call
+    sites that must pick the span's context AFTER the measured work (the
+    mempool reap learns which trace it belongs to by doing the reap)."""
+    from celestia_app_tpu.trace import spans
+    from celestia_app_tpu.trace.metrics import registry
+    from celestia_app_tpu.trace.tracer import SPAN_LABEL_ATTRS, traced
+
+    traced().write(
+        name,
+        duration_ms=elapsed_ns / 1e6,
+        trace_id=ctx.trace_id,
+        span_id=ctx.span_id,
+        parent_span_id=ctx.parent_id,
+        **attrs,
+    )
+    labels = {a: str(attrs[a]) for a in SPAN_LABEL_ATTRS if a in attrs}
+    registry().histogram(
+        f"celestia_{name}_seconds", f"wall time of {name}",
+        **({"buckets": buckets} if buckets else {}),
+    ).observe(elapsed_ns / 1e9, **labels)
+    spans.record_span(
+        name, ctx, start_unix_ns, start_unix_ns + elapsed_ns,
+        {**ctx.baggage, **attrs},
+    )
+    if e2e is not None:
+        spans.observe_e2e(e2e, elapsed_ns / 1e9)

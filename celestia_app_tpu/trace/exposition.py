@@ -11,14 +11,55 @@ hits:
     GET /metrics                 Prometheus text exposition (version 0.0.4)
     GET /trace_tables            {"tables": {name: row_count}}
     GET /trace_tables/<name>     the table as JSONL (application/x-ndjson)
-    GET /healthz                 {"status": "SERVING"} liveness probe
+    GET /healthz                 liveness + per-layer staleness
+
+/healthz is the SLO face: beyond {"status": "SERVING"}, any registered
+health providers (a ServingNode registers its own snapshot: last block
+height and age, mempool depth, peer count, consensus round state) report
+under "layers" — the first place to look when blocks stop, before
+touching the trace tables.  A provider that throws reports its error
+instead of taking the probe down.
 """
 
 from __future__ import annotations
 
 import json
+import threading
 
 METRICS_CONTENT_TYPE = "text/plain; version=0.0.4"
+
+_HEALTH_LOCK = threading.Lock()
+_HEALTH_PROVIDERS: dict[str, object] = {}
+
+
+def register_health_provider(name: str, provider) -> None:
+    """Mount `provider()` (-> JSON-safe dict) under /healthz "layers".
+    Last registration per name wins (one live node per name)."""
+    with _HEALTH_LOCK:
+        _HEALTH_PROVIDERS[name] = provider
+
+
+def unregister_health_provider(name: str, provider=None) -> None:
+    """Remove a provider; with `provider` given, only if it is still the
+    registered one (a stopped node must not unhook its replacement)."""
+    with _HEALTH_LOCK:
+        if provider is None or _HEALTH_PROVIDERS.get(name) is provider:
+            _HEALTH_PROVIDERS.pop(name, None)
+
+
+def health_payload() -> dict:
+    with _HEALTH_LOCK:
+        providers = dict(_HEALTH_PROVIDERS)
+    payload: dict = {"status": "SERVING"}
+    if providers:
+        layers = {}
+        for name, provider in sorted(providers.items()):
+            try:
+                layers[name] = provider()
+            except Exception as e:  # noqa: BLE001 — probe must stay up
+                layers[name] = {"error": f"{type(e).__name__}: {e}"}
+        payload["layers"] = layers
+    return payload
 
 
 def metrics_payload() -> bytes:
@@ -42,7 +83,7 @@ def handle_observability_get(path: str):
     if p == "/metrics":
         return 200, METRICS_CONTENT_TYPE, metrics_payload()
     if p == "/healthz":
-        return 200, "application/json", json.dumps({"status": "SERVING"}).encode()
+        return 200, "application/json", json.dumps(health_payload()).encode()
     if p == "/trace_tables":
         return 200, "application/json", json.dumps(
             {"tables": traced().row_counts()}

@@ -63,6 +63,14 @@ def record(source: str, k: int, *, mode: str | None = None,
     tracer = traced()
     if not tracer._on():
         return
+    # A dispatch running under a request/block trace stamps its row with
+    # the trace_id, tying the device journal to the RPC-to-DAH span tree.
+    if "trace_id" not in fields:
+        from celestia_app_tpu.trace.context import current_context
+
+        ctx = current_context()
+        if ctx is not None:
+            fields["trace_id"] = ctx.trace_id
     tracer.write(TABLE, source=source, k=k, mode=mode, compile=compile,
                  **fields)
     reg = registry()

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Metric-name lint: registry names must be well-formed AND documented.
+
+Walks every registry().counter/gauge/histogram registration in
+`celestia_app_tpu/` (AST, no imports — runs in any image) and checks:
+
+  1. the name matches `celestia_[a-z0-9_]+` (static names exactly;
+     f-string names on their static prefix), so the exposition namespace
+     stays uniform; and
+  2. the name appears in the README "Metrics" table (dynamic families may
+     be documented with a `<placeholder>` segment, e.g.
+     `celestia_block_<stage>_seconds`, matched by prefix), so docs and
+     exposition goldens cannot drift apart.
+
+Run standalone (exit 1 on problems) or via tests/test_trace_lint.py,
+which puts the check in tier-1.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE_DIR = os.path.join(REPO_ROOT, "celestia_app_tpu")
+README = os.path.join(REPO_ROOT, "README.md")
+
+METRIC_NAME_RE = re.compile(r"^celestia_[a-z0-9_]+$")
+METRIC_PREFIX_RE = re.compile(r"^celestia_[a-z0-9_]*$")
+README_TOKEN_RE = re.compile(r"celestia_[a-z0-9_<>]+")
+REGISTRY_METHODS = {"counter", "gauge", "histogram"}
+
+
+def collect_registrations(package_dir: str = PACKAGE_DIR):
+    """[(file, lineno, kind, name)] where kind is "static" (a literal
+    name) or "dynamic" (an f-string; `name` is its static prefix)."""
+    out = []
+    for dirpath, dirnames, filenames in os.walk(package_dir):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+            rel = os.path.relpath(path, REPO_ROOT)
+            for node in ast.walk(tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in REGISTRY_METHODS
+                    and node.args
+                ):
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                    out.append((rel, node.lineno, "static", arg.value))
+                elif isinstance(arg, ast.JoinedStr):
+                    prefix = ""
+                    for part in arg.values:
+                        if isinstance(part, ast.Constant):
+                            prefix += str(part.value)
+                        else:
+                            break
+                    out.append((rel, node.lineno, "dynamic", prefix))
+    return out
+
+
+def readme_metric_tokens(readme_path: str = README) -> set[str]:
+    with open(readme_path, encoding="utf-8") as f:
+        return set(README_TOKEN_RE.findall(f.read()))
+
+
+def lint(package_dir: str = PACKAGE_DIR, readme_path: str = README) -> list[str]:
+    problems = []
+    tokens = readme_metric_tokens(readme_path)
+    # A documented dynamic family like celestia_block_<stage>_seconds
+    # covers every name sharing its static prefix.
+    doc_prefixes = [t.split("<", 1)[0] for t in tokens if "<" in t]
+    for rel, lineno, kind, name in collect_registrations(package_dir):
+        where = f"{rel}:{lineno}"
+        if kind == "static":
+            if not METRIC_NAME_RE.match(name):
+                problems.append(
+                    f"{where}: metric {name!r} does not match "
+                    "celestia_[a-z0-9_]+"
+                )
+            elif name not in tokens and not any(
+                p and name.startswith(p) for p in doc_prefixes
+            ):
+                problems.append(
+                    f"{where}: metric {name!r} missing from the README "
+                    "metrics table"
+                )
+        else:
+            if not METRIC_PREFIX_RE.match(name):
+                problems.append(
+                    f"{where}: dynamic metric prefix {name!r} does not "
+                    "match celestia_[a-z0-9_]*"
+                )
+            elif not any(t.startswith(name) for t in tokens):
+                problems.append(
+                    f"{where}: dynamic metric family {name!r}* missing "
+                    "from the README metrics table"
+                )
+    return problems
+
+
+def main() -> int:
+    problems = lint()
+    regs = collect_registrations()
+    print(
+        f"trace_lint: {len(regs)} registrations "
+        f"({len({n for _, _, k, n in regs if k == 'static'})} distinct static names)"
+    )
+    for p in problems:
+        print(f"  PROBLEM {p}")
+    if problems:
+        return 1
+    print("trace_lint: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,231 @@
+"""The consensus round journal: one `round_journal` row per (height,
+round) with proposer, step deltas, power fractions, timeout fires, and
+WAL fsync time.
+
+The journal itself (trace/round_journal.py) is crypto-free and tested
+with a fake machine + fake clock; the machine-driven legs (a full
+proposal -> prevote -> precommit -> decide round, and a timeout-driven
+round bump) importorskip onto `cryptography` like every RoundMachine
+test.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from celestia_app_tpu.trace.round_journal import RoundJournal
+from celestia_app_tpu.trace.tracer import traced
+
+CHAIN = "round-journal-test"
+BLOCK = b"\xaa" * 32
+
+
+class _FakeTally:
+    def __init__(self, power, total):
+        self._power, self._total = power, total
+
+    def power_any(self):
+        return self._power
+
+    def total_power(self):
+        return self._total
+
+
+class _FakeMachine:
+    height = 7
+    round = 0
+    prevotes: dict = {}
+    precommits: dict = {}
+
+    def proposer(self, round):
+        return "val-0"
+
+    def _tally(self, table, round, vote_type):
+        return (
+            _FakeTally(300, 400) if table is self.prevotes
+            else _FakeTally(400, 400)
+        )
+
+
+class TestRoundJournalUnit:
+    def test_row_shape_deltas_fractions_and_fsync(self):
+        clock = [0.0]
+        fsync = [2.5]
+        j = RoundJournal(clock=lambda: clock[0], fsync_ms_source=lambda: fsync[0])
+        m = _FakeMachine()
+        j.open_round(m)
+        # The driver stamps the trace AFTER the round opens (gossip
+        # _propose_locked); open_round resets it per round.
+        j.trace_id = "trace-xyz"
+        clock[0] = 0.10
+        j.record_step(m, "prevote")
+        clock[0] = 0.25
+        j.record_step(m, "precommit")
+        clock[0] = 0.30
+        j.record_timeout(m, 0, "precommit")
+        clock[0] = 0.40
+        fsync[0] = 6.5
+        j.close_round(m, "decided")
+        row = traced().table(RoundJournal.TABLE)[-1]
+        assert row["height"] == 7 and row["round"] == 0
+        assert row["proposer"] == "val-0" and row["result"] == "decided"
+        assert row["trace_id"] == "trace-xyz"
+        assert row["propose_ms"] == pytest.approx(100.0)
+        assert row["prevote_ms"] == pytest.approx(150.0)
+        assert row["precommit_ms"] == pytest.approx(150.0)
+        assert row["total_ms"] == pytest.approx(400.0)
+        assert row["timeouts"] == ["precommit"]
+        assert row["prevote_power"] == pytest.approx(0.75)
+        assert row["precommit_power"] == pytest.approx(1.0)
+        assert row["wal_fsync_ms"] == pytest.approx(4.0)
+
+    def test_duplicate_steps_keep_first_and_close_is_idempotent(self):
+        clock = [0.0]
+        j = RoundJournal(clock=lambda: clock[0])
+        m = _FakeMachine()
+        j.open_round(m)
+        clock[0] = 0.1
+        j.record_step(m, "prevote")
+        clock[0] = 0.2
+        j.record_step(m, "prevote")  # re-entry: first timestamp wins
+        before = len(traced().table(RoundJournal.TABLE))
+        j.close_round(m, "round_bump")
+        j.close_round(m, "round_bump")  # no open round: no second row
+        rows = traced().table(RoundJournal.TABLE)[before:]
+        assert len(rows) == 1
+        assert rows[0]["propose_ms"] == pytest.approx(100.0)
+        assert rows[0]["precommit_ms"] is None
+
+    def test_trace_id_resets_per_round(self):
+        clock = [0.0]
+        j = RoundJournal(clock=lambda: clock[0])
+        m = _FakeMachine()
+        j.open_round(m)
+        j.trace_id = "round-0-trace"
+        j.close_round(m, "round_bump")
+        j.open_round(m)  # another validator's round: no stamp here
+        j.close_round(m, "decided")
+        rows = traced().table(RoundJournal.TABLE)[-2:]
+        assert rows[0]["trace_id"] == "round-0-trace"
+        assert rows[1]["trace_id"] is None
+
+    def test_stale_round_events_ignored(self):
+        clock = [0.0]
+        j = RoundJournal(clock=lambda: clock[0])
+        m = _FakeMachine()
+        j.open_round(m)
+        j.record_timeout(m, 3, "propose")  # a later round's timer: not ours
+        j.record_step(m, "prevote")
+        m2 = _FakeMachine()
+        m2.round = 1
+        j.record_step(m2, "precommit")  # machine moved on: ignored
+        j.close_round(m, "round_bump")
+        row = traced().table(RoundJournal.TABLE)[-1]
+        assert row["timeouts"] == []
+        assert row["precommit_ms"] is None
+
+
+def _net(n=4):
+    """N machines wired for hand-scripted delivery; the test attaches a
+    journal to the machine it watches BEFORE calling start()."""
+    from celestia_app_tpu.consensus.machine import RoundMachine
+    from celestia_app_tpu.crypto.keys import PrivateKey
+
+    keys = [PrivateKey.from_seed(f"rj-val-{i}".encode()) for i in range(n)]
+    addrs = [k.public_key().address() for k in keys]
+    validators = {a: (k.public_key(), 100) for a, k in zip(addrs, keys)}
+    machines = [
+        RoundMachine(CHAIN, 1, validators, list(addrs), my_address=a, my_key=k)
+        for a, k in zip(addrs, keys)
+    ]
+    return keys, addrs, machines
+
+
+class TestRoundJournalOnMachine:
+    def test_decide_sequence_journals_step_deltas_and_power(self):
+        """proposal -> prevote -> precommit -> decide, fake-clocked."""
+        pytest.importorskip("cryptography")
+        from celestia_app_tpu.consensus.votes import PRECOMMIT, PREVOTE, Vote
+
+        clock = [0.0]
+        journal = RoundJournal(clock=lambda: clock[0])
+        keys, addrs, machines = _net()
+        m0 = machines[0]  # round-0 proposer (order = addrs)
+        m0.journal = journal
+        m0.start()
+        clock[0] = 0.010
+        m0.on_own_proposal(BLOCK)  # propose + own prevote
+        assert m0.step == "prevote"
+        # The other validators' prevotes arrive; polka -> own precommit.
+        clock[0] = 0.030
+        for a, k in zip(addrs[1:], keys[1:]):
+            m0.on_vote(
+                Vote.sign(k, CHAIN, 1, PREVOTE, BLOCK, validator=a, round=0)
+            )
+        assert m0.step == "precommit"
+        # Their precommits arrive: +2/3 for the block -> decide.
+        clock[0] = 0.060
+        for a, k in zip(addrs[1:], keys[1:]):
+            m0.on_vote(
+                Vote.sign(k, CHAIN, 1, PRECOMMIT, BLOCK, validator=a, round=0)
+            )
+        assert m0.decided is not None
+        row = traced().table(RoundJournal.TABLE)[-1]
+        assert row["height"] == 1 and row["round"] == 0
+        assert row["proposer"] == addrs[0]
+        assert row["result"] == "decided"
+        assert row["propose_ms"] == pytest.approx(10.0)
+        assert row["prevote_ms"] == pytest.approx(20.0)
+        assert row["total_ms"] == pytest.approx(60.0)
+        assert row["timeouts"] == []
+        # All four validators prevoted and precommitted the block.
+        assert row["prevote_power"] == pytest.approx(1.0)
+        assert row["precommit_power"] == pytest.approx(1.0)
+
+    def test_timeout_driven_round_bump_journals_the_failed_round(self):
+        pytest.importorskip("cryptography")
+
+        clock = [0.0]
+        journal = RoundJournal(clock=lambda: clock[0])
+        keys, addrs, machines = _net()
+        m1 = machines[1]  # NOT the round-0 proposer: it waits, times out
+        m1.journal = journal
+        m1.start()
+        clock[0] = 0.5
+        m1.on_timeout(0, "propose")  # nil prevote
+        clock[0] = 0.8
+        m1.on_timeout(0, "prevote")  # nil precommit
+        clock[0] = 1.0
+        m1.on_timeout(0, "precommit")  # round bump -> journal row
+        assert m1.round == 1
+        row = traced().table(RoundJournal.TABLE)[-1]
+        assert row["result"] == "round_bump"
+        assert row["height"] == 1 and row["round"] == 0
+        assert row["proposer"] == addrs[0]
+        assert row["timeouts"] == ["propose", "prevote", "precommit"]
+        assert row["propose_ms"] == pytest.approx(500.0)
+        assert row["prevote_ms"] == pytest.approx(300.0)
+        assert row["precommit_ms"] == pytest.approx(200.0)
+        assert row["total_ms"] == pytest.approx(1000.0)
+        # Only m1's own nil votes are in: 100 of 400 power.
+        assert row["prevote_power"] == pytest.approx(0.25)
+        assert row["precommit_power"] == pytest.approx(0.25)
+
+    def test_wal_fsync_feeds_the_round_row(self, tmp_path):
+        pytest.importorskip("cryptography")
+        from celestia_app_tpu.consensus.wal import VoteWAL
+
+        wal = VoteWAL(str(tmp_path / "wal.jsonl"))
+        journal = RoundJournal(fsync_ms_source=lambda: wal.fsync_ms_total)
+        keys, addrs, machines = _net()
+        m1 = machines[1]
+        m1.journal = journal
+        m1.sign_guard = wal.may_sign
+        m1.start()
+        m1.on_timeout(0, "propose")  # signs a nil prevote -> WAL fsync
+        m1.on_timeout(0, "prevote")
+        m1.on_timeout(0, "precommit")
+        row = traced().table(RoundJournal.TABLE)[-1]
+        assert row["wal_fsync_ms"] > 0
+        assert wal.fsync_ms_total > 0
+        wal.close()

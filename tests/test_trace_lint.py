@@ -1,0 +1,48 @@
+"""Tier-1 seat for scripts/trace_lint.py: every registered metric name is
+well-formed (`celestia_[a-z0-9_]+`) and documented in the README metrics
+table, so exposition goldens and docs cannot drift."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+
+_SCRIPT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts", "trace_lint.py",
+)
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("trace_lint", _SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_metric_names_lint_clean():
+    lint = _load()
+    problems = lint.lint()
+    assert problems == [], "\n".join(problems)
+
+
+def test_lint_catches_undocumented_and_malformed_names(tmp_path):
+    lint = _load()
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "def f(reg):\n"
+        "    reg.counter('celestia_documented_total', 'x')\n"
+        "    reg.gauge('celestia_undocumented_thing', 'x')\n"
+        "    reg.histogram('BadName_seconds', 'x')\n"
+        "    reg.histogram(f'celestia_dyn_{1}_seconds', 'x')\n"
+    )
+    readme = tmp_path / "README.md"
+    readme.write_text(
+        "| `celestia_documented_total` | counter |\n"
+        "| `celestia_dyn_<x>_seconds` | histogram |\n"
+    )
+    problems = lint.lint(str(pkg), str(readme))
+    assert len(problems) == 2
+    assert any("celestia_undocumented_thing" in p for p in problems)
+    assert any("BadName_seconds" in p for p in problems)
