@@ -318,6 +318,8 @@ class ExtendedDataSquare:
             # jnp.asarray is a no-copy pass-through for a device array, so
             # donating here would invalidate the CALLER'S buffer.  Their
             # array, their lifetime: take the non-donating pipeline.
+            if ods.dtype != jnp.uint8:  # the host path coerces; so must this
+                ods = jnp.asarray(ods, dtype=jnp.uint8)
             state = pipeline_cache_state(k, construction)
             t0 = time.perf_counter()
             eds, rr, cr, droot = jit_pipeline(k, construction)(ods)

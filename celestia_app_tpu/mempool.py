@@ -15,6 +15,16 @@ three Prometheus families — `celestia_mempool_txs` /
 `celestia_mempool_evictions_total{reason=priority|ttl|recheck}` counting
 every non-commit removal — and the lifecycle histogram gets the
 `mempool_wait` (insert -> reap) and `total` (submit -> commit) phases.
+
+Per-tenant accounting: each entry carries its submitting namespace label
+(first blob's namespace for a BlobTx, the reserved `tx` bucket for
+normal txs), kept reconciled through every admission and removal path —
+insert, priority eviction, TTL expiry, recheck eviction, committed drop
+— onto the `celestia_mempool_namespace_{txs,size_bytes}` depth gauges;
+evictions carry the namespace too.  All namespace label values go
+through the top-N cardinality cap (trace/square_journal.py), and the
+e2e `mempool_wait`/`total` phases inherit the namespace from the
+entry's TraceContext baggage.
 """
 
 from __future__ import annotations
@@ -37,6 +47,15 @@ class _Entry:
     ctx: object | None = None  # submitting request's TraceContext
     t_ins: float = field(default=0.0)  # perf_counter at admission
     reaped: bool = False  # mempool_wait observed (first reap only)
+    # Submitting namespace label, already CAPPED at admission ("tx" for
+    # normal txs, "other" past the top-N admission cap): capping once
+    # here keeps every later gauge/counter refresh a plain dict walk.
+    ns: str = "tx"
+
+    def e2e_namespace(self) -> str | None:
+        """The namespace the entry's e2e observations are attributed to
+        (None for normal txs — they keep the unlabeled phase series)."""
+        return self.ns if self.ns != "tx" else None
 
 
 class PriorityMempool:
@@ -52,6 +71,10 @@ class PriorityMempool:
         self._entries: dict[bytes, _Entry] = {}
         self._seq = 0
         self._bytes = 0
+        # CAPPED namespace label -> [txs, bytes]; entries removed on zero
+        # after the gauge refresh, so the dict only holds live tenants and
+        # is bounded by the cap (top-N + `tx` + `other`) by construction.
+        self._ns_depth: dict[str, list[int]] = {}
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -84,21 +107,41 @@ class PriorityMempool:
         reg.gauge(
             "celestia_mempool_size_bytes", "resident mempool bytes"
         ).set(self._bytes)
+        # Per-tenant depth: keys are capped at admission (distinct raw
+        # labels past the cap already share the `other` entry), so this is
+        # a plain walk; zeroed tenants drop after their gauge lands on 0.
+        ns_txs = reg.gauge(
+            "celestia_mempool_namespace_txs",
+            "resident mempool txs per namespace (top-N capped)",
+        )
+        ns_bytes = reg.gauge(
+            "celestia_mempool_namespace_size_bytes",
+            "resident mempool bytes per namespace (top-N capped)",
+        )
+        for lbl, (n, b) in self._ns_depth.items():
+            ns_txs.set(n, namespace=lbl)
+            ns_bytes.set(b, namespace=lbl)
+        for lbl in [l for l, (n, _) in self._ns_depth.items() if n == 0]:
+            del self._ns_depth[lbl]
 
-    @staticmethod
-    def _tick_eviction(reason: str, n: int = 1) -> None:
+    def _tick_eviction(self, reason: str, n: int = 1, *,
+                       namespace: str = "tx") -> None:
         from celestia_app_tpu.trace.metrics import registry
+        from celestia_app_tpu.trace.square_journal import capped_namespace_label
 
         registry().counter(
             "celestia_mempool_evictions_total",
             "mempool removals that were not block inclusion",
-        ).inc(n, reason=reason)
+        ).inc(n, reason=reason, namespace=capped_namespace_label(namespace))
 
     # --- mutation -----------------------------------------------------------
-    def insert(self, tx: bytes, priority: int, height: int, ctx=None) -> bool:
+    def insert(self, tx: bytes, priority: int, height: int, ctx=None,
+               ns: str | None = None) -> bool:
         """Admit a checked tx; False if duplicate, oversized, or the pool is
         full of higher-priority txs.  `ctx` is the submitting request's
-        TraceContext (defaults to the thread's current one)."""
+        TraceContext (defaults to the thread's current one); `ns` is the
+        tx's already-resolved namespace label, when the caller (the
+        broadcast path) parsed the tx anyway."""
         from celestia_app_tpu.trace.context import current_context, trace_span
 
         if ctx is None:
@@ -107,37 +150,82 @@ class PriorityMempool:
             "mempool_insert", ctx=ctx, layer="mempool",
             tx_bytes=len(tx), height=height,
         ) as sp:
-            ok = self._insert(tx, priority, height, ctx)
+            ok = self._insert(tx, priority, height, ctx, ns)
             sp["result"] = "inserted" if ok else "rejected"
         self._refresh_gauges()
         return ok
 
-    def _insert(self, tx: bytes, priority: int, height: int, ctx) -> bool:
+    def _insert(self, tx: bytes, priority: int, height: int, ctx,
+                ns: str | None = None) -> bool:
         if len(tx) > self.max_tx_bytes:
             return False
         key = self.tx_key(tx)
         if key in self._entries:
             return False
-        # Evict lowest-priority entries to make room (prioritized admission).
-        while self._bytes + len(tx) > self.max_pool_bytes and self._entries:
-            victim_key, victim = min(
-                self._entries.items(), key=lambda kv: (kv[1].priority, -kv[1].seq)
+        # Evict lowest-priority entries to make room (prioritized
+        # admission).  Feasibility is decided BEFORE anything is removed:
+        # evicting one-at-a-time and then discovering the next victim
+        # outranks the newcomer would have destroyed valid residents for
+        # an insert that admits nothing.
+        need = self._bytes + len(tx) - self.max_pool_bytes
+        if need > 0:
+            victims = sorted(
+                (kv for kv in self._entries.items()
+                 if kv[1].priority < priority),
+                key=lambda kv: (kv[1].priority, -kv[1].seq),
             )
-            if victim.priority >= priority:
-                return False  # everything resident outranks the newcomer
-            self._remove(victim_key)
-            self._tick_eviction("priority")
+            chosen, freed = [], 0
+            for kv in victims:
+                if freed >= need:
+                    break
+                chosen.append(kv)
+                freed += len(kv[1].tx)
+            if freed < need:
+                return False  # infeasible: nothing was evicted
+            for victim_key, victim in chosen:
+                self._remove(victim_key)
+                self._tick_eviction("priority", namespace=victim.ns)
+        if ns is not None:  # caller-resolved raw label still needs the cap
+            from celestia_app_tpu.trace.square_journal import (
+                capped_namespace_label,
+            )
+
+            ns = capped_namespace_label(ns)
         self._entries[key] = _Entry(
-            tx, priority, height, self._seq, ctx, time.perf_counter()
+            tx, priority, height, self._seq, ctx, time.perf_counter(),
+            ns=ns if ns is not None else self._namespace_of(tx, ctx),
         )
         self._seq += 1
         self._bytes += len(tx)
+        e = self._entries[key]
+        agg = self._ns_depth.setdefault(e.ns, [0, 0])
+        agg[0] += 1
+        agg[1] += len(tx)
         return True
+
+    @staticmethod
+    def _namespace_of(tx: bytes, ctx) -> str:
+        """The entry's CAPPED namespace label: the submit path already
+        resolved the raw label into the trace baggage; fall back to
+        parsing the tx (gossip relays and direct inserts arrive without
+        baggage).  Capped exactly once, here at admission."""
+        from celestia_app_tpu.trace.square_journal import (
+            capped_namespace_label,
+            tx_namespace_label,
+        )
+
+        baggage = getattr(ctx, "baggage", None)
+        raw = (baggage or {}).get("namespace") or tx_namespace_label(tx)
+        return capped_namespace_label(raw) if raw else "tx"
 
     def _remove(self, key: bytes) -> None:
         e = self._entries.pop(key, None)
         if e is not None:
             self._bytes -= len(e.tx)
+            agg = self._ns_depth.get(e.ns)
+            if agg is not None:
+                agg[0] -= 1
+                agg[1] -= len(e.tx)
 
     def reap(self, max_bytes: int | None = None) -> list[bytes]:
         """Txs by (priority desc, FIFO) under a byte budget.
@@ -188,7 +276,8 @@ class PriorityMempool:
             # until TTL, and re-observing its growing residency would let
             # duplicates dominate the histogram's tail.
             if e.t_ins and not e.reaped:
-                observe_e2e("mempool_wait", now - e.t_ins)
+                observe_e2e("mempool_wait", now - e.t_ins,
+                            namespace=e.e2e_namespace())
             e.reaped = True
         return out
 
@@ -211,15 +300,19 @@ class PriorityMempool:
                 continue
             committed += 1
             if e.ctx is not None and getattr(e.ctx, "start_unix_ns", 0):
-                observe_e2e("total", (now_ns - e.ctx.start_unix_ns) / 1e9)
+                observe_e2e("total", (now_ns - e.ctx.start_unix_ns) / 1e9,
+                            namespace=e.e2e_namespace())
             self._remove(key)
         expired = [
             k for k, e in self._entries.items() if height - e.height >= self.ttl
         ]
+        expired_by_ns: dict[str, int] = {}
         for k in expired:
+            ns = self._entries[k].ns
+            expired_by_ns[ns] = expired_by_ns.get(ns, 0) + 1
             self._remove(k)
-        if expired:
-            self._tick_eviction("ttl", len(expired))
+        for ns, n in sorted(expired_by_ns.items()):
+            self._tick_eviction("ttl", n, namespace=ns)
         traced().write(
             "mempool_update", height=height, committed=committed,
             expired=len(expired), resident=len(self._entries),
@@ -239,7 +332,8 @@ class PriorityMempool:
         """Evict one tx (the post-commit recheck path): counted like every
         other non-commit removal so the gauges reconcile."""
         key = self.tx_key(tx)
-        if key in self._entries:
+        e = self._entries.get(key)
+        if e is not None:
             self._remove(key)
-            self._tick_eviction("recheck")
+            self._tick_eviction("recheck", namespace=e.ns)
             self._refresh_gauges()

@@ -588,8 +588,18 @@ class ServingNode(TestNode):
         exactly when an orchestrator most needs the probe to answer — so
         the lock is taken with a short timeout and contention itself
         becomes the report (best-effort unlocked reads are safe: ints and
-        container sizes, no invariants)."""
+        container sizes, no invariants).
+
+        `last_square` (height, k, occupancy of the most recent square
+        build/construct, from trace/square_journal.py) distinguishes a
+        node stuck producing empty blocks (height advances, occupancy
+        pinned at 0) from a healthy idle one (no recent square at all, or
+        mempool empty).  Process-level, like the metrics registry: in a
+        multi-node test process it reflects the last square ANY node
+        built."""
         import time
+
+        from celestia_app_tpu.trace import square_journal
 
         out: dict = {
             "height": self.app.height,
@@ -602,6 +612,7 @@ class ServingNode(TestNode):
                 "bytes": self.mempool.size_bytes(),
             },
             "peers": len(self.peer_urls),
+            "last_square": square_journal.last_square(),
         }
         if not self.lock.acquire(timeout=0.25):
             out["lock_contended"] = True

@@ -1,7 +1,9 @@
 from celestia_app_tpu.square.builder import (
     BlobPlacement,
     Builder,
+    NamespaceUsage,
     Square,
+    SquareAccounting,
     SquareOverflow,
     build,
     construct,
@@ -16,7 +18,9 @@ from celestia_app_tpu.square.layout import (
 __all__ = [
     "BlobPlacement",
     "Builder",
+    "NamespaceUsage",
     "Square",
+    "SquareAccounting",
     "SquareOverflow",
     "build",
     "construct",

@@ -72,6 +72,53 @@ class _Layout:
     end: int  # share index one past the last non-tail-padding share
 
 
+@dataclass(frozen=True)
+class NamespaceUsage:
+    """One namespace's footprint in a built square."""
+
+    namespace: bytes  # the 29-byte encoded namespace
+    blobs: int
+    shares: int
+    data_bytes: int  # sum of blob payload lengths
+
+
+@dataclass(frozen=True)
+class SquareAccounting:
+    """Exact share-count breakdown of one exported square.
+
+    Every share in the k*k square is attributed to exactly one bucket, so
+    tx + pfb + blob + reserved + namespace + tail == size*size always —
+    the invariant the square journal rows carry and tests pin.
+    """
+
+    size: int  # square size k
+    tx_shares: int  # compact TRANSACTION-namespace shares
+    pfb_shares: int  # compact PAY_FOR_BLOB shares (IndexWrappers)
+    blob_shares: int  # sparse shares holding blob payloads
+    reserved_padding: int  # compact range -> first blob alignment gap
+    namespace_padding: int  # alignment gaps between blobs
+    tail_padding: int  # end of content -> k*k
+    namespaces: tuple[NamespaceUsage, ...]  # sorted by namespace bytes
+
+    @property
+    def total_shares(self) -> int:
+        return self.size * self.size
+
+    @property
+    def used_shares(self) -> int:
+        """Shares carrying data (everything that is not padding)."""
+        return self.tx_shares + self.pfb_shares + self.blob_shares
+
+    @property
+    def padding_shares(self) -> int:
+        return self.reserved_padding + self.namespace_padding + self.tail_padding
+
+    @property
+    def occupancy(self) -> float:
+        """used / k*k — the square-size efficiency signal."""
+        return self.used_shares / self.total_shares
+
+
 class SquareOverflow(ValueError):
     """The content does not fit in the maximum square size."""
 
@@ -91,10 +138,16 @@ def _compact_share_index(byte_offset: int) -> int:
 class Square:
     """An immutable k x k square of shares plus its layout metadata."""
 
-    def __init__(self, shares: list[Share], layout: _Layout):
+    def __init__(
+        self,
+        shares: list[Share],
+        layout: _Layout,
+        accounting: SquareAccounting | None = None,
+    ):
         self.shares = shares
         self.size = layout.size
         self._layout = layout
+        self.accounting = accounting
 
     def __len__(self) -> int:
         return len(self.shares)
@@ -286,7 +339,42 @@ class Builder:
 
         total = layout.size * layout.size
         shares += tail_padding_shares(total - len(shares))
-        return Square(shares, layout)
+        return Square(shares, layout, self._accounting(layout))
+
+    def _accounting(self, layout: _Layout) -> SquareAccounting:
+        """The padding/occupancy breakdown export() used to throw away:
+        re-derived from the solved layout alone (no extra fixpoint runs)."""
+        compact_end = layout.tx_share_count + layout.pfb_share_count
+        if layout.placements:
+            reserved = layout.placements[0].start - compact_end
+            ns_pad = 0
+            cursor = layout.placements[0].start
+            for p in layout.placements:
+                ns_pad += p.start - cursor
+                cursor = p.start + p.share_count
+            blob_shares = sum(p.share_count for p in layout.placements)
+        else:
+            reserved = ns_pad = blob_shares = 0
+        per_ns: dict[bytes, list[int]] = {}  # ns bytes -> [blobs, shares, bytes]
+        for p in layout.placements:
+            blob = self._blob_txs[p.pfb_index].blobs[p.blob_index]
+            agg = per_ns.setdefault(blob.namespace.to_bytes(), [0, 0, 0])
+            agg[0] += 1
+            agg[1] += p.share_count
+            agg[2] += len(blob.data)
+        return SquareAccounting(
+            size=layout.size,
+            tx_shares=layout.tx_share_count,
+            pfb_shares=layout.pfb_share_count,
+            blob_shares=blob_shares,
+            reserved_padding=reserved,
+            namespace_padding=ns_pad,
+            tail_padding=layout.size * layout.size - layout.end,
+            namespaces=tuple(
+                NamespaceUsage(ns, b, s, by)
+                for ns, (b, s, by) in sorted(per_ns.items())
+            ),
+        )
 
     # --- introspection ----------------------------------------------------
     def current_size(self) -> int:
@@ -303,6 +391,16 @@ class Builder:
 
 def _classify(raw_txs: list[bytes]) -> list[tuple[bytes, BlobTx | None]]:
     return [(raw, unmarshal_blob_tx(raw)) for raw in raw_txs]
+
+
+def _journal_export(sq: Square, sp: dict, phase: str, solves: int) -> None:
+    """Shared journal tail of build()/construct(): occupancy onto the
+    span, one square_journal row — one copy so the proposer and validator
+    rows can never drift."""
+    sp["occupancy"] = round(sq.accounting.occupancy, 6)
+    from celestia_app_tpu.trace import square_journal
+
+    square_journal.record(sq, phase=phase, layout_solves=solves)
 
 
 def build(
@@ -339,6 +437,7 @@ def build(
         sp["dropped"] = len(raw_txs) - len(kept_normal) - len(kept_blob)
         sp["layout_solves"] = builder._solves
         sp["k"] = sq.size
+        _journal_export(sq, sp, "build", builder._solves)
     return sq, kept_normal + kept_blob
 
 
@@ -365,4 +464,5 @@ def construct(
         sp["n_blobs"] = len(sq.placements)
         sp["layout_solves"] = builder._solves
         sp["k"] = sq.size
+        _journal_export(sq, sp, "construct", builder._solves)
     return sq

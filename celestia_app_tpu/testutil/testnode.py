@@ -114,6 +114,14 @@ class TestNode:
             from celestia_app_tpu.trace.context import new_context
 
             ctx = new_context(layer="rpc", source="local")
+        # A blob tx's submitting namespace rides the trace baggage from
+        # here on: every descendant span (mempool wait, square build,
+        # dispatch, commit) and its e2e observation carries the tenant.
+        from celestia_app_tpu.trace.square_journal import tx_namespace_label
+
+        ns_lbl = tx_namespace_label(raw_tx)
+        if ns_lbl is not None and ctx.baggage.get("namespace") != ns_lbl:
+            ctx = ctx.child(namespace=ns_lbl)
         with use_context(ctx), trace_span(
             "tx_submit", layer="rpc", e2e="submit", tx_bytes=len(raw_tx),
         ) as sp:
@@ -124,7 +132,8 @@ class TestNode:
                     (e[1] for e in res.events if e[0] == "priority"), 0
                 )
                 self.mempool.insert(
-                    raw_tx, priority, self.app.height, ctx=current_context()
+                    raw_tx, priority, self.app.height, ctx=current_context(),
+                    ns=ns_lbl or "tx",  # already parsed above; don't re-parse
                 )
         return res
 

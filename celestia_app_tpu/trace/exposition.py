@@ -12,6 +12,8 @@ hits:
     GET /trace_tables            {"tables": {name: row_count}}
     GET /trace_tables/<name>     the table as JSONL (application/x-ndjson)
     GET /healthz                 liveness + per-layer staleness
+    GET /namespaces              per-tenant data-plane summary (cumulative
+                                 blob/share/byte totals + last square)
 
 /healthz is the SLO face: beyond {"status": "SERVING"}, any registered
 health providers (a ServingNode registers its own snapshot: last block
@@ -84,6 +86,12 @@ def handle_observability_get(path: str):
         return 200, METRICS_CONTENT_TYPE, metrics_payload()
     if p == "/healthz":
         return 200, "application/json", json.dumps(health_payload()).encode()
+    if p == "/namespaces":
+        from celestia_app_tpu.trace import square_journal
+
+        return 200, "application/json", json.dumps(
+            square_journal.namespaces_payload()
+        ).encode()
     if p == "/trace_tables":
         return 200, "application/json", json.dumps(
             {"tables": traced().row_counts()}

@@ -1,0 +1,432 @@
+#!/usr/bin/env python
+"""Bench trajectory reader + regression gate over the BENCH_r*.json rounds.
+
+Each driver round leaves one `BENCH_rNN.json` at the repo root:
+`{n, cmd, rc, tail, parsed}` where `tail` is the LAST 2000 bytes of the
+bench's stdout — usually ending in the one-line JSON summary bench.py
+prints, but possibly truncated at the front (the r04/r05 rounds lose the
+`results` array and keep only the trailing `parts`/`stability_pct`
+fields) or missing entirely (r01 died before printing).  This tool
+reads the whole series, salvages what each round actually recorded, and
+prints the per-mode trend table nobody could previously assemble:
+
+    python scripts/bench_trend.py            # table + gate
+    python scripts/bench_trend.py --check    # tier-1 self-test mode
+
+The GATE (exit 1) is stability-aware and fires when the newest datapoint
+of a gated series drops more than `--threshold` percent (default 10)
+plus that round's measured `stability_pct` below the best earlier
+datapoint.  Gated by default: the device-resident `compute` rows (the
+ROADMAP headline) and the `parts` decomposition seconds.  The
+link-bound modes (extend / stream / repair / host) ride the tunnel
+between the host and the chip, whose quality varies between rounds
+(BENCH_r03's stream row collapsed 13x while compute improved 24x), so
+they are REPORTED but only gated under `--all-series`.  Malformed or
+empty inputs exit 2 — a bad bench JSON fails tier-1 fast instead of
+silently dropping out of the trajectory.
+
+`--metrics-out <dir>` writes the same artifacts bench.py does — a
+`bench_trend.prom` Prometheus textfile and `bench_trend.jsonl` rows
+(tracer table `bench_trend`) — so the next chip round's numbers land in
+the same tables as the live exposition.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Modes whose rate is device-resident and comparable across rounds.
+GATED_MODES = ("compute",)
+# Modes bound by the host<->device link; reported, not gated by default.
+LINK_BOUND_MODES = ("extend", "stream", "repair", "host")
+
+_MODE_ROW_RE = re.compile(r'\{"mode":\s*"[a-z_]+",\s*"k":\s*\d+[^{}]*\}')
+_STABILITY_RE = re.compile(r'"stability_pct":\s*([0-9.]+)')
+_ERRORS_RE = re.compile(r'"errors":\s*(\[[^\]]*\])')
+
+
+class MalformedRound(ValueError):
+    """A BENCH_r*.json that cannot be read at all (exit 2 material)."""
+
+
+def _balanced_object(text: str, start: int) -> str | None:
+    """The JSON object starting at text[start] == '{', by brace balance
+    (good enough here: bench summaries never put braces in strings)."""
+    depth = 0
+    for i in range(start, len(text)):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return text[start : i + 1]
+    return None
+
+
+def _salvage_tail(tail: str) -> dict:
+    """Partial recovery from a front-truncated summary line: individual
+    mode rows, the parts decomposition, stability, errors."""
+    out: dict = {"partial": True}
+    rows = []
+    for m in _MODE_ROW_RE.finditer(tail):
+        try:
+            rows.append(json.loads(m.group(0)))
+        except ValueError:
+            continue
+    if rows:
+        out["results"] = rows
+    i = tail.rfind('"parts": {')
+    if i >= 0:
+        obj = _balanced_object(tail, i + len('"parts": '))
+        if obj is not None:
+            try:
+                out["parts"] = json.loads(obj)
+            except ValueError:
+                pass
+    m = _STABILITY_RE.search(tail)
+    if m:
+        out["stability_pct"] = float(m.group(1))
+    m = _ERRORS_RE.search(tail)
+    if m:
+        try:
+            out["errors"] = json.loads(m.group(1))
+        except ValueError:
+            pass
+    return out
+
+
+def _summary_from_tail(tail: str) -> dict | None:
+    """The full summary line if the tail still holds it whole."""
+    for line in reversed(tail.splitlines()):
+        if line.startswith('{"metric"'):
+            try:
+                return json.loads(line)
+            except ValueError:
+                return None
+    return None
+
+
+def load_round(path: str) -> dict:
+    """One round's recoverable record:
+
+    {round, rc, ok, partial, platform, headline, stability_pct, errors,
+     modes: {(mode, k): [mb_per_s, ...]}, parts: {name: seconds} | None}
+    """
+    try:
+        with open(path, encoding="utf-8") as f:
+            raw = json.load(f)
+    except (OSError, ValueError) as e:
+        raise MalformedRound(f"{path}: not readable JSON: {e}") from e
+    for key in ("n", "rc", "tail"):
+        if key not in raw:
+            raise MalformedRound(f"{path}: missing required key {key!r}")
+    rec = {
+        "round": int(raw["n"]),
+        "path": os.path.basename(path),
+        "rc": raw["rc"],
+        "ok": raw["rc"] == 0,
+        "partial": False,
+        "platform": None,
+        "headline": None,
+        "stability_pct": None,
+        "errors": None,
+        "modes": {},
+        "parts": None,
+    }
+    summary = raw.get("parsed")
+    if not isinstance(summary, dict):
+        summary = _summary_from_tail(raw["tail"]) if rec["ok"] else None
+        if summary is None and rec["ok"]:
+            summary = _salvage_tail(raw["tail"])
+    if not summary:
+        return rec
+    rec["partial"] = bool(summary.get("partial"))
+    rec["platform"] = summary.get("platform")
+    rec["headline"] = summary.get("value")
+    rec["stability_pct"] = summary.get("stability_pct")
+    rec["errors"] = summary.get("errors")
+    for row in summary.get("results", []):
+        mode, k = row.get("mode"), row.get("k")
+        if mode is None or k is None or "mb_per_s" not in row:
+            raise MalformedRound(
+                f"{path}: result row missing mode/k/mb_per_s: {row}"
+            )
+        rec["modes"].setdefault((str(mode), int(k)), []).append(
+            float(row["mb_per_s"])
+        )
+    parts = summary.get("parts")
+    if isinstance(parts, dict) and isinstance(parts.get("seconds"), dict):
+        rec["parts"] = {
+            str(n): float(s) for n, s in parts["seconds"].items()
+        }
+    return rec
+
+
+def load_series(paths: list[str]) -> list[dict]:
+    if not paths:
+        raise MalformedRound("no BENCH_r*.json files found")
+    rounds = sorted((load_round(p) for p in paths), key=lambda r: r["round"])
+    if not any(r["modes"] or r["parts"] for r in rounds):
+        raise MalformedRound("no round contributed any data")
+    return rounds
+
+
+# --- trend assembly ---------------------------------------------------------
+
+def mode_series(rounds: list[dict]) -> dict[tuple[str, int], list[tuple[int, float]]]:
+    """{(mode, k): [(round, best mb/s)]} — duplicates within a round (the
+    compute@512 stability rerun) collapse to their max."""
+    series: dict[tuple[str, int], list[tuple[int, float]]] = {}
+    for r in rounds:
+        for key, vals in sorted(r["modes"].items()):
+            series.setdefault(key, []).append((r["round"], max(vals)))
+    return series
+
+
+def parts_series(rounds: list[dict]) -> dict[str, list[tuple[int, float]]]:
+    """{part name: [(round, seconds)]} (lower is better)."""
+    series: dict[str, list[tuple[int, float]]] = {}
+    for r in rounds:
+        for name, secs in sorted((r["parts"] or {}).items()):
+            series.setdefault(name, []).append((r["round"], secs))
+    return series
+
+
+def _stability(rounds: list[dict], rnd: int) -> float:
+    for r in rounds:
+        if r["round"] == rnd:
+            return float(r["stability_pct"] or 0.0)
+    return 0.0
+
+
+def find_regressions(
+    rounds: list[dict],
+    threshold_pct: float,
+    gate_modes: tuple[str, ...] = GATED_MODES,
+    gate_all: bool = False,
+) -> list[dict]:
+    """Newest datapoint vs best earlier datapoint per gated series; the
+    effective threshold widens by the newest round's stability_pct."""
+    out = []
+    for (mode, k), pts in sorted(mode_series(rounds).items()):
+        if not gate_all and mode not in gate_modes:
+            continue
+        if len(pts) < 2:
+            continue
+        last_round, last = pts[-1]
+        best_prior = max(v for _, v in pts[:-1])
+        if best_prior <= 0:
+            continue
+        allowed = threshold_pct + _stability(rounds, last_round)
+        worse_pct = (best_prior - last) / best_prior * 100.0
+        if worse_pct > allowed:
+            out.append({
+                "series": f"{mode}@{k}", "unit": "mb_per_s",
+                "round": last_round, "value": last, "best_prior": best_prior,
+                "worse_pct": round(worse_pct, 2), "allowed_pct": round(allowed, 2),
+            })
+    for name, pts in sorted(parts_series(rounds).items()):
+        if len(pts) < 2:
+            continue
+        last_round, last = pts[-1]
+        best_prior = min(v for _, v in pts[:-1])
+        if best_prior <= 0:
+            continue
+        allowed = threshold_pct + _stability(rounds, last_round)
+        worse_pct = (last - best_prior) / best_prior * 100.0
+        if worse_pct > allowed:
+            out.append({
+                "series": f"parts.{name}", "unit": "seconds",
+                "round": last_round, "value": last, "best_prior": best_prior,
+                "worse_pct": round(worse_pct, 2), "allowed_pct": round(allowed, 2),
+            })
+    return out
+
+
+def stale_gated_series(
+    rounds: list[dict],
+    gate_modes: tuple[str, ...] = GATED_MODES,
+    gate_all: bool = False,
+) -> list[dict]:
+    """Gated series whose newest datapoint predates the newest round that
+    recorded ANY data — the gate is comparing stale numbers for them (the
+    checked-in compute rows stop at r03 because the r04/r05 tails lost
+    the results array).  Reported loudly, not failed: a truncated tail
+    must not mask the rounds that DID measure."""
+    newest = max(
+        (r["round"] for r in rounds if r["modes"] or r["parts"]), default=None
+    )
+    if newest is None:
+        return []
+    out = []
+    for (mode, k), pts in sorted(mode_series(rounds).items()):
+        if not gate_all and mode not in gate_modes:
+            continue
+        if pts[-1][0] < newest:
+            out.append({"series": f"{mode}@{k}", "last_round": pts[-1][0],
+                        "newest_round": newest})
+    for name, pts in sorted(parts_series(rounds).items()):
+        if pts[-1][0] < newest:
+            out.append({"series": f"parts.{name}", "last_round": pts[-1][0],
+                        "newest_round": newest})
+    return out
+
+
+def render_table(rounds: list[dict]) -> str:
+    """The human trend table: one column per round, one row per series."""
+    rnds = [r["round"] for r in rounds]
+    lines = []
+    header = ["series".ljust(16)] + [f"r{n:02d}".rjust(9) for n in rnds]
+    lines.append("  ".join(header))
+    modes = mode_series(rounds)
+
+    def fmt_row(label, pts, unit):
+        by_round = dict(pts)
+        cells = [
+            (f"{by_round[n]:9.2f}" if n in by_round else "        -")
+            for n in rnds
+        ]
+        return "  ".join([label.ljust(16)] + cells) + f"  {unit}"
+
+    for mode in GATED_MODES + LINK_BOUND_MODES:
+        for (m, k), pts in sorted(modes.items()):
+            if m == mode:
+                gated = "" if mode in GATED_MODES else " (not gated)"
+                lines.append(fmt_row(f"{m}@{k}", pts, f"MB/s{gated}"))
+    for (m, k), pts in sorted(modes.items()):
+        if m not in GATED_MODES + LINK_BOUND_MODES:
+            lines.append(fmt_row(f"{m}@{k}", pts, "MB/s (not gated)"))
+    for name, pts in sorted(parts_series(rounds).items()):
+        lines.append(fmt_row(f"parts.{name}", pts, "s"))
+    notes = []
+    for r in rounds:
+        tags = []
+        if not r["ok"]:
+            tags.append("FAILED (rc!=0)")
+        if r["partial"]:
+            tags.append("tail truncated; salvaged")
+        if r["errors"]:
+            tags.append(f"errors: {'; '.join(map(str, r['errors']))}")
+        if r["stability_pct"] is not None:
+            tags.append(f"stability ±{r['stability_pct']}%")
+        if tags:
+            notes.append(f"  r{r['round']:02d}: {', '.join(tags)}")
+    if notes:
+        lines.append("round notes:")
+        lines.extend(notes)
+    return "\n".join(lines)
+
+
+def write_metrics_out(out_dir: str, rounds: list[dict],
+                      regressions: list[dict]) -> None:
+    """bench_trend.prom + bench_trend.jsonl, the bench.py --metrics-out
+    shapes (private registry/tracer: this run's view only)."""
+    if REPO_ROOT not in sys.path:  # `python scripts/bench_trend.py` puts
+        sys.path.insert(0, REPO_ROOT)  # scripts/, not the repo, on the path
+    from celestia_app_tpu.trace.metrics import Registry
+    from celestia_app_tpu.trace.tracer import Tracer
+
+    os.makedirs(out_dir, exist_ok=True)
+    reg = Registry()
+    tracer = Tracer(env_gated=False)
+    rate = reg.gauge("celestia_bench_trend_mb_per_s",
+                     "per-round bench rate by series")
+    secs = reg.gauge("celestia_bench_trend_part_seconds",
+                     "per-round parts decomposition seconds")
+    reg.counter("celestia_bench_trend_regressions_total",
+                "series flagged by the trend gate").inc(len(regressions))
+    for (mode, k), pts in sorted(mode_series(rounds).items()):
+        for rnd, v in pts:
+            rate.set(v, mode=mode, k=str(k), round=f"r{rnd:02d}")
+            tracer.write("bench_trend", round=rnd, mode=mode, k=k,
+                         mb_per_s=v)
+    for name, pts in sorted(parts_series(rounds).items()):
+        for rnd, v in pts:
+            secs.set(v, part=name, round=f"r{rnd:02d}")
+            tracer.write("bench_trend", round=rnd, part=name, seconds=v)
+    for reg_row in regressions:
+        tracer.write("bench_trend", regression=True, **reg_row)
+    with open(os.path.join(out_dir, "bench_trend.prom"), "w") as f:
+        f.write(reg.render())
+    with open(os.path.join(out_dir, "bench_trend.jsonl"), "w") as f:
+        jsonl = tracer.export_jsonl("bench_trend")
+        f.write(jsonl + "\n" if jsonl else "")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="*",
+                    help="bench round JSONs (default: BENCH_r*.json at the repo root)")
+    ap.add_argument("--dir", default=REPO_ROOT,
+                    help="directory holding BENCH_r*.json (default: repo root)")
+    ap.add_argument("--threshold", type=float, default=10.0,
+                    help="regression threshold in percent (widened by the "
+                         "round's stability_pct)")
+    ap.add_argument("--all-series", action="store_true",
+                    help="gate the link-bound modes too")
+    ap.add_argument("--check", action="store_true",
+                    help="tier-1 self-test: parse + gate the checked-in "
+                         "rounds, no device needed")
+    ap.add_argument("--metrics-out", metavar="DIR",
+                    help="write bench_trend.prom + bench_trend.jsonl here")
+    ap.add_argument("--json", action="store_true",
+                    help="print the machine-readable summary instead of the table")
+    args = ap.parse_args(argv)
+
+    paths = args.files or sorted(glob.glob(os.path.join(args.dir, "BENCH_r*.json")))
+    try:
+        rounds = load_series(paths)
+    except MalformedRound as e:
+        print(f"bench_trend: MALFORMED: {e}", file=sys.stderr)
+        return 2
+    if args.check:
+        # Self-test: every round that EXITED cleanly must have contributed
+        # data — a bench whose summary line stopped parsing entirely is a
+        # tooling regression, not a quiet gap in the table.
+        for r in rounds:
+            if r["ok"] and not r["modes"] and not r["parts"]:
+                print(f"bench_trend: MALFORMED: {r['path']} exited 0 but no "
+                      "summary data could be recovered from its tail",
+                      file=sys.stderr)
+                return 2
+    regressions = find_regressions(
+        rounds, args.threshold, gate_all=args.all_series
+    )
+    stale = stale_gated_series(rounds, gate_all=args.all_series)
+    if args.metrics_out:
+        write_metrics_out(args.metrics_out, rounds, regressions)
+    if args.json:
+        print(json.dumps({
+            "rounds": [r["round"] for r in rounds],
+            "regressions": regressions,
+            "stale": stale,
+            "threshold_pct": args.threshold,
+        }))
+    else:
+        print(render_table(rounds))
+        for s in stale:
+            print(f"  STALE: gated series {s['series']} last measured in "
+                  f"r{s['last_round']:02d} (newest data is "
+                  f"r{s['newest_round']:02d}) — the gate compares old numbers")
+        if regressions:
+            print("regressions:")
+            for r in regressions:
+                print(f"  {r['series']}: r{r['round']:02d} {r['value']} vs "
+                      f"best prior {r['best_prior']} ({r['unit']}): worse by "
+                      f"{r['worse_pct']}% > allowed {r['allowed_pct']}%")
+        else:
+            gate = "all series" if args.all_series else "compute + parts"
+            print(f"trend gate OK ({gate}, threshold {args.threshold}%"
+                  f" + per-round stability)")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,7 +10,16 @@ Walks every registry().counter/gauge/histogram registration in
   2. the name appears in the README "Metrics" table (dynamic families may
      be documented with a `<placeholder>` segment, e.g.
      `celestia_block_<stage>_seconds`, matched by prefix), so docs and
-     exposition goldens cannot drift apart.
+     exposition goldens cannot drift apart; and
+  3. every explicit LABEL keyword on a metric write (`.inc(...)` /
+     `.set(...)` / `.observe(...)`) matches `[a-z][a-z0-9_]*`; and
+  4. labels fed from an unbounded-cardinality source (today: `namespace`,
+     one value per tenant) only appear in modules that route the value
+     through the top-N cap helper
+     (trace/square_journal.capped_namespace_label) — a module that slaps
+     `namespace=` on a metric without referencing the helper fails,
+     which is what keeps the exposition's label cardinality provably
+     bounded as tenants multiply.
 
 Run standalone (exit 1 on problems) or via tests/test_trace_lint.py,
 which puts the check in tier-1.
@@ -32,10 +41,18 @@ METRIC_PREFIX_RE = re.compile(r"^celestia_[a-z0-9_]*$")
 README_TOKEN_RE = re.compile(r"celestia_[a-z0-9_<>]+")
 REGISTRY_METHODS = {"counter", "gauge", "histogram"}
 
+LABEL_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+METRIC_WRITE_METHODS = {"inc", "set", "observe"}
+# Labels whose value space grows with usage (one value per tenant): a
+# metric may only carry them when the module routes the value through the
+# cardinality cap helper.
+UNBOUNDED_LABELS = {"namespace"}
+CAP_HELPER = "capped_namespace_label"
 
-def collect_registrations(package_dir: str = PACKAGE_DIR):
-    """[(file, lineno, kind, name)] where kind is "static" (a literal
-    name) or "dynamic" (an f-string; `name` is its static prefix)."""
+
+def _parse_package(package_dir: str = PACKAGE_DIR):
+    """[(repo-relative path, parsed AST)] for every .py under the
+    package — the single walk+parse both collectors share."""
     out = []
     for dirpath, dirnames, filenames in os.walk(package_dir):
         dirnames[:] = [d for d in dirnames if d != "__pycache__"]
@@ -45,26 +62,68 @@ def collect_registrations(package_dir: str = PACKAGE_DIR):
             path = os.path.join(dirpath, fn)
             with open(path, encoding="utf-8") as f:
                 tree = ast.parse(f.read(), filename=path)
-            rel = os.path.relpath(path, REPO_ROOT)
-            for node in ast.walk(tree):
-                if not (
-                    isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr in REGISTRY_METHODS
-                    and node.args
-                ):
+            out.append((os.path.relpath(path, REPO_ROOT), tree))
+    return out
+
+
+def collect_registrations(package_dir: str = PACKAGE_DIR, trees=None):
+    """[(file, lineno, kind, name)] where kind is "static" (a literal
+    name) or "dynamic" (an f-string; `name` is its static prefix)."""
+    out = []
+    for rel, tree in trees if trees is not None else _parse_package(package_dir):
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in REGISTRY_METHODS
+                and node.args
+            ):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                out.append((rel, node.lineno, "static", arg.value))
+            elif isinstance(arg, ast.JoinedStr):
+                prefix = ""
+                for part in arg.values:
+                    if isinstance(part, ast.Constant):
+                        prefix += str(part.value)
+                    else:
+                        break
+                out.append((rel, node.lineno, "dynamic", prefix))
+    return out
+
+
+def collect_label_uses(package_dir: str = PACKAGE_DIR, trees=None):
+    """[(file, lineno, label_name, module_has_cap_helper)] for every
+    explicit keyword on a metric write call (.inc/.set/.observe).
+
+    `**spread` labels carry no static name and are skipped (none of the
+    in-tree spreads feed unbounded sources; explicit keywords are the
+    enforcement surface).  Whether the module references the cap helper
+    (an import or a call of `capped_namespace_label`) is recorded per
+    file so lint() can flag unbounded labels used outside it.
+    """
+    out = []
+    for rel, tree in trees if trees is not None else _parse_package(package_dir):
+        has_helper = any(
+            (isinstance(n, ast.Name) and n.id == CAP_HELPER)
+            or (isinstance(n, ast.Attribute) and n.attr == CAP_HELPER)
+            or (isinstance(n, ast.ImportFrom)
+                and any(a.name == CAP_HELPER for a in n.names))
+            for n in ast.walk(tree)
+        )
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in METRIC_WRITE_METHODS
+                and node.keywords
+            ):
+                continue
+            for kw in node.keywords:
+                if kw.arg is None:  # **spread
                     continue
-                arg = node.args[0]
-                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-                    out.append((rel, node.lineno, "static", arg.value))
-                elif isinstance(arg, ast.JoinedStr):
-                    prefix = ""
-                    for part in arg.values:
-                        if isinstance(part, ast.Constant):
-                            prefix += str(part.value)
-                        else:
-                            break
-                    out.append((rel, node.lineno, "dynamic", prefix))
+                out.append((rel, node.lineno, kw.arg, has_helper))
     return out
 
 
@@ -75,11 +134,17 @@ def readme_metric_tokens(readme_path: str = README) -> set[str]:
 
 def lint(package_dir: str = PACKAGE_DIR, readme_path: str = README) -> list[str]:
     problems = []
+    trees = _parse_package(package_dir)  # one walk feeds both collectors
     tokens = readme_metric_tokens(readme_path)
     # A documented dynamic family like celestia_block_<stage>_seconds
-    # covers every name sharing its static prefix.
-    doc_prefixes = [t.split("<", 1)[0] for t in tokens if "<" in t]
-    for rel, lineno, kind, name in collect_registrations(package_dir):
+    # covers every name matching it with the placeholder as one
+    # [a-z0-9_]+ segment — prefix AND suffix must line up (prefix-only
+    # matching let `celestia_<span>_seconds` whitelist every name).
+    doc_res = [
+        re.compile("^" + re.sub(r"<[a-z0-9_]+>", "[a-z0-9_]+", t) + "$")
+        for t in tokens if "<" in t
+    ]
+    for rel, lineno, kind, name in collect_registrations(package_dir, trees):
         where = f"{rel}:{lineno}"
         if kind == "static":
             if not METRIC_NAME_RE.match(name):
@@ -88,7 +153,7 @@ def lint(package_dir: str = PACKAGE_DIR, readme_path: str = README) -> list[str]
                     "celestia_[a-z0-9_]+"
                 )
             elif name not in tokens and not any(
-                p and name.startswith(p) for p in doc_prefixes
+                r.match(name) for r in doc_res
             ):
                 problems.append(
                     f"{where}: metric {name!r} missing from the README "
@@ -105,6 +170,19 @@ def lint(package_dir: str = PACKAGE_DIR, readme_path: str = README) -> list[str]
                     f"{where}: dynamic metric family {name!r}* missing "
                     "from the README metrics table"
                 )
+    for rel, lineno, label, has_helper in collect_label_uses(package_dir, trees):
+        where = f"{rel}:{lineno}"
+        if not LABEL_NAME_RE.match(label):
+            problems.append(
+                f"{where}: metric label {label!r} does not match "
+                "[a-z][a-z0-9_]*"
+            )
+        elif label in UNBOUNDED_LABELS and not has_helper:
+            problems.append(
+                f"{where}: label {label!r} is unbounded-cardinality; route "
+                f"the value through trace/square_journal.{CAP_HELPER} "
+                "(module never references the helper)"
+            )
     return problems
 
 

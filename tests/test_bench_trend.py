@@ -1,0 +1,177 @@
+"""Tier-1 seat for scripts/bench_trend.py: the checked-in BENCH_r*.json
+trajectory must parse and pass the gate (self-test mode, no device), a
+synthetic regression must be flagged, and malformed inputs must fail
+fast instead of silently dropping out of the trajectory."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import shutil
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SCRIPT = os.path.join(REPO_ROOT, "scripts", "bench_trend.py")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("bench_trend", _SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _checked_in_rounds():
+    import glob
+
+    return sorted(glob.glob(os.path.join(REPO_ROOT, "BENCH_r*.json")))
+
+
+def _round_file(tmp_path, n, results, stability=None, errors=None):
+    summary = {"metric": "x", "value": 1.0, "unit": "MB/s", "results": results}
+    if stability is not None:
+        summary["stability_pct"] = stability
+    if errors is not None:
+        summary["errors"] = errors
+    path = tmp_path / f"BENCH_r{n:02d}.json"
+    path.write_text(json.dumps({
+        "n": n, "cmd": "bench", "rc": 0,
+        "tail": "noise line\n" + json.dumps(summary),
+        "parsed": None,
+    }))
+    return str(path)
+
+
+class TestCheckedInTrajectory:
+    def test_check_mode_reproduces_r01_to_r05_and_passes(self, capsys):
+        bt = _load()
+        assert bt.main(["--check"]) == 0
+        out = capsys.readouterr().out
+        # The r02/r03 full summaries and the r04/r05 salvaged parts all
+        # land in one table.
+        assert "compute@512" in out
+        assert "parts.rs_dense" in out
+        assert "trend gate OK" in out
+        # Compute rows stop at r03 while parts data reaches r05: the gate
+        # must SAY it is comparing stale numbers, not stay silent.
+        assert "STALE" in out and "compute@512" in out
+
+    def test_check_fails_on_clean_exit_round_with_no_recoverable_data(
+        self, tmp_path
+    ):
+        bt = _load()
+        _round_file(tmp_path, 1, [
+            {"mode": "compute", "k": 128, "mb_per_s": 100.0},
+        ])
+        (tmp_path / "BENCH_r02.json").write_text(json.dumps({
+            "n": 2, "cmd": "bench", "rc": 0,
+            "tail": "all summary output lost", "parsed": None,
+        }))
+        # Default mode tolerates the gap (the r01 data still renders)...
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+        # ...but --check calls it what it is: a tooling regression.
+        assert bt.main(["--dir", str(tmp_path), "--check"]) == 2
+
+    def test_rounds_salvage_what_each_tail_holds(self):
+        bt = _load()
+        rounds = bt.load_series(_checked_in_rounds())
+        by_n = {r["round"]: r for r in rounds}
+        assert not by_n[1]["ok"] and not by_n[1]["modes"]  # rc=1, no data
+        assert ("compute", 512) in by_n[2]["modes"]
+        # r03 ran compute@512 twice (stability rerun): both kept.
+        assert len(by_n[3]["modes"][("compute", 512)]) == 2
+        # r04/r05 tails are front-truncated: parts salvaged, flagged.
+        for n in (4, 5):
+            assert by_n[n]["partial"]
+            assert "rs_dense" in by_n[n]["parts"]
+            assert by_n[n]["stability_pct"] is not None
+
+
+class TestRegressionGate:
+    def test_injected_synthetic_regression_is_flagged(self, tmp_path, capsys):
+        bt = _load()
+        for p in _checked_in_rounds():
+            shutil.copy(p, tmp_path / os.path.basename(p))
+        # Next round: compute@512 collapses 379 -> 40 MB/s.
+        _round_file(tmp_path, 6, [
+            {"mode": "compute", "k": 512, "mb_per_s": 40.0,
+             "seconds_per_block": 3.0},
+        ])
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        out = capsys.readouterr().out
+        assert "compute@512" in out and "regressions:" in out
+
+    def test_drop_within_threshold_plus_stability_passes(self, tmp_path):
+        bt = _load()
+        _round_file(tmp_path, 1, [
+            {"mode": "compute", "k": 128, "mb_per_s": 100.0},
+        ])
+        # 17% down, but threshold 10 + stability 8 allows it.
+        _round_file(tmp_path, 2, [
+            {"mode": "compute", "k": 128, "mb_per_s": 83.0},
+        ], stability=8.0)
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+        # Without the stability allowance the same drop fails.
+        _round_file(tmp_path, 2, [
+            {"mode": "compute", "k": 128, "mb_per_s": 83.0},
+        ])
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+
+    def test_link_bound_modes_gated_only_with_all_series(self, tmp_path):
+        bt = _load()
+        _round_file(tmp_path, 1, [
+            {"mode": "stream", "k": 128, "mb_per_s": 30.0},
+        ])
+        _round_file(tmp_path, 2, [
+            {"mode": "stream", "k": 128, "mb_per_s": 2.0},
+        ])
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+        assert bt.main(["--dir", str(tmp_path), "--all-series"]) == 1
+
+
+class TestMalformedInputsFailFast:
+    def test_unreadable_json_exits_2(self, tmp_path):
+        bt = _load()
+        (tmp_path / "BENCH_r01.json").write_text("{not json")
+        assert bt.main(["--dir", str(tmp_path)]) == 2
+
+    def test_missing_required_keys_exits_2(self, tmp_path):
+        bt = _load()
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps({"n": 1}))
+        assert bt.main(["--dir", str(tmp_path)]) == 2
+
+    def test_result_row_missing_fields_exits_2(self, tmp_path):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute"}])  # no k / mb_per_s
+        assert bt.main(["--dir", str(tmp_path)]) == 2
+
+    def test_no_files_exits_2(self, tmp_path):
+        bt = _load()
+        assert bt.main(["--dir", str(tmp_path)]) == 2
+
+    def test_all_rounds_empty_exits_2(self, tmp_path):
+        bt = _load()
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+            "n": 1, "cmd": "bench", "rc": 1, "tail": "boom", "parsed": None,
+        }))
+        assert bt.main(["--dir", str(tmp_path)]) == 2
+
+
+class TestMetricsOut:
+    def test_writes_trend_tables(self, tmp_path):
+        bt = _load()
+        out_dir = tmp_path / "metrics"
+        assert bt.main([
+            "--dir", REPO_ROOT, "--metrics-out", str(out_dir), "--json",
+        ]) == 0
+        prom = (out_dir / "bench_trend.prom").read_text()
+        assert "celestia_bench_trend_mb_per_s" in prom
+        assert 'mode="compute"' in prom
+        rows = [
+            json.loads(line)
+            for line in (out_dir / "bench_trend.jsonl").read_text().splitlines()
+        ]
+        assert any(r.get("mode") == "compute" and r.get("k") == 512 for r in rows)
+        assert any(r.get("part") == "rs_dense" for r in rows)

@@ -322,6 +322,14 @@ class TestUnifiedMetrics:
                 assert resp.status == 200
             with urllib.request.urlopen(plane.debug_url + "/healthz", timeout=10) as resp:
                 assert json.loads(resp.read())["status"] == "SERVING"
+            # ... and so does the per-tenant /namespaces summary.
+            ns_bodies = []
+            for url in (gw.url, plane.debug_url):
+                with urllib.request.urlopen(url + "/namespaces", timeout=10) as resp:
+                    assert resp.status == 200
+                    ns_bodies.append(resp.read())
+            assert ns_bodies[0] == ns_bodies[1]
+            assert "namespaces" in json.loads(ns_bodies[0])
         finally:
             gw.stop()
             plane.stop()
@@ -351,6 +359,9 @@ class TestUnifiedMetrics:
                     bodies.append(resp.read())
             assert bodies[0] == bodies[1] == bodies[2]
             assert b"celestia_block_height" in bodies[0]
+            # The data-plane families render on every plane too.
+            assert b"celestia_square_occupancy_ratio" in bodies[0]
+            assert b"celestia_square_padding_shares_total" in bodies[0]
         finally:
             server.stop()
             gw.stop()

@@ -1,0 +1,408 @@
+"""Data-plane observability: square journal accounting, per-namespace
+metrics with the top-N cardinality cap, mempool per-tenant gauges, the
+/namespaces endpoint, and the /healthz last-square snapshot.
+
+Everything here is crypto-free (builder + mempool + trace layer only),
+the same tier test_tracing.py runs in.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.mempool import PriorityMempool
+from celestia_app_tpu.shares.namespace import Namespace
+from celestia_app_tpu.shares.sparse import Blob
+from celestia_app_tpu.square import Builder, build, construct
+from celestia_app_tpu.trace import square_journal
+from celestia_app_tpu.trace.context import new_context, trace_span, use_context
+from celestia_app_tpu.trace.exposition import handle_observability_get
+from celestia_app_tpu.trace.metrics import registry
+from celestia_app_tpu.trace.tracer import traced
+from celestia_app_tpu.tx.envelopes import BlobTx
+
+RNG = np.random.default_rng(7)
+
+
+def rand_bytes(n: int) -> bytes:
+    return RNG.integers(0, 256, n, dtype=np.uint8).tobytes()
+
+
+def user_ns(tag: int) -> Namespace:
+    return Namespace.v0(bytes([tag]) * 10)
+
+
+def make_blob_tx(ns_tags: list[int], sizes: list[int]) -> bytes:
+    blobs = tuple(
+        Blob(user_ns(t), rand_bytes(s)) for t, s in zip(ns_tags, sizes)
+    )
+    return BlobTx(rand_bytes(64), blobs).marshal()
+
+
+def _metric_line(name: str, **labels) -> float | None:
+    """Sum of every series of `name` matching the label filter (the
+    registry is process-wide; series with extra labels aggregate)."""
+    total, seen = 0.0, False
+    for line in registry().render().splitlines():
+        if line.startswith(name) and all(
+            f'{k}="{v}"' in line for k, v in labels.items()
+        ):
+            total += float(line.rsplit(" ", 1)[1])
+            seen = True
+    return total if seen else None
+
+
+def _assert_sums(acct) -> None:
+    assert (
+        acct.tx_shares + acct.pfb_shares + acct.blob_shares
+        + acct.reserved_padding + acct.namespace_padding + acct.tail_padding
+        == acct.size * acct.size
+    )
+    assert acct.used_shares + acct.padding_shares == acct.total_shares
+
+
+class TestSquareAccounting:
+    def test_empty_square_is_all_tail_padding(self):
+        acct = Builder(64).export().accounting
+        assert acct.size == 1
+        assert acct.tail_padding == 1 and acct.used_shares == 0
+        assert acct.occupancy == 0.0
+        assert acct.namespaces == ()
+        _assert_sums(acct)
+
+    def test_tx_only_square_has_no_blob_buckets(self):
+        sq, kept = build([rand_bytes(40)], 64)
+        acct = sq.accounting
+        assert acct.tx_shares == 1 and acct.pfb_shares == 0
+        assert acct.blob_shares == 0
+        assert acct.reserved_padding == acct.namespace_padding == 0
+        assert acct.occupancy == 1.0  # k=1, the single share is the tx
+        _assert_sums(acct)
+
+    def test_blob_immediately_after_pfb_range(self):
+        # A one-share blob aligns to width 1: it starts right after the
+        # PFB compact range — zero reserved AND zero namespace padding.
+        sq, _ = build([make_blob_tx([1], [100])], 64)
+        acct = sq.accounting
+        assert acct.blob_shares == 1
+        assert acct.reserved_padding == 0
+        assert acct.namespace_padding == 0
+        assert acct.tail_padding == acct.total_shares - acct.used_shares
+        _assert_sums(acct)
+
+    def test_adjacent_same_namespace_blobs_zero_namespace_padding(self):
+        sq, _ = build([make_blob_tx([3, 3], [100, 100])], 64)
+        acct = sq.accounting
+        assert acct.blob_shares == 2 and acct.namespace_padding == 0
+        assert len(acct.namespaces) == 1
+        u = acct.namespaces[0]
+        assert (u.blobs, u.shares, u.data_bytes) == (2, 2, 200)
+        _assert_sums(acct)
+
+    def test_alignment_gap_counts_as_namespace_padding(self):
+        # A 1-share blob then a multi-share blob in a LATER namespace:
+        # with threshold 1 the second blob aligns to a subtree boundary,
+        # leaving a gap that must be namespace padding, never lost.
+        sq, _ = build(
+            [make_blob_tx([1], [100]), make_blob_tx([2], [4000])], 64,
+            subtree_root_threshold=1,
+        )
+        acct = sq.accounting
+        assert acct.namespace_padding > 0
+        _assert_sums(acct)
+
+    def test_reserved_padding_before_first_aligned_blob(self):
+        # Txs push the compact range past the blob's subtree boundary
+        # remainder -> an alignment gap before the FIRST blob, which is
+        # reserved padding (not namespace padding).
+        txs = [rand_bytes(300) for _ in range(2)]
+        sq, _ = build(
+            txs + [make_blob_tx([5], [4000])], 64, subtree_root_threshold=1
+        )
+        acct = sq.accounting
+        assert acct.reserved_padding > 0
+        assert acct.namespace_padding == 0
+        _assert_sums(acct)
+
+    def test_randomized_breakdowns_always_sum_to_k_squared(self):
+        for seed in range(12):
+            rng = np.random.default_rng(seed)
+            txs = []
+            for _ in range(int(rng.integers(0, 4))):
+                txs.append(rng.integers(0, 256, 80, dtype=np.uint8).tobytes())
+            for _ in range(int(rng.integers(0, 5))):
+                tags = [int(t) for t in rng.integers(1, 6, rng.integers(1, 3))]
+                sizes = [int(s) for s in rng.integers(1, 3000, len(tags))]
+                txs.append(make_blob_tx(tags, sizes))
+            sq, kept = build(txs, 32)
+            _assert_sums(sq.accounting)
+            if kept:
+                _assert_sums(construct(kept, 32).accounting)
+
+    def test_build_and_construct_agree_on_accounting(self):
+        raw = [rand_bytes(64), make_blob_tx([1], [900]), make_blob_tx([2], [40])]
+        sq, kept = build(raw, 64)
+        assert construct(kept, 64).accounting == sq.accounting
+
+
+class TestSquareJournal:
+    def setup_method(self):
+        square_journal._reset_for_tests()
+
+    def test_row_per_phase_with_trace_id_and_exact_sums(self):
+        ctx = new_context(layer="block", height=9)
+        n_before = len(traced().table(square_journal.TABLE))
+        with use_context(ctx):
+            sq, kept = build([make_blob_tx([1, 2], [500, 1200])], 64)
+            construct(kept, 64)
+        rows = traced().table(square_journal.TABLE)[n_before:]
+        assert [r["phase"] for r in rows] == ["build", "construct"]
+        for row in rows:
+            assert row["trace_id"] == ctx.trace_id
+            assert row["height"] == 9
+            assert (
+                row["tx_shares"] + row["pfb_shares"] + row["blob_shares"]
+                + row["reserved_padding"] + row["namespace_padding"]
+                + row["tail_padding"]
+                == row["k"] * row["k"] == row["total_shares"]
+            )
+            assert row["n_namespaces"] == 2
+            assert set(row["namespaces"]) == {
+                square_journal.namespace_label(user_ns(1).to_bytes()),
+                square_journal.namespace_label(user_ns(2).to_bytes()),
+            }
+
+    def test_metrics_reflect_the_square(self):
+        sq, _ = build([make_blob_tx([4], [600])], 64)
+        acct = sq.accounting
+        assert _metric_line(
+            "celestia_square_occupancy_ratio", k=str(acct.size)
+        ) == pytest.approx(acct.occupancy, abs=1e-6)
+        for kind in ("reserved", "namespace", "tail"):
+            assert _metric_line(
+                "celestia_square_padding_shares_total", kind=kind
+            ) is not None
+        lbl = square_journal.namespace_label(user_ns(4).to_bytes())
+        assert _metric_line(
+            "celestia_namespace_blobs_total", namespace=lbl
+        ) >= 1
+        assert _metric_line(
+            "celestia_namespace_bytes_total", namespace=lbl
+        ) >= 600
+        assert _metric_line(
+            "celestia_namespace_shares_total", namespace=lbl
+        ) >= acct.namespaces[0].shares
+
+    def test_label_cardinality_is_capped(self, monkeypatch):
+        monkeypatch.setenv("CELESTIA_NAMESPACE_TOP_N", "2")
+        square_journal._reset_for_tests()
+        other_before = _metric_line(
+            "celestia_namespace_blobs_total",
+            namespace=square_journal.OTHER_LABEL,
+        ) or 0
+        # One square with 4 tenants: the two biggest get labels, the
+        # rest fold into `other`.
+        build([make_blob_tx([t], [s]) for t, s in
+               zip((11, 12, 13, 14), (4000, 3000, 100, 100))], 64)
+        admitted = {
+            square_journal.capped_namespace_label(
+                square_journal.namespace_label(user_ns(t).to_bytes())
+            )
+            for t in (11, 12, 13, 14)
+        }
+        assert square_journal.OTHER_LABEL in admitted
+        assert len(admitted - {square_journal.OTHER_LABEL}) == 2
+        # The biggest tenants won the slots.
+        assert square_journal.capped_namespace_label(
+            square_journal.namespace_label(user_ns(11).to_bytes())
+        ) != square_journal.OTHER_LABEL
+        assert _metric_line(
+            "celestia_namespace_blobs_total",
+            namespace=square_journal.OTHER_LABEL,
+        ) == other_before + 2
+        # New tenants later never mint new labels.
+        build([make_blob_tx([15], [50])], 64)
+        assert square_journal.capped_namespace_label(
+            square_journal.namespace_label(user_ns(15).to_bytes())
+        ) == square_journal.OTHER_LABEL
+
+    def test_namespaces_endpoint_and_payload(self):
+        build([make_blob_tx([6], [300])], 64)
+        resp = handle_observability_get("/namespaces")
+        assert resp is not None and resp[0] == 200
+        payload = json.loads(resp[2])
+        assert payload == square_journal.namespaces_payload()
+        lbl = square_journal.namespace_label(user_ns(6).to_bytes())
+        assert payload["namespaces"][lbl]["bytes"] >= 300
+        assert payload["last_square"]["k"] >= 1
+        assert payload["top_n"] >= payload["admitted"]
+
+    def test_last_square_distinguishes_empty_blocks(self):
+        assert square_journal.last_square() is None
+        Builder(64).export()  # export alone doesn't journal (no phase)
+        assert square_journal.last_square() is None
+        build([], 16)
+        last = square_journal.last_square()
+        assert last["occupancy"] == 0.0 and last["phase"] == "build"
+        build([make_blob_tx([7], [100])], 64)
+        assert square_journal.last_square()["occupancy"] > 0.0
+
+    def test_snapshot_survives_trace_off(self, monkeypatch):
+        monkeypatch.setenv("CELESTIA_TRACE", "off")
+        square_journal._reset_for_tests()
+        n_before = len(traced().table(square_journal.TABLE))
+        build([make_blob_tx([8], [100])], 64)
+        # No row, no metrics — but the liveness snapshot still updates.
+        assert len(traced().table(square_journal.TABLE)) == n_before
+        assert square_journal.last_square() is not None
+
+
+class TestMempoolNamespaceAccounting:
+    def setup_method(self):
+        square_journal._reset_for_tests()
+
+    def _gauges(self, lbl):
+        return (
+            _metric_line("celestia_mempool_namespace_txs", namespace=lbl),
+            _metric_line(
+                "celestia_mempool_namespace_size_bytes", namespace=lbl
+            ),
+        )
+
+    def test_insert_and_commit_reconcile(self):
+        mp = PriorityMempool()
+        blob_tx = make_blob_tx([21], [100])
+        lbl = square_journal.tx_namespace_label(blob_tx)
+        assert lbl == square_journal.namespace_label(user_ns(21).to_bytes())
+        assert mp.insert(blob_tx, 10, 0)
+        assert mp.insert(b"\x01" * 16, 5, 0)  # normal tx -> `tx` bucket
+        assert self._gauges(lbl) == (1, len(blob_tx))
+        assert self._gauges("tx") == (1, 16)
+        mp.update(1, [blob_tx])  # committed drop
+        assert self._gauges(lbl) == (0, 0)
+        assert self._gauges("tx") == (1, 16)
+
+    def test_all_three_eviction_paths_decrement(self):
+        mp = PriorityMempool(max_pool_bytes=600, ttl_num_blocks=2)
+        txs = {t: make_blob_tx([t], [20]) for t in (31, 32, 33)}
+        lbls = {
+            t: square_journal.tx_namespace_label(raw)
+            for t, raw in txs.items()
+        }
+        assert all(mp.insert(raw, t, 0) for t, raw in txs.items())
+        size = len(txs[31])
+        assert self._gauges(lbls[31]) == (1, size)
+
+        # priority eviction: a big high-priority tx evicts ONLY the
+        # lowest-priority resident (sizes tuned so one eviction fits).
+        big = make_blob_tx([34], [180])
+        assert mp.insert(big, 99, 1)
+        assert mp.has_tx(txs[32]) and mp.has_tx(txs[33])
+        assert not mp.has_tx(txs[31])
+        assert self._gauges(lbls[31]) == (0, 0)
+        assert _metric_line(
+            "celestia_mempool_evictions_total",
+            reason="priority", namespace=lbls[31],
+        ) == 1
+
+        # recheck eviction.
+        mp.remove_tx(txs[32])
+        assert self._gauges(lbls[32]) == (0, 0)
+        assert _metric_line(
+            "celestia_mempool_evictions_total",
+            reason="recheck", namespace=lbls[32],
+        ) == 1
+
+        # ttl expiry (update()'s expired drop): tx 33 (height 0) ages
+        # out at height 2; `big` (height 1) survives.
+        mp.update(2, [])
+        assert len(mp) == 1 and mp.has_tx(big)
+        assert self._gauges(lbls[33]) == (0, 0)
+        assert _metric_line(
+            "celestia_mempool_evictions_total",
+            reason="ttl", namespace=lbls[33],
+        ) == 1
+        for t, raw in txs.items():
+            assert self._gauges(lbls[t]) == (0, 0)
+        assert self._gauges(square_journal.tx_namespace_label(big)) == (
+            1, len(big),
+        )
+
+    def test_infeasible_insert_evicts_nothing(self):
+        # A(prio 1, small) + B(prio 9, big) fill the pool; C(prio 5)
+        # cannot fit even after evicting A because B outranks it — the
+        # old one-at-a-time loop destroyed A anyway, admitted nothing,
+        # and ticked a priority eviction for it.
+        a, b = make_blob_tx([61], [20]), make_blob_tx([62], [260])
+        c = make_blob_tx([63], [40])
+        mp = PriorityMempool(max_pool_bytes=len(a) + len(b))
+        assert mp.insert(a, 1, 0) and mp.insert(b, 9, 0)
+        assert not mp.insert(c, 5, 0)
+        assert mp.has_tx(a) and mp.has_tx(b) and len(mp) == 2
+        assert _metric_line(
+            "celestia_mempool_evictions_total",
+            reason="priority",
+            namespace=square_journal.tx_namespace_label(a),
+        ) is None
+
+    def test_capped_tenants_share_the_other_bucket(self, monkeypatch):
+        monkeypatch.setenv("CELESTIA_NAMESPACE_TOP_N", "1")
+        square_journal._reset_for_tests()
+        mp = PriorityMempool()
+        a, b, c = (make_blob_tx([t], [30]) for t in (41, 42, 43))
+        assert mp.insert(a, 1, 0) and mp.insert(b, 2, 0) and mp.insert(c, 3, 0)
+        # First tenant took the only slot; the other two SUM into `other`.
+        assert self._gauges(square_journal.OTHER_LABEL) == (
+            2, len(b) + len(c),
+        )
+
+
+class TestE2eNamespaceView:
+    def setup_method(self):
+        square_journal._reset_for_tests()
+
+    def test_namespace_baggage_labels_request_scoped_phases(self):
+        ctx = new_context(layer="rpc").child(namespace="abc123")
+        with use_context(ctx):
+            with trace_span("ns_e2e_probe", e2e="submit"):
+                pass
+        assert _metric_line(
+            "celestia_e2e_seconds_count", phase="submit", namespace="abc123"
+        ) == 1
+
+    def test_block_scoped_phases_never_carry_the_tenant(self):
+        # The block adopts the first reaped tx's context, so its baggage
+        # holds that tenant's namespace — but propose/commit measure the
+        # WHOLE block and must stay unlabeled (billing a shared block to
+        # the first-reaped tenant would fragment the phase series).
+        ctx = new_context(layer="block").child(namespace="def456", height=3)
+        with use_context(ctx):
+            with trace_span("ns_block_probe", e2e="propose"):
+                pass
+            with trace_span("ns_block_probe2", e2e="commit"):
+                pass
+        for phase in ("propose", "commit"):
+            assert _metric_line(
+                "celestia_e2e_seconds_count", phase=phase, namespace="def456"
+            ) is None
+            assert _metric_line(
+                "celestia_e2e_seconds_count", phase=phase
+            ) >= 1
+
+    def test_mempool_wait_and_total_carry_the_namespace(self):
+        mp = PriorityMempool()
+        raw = make_blob_tx([51], [40])
+        lbl = square_journal.tx_namespace_label(raw)
+        ctx = new_context(layer="rpc").child(namespace=lbl)
+        assert mp.insert(raw, 1, 0, ctx=ctx)
+        mp.reap()
+        assert _metric_line(
+            "celestia_e2e_seconds_count", phase="mempool_wait", namespace=lbl
+        ) == 1
+        mp.update(1, [raw])
+        assert _metric_line(
+            "celestia_e2e_seconds_count", phase="total", namespace=lbl
+        ) == 1

@@ -39,12 +39,17 @@ def _spans_for(trace_id: str) -> list[dict]:
 
 
 def _metric_line(name: str, **labels) -> float | None:
+    """Sum of every series of `name` matching the label filter (series
+    carrying EXTRA labels — e.g. the per-namespace e2e/eviction children
+    — aggregate instead of shadowing the unlabeled one)."""
+    total, seen = 0.0, False
     for line in registry().render().splitlines():
         if line.startswith(name) and all(
             f'{k}="{v}"' in line for k, v in labels.items()
         ):
-            return float(line.rsplit(" ", 1)[1])
-    return None
+            total += float(line.rsplit(" ", 1)[1])
+            seen = True
+    return total if seen else None
 
 
 class TestTraceContext:
