@@ -1,0 +1,99 @@
+"""Chaos seams + graceful degradation (see chaos/spec.py, chaos/degrade.py).
+
+Hot paths call the module-level seam helpers below; with no chaos
+configured each is one cached-injector check (no env parse, no RNG draw),
+so the seams cost nothing in production.
+
+Activation, in precedence order:
+  * `install(spec_str)` — programmatic (tests, scripts/chaos_soak.py);
+  * `$CELESTIA_CHAOS`    — the env spec, re-parsed when the string changes
+    so a test flipping the env mid-process takes effect.
+
+`uninstall()` drops a programmatic install; `reset_for_tests()` (from
+chaos.degrade) additionally re-arms the breaker and ladder.
+"""
+
+from __future__ import annotations
+
+import os
+
+from celestia_app_tpu.chaos.spec import (  # noqa: F401  (public surface)
+    SEAMS,
+    ChaosInjected,
+    ChaosInjector,
+    parse_spec,
+    validate_params,
+)
+
+_INSTALLED: ChaosInjector | None = None
+# (raw env string, injector-or-None) — the parse cache for the env path.
+_ENV_CACHE: tuple[str, ChaosInjector | None] = ("", None)
+
+
+def install(spec: str | dict) -> ChaosInjector:
+    """Install a chaos spec for this process (overrides $CELESTIA_CHAOS)."""
+    global _INSTALLED
+    # Both activation shapes get key validation — a typo'd fault name in
+    # a dict must fail as loudly as one in the env string.
+    params = (
+        parse_spec(spec) if isinstance(spec, str)
+        else validate_params(dict(spec))
+    )
+    _INSTALLED = ChaosInjector(
+        params, raw=spec if isinstance(spec, str) else ""
+    )
+    return _INSTALLED
+
+
+def uninstall() -> None:
+    global _INSTALLED
+    _INSTALLED = None
+
+
+def injector() -> ChaosInjector | None:
+    """The active injector, or None when no chaos is configured."""
+    global _ENV_CACHE
+    if _INSTALLED is not None:
+        return _INSTALLED
+    raw = os.environ.get("CELESTIA_CHAOS", "")
+    cached_raw, cached = _ENV_CACHE
+    if raw == cached_raw:
+        return cached
+    inj = ChaosInjector(parse_spec(raw), raw=raw) if raw.strip() else None
+    _ENV_CACHE = (raw, inj)
+    return inj
+
+
+# --- seam helpers (the names hot paths import) ------------------------------
+
+def device_dispatch(mode: str) -> None:
+    inj = injector()
+    if inj is not None:
+        inj.device_dispatch(mode)
+
+
+def device_upload() -> None:
+    inj = injector()
+    if inj is not None:
+        inj.device_upload()
+
+
+def gossip_send() -> dict:
+    inj = injector()
+    return inj.gossip_send() if inj is not None else {}
+
+
+def wal_torn_tail() -> bytes | None:
+    inj = injector()
+    return inj.wal_torn_tail() if inj is not None else None
+
+
+def rpc_handle() -> None:
+    inj = injector()
+    if inj is not None:
+        inj.rpc_handle()
+
+
+def mempool_insert() -> bool:
+    inj = injector()
+    return inj.mempool_insert() if inj is not None else False

@@ -265,16 +265,35 @@ def cmd_start(args) -> int:
         from celestia_app_tpu.rpc.client import RemoteNode
 
         for peer_url in peers:
+            # Bounded exponential backoff with deterministic jitter: a
+            # peer that takes a minute to warm its jit cache should not be
+            # hammered 5x/second the whole time, and when the wait DOES
+            # time out the operator sees the last underlying error (DNS?
+            # connection refused? a 500?) instead of a bare deadline.
             peer = RemoteNode(peer_url, defer_status=True, timeout=2.0)
             deadline = time.time() + 120
+            delay, attempt, last_err = 0.2, 0, None
             while True:
                 try:
                     peer.status()
                     break
-                except Exception:
+                except Exception as e:  # chaos-ok: peer warm-up probe loop
+                    last_err = e
                     if time.time() > deadline:
-                        raise TimeoutError(f"peer {peer_url} never came up")
-                    time.sleep(0.2)
+                        raise TimeoutError(
+                            f"peer {peer_url} never came up after "
+                            f"{attempt + 1} attempts "
+                            f"(last error: {type(e).__name__}: {e})"
+                        ) from e
+                    import hashlib
+
+                    digest = hashlib.sha256(
+                        f"{peer_url}:{attempt}".encode()
+                    ).digest()
+                    jitter = 0.25 * delay * (digest[0] / 255.0)
+                    time.sleep(min(delay + jitter, 5.0))
+                    delay = min(delay * 2, 5.0)
+                    attempt += 1
         driver.start()
         print(f"gossip consensus started (wal: {wal_path})", flush=True)
         last_saved = app.height

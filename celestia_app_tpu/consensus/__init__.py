@@ -1,13 +1,11 @@
-from celestia_app_tpu.consensus.votes import (
-    PRECOMMIT,
-    PREVOTE,
-    Commit,
-    ConsensusError,
-    Vote,
-    VoteSet,
-    block_id,
-    verify_commit,
-)
+"""Consensus: Tendermint round machine, vote wire types, WAL.
+
+Lazy exports (the rpc/__init__ pattern): the vote types pull in the
+signing backend's optional `cryptography` dependency, but the WAL
+(consensus/wal.py, double-sign protection) and the round journal are
+crypto-free — a slim image's crash-restart and chaos drills must reach
+`celestia_app_tpu.consensus.wal` without paying the signing import.
+"""
 
 __all__ = [
     "Commit",
@@ -19,3 +17,11 @@ __all__ = [
     "block_id",
     "verify_commit",
 ]
+
+
+def __getattr__(name: str):
+    if name in __all__:
+        from celestia_app_tpu.consensus import votes
+
+        return getattr(votes, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -36,34 +36,108 @@ class VoteWAL:
         # Cumulative append+fsync wall time: the round journal reads the
         # delta per round (consensus/machine.RoundJournal.fsync_ms_source).
         self.fsync_ms_total = 0.0
+        # Torn-tail bookkeeping: bytes dropped by the replay salvage, and
+        # whether an INJECTED torn tail (chaos wal.append seam) currently
+        # sits past _offset on disk awaiting the next append's self-heal.
+        self.salvaged_bytes = 0
+        self._torn = False
         self._load()
         self._fh = open(path, "a", buffering=1)
+        self._offset = self._fh.tell()  # end of the last complete record
 
     def _load(self) -> None:
+        """Replay the journal, salvaging a torn tail.
+
+        A crash mid-append leaves a partial final record (often without
+        its newline).  Replay keeps every COMPLETE fsync'd record and
+        truncates the torn bytes away — without the truncate, the append
+        handle would write the next record onto the tail of the fragment
+        and corrupt BOTH (the record a later restart then fails to
+        replay is exactly the one double-sign protection needed).
+        Mid-file garbage (a corrupted but newline-terminated line) is
+        skipped, never truncated: records after it are still valid.
+
+        The torn record itself is safely LOST, not violated: its vote was
+        never broadcast (may_sign records durably BEFORE the caller
+        signs), so forgetting it can at worst re-sign the same
+        coordinates later — the idempotent case, never an equivocation.
+        """
         if not os.path.exists(self.path):
             os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
             return
-        with open(self.path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    continue  # torn tail write from a crash: ignore
+        with open(self.path, "rb") as f:
+            data = f.read()
+        pos = 0
+        good = 0  # offset just past the last complete (newline'd) line
+        # Split strictly on b"\n" — the only terminator _append writes.
+        # bytes.splitlines() also splits on bare \r, which would make
+        # mid-file garbage CONTAINING a carriage return look like a torn
+        # tail and truncate every later (valid, durably fsync'd) record:
+        # exactly the double-sign window this journal exists to close.
+        while pos < len(data):
+            nl = data.find(b"\n", pos)
+            if nl == -1:
+                break  # torn tail: no terminator — everything past `good` goes
+            line = data[pos:nl]
+            pos = nl + 1
+            stripped = line.strip()
+            if not stripped:
+                good = pos
+                continue
+            try:
+                rec = json.loads(stripped)
                 if rec.get("k") == "vote":
                     self.votes[(rec["h"], rec["r"], rec["t"])] = rec["b"]
                 elif rec.get("k") == "lock":
                     self.locks[rec["h"]] = (rec["r"], rec["b"])
+            except (json.JSONDecodeError, KeyError, TypeError,
+                    AttributeError):
+                # Mid-file garbage: skip the record, keep walking.  The
+                # broad net matters — `123` or `null` parse fine and then
+                # fail attribute/key access, and a replay that CRASHES on
+                # corruption is the failure mode this path exists to
+                # survive.
+                continue
+            good = pos
+        if good < len(data):
+            self.salvaged_bytes = len(data) - good
+            os.truncate(self.path, good)
+            self._note_salvage("replay", self.salvaged_bytes)
+
+    @staticmethod
+    def _note_salvage(where: str, dropped: int) -> None:
+        from celestia_app_tpu.chaos.degrade import recoveries
+        from celestia_app_tpu.trace.tracer import traced
+
+        recoveries().inc(seam="wal.append", outcome="salvaged")
+        traced().write("wal_salvage", where=where, dropped_bytes=dropped)
 
     def _append(self, rec: dict) -> None:
+        from celestia_app_tpu import chaos
+
+        if self._torn:
+            # A prior injected torn tail sits past _offset: heal exactly
+            # the way a restart would, by truncating to the last complete
+            # record before writing anything new.
+            self._fh.truncate(self._offset)
+            self._torn = False
+            self._note_salvage("append", 0)
         t0 = time.perf_counter()
         self._fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
         self._fh.flush()
         os.fsync(self._fh.fileno())
+        self._offset = self._fh.tell()
         elapsed = time.perf_counter() - t0
         self.fsync_ms_total += elapsed * 1e3
+        frag = chaos.wal_torn_tail()
+        if frag is not None:
+            # The chaos seam: durably tear the tail (a crash mid-write of
+            # the NEXT record) so replay/self-heal have something real to
+            # salvage.  _offset deliberately not advanced.
+            self._fh.write(frag.decode())
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._torn = True
         # The fsync sits on the vote-signing path: its latency is a direct
         # input to round time, so it gets its own histogram.
         from celestia_app_tpu.trace.metrics import DEVICE_SECONDS_BUCKETS, registry
@@ -116,6 +190,12 @@ class VoteWAL:
         """
         self.votes = {k: v for k, v in self.votes.items() if k[0] >= below_height}
         self.locks = {h: v for h, v in self.locks.items() if h >= below_height}
+        if self._torn:
+            # Heal an injected torn tail before the handle swap: a failed
+            # rewrite keeps the ORIGINAL file, whose offset bookkeeping
+            # must stay truthful for the next append.
+            self._fh.truncate(self._offset)
+            self._torn = False
         self._fh.close()
         tmp = self.path + ".tmp"
         try:

@@ -97,6 +97,20 @@ def _jit_pipeline(k: int, construction: str):
     return jax.jit(_pipeline(k, construction))
 
 
+@lru_cache(maxsize=None)
+def _host_pipeline(k: int, construction: str):
+    """The degradation floor: the staged composition executed EAGERLY —
+    no jitted program, every op its own dispatch.  Slow, but it removes
+    compiled-program execution from the failure surface entirely, and it
+    is bit-identical to both jitted lowerings (same ops, same order)."""
+    fn = _pipeline(k, construction)
+
+    def run(ods):
+        return fn(jnp.asarray(ods))
+
+    return run
+
+
 def pipeline_cache_state(
     k: int, construction: str | None = None, *, owned: bool = False
 ) -> str:
@@ -106,8 +120,11 @@ def pipeline_cache_state(
     from celestia_app_tpu.kernels.fused import is_built, pipeline_mode
 
     construction = construction or active_construction()
-    if pipeline_mode() == "fused":
+    mode = pipeline_mode()
+    if mode == "fused":
         return "hit" if is_built(k, construction, donate=owned) else "miss"
+    if mode == "host":
+        return "hit"  # eager: nothing compiles, nothing can miss
     return "hit" if (k, construction) in _STAGED_BUILT else "miss"
 
 
@@ -126,8 +143,22 @@ def jit_pipeline(k: int, construction: str | None = None):
     from celestia_app_tpu.kernels.fused import jit_extend_and_dah, pipeline_mode
 
     construction = construction or active_construction()
-    if pipeline_mode() == "fused":
-        return jit_extend_and_dah(k, construction)
+    return _pipeline_for_mode(pipeline_mode(), k, construction, owned=False)
+
+
+def _pipeline_for_mode(
+    mode: str, k: int, construction: str | None = None, *, owned: bool = False
+):
+    """Resolve the pipeline callable for an EXPLICIT mode — the ladder-
+    and retry-aware dispatch path (chaos/degrade.guarded_dispatch) re-
+    resolves through here when the mode moves mid-retry."""
+    from celestia_app_tpu.kernels.fused import jit_extend_and_dah
+
+    construction = construction or active_construction()
+    if mode == "fused":
+        return jit_extend_and_dah(k, construction, donate=owned)
+    if mode == "host":
+        return _host_pipeline(k, construction)
     return _jit_pipeline(k, construction)
 
 
@@ -136,11 +167,9 @@ def _owned_input_pipeline(k: int, construction: str | None = None):
     upload): the donating fused program when the seam says fused, the
     staged jit otherwise.  compute() and warmup() both resolve through
     here so a server's warmed compile is exactly the one its blocks run."""
-    from celestia_app_tpu.kernels.fused import jit_extend_and_dah, pipeline_mode
+    from celestia_app_tpu.kernels.fused import pipeline_mode
 
-    if pipeline_mode() == "fused":
-        return jit_extend_and_dah(k, construction, donate=True)
-    return jit_pipeline(k, construction)
+    return _pipeline_for_mode(pipeline_mode(), k, construction, owned=True)
 
 
 def warmup(
@@ -268,7 +297,7 @@ def _parity_check(ods_host, k: int, construction: str, droot) -> None:
             "parity_mismatch", k=k, construction=construction,
             served=served_root.hex(), staged=staged_root.hex(),
         )
-    except Exception as e:  # noqa: BLE001 — the sentinel must never raise
+    except Exception as e:  # chaos-ok: the sentinel must never raise
         checks.inc(result="error")
         traced().write(
             "parity_mismatch", k=k, construction=construction,
@@ -308,11 +337,12 @@ class ExtendedDataSquare:
         from celestia_app_tpu.kernels.fused import pipeline_mode
         from celestia_app_tpu.trace import journal
 
+        from celestia_app_tpu.chaos.degrade import guarded_dispatch
+
         k = ods.shape[0]
         if k & (k - 1) or not 1 <= k <= MAX_CODEC_SQUARE_SIZE:
             raise ValueError(f"invalid square size {k}")
         assert ods.shape == (k, k, SHARE_SIZE), ods.shape
-        mode = pipeline_mode()
         sentinel_input = None  # a buffer still valid AFTER the dispatch
         if isinstance(ods, jax.Array):
             # jnp.asarray is a no-copy pass-through for a device array, so
@@ -322,7 +352,9 @@ class ExtendedDataSquare:
                 ods = jnp.asarray(ods, dtype=jnp.uint8)
             state = pipeline_cache_state(k, construction)
             t0 = time.perf_counter()
-            eds, rr, cr, droot = jit_pipeline(k, construction)(ods)
+            mode, (eds, rr, cr, droot) = guarded_dispatch(
+                lambda m: _pipeline_for_mode(m, k, construction), ods
+            )
             journal.record(
                 "compute", k, mode=mode, compile=state,
                 dispatch_ms=(time.perf_counter() - t0) * 1e3,
@@ -331,11 +363,17 @@ class ExtendedDataSquare:
         else:
             # The upload below is this call's own buffer, never read again
             # — the donating pipeline may reuse it as extension scratch.
+            # A retry after a REAL mid-dispatch failure re-uploads from
+            # the host copy, so donation never poisons the retry.
             state = pipeline_cache_state(k, construction, owned=True)
             t0 = time.perf_counter()
             x = jnp.asarray(ods, dtype=jnp.uint8)
             t1 = time.perf_counter()
-            eds, rr, cr, droot = _owned_input_pipeline(k, construction)(x)
+            mode, (eds, rr, cr, droot) = guarded_dispatch(
+                lambda m: _pipeline_for_mode(m, k, construction, owned=True),
+                x,
+                refresh=lambda: jnp.asarray(ods, dtype=jnp.uint8),
+            )
             journal.record(
                 "compute", k, mode=mode, compile=state,
                 upload_ms=(t1 - t0) * 1e3,
@@ -453,7 +491,7 @@ def _reset_bridge() -> None:
     if client is not None:
         try:
             client.shutdown()
-        except Exception:
+        except Exception:  # chaos-ok: tearing down an already-dead worker
             pass
 
 
@@ -472,7 +510,7 @@ def _try_bridge_extend(ods: np.ndarray) -> ExtendedDataSquare | None:
         return ExtendedDataSquare(
             eds, rr, cr, np.frombuffer(droot, dtype=np.uint8), k
         )
-    except Exception as e:  # noqa: BLE001 — any bridge fault -> device path
+    except Exception as e:  # chaos-ok: any bridge fault -> device path
         print(f"square bridge fault ({e}); falling back to device pipeline",
               file=sys.stderr)
         _reset_bridge()

@@ -66,14 +66,29 @@ def _silence_unusable_donation_warning() -> None:
 
 
 def pipeline_mode() -> str:
-    """The active extend+DAH lowering: "fused" (default) or "staged".
+    """The active extend+DAH lowering: "fused" (default), "staged", or
+    "host" (all three bit-identical).
 
     $CELESTIA_PIPE_FUSED: "on" / "off" / "auto" (default).  Auto is fused —
     the fused program is bit-identical to the staged pair (pinned on the
     golden vectors) and at worst matches it, so the staged path exists as a
     bench A/B candidate and an escape hatch, not a default.  The bench
     autotuner flips this env for the rows the staged pair wins.
+
+    The env choice is then floored by the degradation ladder
+    (chaos/degrade.py): a process whose device dispatches keep failing is
+    stepped fused -> staged -> host by the circuit breaker, and because
+    every caller routes through here, all of them move together.
     """
+    from celestia_app_tpu.chaos.degrade import effective_device_mode
+
+    return effective_device_mode(env_base_mode())
+
+
+def env_base_mode() -> str:
+    """The env-selected base lowering, WITHOUT the degradation ladder
+    applied — the single parse of $CELESTIA_PIPE_FUSED (the ladder steps
+    relative to this, so two copies of the branch must never diverge)."""
     return "staged" if os.environ.get("CELESTIA_PIPE_FUSED", "auto") == "off" else "fused"
 
 

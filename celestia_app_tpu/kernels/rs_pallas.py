@@ -41,7 +41,7 @@ _TC = 256  # symbol-columns per grid step (lane axis)
 try:  # pallas imports fail on backends without Mosaic; callers gate on TPU
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
-except Exception:  # pragma: no cover - jax always ships pallas today
+except Exception:  # pragma: no cover — chaos-ok: jax always ships pallas today
     pl = None
     pltpu = None
 

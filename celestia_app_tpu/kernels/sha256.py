@@ -385,7 +385,7 @@ def pl_missing() -> bool:
         from jax.experimental import pallas  # noqa: F401
 
         return False
-    except Exception:  # pragma: no cover
+    except Exception:  # pragma: no cover — chaos-ok: probe-only fallback
         return True
 
 
@@ -407,7 +407,7 @@ def _use_pallas(n: int) -> bool:
         # platform "tpu" — keying on the backend name would silently leave
         # the Pallas kernel disabled on the real chip.
         platform = jax.devices()[0].platform
-    except Exception:  # noqa: BLE001 — no backend: host-side tracing only
+    except Exception:  # chaos-ok: no backend: host-side tracing only
         return False
     return platform == "tpu" and n >= 4 * _LANE_TILE
 

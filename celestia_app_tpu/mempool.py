@@ -142,6 +142,7 @@ class PriorityMempool:
         TraceContext (defaults to the thread's current one); `ns` is the
         tx's already-resolved namespace label, when the caller (the
         broadcast path) parsed the tx anyway."""
+        from celestia_app_tpu import chaos
         from celestia_app_tpu.trace.context import current_context, trace_span
 
         if ctx is None:
@@ -150,8 +151,16 @@ class PriorityMempool:
             "mempool_insert", ctx=ctx, layer="mempool",
             tx_bytes=len(tx), height=height,
         ) as sp:
-            ok = self._insert(tx, priority, height, ctx, ns)
-            sp["result"] = "inserted" if ok else "rejected"
+            # Chaos mempool.insert seam: a transient admission drop — the
+            # submitter's retry (or the gossip flood re-offering the tx)
+            # is what gets it in, which is exactly the robustness a lossy
+            # admission path requires.
+            if chaos.mempool_insert():
+                sp["result"] = "chaos_dropped"
+                ok = False
+            else:
+                ok = self._insert(tx, priority, height, ctx, ns)
+                sp["result"] = "inserted" if ok else "rejected"
         self._refresh_gauges()
         return ok
 

@@ -47,13 +47,26 @@ import numpy as np
 
 from celestia_app_tpu.da.eds import (
     ExtendedDataSquare,
-    _owned_input_pipeline,
+    _pipeline_for_mode,
     pipeline_cache_state,
 )
 from celestia_app_tpu.gf.rs import active_construction
 from celestia_app_tpu.trace import journal
 
 _SENTINEL = object()
+
+#: Transient-upload retry budget (chaos upload_fail / a flaky transfer
+#: link): attempts per block before the pipeline declares the feeder dead.
+_UPLOAD_RETRIES = 2
+#: Poll interval for the deadline-aware queue waits: every bounded put/get
+#: wakes this often to check worker liveness, so a dead stage is reported
+#: instead of wedging the caller forever.
+_POLL_S = 0.1
+#: close()'s inactivity window before a still-alive worker is declared
+#: wedged: long enough for a cold large-k jit compile to finish (the
+#: slow-but-healthy case), short enough that an abandoned process isn't
+#: parked behind a dead device forever.
+_CLOSE_STALL_S = 60.0
 
 
 def _queue_depth_gauge():
@@ -62,6 +75,15 @@ def _queue_depth_gauge():
     return registry().gauge(
         "celestia_pipeline_queue_depth",
         "blocks resident per block-pipeline hand-off queue",
+    )
+
+
+def _close_leak_counter():
+    from celestia_app_tpu.trace.metrics import registry
+
+    return registry().counter(
+        "celestia_pipeline_close_leaked_total",
+        "pipeline worker threads still alive after close()'s join timeout",
     )
 
 
@@ -98,8 +120,14 @@ class BlockPipeline:
         )
         # The pipeline owns each uploaded buffer and uses it exactly once,
         # so it rides the owned-input entry: the donating fused program by
-        # default, the staged jit when the seam says staged.
-        self._pipe = _owned_input_pipeline(k, self.construction)
+        # default, the staged jit when the seam says staged.  Resolved per
+        # MODE so the dispatcher can follow the degradation ladder
+        # mid-stream (chaos/degrade.guarded_dispatch re-resolves after a
+        # breaker trip).
+        self._pipe_mode = self._mode
+        self._pipe = _pipeline_for_mode(
+            self._mode, k, self.construction, owned=True
+        )
         # submit -> _tasks -> [uploader: device_put] -> _staged
         #        -> [dispatcher: program dispatch] -> _done
         # _tasks/_done bounded by depth: at most `depth` squares in flight
@@ -121,6 +149,23 @@ class BlockPipeline:
         self._dispatcher.start()
 
     def _upload(self) -> None:
+        """Uploader thread body.  The inner loop handles per-block faults
+        (store the error, forward the sentinel); the outer wrap catches
+        anything that escapes the loop itself, so a worker can die wedged
+        but never die SILENT — submit()/drain() raise the stored
+        exception instead of hanging behind a thread that no longer
+        exists."""
+        try:
+            self._upload_loop()
+        except BaseException as e:  # chaos-ok: worker death must be loud
+            if self._error is None:
+                self._error = e
+            self._force_sentinel(self._staged)
+
+    def _upload_loop(self) -> None:
+        from celestia_app_tpu import chaos
+        from celestia_app_tpu.chaos.degrade import recoveries
+
         failed = False
         while True:
             item = self._tasks.get()
@@ -132,9 +177,19 @@ class BlockPipeline:
             ods, tag = item
             try:
                 t0 = time.perf_counter()
-                x = jax.device_put(np.ascontiguousarray(ods))
+                for attempt in range(_UPLOAD_RETRIES + 1):
+                    try:
+                        chaos.device_upload()  # injected stall/failure
+                        x = jax.device_put(np.ascontiguousarray(ods))
+                        break
+                    except Exception:  # chaos-ok: bounded upload retry
+                        if attempt == _UPLOAD_RETRIES:
+                            raise
+                        time.sleep(0.002 * (2 ** attempt))
+                if attempt:
+                    recoveries().inc(seam="device.upload", outcome="retried")
                 t1 = time.perf_counter()
-            except BaseException as e:  # surfaced on the next drain
+            except BaseException as e:  # chaos-ok: stored, surfaced on the next drain
                 self._error = e
                 self._staged.put(_SENTINEL)
                 failed = True
@@ -146,11 +201,54 @@ class BlockPipeline:
             # dispatch later, so the read always sees the value in
             # practice — and the row falls back to 0.0, never a missing
             # field, if this thread were descheduled that whole time.
+            # The host buffer rides along so a failed DONATED dispatch can
+            # re-upload (guarded_dispatch's refresh) — one extra reference
+            # per staged block, dropped the moment the dispatch lands.
             meta = {"upload_ms": (t1 - t0) * 1e3}
-            self._staged.put((x, tag, meta))
+            self._staged.put((x, tag, meta, ods))
             meta["upload_stall_ms"] = (time.perf_counter() - t1) * 1e3
 
     def _dispatch(self) -> None:
+        try:
+            self._dispatch_loop()
+        except BaseException as e:  # chaos-ok: worker death must be loud
+            if self._error is None:
+                self._error = e
+            self._force_sentinel(self._done)
+
+    @staticmethod
+    def _force_sentinel(q: queue.Queue) -> None:
+        """Deliver a death sentinel even against a full queue, by evicting
+        one staged item per lap.  Dropping in-flight work on a DYING
+        pipeline is correct — results past the failure are void — whereas
+        a dropped sentinel would starve the downstream consumer into the
+        silent wedge this propagation machinery exists to kill.  (This
+        thread is the queue's only producer, so the evict-then-put race
+        only ever runs against consumers, and converges.)"""
+        while True:
+            try:
+                q.put(_SENTINEL, timeout=0.5)
+                return
+            except queue.Full:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    pass
+
+    def _resolve_pipe(self, mode: str):
+        """The owned-input pipeline for `mode`, swapping lowerings when
+        the degradation ladder moved it mid-stream (journal rows from then
+        on carry the mode blocks actually ran)."""
+        if mode != self._pipe_mode:
+            self._pipe = _pipeline_for_mode(
+                mode, self.k, self.construction, owned=True
+            )
+            self._pipe_mode = self._mode = mode
+        return self._pipe
+
+    def _dispatch_loop(self) -> None:
+        from celestia_app_tpu.chaos.degrade import guarded_dispatch
+
         failed = False
         while True:
             t0 = time.perf_counter()
@@ -161,13 +259,19 @@ class BlockPipeline:
                 return
             if failed or self._stopping:
                 continue
-            x, tag, meta = item
+            x, tag, meta, ods_host = item
             try:
                 t1 = time.perf_counter()
-                out = self._pipe(x)  # async enqueue; no sync added here
+                # Async enqueue with retry + ladder fallback; no sync here.
+                _, out = guarded_dispatch(
+                    self._resolve_pipe, x,
+                    refresh=lambda: jax.device_put(
+                        np.ascontiguousarray(ods_host)
+                    ),
+                )
                 meta["dispatch_ms"] = (time.perf_counter() - t1) * 1e3
                 meta["dispatch_starve_ms"] = starve_ms
-            except BaseException as e:
+            except BaseException as e:  # chaos-ok: stored, surfaced on the next drain
                 self._error = e
                 self._done.put(_SENTINEL)
                 failed = True
@@ -177,7 +281,17 @@ class BlockPipeline:
     def _materialize(self, inflight: _InFlight) -> tuple[object, ExtendedDataSquare]:
         eds, rr, cr, droot = inflight.outputs
         t0 = time.perf_counter()
-        jax.block_until_ready(droot)  # the pipeline's one existing sync
+        try:
+            jax.block_until_ready(droot)  # the pipeline's one existing sync
+        except Exception:  # chaos-ok: deferred fault -> breaker, then surface
+            # Async dispatch defers real execution faults to THIS sync,
+            # past guarded_dispatch's reach: this block is lost (the
+            # caller sees the error), but the breaker still learns, so a
+            # persistent fault steps the ladder for the blocks after it.
+            from celestia_app_tpu.chaos.degrade import note_async_device_failure
+
+            note_async_device_failure(self._mode)
+            raise
         meta = inflight.meta
         journal.record(
             "stream", inflight.k, mode=self._mode,
@@ -196,17 +310,62 @@ class BlockPipeline:
             gauge.set(q.qsize(), queue=name)
         return inflight.tag, ExtendedDataSquare(eds, rr, cr, droot, inflight.k)
 
-    def submit(self, ods: np.ndarray, tag: object = None) -> None:
+    def _raise_worker_death(self, stage: str) -> None:
+        err = self._error
+        msg = f"pipeline {stage} thread died"
+        if err is not None:
+            raise RuntimeError(msg) from err
+        raise RuntimeError(msg)
+
+    def submit(self, ods: np.ndarray, tag: object = None,
+               timeout_s: float | None = None) -> None:
         """Enqueue one block; blocks the host only when `depth` squares are
-        already in flight (back-pressure)."""
+        already in flight (back-pressure).
+
+        Deadline-aware: the bounded put wakes periodically to check the
+        workers, so a dead uploader raises the stored exception here
+        instead of wedging the caller behind a queue nobody drains; with
+        `timeout_s` set, sustained back-pressure past the deadline raises
+        TimeoutError (the caller's load-shedding hook)."""
         if self._closed:
             raise RuntimeError("pipeline already closed")
         if self._error is not None:
             raise RuntimeError("pipeline feeder failed") from self._error
-        self._tasks.put((ods, tag))
+        deadline = (
+            time.monotonic() + timeout_s if timeout_s is not None else None
+        )
+        while True:
+            try:
+                self._tasks.put((ods, tag), timeout=_POLL_S)
+                return
+            except queue.Full:
+                if self._error is not None:
+                    raise RuntimeError(
+                        "pipeline feeder failed"
+                    ) from self._error
+                if not self._uploader.is_alive():
+                    self._raise_worker_death("uploader")
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"pipeline back-pressure: no intake slot within "
+                        f"{timeout_s}s (depth={self.depth})"
+                    ) from None
+
+    def _get_done(self):
+        """One _done item, with the wedge check: a dispatcher that died
+        without managing to forward a sentinel leaves the queue silent
+        forever — detect it and raise the stored error instead."""
+        while True:
+            try:
+                return self._done.get(timeout=_POLL_S)
+            except queue.Empty:
+                if not self._dispatcher.is_alive() and self._done.empty():
+                    # Leave _finished unset: the caller's close() still
+                    # owes the uploader an unblock + leak report.
+                    self._raise_worker_death("dispatcher")
 
     def _drain_one(self) -> tuple[object, ExtendedDataSquare]:
-        inflight = self._done.get()
+        inflight = self._get_done()
         if inflight is _SENTINEL:
             self._finished = True
             if self._error is not None:
@@ -216,11 +375,27 @@ class BlockPipeline:
 
     def drain(self):
         """Close the intake and yield (tag, ExtendedDataSquare) for every
-        remaining block, in order."""
+        remaining block, in order.  Blocks computed before a mid-stream
+        failure still come out; the stored exception raises at the
+        failure point (the sentinel) rather than hanging."""
         self._closed = True
-        self._tasks.put(_SENTINEL)  # both stages always consume: cannot block
+        # A LIVE pipeline always consumes the intake (even post-failure
+        # the uploader drains and discards), so the sentinel lands; with
+        # EITHER worker dead it may never free — a dead uploader reads
+        # nothing, and a dead dispatcher leaves the uploader wedged on the
+        # _staged hand-off — so skip the intake rather than blocking on a
+        # queue nobody will drain (the death wrappers already force-fed
+        # the downstream sentinel that _get_done below will surface).
         while True:
-            inflight = self._done.get()
+            try:
+                self._tasks.put(_SENTINEL, timeout=_POLL_S)
+                break
+            except queue.Full:
+                if (not self._uploader.is_alive()
+                        or not self._dispatcher.is_alive()):
+                    break
+        while True:
+            inflight = self._get_done()
             if inflight is _SENTINEL:
                 self._finished = True
                 if self._error is not None:
@@ -235,22 +410,55 @@ class BlockPipeline:
         Keyed on _finished, NOT _closed: abandoning a drain() mid-stream
         leaves _closed set with results still queued, and an early return
         there would strand the dispatcher blocked on a full _done holding
-        `depth` extended squares for the process lifetime."""
+        `depth` extended squares for the process lifetime.
+
+        Worker death is REPORTED, never swallowed: a stage that outlives
+        its join timeout (a genuine wedge — the error-propagation paths
+        above cover everything else) logs and ticks
+        `celestia_pipeline_close_leaked_total{stage}`."""
         if self._finished:
             return
         self._stopping = True  # stages discard anything still queued
-        if not self._closed:
-            self._closed = True
-            self._tasks.put(_SENTINEL)
+        sentinel_needed = not self._closed
+        self._closed = True
         # Unblock the stages if their output queues are full, and drop
-        # held outputs.
-        while True:
-            item = self._done.get()
+        # held outputs.  Bounded waits everywhere: the intake sentinel is
+        # offered NON-blocking inside the drain loop — with every queue
+        # full and _done undrained, a blocking put here would deadlock
+        # against the very back-pressure chain this method exists to
+        # unwind — and a dispatcher that died without a sentinel (or
+        # wedged outright) must not wedge close() itself.  The deadline
+        # measures INACTIVITY (re-armed on every drained item), not total
+        # wall clock: an abandoned stream whose first dispatch is mid-
+        # jit-compile is slow-but-healthy, not a leak to report.
+        deadline = time.monotonic() + _CLOSE_STALL_S
+        while time.monotonic() < deadline:
+            if sentinel_needed:
+                try:
+                    self._tasks.put_nowait(_SENTINEL)
+                    sentinel_needed = False
+                except queue.Full:
+                    pass  # a drain below frees the chain; retry next lap
+            try:
+                item = self._done.get(timeout=_POLL_S)
+            except queue.Empty:
+                if not sentinel_needed and not self._dispatcher.is_alive():
+                    break
+                continue
             if item is _SENTINEL:
                 break
+            deadline = time.monotonic() + _CLOSE_STALL_S  # progress: re-arm
         self._finished = True
         self._uploader.join(timeout=5)
         self._dispatcher.join(timeout=5)
+        for stage, thread in (("uploader", self._uploader),
+                              ("dispatcher", self._dispatcher)):
+            if thread.is_alive():
+                import sys
+
+                print(f"BlockPipeline.close: {stage} thread leaked past "
+                      f"join timeout (k={self.k})", file=sys.stderr)
+                _close_leak_counter().inc(stage=stage)
 
 
 def stream_blocks(ods_iter, k: int, depth: int = 2):

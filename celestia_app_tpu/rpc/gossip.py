@@ -109,6 +109,9 @@ class ConsensusDriver:
         self.valsets: dict[int, dict] = {}
         self._timers: list[threading.Timer] = []
         self._stopped = False
+        # peer url -> consecutive failed sends (gates per-send retries:
+        # a link mid-streak is not worth multiplying timeouts on).
+        self._peer_fail_streak: dict = {}
 
     # --- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -457,25 +460,14 @@ class ConsensusDriver:
 
     @staticmethod
     def _msg_id(msg: dict) -> tuple:
-        if msg.get("kind") == "vote":
-            return ("vote", msg.get("vote", ""))
-        # The PAYLOAD is part of the identity: the proposal signature does
-        # not cover the block bytes (the signed block id does, indirectly),
-        # so without this a tampered relay copy would dedup-block the
-        # genuine message mesh-wide and censor an honest proposal.
-        import hashlib as _hashlib
-        import json as _json
+        # The PAYLOAD is part of a proposal's identity: the proposal
+        # signature does not cover the block bytes (the signed block id
+        # does, indirectly), so without this a tampered relay copy would
+        # dedup-block the genuine message mesh-wide and censor an honest
+        # proposal.  Shared with the chaos drills via rpc/transport.py.
+        from celestia_app_tpu.rpc import transport
 
-        payload = _hashlib.sha256(
-            _json.dumps(
-                [msg.get("block"), msg.get("last_commit"), msg.get("evidence")],
-                sort_keys=True, separators=(",", ":"), default=str,
-            ).encode()
-        ).hexdigest()
-        return (
-            "proposal", msg.get("height"), msg.get("round"),
-            msg.get("proposer"), msg.get("block_hash"), payload,
-        )
+        return transport.msg_id(msg)
 
     def _process(self, msg: dict) -> None:
         node = self.node
@@ -653,14 +645,25 @@ class ConsensusDriver:
         for peer in peers:
             self._send_to(peer, msgs)
 
+    #: Bounded per-peer send retries: a blip on one link costs a short
+    #: backoff instead of relying solely on the round machine's timeouts
+    #: to route around it.  Final failure still falls back to the flood
+    #: (the relay mesh + catch-up heal lost messages).  Delivery itself —
+    #: chaos seam, retry gate, failure streaks — lives in rpc/transport.py
+    #: (crypto-free, so the chaos drills exercise it without the signing
+    #: stack).
+    SEND_RETRIES = 2
+
     def _send_to(self, peer, msgs: list) -> None:
         import time as _time
 
+        from celestia_app_tpu.rpc import transport
         from celestia_app_tpu.trace.metrics import registry
 
         sent = registry().counter(
             "celestia_gossip_msgs_total", "consensus gossip messages"
         )
+        key = getattr(peer, "url", None) or id(peer)
         for msg in msgs:
             sent.inc(kind=str(msg.get("kind", "unknown")), direction="out")
             if self.latency_s or self.jitter_s:
@@ -671,10 +674,10 @@ class ConsensusDriver:
                     digest = _hashlib.sha256(repr(msg).encode()).digest()
                     jitter = self.jitter_s * digest[0] / 255.0
                 _time.sleep(self.latency_s + jitter)
-            try:
-                peer.consensus(msg)
-            except Exception:
-                continue  # unreachable peer: the flood routes around it
+            transport.deliver(
+                peer.consensus, msg, streak=self._peer_fail_streak,
+                key=key, retries=self.SEND_RETRIES,
+            )
 
     def _send_all_later(self, msgs: list) -> None:
         if msgs:

@@ -1200,6 +1200,12 @@ class _Handler(BaseHTTPRequestHandler):
             method = self.methods.get(req.get("method", ""))
             if method is None:
                 raise ValueError(f"unknown method {req.get('method')!r}")
+            # Chaos rpc.handle seam: an injected stall models a slow
+            # ingress; an injected failure surfaces as a normal RPC error
+            # (clients and the gossip retry paths must absorb both).
+            from celestia_app_tpu import chaos
+
+            chaos.rpc_handle()
             result = method(**req.get("params", {}))
             body = {"jsonrpc": "2.0", "id": req.get("id"), "result": result}
             status = 200

@@ -53,6 +53,16 @@ def health_payload() -> dict:
     with _HEALTH_LOCK:
         providers = dict(_HEALTH_PROVIDERS)
     payload: dict = {"status": "SERVING"}
+    # Degradation ladder state (chaos/degrade.py): a process whose device
+    # path has been stepped down keeps serving — correctness is intact,
+    # latency is not — so the probe stays green but SAYS SO, and an
+    # orchestrator can schedule a restart to re-arm the fast path.
+    from celestia_app_tpu.chaos.degrade import degraded_state
+
+    degraded = degraded_state()
+    if degraded:
+        payload["status"] = "DEGRADED"
+        payload["degraded"] = degraded
     if providers:
         layers = {}
         for name, provider in sorted(providers.items()):

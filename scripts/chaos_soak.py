@@ -1,0 +1,338 @@
+#!/usr/bin/env python
+"""Chaos soak: N blocks under a seeded fault spec, bit-identical roots.
+
+The claim under test is the paper's production premise: the device
+pipeline sits on the consensus hot path, so injected faults may cost
+LATENCY but never CORRECTNESS.  Four drills, one process:
+
+  1. device soak     — N deterministic blocks streamed through the
+                       BlockPipeline under dispatch/upload chaos; every
+                       committed DAH root must be bit-identical to the
+                       chaos-off run (retry, backoff, and even a
+                       mid-soak degradation to staged/host are all
+                       invisible in the roots).
+  2. WAL tear drill  — votes journaled with `wal_torn_tail` injection;
+                       a crash+restart replay must salvage every
+                       complete record, refuse the conflicting re-sign,
+                       and allow the idempotent one (double-sign safety
+                       survives the torn tail).
+  3. gossip drill    — a redundant flood over a lossy, duplicating,
+                       transiently-failing link; the receiver-side
+                       msg-id dedup must converge on exactly the unique
+                       message set (drops healed by redundancy+retry,
+                       dups absorbed).
+  4. breaker drill   — a persistent injected device failure must flip
+                       `pipeline_mode()` fused -> staged within the
+                       breaker window, with `celestia_degraded` and
+                       /healthz reporting the degraded state.
+
+Run:
+  JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/chaos_soak.py \
+      --blocks 20 --k 16 \
+      --spec "seed=7,dispatch_fail=0.1,upload_stall_ms=20,gossip_drop=0.2,gossip_dup=0.1,wal_torn_tail=2"
+
+Exits non-zero on any divergence; prints the per-seam
+injection/recovery table either way.  tests/test_chaos.py runs a small
+fixed-seed smoke through these same functions in tier-1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+DEFAULT_SPEC = (
+    "seed=7,dispatch_fail=0.1,upload_stall_ms=5,gossip_drop=0.2,"
+    "gossip_dup=0.1,wal_torn_tail=2"
+)
+
+
+def _deterministic_blocks(n: int, k: int, seed: int = 1234):
+    from celestia_app_tpu.constants import NAMESPACE_SIZE, SHARE_SIZE
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        shares = k * k
+        ns = np.sort(rng.integers(0, 128, shares).astype(np.uint8))
+        ods = rng.integers(0, 256, (shares, SHARE_SIZE), dtype=np.uint8)
+        ods[:, :NAMESPACE_SIZE] = 0
+        ods[:, NAMESPACE_SIZE - 1] = ns
+        out.append((i, ods.reshape(k, k, SHARE_SIZE)))
+    return out
+
+
+def run_device_soak(n_blocks: int, k: int, spec: str) -> dict:
+    """Stream n_blocks through the BlockPipeline chaos-off then chaos-on;
+    returns {"roots_identical": bool, "final_mode": str, ...}."""
+    from celestia_app_tpu import chaos
+    from celestia_app_tpu.chaos import degrade
+    from celestia_app_tpu.kernels.fused import pipeline_mode
+    from celestia_app_tpu.parallel.pipeline import stream_blocks
+
+    blocks = _deterministic_blocks(n_blocks, k)
+
+    # An EMPTY programmatic install, not uninstall(): uninstall falls
+    # back to $CELESTIA_CHAOS, and the whole point of this leg is a
+    # baseline with no injection even when the env spec is set.
+    chaos.install("")
+    degrade.reset_for_tests()
+    baseline = {
+        tag: eds.data_root()
+        for tag, eds in stream_blocks(iter(blocks), k, depth=2)
+    }
+
+    chaos.install(spec)
+    try:
+        chaotic = {
+            tag: eds.data_root()
+            for tag, eds in stream_blocks(iter(blocks), k, depth=2)
+        }
+        final_mode = pipeline_mode()
+        degraded = degrade.degraded_state()
+    finally:
+        chaos.uninstall()
+        degrade.reset_for_tests()
+    mismatches = [
+        t for t in baseline
+        if chaotic.get(t) != baseline[t]
+    ]
+    return {
+        "blocks": n_blocks,
+        "k": k,
+        "roots_identical": not mismatches and len(chaotic) == len(baseline),
+        "mismatched_tags": mismatches,
+        "final_mode": final_mode,
+        "degraded": degraded,
+    }
+
+
+def run_wal_tear_drill(spec: str, wal_dir: str | None = None) -> dict:
+    """Journal votes under torn-tail injection, crash, restart, and check
+    double-sign safety + salvage."""
+    from celestia_app_tpu import chaos
+    from celestia_app_tpu.consensus.wal import VoteWAL
+
+    PREVOTE, PRECOMMIT = 1, 2  # votes.py constants, sans its crypto import
+
+    block_a, block_b = b"\xaa" * 32, b"\xbb" * 32
+    tmp = wal_dir or tempfile.mkdtemp(prefix="chaos-wal-")
+    path = os.path.join(tmp, "wal.jsonl")
+    chaos.install(spec)
+    try:
+        wal = VoteWAL(path)
+        signed = []
+        for h in range(1, 9):
+            for vt in (PREVOTE, PRECOMMIT):
+                if wal.may_sign(h, 0, vt, block_a):
+                    signed.append((h, 0, vt))
+        # The spec's torn tails self-healed as appends continued (the
+        # live truncate path).  For the restart-salvage leg the LAST
+        # append must tear: re-arm one torn tail, sign, and crash
+        # WITHOUT close — the durably fsync'd partial record is exactly
+        # what the restart sees.
+        chaos.install("seed=1,wal_torn_tail=1")
+        assert wal.may_sign(99_000, 0, PREVOTE, block_a)
+        signed.append((99_000, 0, PREVOTE))
+        torn_on_disk = wal._torn
+        del wal
+    finally:
+        chaos.uninstall()
+
+    wal2 = VoteWAL(path)
+    # Every completed record survives: the conflicting vote is refused at
+    # every signed coordinate; the identical re-sign stays allowed (how a
+    # restarted node rejoins and re-broadcasts without equivocating).
+    refused = all(
+        not wal2.may_sign(h, r, t, block_b) for h, r, t in signed
+    )
+    idempotent = all(wal2.may_sign(h, r, t, block_a) for h, r, t in signed)
+    fresh = wal2.may_sign(99, 0, PREVOTE, block_b)  # new coords: free
+    wal2.close()
+    return {
+        "signed": len(signed),
+        "torn_on_disk": torn_on_disk,
+        "salvaged_bytes": wal2.salvaged_bytes,
+        "conflicts_refused": refused,
+        "idempotent_resign_ok": idempotent,
+        "fresh_coords_ok": fresh,
+        "ok": refused and idempotent and fresh,
+    }
+
+
+class _FlakyPeer:
+    """Fails every `fail_every`-th consensus() call (transient link)."""
+
+    url = "chaos://flaky-peer"
+
+    def __init__(self, fail_every: int = 5):
+        self.fail_every = fail_every
+        self.calls = 0
+        self.delivered: list[dict] = []
+
+    def consensus(self, msg: dict) -> dict:
+        self.calls += 1
+        if self.fail_every and self.calls % self.fail_every == 0:
+            raise ConnectionError("chaos: transient peer failure")
+        self.delivered.append(msg)
+        return {"ok": True}
+
+
+def run_gossip_drill(spec: str, n_msgs: int = 40, max_rounds: int = 12) -> dict:
+    """Flood unique messages over a chaotic link (rpc/transport.deliver —
+    the same path ConsensusDriver._send_to rides) until the receiver's
+    dedup set converges on exactly the unique set, as the real mesh does:
+    losses are healed by RE-FLOODING (relays, round timeouts, catch-up
+    all re-offer messages), never by the sender knowing a drop happened.
+    Must converge within `max_rounds` despite drops, dups, and a
+    transiently failing peer — and dedup must keep the unique set exact
+    despite the duplicate deliveries."""
+    from celestia_app_tpu import chaos
+    from celestia_app_tpu.rpc import transport
+
+    peer = _FlakyPeer(fail_every=5)
+    streak: dict = {}
+    msgs = [
+        {"kind": "vote", "height": 1, "vote": f"{i:04x}"}
+        for i in range(n_msgs)
+    ]
+    expected = {transport.msg_id(m) for m in msgs}
+    rounds = 0
+    chaos.install(spec)
+    try:
+        while rounds < max_rounds:
+            rounds += 1
+            for msg in msgs:
+                transport.deliver(
+                    peer.consensus, msg, streak=streak, key=peer.url
+                )
+            # Reorder-delayed deliveries land on timer threads: wait them
+            # out so the convergence check sees settled state.
+            transport.drain_delayed()
+            # Receiver-side flood termination: the dedup key handle() uses.
+            if {transport.msg_id(m) for m in peer.delivered} == expected:
+                break
+    finally:
+        chaos.uninstall()
+    transport.drain_delayed()
+    unique = {transport.msg_id(m) for m in peer.delivered}
+    return {
+        "sent_unique": n_msgs,
+        "rounds": rounds,
+        "deliveries": len(peer.delivered),
+        "unique_delivered": len(unique),
+        "converged": unique == expected,
+        "ok": unique == expected and rounds <= max_rounds,
+    }
+
+
+def run_breaker_drill(k: int = 4) -> dict:
+    """A persistent injected device failure must flip the ladder to
+    staged within the breaker window, visible on /healthz."""
+    from celestia_app_tpu import chaos
+    from celestia_app_tpu.chaos import degrade
+    from celestia_app_tpu.da.eds import ExtendedDataSquare
+    from celestia_app_tpu.constants import SHARE_SIZE
+    from celestia_app_tpu.kernels.fused import pipeline_mode
+    from celestia_app_tpu.trace.exposition import health_payload
+
+    chaos.install("")  # chaos-free even when $CELESTIA_CHAOS is set
+    degrade.reset_for_tests()
+    ods = np.zeros((k, k, SHARE_SIZE), dtype=np.uint8)
+    healthy_root = ExtendedDataSquare.compute(ods).data_root()
+    chaos.install("seed=11,dispatch_fail=1.0")
+    try:
+        degraded_root = ExtendedDataSquare.compute(ods).data_root()
+        mode = pipeline_mode()
+        health = health_payload()
+    finally:
+        chaos.uninstall()
+    result = {
+        "mode_after": mode,
+        "health_status": health.get("status"),
+        "health_degraded": health.get("degraded"),
+        "roots_identical": degraded_root == healthy_root,
+        "ok": (
+            mode == "staged"
+            and health.get("status") == "DEGRADED"
+            and health.get("degraded") == {"device": "staged"}
+            and degraded_root == healthy_root
+        ),
+    }
+    degrade.reset_for_tests()
+    return result
+
+
+def seam_table() -> str:
+    """The per-seam injection/recovery counts, straight off the registry."""
+    from celestia_app_tpu.trace.metrics import registry
+
+    lines = [
+        line for line in registry().render().splitlines()
+        if line.startswith(("celestia_chaos_injections_total",
+                            "celestia_recoveries_total"))
+    ]
+    return "\n".join(lines) or "(no injections fired)"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--blocks", type=int, default=20)
+    ap.add_argument("--k", type=int, default=16)
+    ap.add_argument("--spec", default=DEFAULT_SPEC)
+    args = ap.parse_args(argv)
+
+    print(f"chaos_soak: spec={args.spec!r}", flush=True)
+    failures = []
+
+    dev = run_device_soak(args.blocks, args.k, args.spec)
+    print(f"device soak: {dev['blocks']} blocks @ k={dev['k']} -> "
+          f"roots_identical={dev['roots_identical']} "
+          f"final_mode={dev['final_mode']} degraded={dev['degraded']}",
+          flush=True)
+    if not dev["roots_identical"]:
+        failures.append(f"device soak diverged: {dev['mismatched_tags']}")
+
+    wal = run_wal_tear_drill(args.spec)
+    print(f"WAL tear drill: signed={wal['signed']} "
+          f"torn_on_disk={wal['torn_on_disk']} "
+          f"salvaged_bytes={wal['salvaged_bytes']} "
+          f"conflicts_refused={wal['conflicts_refused']} "
+          f"idempotent_resign_ok={wal['idempotent_resign_ok']}", flush=True)
+    if not wal["ok"]:
+        failures.append(f"WAL drill failed: {wal}")
+
+    gos = run_gossip_drill(args.spec)
+    print(f"gossip drill: {gos['sent_unique']} unique msgs converged in "
+          f"{gos['rounds']} flood rounds -> {gos['deliveries']} deliveries, "
+          f"{gos['unique_delivered']} unique after dedup "
+          f"(converged={gos['converged']})", flush=True)
+    if not gos["ok"]:
+        failures.append(f"gossip drill failed: {gos}")
+
+    brk = run_breaker_drill(k=min(args.k, 8))
+    print(f"breaker drill: mode_after={brk['mode_after']} "
+          f"health={brk['health_status']} {brk['health_degraded']} "
+          f"roots_identical={brk['roots_identical']}", flush=True)
+    if not brk["ok"]:
+        failures.append(f"breaker drill failed: {brk}")
+
+    print("\nper-seam injection/recovery counts:")
+    print(seam_table(), flush=True)
+
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    print("\nchaos_soak: OK — every drill held correctness under failure")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,7 +19,15 @@ Walks every registry().counter/gauge/histogram registration in
      (trace/square_journal.capped_namespace_label) — a module that slaps
      `namespace=` on a metric without referencing the helper fails,
      which is what keeps the exposition's label cardinality provably
-     bounded as tenants multiply.
+     bounded as tenants multiply; and
+  5. in the HOT-PATH modules (parallel/, da/, kernels/, consensus/),
+     every `except Exception:` / bare `except:` handler carries a
+     `# chaos-ok: <why>` rationale on its line (or the line above).  A
+     broad catch on the block path is where a fault gets SWALLOWED
+     instead of retried/degraded/propagated (the chaos layer exists
+     because of exactly such sites) — the tag forces each one to say why
+     swallowing is right.  Existing sites were grandfathered by tagging
+     them with their (pre-existing) rationales.
 
 Run standalone (exit 1 on problems) or via tests/test_trace_lint.py,
 which puts the check in tier-1.
@@ -49,10 +57,15 @@ METRIC_WRITE_METHODS = {"inc", "set", "observe"}
 UNBOUNDED_LABELS = {"namespace"}
 CAP_HELPER = "capped_namespace_label"
 
+# Hot-path module prefixes (package-relative) where a broad exception
+# handler must carry a `# chaos-ok:` rationale tag.
+HOT_PATH_PREFIXES = ("parallel/", "da/", "kernels/", "consensus/")
+CHAOS_OK_TAG = "chaos-ok:"
+
 
 def _parse_package(package_dir: str = PACKAGE_DIR):
-    """[(repo-relative path, parsed AST)] for every .py under the
-    package — the single walk+parse both collectors share."""
+    """[(repo-relative path, parsed AST, source lines)] for every .py
+    under the package — the single walk+parse all collectors share."""
     out = []
     for dirpath, dirnames, filenames in os.walk(package_dir):
         dirnames[:] = [d for d in dirnames if d != "__pycache__"]
@@ -61,8 +74,11 @@ def _parse_package(package_dir: str = PACKAGE_DIR):
                 continue
             path = os.path.join(dirpath, fn)
             with open(path, encoding="utf-8") as f:
-                tree = ast.parse(f.read(), filename=path)
-            out.append((os.path.relpath(path, REPO_ROOT), tree))
+                source = f.read()
+            tree = ast.parse(source, filename=path)
+            out.append((
+                os.path.relpath(path, REPO_ROOT), tree, source.splitlines()
+            ))
     return out
 
 
@@ -70,7 +86,7 @@ def collect_registrations(package_dir: str = PACKAGE_DIR, trees=None):
     """[(file, lineno, kind, name)] where kind is "static" (a literal
     name) or "dynamic" (an f-string; `name` is its static prefix)."""
     out = []
-    for rel, tree in trees if trees is not None else _parse_package(package_dir):
+    for rel, tree, _ in trees if trees is not None else _parse_package(package_dir):
         for node in ast.walk(tree):
             if not (
                 isinstance(node, ast.Call)
@@ -104,7 +120,7 @@ def collect_label_uses(package_dir: str = PACKAGE_DIR, trees=None):
     file so lint() can flag unbounded labels used outside it.
     """
     out = []
-    for rel, tree in trees if trees is not None else _parse_package(package_dir):
+    for rel, tree, _ in trees if trees is not None else _parse_package(package_dir):
         has_helper = any(
             (isinstance(n, ast.Name) and n.id == CAP_HELPER)
             or (isinstance(n, ast.Attribute) and n.attr == CAP_HELPER)
@@ -124,6 +140,48 @@ def collect_label_uses(package_dir: str = PACKAGE_DIR, trees=None):
                 if kw.arg is None:  # **spread
                     continue
                 out.append((rel, node.lineno, kw.arg, has_helper))
+    return out
+
+
+def _is_hot_path(rel: str) -> bool:
+    p = "/" + rel.replace(os.sep, "/")
+    return any("/" + prefix in p for prefix in HOT_PATH_PREFIXES)
+
+
+def collect_broad_excepts(package_dir: str = PACKAGE_DIR, trees=None):
+    """[(file, lineno, tagged)] for every `except Exception` / bare
+    `except:` handler in a hot-path module.  `tagged` is whether the
+    handler line (or the line above it — long rationales wrap) carries
+    the `# chaos-ok:` tag."""
+
+    def _catches_broad(h: ast.ExceptHandler) -> bool:
+        if h.type is None:
+            return True  # bare except
+        names = (
+            h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+        )
+        # BaseException is in the net too: the strictly BROADER catch
+        # must not be the easy way around the rationale requirement.
+        return any(
+            isinstance(n, ast.Name)
+            and n.id in ("Exception", "BaseException")
+            for n in names
+        )
+
+    out = []
+    for rel, tree, lines in (
+        trees if trees is not None else _parse_package(package_dir)
+    ):
+        if not _is_hot_path(rel):
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.ExceptHandler)
+                    and _catches_broad(node)):
+                continue
+            nearby = lines[max(0, node.lineno - 2):node.lineno]
+            out.append(
+                (rel, node.lineno, any(CHAOS_OK_TAG in l for l in nearby))
+            )
     return out
 
 
@@ -182,6 +240,14 @@ def lint(package_dir: str = PACKAGE_DIR, readme_path: str = README) -> list[str]
                 f"{where}: label {label!r} is unbounded-cardinality; route "
                 f"the value through trace/square_journal.{CAP_HELPER} "
                 "(module never references the helper)"
+            )
+    for rel, lineno, tagged in collect_broad_excepts(package_dir, trees):
+        if not tagged:
+            problems.append(
+                f"{rel}:{lineno}: broad `except Exception` in a hot-path "
+                f"module without a `# {CHAOS_OK_TAG}` rationale — swallow "
+                "sites on the block path must say why they are not a "
+                "retry/degrade/propagate seam (see chaos/)"
             )
     return problems
 

@@ -1,0 +1,552 @@
+"""Chaos seams + graceful degradation (celestia_app_tpu/chaos/).
+
+Tier-1 seats for the failure machinery, all crypto-free:
+
+  * spec parsing + per-seam deterministic injection;
+  * the fast chaos smoke: scripts/chaos_soak.py's device/WAL/gossip/
+    breaker drills at small k with a fixed seed, so the injection seams
+    cannot bit-rot (the full soak is the same functions, bigger knobs);
+  * the degradation ladder: fused -> staged within the breaker window
+    under persistent injected device failure, bit-identical roots,
+    /healthz DEGRADED;
+  * BlockPipeline failure propagation: a dead worker raises the stored
+    exception at the next put()/drain() instead of wedging the caller;
+  * crash-restart determinism: a validator killed between WAL fsync and
+    broadcast refuses the conflicting vote after restart and rejoins
+    via the idempotent re-sign — double-sign safety across the crash,
+    torn tail included.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu import chaos
+from celestia_app_tpu.chaos import degrade
+from celestia_app_tpu.chaos.spec import ChaosInjected, ChaosInjector, parse_spec
+from celestia_app_tpu.constants import SHARE_SIZE
+
+_SOAK = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts", "chaos_soak.py",
+)
+
+PREVOTE = 1  # consensus/votes.py constant, sans its crypto import
+
+
+def _load_soak():
+    spec = importlib.util.spec_from_file_location("chaos_soak", _SOAK)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.uninstall()
+    degrade.reset_for_tests()
+    yield
+    chaos.uninstall()
+    degrade.reset_for_tests()
+
+
+def _injections(seam: str) -> float:
+    from celestia_app_tpu.trace.metrics import registry
+
+    counter = registry().counter("celestia_chaos_injections_total")
+    with counter._lock:
+        return counter._values.get((("seam", seam),), 0.0)
+
+
+class TestSpec:
+    def test_parse_happy_path(self):
+        params = parse_spec(
+            "seed=7,dispatch_fail=0.05,upload_stall_ms=200,"
+            "gossip_drop=0.1,wal_torn_tail=1,rpc_slow_ms=100"
+        )
+        assert params["seed"] == 7
+        assert params["dispatch_fail"] == pytest.approx(0.05)
+        assert params["wal_torn_tail"] == 1
+
+    def test_unknown_key_and_malformed_pair_rejected(self):
+        with pytest.raises(ValueError):
+            parse_spec("seed=7,dispatch_fial=0.5")  # typo must not no-op
+        with pytest.raises(ValueError):
+            parse_spec("dispatch_fail")
+        with pytest.raises(ValueError):
+            parse_spec("dispatch_fail=lots")
+
+    def test_injection_sequence_deterministic_per_seam(self):
+        """Same spec -> same per-seam verdict sequence, regardless of how
+        calls to OTHER seams interleave."""
+        a = ChaosInjector(parse_spec("seed=3,gossip_drop=0.5"))
+        b = ChaosInjector(parse_spec("seed=3,gossip_drop=0.5"))
+        seq_a = [bool(a.gossip_send().get("drop")) for _ in range(32)]
+        seq_b = []
+        for _ in range(32):
+            b.mempool_insert()  # interleaved other-seam traffic
+            seq_b.append(bool(b.gossip_send().get("drop")))
+        assert seq_a == seq_b
+        assert any(seq_a) and not all(seq_a)
+
+    def test_install_validates_dict_specs_too(self):
+        with pytest.raises(ValueError, match="dispatch_fial"):
+            chaos.install({"dispatch_fial": 1.0})  # typo'd dict = loud
+
+    def test_env_spec_activates_and_cache_follows_changes(self, monkeypatch):
+        monkeypatch.setenv("CELESTIA_CHAOS", "seed=1,mempool_drop=1.0")
+        assert chaos.mempool_insert() is True
+        monkeypatch.setenv("CELESTIA_CHAOS", "")
+        assert chaos.mempool_insert() is False
+
+    def test_dispatch_fail_targets_fused_rung_only(self):
+        inj = ChaosInjector(parse_spec("seed=2,dispatch_fail=1.0"))
+        with pytest.raises(ChaosInjected):
+            inj.device_dispatch("fused")
+        inj.device_dispatch("staged")  # no raise: the ladder's escape rung
+        inj_all = ChaosInjector(
+            parse_spec("seed=2,dispatch_fail=1.0,dispatch_fail_all=1")
+        )
+        with pytest.raises(ChaosInjected):
+            inj_all.device_dispatch("staged")
+
+
+class TestDegradationLadder:
+    def test_breaker_flips_fused_to_staged_with_healthz(self):
+        """The acceptance drill: persistent injected device failure flips
+        pipeline_mode to staged within the breaker window, with
+        celestia_degraded and /healthz reflecting it — and the root
+        unchanged."""
+        soak = _load_soak()
+        result = soak.run_breaker_drill(k=4)
+        assert result["ok"], result
+        assert result["mode_after"] == "staged"
+        assert result["health_status"] == "DEGRADED"
+        assert result["roots_identical"]
+
+    def test_ladder_steps_and_reset(self):
+        ladder = degrade.DeviceDegradation()
+        assert ladder.effective_mode("fused") == "fused"
+        assert ladder.degrade("fused") == "staged"
+        assert ladder.effective_mode("fused") == "staged"
+        assert ladder.state() == {"device": "staged"}
+        assert ladder.degrade("fused") == "host"
+        assert ladder.degrade("fused") is None  # the floor
+        ladder.reset()
+        assert ladder.effective_mode("fused") == "fused"
+        assert ladder.state() is None
+
+    def test_ladder_respects_env_base(self):
+        ladder = degrade.DeviceDegradation()
+        # env already staged: first degrade goes straight to host.
+        assert ladder.degrade("staged") == "host"
+        assert ladder.effective_mode("staged") == "host"
+
+    def test_concurrent_trips_step_one_rung_not_two(self):
+        """Two breaker trips from one burst of FUSED failures must not
+        double-step the ladder past the staged rung: the second caller's
+        `observed` rung is already below the floor, so it adopts the
+        existing step instead of stacking another."""
+        ladder = degrade.DeviceDegradation()
+        assert ladder.degrade("fused", observed="fused") == "staged"
+        # The racing thread also saw FUSED fail, but the floor has moved:
+        assert ladder.degrade("fused", observed="fused") == "staged"
+        assert ladder.effective_mode("fused") == "staged"
+        # A genuine staged-rung failure still steps down.
+        assert ladder.degrade("fused", observed="staged") == "host"
+
+    def test_guarded_dispatch_retries_within_rung(self):
+        """Transient failures are retried with backoff inside the rung;
+        the ladder does not move."""
+        calls = {"n": 0}
+
+        def flaky(_x):
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise RuntimeError("transient")
+            return "ok"
+
+        breaker = degrade.CircuitBreaker(threshold=5)
+        mode, out = degrade.guarded_dispatch(
+            lambda m: flaky, "x", breaker=breaker, sleep=lambda s: None
+        )
+        assert out == "ok" and calls["n"] == 3
+        assert degrade.degraded_state() is None
+
+    def test_guarded_dispatch_raises_when_floor_exhausts(self, monkeypatch):
+        def always_fail(_x):
+            raise RuntimeError("dead device")
+
+        breaker = degrade.CircuitBreaker(threshold=2)
+        with pytest.raises(RuntimeError, match="dead device"):
+            degrade.guarded_dispatch(
+                lambda m: always_fail, "x", breaker=breaker,
+                sleep=lambda s: None,
+            )
+        # It walked the whole ladder before giving up.
+        assert degrade.degraded_state() == {"device": "host"}
+        # And SUBSEQUENT calls keep raising promptly: the breaker stayed
+        # past its threshold (>=, not ==), so the next block's dispatch
+        # must not spin in the retry loop forever.
+        calls = {"n": 0}
+
+        def count_and_fail(_x):
+            calls["n"] += 1
+            raise RuntimeError("still dead")
+
+        with pytest.raises(RuntimeError, match="still dead"):
+            degrade.guarded_dispatch(
+                lambda m: count_and_fail, "x", breaker=breaker,
+                sleep=lambda s: None,
+            )
+        assert calls["n"] == 1  # one attempt, immediate re-raise
+
+
+class TestChaosSmoke:
+    """The tier-1 chaos smoke: the soak machinery at small k, fixed seed."""
+
+    SPEC = (
+        "seed=5,dispatch_fail=0.2,upload_stall_ms=1,upload_fail=0.1,"
+        "gossip_drop=0.25,gossip_dup=0.15,wal_torn_tail=2"
+    )
+
+    def test_device_soak_bit_identical_roots_under_chaos(self):
+        soak = _load_soak()
+        before = _injections("device.dispatch") + _injections("device.upload")
+        result = soak.run_device_soak(5, 4, self.SPEC)
+        after = _injections("device.dispatch") + _injections("device.upload")
+        assert result["roots_identical"], result
+        assert after > before, "smoke ran but injected nothing"
+
+    def test_wal_tear_drill(self):
+        soak = _load_soak()
+        result = soak.run_wal_tear_drill(self.SPEC)
+        assert result["ok"], result
+        assert result["torn_on_disk"], "the tail was never torn"
+        assert result["salvaged_bytes"] > 0
+
+    def test_gossip_drill_converges(self):
+        soak = _load_soak()
+        before = _injections("gossip.send")
+        result = soak.run_gossip_drill(self.SPEC, n_msgs=20)
+        assert result["ok"], result
+        assert _injections("gossip.send") > before
+
+    def test_soak_main_smoke(self, capsys):
+        """The script's own entry point end to end (tiny knobs)."""
+        soak = _load_soak()
+        rc = soak.main([
+            "--blocks", "3", "--k", "4",
+            "--spec", "seed=9,dispatch_fail=0.3,gossip_drop=0.2,"
+                      "wal_torn_tail=1",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "chaos_soak: OK" in out
+        assert "celestia_chaos_injections_total" in out
+
+
+class TestPipelinePropagation:
+    """BlockPipeline: worker death raises at put()/drain(), never hangs."""
+
+    def _blocks(self, n, k=4):
+        return [
+            (i, np.zeros((k, k, SHARE_SIZE), dtype=np.uint8))
+            for i in range(n)
+        ]
+
+    def test_upload_failure_propagates_on_drain(self):
+        from celestia_app_tpu.parallel.pipeline import BlockPipeline
+
+        chaos.install("seed=1,upload_fail=1.0")  # exhausts the retry budget
+        pipe = BlockPipeline(4, depth=2)
+        try:
+            pipe.submit(self._blocks(1)[0][1], tag=0)
+            with pytest.raises(RuntimeError, match="feeder failed"):
+                for _ in pipe.drain():
+                    pass
+        finally:
+            chaos.uninstall()
+            pipe.close()
+
+    def test_submit_raises_after_feeder_death_instead_of_hanging(self):
+        from celestia_app_tpu.parallel.pipeline import BlockPipeline
+
+        chaos.install("seed=1,upload_fail=1.0")
+        pipe = BlockPipeline(4, depth=1)
+        try:
+            ods = self._blocks(1)[0][1]
+            with pytest.raises((RuntimeError, TimeoutError)):
+                # depth=1: once the feeder dies, puts would previously
+                # block forever; now either the stored error or the
+                # deadline surfaces.
+                for i in range(16):
+                    pipe.submit(ods, tag=i, timeout_s=5.0)
+        finally:
+            chaos.uninstall()
+            pipe.close()
+
+    def test_transient_upload_faults_are_retried(self):
+        from celestia_app_tpu.parallel.pipeline import stream_blocks
+
+        chaos.install("seed=6,upload_fail=0.3")
+        try:
+            blocks = self._blocks(6)
+            out = list(stream_blocks(iter(blocks), 4, depth=2))
+            assert [t for t, _ in out] == list(range(6))
+        finally:
+            chaos.uninstall()
+
+    def test_submit_deadline_surfaces_as_timeout(self):
+        """Sustained back-pressure past an explicit deadline raises
+        TimeoutError instead of blocking forever."""
+        import queue as _q
+
+        from celestia_app_tpu.parallel.pipeline import BlockPipeline
+
+        pipe = BlockPipeline(4, depth=1)
+        try:
+            # Wedge the intake artificially: fill _tasks so the put must
+            # wait, while workers are blocked behind a full _done that
+            # nobody drains.
+            for i in range(8):
+                try:
+                    pipe._tasks.put((self._blocks(1)[0][1], i), timeout=0.2)
+                except _q.Full:
+                    break
+            with pytest.raises(TimeoutError, match="back-pressure"):
+                pipe.submit(self._blocks(1)[0][1], tag=99, timeout_s=0.5)
+        finally:
+            pipe.close()
+
+    def test_drain_does_not_hang_when_workers_hard_died(self, monkeypatch):
+        """drain() with a full intake and DEAD workers must surface the
+        stored error, not spin on the sentinel put forever (the silent
+        wedge: dispatcher hard-dead, uploader parked on the hand-off)."""
+        from celestia_app_tpu.parallel import pipeline as pl
+
+        monkeypatch.setattr(pl.threading.Thread, "start", lambda self: None)
+        pipe = pl.BlockPipeline(4, depth=1)  # workers never actually run
+        pipe._error = RuntimeError("hard death")
+        pipe._tasks.put((self._blocks(1)[0][1], 0))  # intake full
+        pipe._done.put(pl._SENTINEL)  # what the death wrapper force-feeds
+        with pytest.raises(RuntimeError, match="feeder failed"):
+            for _ in pipe.drain():
+                pass
+
+    def test_deferred_device_fault_feeds_the_breaker(self):
+        """A fault surfacing at the drain's sync (async dispatch defers
+        real execution errors there) still steps the ladder."""
+        from celestia_app_tpu.chaos.degrade import note_async_device_failure
+
+        for _ in range(degrade.DEVICE_BREAKER.threshold):
+            note_async_device_failure("fused")
+        assert degrade.degraded_state() == {"device": "staged"}
+
+    def test_close_leak_counter_registered(self):
+        # The genuine-wedge path is (deliberately) hard to reach; pin the
+        # counter's registration + README row via the registry.
+        from celestia_app_tpu.parallel.pipeline import _close_leak_counter
+
+        c = _close_leak_counter()
+        assert c.name == "celestia_pipeline_close_leaked_total"
+
+
+class TestCrashRestartDeterminism:
+    """Satellite: kill a node between WAL fsync and broadcast; restart;
+    the node must refuse the conflicting vote and rejoin without
+    double-signing (crypto-free, like test_round_journal.py)."""
+
+    A, B = b"\xaa" * 32, b"\xbb" * 32
+
+    def test_fsync_then_crash_then_conflicting_vote_refused(self, tmp_path):
+        from celestia_app_tpu.consensus.wal import VoteWAL
+
+        path = str(tmp_path / "wal.jsonl")
+        wal = VoteWAL(path)
+        # The record-then-sign contract: may_sign journals durably FIRST.
+        assert wal.may_sign(5, 0, PREVOTE, self.A)
+        # CRASH between fsync and broadcast: no close(), no vote sent.
+        del wal
+
+        wal2 = VoteWAL(path)
+        # A different proposal at the same coordinates (the equivocation
+        # x/slashing tombstones for) draws NO signature...
+        assert not wal2.may_sign(5, 0, PREVOTE, self.B)
+        # ...but re-signing the SAME vote is allowed — how the restarted
+        # node rejoins and re-broadcasts without equivocating.
+        assert wal2.may_sign(5, 0, PREVOTE, self.A)
+        # And fresh coordinates are unaffected.
+        assert wal2.may_sign(6, 0, PREVOTE, self.B)
+        wal2.close()
+
+    def test_crash_with_torn_tail_salvages_and_stays_safe(self, tmp_path):
+        from celestia_app_tpu.consensus.wal import VoteWAL
+
+        path = str(tmp_path / "wal.jsonl")
+        chaos.install("seed=1,wal_torn_tail=1")
+        try:
+            wal = VoteWAL(path)
+            assert wal.may_sign(7, 0, PREVOTE, self.A)  # append + torn tail
+            assert wal._torn
+            del wal  # crash: the fsync'd partial record is on disk
+        finally:
+            chaos.uninstall()
+        size_before = os.path.getsize(path)
+        wal2 = VoteWAL(path)
+        # Replay salvaged: torn bytes truncated, the complete record kept.
+        assert wal2.salvaged_bytes > 0
+        assert os.path.getsize(path) == size_before - wal2.salvaged_bytes
+        assert not wal2.may_sign(7, 0, PREVOTE, self.B)
+        assert wal2.may_sign(7, 0, PREVOTE, self.A)
+        wal2.close()
+
+    def test_live_self_heal_keeps_later_records_replayable(self, tmp_path):
+        """A torn tail mid-run must not corrupt the NEXT append: the live
+        WAL truncates back to the last complete record before writing."""
+        from celestia_app_tpu.consensus.wal import VoteWAL
+
+        path = str(tmp_path / "wal.jsonl")
+        chaos.install("seed=1,wal_torn_tail=1")
+        try:
+            wal = VoteWAL(path)
+            assert wal.may_sign(1, 0, PREVOTE, self.A)  # torn after this
+            assert wal.may_sign(2, 0, PREVOTE, self.A)  # heals, then appends
+            wal.close()
+        finally:
+            chaos.uninstall()
+        wal2 = VoteWAL(path)
+        assert not wal2.may_sign(1, 0, PREVOTE, self.B)
+        assert not wal2.may_sign(2, 0, PREVOTE, self.B)
+        wal2.close()
+
+    def test_mid_file_garbage_does_not_truncate_later_records(self, tmp_path):
+        from celestia_app_tpu.consensus.wal import VoteWAL
+
+        path = str(tmp_path / "wal.jsonl")
+        wal = VoteWAL(path)
+        assert wal.may_sign(1, 0, PREVOTE, self.A)
+        wal.close()
+        with open(path, "a") as f:
+            # Newline'd mid-file corruption, including lines that PARSE
+            # as JSON but are not records (non-dicts, missing keys):
+            # replay must skip them all, never crash on them.
+            f.write("NOT-JSON-GARBAGE\n")
+            f.write("123\n")
+            f.write("null\n")
+            f.write('{"k":"vote"}\n')
+            f.write('{"k":"lock","h":3}\n')
+            # A bare \r inside garbage must NOT read as a line break —
+            # that would make everything after it look like a torn tail
+            # and TRUNCATE later valid records (a double-sign window).
+            f.write("garbage\rwith\rcarriage\rreturns\n")
+        wal2 = VoteWAL(path)
+        assert wal2.may_sign(2, 0, PREVOTE, self.A)
+        wal2.close()
+        wal3 = VoteWAL(path)
+        # Both complete records survive the garbage line between them.
+        assert not wal3.may_sign(1, 0, PREVOTE, self.B)
+        assert not wal3.may_sign(2, 0, PREVOTE, self.B)
+        wal3.close()
+
+
+class TestTransportAndSeams:
+    def test_deliver_retries_transient_then_gates_on_streak(self):
+        from celestia_app_tpu.rpc import transport
+
+        calls = {"n": 0}
+
+        def flaky(msg):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ConnectionError("blip")
+
+        streak: dict = {}
+        assert transport.deliver(flaky, {"kind": "vote", "vote": "aa"},
+                                 streak=streak, key="p", sleep=lambda s: None)
+        assert calls["n"] == 2 and streak == {}
+
+        def dead(msg):
+            raise ConnectionError("down")
+
+        # First failure exhausts the retry budget and starts the streak...
+        assert not transport.deliver(dead, {"kind": "vote", "vote": "bb"},
+                                     streak=streak, key="p",
+                                     sleep=lambda s: None)
+        n_before = calls["n"]
+        # ...after which the peer gets exactly ONE attempt per message.
+        attempts = {"n": 0}
+
+        def dead2(msg):
+            attempts["n"] += 1
+            raise ConnectionError("still down")
+
+        assert not transport.deliver(dead2, {"kind": "vote", "vote": "cc"},
+                                     streak=streak, key="p",
+                                     sleep=lambda s: None)
+        assert attempts["n"] == 1
+        assert streak["p"] == 2
+
+    def test_reorder_delay_lets_later_messages_overtake(self):
+        """An injected reorder-delay must produce genuine reordering: the
+        delayed message lands on a timer thread, so a message sent AFTER
+        it arrives FIRST."""
+        from celestia_app_tpu.rpc import transport
+
+        delivered: list[str] = []
+        streak: dict = {}
+
+        def send(msg):
+            delivered.append(msg["vote"])
+
+        chaos.install("seed=1,gossip_delay_ms=150,gossip_reorder=1.0")
+        try:
+            assert transport.deliver(send, {"kind": "vote", "vote": "late"},
+                                     streak=streak, key="p")
+            assert delivered == []  # in flight on the timer, not inline
+        finally:
+            chaos.uninstall()
+        transport.deliver(send, {"kind": "vote", "vote": "early"},
+                          streak=streak, key="p")
+        assert delivered == ["early"]  # overtook the delayed one
+        transport.drain_delayed()
+        assert delivered == ["early", "late"]
+
+    def test_mempool_insert_seam_drops_transiently(self):
+        from celestia_app_tpu.mempool import PriorityMempool
+
+        pool = PriorityMempool()
+        chaos.install("seed=1,mempool_drop=1.0")
+        try:
+            assert not pool.insert(b"tx-1", priority=1, height=1)
+            assert len(pool) == 0
+        finally:
+            chaos.uninstall()
+        # The submitter's retry (chaos gone) gets it in.
+        assert pool.insert(b"tx-1", priority=1, height=1)
+        assert len(pool) == 1
+
+    def test_rpc_handle_seam_raises_injected(self):
+        chaos.install("seed=1,rpc_fail=1.0")
+        try:
+            with pytest.raises(ChaosInjected):
+                chaos.rpc_handle()
+        finally:
+            chaos.uninstall()
+
+    def test_healthz_degraded_state(self):
+        from celestia_app_tpu.trace.exposition import health_payload
+
+        assert health_payload()["status"] == "SERVING"
+        degrade.DEVICE_DEGRADATION.degrade("fused")
+        try:
+            payload = health_payload()
+            assert payload["status"] == "DEGRADED"
+            assert payload["degraded"] == {"device": "staged"}
+        finally:
+            degrade.reset_for_tests()
+        assert health_payload()["status"] == "SERVING"

@@ -102,6 +102,81 @@ def test_label_names_pinned_and_namespace_requires_cap_helper(tmp_path):
     assert not any("capped.py" in p for p in problems)
 
 
+def test_hot_path_broad_except_requires_chaos_ok_tag(tmp_path):
+    lint = _load()
+    pkg = tmp_path / "pkg"
+    (pkg / "da").mkdir(parents=True)
+    (pkg / "rpc").mkdir()
+    # Hot-path module: one tagged handler (ok), one untagged (problem),
+    # one bare `except:` untagged (problem), narrow catches ignored.
+    (pkg / "da" / "mod.py").write_text(
+        "def f():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except Exception:  # chaos-ok: documented swallow\n"
+        "        pass\n"
+        "    try:\n"
+        "        pass\n"
+        "    except Exception:\n"
+        "        pass\n"
+        "    try:\n"
+        "        pass\n"
+        "    except:\n"
+        "        pass\n"
+        "    try:\n"
+        "        pass\n"
+        "    except ValueError:\n"
+        "        pass\n"
+        "    try:\n"
+        "        pass\n"
+        "    except BaseException:\n"  # the broader catch is no escape
+        "        pass\n"
+    )
+    # Non-hot-path module: broad catches are not this rule's business.
+    (pkg / "rpc" / "mod.py").write_text(
+        "def g():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    readme = tmp_path / "README.md"
+    readme.write_text("")
+    problems = [p for p in lint.lint(str(pkg), str(readme))
+                if "chaos-ok" in p]
+    assert len(problems) == 3
+    assert all("da" in p for p in problems)
+
+
+def test_chaos_ok_tag_on_preceding_line_counts(tmp_path):
+    # Long rationales wrap: the tag may sit on the line above the handler.
+    lint = _load()
+    pkg = tmp_path / "pkg"
+    (pkg / "kernels").mkdir(parents=True)
+    (pkg / "kernels" / "mod.py").write_text(
+        "def f():\n"
+        "    try:\n"
+        "        pass\n"
+        "    # chaos-ok: the rationale wrapped onto its own line\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    readme = tmp_path / "README.md"
+    readme.write_text("")
+    assert [p for p in lint.lint(str(pkg), str(readme))
+            if "chaos-ok" in p] == []
+
+
+def test_in_tree_hot_path_broad_excepts_all_tagged():
+    # The real package already satisfies the rule (lint() clean is
+    # asserted above); additionally pin that the collector actually SEES
+    # in-tree sites, so the rule is enforced against something real.
+    lint = _load()
+    sites = lint.collect_broad_excepts()
+    assert sites, "expected in-tree hot-path broad except handlers"
+    assert all(tagged for _, _, tagged in sites)
+
+
 def test_in_tree_namespace_labels_all_route_through_the_cap(tmp_str=None):
     # The real package must already satisfy the new rules (lint() clean
     # is asserted above); additionally pin that the modules known to
