@@ -1,21 +1,30 @@
 """Graceful degradation: the device-path circuit breaker and mode ladder.
 
 The device pipeline sits on the consensus hot path, so a dispatch failure
-must degrade LATENCY, never correctness.  All three lowerings of the
+must degrade LATENCY, never correctness.  All four lowerings of the
 extend+DAH pipeline are bit-identical (pinned on the golden vectors), so
 stepping down the ladder
 
-    fused  ->  staged  ->  host
+    fused_epi  ->  fused  ->  staged  ->  host
 
 changes how a block's roots are computed, never what they are — a
 degraded validator keeps signing the same DAH roots as its healthy peers.
 
+  * fused_epi: the fused program with the leaf-hash epilogue (column
+    extend feeds the bottom half's NMT leaf rounds from VMEM,
+    kernels/rs_xor) — active only when the autotuner seats it
+    ($CELESTIA_PIPE_FUSED=epi); its custom kernel is the most exotic
+    lowering, so it is the first rung distrusted;
   * fused:  one donated single-dispatch jitted program (the default);
   * staged: the extend-then-hash jit pair (da/eds._pipeline) — the
     escape hatch when the fused program itself is what keeps faulting;
   * host:   the same staged composition executed EAGERLY (op-by-op, no
     compiled program dispatch) — the floor when compiled execution on
     this process keeps failing at all.
+
+A process based below the top rung enters the ladder where its env put
+it (base "fused" never climbs to "fused_epi"): degradation only ever
+steps DOWN from the seated mode.
 
 `guarded_dispatch` wraps every extend+DAH dispatch: bounded exponential
 backoff retries within a rung, and a consecutive-failure circuit breaker
@@ -41,7 +50,7 @@ from __future__ import annotations
 import threading
 import time
 
-LADDER = ("fused", "staged", "host")
+LADDER = ("fused_epi", "fused", "staged", "host")
 
 #: Consecutive same-rung dispatch failures before the breaker trips and
 #: the ladder steps down ($CELESTIA_BREAKER_THRESHOLD).
@@ -253,12 +262,12 @@ def guarded_dispatch(resolve, x, *, refresh=None,
                 _recoveries().inc(seam="device.dispatch", outcome="retried")
             return mode, out
         except Exception as e:  # chaos-ok: every rung retries, the floor re-raises
-            if (refresh is not None and mode == "fused"
+            if (refresh is not None and mode in ("fused", "fused_epi")
                     and not isinstance(e, ChaosInjected)):
-                # Only the fused rung donates, so only ITS real failures
-                # can have consumed the input; refresh is itself guarded —
-                # an upload blip during recovery must feed the normal
-                # retry/degrade accounting, not abort it.
+                # Only the fused-family rungs donate, so only THEIR real
+                # failures can have consumed the input; refresh is itself
+                # guarded — an upload blip during recovery must feed the
+                # normal retry/degrade accounting, not abort it.
                 try:
                     x = refresh()
                 except Exception:  # chaos-ok: next attempt re-lands here

@@ -167,10 +167,13 @@ class ChaosInjector:
     # --- seams --------------------------------------------------------------
     def device_dispatch(self, mode: str) -> None:
         """Stall and/or fail one extend+DAH dispatch.  `dispatch_fail`
-        targets the fused lowering only (modeling a device-path fault the
-        ladder can step away from) unless `dispatch_fail_all` widens it."""
+        targets the fused-family lowerings only — "fused" and the
+        leaf-hash-epilogue "fused_epi" rung above it (modeling a
+        device-path fault the ladder can step away from) — unless
+        `dispatch_fail_all` widens it."""
         self._stall("device.dispatch", "dispatch_stall_ms", "dispatch_stall")
-        applies = mode == "fused" or self._p("dispatch_fail_all") > 0
+        applies = (mode in ("fused", "fused_epi")
+                   or self._p("dispatch_fail_all") > 0)
         if applies and self._fire("device.dispatch", "dispatch_fail"):
             self._count("device.dispatch", "dispatch_fail")
             raise ChaosInjected("device.dispatch", "dispatch_fail")

@@ -121,8 +121,10 @@ def pipeline_cache_state(
 
     construction = construction or active_construction()
     mode = pipeline_mode()
-    if mode == "fused":
-        return "hit" if is_built(k, construction, donate=owned) else "miss"
+    if mode in ("fused", "fused_epi"):
+        return "hit" if is_built(
+            k, construction, donate=owned, epilogue=(mode == "fused_epi")
+        ) else "miss"
     if mode == "host":
         return "hit"  # eager: nothing compiles, nothing can miss
     return "hit" if (k, construction) in _STAGED_BUILT else "miss"
@@ -155,8 +157,10 @@ def _pipeline_for_mode(
     from celestia_app_tpu.kernels.fused import jit_extend_and_dah
 
     construction = construction or active_construction()
-    if mode == "fused":
-        return jit_extend_and_dah(k, construction, donate=owned)
+    if mode in ("fused", "fused_epi"):
+        return jit_extend_and_dah(
+            k, construction, donate=owned, epilogue=(mode == "fused_epi")
+        )
     if mode == "host":
         return _host_pipeline(k, construction)
     return _jit_pipeline(k, construction)
@@ -257,10 +261,10 @@ def _maybe_parity_check(ods_host, k: int, construction: str, droot) -> None:
         return
     from celestia_app_tpu.kernels.fused import pipeline_mode
 
-    if pipeline_mode() != "fused":
-        # Staged mode already IS the reference lowering: re-running it
-        # against itself would burn a duplicate dispatch to report a
-        # meaningless "match".
+    if pipeline_mode() not in ("fused", "fused_epi"):
+        # Staged mode (and its eager host twin) already IS the reference
+        # lowering: re-running it against itself would burn a duplicate
+        # dispatch to report a meaningless "match".
         return
     global _PARITY_COUNT
     with _PARITY_LOCK:

@@ -66,19 +66,23 @@ def _silence_unusable_donation_warning() -> None:
 
 
 def pipeline_mode() -> str:
-    """The active extend+DAH lowering: "fused" (default), "staged", or
-    "host" (all three bit-identical).
+    """The active extend+DAH lowering: "fused" (default), "fused_epi",
+    "staged", or "host" (all four bit-identical).
 
-    $CELESTIA_PIPE_FUSED: "on" / "off" / "auto" (default).  Auto is fused —
-    the fused program is bit-identical to the staged pair (pinned on the
-    golden vectors) and at worst matches it, so the staged path exists as a
-    bench A/B candidate and an escape hatch, not a default.  The bench
-    autotuner flips this env for the rows the staged pair wins.
+    $CELESTIA_PIPE_FUSED: "on" / "off" / "epi" / "auto" (default).  Auto
+    is fused — the fused program is bit-identical to the staged pair
+    (pinned on the golden vectors) and at worst matches it, so the staged
+    path exists as a bench A/B candidate and an escape hatch, not a
+    default.  "epi" selects the leaf-hash-epilogue variant (the column-
+    phase extend feeds the bottom half's NMT leaf rounds from VMEM,
+    kernels/rs_xor.extend_leaf_digests).  The bench autotuner flips this
+    env for whichever candidate the parts row seats.
 
     The env choice is then floored by the degradation ladder
     (chaos/degrade.py): a process whose device dispatches keep failing is
-    stepped fused -> staged -> host by the circuit breaker, and because
-    every caller routes through here, all of them move together.
+    stepped fused_epi -> fused -> staged -> host by the circuit breaker,
+    and because every caller routes through here, all of them move
+    together.
     """
     from celestia_app_tpu.chaos.degrade import effective_device_mode
 
@@ -89,11 +93,19 @@ def env_base_mode() -> str:
     """The env-selected base lowering, WITHOUT the degradation ladder
     applied — the single parse of $CELESTIA_PIPE_FUSED (the ladder steps
     relative to this, so two copies of the branch must never diverge)."""
-    return "staged" if os.environ.get("CELESTIA_PIPE_FUSED", "auto") == "off" else "fused"
+    val = os.environ.get("CELESTIA_PIPE_FUSED", "auto")
+    if val == "off":
+        return "staged"
+    if val == "epi":
+        return "fused_epi"
+    return "fused"
 
 
 def extend_and_dah_fn(
-    k: int, construction: str | None = None, roots_only: bool = False
+    k: int,
+    construction: str | None = None,
+    roots_only: bool = False,
+    epilogue: bool = False,
 ):
     """Build the fused program for square size k.
 
@@ -102,8 +114,23 @@ def extend_and_dah_fn(
       roots_only=True  -> (row_roots, col_roots, droot)
     with eds (2k, 2k, S), roots (2k, 90), droot (32,).  The RS construction
     is resolved at build time; callers caching the result must key on it.
+
+    epilogue=True is the LEAF-HASH-EPILOGUE variant (pipeline mode
+    "fused_epi"): the column-phase extend feeds the bottom half's NMT
+    leaf rounds directly from VMEM (kernels/rs_xor.extend_leaf_digests on
+    TPU; the same ops staged through XLA elsewhere), so the bottom shares
+    land in HBM once as output instead of round-tripping before hashing.
+    It splits the leaf batch in two — the earlier experiment that split
+    WITHOUT fusing into the extend measured slower, which is exactly why
+    this variant is a tuned-seat candidate (bench parts row, >3%
+    hysteresis) and not the default.  Bit-identical either way.
     """
     encode = encode_fn(k, construction)
+    bottom_fn = None
+    if epilogue:
+        from celestia_app_tpu.kernels.rs_xor import bottom_leaf_fn
+
+        bottom_fn = bottom_leaf_fn(k, construction, fallback_encode=encode)
 
     def run(ods: jnp.ndarray):
         parity = jnp.frombuffer(PARITY_NAMESPACE_BYTES, dtype=jnp.uint8)
@@ -112,21 +139,40 @@ def extend_and_dah_fn(
         top = jnp.concatenate([ods, q1], axis=1)  # (k, 2k, S)
         # Column phase contracts over the row axis directly — Q2/Q3 arrive
         # as the bottom rows with no transpose (row/col encodes commute).
-        bottom = encode(top, 0)  # (k, 2k, S)
-        eds = jnp.concatenate([top, bottom], axis=0)  # (2k, 2k, S)
+        if epilogue:
+            # Bottom shares + their (constant-namespace) leaf digests in
+            # one program; only the top half still needs per-leaf
+            # namespace bookkeeping (Q0 own ns, Q1 parity).
+            bottom, bot_hashes = bottom_fn(top)  # (k,2k,S), (k,2k,32)
+            eds = jnp.concatenate([top, bottom], axis=0)
+            col = jnp.arange(2 * k)
+            top_ns = jnp.where(
+                (col < k)[None, :, None], top[..., :NAMESPACE_SIZE], parity
+            )
+            t_mins, t_maxs, t_hashes = leaf_digests(top_ns, top)
+            par_ns = jnp.broadcast_to(parity, (k, 2 * k, NAMESPACE_SIZE))
+            mins = jnp.concatenate([t_mins, par_ns], axis=0)
+            maxs = jnp.concatenate([t_maxs, par_ns], axis=0)
+            hashes = jnp.concatenate([t_hashes, bot_hashes], axis=0)
+        else:
+            bottom = encode(top, 0)  # (k, 2k, S)
+            eds = jnp.concatenate([top, bottom], axis=0)  # (2k, 2k, S)
 
-        # Q0 leaves carry the share's own namespace, every parity leaf the
-        # parity namespace (pkg/wrapper/nmt_wrapper.go:93-114).  All 4k^2
-        # leaves hash in ONE batched call — splitting by half measured
-        # slower (smaller SHA batches, same serial schedule).
-        idx = jnp.arange(2 * k)
-        q0 = (idx[:, None] < k) & (idx[None, :] < k)
-        row_ns = jnp.where(q0[..., None], eds[..., :NAMESPACE_SIZE], parity)
+            # Q0 leaves carry the share's own namespace, every parity leaf
+            # the parity namespace (pkg/wrapper/nmt_wrapper.go:93-114).
+            # All 4k^2 leaves hash in ONE batched call — splitting by half
+            # measured slower (smaller SHA batches, same serial schedule).
+            idx = jnp.arange(2 * k)
+            q0 = (idx[:, None] < k) & (idx[None, :] < k)
+            row_ns = jnp.where(
+                q0[..., None], eds[..., :NAMESPACE_SIZE], parity
+            )
 
-        # The digest at (i, j) serves both the row-i and col-j trees, so
-        # each leaf is hashed exactly once and the column reduction runs on
-        # the transpose (leaf hashing is 9 SHA-256 blocks vs 3 for nodes).
-        mins, maxs, hashes = leaf_digests(row_ns, eds)
+            # The digest at (i, j) serves both the row-i and col-j trees,
+            # so each leaf is hashed exactly once and the column reduction
+            # runs on the transpose (leaf hashing is 9 SHA-256 blocks vs 3
+            # for nodes).
+            mins, maxs, hashes = leaf_digests(row_ns, eds)
         row_roots = tree_roots_from_digests(mins, maxs, hashes)  # (2k, 90)
         col_roots = tree_roots_from_digests(
             mins.transpose(1, 0, 2),
@@ -155,25 +201,27 @@ def is_built(
     *,
     donate: bool = False,
     roots_only: bool = False,
+    epilogue: bool = False,
 ) -> bool:
-    key = (k, construction or active_construction(), donate, roots_only)
+    key = (k, construction or active_construction(), donate, roots_only,
+           epilogue)
     return key in _BUILT_KEYS
 
 
 @lru_cache(maxsize=None)
 def _jit_extend_and_dah(
-    k: int, construction: str, donate: bool, roots_only: bool
+    k: int, construction: str, donate: bool, roots_only: bool, epilogue: bool
 ):
     if donate:
         _silence_unusable_donation_warning()
     # Body runs on cache miss only: note the build for the journal's
     # hit/miss column and the celestia_jit_builds_total counter.
-    _BUILT_KEYS.add((k, construction, donate, roots_only))
+    _BUILT_KEYS.add((k, construction, donate, roots_only, epilogue))
     from celestia_app_tpu.trace.journal import note_jit_build
 
     note_jit_build("extend_and_dah")
     return jax.jit(
-        extend_and_dah_fn(k, construction, roots_only),
+        extend_and_dah_fn(k, construction, roots_only, epilogue=epilogue),
         donate_argnums=(0,) if donate else (),
     )
 
@@ -184,9 +232,10 @@ def jit_extend_and_dah(
     *,
     donate: bool = False,
     roots_only: bool = False,
+    epilogue: bool = False,
 ):
     """Cached jitted fused pipeline, keyed on (k, RS construction, donate,
-    roots_only).
+    roots_only, epilogue).
 
     donate=True invalidates the caller's ODS device buffer — only pass it
     for a buffer the pipeline owns (a fresh `jnp.asarray` upload, a feeder
@@ -196,5 +245,6 @@ def jit_extend_and_dah(
     the hint and keep the copy — semantics are unchanged either way.
     """
     return _jit_extend_and_dah(
-        k, construction or active_construction(), donate, roots_only
+        k, construction or active_construction(), donate, roots_only,
+        epilogue,
     )

@@ -144,17 +144,35 @@ def _use_pallas_rs(k: int, m: int) -> bool:
     return pallas_supported(k, m)
 
 
+def _use_xor_rs(k: int, m: int) -> bool:
+    """$CELESTIA_RS_XOR: "on" / "off" (default).  The bitsliced XOR/AND-
+    popcount Pallas lowering (kernels/rs_xor.py): no MXU, no int32
+    accumulator, no 8x bit inflation — the arXiv 2108.02692 schedule.
+    Opt-in until a chip run; the bench autotuner measures it as the
+    rs_xor parts candidate and flips this env for the rows it wins.
+    Off-TPU the kernel runs in interpret mode (slow but correct), so the
+    seam is CPU-runnable."""
+    import os
+
+    if os.environ.get("CELESTIA_RS_XOR", "off") != "on":
+        return False
+    from celestia_app_tpu.kernels.rs_xor import xor_supported
+
+    return xor_supported(k, m)
+
+
 def encode_fn(k: int, construction: str | None = None):
     """The encode-path selector: f(data, contract_axis) -> parity shares.
 
-    ONE owner for the FFT-vs-dense-vs-pallas policy — both the single-chip
-    square extension and the sharded pipeline build their encode through
-    here, so the selection (and any future threshold/env change) cannot
-    diverge between them.  Auto picks per platform and size (see
-    _fft_choice for the measured rationale: dense on TPU, md-FFT on other
-    platforms at k >= 512); CELESTIA_RS_FFT=on forces the additive-FFT
-    butterflies and CELESTIA_RS_PALLAS=on the fused Pallas dense kernel —
-    identical bytes any way.
+    ONE owner for the FFT-vs-dense-vs-pallas-vs-xor policy — both the
+    single-chip square extension and the sharded pipeline build their
+    encode through here, so the selection (and any future threshold/env
+    change) cannot diverge between them.  Auto picks per platform and
+    size (see _fft_choice for the measured rationale: dense on TPU,
+    md-FFT on other platforms at k >= 512); CELESTIA_RS_FFT=on forces
+    the additive-FFT butterflies, CELESTIA_RS_PALLAS=on the fused Pallas
+    dense kernel, and CELESTIA_RS_XOR=on the bitsliced XOR schedule
+    (kernels/rs_xor.py) — identical bytes any way.
     """
     from celestia_app_tpu.gf.rs import active_construction as _active
 
@@ -176,6 +194,16 @@ def encode_fn(k: int, construction: str | None = None):
 
         def encode(data: jnp.ndarray, contract_axis: int = 1) -> jnp.ndarray:
             return encode_axis_pallas(data, G_bits_pl, m, contract_axis)
+    elif _use_xor_rs(k, m):
+        from celestia_app_tpu.kernels.rs_xor import (
+            encode_axis_xor,
+            pack_generator_words,
+        )
+
+        G_words = jnp.asarray(pack_generator_words(codec.generator_bits()))
+
+        def encode(data: jnp.ndarray, contract_axis: int = 1) -> jnp.ndarray:
+            return encode_axis_xor(data, G_words, m, contract_axis)
     else:
         G_bits = jnp.asarray(codec.generator_bits())
 

@@ -246,7 +246,13 @@ def _leaf_tile_compute(ns_tile, share_tile, tn: int):
 
     Pure jnp — the pallas kernel wraps exactly this function, and the
     off-TPU tests jit it directly (interpret mode cannot execute the
-    ~7k-op unrolled round structure in reasonable time)."""
+    ~7k-op unrolled round structure in reasonable time).
+
+    SEAM: kernels/rs_xor._epi_kernel (the extend+leaf-hash epilogue,
+    pipeline mode "fused_epi") also wraps this function, feeding it
+    column-phase extend tiles straight from VMEM — keep the signature
+    and digest semantics stable or both fused paths fork at once (the
+    shared function is what makes their digests provably identical)."""
     k_chunks = _K.reshape(4, 16)
     # 34 tail bytes (0x80, zeros, bit length) as python ints: a captured
     # constant ARRAY would have to be a pallas input; scalar fulls go

@@ -46,6 +46,16 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 GATED_MODES = ("compute",)
 # Modes bound by the host<->device link; reported, not gated by default.
 LINK_BOUND_MODES = ("extend", "stream", "repair", "host")
+# Parts candidates only measured on TPU (the Pallas lowerings): their
+# absence from a CPU-fallback round is a platform gap, not a stale series
+# — the trend gate must not cry STALE when a chip round simply didn't
+# happen.  fused / fused_epi are NOT here: bench measures them on every
+# platform (the epilogue rides an XLA composition off-chip), so they are
+# never absent — cross-platform comparability is instead handled by the
+# regression gate's same-platform rule below.
+HW_GATED_PARTS = (
+    "rs_dense_pl", "rs_xor", "nmt_dah_pallas", "nmt_dah_plf",
+)
 
 _MODE_ROW_RE = re.compile(r'\{"mode":\s*"[a-z_]+",\s*"k":\s*\d+[^{}]*\}')
 _STABILITY_RE = re.compile(r'"stability_pct":\s*([0-9.]+)')
@@ -117,7 +127,8 @@ def load_round(path: str) -> dict:
     """One round's recoverable record:
 
     {round, rc, ok, partial, platform, headline, stability_pct, errors,
-     modes: {(mode, k): [mb_per_s, ...]}, parts: {name: seconds} | None}
+     modes: {(mode, k): [mb_per_s, ...]}, parts: {name: seconds} | None,
+     tuned: {rs, sha, pipe} | None, applied: {rs, sha, pipe} | None}
     """
     try:
         with open(path, encoding="utf-8") as f:
@@ -139,6 +150,8 @@ def load_round(path: str) -> dict:
         "errors": None,
         "modes": {},
         "parts": None,
+        "tuned": None,
+        "applied": None,
     }
     summary = raw.get("parsed")
     if not isinstance(summary, dict):
@@ -166,6 +179,10 @@ def load_round(path: str) -> dict:
         rec["parts"] = {
             str(n): float(s) for n, s in parts["seconds"].items()
         }
+        for seat_key in ("tuned", "applied"):
+            seats = parts.get(seat_key)
+            if isinstance(seats, dict):
+                rec[seat_key] = {str(a): str(b) for a, b in seats.items()}
     return rec
 
 
@@ -206,22 +223,52 @@ def _stability(rounds: list[dict], rnd: int) -> float:
     return 0.0
 
 
+def _comparable_priors(
+    pts: list[tuple[int, float]], platforms: dict[int, str | None]
+) -> list[float]:
+    """Prior datapoints the newest one may fairly be compared against.
+
+    A CPU-fallback round's numbers are not a regression against a chip
+    round's (a fused_epi measured at CPU speed after a TPU round would
+    read as a 100x collapse): a prior whose platform is KNOWN and
+    DIFFERENT from the newest round's known platform is excluded.
+    Unknown platforms (salvaged tails carry none) stay comparable on
+    BOTH sides — dropping them would silently weaken the gate for
+    exactly the rounds that already lost their results array, and the
+    legacy all-priors behavior is what the checked-in r01..r05 series
+    were gated under."""
+    last_round = pts[-1][0]
+    plat = platforms.get(last_round)
+    priors = pts[:-1]
+    if plat is not None:
+        priors = [
+            p for p in priors
+            if platforms.get(p[0]) in (None, plat)
+        ]
+    return [v for _, v in priors]
+
+
 def find_regressions(
     rounds: list[dict],
     threshold_pct: float,
     gate_modes: tuple[str, ...] = GATED_MODES,
     gate_all: bool = False,
 ) -> list[dict]:
-    """Newest datapoint vs best earlier datapoint per gated series; the
-    effective threshold widens by the newest round's stability_pct."""
+    """Newest datapoint vs best earlier SAME-PLATFORM datapoint per gated
+    series (see _comparable_priors); the effective threshold widens by
+    the newest round's stability_pct."""
+    platforms = {r["round"]: r.get("platform") for r in rounds}
     out = []
     for (mode, k), pts in sorted(mode_series(rounds).items()):
         if not gate_all and mode not in gate_modes:
             continue
         if len(pts) < 2:
             continue
+        priors = _comparable_priors(pts, platforms)
+        if not priors:
+            continue  # nothing measured on this platform before
         last_round, last = pts[-1]
-        best_prior = max(v for _, v in pts[:-1])
+        best_prior = max(priors)
         if best_prior <= 0:
             continue
         allowed = threshold_pct + _stability(rounds, last_round)
@@ -235,8 +282,11 @@ def find_regressions(
     for name, pts in sorted(parts_series(rounds).items()):
         if len(pts) < 2:
             continue
+        priors = _comparable_priors(pts, platforms)
+        if not priors:
+            continue
         last_round, last = pts[-1]
-        best_prior = min(v for _, v in pts[:-1])
+        best_prior = min(priors)
         if best_prior <= 0:
             continue
         allowed = threshold_pct + _stability(rounds, last_round)
@@ -250,6 +300,43 @@ def find_regressions(
     return out
 
 
+def seat_changes(rounds: list[dict]) -> list[dict]:
+    """Tuned-seat flips between consecutive rounds that recorded a tuner
+    verdict.  A flip (e.g. rs rs_dense -> rs_xor) is NEWS, not a fault:
+    the >3% hysteresis already demanded a real win, so the trend tool
+    names it a seat change — otherwise a newly seated candidate reads as
+    a series appearing from nowhere while the dethroned incumbent's
+    series looks abandoned."""
+    seated = [r for r in rounds if r["tuned"]]
+    out = []
+    for prev, cur in zip(seated, seated[1:]):
+        for key in sorted(set(prev["tuned"]) | set(cur["tuned"])):
+            a, b = prev["tuned"].get(key), cur["tuned"].get(key)
+            if a is not None and b is not None and a != b:
+                out.append({
+                    "seat": key, "from": a, "to": b,
+                    "from_round": prev["round"], "round": cur["round"],
+                })
+    return out
+
+
+def seat_overrides(rounds: list[dict]) -> list[dict]:
+    """Seats where the newest round's APPLIED config diverges from its
+    tuner pick — an operator-set env knob won over the autotuner (the
+    bench honors operator knobs by design).  Worth a line: later rows in
+    that round did NOT run the tuner's winner, so its series reflect the
+    operator's choice, not the measured-best."""
+    for r in reversed(rounds):
+        if r["tuned"] and r["applied"]:
+            return [
+                {"seat": k, "tuned": r["tuned"][k],
+                 "applied": r["applied"][k], "round": r["round"]}
+                for k in sorted(set(r["tuned"]) & set(r["applied"]))
+                if r["tuned"][k] != r["applied"][k]
+            ]
+    return []
+
+
 def stale_gated_series(
     rounds: list[dict],
     gate_modes: tuple[str, ...] = GATED_MODES,
@@ -259,12 +346,26 @@ def stale_gated_series(
     recorded ANY data — the gate is comparing stale numbers for them (the
     checked-in compute rows stop at r03 because the r04/r05 tails lost
     the results array).  Reported loudly, not failed: a truncated tail
-    must not mask the rounds that DID measure."""
+    must not mask the rounds that DID measure.
+
+    Hardware-gated parts candidates (HW_GATED_PARTS) absent from a
+    newest round that did not run on the chip get `hw_gated: True`
+    instead: a CPU-fallback round CANNOT measure them, so their absence
+    is a platform gap, not a stale series the gate should shout about.
+    """
     newest = max(
         (r["round"] for r in rounds if r["modes"] or r["parts"]), default=None
     )
     if newest is None:
         return []
+    newest_rec = next(r for r in rounds if r["round"] == newest)
+    # The hw-gated downgrade ("this candidate CANNOT be measured off the
+    # chip") only applies when the newest round's platform is KNOWN and
+    # non-TPU.  Unknown (a salvaged tail lost the tag) stays on the STALE
+    # path: claiming "no chip" for a round that may well have been the
+    # chip would hide that the gate is comparing stale chip numbers.
+    plat = newest_rec.get("platform")
+    newest_known_off_chip = plat is not None and plat != "tpu"
     out = []
     for (mode, k), pts in sorted(mode_series(rounds).items()):
         if not gate_all and mode not in gate_modes:
@@ -274,8 +375,11 @@ def stale_gated_series(
                         "newest_round": newest})
     for name, pts in sorted(parts_series(rounds).items()):
         if pts[-1][0] < newest:
-            out.append({"series": f"parts.{name}", "last_round": pts[-1][0],
-                        "newest_round": newest})
+            entry = {"series": f"parts.{name}", "last_round": pts[-1][0],
+                     "newest_round": newest}
+            if name in HW_GATED_PARTS and newest_known_off_chip:
+                entry["hw_gated"] = True
+            out.append(entry)
     return out
 
 
@@ -400,21 +504,41 @@ def main(argv: list[str] | None = None) -> int:
         rounds, args.threshold, gate_all=args.all_series
     )
     stale = stale_gated_series(rounds, gate_all=args.all_series)
+    seats = seat_changes(rounds)
+    overrides = seat_overrides(rounds)
     if args.metrics_out:
         write_metrics_out(args.metrics_out, rounds, regressions)
     if args.json:
         print(json.dumps({
             "rounds": [r["round"] for r in rounds],
             "regressions": regressions,
-            "stale": stale,
+            "stale": [s for s in stale if not s.get("hw_gated")],
+            "hw_gated": [s for s in stale if s.get("hw_gated")],
+            "seat_changes": seats,
+            "seat_overrides": overrides,
             "threshold_pct": args.threshold,
         }))
     else:
         print(render_table(rounds))
+        for c in seats:
+            print(f"  SEAT CHANGE: {c['seat']} {c['from']} -> {c['to']} "
+                  f"(r{c['from_round']:02d} -> r{c['round']:02d}; the >3% "
+                  "hysteresis demanded a real win, so series moving between "
+                  "these candidates is expected, not a regression)")
+        for o in overrides:
+            print(f"  OPERATOR OVERRIDE: {o['seat']} ran {o['applied']} in "
+                  f"r{o['round']:02d} though the tuner picked {o['tuned']} — "
+                  "that round's later rows reflect the operator's knob")
         for s in stale:
-            print(f"  STALE: gated series {s['series']} last measured in "
-                  f"r{s['last_round']:02d} (newest data is "
-                  f"r{s['newest_round']:02d}) — the gate compares old numbers")
+            if s.get("hw_gated"):
+                print(f"  hw-gated: {s['series']} not measurable in "
+                      f"r{s['newest_round']:02d} (no chip; last chip value "
+                      f"r{s['last_round']:02d}) — platform gap, not stale")
+            else:
+                print(f"  STALE: gated series {s['series']} last measured in "
+                      f"r{s['last_round']:02d} (newest data is "
+                      f"r{s['newest_round']:02d}) — the gate compares old "
+                      "numbers")
         if regressions:
             print("regressions:")
             for r in regressions:

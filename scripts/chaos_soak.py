@@ -22,9 +22,14 @@ LATENCY but never CORRECTNESS.  Four drills, one process:
                        message set (drops healed by redundancy+retry,
                        dups absorbed).
   4. breaker drill   — a persistent injected device failure must flip
-                       `pipeline_mode()` fused -> staged within the
-                       breaker window, with `celestia_degraded` and
-                       /healthz reporting the degraded state.
+                       `pipeline_mode()` down the ladder to staged
+                       within the breaker window, with
+                       `celestia_degraded` and /healthz reporting the
+                       degraded state.  Runs twice: from the default
+                       fused seat AND from the leaf-hash-epilogue seat
+                       ($CELESTIA_PIPE_FUSED=epi), which must walk the
+                       extra fused_epi -> fused rung first — whichever
+                       mode the autotuner seats, the ladder holds.
 
 Run:
   JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/chaos_soak.py \
@@ -232,9 +237,16 @@ def run_gossip_drill(spec: str, n_msgs: int = 40, max_rounds: int = 12) -> dict:
     }
 
 
-def run_breaker_drill(k: int = 4) -> dict:
+def run_breaker_drill(k: int = 4, base_env: str | None = None) -> dict:
     """A persistent injected device failure must flip the ladder to
-    staged within the breaker window, visible on /healthz."""
+    staged within the breaker window, visible on /healthz.
+
+    `base_env` pins $CELESTIA_PIPE_FUSED for the drill (e.g. "epi" to
+    start from the leaf-hash-epilogue seat the autotuner may install —
+    dispatch_fail targets the whole fused family, so that seat walks the
+    extra fused_epi -> fused rung before landing on staged).  None keeps
+    the ambient env.
+    """
     from celestia_app_tpu import chaos
     from celestia_app_tpu.chaos import degrade
     from celestia_app_tpu.da.eds import ExtendedDataSquare
@@ -242,6 +254,9 @@ def run_breaker_drill(k: int = 4) -> dict:
     from celestia_app_tpu.kernels.fused import pipeline_mode
     from celestia_app_tpu.trace.exposition import health_payload
 
+    saved_pipe = os.environ.get("CELESTIA_PIPE_FUSED")
+    if base_env is not None:
+        os.environ["CELESTIA_PIPE_FUSED"] = base_env
     chaos.install("")  # chaos-free even when $CELESTIA_CHAOS is set
     degrade.reset_for_tests()
     ods = np.zeros((k, k, SHARE_SIZE), dtype=np.uint8)
@@ -253,6 +268,11 @@ def run_breaker_drill(k: int = 4) -> dict:
         health = health_payload()
     finally:
         chaos.uninstall()
+        if base_env is not None:
+            if saved_pipe is None:
+                os.environ.pop("CELESTIA_PIPE_FUSED", None)
+            else:
+                os.environ["CELESTIA_PIPE_FUSED"] = saved_pipe
     result = {
         "mode_after": mode,
         "health_status": health.get("status"),
@@ -315,6 +335,13 @@ def main(argv=None) -> int:
           f"(converged={gos['converged']})", flush=True)
     if not gos["ok"]:
         failures.append(f"gossip drill failed: {gos}")
+
+    brk_epi = run_breaker_drill(k=min(args.k, 8), base_env="epi")
+    print(f"breaker drill (epi seat): mode_after={brk_epi['mode_after']} "
+          f"health={brk_epi['health_status']} "
+          f"roots_identical={brk_epi['roots_identical']}", flush=True)
+    if not brk_epi["ok"]:
+        failures.append(f"breaker drill (epi seat) failed: {brk_epi}")
 
     brk = run_breaker_drill(k=min(args.k, 8))
     print(f"breaker drill: mode_after={brk['mode_after']} "

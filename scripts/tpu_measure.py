@@ -81,15 +81,20 @@ def main() -> int:
     if out["platform"] == "tpu":
         from celestia_app_tpu.gf.rs import codec_for_width
         from celestia_app_tpu.kernels.rs_pallas import pallas_supported
+        from celestia_app_tpu.kernels.rs_xor import xor_supported
 
-        if pallas_supported(k, codec_for_width(k).field.m):
+        m_field = codec_for_width(k).field.m
+        if pallas_supported(k, m_field):
             rs_flags.append(
                 ("dense_pl",
                  {"CELESTIA_RS_FFT": "off", "CELESTIA_RS_PALLAS": "on"}))
+        if xor_supported(k, m_field):
+            rs_flags.append(
+                ("xor", {"CELESTIA_RS_FFT": "off", "CELESTIA_RS_XOR": "on"}))
     checksums = {}
     for label, flags in rs_flags:
         for var in ("CELESTIA_RS_FFT", "CELESTIA_RS_FFT_MD",
-                    "CELESTIA_RS_PALLAS"):
+                    "CELESTIA_RS_PALLAS", "CELESTIA_RS_XOR"):
             os.environ.pop(var, None)
         os.environ.update(flags)
         fn = jax.jit(extend_square_fn(k))
@@ -104,7 +109,7 @@ def main() -> int:
         out["rs_all"][label] = [round(t, 4) for t in ts]
         print(f"# rs {label}: median {med:.4f}s (compile+first {compile_s:.1f}s) {ts}", flush=True)
     for var in ("CELESTIA_RS_FFT", "CELESTIA_RS_FFT_MD",
-                "CELESTIA_RS_PALLAS"):
+                "CELESTIA_RS_PALLAS", "CELESTIA_RS_XOR"):
         os.environ.pop(var, None)
     out["rs_checksums_equal"] = len(set(checksums.values())) == 1
     assert out["rs_checksums_equal"], f"RS variants disagree: {checksums}"
@@ -158,6 +163,17 @@ def main() -> int:
     mb = (k * k * SHARE_SIZE) / 1e6
     out["pipeline_mb_s"] = round(mb / med, 1)
     print(f"# pipeline: {med:.4f}s = {mb / med:.1f} MB/s", flush=True)
+
+    # --- leaf-hash-epilogue pipeline variant (fused_epi candidate) ---
+    if out["platform"] == "tpu":
+        from celestia_app_tpu.kernels.fused import extend_and_dah_fn
+
+        epi = jax.jit(extend_and_dah_fn(k, epilogue=True))
+        jax.block_until_ready(epi(warm)[3])
+        med, ts = timed(lambda x: epi(x)[3], variants(iters, base=40))
+        out["pipeline_epi"] = round(med, 4)
+        out["pipeline_epi_mb_s"] = round(mb / med, 1)
+        print(f"# pipeline_epi: {med:.4f}s = {mb / med:.1f} MB/s", flush=True)
 
     print(json.dumps(out), flush=True)
     return 0

@@ -131,6 +131,159 @@ class TestRegressionGate:
         assert bt.main(["--dir", str(tmp_path), "--all-series"]) == 1
 
 
+def _round_file_with_parts(tmp_path, n, parts_seconds, tuned=None,
+                           results=None, platform=None, applied=None):
+    summary = {
+        "metric": "x", "value": 1.0, "unit": "MB/s",
+        "results": results or [],
+        "parts": {"k": 512, "seconds": parts_seconds,
+                  **({"tuned": tuned} if tuned else {}),
+                  **({"applied": applied} if applied else {})},
+    }
+    if platform:
+        summary["platform"] = platform
+    path = tmp_path / f"BENCH_r{n:02d}.json"
+    path.write_text(json.dumps({
+        "n": n, "cmd": "bench", "rc": 0,
+        "tail": json.dumps(summary), "parsed": summary,
+    }))
+    return str(path)
+
+
+class TestSeatChanges:
+    """A tuned-seat flip (the rs_xor / fused_epi candidates landing) must
+    surface as a SEAT CHANGE, never as a phantom regression or a STALE
+    series — the ISSUE 6 trend-gate satellite."""
+
+    def test_seat_flip_is_reported_not_regressed(self, tmp_path, capsys):
+        bt = _load()
+        _round_file_with_parts(
+            tmp_path, 1, {"rs_dense": 1.0, "nmt_dah": 0.4},
+            tuned={"rs": "rs_dense", "sha": "pallas", "pipe": "fused"},
+            platform="tpu",
+        )
+        # Next chip round: rs_xor measured, wins the seat outright.
+        _round_file_with_parts(
+            tmp_path, 2, {"rs_dense": 1.0, "rs_xor": 0.5, "nmt_dah": 0.4},
+            tuned={"rs": "rs_xor", "sha": "pallas", "pipe": "fused_epi"},
+            platform="tpu",
+        )
+        assert bt.main(["--dir", str(tmp_path)]) == 0  # no regression
+        out = capsys.readouterr().out
+        assert "SEAT CHANGE: rs rs_dense -> rs_xor" in out
+        assert "SEAT CHANGE: pipe fused -> fused_epi" in out
+        assert "regressions:" not in out
+
+    def test_new_candidate_single_point_never_gates(self, tmp_path):
+        """rs_xor appearing for the first time has one datapoint — the
+        gate needs two, so a brand-new series can never fail the run."""
+        bt = _load()
+        _round_file_with_parts(tmp_path, 1, {"rs_dense": 1.0})
+        _round_file_with_parts(
+            tmp_path, 2, {"rs_dense": 1.0, "rs_xor": 99.0})
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+
+    def test_hw_gated_candidate_missing_on_cpu_round_is_not_stale(
+        self, tmp_path, capsys
+    ):
+        """A chip round measures rs_xor; the next round falls back to CPU
+        and cannot.  That is a platform gap, not a STALE series."""
+        bt = _load()
+        _round_file_with_parts(
+            tmp_path, 1,
+            {"rs_dense": 1.0, "rs_xor": 0.9, "rs_dense_pl": 0.95},
+            platform="tpu",
+        )
+        _round_file_with_parts(
+            tmp_path, 2, {"rs_dense": 6.0}, platform="cpu",
+        )
+        bt.main(["--dir", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert "hw-gated: parts.rs_xor" in out
+        assert "hw-gated: parts.rs_dense_pl" in out
+        assert "STALE" not in out
+
+    def test_unknown_platform_newest_round_stays_stale(
+        self, tmp_path, capsys
+    ):
+        """A newest round whose platform tag was LOST (truncated tail)
+        may well have been the chip: hw-gated's 'no chip' claim must not
+        fire — the honest report is STALE."""
+        bt = _load()
+        _round_file_with_parts(
+            tmp_path, 1, {"rs_dense": 1.0, "rs_xor": 0.9}, platform="tpu",
+        )
+        _round_file_with_parts(tmp_path, 2, {"rs_dense": 1.0})  # no tag
+        bt.main(["--dir", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert "STALE: gated series parts.rs_xor" in out
+        assert "hw-gated" not in out
+
+    def test_cpu_fallback_round_never_regresses_chip_numbers(
+        self, tmp_path, capsys
+    ):
+        """fused_epi (and every parts series) is measured on BOTH
+        platforms; a CPU-fallback round's seconds must not gate against a
+        chip round's — same-platform comparison only."""
+        bt = _load()
+        _round_file_with_parts(
+            tmp_path, 1, {"rs_dense": 0.2, "fused": 0.3, "fused_epi": 0.25},
+            platform="tpu",
+        )
+        _round_file_with_parts(
+            tmp_path, 2, {"rs_dense": 6.0, "fused": 9.0, "fused_epi": 8.0},
+            platform="cpu",
+        )
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "regressions:" not in out
+        # A genuine same-platform collapse still gates.
+        _round_file_with_parts(
+            tmp_path, 3, {"rs_dense": 30.0, "fused": 9.0, "fused_epi": 8.0},
+            platform="cpu",
+        )
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+
+    def test_unknown_platform_priors_still_gate(self, tmp_path):
+        """A salvaged round that lost its platform tag must keep gating:
+        only a KNOWN different platform excludes a prior — silently
+        dropping unknowns would weaken the gate for exactly the rounds
+        whose tails were truncated."""
+        bt = _load()
+        _round_file_with_parts(tmp_path, 1, {"rs_dense": 0.2})  # no platform
+        _round_file_with_parts(
+            tmp_path, 2, {"rs_dense": 30.0}, platform="tpu",
+        )
+        assert bt.main(["--dir", str(tmp_path)]) == 1  # still flagged
+
+    def test_operator_override_is_reported(self, tmp_path, capsys):
+        bt = _load()
+        _round_file_with_parts(
+            tmp_path, 1, {"rs_dense": 1.0, "rs_xor": 0.5},
+            tuned={"rs": "rs_xor", "sha": "pallas"},
+            applied={"rs": "rs_dense", "sha": "pallas"},
+            platform="tpu",
+        )
+        bt.main(["--dir", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert "OPERATOR OVERRIDE: rs ran rs_dense" in out
+
+    def test_json_output_carries_seats(self, tmp_path, capsys):
+        bt = _load()
+        _round_file_with_parts(
+            tmp_path, 1, {"rs_dense": 1.0},
+            tuned={"rs": "rs_dense", "sha": "pallas"}, platform="tpu")
+        _round_file_with_parts(
+            tmp_path, 2, {"rs_dense": 1.0, "rs_xor": 0.5},
+            tuned={"rs": "rs_xor", "sha": "pallas"}, platform="tpu")
+        assert bt.main(["--dir", str(tmp_path), "--json"]) == 0
+        data = json.loads(capsys.readouterr().out)
+        assert data["seat_changes"] == [{
+            "seat": "rs", "from": "rs_dense", "to": "rs_xor",
+            "from_round": 1, "round": 2,
+        }]
+
+
 class TestMalformedInputsFailFast:
     def test_unreadable_json_exits_2(self, tmp_path):
         bt = _load()

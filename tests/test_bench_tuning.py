@@ -89,6 +89,138 @@ class TestRound5Candidates:
         assert tuned == {"rs": "rs_dense", "sha": "jnp"} and nmt == 0.5
 
 
+class TestRound6Candidates:
+    """rs_xor (bitsliced XOR/AND-parity Pallas lowering) joins the RS A/B
+    and fused_epi (leaf-hash epilogue) the pipe A/B: same hysteresis
+    discipline as every earlier candidate."""
+
+    def test_rs_xor_takes_seat_on_clear_win(self):
+        s = _seconds()
+        s["rs_xor"] = 0.5
+        _, tuned = bench._pick_tuned(s, on_tpu=True)
+        assert tuned["rs"] == "rs_xor"
+
+    def test_rs_xor_noise_margin_holds(self):
+        s = _seconds()
+        s["rs_xor"] = 0.98
+        _, tuned = bench._pick_tuned(s, on_tpu=True)
+        assert tuned["rs"] == "rs_dense"
+
+    def test_rs_xor_must_beat_the_current_seat_holder(self):
+        # rs_dense_pl takes the seat first; rs_xor must then beat IT.
+        s = _seconds()
+        s["rs_dense_pl"] = 0.5
+        s["rs_xor"] = 0.49  # 2% vs the pallas seat: stays benched
+        _, tuned = bench._pick_tuned(s, on_tpu=True)
+        assert tuned["rs"] == "rs_dense_pl"
+        s["rs_xor"] = 0.4
+        _, tuned = bench._pick_tuned(s, on_tpu=True)
+        assert tuned["rs"] == "rs_xor"
+
+    def test_fused_epi_takes_pipe_seat_on_clear_win(self):
+        tuned = {"rs": "rs_dense", "sha": "jnp"}
+        s = _seconds_base(1.0, 0.5)
+        s["fused"] = 1.0
+        s["fused_epi"] = 0.9
+        assert bench._pick_pipe(s, tuned) == "fused_epi"
+
+    def test_fused_epi_noise_margin_holds(self):
+        tuned = {"rs": "rs_dense", "sha": "jnp"}
+        s = _seconds_base(1.0, 0.5)
+        s["fused"] = 1.0
+        s["fused_epi"] = 0.98  # 2%: the incumbent keeps the seat
+        assert bench._pick_pipe(s, tuned) == "fused"
+
+    def test_fused_epi_must_beat_staged_when_staged_leads(self):
+        # staged takes the seat off fused; epi must then beat STAGED.
+        tuned = {"rs": "rs_dense", "sha": "jnp"}
+        s = _seconds_base(1.0, 0.5)  # staged = 1.5
+        s["fused"] = 1.60
+        s["fused_epi"] = 1.47  # 2% vs staged: stays benched
+        assert bench._pick_pipe(s, tuned) == "staged"
+        s["fused_epi"] = 1.40
+        assert bench._pick_pipe(s, tuned) == "fused_epi"
+
+    def test_absent_epi_candidate_never_crashes(self):
+        # CPU fallback rows may lack the fused_epi key entirely.
+        tuned = {"rs": "rs_dense", "sha": "jnp"}
+        s = _seconds_base(1.0, 0.5)
+        s["fused"] = 1.0
+        assert bench._pick_pipe(s, tuned) == "fused"
+
+
+class TestChallengerFaultTolerance:
+    """A challenger candidate that fails to build/run (the hazard for
+    Pallas kernels unmeasured on this hardware) must cost its own row,
+    not the whole parts stage — the incumbents and the seat survive."""
+
+    def test_failing_challenger_becomes_error_note(self, monkeypatch):
+        import numpy as np
+
+        from celestia_app_tpu.kernels import rs as rs_mod
+
+        real = rs_mod.extend_square_fn
+
+        def flaky(k, construction=None):
+            if os.environ.get("CELESTIA_RS_FFT") == "on":
+                raise RuntimeError("mosaic lowering failed")
+            return real(k, construction)
+
+        monkeypatch.setattr(rs_mod, "extend_square_fn", flaky)
+        ods = bench._random_ods(2)
+        out = bench._parts_seconds(ods, 1)
+        assert "rs_dense" in out  # the incumbent measured
+        assert "rs_fft" not in out and "rs_fft_md" not in out
+        assert "mosaic lowering failed" in out["rs_fft_error"]
+        assert out["tuned"]["rs"] == "rs_dense"  # seat fell back cleanly
+        assert np.isfinite(out["rs_dense"])
+
+
+class TestSeatApplication:
+    """ISSUE 6 satellite: a tuned seat must round-trip through the shared
+    env mapping — _env_for_tuned applied to the environment, then read
+    back by _applied_from_env (the child's tuned-applied record), must
+    reproduce the tuner's picks exactly.  rs_xor rides the same mapping
+    as rs_dense_pl; fused_epi the same as staged."""
+
+    RS = ("rs_dense", "rs_fft", "rs_fft_md", "rs_dense_pl", "rs_xor")
+    PIPES = ("fused", "staged", "fused_epi")
+
+    def _round_trip(self, tuned):
+        saved = {v: os.environ.get(v) for v in bench._TUNE_VARS}
+        try:
+            for v in bench._TUNE_VARS:
+                os.environ.pop(v, None)
+            bench._apply_env(bench._env_for_tuned(tuned))
+            return bench._applied_from_env()
+        finally:
+            bench._apply_env(saved)
+
+    def test_every_rs_seat_round_trips(self):
+        for rs in self.RS:
+            tuned = {"rs": rs, "sha": "pallas", "pipe": "fused"}
+            assert self._round_trip(tuned) == tuned, rs
+
+    def test_every_pipe_seat_round_trips(self):
+        for pipe in self.PIPES:
+            tuned = {"rs": "rs_xor", "sha": "plf", "pipe": pipe}
+            assert self._round_trip(tuned) == tuned, pipe
+
+    def test_rs_xor_mapping_mirrors_rs_dense_pl(self):
+        """The two Pallas RS seats use the same env shape: exactly one
+        opt-in var set, every other RS var off/absent — so the child's
+        group-apply logic treats them identically."""
+        env_pl = bench._env_for_tuned({"rs": "rs_dense_pl", "sha": "jnp"})
+        env_xor = bench._env_for_tuned({"rs": "rs_xor", "sha": "jnp"})
+        assert env_pl["CELESTIA_RS_PALLAS"] == "on"
+        assert env_pl["CELESTIA_RS_XOR"] is None
+        assert env_xor["CELESTIA_RS_XOR"] == "on"
+        assert env_xor["CELESTIA_RS_PALLAS"] is None
+        for env in (env_pl, env_xor):
+            assert env["CELESTIA_RS_FFT"] == "off"
+            assert env["CELESTIA_RS_FFT_MD"] is None
+
+
 class TestFusedPipeSeat:
     """The fused single-dispatch extend_and_dah program joins the A/B as
     the pipeline incumbent: the staged pair (at its own tuned-best RS and

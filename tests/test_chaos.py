@@ -107,6 +107,8 @@ class TestSpec:
         inj = ChaosInjector(parse_spec("seed=2,dispatch_fail=1.0"))
         with pytest.raises(ChaosInjected):
             inj.device_dispatch("fused")
+        with pytest.raises(ChaosInjected):  # the epilogue rung is fused-family
+            inj.device_dispatch("fused_epi")
         inj.device_dispatch("staged")  # no raise: the ladder's escape rung
         inj_all = ChaosInjector(
             parse_spec("seed=2,dispatch_fail=1.0,dispatch_fail_all=1")
@@ -145,6 +147,34 @@ class TestDegradationLadder:
         # env already staged: first degrade goes straight to host.
         assert ladder.degrade("staged") == "host"
         assert ladder.effective_mode("staged") == "host"
+
+    def test_ladder_from_epilogue_seat(self):
+        """A process seated on fused_epi walks the full four-rung ladder:
+        fused_epi -> fused -> staged -> host — the epilogue's custom
+        kernel is distrusted first, the plain fused program second."""
+        ladder = degrade.DeviceDegradation()
+        assert ladder.effective_mode("fused_epi") == "fused_epi"
+        assert ladder.degrade("fused_epi") == "fused"
+        assert ladder.state() == {"device": "fused"}
+        assert ladder.degrade("fused_epi") == "staged"
+        assert ladder.degrade("fused_epi") == "host"
+        assert ladder.degrade("fused_epi") is None  # the floor
+        ladder.reset()
+        # A fused-based process never CLIMBS to the epilogue rung: the
+        # floor only ever steps down from the seated base.
+        assert ladder.effective_mode("fused") == "fused"
+        ladder.degrade("staged")
+        assert ladder.effective_mode("fused_epi") == "host"
+
+    def test_breaker_drill_from_epilogue_seat(self):
+        """ISSUE 6 acceptance: with the rs_xor-era seat installed
+        ($CELESTIA_PIPE_FUSED=epi), the breaker drill still steps the
+        ladder to a bit-identical root — through the extra rung."""
+        soak = _load_soak()
+        result = soak.run_breaker_drill(k=4, base_env="epi")
+        assert result["ok"], result
+        assert result["mode_after"] == "staged"
+        assert result["roots_identical"]
 
     def test_concurrent_trips_step_one_rung_not_two(self):
         """Two breaker trips from one burst of FUSED failures must not

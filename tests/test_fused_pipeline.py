@@ -155,6 +155,74 @@ class TestFusedParity:
         assert a.data_root() == b.data_root()
 
 
+class TestFusedEpilogue:
+    """The leaf-hash-epilogue variant (pipeline mode "fused_epi": the
+    column-phase extend feeds the bottom half's parity-namespace leaf
+    digests before anything lands in HBM on TPU; the same ops staged
+    through XLA off-chip) must be bit-identical to the staged path —
+    roots, data root, and EDS bytes — so the bench autotuner's three-way
+    pipe seat stays a pure perf choice."""
+
+    @pytest.mark.parametrize("k", [2, 8])
+    def test_epilogue_matches_staged(self, k):
+        ods = random_ods(k, seed=k * 23 + 5)
+        ref = _staged(k, ods)
+        got = jit_extend_and_dah(k, epilogue=True)(
+            jnp.asarray(ods, dtype=jnp.uint8)
+        )
+        for name, a, b in zip(("eds", "row_roots", "col_roots", "droot"),
+                              ref, got):
+            assert np.array_equal(a, np.asarray(b)), (k, name)
+
+    def test_golden_vectors_through_epilogue(self):
+        """The reference golden DAH hash (k=2) via the epilogue lowering,
+        donated like a block-production dispatch would be."""
+        from celestia_app_tpu.da.dah import DataAvailabilityHeader
+
+        k, want = 2, K2_HASH
+        shares = [_golden_share()] * (k * k)
+        ods = np.frombuffer(b"".join(shares), dtype=np.uint8).reshape(
+            k, k, SHARE_SIZE
+        )
+        _, rr, cr, _ = jit_extend_and_dah(k, donate=True, epilogue=True)(
+            jnp.asarray(ods, dtype=jnp.uint8)
+        )
+        dah = DataAvailabilityHeader(
+            row_roots=[bytes(r) for r in np.asarray(rr)],
+            column_roots=[bytes(r) for r in np.asarray(cr)],
+        )
+        assert dah.hash() == want
+
+    def test_env_epi_routes_whole_stack(self, monkeypatch):
+        """$CELESTIA_PIPE_FUSED=epi flips pipeline_mode to fused_epi and
+        ExtendedDataSquare.compute rides it, byte-identical to staged."""
+        from celestia_app_tpu.kernels.fused import env_base_mode
+
+        k = 8
+        ods = random_ods(k, seed=77)
+        monkeypatch.setenv("CELESTIA_PIPE_FUSED", "off")
+        staged = ExtendedDataSquare.compute(ods)
+        monkeypatch.setenv("CELESTIA_PIPE_FUSED", "epi")
+        assert env_base_mode() == "fused_epi"
+        assert pipeline_mode() == "fused_epi"
+        epi = ExtendedDataSquare.compute(ods)
+        assert epi.data_root() == staged.data_root()
+        assert epi.row_roots() == staged.row_roots()
+        assert epi.col_roots() == staged.col_roots()
+        np.testing.assert_array_equal(epi.squared(), staged.squared())
+
+    def test_roots_only_epilogue_lowering(self):
+        k = 4
+        ods = random_ods(k, seed=41)
+        _, rr, cr, droot = _staged(k, ods)
+        got = jit_extend_and_dah(k, roots_only=True, epilogue=True)(
+            jnp.asarray(ods, dtype=jnp.uint8)
+        )
+        assert np.array_equal(rr, np.asarray(got[0]))
+        assert np.array_equal(cr, np.asarray(got[1]))
+        assert np.array_equal(droot, np.asarray(got[2]))
+
+
 class TestFusedMultiChip:
     """Multi-chip paths under the conftest 8-device CPU mesh: the DAH-only
     pipeline all-gathers only 90-byte roots (never shares) and must stay
