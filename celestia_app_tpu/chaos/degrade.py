@@ -157,6 +157,13 @@ class DeviceDegradation:
         print(f"device pipeline degraded to {new!r} "
               f"(breaker tripped on repeated dispatch failure)",
               file=sys.stderr)
+        # Black-box the moment of the trip: the journal rows explaining
+        # WHY are still in the ring buffers right now; in an hour they
+        # won't be.  note_trigger never raises and rate-limits itself.
+        from celestia_app_tpu.trace.flight_recorder import note_trigger
+
+        note_trigger("breaker_trip", layer="device", mode=new,
+                     observed=observed, base=base)
         return new
 
     def state(self) -> dict | None:
@@ -214,6 +221,15 @@ def note_async_device_failure(observed: str) -> None:
             _env_base_mode(), observed=observed
         ) is not None:
             DEVICE_BREAKER.reset()
+        else:
+            # Already on the ladder floor: degrade() (which black-boxes
+            # the step) did nothing, but a PERSISTENT deferred fault at
+            # the floor is exactly a flight-recorder moment — capture it
+            # here (rate-limited) since no step will.
+            from celestia_app_tpu.trace.flight_recorder import note_trigger
+
+            note_trigger("breaker_trip", layer="device", mode="host",
+                         observed=observed, at_floor=True)
 
 
 def guarded_dispatch(resolve, x, *, refresh=None,

@@ -107,10 +107,15 @@ class VoteWAL:
     @staticmethod
     def _note_salvage(where: str, dropped: int) -> None:
         from celestia_app_tpu.chaos.degrade import recoveries
+        from celestia_app_tpu.trace.flight_recorder import note_trigger
         from celestia_app_tpu.trace.tracer import traced
 
         recoveries().inc(seam="wal.append", outcome="salvaged")
         traced().write("wal_salvage", where=where, dropped_bytes=dropped)
+        # A salvage means a crash tore the double-sign guard's journal:
+        # snapshot the surrounding state while it still exists
+        # (note_trigger rate-limits per trigger and never raises).
+        note_trigger("wal_salvage", where=where, dropped_bytes=dropped)
 
     def _append(self, rec: dict) -> None:
         from celestia_app_tpu import chaos

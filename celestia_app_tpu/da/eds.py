@@ -301,6 +301,15 @@ def _parity_check(ods_host, k: int, construction: str, droot) -> None:
             "parity_mismatch", k=k, construction=construction,
             served=served_root.hex(), staged=staged_root.hex(),
         )
+        # A root divergence between bit-identical-by-contract lowerings
+        # is the most forensically urgent trigger there is: capture the
+        # full state before any ring buffer moves (never raises).
+        from celestia_app_tpu.trace.flight_recorder import note_trigger
+
+        note_trigger(
+            "parity_mismatch", k=k, construction=construction,
+            served=served_root.hex(), staged=staged_root.hex(),
+        )
     except Exception as e:  # chaos-ok: the sentinel must never raise
         checks.inc(result="error")
         traced().write(

@@ -161,6 +161,7 @@ class BlockPipeline:
             if self._error is None:
                 self._error = e
             self._force_sentinel(self._staged)
+            self._note_death("uploader", e)
 
     def _upload_loop(self) -> None:
         from celestia_app_tpu import chaos
@@ -192,6 +193,7 @@ class BlockPipeline:
             except BaseException as e:  # chaos-ok: stored, surfaced on the next drain
                 self._error = e
                 self._staged.put(_SENTINEL)
+                self._note_death("uploader", e)
                 failed = True
                 continue
             # Stage timings ride the hand-off in `meta`; the put-stall
@@ -215,6 +217,21 @@ class BlockPipeline:
             if self._error is None:
                 self._error = e
             self._force_sentinel(self._done)
+            self._note_death("dispatcher", e)
+
+    def _note_death(self, stage: str, err: BaseException) -> None:
+        """Black-box a pipeline-fatal stage failure: the journal rows
+        around the death are the forensic record and the ring buffer is
+        still warm.  ALWAYS called after the death sentinel is delivered
+        — capture serializes table tails and probes /healthz, and a
+        consumer blocked on the queue must not wait behind forensics.
+        note_trigger rate-limits and never raises."""
+        from celestia_app_tpu.trace.flight_recorder import note_trigger
+
+        note_trigger(
+            "worker_death", stage=stage, k=self.k, depth=self.depth,
+            mode=self._mode, error=f"{type(err).__name__}: {err}"[:300],
+        )
 
     @staticmethod
     def _force_sentinel(q: queue.Queue) -> None:
@@ -274,6 +291,7 @@ class BlockPipeline:
             except BaseException as e:  # chaos-ok: stored, surfaced on the next drain
                 self._error = e
                 self._done.put(_SENTINEL)
+                self._note_death("dispatcher", e)
                 failed = True
                 continue
             self._done.put(_InFlight(tag, out, self.k, meta))

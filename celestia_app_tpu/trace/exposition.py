@@ -10,10 +10,12 @@ hits:
 
     GET /metrics                 Prometheus text exposition (version 0.0.4)
     GET /trace_tables            {"tables": {name: row_count}}
-    GET /trace_tables/<name>     the table as JSONL (application/x-ndjson)
-    GET /healthz                 liveness + per-layer staleness
+    GET /trace_tables/<name>     the table as JSONL (application/x-ndjson);
+                                 ?tail=N serves only the last N rows
+    GET /healthz                 liveness + per-layer staleness + SLO block
     GET /namespaces              per-tenant data-plane summary (cumulative
                                  blob/share/byte totals + last square)
+    GET /slo                     SLO burn-rate evaluation (trace/slo.py)
 
 /healthz is the SLO face: beyond {"status": "SERVING"}, any registered
 health providers (a ServingNode registers its own snapshot: last block
@@ -63,6 +65,13 @@ def health_payload() -> dict:
     if degraded:
         payload["status"] = "DEGRADED"
         payload["degraded"] = degraded
+    # The SLO face: DEGRADED answers "is the device path stepped down",
+    # the slo block answers "is the error budget burning" — one probe
+    # distinguishes the two.  Read-only: the probe reports the LAST
+    # evaluation, it never forces one.
+    from celestia_app_tpu.trace.slo import engine
+
+    payload["slo"] = engine().health_block()
     if providers:
         layers = {}
         for name, provider in sorted(providers.items()):
@@ -83,13 +92,33 @@ def metrics_payload() -> bytes:
     return registry().render().encode()
 
 
+#: Ceiling on /trace_tables/<name>?tail=N — matches the tracer's default
+#: ring size; a larger ask is a whole-table pull, which the uncapped
+#: endpoint already serves.
+MAX_TAIL = 10_000
+
+
+def _parse_tail(query: str):
+    """The `tail` parameter of a /trace_tables/<name> query: (ok, value)
+    where value is None when absent, else the capped int; ok=False means
+    the parameter was present but not a positive integer (a 400)."""
+    for pair in query.split("&"):
+        if not pair.startswith("tail="):
+            continue
+        raw = pair[len("tail="):]
+        if not raw.isdigit() or int(raw) < 1:
+            return False, raw
+        return True, min(int(raw), MAX_TAIL)
+    return True, None
+
+
 def handle_observability_get(path: str):
     """Route an HTTP GET path; returns (status, content_type, body-bytes)
     or None when the path is not an observability endpoint (the caller
     falls through to its own routes / 404)."""
     from celestia_app_tpu.trace.tracer import traced
 
-    p = path.split("?", 1)[0]
+    p, _, query = path.partition("?")
     if p != "/":
         p = p.rstrip("/")
     if p == "/metrics":
@@ -102,18 +131,32 @@ def handle_observability_get(path: str):
         return 200, "application/json", json.dumps(
             square_journal.namespaces_payload()
         ).encode()
+    if p == "/slo":
+        from celestia_app_tpu.trace.slo import engine
+
+        # One rate-limited evaluation per scrape window: the payload is a
+        # pure function of the retained evaluation state, so planes
+        # scraped inside one tick interval serve identical bytes.
+        eng = engine()
+        eng.maybe_tick()
+        return 200, "application/json", json.dumps(eng.payload()).encode()
     if p == "/trace_tables":
         return 200, "application/json", json.dumps(
             {"tables": traced().row_counts()}
         ).encode()
     if p.startswith("/trace_tables/"):
         name = p[len("/trace_tables/"):]
+        ok, tail = _parse_tail(query)
+        if not ok:
+            return 400, "application/json", json.dumps(
+                {"error": f"tail must be a positive integer, got {tail!r}"}
+            ).encode()
         tracer = traced()
         if name not in tracer.tables():
             return 404, "application/json", json.dumps(
                 {"error": f"no trace table {name!r}"}
             ).encode()
-        body = tracer.export_jsonl(name)
+        body = tracer.export_jsonl(name, tail=tail)
         return 200, "application/x-ndjson", (body + "\n").encode()
     return None
 

@@ -60,6 +60,16 @@ def record(source: str, k: int, *, mode: str | None = None,
     profiler.block_profiler().note_block()
     profiler.record_hbm_high_water(point=source, k=k)
 
+    # The SLO engine ticks on the block funnel (rate-limited to
+    # $CELESTIA_SLO_TICK_S): every block through the device pipeline is
+    # a chance to notice the budget burning WITHOUT an external poller.
+    # Outside the $CELESTIA_TRACE gate, like the profiler hooks — the
+    # degraded/occupancy gauges it judges keep updating when tracing is
+    # muted, so judgment must too.
+    from celestia_app_tpu.trace.slo import engine
+
+    engine().maybe_tick()
+
     tracer = traced()
     if not tracer._on():
         return

@@ -51,6 +51,12 @@ class Counter:
         with self._lock:
             self._values[tuple(sorted(labels.items()))] += amount
 
+    def samples(self) -> list[tuple[dict, float]]:
+        """[(labels dict, value)] for every child series — the read-side
+        accessor gauge predicates (trace/slo.py) evaluate over."""
+        with self._lock:
+            return [(dict(key), val) for key, val in sorted(self._values.items())]
+
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         with self._lock:
@@ -72,6 +78,97 @@ class Gauge(Counter):
             line.replace(" counter", " gauge", 1) if line.startswith("# TYPE") else line
             for line in super().render()
         ]
+
+
+class HistogramSnapshot:
+    """Point-in-time copy of a Histogram's children, the unit of windowed
+    evaluation: `delta(earlier)` subtracts an older snapshot child-wise
+    (what arrived IN the window, not since process start — Prometheus
+    counters are cumulative, SLO windows are not), and `quantile` /
+    `fraction_over` estimate from bucket counts with linear interpolation
+    inside the bounding bucket.  `**labels` on the estimators is a subset
+    selector: children whose label sets contain every given pair are
+    merged before estimating (so `phase="total"` covers the per-tenant
+    `{phase="total",namespace=...}` children too)."""
+
+    def __init__(self, buckets: tuple[float, ...], children: dict):
+        self.buckets = buckets
+        # label key tuple -> (per-bucket counts incl. +Inf tail, sum)
+        self.children = children
+
+    def delta(self, earlier: "HistogramSnapshot") -> "HistogramSnapshot":
+        """This snapshot minus `earlier`: the observations of the window
+        between them.  Children absent earlier keep their full counts; a
+        reset (counts going backwards, e.g. a test registry swap) clamps
+        at zero rather than going negative."""
+        out = {}
+        for key, (counts, total) in self.children.items():
+            old = earlier.children.get(key)
+            if old is None:
+                out[key] = (list(counts), total)
+                continue
+            out[key] = (
+                [max(0, c - o) for c, o in zip(counts, old[0])],
+                max(0.0, total - old[1]),
+            )
+        return HistogramSnapshot(self.buckets, out)
+
+    def _merged(self, labels: dict) -> list[int]:
+        """Summed per-bucket counts over children matching the subset
+        selector (stringified values, like observe())."""
+        want = {(k, str(v)) for k, v in labels.items()}
+        merged = [0] * (len(self.buckets) + 1)
+        for key, (counts, _) in self.children.items():
+            if want <= set(key):
+                for i, c in enumerate(counts):
+                    merged[i] += c
+        return merged
+
+    def count(self, **labels) -> int:
+        return sum(self._merged(labels))
+
+    def quantile(self, q: float, **labels) -> float | None:
+        """Bucket-interpolated quantile estimate in [0, 1] -> value, or
+        None with no observations.  Ranks landing in the +Inf tail clamp
+        to the largest finite bound (the estimate cannot exceed what the
+        buckets resolve)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        counts = self._merged(labels)
+        total = sum(counts)
+        if total == 0:
+            return None
+        rank = q * total
+        cumulative = 0
+        for i, bound in enumerate(self.buckets):
+            prev_cum, cumulative = cumulative, cumulative + counts[i]
+            if cumulative >= rank:
+                lower = self.buckets[i - 1] if i else 0.0
+                if counts[i] == 0:
+                    return bound
+                frac = (rank - prev_cum) / counts[i]
+                return lower + (bound - lower) * frac
+        return self.buckets[-1] if self.buckets else None
+
+    def fraction_over(self, threshold: float, **labels) -> float | None:
+        """Estimated fraction of observations strictly above `threshold`
+        (the SLO bad-event rate), interpolating inside the bucket that
+        contains it; the +Inf tail always counts as over.  None with no
+        observations."""
+        counts = self._merged(labels)
+        total = sum(counts)
+        if total == 0:
+            return None
+        under = 0.0
+        for i, bound in enumerate(self.buckets):
+            if bound <= threshold:
+                under += counts[i]
+                continue
+            lower = self.buckets[i - 1] if i else 0.0
+            if threshold > lower:
+                under += counts[i] * (threshold - lower) / (bound - lower)
+            break
+        return max(0.0, min(1.0, (total - under) / total))
 
 
 class Histogram:
@@ -103,6 +200,23 @@ class Histogram:
                     break
             else:
                 counts[-1] += 1
+
+    def snapshot(self) -> HistogramSnapshot:
+        """Copy the current child counts for windowed evaluation: two
+        snapshots bracket a window, `later.delta(earlier)` is what landed
+        inside it (trace/slo.py's rolling-window input)."""
+        with self._lock:
+            children = {
+                key: (list(child[0]), child[1])
+                for key, child in self._children.items()
+            }
+        return HistogramSnapshot(self.buckets, children)
+
+    def quantile(self, q: float, **labels) -> float | None:
+        """Bucket-interpolated quantile over the CUMULATIVE counts (all
+        observations since process start); window-scoped quantiles go
+        through snapshot()/delta() instead."""
+        return self.snapshot().quantile(q, **labels)
 
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
@@ -150,6 +264,13 @@ class Registry:
         return self._get_or_make(
             name, lambda: Histogram(name, help_text, buckets), Histogram
         )
+
+    def get(self, name: str) -> "Counter | Gauge | Histogram | None":
+        """The registered metric by name, or None — the read-side lookup
+        (SLO evaluation) that must never create a family as a side
+        effect of observing it."""
+        with self._lock:
+            return self._metrics.get(name)
 
     def _get_or_make(self, name, factory, kind):
         with self._lock:

@@ -183,6 +183,16 @@ def record(sq, *, phase: str, layout_solves: int | None = None) -> None:
         "celestia_square_occupancy_ratio",
         "used/total share ratio of the last built square, by k",
     ).set(occupancy, k=str(acct.size))
+    # The UNLABELED twin always holds the latest square regardless of k:
+    # the SLO engine judges this one, because a per-k child for a size
+    # no longer being built would otherwise pin its stale ratio forever
+    # (one near-empty k=2 square during idle must not read as a
+    # permanently burning occupancy floor after traffic resumes at k=32).
+    reg.gauge(
+        "celestia_square_last_occupancy_ratio",
+        "used/total share ratio of the most recent exported square "
+        "(unlabeled: always the latest, never a stale per-k child)",
+    ).set(occupancy)
     pad = reg.counter(
         "celestia_square_padding_shares_total",
         "padding shares in exported squares by kind",

@@ -115,9 +115,23 @@ class Tracer:
         with self._lock:
             return {name: len(rows) for name, rows in sorted(self._tables.items())}
 
-    def export_jsonl(self, name: str) -> str:
+    def tail(self, name: str, n: int) -> list[dict]:
+        """The last `n` rows of a table (row copies) — what the flight
+        recorder bundles and /trace_tables/<name>?tail=N serves."""
+        if n <= 0:
+            return []
         with self._lock:
-            rows = list(self._tables.get(name, []))
+            rows = self._tables.get(name, [])
+            return list(rows[-n:])
+
+    def export_jsonl(self, name: str, tail: int | None = None) -> str:
+        # Delegate the tail slice so the two accessors cannot diverge
+        # (tail=0 means zero rows, never the whole ring).
+        if tail is None:
+            with self._lock:
+                rows = list(self._tables.get(name, []))
+        else:
+            rows = self.tail(name, tail)
         return "\n".join(json.dumps(r) for r in rows)
 
     def clear(self) -> None:

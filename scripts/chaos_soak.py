@@ -25,11 +25,23 @@ LATENCY but never CORRECTNESS.  Four drills, one process:
                        `pipeline_mode()` down the ladder to staged
                        within the breaker window, with
                        `celestia_degraded` and /healthz reporting the
-                       degraded state.  Runs twice: from the default
-                       fused seat AND from the leaf-hash-epilogue seat
-                       ($CELESTIA_PIPE_FUSED=epi), which must walk the
-                       extra fused_epi -> fused rung first — whichever
-                       mode the autotuner seats, the ladder holds.
+                       degraded state — AND the telemetry plane must
+                       NOTICE on its own: the `degraded` SLO enters
+                       fast-burn (a page) and the flight recorder writes
+                       a bundle, all within the drill's block budget.
+                       The drill reports DETECTION LATENCY — blocks and
+                       wall-ms from the first injected failure to the
+                       page — the ROADMAP's time-to-detection
+                       measurement, now standing.  Runs twice: from the
+                       default fused seat AND from the leaf-hash-
+                       epilogue seat ($CELESTIA_PIPE_FUSED=epi), which
+                       must walk the extra fused_epi -> fused rung first
+                       — whichever mode the autotuner seats, the ladder
+                       holds.
+
+Every drill runs with the flight recorder armed ($CELESTIA_FLIGHT_DIR
+defaults to a temp dir here); the summary prints a detection-latency
+column per drill next to the per-seam injection/recovery counts.
 
 Run:
   JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/chaos_soak.py \
@@ -47,6 +59,7 @@ import argparse
 import os
 import sys
 import tempfile
+import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
@@ -56,6 +69,42 @@ DEFAULT_SPEC = (
     "seed=7,dispatch_fail=0.1,upload_stall_ms=5,gossip_drop=0.2,"
     "gossip_dup=0.1,wal_torn_tail=2"
 )
+
+
+def _arm_flight_recorder() -> str:
+    """Ensure $CELESTIA_FLIGHT_DIR is set (temp dir when the operator
+    didn't pick one) so every drill's anomalies produce bundles."""
+    d = os.environ.get("CELESTIA_FLIGHT_DIR")
+    if not d:
+        d = tempfile.mkdtemp(prefix="chaos-flight-")
+        os.environ["CELESTIA_FLIGHT_DIR"] = d
+    return d
+
+
+def _first_dump_after(t0_ns: int, trigger: str | None = None) -> dict | None:
+    """The first successful flight dump at/after `t0_ns` (optionally for
+    one trigger) — how drills measure wall-clock time-to-detection.
+    Reads the recorder's own ungated log, NOT the flight_dump trace row:
+    with $CELESTIA_TRACE=off (the low-overhead measurement combo) the
+    row vanishes but the bundle on disk is still the detection fact."""
+    from celestia_app_tpu.trace.flight_recorder import recent_dumps
+
+    dumps = recent_dumps(since_ns=t0_ns, trigger=trigger)
+    return dumps[0] if dumps else None
+
+
+def _detection(t0_ns: int, trigger: str | None = None,
+               blocks: int | None = None) -> dict | None:
+    """Detection-latency record for the summary table, or None when no
+    dump landed after `t0_ns`."""
+    row = _first_dump_after(t0_ns, trigger)
+    if row is None:
+        return None
+    return {
+        "by": row.get("trigger"),
+        "blocks": blocks,
+        "wall_ms": round((row["ts_ns"] - t0_ns) / 1e6, 3),
+    }
 
 
 def _deterministic_blocks(n: int, k: int, seed: int = 1234):
@@ -94,6 +143,7 @@ def run_device_soak(n_blocks: int, k: int, spec: str) -> dict:
     }
 
     chaos.install(spec)
+    t0_ns = time.time_ns()
     try:
         chaotic = {
             tag: eds.data_root()
@@ -115,6 +165,10 @@ def run_device_soak(n_blocks: int, k: int, spec: str) -> dict:
         "mismatched_tags": mismatches,
         "final_mode": final_mode,
         "degraded": degraded,
+        # Recovery usually absorbs p=0.1 faults without an anomaly; when
+        # one DOES surface (a breaker trip mid-soak), this records how
+        # long the plane took to notice.
+        "detection": _detection(t0_ns),
     }
 
 
@@ -130,6 +184,7 @@ def run_wal_tear_drill(spec: str, wal_dir: str | None = None) -> dict:
     tmp = wal_dir or tempfile.mkdtemp(prefix="chaos-wal-")
     path = os.path.join(tmp, "wal.jsonl")
     chaos.install(spec)
+    t0_ns = time.time_ns()
     try:
         wal = VoteWAL(path)
         signed = []
@@ -168,6 +223,9 @@ def run_wal_tear_drill(spec: str, wal_dir: str | None = None) -> dict:
         "idempotent_resign_ok": idempotent,
         "fresh_coords_ok": fresh,
         "ok": refused and idempotent and fresh,
+        # The restart replay's salvage is the anomaly; the wal_salvage
+        # flight dump is the plane noticing it.
+        "detection": _detection(t0_ns, trigger="wal_salvage"),
     }
 
 
@@ -237,9 +295,16 @@ def run_gossip_drill(spec: str, n_msgs: int = 40, max_rounds: int = 12) -> dict:
     }
 
 
-def run_breaker_drill(k: int = 4, base_env: str | None = None) -> dict:
+def run_breaker_drill(k: int = 4, base_env: str | None = None,
+                      blocks: int = 8) -> dict:
     """A persistent injected device failure must flip the ladder to
-    staged within the breaker window, visible on /healthz.
+    staged within the breaker window, visible on /healthz — and the
+    telemetry plane must DETECT it end-to-end: sustained
+    `dispatch_fail=1.0` has to drive the `degraded` SLO into fast-burn
+    (a page) and produce a flight bundle within `blocks` blocks, with
+    every committed root still bit-identical to the chaos-off run.
+    Reports detection latency (blocks + wall-ms from first injection to
+    the page).
 
     `base_env` pins $CELESTIA_PIPE_FUSED for the drill (e.g. "epi" to
     start from the leaf-hash-epilogue seat the autotuner may install —
@@ -252,53 +317,123 @@ def run_breaker_drill(k: int = 4, base_env: str | None = None) -> dict:
     from celestia_app_tpu.da.eds import ExtendedDataSquare
     from celestia_app_tpu.constants import SHARE_SIZE
     from celestia_app_tpu.kernels.fused import pipeline_mode
+    from celestia_app_tpu.trace import flight_recorder, slo
     from celestia_app_tpu.trace.exposition import health_payload
 
-    saved_pipe = os.environ.get("CELESTIA_PIPE_FUSED")
+    saved = {
+        name: os.environ.get(name)
+        for name in ("CELESTIA_PIPE_FUSED", "CELESTIA_SLO_TICK_S",
+                     "CELESTIA_FLIGHT_DIR")
+    }
     if base_env is not None:
         os.environ["CELESTIA_PIPE_FUSED"] = base_env
+    _arm_flight_recorder()
+    # Evaluate SLOs on every block-journal row: the drill measures
+    # DETECTION latency, not tick-rate-limit latency.
+    os.environ["CELESTIA_SLO_TICK_S"] = "0"
     chaos.install("")  # chaos-free even when $CELESTIA_CHAOS is set
     degrade.reset_for_tests()
+    engine = slo._reset_for_tests()
+    flight_recorder._reset_for_tests()  # drills must not inherit limits
     ods = np.zeros((k, k, SHARE_SIZE), dtype=np.uint8)
     healthy_root = ExtendedDataSquare.compute(ods).data_root()
     chaos.install("seed=11,dispatch_fail=1.0")
+    t0_ns = time.time_ns()
+    t0 = time.perf_counter()
+    detect_blocks = None
+    detect_wall_ms = None
+    roots_identical = True
+    blocks_run = 0
     try:
-        degraded_root = ExtendedDataSquare.compute(ods).data_root()
+        for i in range(1, blocks + 1):
+            blocks_run = i
+            root = ExtendedDataSquare.compute(ods).data_root()
+            roots_identical = roots_identical and (root == healthy_root)
+            if engine.paged("degraded") and _first_dump_after(
+                t0_ns, trigger="slo_fast_burn"
+            ):
+                detect_blocks = i
+                detect_wall_ms = round((time.perf_counter() - t0) * 1e3, 3)
+                break
         mode = pipeline_mode()
         health = health_payload()
     finally:
         chaos.uninstall()
-        if base_env is not None:
-            if saved_pipe is None:
-                os.environ.pop("CELESTIA_PIPE_FUSED", None)
+        for name, val in saved.items():
+            if val is None:
+                os.environ.pop(name, None)
             else:
-                os.environ["CELESTIA_PIPE_FUSED"] = saved_pipe
+                os.environ[name] = val
+    page_dump = _first_dump_after(t0_ns, trigger="slo_fast_burn")
+    trip_dump = _first_dump_after(t0_ns, trigger="breaker_trip")
     result = {
         "mode_after": mode,
         "health_status": health.get("status"),
         "health_degraded": health.get("degraded"),
-        "roots_identical": degraded_root == healthy_root,
+        "slo_health": health.get("slo"),
+        "roots_identical": roots_identical,
+        "paged": detect_blocks is not None,
+        "detection_blocks": detect_blocks,
+        "detection_wall_ms": detect_wall_ms,
+        "flight_bundle": page_dump.get("path") if page_dump else None,
+        "breaker_bundle": trip_dump.get("path") if trip_dump else None,
+        "blocks_run": blocks_run,
+        "detection": (
+            {"by": "slo_fast_burn", "blocks": detect_blocks,
+             "wall_ms": detect_wall_ms}
+            if detect_blocks is not None else None
+        ),
         "ok": (
             mode == "staged"
             and health.get("status") == "DEGRADED"
             and health.get("degraded") == {"device": "staged"}
-            and degraded_root == healthy_root
+            and roots_identical
+            and detect_blocks is not None
+            and page_dump is not None
+            and trip_dump is not None
+            and "degraded" in (health.get("slo") or {}).get("burning", [])
         ),
     }
     degrade.reset_for_tests()
     return result
 
 
-def seam_table() -> str:
-    """The per-seam injection/recovery counts, straight off the registry."""
+def seam_table_lines(prefixes: tuple[str, ...]) -> list[str]:
+    """Exposition lines for the given metric families, straight off the
+    registry (the soak's summary-table reader)."""
     from celestia_app_tpu.trace.metrics import registry
 
-    lines = [
+    return [
         line for line in registry().render().splitlines()
-        if line.startswith(("celestia_chaos_injections_total",
-                            "celestia_recoveries_total"))
+        if line.startswith(prefixes) and not line.startswith("#")
     ]
+
+
+def seam_table() -> str:
+    """The per-seam injection/recovery counts, straight off the registry."""
+    lines = seam_table_lines(("celestia_chaos_injections_total",
+                              "celestia_recoveries_total"))
     return "\n".join(lines) or "(no injections fired)"
+
+
+def _detection_cell(det: dict | None) -> str:
+    if det is None:
+        return f"{'-':<16} {'-':>6} {'-':>10}"
+    blocks = det.get("blocks")
+    wall = det.get("wall_ms")
+    return (f"{det.get('by') or '-':<16} "
+            f"{blocks if blocks is not None else '-':>6} "
+            f"{wall if wall is not None else '-':>10}")
+
+
+def detection_table(rows: list[tuple[str, dict | None]]) -> str:
+    """The per-drill time-to-detection summary: which signal noticed the
+    injected fault first (SLO page / flight trigger), after how many
+    blocks, and after how many wall-ms."""
+    out = [f"{'drill':<22} {'detected by':<16} {'blocks':>6} {'wall_ms':>10}"]
+    for name, det in rows:
+        out.append(f"{name:<22} {_detection_cell(det)}")
+    return "\n".join(out)
 
 
 def main(argv=None) -> int:
@@ -308,7 +443,9 @@ def main(argv=None) -> int:
     ap.add_argument("--spec", default=DEFAULT_SPEC)
     args = ap.parse_args(argv)
 
-    print(f"chaos_soak: spec={args.spec!r}", flush=True)
+    flight_dir = _arm_flight_recorder()
+    print(f"chaos_soak: spec={args.spec!r} flight_dir={flight_dir}",
+          flush=True)
     failures = []
 
     dev = run_device_soak(args.blocks, args.k, args.spec)
@@ -339,19 +476,43 @@ def main(argv=None) -> int:
     brk_epi = run_breaker_drill(k=min(args.k, 8), base_env="epi")
     print(f"breaker drill (epi seat): mode_after={brk_epi['mode_after']} "
           f"health={brk_epi['health_status']} "
-          f"roots_identical={brk_epi['roots_identical']}", flush=True)
+          f"roots_identical={brk_epi['roots_identical']} "
+          f"paged={brk_epi['paged']} "
+          f"detection={brk_epi['detection_blocks']} blocks / "
+          f"{brk_epi['detection_wall_ms']} ms", flush=True)
     if not brk_epi["ok"]:
         failures.append(f"breaker drill (epi seat) failed: {brk_epi}")
 
     brk = run_breaker_drill(k=min(args.k, 8))
     print(f"breaker drill: mode_after={brk['mode_after']} "
           f"health={brk['health_status']} {brk['health_degraded']} "
-          f"roots_identical={brk['roots_identical']}", flush=True)
+          f"roots_identical={brk['roots_identical']} "
+          f"paged={brk['paged']} "
+          f"detection={brk['detection_blocks']} blocks / "
+          f"{brk['detection_wall_ms']} ms flight={brk['flight_bundle']}",
+          flush=True)
     if not brk["ok"]:
         failures.append(f"breaker drill failed: {brk}")
 
     print("\nper-seam injection/recovery counts:")
     print(seam_table(), flush=True)
+
+    print("\ntime-to-detection per drill:")
+    print(detection_table([
+        ("device soak", dev.get("detection")),
+        ("WAL tear", wal.get("detection")),
+        ("gossip", None),  # healed by redundancy: no anomaly to page on
+        ("breaker (epi seat)", brk_epi.get("detection")),
+        ("breaker (fused)", brk.get("detection")),
+    ]), flush=True)
+    flight_lines = seam_table_lines((
+        "celestia_flight_dumps_total",
+        "celestia_flight_dumps_suppressed_total",
+        "celestia_slo_violations_total",
+    ))
+    if flight_lines:
+        print("\npages + flight dumps:")
+        print("\n".join(flight_lines), flush=True)
 
     if failures:
         for f in failures:

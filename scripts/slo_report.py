@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Render a flight-recorder bundle offline: what paged, what was burning,
+and the journal rows around the trigger.
+
+The flight recorder (celestia_app_tpu/trace/flight_recorder.py) writes
+one JSON bundle per anomaly trigger under $CELESTIA_FLIGHT_DIR; this
+script is the forensic reader — no live process, no imports from the
+serving stack, just the bundle:
+
+  python scripts/slo_report.py <bundle.json>        one bundle
+  python scripts/slo_report.py <flight-dir>         the newest bundle
+  python scripts/slo_report.py <flight-dir> --list  enumerate bundles
+  ... --rows 10                                     journal rows shown
+                                                    per table
+
+Sections: the trigger and its context, the health/degradation state at
+capture, the SLO table (state, fast/slow burn, objective — burning rows
+first), the per-tenant accounting snapshot, and the tail of the most
+forensically relevant trace tables (slo_page, flight_dump,
+block_journal, square_journal, chaos_injection, parity_mismatch,
+wal_salvage) around the moment of capture.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+#: Tables rendered (in this order) when present in the bundle; anything
+#: else in the capture is listed by row count only.
+FOCUS_TABLES = (
+    "slo_page",
+    "chaos_injection",
+    "parity_mismatch",
+    "wal_salvage",
+    "flight_dump",
+    "block_journal",
+    "square_journal",
+)
+
+
+def find_bundle(path: str) -> str:
+    """Resolve a bundle path: a file is itself; a directory yields its
+    newest flight-*.json."""
+    if os.path.isfile(path):
+        return path
+    if os.path.isdir(path):
+        bundles = sorted(
+            f for f in os.listdir(path)
+            if f.startswith("flight-") and f.endswith(".json")
+        )
+        if not bundles:
+            raise FileNotFoundError(f"no flight-*.json bundles under {path}")
+        # Filenames embed capture unix-ns, so lexical max of the ts field
+        # is the newest; sort on the embedded timestamp to be exact.
+        bundles.sort(key=lambda f: f.split("-")[-2])
+        return os.path.join(path, bundles[-1])
+    raise FileNotFoundError(path)
+
+
+def list_bundles(path: str) -> list[str]:
+    if not os.path.isdir(path):
+        raise NotADirectoryError(path)
+    return sorted(
+        f for f in os.listdir(path)
+        if f.startswith("flight-") and f.endswith(".json")
+    )
+
+
+def _fmt_ns(ns: int | None) -> str:
+    if not ns:
+        return "-"
+    import datetime
+
+    dt = datetime.datetime.fromtimestamp(ns / 1e9, datetime.timezone.utc)
+    return dt.strftime("%Y-%m-%d %H:%M:%S.%f UTC")
+
+
+def _slo_rows(slo_payload: dict) -> list[tuple[str, dict]]:
+    """SLO rows, burning first (fast_burn, slow_burn, error, ok)."""
+    order = {"fast_burn": 0, "slow_burn": 1, "error": 2, "ok": 3}
+    slos = slo_payload.get("slos", {})
+    return sorted(
+        slos.items(),
+        key=lambda kv: (order.get(kv[1].get("state"), 9), kv[0]),
+    )
+
+
+def render(bundle: dict, rows_per_table: int = 8) -> str:
+    out: list[str] = []
+    trigger = bundle.get("trigger", "?")
+    out.append(f"flight bundle: trigger={trigger!r} "
+               f"captured={_fmt_ns(bundle.get('captured_unix_ns'))} "
+               f"pid={bundle.get('pid', '-')}")
+    ctx = bundle.get("context") or {}
+    if ctx:
+        out.append("trigger context:")
+        for k, v in sorted(ctx.items()):
+            out.append(f"  {k} = {v}")
+    health = bundle.get("healthz") or {}
+    degraded = bundle.get("degraded")
+    out.append(f"health: status={health.get('status', '-')}"
+               + (f" degraded={degraded}" if degraded else ""))
+    if bundle.get("chaos_spec"):
+        out.append(f"chaos spec active: {bundle['chaos_spec']!r}")
+
+    slo_payload = bundle.get("slo") or {}
+    windows = slo_payload.get("windows", {})
+    out.append("")
+    out.append(f"SLOs (fast={windows.get('fast_s', '-')}s "
+               f"slow={windows.get('slow_s', '-')}s, "
+               f"evaluated={slo_payload.get('evaluated_unix_ms', '-')}):")
+    slo_rows = _slo_rows(slo_payload)
+    if not slo_rows:
+        out.append("  (no evaluation retained in bundle)")
+    else:
+        out.append(f"  {'slo':<18} {'state':<10} {'burn fast':>10} "
+                   f"{'burn slow':>10}  objective")
+        for name, r in slo_rows:
+            burn = r.get("burn", {})
+            marker = " <-- PAGING" if r.get("state") == "fast_burn" else ""
+            out.append(
+                f"  {name:<18} {r.get('state', '?'):<10} "
+                f"{burn.get('fast', '-'):>10} {burn.get('slow', '-'):>10}  "
+                f"{r.get('objective', '')}{marker}"
+            )
+
+    ns_payload = bundle.get("namespaces") or {}
+    tenants = ns_payload.get("namespaces") or {}
+    if tenants:
+        out.append("")
+        out.append(f"tenants ({len(tenants)} namespace labels, "
+                   f"top_n={ns_payload.get('top_n', '-')}):")
+        by_shares = sorted(
+            tenants.items(), key=lambda kv: -kv[1].get("shares", 0)
+        )
+        for lbl, t in by_shares[:10]:
+            out.append(f"  {lbl:<20} blobs={t.get('blobs', 0):<8} "
+                       f"shares={t.get('shares', 0):<10} "
+                       f"bytes={t.get('bytes', 0)}")
+
+    tables = bundle.get("tables") or {}
+    out.append("")
+    out.append(f"trace tables captured: "
+               + (", ".join(f"{name}({len(rows)})"
+                            for name, rows in sorted(tables.items()))
+                  or "(none)"))
+    for name in FOCUS_TABLES:
+        rows = tables.get(name)
+        if not rows:
+            continue
+        out.append("")
+        out.append(f"{name} (last {min(rows_per_table, len(rows))} "
+                   f"of {len(rows)} captured):")
+        for row in rows[-rows_per_table:]:
+            compact = {k: v for k, v in row.items() if v is not None}
+            out.append("  " + json.dumps(compact, sort_keys=True)[:240])
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="bundle file or $CELESTIA_FLIGHT_DIR")
+    ap.add_argument("--rows", type=int, default=8,
+                    help="journal rows shown per table (default 8)")
+    ap.add_argument("--list", action="store_true",
+                    help="list bundles in the directory and exit")
+    args = ap.parse_args(argv)
+
+    try:
+        if args.list:
+            for name in list_bundles(args.path):
+                print(name)
+            return 0
+        path = find_bundle(args.path)
+    except (FileNotFoundError, NotADirectoryError) as e:
+        print(f"slo_report: {e}", file=sys.stderr)
+        return 2
+    with open(path, encoding="utf-8") as f:
+        bundle = json.load(f)
+    print(f"# {path}")
+    print(render(bundle, rows_per_table=max(1, args.rows)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

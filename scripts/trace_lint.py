@@ -27,7 +27,14 @@ Walks every registry().counter/gauge/histogram registration in
      instead of retried/degraded/propagated (the chaos layer exists
      because of exactly such sites) — the tag forces each one to say why
      swallowing is right.  Existing sites were grandfathered by tagging
-     them with their (pre-existing) rationales.
+     them with their (pre-existing) rationales; and
+  6. every path ROUTED in trace/exposition.handle_observability_get —
+     an `p == "/x"` equality or a `p.startswith("/x/")` prefix — appears
+     in the README endpoint table as a `GET /x` (prefix routes match any
+     documented `GET /x/<placeholder>` row).  The shared handler is what
+     makes the three planes' observability surface one surface; this
+     rule closes the doc-drift loophole where a new endpoint ships on
+     every plane but no operator can discover it.
 
 Run standalone (exit 1 on problems) or via tests/test_trace_lint.py,
 which puts the check in tier-1.
@@ -61,6 +68,12 @@ CAP_HELPER = "capped_namespace_label"
 # handler must carry a `# chaos-ok:` rationale tag.
 HOT_PATH_PREFIXES = ("parallel/", "da/", "kernels/", "consensus/")
 CHAOS_OK_TAG = "chaos-ok:"
+
+# Rule 6: the shared observability router + the README table its routes
+# must be documented in.
+EXPOSITION_REL = os.path.join("celestia_app_tpu", "trace", "exposition.py")
+ROUTER_FUNC = "handle_observability_get"
+README_ENDPOINT_RE = re.compile(r"GET\s+(/[A-Za-z0-9_/<>-]*)")
 
 
 def _parse_package(package_dir: str = PACKAGE_DIR):
@@ -185,9 +198,57 @@ def collect_broad_excepts(package_dir: str = PACKAGE_DIR, trees=None):
     return out
 
 
+def collect_routed_paths(package_dir: str = PACKAGE_DIR, trees=None):
+    """[(file, lineno, kind, path)] for every route in the shared
+    observability handler: kind "exact" for `p == "/x"` comparisons,
+    "prefix" for `p.startswith("/x/")`.  The bare "/" normalization
+    compare is not a route and is skipped."""
+    out = []
+    for rel, tree, _ in trees if trees is not None else _parse_package(package_dir):
+        if rel.replace(os.sep, "/") != EXPOSITION_REL.replace(os.sep, "/"):
+            continue
+        router = next(
+            (n for n in ast.walk(tree)
+             if isinstance(n, ast.FunctionDef) and n.name == ROUTER_FUNC),
+            None,
+        )
+        if router is None:
+            continue
+        for node in ast.walk(router):
+            if isinstance(node, ast.Compare):
+                for side in [node.left, *node.comparators]:
+                    if (
+                        isinstance(side, ast.Constant)
+                        and isinstance(side.value, str)
+                        and side.value.startswith("/")
+                        and side.value != "/"
+                    ):
+                        out.append((rel, node.lineno, "exact", side.value))
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "startswith"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and node.args[0].value.startswith("/")
+            ):
+                out.append(
+                    (rel, node.lineno, "prefix", node.args[0].value)
+                )
+    return out
+
+
 def readme_metric_tokens(readme_path: str = README) -> set[str]:
     with open(readme_path, encoding="utf-8") as f:
         return set(README_TOKEN_RE.findall(f.read()))
+
+
+def readme_endpoint_paths(readme_path: str = README) -> set[str]:
+    """Every `GET /path` the README documents (the endpoint table plus
+    any prose mention — either keeps the route discoverable)."""
+    with open(readme_path, encoding="utf-8") as f:
+        return set(README_ENDPOINT_RE.findall(f.read()))
 
 
 def lint(package_dir: str = PACKAGE_DIR, readme_path: str = README) -> list[str]:
@@ -249,15 +310,33 @@ def lint(package_dir: str = PACKAGE_DIR, readme_path: str = README) -> list[str]
                 "sites on the block path must say why they are not a "
                 "retry/degrade/propagate seam (see chaos/)"
             )
+    endpoints = readme_endpoint_paths(readme_path)
+    for rel, lineno, kind, path in collect_routed_paths(package_dir, trees):
+        where = f"{rel}:{lineno}"
+        if kind == "exact":
+            documented = path in endpoints
+        else:  # prefix route: any documented path under the prefix counts
+            documented = any(
+                e.startswith(path) and len(e) > len(path) for e in endpoints
+            )
+        if not documented:
+            problems.append(
+                f"{where}: routed path {path!r}{'*' if kind == 'prefix' else ''} "
+                "missing from the README endpoint table — every route on "
+                "the shared observability handler must be documented "
+                "(GET <path> in README.md)"
+            )
     return problems
 
 
 def main() -> int:
     problems = lint()
     regs = collect_registrations()
+    routes = collect_routed_paths()
     print(
         f"trace_lint: {len(regs)} registrations "
-        f"({len({n for _, _, k, n in regs if k == 'static'})} distinct static names)"
+        f"({len({n for _, _, k, n in regs if k == 'static'})} distinct static names), "
+        f"{len(routes)} observability routes"
     )
     for p in problems:
         print(f"  PROBLEM {p}")

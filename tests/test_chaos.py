@@ -122,13 +122,27 @@ class TestDegradationLadder:
         """The acceptance drill: persistent injected device failure flips
         pipeline_mode to staged within the breaker window, with
         celestia_degraded and /healthz reflecting it — and the root
-        unchanged."""
+        unchanged.  End-to-end DETECTION rides the same drill: the
+        `degraded` SLO must enter fast-burn (a page) and the flight
+        recorder must write bundles, within the drill's block budget,
+        with the detection latency reported."""
+        import os
+
         soak = _load_soak()
         result = soak.run_breaker_drill(k=4)
         assert result["ok"], result
         assert result["mode_after"] == "staged"
         assert result["health_status"] == "DEGRADED"
         assert result["roots_identical"]
+        # The telemetry plane judged the incident itself:
+        assert result["paged"]
+        assert result["detection_blocks"] is not None
+        assert result["detection_blocks"] <= result["blocks_run"]
+        assert result["detection_wall_ms"] > 0
+        assert "degraded" in result["slo_health"]["burning"]
+        # ... and black-boxed it: both the trip and the page dumped.
+        assert result["breaker_bundle"] and os.path.isfile(result["breaker_bundle"])
+        assert result["flight_bundle"] and os.path.isfile(result["flight_bundle"])
 
     def test_ladder_steps_and_reset(self):
         ladder = degrade.DeviceDegradation()
@@ -266,8 +280,13 @@ class TestChaosSmoke:
         assert result["ok"], result
         assert _injections("gossip.send") > before
 
-    def test_soak_main_smoke(self, capsys):
-        """The script's own entry point end to end (tiny knobs)."""
+    def test_soak_main_smoke(self, capsys, monkeypatch, tmp_path):
+        """The script's own entry point end to end (tiny knobs).
+
+        main() arms the flight recorder via $CELESTIA_FLIGHT_DIR for the
+        whole process; monkeypatch scopes that to this test so later
+        tests don't inherit an armed recorder."""
+        monkeypatch.setenv("CELESTIA_FLIGHT_DIR", str(tmp_path))
         soak = _load_soak()
         rc = soak.main([
             "--blocks", "3", "--k", "4",
@@ -278,6 +297,11 @@ class TestChaosSmoke:
         assert rc == 0, out
         assert "chaos_soak: OK" in out
         assert "celestia_chaos_injections_total" in out
+        # The per-drill detection-latency summary prints, and the
+        # breaker drills page via the SLO engine.
+        assert "time-to-detection per drill" in out
+        assert "slo_fast_burn" in out
+        assert "celestia_slo_violations_total" in out
 
 
 class TestPipelinePropagation:

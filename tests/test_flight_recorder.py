@@ -1,0 +1,175 @@
+"""Flight recorder: bundle capture, atomicity, per-trigger rate
+limiting, and the breaker-trip hook (trace/flight_recorder.py).
+
+Crypto-free: the black box must be pinned even in slim images.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from celestia_app_tpu.chaos import degrade
+from celestia_app_tpu.trace import flight_recorder as fr
+from celestia_app_tpu.trace.tracer import traced
+
+
+def _counter_value(name: str, **labels) -> float:
+    from celestia_app_tpu.trace.metrics import registry
+
+    for line in registry().render().splitlines():
+        if line.startswith(name) and all(
+            f'{k}="{v}"' in line for k, v in labels.items()
+        ):
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+class TestFlightRecorder:
+    def test_disabled_without_flight_dir(self, monkeypatch):
+        monkeypatch.delenv("CELESTIA_FLIGHT_DIR", raising=False)
+        fr._reset_for_tests()
+        assert fr.note_trigger("breaker_trip", mode="staged") is None
+
+    def test_bundle_contents_and_atomicity(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("CELESTIA_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("CELESTIA_FLIGHT_TAIL", "5")
+        fr._reset_for_tests()
+        for i in range(10):
+            traced().write("fr_bundle_table", i=i)
+        path = fr.note_trigger("parity_mismatch", k=8, served="aa",
+                               staged="bb")
+        assert path and os.path.isfile(path)
+        name = os.path.basename(path)
+        assert name.startswith("flight-parity_mismatch-")
+        assert name.endswith(".json")
+        # Atomic write: no dot-tmp remnants next to the bundle.
+        assert not [f for f in os.listdir(tmp_path) if f.startswith(".tmp-")]
+        with open(path, encoding="utf-8") as f:
+            bundle = json.load(f)
+        assert bundle["trigger"] == "parity_mismatch"
+        assert bundle["context"]["k"] == 8
+        # Every table is tail-capped at $CELESTIA_FLIGHT_TAIL rows.
+        rows = bundle["tables"]["fr_bundle_table"]
+        assert len(rows) == 5 and rows[-1]["i"] == 9
+        # The judgment + degradation state rides along.
+        assert bundle["healthz"]["status"] in ("SERVING", "DEGRADED")
+        assert "slos" in bundle["slo"]
+        assert "namespaces" in bundle["namespaces"]
+        assert _counter_value(
+            "celestia_flight_dumps_total", trigger="parity_mismatch"
+        ) >= 1
+        # ...and the dump itself is journaled (how drills measure
+        # time-to-detection).
+        dump_rows = [r for r in traced().table("flight_dump")
+                     if r.get("path") == path]
+        assert dump_rows and dump_rows[0]["trigger"] == "parity_mismatch"
+
+    def test_flapping_trigger_is_rate_limited(self, monkeypatch, tmp_path):
+        """Acceptance: a flapping trigger produces suppressed-dump
+        counts, not unbounded disk writes."""
+        monkeypatch.setenv("CELESTIA_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("CELESTIA_FLIGHT_MIN_INTERVAL_S", "3600")
+        fr._reset_for_tests()
+        suppressed_before = _counter_value(
+            "celestia_flight_dumps_suppressed_total", trigger="worker_death"
+        )
+        paths = [fr.note_trigger("worker_death", stage="uploader", n=i)
+                 for i in range(10)]
+        written = [p for p in paths if p]
+        assert len(written) == 1  # first dump only
+        assert len(os.listdir(tmp_path)) == 1
+        assert _counter_value(
+            "celestia_flight_dumps_suppressed_total", trigger="worker_death"
+        ) == suppressed_before + 9
+        # A DIFFERENT trigger is not suppressed by this one's limiter.
+        assert fr.note_trigger("wal_salvage", where="replay") is not None
+
+    def test_interval_zero_disables_suppression(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("CELESTIA_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("CELESTIA_FLIGHT_MIN_INTERVAL_S", "0")
+        fr._reset_for_tests()
+        assert fr.note_trigger("slo_fast_burn", slo="x") is not None
+        assert fr.note_trigger("slo_fast_burn", slo="x") is not None
+        assert len(os.listdir(tmp_path)) == 2
+
+    def test_never_raises_on_unwritable_dir(self, monkeypatch, tmp_path):
+        target = tmp_path / "blocked"
+        target.write_text("a file, not a dir")  # makedirs will fail
+        monkeypatch.setenv("CELESTIA_FLIGHT_DIR", str(target))
+        monkeypatch.setenv("CELESTIA_FLIGHT_MIN_INTERVAL_S", "3600")
+        fr._reset_for_tests()
+        failed_before = _counter_value(
+            "celestia_flight_dumps_failed_total", trigger="breaker_trip"
+        )
+        assert fr.note_trigger("breaker_trip", mode="host") is None
+        assert _counter_value(
+            "celestia_flight_dumps_failed_total", trigger="breaker_trip"
+        ) == failed_before + 1
+        # A failed attempt releases its rate-limit slot: once the path is
+        # writable again the NEXT firing dumps instead of being
+        # suppressed as a duplicate of a bundle that never existed.
+        target.unlink()
+        assert fr.note_trigger("breaker_trip", mode="host") is not None
+
+    def test_breaker_trip_hook_dumps(self, monkeypatch, tmp_path):
+        """DeviceDegradation.degrade black-boxes the trip."""
+        monkeypatch.setenv("CELESTIA_FLIGHT_DIR", str(tmp_path))
+        fr._reset_for_tests()
+        ladder = degrade.DeviceDegradation()
+        try:
+            assert ladder.degrade("fused", observed="fused") == "staged"
+            bundles = [f for f in os.listdir(tmp_path)
+                       if f.startswith("flight-breaker_trip-")]
+            assert len(bundles) == 1
+            with open(tmp_path / bundles[0], encoding="utf-8") as f:
+                bundle = json.load(f)
+            assert bundle["context"]["mode"] == "staged"
+            assert bundle["context"]["observed"] == "fused"
+        finally:
+            # degrade() published to the GLOBAL celestia_degraded gauge;
+            # clear it so later SLO ticks don't see a phantom trip.
+            ladder.reset()
+
+
+class TestSLOReport:
+    """scripts/slo_report.py renders a bundle offline."""
+
+    def _load(self):
+        import importlib.util
+
+        script = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "slo_report.py",
+        )
+        spec = importlib.util.spec_from_file_location("slo_report", script)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_renders_a_real_bundle(self, monkeypatch, tmp_path, capsys):
+        monkeypatch.setenv("CELESTIA_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("CELESTIA_FLIGHT_MIN_INTERVAL_S", "0")
+        fr._reset_for_tests()
+        from celestia_app_tpu.trace import slo
+
+        slo.engine().tick()  # retain an evaluation for the bundle
+        traced().write("slo_page", slo="degraded", state="fast_burn")
+        path = fr.note_trigger("slo_fast_burn", slo="degraded",
+                               burn_fast=100.0)
+        assert path
+        report = self._load()
+        # Directory resolution picks the newest bundle; --rows renders
+        # the table tails.
+        assert report.main([str(tmp_path), "--rows", "3"]) == 0
+        out = capsys.readouterr().out
+        assert "trigger='slo_fast_burn'" in out
+        assert "SLOs (" in out
+        assert "slo_page" in out
+        assert report.main([str(tmp_path), "--list"]) == 0
+        assert os.path.basename(path) in capsys.readouterr().out
+
+    def test_missing_bundle_is_exit_2(self, tmp_path, capsys):
+        report = self._load()
+        assert report.main([str(tmp_path / "nope.json")]) == 2
+        assert report.main([str(tmp_path)]) == 2  # empty dir

@@ -1,0 +1,286 @@
+"""SLO burn-rate engine + quantile estimation (trace/slo.py,
+trace/metrics.py snapshot/quantile).
+
+Crypto-free on purpose: the judgment layer must be pinned even in slim
+images (like the rest of the observability stack).  Engine tests inject
+a fake clock so windows are deterministic; metric families use
+test-unique names so the process-global registry never cross-talks.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from celestia_app_tpu.trace import slo
+from celestia_app_tpu.trace.metrics import Registry, registry
+from celestia_app_tpu.trace.slo import SLOEngine, SLOSpec
+
+
+class TestQuantileEstimation:
+    """Histogram.quantile + snapshot()/delta(): bucket-interpolated
+    estimates against exact sample sets, usable standalone from the SLO
+    engine (which builds its windows from exactly these)."""
+
+    def _hist(self):
+        r = Registry()
+        return r.histogram("q_seconds", buckets=(0.1, 1.0, 10.0))
+
+    def test_quantile_interpolates_within_bounding_bucket(self):
+        h = self._hist()
+        # 5 samples land in (0, 0.1], 4 in (0.1, 1.0], 1 in (1.0, 10.0].
+        for v in [0.05] * 5 + [0.5] * 4 + [5.0]:
+            h.observe(v, phase="total")
+        # p50: rank 5 of 10 -> exactly fills bucket 1 -> its upper bound.
+        assert h.quantile(0.5, phase="total") == 0.1
+        # p90: rank 9 of 10 -> end of bucket 2 -> 1.0.
+        assert abs(h.quantile(0.9, phase="total") - 1.0) < 1e-9
+        # p99: rank 9.9 -> 0.9 into bucket 3's count of 1 -> 1 + 9*0.9.
+        assert abs(h.quantile(0.99, phase="total") - 9.1) < 1e-9
+
+    def test_inf_tail_clamps_to_largest_finite_bound(self):
+        h = self._hist()
+        for _ in range(10):
+            h.observe(100.0)  # all in the +Inf tail
+        assert h.quantile(0.99) == 10.0
+
+    def test_empty_and_bad_q(self):
+        h = self._hist()
+        assert h.quantile(0.99) is None
+        h.observe(0.5)
+        with pytest.raises(ValueError):
+            h.quantile(1.5)
+
+    def test_label_selector_merges_subset_matches(self):
+        h = self._hist()
+        h.observe(0.05, phase="total", namespace="aa")
+        h.observe(5.0, phase="total", namespace="bb")
+        h.observe(0.05, phase="dispatch")
+        snap = h.snapshot()
+        # phase=total merges both per-namespace children...
+        assert snap.count(phase="total") == 2
+        # ...and the unlabeled selector merges everything.
+        assert snap.count() == 3
+        assert snap.count(phase="reap") == 0
+
+    def test_snapshot_delta_isolates_the_window(self):
+        h = self._hist()
+        for _ in range(8):
+            h.observe(0.05, phase="total")  # old, fast traffic
+        s1 = h.snapshot()
+        for _ in range(4):
+            h.observe(5.0, phase="total")  # the window's slow burst
+        delta = h.snapshot().delta(s1)
+        assert delta.count(phase="total") == 4
+        # Cumulative view is diluted; the window sees only the burst.
+        assert h.quantile(0.5, phase="total") < 1.0
+        assert delta.quantile(0.5, phase="total") > 1.0
+        assert delta.fraction_over(1.0, phase="total") == 1.0
+
+    def test_fraction_over_interpolates(self):
+        h = self._hist()
+        for _ in range(10):
+            h.observe(0.5)  # all inside (0.1, 1.0]
+        snap = h.snapshot()
+        # Threshold 0.55 sits halfway through (0.1, 1.0]: interpolation
+        # attributes half the bucket above it.
+        assert abs(snap.fraction_over(0.55) - 0.5) < 1e-9
+        assert snap.fraction_over(1.0) == 0.0
+        assert snap.fraction_over(0.05) > 0.9
+
+    def test_delta_tolerates_new_children_and_resets(self):
+        h = self._hist()
+        h.observe(0.5, k="4")
+        s1 = h.snapshot()
+        h.observe(0.5, k="8")  # child born inside the window
+        delta = h.snapshot().delta(s1)
+        assert delta.count(k="8") == 1
+        assert delta.count(k="4") == 0
+
+
+class _Clock:
+    """Injectable monotonic clock."""
+
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+def _quantile_spec(metric: str, **over) -> SLOSpec:
+    kw = dict(name="test_p99", metric=metric,
+              labels=(("phase", "total"),), quantile=0.99, threshold=1.0)
+    kw.update(over)
+    return SLOSpec(**kw)
+
+
+class TestSLOEngineQuantile:
+    def test_good_traffic_burns_nothing(self, monkeypatch):
+        monkeypatch.setenv("CELESTIA_SLO_FAST_S", "10")
+        monkeypatch.setenv("CELESTIA_SLO_SLOW_S", "100")
+        metric = "slo_t_good_seconds"
+        hist = registry().histogram(metric, buckets=(0.1, 1.0, 10.0))
+        clock = _Clock()
+        eng = SLOEngine((_quantile_spec(metric),), clock=clock)
+        eng.tick()
+        for _ in range(50):
+            hist.observe(0.05, phase="total")
+        clock.advance(2)
+        res = eng.tick()["test_p99"]
+        assert res["state"] == "ok"
+        assert res["burn"] == {"fast": 0.0, "slow": 0.0}
+        assert res["window_count"] == 50
+        assert res["current"] <= 0.1
+
+    def test_sustained_badness_pages_fast_window(self, monkeypatch):
+        monkeypatch.setenv("CELESTIA_SLO_FAST_S", "10")
+        monkeypatch.setenv("CELESTIA_SLO_SLOW_S", "100")
+        metric = "slo_t_bad_seconds"
+        hist = registry().histogram(metric, buckets=(0.1, 1.0, 10.0))
+        clock = _Clock()
+        eng = SLOEngine((_quantile_spec(metric),), clock=clock)
+        eng.tick()
+        before = _counter_value("celestia_slo_violations_total",
+                                slo="test_p99")
+        for _ in range(20):
+            hist.observe(5.0, phase="total")  # every event over threshold
+        clock.advance(2)
+        res = eng.tick()["test_p99"]
+        # bad fraction 1.0 / budget 0.01 = burn 100 >= 14.4 -> page.
+        assert res["state"] == "fast_burn"
+        assert res["burn"]["fast"] == pytest.approx(100.0)
+        assert eng.paged("test_p99")
+        assert _counter_value(
+            "celestia_slo_violations_total", slo="test_p99"
+        ) == before + 1
+        # Staying in fast_burn on the next tick is NOT a second page.
+        clock.advance(1)
+        hist.observe(5.0, phase="total")
+        eng.tick()
+        assert _counter_value(
+            "celestia_slo_violations_total", slo="test_p99"
+        ) == before + 1
+        # Burn gauges published per window.
+        text = registry().render()
+        assert 'celestia_slo_burn_rate{slo="test_p99",window="fast"}' in text
+        assert 'celestia_slo_burn_rate{slo="test_p99",window="slow"}' in text
+
+    def test_fast_window_recovers_while_slow_still_burns(self, monkeypatch):
+        monkeypatch.setenv("CELESTIA_SLO_FAST_S", "10")
+        monkeypatch.setenv("CELESTIA_SLO_SLOW_S", "1000")
+        metric = "slo_t_recover_seconds"
+        hist = registry().histogram(metric, buckets=(0.1, 1.0, 10.0))
+        clock = _Clock()
+        spec = _quantile_spec(metric, slow_burn=50.0)
+        eng = SLOEngine((spec,), clock=clock)
+        eng.tick()
+        for _ in range(20):
+            hist.observe(5.0, phase="total")  # the incident
+        clock.advance(2)
+        assert eng.tick()["test_p99"]["state"] == "fast_burn"
+        # The incident ends; good traffic resumes and the fast window
+        # slides past the burst while the slow window still holds it.
+        for step in range(6):
+            clock.advance(4)
+            for _ in range(10):
+                hist.observe(0.05, phase="total")
+            res = eng.tick()["test_p99"]
+        assert res["burn"]["fast"] == 0.0
+        assert res["burn"]["slow"] > 0.0
+        assert res["state"] in ("ok", "slow_burn")
+
+    def test_no_data_is_ok_not_error(self):
+        eng = SLOEngine((_quantile_spec("slo_t_absent_seconds"),),
+                        clock=_Clock())
+        res = eng.tick()["test_p99"]
+        assert res["state"] == "ok"
+        assert res["burn"] == {"fast": 0.0, "slow": 0.0}
+
+
+class TestSLOEngineGauge:
+    def test_gauge_predicate_pages_and_recovers(self, monkeypatch):
+        monkeypatch.setenv("CELESTIA_SLO_FAST_S", "10")
+        monkeypatch.setenv("CELESTIA_SLO_SLOW_S", "40")
+        metric = "slo_t_degraded"
+        gauge = registry().gauge(metric)
+        gauge.set(0.0, mode="staged")
+        spec = SLOSpec(name="test_degraded", metric=metric, kind="gauge",
+                       op="==", threshold=0.0, budget=0.01)
+        clock = _Clock()
+        eng = SLOEngine((spec,), clock=clock)
+        assert eng.tick()["test_degraded"]["state"] == "ok"
+        gauge.set(1.0, mode="staged")  # the breaker trips
+        clock.advance(1)
+        res = eng.tick()["test_degraded"]
+        assert res["state"] == "fast_burn"
+        assert res["violated_now"] == 1
+        assert eng.paged("test_degraded")
+        # Recovery: predicate holds again, the violated ticks age out of
+        # the windows, the page clears.
+        gauge.set(0.0, mode="staged")
+        for _ in range(12):
+            clock.advance(5)
+            res = eng.tick()["test_degraded"]
+        assert res["state"] == "ok"
+        assert not eng.paged("test_degraded")
+
+    def test_label_selector_restricts_samples(self):
+        metric = "slo_t_occupancy"
+        gauge = registry().gauge(metric)
+        gauge.set(0.9, k="8")
+        gauge.set(0.01, k="64")
+        spec = SLOSpec(name="test_occ", metric=metric, kind="gauge",
+                       op=">=", threshold=0.05,
+                       labels=(("k", "8"),))
+        eng = SLOEngine((spec,), clock=_Clock())
+        assert eng.tick()["test_occ"]["violated_now"] == 0
+        spec_all = SLOSpec(name="test_occ_all", metric=metric, kind="gauge",
+                           op=">=", threshold=0.05)
+        eng2 = SLOEngine((spec_all,), clock=_Clock())
+        assert eng2.tick()["test_occ_all"]["violated_now"] == 1
+
+
+class TestEngineSurface:
+    def test_default_specs_evaluate_clean(self):
+        eng = SLOEngine(clock=_Clock())
+        res = eng.tick()
+        assert {"e2e_total_p99", "dispatch_p99", "mempool_wait_p99",
+                "square_occupancy", "degraded"} <= set(res)
+        for r in res.values():
+            assert "burn" in r and "state" in r, r
+
+    def test_payload_and_health_block_shape(self):
+        eng = SLOEngine(clock=_Clock())
+        # Pre-tick: empty but well-formed (healthz must not explode on a
+        # fresh process).
+        assert eng.health_block() == {"status": "OK", "burning": []}
+        eng.tick()
+        payload = eng.payload()
+        assert set(payload) == {"windows", "evaluated_unix_ms", "slos"}
+        assert payload["slos"]["degraded"]["objective"]
+        assert eng.health_block()["status"] in ("OK", "BURNING")
+
+    def test_maybe_tick_rate_limit(self, monkeypatch):
+        monkeypatch.setenv("CELESTIA_SLO_TICK_S", "100")
+        clock = _Clock()
+        eng = SLOEngine((), clock=clock)
+        assert eng.maybe_tick() is True  # first tick always runs
+        assert eng.maybe_tick() is False  # inside the interval
+        clock.advance(101)
+        assert eng.maybe_tick() is True
+
+    def test_global_engine_reset(self):
+        eng = slo._reset_for_tests()
+        assert slo.engine() is eng
+
+
+def _counter_value(name: str, **labels) -> float:
+    for line in registry().render().splitlines():
+        if line.startswith(name) and all(
+            f'{k}="{v}"' in line for k, v in labels.items()
+        ):
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0

@@ -189,3 +189,87 @@ def test_in_tree_namespace_labels_all_route_through_the_cap(tmp_str=None):
     for f, _, label, has_helper in uses:
         if label in lint.UNBOUNDED_LABELS:
             assert has_helper, f"{f} uses {label!r} without the cap helper"
+
+
+def test_routed_paths_must_be_documented(tmp_path):
+    """Rule 6: every path routed by the shared observability handler
+    must appear as a GET /<path> in the README endpoint table (prefix
+    routes match a documented placeholder row)."""
+    lint = _load()
+    pkg = tmp_path / "pkg"
+    (pkg / "trace").mkdir(parents=True)
+    (pkg / "trace" / "exposition.py").write_text(
+        "def handle_observability_get(path):\n"
+        "    p = path.split('?', 1)[0]\n"
+        "    if p != '/':\n"  # normalization compare: not a route
+        "        p = p.rstrip('/')\n"
+        "    if p == '/metrics':\n"
+        "        return 1\n"
+        "    if p == '/undocumented':\n"
+        "        return 2\n"
+        "    if p.startswith('/tables/'):\n"
+        "        return 3\n"
+        "    if p.startswith('/secret/'):\n"
+        "        return 4\n"
+        "    return None\n"
+    )
+    readme = tmp_path / "README.md"
+    readme.write_text(
+        "| `GET /metrics` | exposition |\n"
+        "| `GET /tables/<name>` | a table |\n"
+    )
+    # collect_routed_paths only looks at trace/exposition.py -- but the
+    # tmp package has it at the same relative location only if rooted
+    # like the real tree; point EXPOSITION_REL at the tmp layout.
+    saved = lint.EXPOSITION_REL
+    lint.EXPOSITION_REL = "trace/exposition.py"
+    try:
+        import os as _os
+
+        rel_trees = []
+        for rel, tree, lines in lint._parse_package(str(pkg)):
+            # _parse_package keys paths relative to the REPO root; re-key
+            # them relative to the tmp package so the router is found.
+            rel_trees.append((
+                _os.path.relpath(_os.path.join(lint.REPO_ROOT, rel), str(pkg)),
+                tree, lines,
+            ))
+        problems = [
+            p for p in (
+                f for f in _route_problems(lint, rel_trees, str(readme))
+            )
+        ]
+    finally:
+        lint.EXPOSITION_REL = saved
+    assert any("/undocumented" in p for p in problems)
+    assert any("/secret/" in p for p in problems)
+    assert not any("/metrics" in p for p in problems)
+    assert not any("/tables/" in p for p in problems)
+    # The "/" normalization compare is never a route.
+    assert not any("'/'" in p for p in problems)
+
+
+def _route_problems(lint, trees, readme_path):
+    endpoints = lint.readme_endpoint_paths(readme_path)
+    for rel, lineno, kind, path in lint.collect_routed_paths(trees=trees):
+        if kind == "exact":
+            documented = path in endpoints
+        else:
+            documented = any(
+                e.startswith(path) and len(e) > len(path) for e in endpoints
+            )
+        if not documented:
+            yield f"{rel}:{lineno}: routed path {path!r} undocumented"
+
+
+def test_in_tree_routes_are_seen_and_documented():
+    # The real handler's routes are collected (so rule 6 bites on
+    # something real) and /slo -- this PR's new endpoint -- is among
+    # them, documented.
+    lint = _load()
+    routes = lint.collect_routed_paths()
+    paths = {p for _, _, _, p in routes}
+    assert "/slo" in paths
+    assert "/metrics" in paths
+    assert "/trace_tables/" in paths  # the prefix route
+    assert "/" not in paths  # normalization compare is not a route

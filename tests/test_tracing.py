@@ -271,7 +271,13 @@ class TestHealthz:
             exposition._HEALTH_PROVIDERS.clear()
         try:
             status, _, body = handle_observability_get("/healthz")
-            assert status == 200 and json.loads(body) == {"status": "SERVING"}
+            payload = json.loads(body)
+            # The SLO judgment block is always present (PR 7); with no
+            # providers registered, nothing else is.
+            assert status == 200
+            assert payload["status"] == "SERVING"
+            assert set(payload) == {"status", "slo"}
+            assert payload["slo"]["status"] in ("OK", "BURNING")
         finally:
             with exposition._HEALTH_LOCK:
                 exposition._HEALTH_PROVIDERS.update(saved)
@@ -295,7 +301,8 @@ class TestHealthz:
             unregister_health_provider("good")
             unregister_health_provider("bad")
         status, _, body = handle_observability_get("/healthz")
-        assert json.loads(body) == {"status": "SERVING"}
+        payload = json.loads(body)
+        assert payload["status"] == "SERVING" and "layers" not in payload
 
     def test_unregister_checks_identity(self):
         def one():
