@@ -464,19 +464,59 @@ class App:
         # bytes this node already extended (its own Prepare, usually).
         return self._square_root(sq.size, sq.share_bytes()) == data.hash
 
-    def _square_root(self, size: int, share_bytes: list[bytes]) -> bytes:
-        """DAH hash of a built square, memoized on the square's content."""
+    @staticmethod
+    def _square_key(size: int, share_bytes: list[bytes]) -> tuple:
         import hashlib
 
         digest = hashlib.sha256()
         for s in share_bytes:
             digest.update(s)
-        key = (size, digest.digest())
+        return (size, digest.digest())
+
+    def square_eds(self, size: int, share_bytes: list[bytes]):
+        """The extended square for a built square's shares — the serve
+        plane's rebuild source (rpc/server._rebuild_eds): when the
+        content matches the square this app just extended, the SAME
+        device-resident handle comes back with zero extra extensions;
+        otherwise (a cache-miss rebuild of an old height) it extends
+        fresh.  Deliberately NOT a `_last_eds` writer: that slot belongs
+        to the consensus path (_square_root), and a concurrent read-side
+        rebuild overwriting it would displace the just-extended square
+        right before the commit hook retains it.  The rebuild's caller
+        admits the result to the forest cache, so repeats are already
+        covered there."""
+        key = self._square_key(size, share_bytes)
+        last = getattr(self, "_last_eds", None)
+        if last is not None and last[0] == key:
+            return last[1]
+        return extend_shares(share_bytes)
+
+    def last_eds_for_root(self, data_root: bytes):
+        """The freshest extended square IF its DAH hash is `data_root` —
+        how the serving plane's commit hook retains the just-committed
+        height without reconstructing the square (no second layout
+        solve, no duplicate square-journal row, no device work)."""
+        last = getattr(self, "_last_eds", None)
+        if last is not None and last[2] == data_root:
+            return last[1]
+        return None
+
+    def _square_root(self, size: int, share_bytes: list[bytes]) -> bytes:
+        """DAH hash of a built square, memoized on the square's content."""
+        key = self._square_key(size, share_bytes)
         cached = self._own_roots.get(key)
         if cached is not None:
             return cached
         eds = extend_shares(share_bytes)
         root = DataAvailabilityHeader.from_eds(eds).hash()
+        from celestia_app_tpu.serve import serve_heights
+
+        if serve_heights() > 0:
+            # Keep the freshest EDS handle alive for the serve plane's
+            # commit-time retention (ONE handle; the forest cache owns
+            # longer-term residency).  Gated so a node with serving
+            # disabled holds no extra device memory.
+            self._last_eds = (key, eds, root)
         while len(self._own_roots) >= 4:
             self._own_roots.pop(next(iter(self._own_roots)))
         self._own_roots[key] = root

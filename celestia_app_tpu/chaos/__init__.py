@@ -97,3 +97,9 @@ def rpc_handle() -> None:
 def mempool_insert() -> bool:
     inj = injector()
     return inj.mempool_insert() if inj is not None else False
+
+
+def proof_serve() -> None:
+    inj = injector()
+    if inj is not None:
+        inj.proof_serve()

@@ -12,6 +12,7 @@ to something that can fail:
     wal.append        one consensus WAL record append+fsync (consensus/wal)
     rpc.handle        one JSON-RPC request (rpc/server)
     mempool.insert    one tx admission (mempool)
+    proof.serve       one batched DAS proof dispatch (serve/sampler)
 
 Spec grammar — comma-separated `key=value` pairs, e.g.
 
@@ -35,6 +36,9 @@ gossip_drop=0.1,wal_torn_tail=1,rpc_slow_ms=100"
     rpc_fail=<p>          request fails with an injected server error
     mempool_drop=<p>      admission transiently rejects
     mempool_slow_ms=<ms>  [mempool_slow=<p>]
+    proof_fail=<p>        batched proof dispatch raises (host fallback
+                          must answer bit-identically)
+    proof_slow_ms=<ms>    [proof_slow=<p>] proof dispatch stalls
 
 Determinism: every seam draws from its OWN `random.Random` seeded by
 (seed, seam name), so the injection sequence a seam sees depends only on
@@ -71,6 +75,7 @@ SEAMS = (
     "wal.append",
     "rpc.handle",
     "mempool.insert",
+    "proof.serve",
 )
 
 _KNOWN_KEYS = {
@@ -82,6 +87,7 @@ _KNOWN_KEYS = {
     "wal_torn_tail",
     "rpc_slow_ms", "rpc_slow", "rpc_fail",
     "mempool_drop", "mempool_slow_ms", "mempool_slow",
+    "proof_fail", "proof_slow_ms", "proof_slow",
 }
 
 
@@ -228,3 +234,13 @@ class ChaosInjector:
             self._count("mempool.insert", "mempool_drop")
             return True
         return False
+
+    def proof_serve(self) -> None:
+        """Stall and/or fail one BATCHED proof dispatch (serve/sampler):
+        the sampler must absorb the failure by answering the batch on the
+        pure-host path with bit-identical proof bytes — the serve plane's
+        analog of the extend pipeline's fused->staged seam."""
+        self._stall("proof.serve", "proof_slow_ms", "proof_slow")
+        if self._fire("proof.serve", "proof_fail"):
+            self._count("proof.serve", "proof_fail")
+            raise ChaosInjected("proof.serve", "proof_fail")

@@ -335,6 +335,75 @@ class ExtendedDataSquare:
         self._col_roots = col_roots
         self._data_root = data_root
         self.k = k  # ODS width (original square size)
+        # Proof-serving state: per-axis host NMT memo (one tree build per
+        # touched row/col per HANDLE, not per request) and, when the serve
+        # cache retained this height, the device-resident forest handle
+        # (serve/cache.CachedForest) whose precomputed levels replace
+        # host hashing entirely.
+        self._tree_memo: dict = {}
+        self._forest = None  # set by serve/cache.ForestCache.put
+
+    def attach_forest(self, forest) -> None:
+        """Hook the retained device forest onto this handle so every
+        proof path (incl. proof/share_proof's host constructors) stops
+        re-hashing rows the device already hashed."""
+        self._forest = forest
+        self._tree_memo.clear()  # forest-backed trees are strictly better
+
+    def leaf_namespace(self, row: int, col: int) -> bytes:
+        """The namespace the (row, col) EDS leaf carries in its trees:
+        the share's own namespace inside Q0, the parity namespace in
+        every other quadrant (pkg/wrapper/nmt_wrapper.go:93-114)."""
+        if row < self.k and col < self.k:
+            return bytes(
+                np.asarray(self._eds[row, col, :NAMESPACE_SIZE]).tobytes()
+            )
+        return PARITY_NAMESPACE_BYTES
+
+    def _axis_tree(self, axis: str, index: int, *, host: bool = False):
+        """Memoized per-line NMT for one row ("row") or column ("col").
+
+        Returns an object with the `levels()` surface nmt.proof consumes:
+        a forest-backed view (pure indexing) when the serve cache retained
+        this square, else a freshly built host NamespacedMerkleTree whose
+        leaves follow the full-EDS quadrant namespace rule (Q0 leaves own
+        their namespace; EVERY other quadrant is parity — `_row_tree`'s
+        old c<k rule was only valid for top rows).  `host=True` forces
+        the from-scratch host build even with a forest resident — the
+        sampler's bit-exactness fallback must not depend on the machinery
+        it is the fallback FOR.
+        """
+        key = (axis, index, host)
+        cached = self._tree_memo.get(key)
+        if cached is not None:
+            return cached
+        if self._forest is not None and not host:
+            tree = self._forest.line_tree(axis, index)
+        else:
+            from celestia_app_tpu.nmt.tree import NamespacedMerkleTree
+
+            line = (
+                np.asarray(self._eds[index])
+                if axis == "row"
+                else np.asarray(self._eds[:, index])
+            )
+            tree = NamespacedMerkleTree()
+            for j in range(2 * self.k):
+                r, c = (index, j) if axis == "row" else (j, index)
+                ns = (
+                    bytes(line[j, :NAMESPACE_SIZE].tobytes())
+                    if r < self.k and c < self.k
+                    else PARITY_NAMESPACE_BYTES
+                )
+                tree.push(ns + bytes(line[j].tobytes()))
+        self._tree_memo[key] = tree
+        return tree
+
+    def row_tree(self, row: int, *, host: bool = False):
+        return self._axis_tree("row", row, host=host)
+
+    def col_tree(self, col: int, *, host: bool = False):
+        return self._axis_tree("col", col, host=host)
 
     @property
     def width(self) -> int:
@@ -411,6 +480,17 @@ class ExtendedDataSquare:
     def flattened_ods(self) -> list[bytes]:
         q0 = np.asarray(self._eds[: self.k, : self.k])
         return [q0[i, j].tobytes() for i in range(self.k) for j in range(self.k)]
+
+    def ods_namespaces(self) -> np.ndarray:
+        """(k*k, NAMESPACE_SIZE) uint8 of the ODS share namespaces, row
+        major — the namespace-range scan input (proof.ods_namespace_range);
+        memoized so repeated namespace queries pay one device read."""
+        cached = getattr(self, "_ods_ns", None)
+        if cached is None:
+            cached = self._ods_ns = np.asarray(
+                self._eds[: self.k, : self.k, :NAMESPACE_SIZE]
+            ).reshape(self.k * self.k, NAMESPACE_SIZE)
+        return cached
 
     def row_roots(self) -> list[bytes]:
         rr = np.asarray(self._row_roots)

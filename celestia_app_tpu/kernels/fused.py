@@ -248,3 +248,89 @@ def jit_extend_and_dah(
         k, construction or active_construction(), donate, roots_only,
         epilogue,
     )
+
+
+# --- forest retention (the serve plane's read side) -------------------------
+#
+# The block-path program above materializes every NMT level on device and
+# keeps only the 4k roots; the proof-serving plane (serve/) needs the WHOLE
+# forest — every inner node of every row and column tree — so a batch of
+# DAS sample requests is answered by gathers instead of host re-hashing.
+#
+# Deliberately a SEPARATE single-dispatch program over the retained EDS
+# rather than a new output arm of extend_and_dah: widening the block-path
+# program would add compile-cache keys and donation variants to every rung
+# of the degradation ladder for a product only the read side consumes.
+# Admission happens at commit, but the forest dispatch is an ASYNC jax
+# enqueue — the leaf re-hash overlaps whatever runs next, and the commit
+# path only pays the enqueue plus the (memoized) root reads.  The recompute
+# is once per RETAINED height, bounded by $CELESTIA_SERVE_HEIGHTS.
+
+
+def forest_level_layout(k: int) -> tuple[tuple[int, ...], tuple[int, ...]]:
+    """(widths, offsets) of the flattened forest for 2k trees of 2k leaves.
+
+    Level h holds 2k trees x (2k >> h) nodes; the flat (N, 90) array
+    concatenates levels leaf-first, each level row-major by tree.  The
+    node (tree t, level h, index i) lives at flat[offsets[h] + t*widths[h]
+    + i] — the indexing contract serve/sampler.py's gather relies on.
+    """
+    n = 2 * k
+    widths = []
+    w = n
+    while w >= 1:
+        widths.append(w)
+        w //= 2
+    offsets, off = [], 0
+    for w in widths:
+        offsets.append(off)
+        off += n * w
+    return tuple(widths), tuple(offsets)
+
+
+def forest_fn(k: int):
+    """Build f(eds) -> (row_flat, col_flat): the complete namespaced-digest
+    forests of both axes, flattened per forest_level_layout.
+
+    Each node is the 90-byte min||max||hash digest (nmt/hasher.py wire
+    form), so a proof node is a single flat-array row — byte-identical to
+    what the host NamespacedMerkleTree computes for the same leaf
+    (tests/test_das_proofs.py pins proof-level identity).
+    """
+    from celestia_app_tpu.kernels.nmt import (
+        leaf_digests,
+        tree_levels_from_digests,
+    )
+
+    def flatten(levels):
+        return jnp.concatenate(
+            [
+                jnp.concatenate([m, x, h], axis=2).reshape(-1, 90)
+                for m, x, h in levels
+            ],
+            axis=0,
+        )
+
+    def run(eds: jnp.ndarray):
+        from celestia_app_tpu.da.eds import leaf_namespaces
+
+        row_ns, _ = leaf_namespaces(eds, k)
+        mins, maxs, hashes = leaf_digests(row_ns, eds)
+        row_levels = tree_levels_from_digests(mins, maxs, hashes)
+        col_levels = tree_levels_from_digests(
+            mins.transpose(1, 0, 2),
+            maxs.transpose(1, 0, 2),
+            hashes.transpose(1, 0, 2),
+        )
+        return flatten(row_levels), flatten(col_levels)
+
+    return run
+
+
+@lru_cache(maxsize=None)
+def jit_forest(k: int):
+    """Cached jitted forest builder — ONE dispatch per retained height."""
+    from celestia_app_tpu.trace.journal import note_jit_build
+
+    note_jit_build("forest")
+    return jax.jit(forest_fn(k))

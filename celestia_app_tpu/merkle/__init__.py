@@ -57,6 +57,40 @@ def proof(items: list[bytes], index: int) -> list[bytes]:
     return proof(items[k:], index - k) + [hash_from_byte_slices(items[:k])]
 
 
+def levels_from_leaves(items: list[bytes]) -> list[list[bytes]]:
+    """All tree levels (leaf hashes first, [root] last) for a power-of-two
+    leaf count — the memoized twin of `proof`: building this once per
+    4k-root set lets a proof-serving cache answer every audit path by
+    indexing (`path_from_levels`) instead of re-hashing O(n log n) per
+    request.  Power-of-two only: split_point(n) == n/2 exactly then, so
+    level indexing and the recursive split agree."""
+    n = len(items)
+    if n & (n - 1) or n == 0:
+        raise ValueError(f"levels_from_leaves needs a power of two, got {n}")
+    level = [leaf_hash(i) for i in items]
+    levels = [level]
+    while len(level) > 1:
+        level = [
+            inner_hash(level[i], level[i + 1]) for i in range(0, len(level), 2)
+        ]
+        levels.append(level)
+    return levels
+
+
+def path_from_levels(levels: list[list[bytes]], index: int) -> list[bytes]:
+    """Audit path (sibling hashes, leaf-to-root) from precomputed levels —
+    byte-identical to `proof(items, index)` for power-of-two item counts
+    (pinned by tests/test_das_proofs.py)."""
+    n = len(levels[0])
+    if not 0 <= index < n:
+        raise IndexError(index)
+    path = []
+    for level in levels[:-1]:
+        path.append(level[index ^ 1])
+        index //= 2
+    return path
+
+
 def compute_root_from_path(index: int, total: int, leaf_h: bytes, path: list[bytes]) -> bytes:
     """Root implied by a leaf hash and its audit path (leaf-to-root order)."""
     if total <= 0 or not 0 <= index < total:

@@ -57,6 +57,54 @@ def prove_range(tree: NamespacedMerkleTree, start: int, end: int) -> NmtRangePro
     return NmtRangeProof(start, end, tuple(nodes), n)
 
 
+def range_proof_node_coords(
+    total: int, start: int, end: int
+) -> list[tuple[int, int]]:
+    """The (level, index) coordinates of a range proof's nodes, in the
+    exact DFS order `prove_range` emits them — level 0 = leaves.
+
+    Power-of-two totals only: every out-of-range subtree the DFS visits
+    is then a complete ALIGNED block, so its digest is one entry of a
+    precomputed level (a NamespacedMerkleTree's `levels()`, or the
+    device-resident forest serve/cache.py retains) and proof extraction
+    becomes pure indexing — no hashing per request.  This is the shared
+    index plan of the batched sampler AND the host fallback, which is
+    what makes their proof bytes identical by construction.
+    """
+    if total & (total - 1) or total <= 0:
+        raise ValueError(f"range_proof_node_coords needs a power of two, got {total}")
+    if not 0 <= start < end <= total:
+        raise ValueError(f"invalid range [{start},{end}) of {total} leaves")
+    coords: list[tuple[int, int]] = []
+
+    def walk(lo: int, hi: int) -> None:
+        if hi <= start or lo >= end:
+            size = hi - lo
+            coords.append((size.bit_length() - 1, lo // size))
+            return
+        if hi - lo == 1:
+            return
+        sp = (hi - lo) // 2  # power-of-two split == split_point
+        walk(lo, lo + sp)
+        walk(lo + sp, hi)
+
+    walk(0, total)
+    return coords
+
+
+def prove_range_from_levels(
+    levels: list[list[bytes]], start: int, end: int
+) -> NmtRangeProof:
+    """`prove_range` from precomputed digest levels (leaf level first) —
+    byte-identical output for power-of-two trees, zero hashing."""
+    total = len(levels[0])
+    nodes = tuple(
+        levels[lvl][idx]
+        for lvl, idx in range_proof_node_coords(total, start, end)
+    )
+    return NmtRangeProof(start, end, nodes, total)
+
+
 def _verify_digests(
     root: bytes, proof: NmtRangeProof, leaf_digests: list[bytes]
 ) -> bool:

@@ -15,11 +15,12 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+import numpy as np
+
 from celestia_app_tpu import merkle
-from celestia_app_tpu.constants import NAMESPACE_SIZE, PARITY_NAMESPACE_BYTES
+from celestia_app_tpu.constants import NAMESPACE_SIZE
 from celestia_app_tpu.da.eds import ExtendedDataSquare
-from celestia_app_tpu.nmt.proof import NmtRangeProof, prove_range, verify_range
-from celestia_app_tpu.nmt.tree import NamespacedMerkleTree
+from celestia_app_tpu.nmt.proof import NmtRangeProof, verify_range
 
 
 @dataclass(frozen=True)
@@ -70,14 +71,45 @@ class ShareProof:
         return cursor == len(self.data)
 
 
-def _row_tree(eds_row, k: int) -> NamespacedMerkleTree:
-    """Extended-row NMT: own namespace in Q0 columns, parity outside."""
-    tree = NamespacedMerkleTree()
-    for c in range(2 * k):
-        raw = bytes(eds_row[c].tobytes())
-        ns = raw[:NAMESPACE_SIZE] if c < k else PARITY_NAMESPACE_BYTES
-        tree.push(ns + raw)
-    return tree
+def _range_proof(tree, lo: int, hi: int) -> NmtRangeProof:
+    """Range proof off a memoized tree: every tree in the square has a
+    power-of-two leaf count, so the proof is assembled from the tree's
+    precomputed `levels()` by pure indexing (prove_range_from_levels) —
+    a host NamespacedMerkleTree pays its hashes once per tree build, a
+    forest-backed view (serve/cache.py) pays none at all.  Byte-identical
+    to the recursive prove_range walk either way."""
+    from celestia_app_tpu.nmt.proof import prove_range_from_levels
+
+    return prove_range_from_levels(tree.levels(), lo, hi)
+
+
+def _row_proof(eds: ExtendedDataSquare, start_row: int, end_row: int) -> RowProof:
+    """RowProof for leaves [start_row, end_row) of the 4k data-root tree
+    (row roots first, column roots second — a column-tree proof passes
+    indices >= 2k).  With a serve-cache forest attached the audit paths
+    index the memoized root-tree levels instead of re-hashing the 4k-leaf
+    tree per request; byte-identical either way (pinned by the
+    indexing-twin tests)."""
+    forest = getattr(eds, "_forest", None)
+    if forest is not None:
+        all_roots = forest.row_roots + forest.col_roots
+        paths = (
+            tuple(merkle.path_from_levels(forest.root_levels, r))
+            for r in range(start_row, end_row)
+        )
+    else:
+        all_roots = eds.row_roots() + eds.col_roots()
+        paths = (
+            tuple(merkle.proof(all_roots, r))
+            for r in range(start_row, end_row)
+        )
+    return RowProof(
+        row_roots=tuple(all_roots[r] for r in range(start_row, end_row)),
+        proofs=tuple(paths),
+        start_row=start_row,
+        end_row=end_row,
+        total=len(all_roots),
+    )
 
 
 def new_share_inclusion_proof(
@@ -87,7 +119,10 @@ def new_share_inclusion_proof(
 
     All shares in the range must carry one namespace (the square layout
     guarantees this for any single blob or compact run; reference
-    pkg/proof/proof.go:79 enforces the same).
+    pkg/proof/proof.go:79 enforces the same).  Row trees come from
+    `eds.row_tree` — memoized per handle and forest-backed when the serve
+    cache retains this height, so an m-row range pays at most m tree
+    builds (zero with a resident forest), never m x shares of hashing.
     """
     k = eds.k
     if not 0 <= start < end <= k * k:
@@ -109,21 +144,80 @@ def new_share_inclusion_proof(
                     f"share ({r},{c}) namespace differs from range start"
                 )
             shares.append(raw)
-        nmt_proofs.append(prove_range(_row_tree(row, k), lo, hi))
+        nmt_proofs.append(_range_proof(eds.row_tree(r), lo, hi))
 
-    all_roots = eds.row_roots() + eds.col_roots()
-    row_proof = RowProof(
-        row_roots=tuple(all_roots[r] for r in range(start_row, end_row)),
-        proofs=tuple(
-            tuple(merkle.proof(all_roots, r)) for r in range(start_row, end_row)
-        ),
-        start_row=start_row,
-        end_row=end_row,
-        total=len(all_roots),
-    )
     return ShareProof(
         data=tuple(shares),
         share_proofs=tuple(nmt_proofs),
         namespace=namespace,
-        row_proof=row_proof,
+        row_proof=_row_proof(eds, start_row, end_row),
     )
+
+
+def new_share_sample_proof(
+    eds: ExtendedDataSquare, row: int, col: int, axis: str = "row"
+) -> ShareProof:
+    """Proof for ONE coordinate of the EXTENDED square — the DAS sampling
+    unit: light clients draw (row, col) uniformly over all four quadrants,
+    so parity shares must prove exactly like data shares.  The leaf's
+    namespace follows the quadrant rule (own inside Q0, parity outside);
+    `ShareProof.verify` reconstructs the leaf as namespace || share, so
+    the existing verifier covers the whole square unchanged.
+
+    `axis` picks which tree commits the share — "row" proves leaf `col`
+    of row tree `row`; "col" proves leaf `row` of COLUMN tree `col`,
+    whose root sits in the second half of the 4k data-root leaves (index
+    2k + col).  Both verify through the same ShareProof.verify; a light
+    client that already holds one axis's root samples through the other
+    for free."""
+    n = 2 * eds.k
+    if not (0 <= row < n and 0 <= col < n):
+        raise ValueError(f"EDS coordinate ({row},{col}) outside {n}x{n}")
+    if axis not in ("row", "col"):
+        raise ValueError(f"axis must be 'row' or 'col', got {axis!r}")
+    share = bytes(np.asarray(eds._eds[row, col]).tobytes())
+    if axis == "col":
+        nmt = _range_proof(eds.col_tree(col), row, row + 1)
+        root_index = n + col  # column roots are the second 2k leaves
+    else:
+        nmt = _range_proof(eds.row_tree(row), col, col + 1)
+        root_index = row
+    return ShareProof(
+        data=(share,),
+        share_proofs=(nmt,),
+        namespace=eds.leaf_namespace(row, col),
+        row_proof=_row_proof(eds, root_index, root_index + 1),
+    )
+
+
+def ods_namespace_range(
+    eds: ExtendedDataSquare, namespace: bytes
+) -> tuple[int, int] | None:
+    """The contiguous row-major ODS range [start, end) holding `namespace`,
+    or None when the square carries no such share.  The square builder
+    lays shares out in namespace order, so one namespace is always one
+    contiguous run — the invariant GetSharesByNamespace leans on."""
+    if len(namespace) != NAMESPACE_SIZE:
+        raise ValueError(f"namespace must be {NAMESPACE_SIZE} bytes")
+    ns_grid = eds.ods_namespaces()  # (k*k, NAMESPACE_SIZE) row-major
+    matches = np.all(ns_grid == np.frombuffer(namespace, dtype=np.uint8), axis=1)
+    idx = np.flatnonzero(matches)
+    if idx.size == 0:
+        return None
+    start, end = int(idx[0]), int(idx[-1]) + 1
+    if end - start != idx.size:
+        raise ValueError(
+            f"namespace {namespace.hex()} is not contiguous in the square"
+        )
+    return start, end
+
+
+def new_namespace_proof(
+    eds: ExtendedDataSquare, namespace: bytes
+) -> ShareProof | None:
+    """All shares of `namespace` with their multi-row inclusion proof, or
+    None when the namespace is absent from the square."""
+    rng = ods_namespace_range(eds, namespace)
+    if rng is None:
+        return None
+    return new_share_inclusion_proof(eds, rng[0], rng[1])

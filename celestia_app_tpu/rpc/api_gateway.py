@@ -371,7 +371,7 @@ class _ApiHandler(BaseHTTPRequestHandler):
             send_observability_response,
         )
 
-        resp = handle_observability_get(self.path)
+        resp = handle_observability_get(self.path, plane="rest")
         if resp is not None:
             send_observability_response(self, resp)
             return

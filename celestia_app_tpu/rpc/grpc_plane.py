@@ -23,6 +23,10 @@ layer, protoc-cross-validated by tests/test_proto_wire.py):
   celestia.tpu.subscription.v1.Subscription/WaitTx long-poll tx commit
       (this framework's analog of Tendermint's websocket /subscribe —
       the reference serves that from celestia-core RPC, not gRPC)
+  celestia.tpu.das.v1.Das/GetShareProof|GetSharesByNamespace  the DAS
+      sampling surface (serve/): responses carry the canonical
+      serve/api.render payload bytes, byte-identical to the HTTP planes'
+      GET /das/* bodies
 
 List queries speak cosmos.base.query.v1beta1 PageRequest/PageResponse
 (offset/limit/count_total/reverse; next_key is an opaque offset cursor).
@@ -634,6 +638,57 @@ def _handlers(node) -> dict:
         height, code, log = status
         return encode_bytes_field(2, _tx_response(height, txhash, code, log))
 
+    def _node_das_provider():
+        get = getattr(node, "das_provider", None)
+        if get is None:
+            raise _Abort(
+                "UNIMPLEMENTED", "this node serves no DAS surface (serve/)"
+            )
+        return get()
+
+    def _das_payload(build) -> bytes:
+        from celestia_app_tpu.serve.api import UnknownHeight
+
+        try:
+            payload = build()
+        except UnknownHeight as e:
+            raise _Abort("NOT_FOUND", str(e)) from None
+        except (TypeError, ValueError) as e:
+            raise _Abort("INVALID_ARGUMENT", str(e)) from None
+        from celestia_app_tpu.serve.api import render
+
+        return encode_bytes_field(1, render(payload))
+
+    def das_share_proof(req: bytes) -> bytes:
+        # celestia.tpu.das.v1 GetShareProofRequest {height=1, row=2,
+        # col=3, axis=4 ("row" default / "col")} -> {payload=1 bytes}:
+        # the canonical serve/api.render bytes, so the gRPC answer is
+        # byte-identical to the GET /das/share_proof body on the HTTP
+        # planes.
+        from celestia_app_tpu.serve.api import count_served
+
+        provider = _node_das_provider()
+        height, row, col = (
+            _field_int(req, 1), _field_int(req, 2), _field_int(req, 3)
+        )
+        axis = _field_str(req, 4) or "row"
+        out = _das_payload(
+            lambda: provider.share_proof_payload(height, row, col, axis=axis)
+        )
+        count_served("grpc", "share_proof")
+        return out
+
+    def das_shares_by_namespace(req: bytes) -> bytes:
+        # GetSharesByNamespaceRequest {height=1, namespace=2 (29-byte
+        # hex string)} -> {payload=1 bytes}.
+        from celestia_app_tpu.serve.api import count_served
+
+        provider = _node_das_provider()
+        height, ns_hex = _field_int(req, 1), _field_str(req, 2)
+        out = _das_payload(lambda: provider.shares_payload(height, ns_hex))
+        count_served("grpc", "shares")
+        return out
+
     return {
         "cosmos.tx.v1beta1.Service": {
             "BroadcastTx": broadcast_tx,
@@ -671,6 +726,10 @@ def _handlers(node) -> dict:
             "GetNodeInfo": get_node_info,
         },
         "celestia.tpu.subscription.v1.Subscription": {"WaitTx": wait_tx},
+        "celestia.tpu.das.v1.Das": {
+            "GetShareProof": das_share_proof,
+            "GetSharesByNamespace": das_shares_by_namespace,
+        },
     }
 
 
@@ -714,7 +773,7 @@ def _serve_debug_port(host: str, port: int):
             pass
 
         def do_GET(self):  # noqa: N802 — http.server API
-            resp = handle_observability_get(self.path)
+            resp = handle_observability_get(self.path, plane="grpc")
             if resp is None:
                 self.send_response(404)
                 self.end_headers()
@@ -814,6 +873,9 @@ class GrpcNode:
                 "signing_infos": "/cosmos.slashing.v1beta1.Query/SigningInfos",
                 "slashing_params": "/cosmos.slashing.v1beta1.Query/Params",
                 "wait_tx": "/celestia.tpu.subscription.v1.Subscription/WaitTx",
+                "das_share_proof": "/celestia.tpu.das.v1.Das/GetShareProof",
+                "das_shares":
+                    "/celestia.tpu.das.v1.Das/GetSharesByNamespace",
             }.items()
         }
 
@@ -1104,6 +1166,39 @@ class GrpcNode:
             "tombstoned": bool(_field_int(raw, 5)),
             "missed_blocks": _field_int(raw, 6),
         }
+
+    def share_proof_bytes(self, height: int, row: int, col: int,
+                          axis: str = "row") -> bytes:
+        """Raw canonical payload bytes of GetShareProof — byte-identical
+        to the HTTP planes' GET /das/share_proof body (the cross-plane
+        identity tests compare exactly this)."""
+        req = (
+            encode_varint_field(1, height)
+            + encode_varint_field(2, row)
+            + encode_varint_field(3, col)
+        )
+        if axis != "row":
+            req += encode_bytes_field(4, axis.encode())
+        return _field_bytes(self._call["das_share_proof"](req), 1)
+
+    def share_proof(self, height: int, row: int, col: int,
+                    axis: str = "row") -> dict:
+        """GetShareProof payload as a dict; `proof` reconstructs via
+        rpc/codec.share_proof_from_json for client-side verify()."""
+        import json
+
+        return json.loads(self.share_proof_bytes(height, row, col, axis))
+
+    def shares_by_namespace_bytes(self, height: int, namespace_hex: str) -> bytes:
+        req = encode_varint_field(1, height) + encode_bytes_field(
+            2, namespace_hex.encode()
+        )
+        return _field_bytes(self._call["das_shares"](req), 1)
+
+    def shares_by_namespace(self, height: int, namespace_hex: str) -> dict:
+        import json
+
+        return json.loads(self.shares_by_namespace_bytes(height, namespace_hex))
 
     def slashing_params(self) -> dict:
         p = _field_bytes(self._call["slashing_params"](b""), 1)

@@ -88,6 +88,13 @@ class ServingNode(TestNode):
         # hard cap in force then, not the current gov param.
         self._version_by_height: dict[int, int] = {}
         self.lock = threading.RLock()
+        # The proof-serving plane's retention (serve/): every committed
+        # non-empty height's EDS + NMT forests, LRU over
+        # $CELESTIA_SERVE_HEIGHTS with host spill — the read side light
+        # clients sample against.  Built lazily with its DasProvider so a
+        # node that never serves proofs pays nothing.
+        self._serve_cache = None
+        self._das_provider = None
         # Serializes whole produce+replicate rounds so replicated heights
         # reach peers in order even with concurrent produce callers.
         self._produce_lock = threading.Lock()
@@ -275,6 +282,7 @@ class ServingNode(TestNode):
         )
         self._version_by_height[height] = proposal_version
         self._prevoted.pop(height, None)  # round done
+        self._retain_for_serving(height, data)
         for ev in evidence:
             self._used_evidence.add(ev.key())
         # Bound the evidence pool (Tendermint prunes expired evidence).
@@ -283,6 +291,126 @@ class ServingNode(TestNode):
         if self.snapshot_interval and height % self.snapshot_interval == 0:
             self._take_snapshot(height)
         return results
+
+    # --- the proof-serving plane (serve/) ------------------------------------
+    @property
+    def serve_cache(self):
+        if self._serve_cache is None:
+            from celestia_app_tpu.serve.cache import ForestCache
+
+            self._serve_cache = ForestCache()
+        return self._serve_cache
+
+    def das_provider(self):
+        """This node's DasProvider (serve/api.py): the cache-backed
+        payload builder every plane serves; misses rebuild from the block
+        store so an evicted height is slower, never unservable."""
+        if self._das_provider is None:
+            from celestia_app_tpu.serve.api import DasProvider
+
+            self._das_provider = DasProvider(
+                cache=self.serve_cache, rebuild=self._rebuild_eds
+            )
+        return self._das_provider
+
+    def _retain_for_serving(self, height: int, data: BlockData) -> None:
+        """Admit the committed height's EDS + forests to the serve cache.
+
+        The normal path is free of square work: the app extended exactly
+        this square during Prepare/Process and still holds the handle
+        (App.last_eds_for_root, matched on the committed data hash), so
+        retention costs one async forest dispatch — no second layout
+        solve, no duplicate square-journal row, no re-extension.  A
+        memo miss (e.g. the handle was displaced) falls back to a full
+        rebuild.  Never raises into the commit path: the serve plane
+        degrading must not stall consensus.
+        """
+        from celestia_app_tpu.serve import serve_heights
+
+        if serve_heights() <= 0 or not data.txs:
+            return  # disabled, or an empty block (the min square)
+        try:
+            eds = self.app.last_eds_for_root(data.hash)
+            if eds is None:
+                eds = self._eds_for_block(data)
+            if eds is not None:
+                self.serve_cache.put(height, eds)
+        except Exception as e:  # noqa: BLE001 — read plane must not stall commit
+            import sys
+
+            print(f"serve retention failed at height {height}: {e}",
+                  file=sys.stderr)
+
+    def _eds_for_block(self, data: BlockData):
+        """Reconstruct the block's EDS, ROOT-VERIFIED against the
+        committed data hash; None for empty blocks or an unreproducible
+        square.
+
+        The square is re-solved under the CURRENT effective cap first
+        (the common case) and, when that fails to reproduce, under the
+        committed square size as the ceiling — a governance cap change
+        after this height would otherwise re-solve a DIFFERENT layout
+        whose proofs can never verify against the committed header (the
+        block store's own square_size_upper_bound caveat).  The DAH-hash
+        check is the gate either way: this node never serves proofs
+        against a root it did not commit."""
+        import sys
+
+        from celestia_app_tpu.da.dah import DataAvailabilityHeader
+        from celestia_app_tpu.square import builder as square
+
+        if not data.txs:
+            return None
+        caps = [self.app.max_effective_square_size()]
+        if data.square_size not in caps:
+            caps.append(data.square_size)
+        for cap in caps:
+            sq = square.construct(list(data.txs), cap)
+            if sq.is_empty() or sq.size != data.square_size:
+                continue
+            eds = self.app.square_eds(sq.size, sq.share_bytes())
+            if DataAvailabilityHeader.from_eds(eds).hash() == data.hash:
+                return eds
+        print(
+            f"serve rebuild cannot reproduce the committed square "
+            f"(size {data.square_size}, root {data.hash.hex()[:16]}); "
+            "refusing to serve unverifiable proofs",
+            file=sys.stderr,
+        )
+        return None
+
+    def _rebuild_eds(self, height: int):
+        """DasProvider miss path: rebuild from the block store's raw txs
+        (the querier pattern) so proofs outlive every cache tier."""
+        with self.lock:
+            entry = self._blocks_by_height.get(height)
+        if entry is None:
+            return None
+        return self._eds_for_block(entry[0])
+
+    def rpc_get_share_proof(
+        self, height: int, row: int, col: int, axis: str = "row"
+    ) -> dict:
+        """GetShareProof — one DAS sample of the EXTENDED square (parity
+        quadrants included), proven to the height's committed DAH data
+        root through the row tree or (axis="col") the column tree.  Same
+        payload dict the GET /das/share_proof route renders."""
+        from celestia_app_tpu.serve.api import count_served
+
+        payload = self.das_provider().share_proof_payload(
+            int(height), int(row), int(col), axis=axis
+        )
+        count_served("jsonrpc", "share_proof")
+        return payload
+
+    def rpc_get_shares_by_namespace(self, height: int, namespace: str) -> dict:
+        """GetSharesByNamespace — every share of a namespace with its
+        multi-row inclusion proof (namespace as 29-byte hex)."""
+        from celestia_app_tpu.serve.api import count_served
+
+        payload = self.das_provider().shares_payload(int(height), namespace)
+        count_served("jsonrpc", "shares")
+        return payload
 
     # --- state-sync snapshots -------------------------------------------------
     SNAPSHOT_CHUNK_BYTES = 512 * 1024
@@ -613,6 +741,14 @@ class ServingNode(TestNode):
             },
             "peers": len(self.peer_urls),
             "last_square": square_journal.last_square(),
+            # The serve plane's cache: resident heights per tier, hit
+            # ratio, last eviction — a proof plane stuck at cold (all
+            # misses, nothing resident while heights commit) is one
+            # probe away, byte-identical on every plane like the rest
+            # of /healthz.  Always ForestCache.stats() — one source of
+            # the block's shape; a never-touched cache is trivially
+            # cheap to instantiate and reports its true empty state.
+            "serve": self.serve_cache.stats(),
         }
         if not self.lock.acquire(timeout=0.25):
             out["lock_contended"] = True
@@ -1186,7 +1322,7 @@ class _Handler(BaseHTTPRequestHandler):
             send_observability_response,
         )
 
-        resp = handle_observability_get(self.path)
+        resp = handle_observability_get(self.path, plane="jsonrpc")
         if resp is None:
             self.send_response(404)
             self.end_headers()
@@ -1246,6 +1382,14 @@ class NodeServer:
             from celestia_app_tpu.trace.exposition import register_health_provider
 
             register_health_provider(self._health_name, self._health_provider)
+        # Mount the node's DAS surface behind GET /das/* on every plane
+        # (the shared handler; last-registered node answers).
+        self._das_provider = None
+        if hasattr(node, "das_provider"):
+            from celestia_app_tpu.trace.exposition import register_das_provider
+
+            self._das_provider = node.das_provider()
+            register_das_provider(self._das_provider)
 
     def start(self, block_interval_s: float | None = None):
         t = threading.Thread(target=self.httpd.serve_forever, daemon=True)
@@ -1275,6 +1419,10 @@ class NodeServer:
             from celestia_app_tpu.trace.exposition import unregister_health_provider
 
             unregister_health_provider(self._health_name, self._health_provider)
+        if self._das_provider is not None:
+            from celestia_app_tpu.trace.exposition import unregister_das_provider
+
+            unregister_das_provider(self._das_provider)
         driver = getattr(self.node, "consensus_driver", None)
         if driver is not None:
             driver.stop()

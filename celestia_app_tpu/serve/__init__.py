@@ -1,0 +1,53 @@
+"""serve/: the batched proof-serving plane — the READ side of DA.
+
+Everything before this package wrote: build squares, commit roots, page
+when a p99 burns.  This package is what light clients actually consume —
+NMT inclusion proofs for sampled shares (the DAS workload, "millions of
+users" in ROADMAP terms; ACeD's scalable DA-oracle read path):
+
+  cache.py    ForestCache: device-resident EDS + row/col NMT forests for
+              the last $CELESTIA_SERVE_HEIGHTS heights (LRU), host spill
+              below that — proofs never become unservable, only slower.
+  sampler.py  ProofSampler: queued sample requests answered a whole batch
+              per dispatch (share gather + vectorized Merkle-path
+              extraction from the cached forest), with a pure-host
+              fallback pinned bit-identical (the fused->staged seam of
+              the read side; chaos seam `proof.serve`).
+  api.py      DasProvider: the one payload builder all three RPC planes
+              serve, so GetShareProof / GetSharesByNamespace responses
+              are byte-identical across JSON-RPC, REST, and gRPC by
+              construction (the /metrics exposition pattern).
+
+Wire-up: ServingNode retains each committed height's EDS into its cache
+(rpc/server.py) and registers a DasProvider on the shared exposition
+handler, which mounts `GET /das/share_proof` and `GET /das/shares` on
+every serving plane; the gRPC plane additionally speaks a real
+celestia.tpu.das.v1.Das service carrying the same payload bytes.
+"""
+
+from __future__ import annotations
+
+import os
+
+from celestia_app_tpu.serve.cache import ForestCache  # noqa: F401
+from celestia_app_tpu.serve.sampler import ProofSampler, serve_mode  # noqa: F401
+
+
+def serve_heights() -> int:
+    """$CELESTIA_SERVE_HEIGHTS: device-resident cached heights (LRU size);
+    0 disables retention entirely (proofs rebuild from block txs)."""
+    try:
+        return int(os.environ.get("CELESTIA_SERVE_HEIGHTS", "4") or "4")
+    except ValueError:
+        return 4
+
+
+def spill_heights() -> int:
+    """$CELESTIA_SERVE_SPILL: host-spill tier size (heights evicted from
+    the device tier land here as numpy copies before dropping entirely);
+    default 2x the device tier."""
+    try:
+        raw = os.environ.get("CELESTIA_SERVE_SPILL", "")
+        return int(raw) if raw else 2 * serve_heights()
+    except ValueError:
+        return 2 * serve_heights()

@@ -1,0 +1,144 @@
+"""DasProvider: the ONE payload builder every serving plane answers with.
+
+The repo's cross-plane identity pattern (trace/exposition.py): byte-equal
+responses are structural when all planes call one renderer, never a test
+invariant to chase.  The JSON-RPC server, the REST gateway, and the gRPC
+plane's debug sidecar all route `GET /das/share_proof` and
+`GET /das/shares` through the shared observability handler, which calls
+the registered DasProvider here; the real gRPC Das service
+(rpc/grpc_plane.py) and the JSON-RPC POST methods (rpc/server.py) carry
+the same `render()` bytes / payload dicts.
+
+Payloads are a pure function of chain state (height, coordinates, the
+committed proofs) — cache tier, timing, and plane never leak in, so two
+scrapes of the same request on different planes are identical bytes.
+Every served proof verifies against the height's committed DAH data root
+via the existing ShareProof.verify (clients reconstruct the dataclasses
+with rpc/codec.share_proof_from_json).
+"""
+
+from __future__ import annotations
+
+import json
+
+from celestia_app_tpu.constants import NAMESPACE_SIZE
+
+
+def render(payload: dict) -> bytes:
+    """Canonical response bytes (sorted keys, compact separators) — the
+    byte-identity unit shared by the GET routes and the gRPC service."""
+    return json.dumps(payload, sort_keys=True, separators=(",", ":")).encode()
+
+
+def count_served(plane: str, kind: str) -> None:
+    from celestia_app_tpu.trace.metrics import registry
+
+    registry().counter(
+        "celestia_proofs_served_total",
+        "DAS proofs served, by serving plane and query kind",
+    ).inc(plane=plane, kind=kind)
+
+
+class UnknownHeight(KeyError):
+    """No cached, spilled, or rebuildable square at this height (a 404)."""
+
+
+class DasProvider:
+    """Binds a ForestCache + ProofSampler + an optional rebuild source.
+
+    `rebuild(height)` returns an ExtendedDataSquare for a height the
+    cache no longer holds (a ServingNode reconstructs it from the block
+    store's raw txs — the querier path), or None when the height is
+    genuinely unknown; the rebuilt square is re-admitted so the next
+    sample is a hit.
+    """
+
+    def __init__(self, cache=None, sampler=None, rebuild=None):
+        import threading
+
+        from celestia_app_tpu.serve.cache import ForestCache
+        from celestia_app_tpu.serve.sampler import ProofSampler
+
+        self.cache = cache if cache is not None else ForestCache()
+        self.sampler = sampler if sampler is not None else ProofSampler()
+        self.rebuild = rebuild
+        # Serializes the miss path: N concurrent requests for one evicted
+        # height must cost ONE square rebuild + forest build, not N.
+        self._rebuild_lock = threading.Lock()
+
+    def entry(self, height: int):
+        entry, tier = self.cache.get(height)
+        if entry is not None:
+            return entry
+        with self._rebuild_lock:
+            entry, tier = self.cache.get(height)  # a peer may have rebuilt
+            if entry is not None:
+                return entry
+            eds = self.rebuild(height) if self.rebuild is not None else None
+            if eds is None:
+                raise UnknownHeight(f"no square known at height {height}")
+            entry = self.cache.put(height, eds)
+        if entry is None:  # retention disabled: serve without admitting
+            from celestia_app_tpu.serve.cache import CachedForest
+
+            import jax.numpy as jnp
+
+            from celestia_app_tpu.kernels.fused import jit_forest
+
+            row_flat, col_flat = jit_forest(eds.k)(jnp.asarray(eds._eds))
+            entry = CachedForest(height, eds, row_flat, col_flat)
+        return entry
+
+    # --- payload builders ---------------------------------------------------
+    def share_proof_payload(
+        self, height: int, row: int, col: int, axis: str = "row"
+    ) -> dict:
+        from celestia_app_tpu.rpc.codec import to_jsonable
+
+        entry = self.entry(height)
+        proof = self.sampler.share_proof(entry, row, col, axis=axis)
+        return {
+            "height": height,
+            "row": row,
+            "col": col,
+            "axis": axis,
+            "square_size": entry.k,
+            "proof": to_jsonable(proof),
+            "data_root": entry.data_root.hex(),
+        }
+
+    def shares_payload(self, height: int, namespace_hex: str) -> dict:
+        from celestia_app_tpu.proof.share_proof import ods_namespace_range
+        from celestia_app_tpu.rpc.codec import to_jsonable
+
+        try:
+            namespace = bytes.fromhex(namespace_hex)
+        except ValueError as e:
+            raise ValueError(f"namespace must be hex: {e}") from e
+        if len(namespace) != NAMESPACE_SIZE:
+            raise ValueError(
+                f"namespace must be {NAMESPACE_SIZE} bytes, "
+                f"got {len(namespace)}"
+            )
+        entry = self.entry(height)
+        rng = ods_namespace_range(entry.eds, namespace)
+        payload: dict = {
+            "height": height,
+            "namespace": namespace_hex.lower(),
+            "square_size": entry.k,
+            "data_root": entry.data_root.hex(),
+        }
+        if rng is None:
+            payload.update({"found": False, "shares": 0, "proof": None})
+            return payload
+        from celestia_app_tpu.proof.share_proof import new_share_inclusion_proof
+
+        proof = new_share_inclusion_proof(entry.eds, rng[0], rng[1])
+        payload.update({
+            "found": True,
+            "start": rng[0],
+            "end": rng[1],
+            "shares": rng[1] - rng[0],
+            "proof": to_jsonable(proof),
+        })
+        return payload

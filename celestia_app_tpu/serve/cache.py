@@ -1,0 +1,321 @@
+"""ForestCache: device-resident EDS + NMT forests over the last N heights.
+
+`kernels/fused.py` materializes every NMT level on device and throws all
+but the 4k roots away; the serve plane's unlock is keeping them.  At
+cache admission one extra dispatch (`kernels.fused.jit_forest`) rebuilds
+both axis forests from the retained EDS buffer into two flat (N, 90)
+device arrays — every inner node of every row/column tree, indexable by
+(tree, level, index) via `forest_level_layout` — after which a whole
+batch of DAS sample proofs is two gathers (serve/sampler.py), zero
+hashes.
+
+Tiers (all bounded, so the serve plane's memory is a knob, not a leak):
+
+  device  the last $CELESTIA_SERVE_HEIGHTS heights, LRU — jnp arrays,
+          answering batches at gather speed;
+  host    the next $CELESTIA_SERVE_SPILL evicted heights as numpy copies
+          (same bytes; numpy gathers) — slower, never unservable;
+  gone    beyond spill the entry drops; the DasProvider rebuilds the
+          square from the block store's raw txs on demand (the
+          pre-existing querier path) and re-admits it.
+
+A cache hit/miss and the tier it landed on tick
+celestia_serve_cache_{hits,misses}_total; evictions tick
+celestia_serve_cache_evictions_total{tier}; /healthz's ServingNode layer
+reports resident heights + hit ratio so a stuck-at-cold cache is one
+probe away.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+
+class _ForestLineTree:
+    """The `levels()` surface of one row/column tree, backed by flat
+    forest arrays — what eds.row_tree returns when a forest is resident,
+    so nmt.proof.prove_range_from_levels assembles proofs by indexing."""
+
+    def __init__(self, forest: "CachedForest", axis: str, index: int):
+        self._forest = forest
+        self._axis = axis
+        self._index = index
+        self._levels: list[list[bytes]] | None = None
+
+    def levels(self) -> list[list[bytes]]:
+        if self._levels is None:
+            self._levels = self._forest.line_levels(self._axis, self._index)
+        return self._levels
+
+    def root(self) -> bytes:
+        return self.levels()[-1][0]
+
+
+class CachedForest:
+    """One height's retained proof state.
+
+    Holds the EDS (2k, 2k, S) buffer, both flat forests, the host root
+    list + memoized data-root tree levels (merkle.levels_from_leaves, so
+    RowProof audit paths are indexing too), and the ODS namespace grid
+    for namespace-range queries.  `spill()` converts the device arrays to
+    numpy in place — the bytes every accessor returns are identical
+    either way (the tier only moves where the gather runs).
+    """
+
+    def __init__(self, height: int, eds, row_flat, col_flat):
+        from celestia_app_tpu import merkle
+        from celestia_app_tpu.kernels.fused import forest_level_layout
+
+        self.height = height
+        self.k = eds.k
+        self.eds = eds
+        self.device_resident = True
+        self.row_flat = row_flat  # (N, 90) — all row-tree levels, flat
+        self.col_flat = col_flat
+        self.widths, self.offsets = forest_level_layout(self.k)
+        self.row_roots = eds.row_roots()
+        self.col_roots = eds.col_roots()
+        self.data_root = eds.data_root()
+        self.root_levels = merkle.levels_from_leaves(
+            self.row_roots + self.col_roots
+        )
+        eds.attach_forest(self)
+
+    # --- indexing ----------------------------------------------------------
+    def flat_index(self, tree: int, level: int, index: int) -> int:
+        """Flat row of node (tree, level, index) — forest_level_layout's
+        contract, shared with the sampler's batch index plan."""
+        return self.offsets[level] + tree * self.widths[level] + index
+
+    def _flat(self, axis: str):
+        return self.row_flat if axis == "row" else self.col_flat
+
+    def gather(self, axis: str, flat_indices) -> np.ndarray:
+        """(len(flat_indices), 90) node bytes in one take — jnp on the
+        device tier, numpy after spill; same bytes either way."""
+        flat = self._flat(axis)
+        if isinstance(flat, np.ndarray):
+            return flat[np.asarray(flat_indices, dtype=np.int64)]
+        import jax.numpy as jnp
+
+        return np.asarray(
+            jnp.take(flat, jnp.asarray(flat_indices, dtype=jnp.int32), axis=0)
+        )
+
+    def gather_shares(self, coords) -> np.ndarray:
+        """(B, SHARE_SIZE) shares for [(row, col), ...] in one take."""
+        n = 2 * self.k
+        idx = [r * n + c for r, c in coords]
+        buf = self.eds._eds
+        if isinstance(buf, np.ndarray):
+            flat = buf.reshape(n * n, buf.shape[-1])
+            return flat[np.asarray(idx, dtype=np.int64)]
+        import jax.numpy as jnp
+
+        flat = buf.reshape(n * n, buf.shape[-1])
+        return np.asarray(
+            jnp.take(flat, jnp.asarray(idx, dtype=jnp.int32), axis=0)
+        )
+
+    def line_levels(self, axis: str, index: int) -> list[list[bytes]]:
+        """All digest levels of one tree, as host bytes (one gather)."""
+        idx = [
+            self.flat_index(index, lvl, i)
+            for lvl, w in enumerate(self.widths)
+            for i in range(w)
+        ]
+        nodes = self.gather(axis, idx)
+        levels: list[list[bytes]] = []
+        pos = 0
+        for w in self.widths:
+            levels.append(
+                [bytes(nodes[pos + i].tobytes()) for i in range(w)]
+            )
+            pos += w
+        return levels
+
+    def line_tree(self, axis: str, index: int) -> _ForestLineTree:
+        return _ForestLineTree(self, axis, index)
+
+    # --- tier movement -----------------------------------------------------
+    def spill(self) -> None:
+        """Device -> host: numpy copies of the EDS and both forests (the
+        proofs keep serving, the gathers just run on host memory)."""
+        if not self.device_resident:
+            return
+        self.row_flat = np.asarray(self.row_flat)
+        self.col_flat = np.asarray(self.col_flat)
+        self.eds._eds = np.asarray(self.eds._eds)
+        self.device_resident = False
+
+
+class ForestCache:
+    """LRU over heights, two tiers (device + host spill), thread-safe."""
+
+    def __init__(self, heights: int | None = None, spill: int | None = None):
+        self._heights = heights
+        self._spill = spill
+        self._lock = threading.Lock()
+        self._device: OrderedDict[int, CachedForest] = OrderedDict()
+        self._host: OrderedDict[int, CachedForest] = OrderedDict()
+        self._hits = {"device": 0, "host": 0}
+        self._misses = 0
+        self._last_eviction: int | None = None
+        # Single-flight per height: concurrent misses on one height must
+        # not each pay a forest dispatch (and transiently hold N copies
+        # of the EDS+forests) only for the last put to win.
+        self._building: dict = {}
+
+    def _capacity(self) -> tuple[int, int]:
+        from celestia_app_tpu.serve import serve_heights, spill_heights
+
+        return (
+            self._heights if self._heights is not None else serve_heights(),
+            self._spill if self._spill is not None else spill_heights(),
+        )
+
+    # --- admission ---------------------------------------------------------
+    def put(self, height: int, eds) -> CachedForest | None:
+        """Retain one height: build the forest (ONE extra dispatch) and
+        admit it to the device tier, evicting oldest-first down the
+        tiers.  Returns the entry, or None when retention is disabled
+        ($CELESTIA_SERVE_HEIGHTS=0)."""
+        cap, spill_cap = self._capacity()
+        if cap <= 0:
+            return None
+        with self._lock:
+            existing = self._device.get(height)
+            if existing is not None:
+                self._device.move_to_end(height)
+                return existing
+            gate = self._building.get(height)
+            if gate is None:
+                gate = self._building[height] = threading.Lock()
+        with gate:
+            with self._lock:
+                existing = self._device.get(height)
+                if existing is not None:  # a concurrent put already built it
+                    self._device.move_to_end(height)
+                    self._building.pop(height, None)
+                    return existing
+            import jax.numpy as jnp
+
+            from celestia_app_tpu.kernels.fused import jit_forest
+
+            row_flat, col_flat = jit_forest(eds.k)(jnp.asarray(eds._eds))
+            entry = CachedForest(height, eds, row_flat, col_flat)
+            # Admission happens INSIDE the gate: a concurrent put that
+            # passes the gate next must find the entry resident, or the
+            # single-flight promise ("one forest dispatch per height")
+            # would leak through the build->admit window.
+            evicted: list[CachedForest] = []
+            with self._lock:
+                self._host.pop(height, None)  # re-admission promotes
+                self._device[height] = entry
+                self._device.move_to_end(height)
+                while len(self._device) > cap:
+                    h, old = self._device.popitem(last=False)
+                    evicted.append(old)
+                    self._last_eviction = h
+                for old in evicted:
+                    old.spill()
+                    self._host[old.height] = old
+                    self._host.move_to_end(old.height)
+                dropped = 0
+                while len(self._host) > spill_cap:
+                    self._host.popitem(last=False)
+                    dropped += 1
+        self._building.pop(height, None)
+        self._count_evictions(len(evicted), dropped)
+        self._publish_residency()
+        return entry
+
+    def _count_evictions(self, spilled: int, dropped: int) -> None:
+        if not (spilled or dropped):
+            return
+        from celestia_app_tpu.trace.metrics import registry
+
+        ev = registry().counter(
+            "celestia_serve_cache_evictions_total",
+            "serve-cache evictions by destination tier "
+            "(device->host spill; host->dropped)",
+        )
+        if spilled:
+            ev.inc(spilled, tier="host")
+        if dropped:
+            ev.inc(dropped, tier="dropped")
+
+    def _publish_residency(self) -> None:
+        from celestia_app_tpu.trace.metrics import registry
+
+        gauge = registry().gauge(
+            "celestia_serve_cache_resident",
+            "heights resident in the serve cache, by tier",
+        )
+        with self._lock:
+            gauge.set(len(self._device), tier="device")
+            gauge.set(len(self._host), tier="host")
+
+    # --- lookup ------------------------------------------------------------
+    def get(self, height: int) -> tuple[CachedForest | None, str]:
+        """(entry, tier) where tier is "device" / "host" / "miss"."""
+        from celestia_app_tpu.trace.metrics import registry
+
+        with self._lock:
+            entry = self._device.get(height)
+            if entry is not None:
+                self._device.move_to_end(height)
+                self._hits["device"] += 1
+                tier = "device"
+            else:
+                entry = self._host.get(height)
+                if entry is not None:
+                    self._host.move_to_end(height)
+                    self._hits["host"] += 1
+                    tier = "host"
+                else:
+                    self._misses += 1
+                    tier = "miss"
+        if entry is not None:
+            registry().counter(
+                "celestia_serve_cache_hits_total",
+                "serve-cache lookups answered, by tier",
+            ).inc(tier=tier)
+        else:
+            registry().counter(
+                "celestia_serve_cache_misses_total",
+                "serve-cache lookups that fell through to a rebuild",
+            ).inc()
+        return entry, tier
+
+    # --- introspection ------------------------------------------------------
+    def stats(self) -> dict:
+        """The /healthz "serve" block: residency, hit ratio, last
+        eviction — a stuck-at-cold cache (all misses, nothing resident)
+        is one probe away."""
+        with self._lock:
+            hits = dict(self._hits)
+            misses = self._misses
+            total = hits["device"] + hits["host"] + misses
+            return {
+                "device_heights": sorted(self._device),
+                "host_heights": sorted(self._host),
+                "hits": hits,
+                "misses": misses,
+                "hit_ratio": (
+                    round((hits["device"] + hits["host"]) / total, 4)
+                    if total else None
+                ),
+                "last_eviction": self._last_eviction,
+            }
+
+    def reset_for_tests(self) -> None:
+        with self._lock:
+            self._device.clear()
+            self._host.clear()
+            self._hits = {"device": 0, "host": 0}
+            self._misses = 0
+            self._last_eviction = None

@@ -1,0 +1,297 @@
+"""ProofSampler: queued DAS sample requests, answered a whole batch per
+dispatch.
+
+The read-side twin of the fused->staged seam: two lowerings of "prove
+share (row, col) against the committed DAH root", pinned byte-identical:
+
+  batched (default)  the index plan for every queued request is computed
+                     host-side (range_proof_node_coords — pure int math),
+                     then the whole batch's proof nodes and shares come
+                     off the cached forest in ONE gather per array
+                     (serve/cache.CachedForest.gather), and RowProof
+                     audit paths are indexed out of the memoized
+                     data-root tree levels.  Zero hashing per request.
+  host (fallback)    rebuild the touched row's NMT from the retained
+                     shares (eds.row_tree(host=True)) and re-derive the
+                     audit path recursively (merkle.proof) — no forest,
+                     no gather, no batch machinery.  Slower, independent,
+                     bit-identical.
+
+$CELESTIA_SERVE_MODE pins the lowering ("batched" / "host"); the chaos
+seam `proof.serve` ($CELESTIA_CHAOS proof_fail / proof_slow_ms) injects
+failures into the batched dispatch, which the sampler absorbs by
+answering the SAME batch on the host path — ticking
+celestia_recoveries_total{seam="proof.serve"} — so an injected fault
+costs latency, never a wrong or missing proof.
+
+Queueing: concurrent `share_proof` callers park on a shared queue; the
+first arrival becomes the batch leader, waits $CELESTIA_SERVE_BATCH_MS
+(default 0: drain whatever queued), and answers everyone in one
+dispatch.  Latency lands on celestia_proof_latency_seconds{phase}:
+queue_wait and total per sample, gather and assemble per batch.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from functools import lru_cache
+
+from celestia_app_tpu.proof.share_proof import RowProof, ShareProof
+from celestia_app_tpu.constants import NAMESPACE_SIZE, PARITY_NAMESPACE_BYTES
+from celestia_app_tpu.nmt.proof import (
+    NmtRangeProof,
+    prove_range_from_levels,
+    range_proof_node_coords,
+)
+
+
+def serve_mode() -> str:
+    """$CELESTIA_SERVE_MODE: "batched" (default) or "host"."""
+    return (
+        "host"
+        if os.environ.get("CELESTIA_SERVE_MODE", "") == "host"
+        else "batched"
+    )
+
+
+def batch_window_s() -> float:
+    """$CELESTIA_SERVE_BATCH_MS: how long the batch leader waits for more
+    requests to coalesce before dispatching (0 = drain what queued)."""
+    try:
+        return max(
+            float(os.environ.get("CELESTIA_SERVE_BATCH_MS", "0") or 0), 0.0
+        ) / 1e3
+    except ValueError:
+        return 0.0
+
+
+@lru_cache(maxsize=4096)
+def _sample_coords(total: int, col: int) -> tuple[tuple[int, int], ...]:
+    """(level, index) plan for a single-leaf range [col, col+1) — shared
+    by every request sampling that column of a same-k square."""
+    return tuple(range_proof_node_coords(total, col, col + 1))
+
+
+def _latency():
+    from celestia_app_tpu.trace.metrics import DEVICE_SECONDS_BUCKETS, registry
+
+    return registry().histogram(
+        "celestia_proof_latency_seconds",
+        "DAS proof serving latency by phase (queue_wait/gather/assemble "
+        "per the sampler; total is per served sample)",
+        buckets=DEVICE_SECONDS_BUCKETS,
+    )
+
+
+class _Pending:
+    __slots__ = ("entry", "row", "col", "axis", "event", "proof", "error",
+                 "t_submit")
+
+    def __init__(self, entry, row: int, col: int, axis: str):
+        self.entry = entry
+        self.row = row
+        self.col = col
+        self.axis = axis
+        self.event = threading.Event()
+        self.proof: ShareProof | None = None
+        self.error: Exception | None = None
+        self.t_submit = time.perf_counter()
+
+
+class ProofSampler:
+    """Batching sampler over ForestCache entries (serve/cache.py)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue: list[_Pending] = []
+        self._leader_active = False
+
+    # --- the queued entry point --------------------------------------------
+    def share_proof(self, entry, row: int, col: int, axis: str = "row",
+                    timeout_s: float = 30.0) -> ShareProof:
+        """One sample through the batch queue: enqueue, and either lead
+        the next batch dispatch or park until a leader answers."""
+        p = _Pending(entry, row, col, axis)
+        with self._lock:
+            self._queue.append(p)
+            lead = not self._leader_active
+            if lead:
+                self._leader_active = True
+        if lead:
+            window = batch_window_s()
+            if window:
+                time.sleep(window)
+            with self._lock:
+                batch, self._queue = self._queue, []
+                self._leader_active = False
+            self._serve_batch(batch)
+        elif not p.event.wait(timeout_s):
+            raise TimeoutError(
+                f"proof sample ({row},{col}) not served within {timeout_s}s"
+            )
+        if p.error is not None:
+            raise p.error
+        assert p.proof is not None
+        return p.proof
+
+    def _serve_batch(self, batch: list[_Pending]) -> None:
+        lat = _latency()
+        t0 = time.perf_counter()
+        for p in batch:
+            lat.observe(t0 - p.t_submit, phase="queue_wait")
+        by_entry: dict[tuple, list[_Pending]] = {}
+        for p in batch:
+            by_entry.setdefault((id(p.entry), p.axis), []).append(p)
+        from celestia_app_tpu.trace.tracer import traced
+
+        traced().write(
+            "proof_serve", batch=len(batch), heights=len(by_entry),
+            mode=serve_mode(),
+        )
+        for group in by_entry.values():
+            entry = group[0].entry
+            coords = [(p.row, p.col) for p in group]
+            try:
+                proofs = self.sample_batch(entry, coords, axis=group[0].axis)
+                for p, proof in zip(group, proofs):
+                    p.proof = proof
+            except Exception as e:  # noqa: BLE001 — parked callers must wake
+                for p in group:
+                    p.error = e
+            finally:
+                for p in group:
+                    lat.observe(
+                        time.perf_counter() - p.t_submit, phase="total"
+                    )
+                    p.event.set()
+
+    # --- the two lowerings --------------------------------------------------
+    def sample_batch(self, entry, coords, axis: str = "row") -> list[ShareProof]:
+        """Answer [(row, col), ...] against one cached height on one
+        sampling axis; routes the $CELESTIA_SERVE_MODE seam and absorbs
+        injected/real batched-path faults by re-answering on the host
+        path (bit-identical)."""
+        from celestia_app_tpu import chaos
+        from celestia_app_tpu.chaos.degrade import recoveries
+
+        n = 2 * entry.k
+        if axis not in ("row", "col"):
+            raise ValueError(f"axis must be 'row' or 'col', got {axis!r}")
+        for row, col in coords:
+            if not (0 <= row < n and 0 <= col < n):
+                raise ValueError(f"coordinate ({row},{col}) outside {n}x{n}")
+        if serve_mode() == "host":
+            return self._host_batch(entry, coords, axis)
+        try:
+            chaos.proof_serve()
+            return self._batched(entry, coords, axis)
+        except Exception:  # noqa: BLE001 — the host path is the answer
+            proofs = self._host_batch(entry, coords, axis)
+            recoveries().inc(seam="proof.serve", outcome="degraded")
+            return proofs
+
+    def _batched(self, entry, coords, axis: str = "row") -> list[ShareProof]:
+        lat = _latency()
+        n = 2 * entry.k
+        # Row sampling proves leaf `col` of tree `row`; column sampling
+        # the transpose — leaf `row` of column tree `col`, whose root is
+        # data-root leaf 2k + col.
+        if axis == "col":
+            plans = [_sample_coords(n, row) for row, _ in coords]
+            trees = [col for _, col in coords]
+        else:
+            plans = [_sample_coords(n, col) for _, col in coords]
+            trees = [row for row, _ in coords]
+        node_idx: list[int] = []
+        for tree, plan in zip(trees, plans):
+            node_idx.extend(
+                entry.flat_index(tree, lvl, i) for lvl, i in plan
+            )
+        t0 = time.perf_counter()
+        nodes = entry.gather(axis, node_idx)
+        shares = entry.gather_shares(coords)
+        lat.observe(time.perf_counter() - t0, phase="gather")
+
+        t1 = time.perf_counter()
+        from celestia_app_tpu import merkle
+
+        all_roots = entry.row_roots + entry.col_roots
+        out: list[ShareProof] = []
+        pos = 0
+        for (row, col), plan, share_row in zip(coords, plans, shares):
+            share = bytes(share_row.tobytes())
+            nmt_nodes = tuple(
+                bytes(nodes[pos + i].tobytes()) for i in range(len(plan))
+            )
+            pos += len(plan)
+            ns = (
+                share[:NAMESPACE_SIZE]
+                if row < entry.k and col < entry.k
+                else PARITY_NAMESPACE_BYTES
+            )
+            if axis == "col":
+                leaf, root_index = row, n + col
+            else:
+                leaf, root_index = col, row
+            out.append(ShareProof(
+                data=(share,),
+                share_proofs=(NmtRangeProof(leaf, leaf + 1, nmt_nodes, n),),
+                namespace=ns,
+                row_proof=RowProof(
+                    row_roots=(all_roots[root_index],),
+                    proofs=(tuple(
+                        merkle.path_from_levels(entry.root_levels, root_index)
+                    ),),
+                    start_row=root_index,
+                    end_row=root_index + 1,
+                    total=2 * n,
+                ),
+            ))
+        lat.observe(time.perf_counter() - t1, phase="assemble")
+        return out
+
+    def _host_batch(self, entry, coords, axis: str = "row") -> list[ShareProof]:
+        return [self.host_proof(entry, row, col, axis) for row, col in coords]
+
+    @staticmethod
+    def host_proof(entry, row: int, col: int, axis: str = "row") -> ShareProof:
+        """The pure-host lowering: rebuild the row tree from the shares,
+        re-derive the data-root audit path recursively.  MUST stay
+        byte-identical to _batched (the serve plane's exactness seam,
+        pinned by tests/test_das_proofs.py and the chaos soak's sampling
+        drill)."""
+        import numpy as np
+
+        from celestia_app_tpu import merkle
+
+        eds = entry.eds
+        n = 2 * entry.k
+        share = bytes(np.asarray(eds._eds[row, col]).tobytes())
+        if axis == "col":
+            tree = eds.col_tree(col, host=True)
+            proof = prove_range_from_levels(tree.levels(), row, row + 1)
+            root_index = n + col
+        else:
+            tree = eds.row_tree(row, host=True)
+            proof = prove_range_from_levels(tree.levels(), col, col + 1)
+            root_index = row
+        all_roots = entry.row_roots + entry.col_roots
+        ns = (
+            share[:NAMESPACE_SIZE]
+            if row < entry.k and col < entry.k
+            else PARITY_NAMESPACE_BYTES
+        )
+        return ShareProof(
+            data=(share,),
+            share_proofs=(proof,),
+            namespace=ns,
+            row_proof=RowProof(
+                row_roots=(all_roots[root_index],),
+                proofs=(tuple(merkle.proof(all_roots, root_index)),),
+                start_row=root_index,
+                end_row=root_index + 1,
+                total=len(all_roots),
+            ),
+        )

@@ -16,6 +16,11 @@ hits:
     GET /namespaces              per-tenant data-plane summary (cumulative
                                  blob/share/byte totals + last square)
     GET /slo                     SLO burn-rate evaluation (trace/slo.py)
+    GET /das/share_proof         one DAS sample: ?height=&row=&col= ->
+                                 ShareProof vs the committed DAH data root
+                                 (serve/, the batched proof plane)
+    GET /das/shares              namespace-ranged query: ?height=&namespace=
+                                 (29-byte hex) -> shares + multi-row proof
 
 /healthz is the SLO face: beyond {"status": "SERVING"}, any registered
 health providers (a ServingNode registers its own snapshot: last block
@@ -34,6 +39,32 @@ METRICS_CONTENT_TYPE = "text/plain; version=0.0.4"
 
 _HEALTH_LOCK = threading.Lock()
 _HEALTH_PROVIDERS: dict[str, object] = {}
+
+_DAS_LOCK = threading.Lock()
+_DAS_PROVIDER = None  # serve/api.DasProvider; last registration wins
+
+
+def register_das_provider(provider) -> None:
+    """Mount a serve/api.DasProvider behind GET /das/* on every plane.
+    Last registration wins (one serving node per process answers DAS;
+    multi-node test processes register explicitly per scenario)."""
+    global _DAS_PROVIDER
+    with _DAS_LOCK:
+        _DAS_PROVIDER = provider
+
+
+def unregister_das_provider(provider=None) -> None:
+    """Remove the provider; with `provider` given, only if still the
+    registered one (a stopped node must not unhook its replacement)."""
+    global _DAS_PROVIDER
+    with _DAS_LOCK:
+        if provider is None or _DAS_PROVIDER is provider:
+            _DAS_PROVIDER = None
+
+
+def das_provider():
+    with _DAS_LOCK:
+        return _DAS_PROVIDER
 
 
 def register_health_provider(name: str, provider) -> None:
@@ -112,15 +143,65 @@ def _parse_tail(query: str):
     return True, None
 
 
-def handle_observability_get(path: str):
+def _query_params(query: str) -> dict[str, str]:
+    from urllib.parse import parse_qs
+
+    return {k: v[0] for k, v in parse_qs(query).items() if v}
+
+
+def _das_response(kind: str, query: str, plane: str):
+    """GET /das/* -> the registered DasProvider's canonical payload bytes
+    (serve/api.render — the SAME bytes the gRPC Das service carries), with
+    gateway-shaped errors: 503 no provider, 400 bad params, 404 unknown
+    height."""
+    provider = das_provider()
+    if provider is None:
+        return 503, "application/json", json.dumps(
+            {"error": "no DAS provider registered (serve/ plane not wired)"}
+        ).encode()
+    from celestia_app_tpu.serve.api import UnknownHeight, count_served, render
+
+    params = _query_params(query)
+    try:
+        if kind == "share_proof":
+            payload = provider.share_proof_payload(
+                int(params.get("height", "")),
+                int(params.get("row", "")),
+                int(params.get("col", "")),
+                axis=params.get("axis", "row"),
+            )
+        else:
+            payload = provider.shares_payload(
+                int(params.get("height", "")),
+                params.get("namespace", ""),
+            )
+    except UnknownHeight as e:
+        return 404, "application/json", json.dumps({"error": str(e)}).encode()
+    except (TypeError, ValueError) as e:
+        return 400, "application/json", json.dumps({"error": str(e)}).encode()
+    except Exception as e:  # noqa: BLE001 — a proof fault must not kill the probe port
+        return 500, "application/json", json.dumps(
+            {"error": f"{type(e).__name__}: {e}"}
+        ).encode()
+    count_served(plane, kind)
+    return 200, "application/json", render(payload)
+
+
+def handle_observability_get(path: str, plane: str = "shared"):
     """Route an HTTP GET path; returns (status, content_type, body-bytes)
     or None when the path is not an observability endpoint (the caller
-    falls through to its own routes / 404)."""
+    falls through to its own routes / 404).  `plane` names the mounting
+    plane for per-plane serving counters (the BODY never depends on it —
+    byte-identity across planes is the contract)."""
     from celestia_app_tpu.trace.tracer import traced
 
     p, _, query = path.partition("?")
     if p != "/":
         p = p.rstrip("/")
+    if p == "/das/share_proof":
+        return _das_response("share_proof", query, plane)
+    if p == "/das/shares":
+        return _das_response("shares", query, plane)
     if p == "/metrics":
         return 200, METRICS_CONTENT_TYPE, metrics_payload()
     if p == "/healthz":

@@ -162,6 +162,15 @@ def default_slos() -> tuple[SLOSpec, ...]:
             metric="celestia_square_last_occupancy_ratio",
             kind="gauge", op=">=", threshold=0.05, budget=0.1,
         ),
+        # The read side: a DAS sample must come back fast at p99 — light
+        # clients time out and resample, so a slow proof plane IS an
+        # availability incident even while blocks commit on schedule.
+        # Judged per served sample (serve/sampler's {phase="total"}
+        # child); a node serving no proofs observes nothing and burns 0.
+        SLOSpec(
+            name="proof_p99", metric="celestia_proof_latency_seconds",
+            labels=(("phase", "total"),), quantile=0.99, threshold=0.5,
+        ),
         SLOSpec(
             name="degraded", metric="celestia_degraded",
             kind="gauge", op="==", threshold=0.0, budget=0.01,

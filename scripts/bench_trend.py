@@ -29,6 +29,12 @@ silently dropping out of the trajectory.
 `bench_trend.prom` Prometheus textfile and `bench_trend.jsonl` rows
 (tracer table `bench_trend`) — so the next chip round's numbers land in
 the same tables as the live exposition.
+
+The PROOF-SERVING trajectory rides the same gate: any `DAS_rNN.json`
+records at the repo root (written by `scripts/das_loadgen.py
+--round-out`) contribute a proofs/sec series (gated like a rate, higher
+is better) and a proof-p99 series (gated like a parts time, lower is
+better), under the same same-platform comparability rule.
 """
 
 from __future__ import annotations
@@ -193,6 +199,68 @@ def load_series(paths: list[str]) -> list[dict]:
     if not any(r["modes"] or r["parts"] for r in rounds):
         raise MalformedRound("no round contributed any data")
     return rounds
+
+
+# --- DAS loadgen rounds (scripts/das_loadgen.py --round-out) -----------------
+
+def load_das_round(path: str) -> dict:
+    """One DAS_rNN.json: {n, proofs_per_s, proof_p99_ms, [platform, ...]}.
+    Malformed files exit 2 like a bad bench round — a broken loadgen
+    record must not silently drop out of the trajectory."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            raw = json.load(f)
+    except (OSError, ValueError) as e:
+        raise MalformedRound(f"{path}: not readable JSON: {e}") from e
+    for key in ("n", "proofs_per_s", "proof_p99_ms"):
+        if key not in raw or raw[key] is None:
+            raise MalformedRound(f"{path}: missing required key {key!r}")
+    return {
+        "round": int(raw["n"]),
+        "path": os.path.basename(path),
+        "proofs_per_s": float(raw["proofs_per_s"]),
+        "proof_p99_ms": float(raw["proof_p99_ms"]),
+        "platform": raw.get("platform"),
+    }
+
+
+def load_das_series(paths: list[str]) -> list[dict]:
+    """The proof-serving trajectory; [] when no loadgen round exists yet
+    (the series is additive — bench rounds alone stay valid)."""
+    return sorted((load_das_round(p) for p in paths), key=lambda r: r["round"])
+
+
+def find_das_regressions(das_rounds: list[dict], threshold_pct: float) -> list[dict]:
+    """proofs/sec gates like a rate (higher better), proof-p99 like a
+    parts time (lower better); same-platform comparability rule as the
+    bench series (a CPU loadgen number is not a regression against a
+    chip round's)."""
+    platforms = {r["round"]: r.get("platform") for r in das_rounds}
+    out = []
+    for key, better in (("proofs_per_s", "higher"), ("proof_p99_ms", "lower")):
+        pts = [(r["round"], r[key]) for r in das_rounds]
+        if len(pts) < 2:
+            continue
+        priors = _comparable_priors(pts, platforms)
+        if not priors:
+            continue
+        last_round, last = pts[-1]
+        best_prior = max(priors) if better == "higher" else min(priors)
+        if best_prior <= 0:
+            continue
+        worse_pct = (
+            (best_prior - last) / best_prior * 100.0
+            if better == "higher"
+            else (last - best_prior) / best_prior * 100.0
+        )
+        if worse_pct > threshold_pct:
+            out.append({
+                "series": f"das.{key}", "unit": key,
+                "round": last_round, "value": last, "best_prior": best_prior,
+                "worse_pct": round(worse_pct, 2),
+                "allowed_pct": round(threshold_pct, 2),
+            })
+    return out
 
 
 # --- trend assembly ---------------------------------------------------------
@@ -429,7 +497,8 @@ def render_table(rounds: list[dict]) -> str:
 
 
 def write_metrics_out(out_dir: str, rounds: list[dict],
-                      regressions: list[dict]) -> None:
+                      regressions: list[dict],
+                      das_rounds: list[dict] | None = None) -> None:
     """bench_trend.prom + bench_trend.jsonl, the bench.py --metrics-out
     shapes (private registry/tracer: this run's view only)."""
     if REPO_ROOT not in sys.path:  # `python scripts/bench_trend.py` puts
@@ -455,6 +524,17 @@ def write_metrics_out(out_dir: str, rounds: list[dict],
         for rnd, v in pts:
             secs.set(v, part=name, round=f"r{rnd:02d}")
             tracer.write("bench_trend", round=rnd, part=name, seconds=v)
+    if das_rounds:
+        das = reg.gauge("celestia_bench_trend_das",
+                        "per-round DAS loadgen series (proofs/sec, p99 ms)")
+        for r in das_rounds:
+            das.set(r["proofs_per_s"], series="proofs_per_s",
+                    round=f"r{r['round']:02d}")
+            das.set(r["proof_p99_ms"], series="proof_p99_ms",
+                    round=f"r{r['round']:02d}")
+            tracer.write("bench_trend", round=r["round"],
+                         proofs_per_s=r["proofs_per_s"],
+                         proof_p99_ms=r["proof_p99_ms"])
     for reg_row in regressions:
         tracer.write("bench_trend", regression=True, **reg_row)
     with open(os.path.join(out_dir, "bench_trend.prom"), "w") as f:
@@ -485,8 +565,13 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
 
     paths = args.files or sorted(glob.glob(os.path.join(args.dir, "BENCH_r*.json")))
+    das_paths = (
+        [] if args.files
+        else sorted(glob.glob(os.path.join(args.dir, "DAS_r*.json")))
+    )
     try:
         rounds = load_series(paths)
+        das_rounds = load_das_series(das_paths)
     except MalformedRound as e:
         print(f"bench_trend: MALFORMED: {e}", file=sys.stderr)
         return 2
@@ -503,14 +588,16 @@ def main(argv: list[str] | None = None) -> int:
     regressions = find_regressions(
         rounds, args.threshold, gate_all=args.all_series
     )
+    regressions += find_das_regressions(das_rounds, args.threshold)
     stale = stale_gated_series(rounds, gate_all=args.all_series)
     seats = seat_changes(rounds)
     overrides = seat_overrides(rounds)
     if args.metrics_out:
-        write_metrics_out(args.metrics_out, rounds, regressions)
+        write_metrics_out(args.metrics_out, rounds, regressions, das_rounds)
     if args.json:
         print(json.dumps({
             "rounds": [r["round"] for r in rounds],
+            "das_rounds": [r["round"] for r in das_rounds],
             "regressions": regressions,
             "stale": [s for s in stale if not s.get("hw_gated")],
             "hw_gated": [s for s in stale if s.get("hw_gated")],
@@ -520,6 +607,11 @@ def main(argv: list[str] | None = None) -> int:
         }))
     else:
         print(render_table(rounds))
+        for r in das_rounds:
+            print(f"  das r{r['round']:02d}: "
+                  f"{r['proofs_per_s']:9.2f} proofs/s  "
+                  f"p99 {r['proof_p99_ms']:8.3f} ms"
+                  + (f"  [{r['platform']}]" if r.get("platform") else ""))
         for c in seats:
             print(f"  SEAT CHANGE: {c['seat']} {c['from']} -> {c['to']} "
                   f"(r{c['from_round']:02d} -> r{c['round']:02d}; the >3% "

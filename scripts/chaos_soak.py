@@ -16,6 +16,12 @@ LATENCY but never CORRECTNESS.  Four drills, one process:
                        complete record, refuse the conflicting re-sign,
                        and allow the idempotent one (double-sign safety
                        survives the torn tail).
+  2b. sampling drill — DAS samples under injected proof.serve faults
+                       (failed/slow batched proof dispatches): the
+                       sampler must absorb every injection on the
+                       pure-host fallback with proof bytes BIT-IDENTICAL
+                       to the chaos-off batched run, all verifying
+                       against the committed DAH data root.
   3. gossip drill    — a redundant flood over a lossy, duplicating,
                        transiently-failing link; the receiver-side
                        msg-id dedup must converge on exactly the unique
@@ -398,6 +404,75 @@ def run_breaker_drill(k: int = 4, base_env: str | None = None,
     return result
 
 
+def run_sampling_drill(k: int = 8, samples: int = 64,
+                       spec: str = "seed=5,proof_fail=0.5,proof_slow_ms=2"
+                       ) -> dict:
+    """The serve plane's bit-exactness drill: under injected proof.serve
+    faults (failed/slow batched dispatches), every DAS sample must still
+    be answered — the sampler absorbs each injected failure by
+    re-answering the batch on the pure-host path — and every proof must
+    be BYTE-IDENTICAL to the chaos-off batched run and verify against
+    the committed DAH data root.  The read-side mirror of the device
+    soak's 'latency, never correctness' claim."""
+    from celestia_app_tpu import chaos
+    from celestia_app_tpu.da.eds import ExtendedDataSquare
+    from celestia_app_tpu.serve.api import render
+    from celestia_app_tpu.serve.cache import ForestCache
+    from celestia_app_tpu.serve.sampler import ProofSampler
+    from celestia_app_tpu.rpc.codec import to_jsonable
+    from celestia_app_tpu.trace.metrics import registry
+
+    _, ods = _deterministic_blocks(1, k, seed=515)[0]
+    chaos.install("")  # baseline leg: no injection even with env chaos set
+    eds = ExtendedDataSquare.compute(ods)
+    root = eds.data_root()
+    cache = ForestCache(heights=2, spill=2)
+    entry = cache.put(1, eds)
+    sampler = ProofSampler()
+    rng = np.random.default_rng(99)
+    n = 2 * k
+    coords = [
+        (int(rng.integers(0, n)), int(rng.integers(0, n)))
+        for _ in range(samples)
+    ]
+    baseline = [
+        render(to_jsonable(p)) for p in sampler.sample_batch(entry, coords)
+    ]
+
+    def _injections() -> float:
+        for labels, val in registry().counter(
+            "celestia_chaos_injections_total", ""
+        ).samples():
+            if labels.get("seam") == "proof.serve":
+                return val
+        return 0.0
+
+    inj_before = _injections()
+    chaos.install(spec)
+    t0_ns = time.time_ns()
+    try:
+        # One batch per handful of coords so the fail probability gets
+        # many dispatches to bite (one giant batch = one coin flip).
+        chaotic = []
+        for i in range(0, samples, 8):
+            chaotic.extend(sampler.sample_batch(entry, coords[i:i + 8]))
+    finally:
+        chaos.uninstall()
+    chaotic_bytes = [render(to_jsonable(p)) for p in chaotic]
+    identical = chaotic_bytes == baseline
+    verified = all(p.verify(root) for p in chaotic)
+    injected = _injections() - inj_before
+    return {
+        "samples": samples,
+        "k": k,
+        "bit_identical": identical,
+        "all_verify": verified,
+        "injections": injected,
+        "ok": identical and verified,
+        "detection": _detection(t0_ns),
+    }
+
+
 def seam_table_lines(prefixes: tuple[str, ...]) -> list[str]:
     """Exposition lines for the given metric families, straight off the
     registry (the soak's summary-table reader)."""
@@ -465,6 +540,14 @@ def main(argv=None) -> int:
     if not wal["ok"]:
         failures.append(f"WAL drill failed: {wal}")
 
+    smp = run_sampling_drill(k=min(args.k, 8))
+    print(f"sampling drill: {smp['samples']} DAS samples @ k={smp['k']} -> "
+          f"bit_identical={smp['bit_identical']} "
+          f"all_verify={smp['all_verify']} "
+          f"injections={smp['injections']:.0f}", flush=True)
+    if not smp["ok"]:
+        failures.append(f"sampling drill failed: {smp}")
+
     gos = run_gossip_drill(args.spec)
     print(f"gossip drill: {gos['sent_unique']} unique msgs converged in "
           f"{gos['rounds']} flood rounds -> {gos['deliveries']} deliveries, "
@@ -501,6 +584,7 @@ def main(argv=None) -> int:
     print(detection_table([
         ("device soak", dev.get("detection")),
         ("WAL tear", wal.get("detection")),
+        ("sampling", smp.get("detection")),  # healed by host fallback
         ("gossip", None),  # healed by redundancy: no anomaly to page on
         ("breaker (epi seat)", brk_epi.get("detection")),
         ("breaker (fused)", brk.get("detection")),

@@ -1,0 +1,294 @@
+#!/usr/bin/env python
+"""DAS sampling load generator: thousands of queued share samples, p50/p99.
+
+The txsim of the read side.  Where txsim floods BroadcastTx, this floods
+the proof plane: N worker threads draw seeded-random (height, row, col)
+coordinates over M cached squares and push them through the batched
+ProofSampler queue (serve/sampler.py) — exactly the path the three RPC
+planes serve — measuring per-sample wall latency and aggregate
+proofs/sec.  A seeded subset of proofs is verified against the committed
+DAH data root, so a loadgen run that "performs well" while serving
+garbage fails loudly.
+
+Runs crypto-free (no signing stack): squares are deterministic synthetic
+blocks admitted straight into a ForestCache, so the tool measures the
+serve plane, not block production.  `--mode host` drives the pure-host
+fallback for an A/B number; `--url` instead samples a LIVE node's
+GET /das/share_proof endpoint over HTTP.
+
+  JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/das_loadgen.py \
+      --heights 4 --k 16 --samples 2000 --threads 8 \
+      --metrics-out /tmp/das --round-out DAS_r01.json
+
+Prints a one-line JSON summary; --metrics-out writes das_loadgen.prom
+(the celestia_proof_* / celestia_serve_* families) + das_loadgen.jsonl;
+--round-out writes the DAS_rNN.json record scripts/bench_trend.py reads
+into its proofs/sec + proof-p99 trend series and regression gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+import numpy as np  # noqa: E402
+
+
+def deterministic_square(k: int, seed: int):
+    """One synthetic namespace-ordered ODS (the chaos_soak block shape)."""
+    from celestia_app_tpu.constants import NAMESPACE_SIZE, SHARE_SIZE
+
+    rng = np.random.default_rng(seed)
+    ns = np.sort(rng.integers(0, 128, k * k).astype(np.uint8))
+    ods = rng.integers(0, 256, (k * k, SHARE_SIZE), dtype=np.uint8)
+    ods[:, :NAMESPACE_SIZE] = 0
+    ods[:, NAMESPACE_SIZE - 1] = ns
+    return ods.reshape(k, k, SHARE_SIZE)
+
+
+def build_cache(heights: int, k: int, seed: int):
+    from celestia_app_tpu.da.eds import ExtendedDataSquare
+    from celestia_app_tpu.serve.cache import ForestCache
+
+    cache = ForestCache(heights=heights, spill=heights)
+    roots = {}
+    for h in range(1, heights + 1):
+        eds = ExtendedDataSquare.compute(deterministic_square(k, seed + h))
+        cache.put(h, eds)
+        roots[h] = eds.data_root()
+    return cache, roots
+
+
+def run_local(args) -> dict:
+    """Drive the in-process sampler queue with `threads` workers."""
+    from celestia_app_tpu.serve.sampler import ProofSampler
+
+    cache, roots = build_cache(args.heights, args.k, args.seed)
+    sampler = ProofSampler()
+    n = 2 * args.k
+    rng = np.random.default_rng(args.seed)
+    axes = (
+        ("row", "col") if args.axes == "both" else (args.axes,)
+    )
+    plan = [
+        (int(rng.integers(1, args.heights + 1)),
+         int(rng.integers(0, n)), int(rng.integers(0, n)),
+         axes[int(rng.integers(0, len(axes)))])
+        for _ in range(args.samples)
+    ]
+    verify_every = max(1, args.samples // max(args.verify, 1))
+    latencies: list[float] = []
+    failures: list[str] = []
+    lock = threading.Lock()
+    cursor = iter(range(args.samples))
+
+    def worker():
+        while True:
+            with lock:
+                i = next(cursor, None)
+            if i is None:
+                return
+            h, r, c, axis = plan[i]
+            entry, _ = cache.get(h)
+            t0 = time.perf_counter()
+            try:
+                proof = sampler.share_proof(entry, r, c, axis=axis)
+            except Exception as e:  # noqa: BLE001 — a drop IS the measurement
+                with lock:
+                    failures.append(f"({h},{r},{c}): {type(e).__name__}: {e}")
+                return
+            dt = time.perf_counter() - t0
+            ok = True
+            if i % verify_every == 0:
+                ok = proof.verify(roots[h])
+            with lock:
+                latencies.append(dt)
+                if not ok:
+                    failures.append(f"({h},{r},{c}): proof failed verify")
+
+    t_start = time.perf_counter()
+    threads = [
+        threading.Thread(target=worker, daemon=True)
+        for _ in range(args.threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t_start
+
+    lat_ms = sorted(v * 1e3 for v in latencies)
+
+    def pct(p):
+        if not lat_ms:
+            return None
+        return round(lat_ms[min(len(lat_ms) - 1, int(p * len(lat_ms)))], 3)
+
+    import jax
+
+    return {
+        "metric": "das_loadgen",
+        "mode": os.environ.get("CELESTIA_SERVE_MODE", "") or "batched",
+        "samples": len(lat_ms),
+        "requested": args.samples,
+        "heights": args.heights,
+        "k": args.k,
+        "threads": args.threads,
+        "axes": args.axes,
+        "wall_s": round(wall_s, 3),
+        "proofs_per_s": round(len(lat_ms) / wall_s, 2) if wall_s else None,
+        "proof_p50_ms": pct(0.50),
+        "proof_p99_ms": pct(0.99),
+        "verified": (len(lat_ms) + verify_every - 1) // verify_every,
+        "failures": failures[:5],
+        "platform": jax.default_backend(),
+        "cache": cache.stats(),
+    }
+
+
+def run_url(args) -> dict:
+    """Sample a live node's GET /das/share_proof over HTTP."""
+    import urllib.request
+
+    # Probe the square size from a first sample at (0, 0).
+    def get(h, r, c):
+        with urllib.request.urlopen(
+            f"{args.url}/das/share_proof?height={h}&row={r}&col={c}",
+            timeout=30,
+        ) as resp:
+            return json.loads(resp.read())
+
+    first = get(args.height, 0, 0)
+    n = 2 * first["square_size"]
+    rng = np.random.default_rng(args.seed)
+    lat_ms: list[float] = []
+    failures: list[str] = []
+    t_start = time.perf_counter()
+    for _ in range(args.samples):
+        r, c = int(rng.integers(0, n)), int(rng.integers(0, n))
+        t0 = time.perf_counter()
+        try:
+            get(args.height, r, c)
+            lat_ms.append((time.perf_counter() - t0) * 1e3)
+        except Exception as e:  # noqa: BLE001
+            failures.append(f"({r},{c}): {type(e).__name__}: {e}")
+    wall_s = time.perf_counter() - t_start
+    lat_ms.sort()
+
+    def pct(p):
+        if not lat_ms:
+            return None
+        return round(lat_ms[min(len(lat_ms) - 1, int(p * len(lat_ms)))], 3)
+
+    return {
+        "metric": "das_loadgen",
+        "mode": "url",
+        "url": args.url,
+        "samples": len(lat_ms),
+        "wall_s": round(wall_s, 3),
+        "proofs_per_s": round(len(lat_ms) / wall_s, 2) if wall_s else None,
+        "proof_p50_ms": pct(0.50),
+        "proof_p99_ms": pct(0.99),
+        "failures": failures[:5],
+        "platform": None,
+    }
+
+
+def write_metrics_out(out_dir: str) -> None:
+    """das_loadgen.prom + das_loadgen.jsonl: the serve-plane families off
+    the live registry (the loadgen drove the REAL sampler metrics, so the
+    artifact is exactly what a /metrics scrape would have seen)."""
+    from celestia_app_tpu.trace.metrics import registry
+    from celestia_app_tpu.trace.tracer import traced
+
+    os.makedirs(out_dir, exist_ok=True)
+    keep = ("celestia_proof_", "celestia_serve_", "celestia_recoveries_",
+            "celestia_chaos_")
+    lines, emit = [], False
+    for line in registry().render().splitlines():
+        if line.startswith("# HELP") or line.startswith("# TYPE"):
+            emit = line.split()[2].startswith(keep)
+        if emit:
+            lines.append(line)
+    with open(os.path.join(out_dir, "das_loadgen.prom"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+    rows = traced().export_jsonl("proof_serve")
+    with open(os.path.join(out_dir, "das_loadgen.jsonl"), "w") as f:
+        f.write(rows + "\n" if rows else "")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--heights", type=int, default=4)
+    ap.add_argument("--k", type=int, default=16)
+    ap.add_argument("--samples", type=int, default=2000)
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--verify", type=int, default=64,
+                    help="how many sampled proofs to verify against the root")
+    ap.add_argument("--mode", choices=("batched", "host"), default=None,
+                    help="pin $CELESTIA_SERVE_MODE for the run")
+    ap.add_argument("--axes", choices=("row", "col", "both"), default="both",
+                    help="sampling axis mix (light clients draw both)")
+    ap.add_argument("--url", default=None,
+                    help="sample a live node's /das/share_proof instead")
+    ap.add_argument("--height", type=int, default=1,
+                    help="height to sample in --url mode")
+    ap.add_argument("--metrics-out", metavar="DIR")
+    ap.add_argument("--round-out", metavar="DAS_rNN.json",
+                    help="write the bench_trend round record here")
+    args = ap.parse_args(argv)
+
+    saved = os.environ.get("CELESTIA_SERVE_MODE")
+    if args.mode:
+        os.environ["CELESTIA_SERVE_MODE"] = args.mode
+    try:
+        summary = run_url(args) if args.url else run_local(args)
+    finally:
+        if args.mode:
+            if saved is None:
+                os.environ.pop("CELESTIA_SERVE_MODE", None)
+            else:
+                os.environ["CELESTIA_SERVE_MODE"] = saved
+
+    print(json.dumps(summary), flush=True)
+    if args.metrics_out:
+        write_metrics_out(args.metrics_out)
+    if args.round_out:
+        import re
+
+        m = re.search(r"DAS_r(\d+)\.json$", os.path.basename(args.round_out))
+        record = {
+            "n": int(m.group(1)) if m else 0,
+            "proofs_per_s": summary["proofs_per_s"],
+            "proof_p50_ms": summary["proof_p50_ms"],
+            "proof_p99_ms": summary["proof_p99_ms"],
+            "samples": summary["samples"],
+            "k": summary.get("k"),
+            "mode": summary["mode"],
+            "platform": summary.get("platform"),
+        }
+        with open(args.round_out, "w") as f:
+            json.dump(record, f, indent=1)
+    if summary.get("failures"):
+        for fail in summary["failures"]:
+            print(f"FAIL: {fail}", file=sys.stderr)
+        return 1
+    if summary["samples"] < args.samples:
+        print("FAIL: not every requested sample was served", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -328,3 +328,83 @@ class TestMetricsOut:
         ]
         assert any(r.get("mode") == "compute" and r.get("k") == 512 for r in rows)
         assert any(r.get("part") == "rs_dense" for r in rows)
+
+
+def _das_file(tmp_path, n, proofs_per_s, p99_ms, platform="cpu"):
+    path = tmp_path / f"DAS_r{n:02d}.json"
+    path.write_text(json.dumps({
+        "n": n, "proofs_per_s": proofs_per_s, "proof_p50_ms": p99_ms / 3,
+        "proof_p99_ms": p99_ms, "samples": 100, "k": 8, "mode": "batched",
+        "platform": platform,
+    }))
+    return str(path)
+
+
+class TestDasSeries:
+    """The proof-serving trajectory (scripts/das_loadgen.py --round-out)
+    rides the same trend table and regression gate as the bench rounds."""
+
+    def test_checked_in_das_round_parses_and_renders(self, capsys):
+        bt = _load()
+        assert bt.main(["--check"]) == 0
+        out = capsys.readouterr().out
+        assert "das r01" in out and "proofs/s" in out
+
+    def test_das_throughput_regression_is_flagged(self, tmp_path, capsys):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _das_file(tmp_path, 1, proofs_per_s=400.0, p99_ms=50.0)
+        _das_file(tmp_path, 2, proofs_per_s=200.0, p99_ms=50.0)  # -50%
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        assert "das.proofs_per_s" in capsys.readouterr().out
+
+    def test_das_p99_regression_is_flagged(self, tmp_path, capsys):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _das_file(tmp_path, 1, proofs_per_s=400.0, p99_ms=50.0)
+        _das_file(tmp_path, 2, proofs_per_s=400.0, p99_ms=120.0)  # p99 2.4x
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        assert "das.proof_p99_ms" in capsys.readouterr().out
+
+    def test_das_improvement_passes(self, tmp_path):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _das_file(tmp_path, 1, proofs_per_s=400.0, p99_ms=50.0)
+        _das_file(tmp_path, 2, proofs_per_s=500.0, p99_ms=40.0)
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+
+    def test_das_cross_platform_prior_not_compared(self, tmp_path):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        # A chip round's proofs/sec must not gate a CPU-fallback round.
+        _das_file(tmp_path, 1, proofs_per_s=40_000.0, p99_ms=1.0,
+                  platform="tpu")
+        _das_file(tmp_path, 2, proofs_per_s=300.0, p99_ms=80.0,
+                  platform="cpu")
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+
+    def test_malformed_das_round_exits_2(self, tmp_path):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        (tmp_path / "DAS_r01.json").write_text(json.dumps({"n": 1}))
+        assert bt.main(["--dir", str(tmp_path)]) == 2
+
+    def test_das_series_in_json_output(self, tmp_path, capsys):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _das_file(tmp_path, 1, proofs_per_s=400.0, p99_ms=50.0)
+        assert bt.main(["--dir", str(tmp_path), "--json"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["das_rounds"] == [1]
+
+    def test_das_metrics_out(self, tmp_path):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _das_file(tmp_path, 1, proofs_per_s=400.0, p99_ms=50.0)
+        out_dir = tmp_path / "metrics"
+        assert bt.main([
+            "--dir", str(tmp_path), "--metrics-out", str(out_dir), "--json",
+        ]) == 0
+        prom = (out_dir / "bench_trend.prom").read_text()
+        assert "celestia_bench_trend_das" in prom
+        assert 'series="proofs_per_s"' in prom

@@ -272,4 +272,5 @@ def test_in_tree_routes_are_seen_and_documented():
     assert "/slo" in paths
     assert "/metrics" in paths
     assert "/trace_tables/" in paths  # the prefix route
+    assert "/das/share_proof" in paths and "/das/shares" in paths
     assert "/" not in paths  # normalization compare is not a route
