@@ -85,7 +85,12 @@ BASELINE_NOTE = (
     "the parts row's `tuned` field). Stream mode double-buffers with a "
     "dedicated uploader thread and a separate dispatcher (block N+1 "
     "uploads while block N computes), each streamed block a distinct "
-    "buffer so relay memoization is never what gets measured."
+    "buffer so relay memoization is never what gets measured. The "
+    "stream stage additionally emits stream_b{1,2,4} rows — blocks/sec "
+    "with B same-k squares coalesced into ONE vmapped dispatch "
+    "($CELESTIA_PIPE_BATCH, cross-height continuous batching): batch-B "
+    "seconds/block below the batch-1 row means B squares in one "
+    "dispatch cost less than B dispatch latencies."
 )
 
 
@@ -585,6 +590,55 @@ def _stream_seconds(ods: np.ndarray, iters: int) -> float:
     return (time.perf_counter() - t0) / n
 
 
+#: Coalesced-dispatch sizes the batched stream row measures (the
+#: continuous-batching leg: B same-k squares in ONE vmapped dispatch
+#: instead of B dispatch latencies).
+STREAM_BATCHES = (1, 2, 4)
+
+
+def _stream_batched_seconds(ods: np.ndarray, iters: int) -> dict[int, float]:
+    """Seconds per block streamed at each coalescing size in
+    STREAM_BATCHES — the blocks/sec face of cross-height continuous
+    batching.  Same distinct-buffer and prebuilt-variant rules as
+    _stream_seconds; depth widens with the batch so the coalescer always
+    has queued squares to merge (otherwise the occupancy signal would
+    close every batch at 1 and the row would measure nothing)."""
+    from celestia_app_tpu.parallel.pipeline import stream_blocks
+
+    k = ods.shape[0]
+    n = min(4 * iters, max(4, int(1.5e9 / ods.nbytes)))
+    n -= n % max(STREAM_BATCHES)  # same block count for every batch size
+    blocks = [_variant(ods, i, axis=0) for i in range(n)]
+    warm_blocks = [_variant(ods, n + i, axis=0) for i in range(max(STREAM_BATCHES))]
+
+    def feed(blist):
+        for i, b in enumerate(blist):
+            yield i, b
+
+    # AOT-compile EVERY coalescing size 1..max up front: _coalesce is
+    # opportunistic (it merges whatever happens to be queued), so a warm
+    # STREAM alone cannot guarantee which batch executables get built —
+    # and a multi-second jax compile landing inside the timed window
+    # would corrupt a series bench_trend gates.  warmup() builds the
+    # owned-input batched programs directly, race-free.
+    from celestia_app_tpu.da.eds import warmup as _warmup
+
+    _warmup(square_sizes=[k],
+            batches=tuple(range(2, max(STREAM_BATCHES) + 1)))
+    out: dict[int, float] = {}
+    for batch in STREAM_BATCHES:
+        depth = max(2, batch)
+        # One warm stream per size on top of the AOT compiles: primes the
+        # pipeline threads and any remaining first-dispatch cost.
+        list(stream_blocks(feed(warm_blocks), k, depth=depth, batch=batch))
+        t0 = time.perf_counter()
+        for _tag, eds in stream_blocks(feed(blocks), k, depth=depth,
+                                       batch=batch):
+            eds.data_root()  # host sync per block, as a server would
+        out[batch] = (time.perf_counter() - t0) / n
+    return out
+
+
 # --------------------------------------------------------------------------
 # child: run stages, append a JSON line per completed stage
 # --------------------------------------------------------------------------
@@ -775,6 +829,27 @@ def _run_child() -> None:
                 "loadavg": round(la, 2),
                 "platform": platform,
             })
+            if mode == "stream":
+                # The continuous-batching rows ride the stream stage:
+                # blocks/sec at batch ∈ STREAM_BATCHES coalesced same-k
+                # squares per dispatch.  One row per size (mode
+                # stream_b<N>), rate-shaped like every other row so
+                # bench_trend gates them as a series; batch-1 is the
+                # unbatched control the coalesced sizes are judged
+                # against (batch-B seconds/block < batch-1 means B
+                # squares in one dispatch cost less than B dispatches).
+                t_b = time.monotonic()
+                for batch, bsecs in _stream_batched_seconds(ods, iters).items():
+                    emit({
+                        "stage": f"stream_b{batch}@{k}",
+                        "mode": f"stream_b{batch}", "k": k, "batch": batch,
+                        "seconds_per_block": bsecs, "mb": ods_mb,
+                        "mb_per_s": round(ods_mb / bsecs, 3),
+                        "blocks_per_s": round(1.0 / bsecs, 3),
+                        "wall_s": round(time.monotonic() - t_b, 1),
+                        "loadavg": round(la, 2),
+                        "platform": platform,
+                    })
         except Exception as e:  # noqa: BLE001 — record and move on
             emit({"stage": name, "error": f"{type(e).__name__}: {e}"[:500]})
         gc.collect()  # release the stage's device buffers before the next

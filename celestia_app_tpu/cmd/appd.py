@@ -208,12 +208,21 @@ def cmd_start(args) -> int:
     app = load_app(args.home, node_min_gas_price=min_gas)
     if args.warmup != "none":
         from celestia_app_tpu.da.eds import warmup
+        from celestia_app_tpu.parallel.pipeline import env_batch_cap
 
         upto = app.max_effective_square_size()
         sizes = [1, upto] if args.warmup == "minimal" else None
+        # A server running with $CELESTIA_PIPE_BATCH=B (or =auto, whose
+        # ceiling is the auto batch) also warms the coalesced-dispatch
+        # programs up to that cap, so the dispatcher's first batched
+        # block never pays a compile on the block path.
+        batch_cap = env_batch_cap()
+        batches = tuple(range(2, batch_cap + 1)) if batch_cap > 1 else ()
         t0 = time.time()
-        warmed = warmup(square_sizes=sizes, upto=None if sizes else upto)
-        print(f"warmed square sizes {warmed} in {time.time() - t0:.1f}s",
+        warmed = warmup(square_sizes=sizes, upto=None if sizes else upto,
+                        batches=batches)
+        print(f"warmed square sizes {warmed} in {time.time() - t0:.1f}s"
+              + (f" (incl. batch sizes {list(batches)})" if batches else ""),
               flush=True)
     node = None
     peers = [u for u in (getattr(args, "peers", "") or "").split(",") if u]

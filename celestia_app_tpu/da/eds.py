@@ -176,10 +176,229 @@ def _owned_input_pipeline(k: int, construction: str | None = None):
     return _pipeline_for_mode(pipeline_mode(), k, construction, owned=True)
 
 
+# --- batched (multi-square) pipeline ----------------------------------------
+
+
+@lru_cache(maxsize=None)
+def _jit_pipeline_batched(k: int, construction: str, batch: int):
+    """vmap of the STAGED composition over a (batch, k, k, S) stack — the
+    batched twin of _jit_pipeline, the ladder rung batched dispatch falls
+    to when the fused family is degraded."""
+    from celestia_app_tpu.trace.journal import note_jit_build
+
+    note_jit_build("staged_pipeline_batched")
+    return jax.jit(jax.vmap(_pipeline(k, construction)))
+
+
+def _host_pipeline_batched(k: int, construction: str):
+    """The batched degradation floor: each square through the eager host
+    pipeline one by one (no compiled program at all), outputs stacked to
+    the batched shape.  Exactly what "the unbatched rung" means at the
+    bottom of the ladder."""
+    run_one = _host_pipeline(k, construction)
+
+    def run(odss):
+        outs = [run_one(odss[b]) for b in range(odss.shape[0])]
+        return tuple(
+            jnp.stack([o[i] for o in outs]) for i in range(4)
+        )
+
+    return run
+
+
+def _batched_pipeline_for_mode(
+    mode: str, k: int, batch: int, construction: str | None = None,
+    *, owned: bool = False,
+):
+    """The batched pipeline callable for an EXPLICIT mode: f(odss) with
+    odss (batch, k, k, S) -> (eds, row_roots, col_roots, droots), each
+    output carrying the leading batch axis.  Keyed per (k, batch, mode)
+    through the underlying jit caches; fused_epi folds into the fused
+    batched program (the epilogue tile schedule is per-square — see
+    kernels/fused.py) so the ladder's batched modes are fused / staged /
+    host."""
+    from celestia_app_tpu.kernels.fused import jit_extend_and_dah_batched
+
+    construction = construction or active_construction()
+    if mode in ("fused", "fused_epi"):
+        return jit_extend_and_dah_batched(
+            k, batch, construction, donate=owned
+        )
+    if mode == "host":
+        return _host_pipeline_batched(k, construction)
+    return _jit_pipeline_batched(k, construction, batch)
+
+
+def jit_pipeline_batched(k: int, batch: int, construction: str | None = None):
+    """Cached batched pipeline for the ACTIVE mode — the multi-square
+    analog of jit_pipeline.  Non-donating; the BlockPipeline dispatcher
+    (which owns its uploads) resolves owned=True via
+    _batched_pipeline_for_mode directly."""
+    from celestia_app_tpu.kernels.fused import pipeline_mode
+
+    return _batched_pipeline_for_mode(
+        pipeline_mode(), k, batch, construction, owned=False
+    )
+
+
+# --- speculative extend ------------------------------------------------------
+#
+# $CELESTIA_PIPE_SPECULATE=on arms cross-height speculation: a caller that
+# can SEE the next proposal early (a proposer assembling height h+1 while
+# height h is still gathering precommits) starts its extend+DAH dispatch
+# ahead of adoption and the eventual compute() claims the in-flight result
+# instead of dispatching again.  Correctness-free by construction: a claim
+# only hits when the claimed ODS bytes (and RS construction) are EXACTLY
+# what was speculated — a round change that re-proposes different content
+# digests differently and the entry is discarded, costing one wasted
+# dispatch and nothing else.  Every lowering is bit-identical (the chaos
+# ladder's standing proof), so even a ladder step between speculate and
+# claim cannot change a byte.
+
+
+def speculation_enabled() -> bool:
+    """$CELESTIA_PIPE_SPECULATE: "on"/"1" arms the speculative-extend
+    seam (default off — speculation trades wasted dispatches for
+    latency, a choice the operator makes)."""
+    import os
+
+    return os.environ.get("CELESTIA_PIPE_SPECULATE", "").lower() in (
+        "on", "1", "true",
+    )
+
+
+def _speculation_counter():
+    from celestia_app_tpu.trace.metrics import registry
+
+    return registry().counter(
+        "celestia_speculation_total",
+        "speculative extends by outcome: hit (claimed) / discard "
+        "(content or construction changed before adoption, e.g. a round "
+        "change re-proposed the square)",
+    )
+
+
+class SpeculativeExtender:
+    """One in-flight speculative extend (the next proposal's square).
+
+    `speculate()` digests the candidate ODS, dispatches the owned-input
+    pipeline asynchronously (JAX dispatch is an async enqueue — this
+    returns as soon as the program is queued), and parks the device
+    handles.  `claim()` returns the finished ExtendedDataSquare iff the
+    claimed bytes match the speculated digest; any mismatch — a round
+    change, a construction flip — discards the entry and the caller
+    computes normally.  `discard()` is the explicit round-change hook.
+
+    Holds at most ONE entry: speculation is about the block after the one
+    in consensus, and a second speculate() before the first resolves
+    replaces (and counts as discarding) the stale one.
+    """
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._entry: dict | None = None
+
+    @staticmethod
+    def _digest(ods: np.ndarray) -> bytes:
+        import hashlib
+
+        return hashlib.sha256(np.ascontiguousarray(ods).tobytes()).digest()
+
+    def speculate(
+        self,
+        ods: np.ndarray,
+        *,
+        height: int | None = None,
+        round_: int | None = None,
+        construction: str | None = None,
+    ) -> bool:
+        """Start extending `ods` ahead of adoption; False when the seam
+        is off (callers need no second gate).  Rides guarded_dispatch so
+        a speculative fault walks the same retry/ladder path a real
+        dispatch would — and can never raise into the consensus loop that
+        merely HOPED to save latency."""
+        if not speculation_enabled():
+            return False
+        from celestia_app_tpu.chaos.degrade import guarded_dispatch
+
+        k = ods.shape[0]
+        construction = construction or active_construction()
+        digest = self._digest(ods)
+        try:
+            x = jnp.asarray(ods, dtype=jnp.uint8)
+            mode, out = guarded_dispatch(
+                lambda m: _pipeline_for_mode(m, k, construction, owned=True),
+                x,
+                refresh=lambda: jnp.asarray(ods, dtype=jnp.uint8),
+            )
+        except Exception:  # chaos-ok: speculation is best-effort by contract
+            return False
+        with self._lock:
+            if self._entry is not None:
+                _speculation_counter().inc(outcome="discard")
+            self._entry = {
+                "digest": digest, "height": height, "round": round_,
+                "k": k, "construction": construction, "mode": mode,
+                "outputs": out,
+            }
+        return True
+
+    def claim(
+        self, ods: np.ndarray, construction: str | None = None
+    ) -> tuple["ExtendedDataSquare", str] | None:
+        """(eds, mode) when the in-flight speculation is EXACTLY the
+        square being adopted (bytes + construction), else None — with the
+        mismatched entry discarded (the round-change outcome)."""
+        with self._lock:
+            entry, self._entry = self._entry, None
+        if entry is None:
+            return None
+        construction = construction or active_construction()
+        if (
+            entry["k"] != ods.shape[0]
+            or entry["construction"] != construction
+            or entry["digest"] != self._digest(ods)
+        ):
+            _speculation_counter().inc(outcome="discard")
+            return None
+        _speculation_counter().inc(outcome="hit")
+        eds, rr, cr, droot = entry["outputs"]
+        return (
+            ExtendedDataSquare(eds, rr, cr, droot, entry["k"]),
+            entry["mode"],
+        )
+
+    def discard(self) -> bool:
+        """Drop the in-flight entry (the explicit round-change signal);
+        True when there was one."""
+        with self._lock:
+            entry, self._entry = self._entry, None
+        if entry is None:
+            return False
+        _speculation_counter().inc(outcome="discard")
+        return True
+
+    def pending(self) -> bool:
+        with self._lock:
+            return self._entry is not None
+
+
+_SPECULATOR = SpeculativeExtender()
+
+
+def speculator() -> SpeculativeExtender:
+    """The process-wide speculative extender (one in-flight next-block
+    speculation per process, like the consensus loop it serves)."""
+    return _SPECULATOR
+
+
 def warmup(
     square_sizes: list[int] | None = None,
     upto: int | None = None,
     constructions: tuple[str, ...] | None = None,
+    batches: tuple[int, ...] = (),
 ) -> list[int]:
     """AOT-compile the fused pipeline for the given square sizes.
 
@@ -192,6 +411,11 @@ def warmup(
     Only the given `constructions` (default: the active one) are warmed —
     flipping $CELESTIA_RS_CONSTRUCTION after warmup puts the next block's
     compile back on the critical path unless the flip target was listed.
+
+    `batches` additionally warms the batched (vmap'd multi-square)
+    programs at those coalesced sizes — a server running with
+    $CELESTIA_PIPE_BATCH=B should warm batches=tuple(range(2, B+1)) so
+    the dispatcher's first coalesced dispatch never pays a compile.
     """
     if square_sizes is None:
         assert upto is not None, "pass square_sizes or upto"
@@ -224,6 +448,23 @@ def warmup(
                 construction=construction,
                 warm_ms=(time.perf_counter() - t0) * 1e3,
             )
+            for batch in batches:
+                if batch < 2:
+                    continue  # batch-1 dispatch rides the unbatched entry
+                t0 = time.perf_counter()
+                stack = jnp.asarray(
+                    np.zeros((batch, k, k, SHARE_SIZE), dtype=np.uint8)
+                )
+                jax.block_until_ready(
+                    _batched_pipeline_for_mode(
+                        pipeline_mode(), k, batch, construction, owned=True
+                    )(stack)
+                )
+                journal.record(
+                    "warmup", k, mode=pipeline_mode(), compile=state,
+                    construction=construction, batch_size=batch,
+                    warm_ms=(time.perf_counter() - t0) * 1e3,
+                )
     return list(square_sizes)
 
 
@@ -342,6 +583,11 @@ class ExtendedDataSquare:
         # host hashing entirely.
         self._tree_memo: dict = {}
         self._forest = None  # set by serve/cache.ForestCache.put
+        # Retention listener: the continuous pipeline's buffer ring hooks
+        # this (parallel/pipeline._BufferRing.pin via attach_forest) so a
+        # serve-cache retention PINS the ring slot that fed this square —
+        # a recycled donated buffer must never alias a retained EDS.
+        self._retain_cb = None
 
     def attach_forest(self, forest) -> None:
         """Hook the retained device forest onto this handle so every
@@ -349,6 +595,9 @@ class ExtendedDataSquare:
         re-hashing rows the device already hashed."""
         self._forest = forest
         self._tree_memo.clear()  # forest-backed trees are strictly better
+        cb = self._retain_cb
+        if cb is not None:
+            cb()  # tell the feeding buffer ring this square is retained
 
     def leaf_namespace(self, row: int, col: int) -> bytes:
         """The namespace the (row, col) EDS leaf carries in its trees:
@@ -425,6 +674,28 @@ class ExtendedDataSquare:
         if k & (k - 1) or not 1 <= k <= MAX_CODEC_SQUARE_SIZE:
             raise ValueError(f"invalid square size {k}")
         assert ods.shape == (k, k, SHARE_SIZE), ods.shape
+        spec_outcome = None
+        if speculation_enabled() and _SPECULATOR.pending():
+            claimed = _SPECULATOR.claim(np.asarray(ods), construction)
+            if claimed is not None:
+                # The dispatch already ran at speculate() time; this call
+                # pays a content digest and nothing else.  compile="hit"
+                # by construction (speculate built the program).
+                eds_obj, spec_mode = claimed
+                journal.record(
+                    "compute", k, mode=spec_mode, compile="hit",
+                    speculation="hit",
+                )
+                _maybe_parity_check(
+                    np.asarray(ods), k,
+                    construction or active_construction(),
+                    eds_obj._data_root,
+                )
+                return eds_obj
+            # A pending entry that did not match IS the round-change
+            # outcome: the square was re-proposed with different bytes
+            # and the wasted dispatch is discarded, never served.
+            spec_outcome = "discard"
         sentinel_input = None  # a buffer still valid AFTER the dispatch
         if isinstance(ods, jax.Array):
             # jnp.asarray is a no-copy pass-through for a device array, so
@@ -440,6 +711,7 @@ class ExtendedDataSquare:
             journal.record(
                 "compute", k, mode=mode, compile=state,
                 dispatch_ms=(time.perf_counter() - t0) * 1e3,
+                **({"speculation": spec_outcome} if spec_outcome else {}),
             )
             sentinel_input = ods  # undonated: still live and immutable
         else:
@@ -460,6 +732,7 @@ class ExtendedDataSquare:
                 "compute", k, mode=mode, compile=state,
                 upload_ms=(t1 - t0) * 1e3,
                 dispatch_ms=(time.perf_counter() - t1) * 1e3,
+                **({"speculation": spec_outcome} if spec_outcome else {}),
             )
             sentinel_input = ods  # the host copy (x may be donated away)
         _maybe_parity_check(

@@ -250,6 +250,84 @@ def jit_extend_and_dah(
     )
 
 
+# --- batched (vmap'd) multi-square dispatch ---------------------------------
+#
+# The cross-height continuous-batching leg (parallel/pipeline.py): when
+# traffic produces many small same-k squares, B of them dispatch as ONE
+# vmapped program over a (B, k, k, S) stack instead of paying B dispatch
+# round-trips.  Its own compile-cache family, keyed per (k, construction,
+# batch, donate, roots_only) — a batch of 4 k=128 squares is a different
+# executable than 4 singles, and the journal's hit/miss column must say
+# which one a dispatch paid for.
+#
+# Sharding contract (SNIPPETS.md pjit notes): the batched program takes no
+# explicit in/out_shardings — outputs inherit the committed sharding of the
+# batched input, so the (B, ...) layout one height's dispatch produces is
+# exactly the layout the next height's dispatch consumes and batches never
+# reshard between heights.  (On this image's single CPU device that is
+# trivially true; on a mesh the batch axis stays wherever the uploader
+# committed it.)
+#
+# The fused_epi seat deliberately folds into the plain fused body here: the
+# leaf-hash epilogue is a per-square VMEM tile schedule (kernels/rs_xor),
+# and vmapping a Pallas kernel is its own lowering project — all modes are
+# bit-identical, so the batched program uses the one fused body and the
+# ladder's epi/fused distinction stays an UNBATCHED perf detail.
+
+_BATCHED_BUILT: set[tuple] = set()
+
+
+def batched_is_built(
+    k: int,
+    batch: int,
+    construction: str | None = None,
+    *,
+    donate: bool = False,
+    roots_only: bool = False,
+) -> bool:
+    key = (k, construction or active_construction(), batch, donate,
+           roots_only)
+    return key in _BATCHED_BUILT
+
+
+@lru_cache(maxsize=None)
+def _jit_extend_and_dah_batched(
+    k: int, construction: str, batch: int, donate: bool, roots_only: bool
+):
+    if donate:
+        _silence_unusable_donation_warning()
+    _BATCHED_BUILT.add((k, construction, batch, donate, roots_only))
+    from celestia_app_tpu.trace.journal import note_jit_build
+
+    note_jit_build("extend_and_dah_batched")
+    return jax.jit(
+        jax.vmap(extend_and_dah_fn(k, construction, roots_only)),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def jit_extend_and_dah_batched(
+    k: int,
+    batch: int,
+    construction: str | None = None,
+    *,
+    donate: bool = False,
+    roots_only: bool = False,
+):
+    """Cached vmapped fused pipeline: f(odss) with odss (batch, k, k, S)
+    uint8 -> (eds (batch,2k,2k,S), row_roots (batch,2k,90), col_roots,
+    droots (batch,32)) — every square computed exactly as the unbatched
+    fused program computes it (pinned bit-identical by
+    tests/test_continuous_batching.py).  `batch` is part of the cache key:
+    the dispatcher compiles one executable per coalesced size it actually
+    sees."""
+    if batch < 1:
+        raise ValueError(f"batch must be >= 1, got {batch}")
+    return _jit_extend_and_dah_batched(
+        k, construction or active_construction(), batch, donate, roots_only
+    )
+
+
 # --- forest retention (the serve plane's read side) -------------------------
 #
 # The block-path program above materializes every NMT level on device and

@@ -21,14 +21,42 @@ Three overlaps compose here:
     device a dispatch round-trip is milliseconds of dead link time per
     block that the split reclaims.
 
+Cross-height continuous batching (this file's third era) adds three legs:
+
+  * persistent donated buffers: a small ring of staging buffers
+    (`_BufferRing`, depth+1 slots) is allocated ONCE and recycled across
+    blocks — the uploader copies height h+1's shares into a free slot
+    while height h is still dispatching, instead of allocating a fresh
+    contiguous buffer per height.  A slot is recycled only after its
+    batch's drain sync confirms the device consumed it, and a slot whose
+    square the serve plane RETAINED (serve/cache.ForestCache — donation
+    may alias the upload into the retained EDS) is pinned: the next
+    acquire swaps in a fresh backing buffer instead of overwriting bytes
+    a proof plane may still be serving.
+  * vmap'd multi-square dispatch: with `$CELESTIA_PIPE_BATCH` > 1 (or
+    `auto`, driven by the square journal's occupancy signal) the uploader
+    coalesces queued same-k squares into one (B, k, k, S) staging slot
+    and the dispatcher runs ONE vmapped fused program
+    (da/eds._batched_pipeline_for_mode) instead of paying B dispatch
+    latencies.  A batched-dispatch fault degrades to per-square dispatch
+    through the normal guarded ladder (batched -> unbatched fused ->
+    staged -> host), ticking celestia_recoveries_total{outcome=unbatched}.
+  * speculative extend lives in da/eds.SpeculativeExtender
+    ($CELESTIA_PIPE_SPECULATE): the consensus loop can start extending
+    the NEXT proposal while the current height is still voting, and
+    compute() claims the in-flight result on a content match (discarding
+    on round change — every lowering is bit-identical, so speculation is
+    a pure latency trade).
+
 Every drained block writes one `block_journal` row (trace/journal.py):
 upload/dispatch/drain ms plus the two queue stalls (uploader blocked on
-the depth-bounded hand-off, dispatcher starved of staged uploads), all
-host perf_counter deltas around calls the pipeline already makes — the
-only device sync remains the drain's existing block_until_ready.
+the depth-bounded hand-off, dispatcher starved of staged uploads) and the
+dispatch's `batch_size`, all host perf_counter deltas around calls the
+pipeline already makes — the only device sync remains the drain's
+existing block_until_ready.
 
 `BlockPipeline` bounds in-flight blocks (double buffering by default) so
-HBM holds at most `depth` extended squares.  When the fused lowering is
+HBM holds at most `depth` extended batches.  When the fused lowering is
 active (kernels/fused.pipeline_mode), each uploaded ODS buffer is DONATED
 to its dispatch — the pipeline owns the upload, nothing re-reads it, and
 XLA may reuse it as extension scratch, which is what keeps depth>1
@@ -37,6 +65,7 @@ affordable at k=512 (one 134 MB scratch saved per in-flight block).
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -45,8 +74,10 @@ from dataclasses import dataclass, field
 import jax
 import numpy as np
 
+from celestia_app_tpu.constants import SHARE_SIZE
 from celestia_app_tpu.da.eds import (
     ExtendedDataSquare,
+    _batched_pipeline_for_mode,
     _pipeline_for_mode,
     pipeline_cache_state,
 )
@@ -67,6 +98,53 @@ _POLL_S = 0.1
 #: slow-but-healthy case), short enough that an abandoned process isn't
 #: parked behind a dead device forever.
 _CLOSE_STALL_S = 60.0
+#: The coalescing ceiling $CELESTIA_PIPE_BATCH=auto resolves to when the
+#: square journal's occupancy signal says traffic is producing small,
+#: under-filled squares (the regime where dispatch latency dominates).
+_AUTO_BATCH = 4
+#: Occupancy below which `auto` batching engages: a square less than half
+#: full at the current k means the proposer is cutting small squares.
+_AUTO_OCCUPANCY = 0.5
+
+
+def env_batch() -> int:
+    """$CELESTIA_PIPE_BATCH: how many queued same-k squares one dispatch
+    may coalesce.  ""/unset/"0"/"1" = off (every square its own
+    dispatch); an integer N > 1 = coalesce up to N; "auto" = consult the
+    square journal's occupancy signal — when the last exported square ran
+    under 50% occupancy (0.0, an empty square, very much included),
+    traffic is producing many small squares and the dispatcher batches up
+    to 4, otherwise it stays unbatched."""
+    val = os.environ.get("CELESTIA_PIPE_BATCH", "").strip().lower()
+    if val in ("", "0", "1", "off"):
+        return 1
+    if val == "auto":
+        from celestia_app_tpu.trace.square_journal import last_square
+
+        last = last_square()
+        if last is None:
+            return 1  # no traffic signal yet: stay unbatched
+        occupancy = last.get("occupancy")
+        if occupancy is not None and occupancy < _AUTO_OCCUPANCY:
+            return _AUTO_BATCH
+        return 1
+    try:
+        return max(1, int(val))
+    except ValueError:
+        return 1
+
+
+def env_batch_cap() -> int:
+    """The CEILING $CELESTIA_PIPE_BATCH may ever resolve to — what a
+    server's warmup must compile for.  Unlike env_batch() this ignores
+    the instantaneous occupancy signal: "auto" at startup sees no
+    traffic and env_batch() says 1, but the moment small squares arrive
+    it will say _AUTO_BATCH, and THAT first coalesced dispatch must not
+    pay a compile on the block path."""
+    val = os.environ.get("CELESTIA_PIPE_BATCH", "").strip().lower()
+    if val == "auto":
+        return _AUTO_BATCH
+    return env_batch()
 
 
 def _queue_depth_gauge():
@@ -87,23 +165,167 @@ def _close_leak_counter():
     )
 
 
+def _ring_occupancy_gauge():
+    from celestia_app_tpu.trace.metrics import registry
+
+    return registry().gauge(
+        "celestia_pipeline_ring_occupancy",
+        "buffer-ring slots by state (free / in_use / pinned-for-swap)",
+    )
+
+
+def _batch_size_histogram():
+    from celestia_app_tpu.trace.metrics import registry
+
+    return registry().histogram(
+        "celestia_pipeline_batch_size",
+        "same-k squares coalesced into one pipeline dispatch",
+        buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0),
+    )
+
+
+class _BufferRing:
+    """Persistent staging buffers recycled across blocks.
+
+    `slots` host arrays of shape (batch, k, k, SHARE_SIZE), allocated once
+    at pipeline construction: the uploader copies each height's shares
+    into a free slot (a memcpy into memory the allocator already owns —
+    no per-height allocation, and on pinned-memory backends the transfer
+    engine reads straight out of it) and `device_put`s the filled rows.
+
+    Recycling contract:
+
+      * a slot frees only when its batch's DRAIN confirmed the device
+        consumed the upload (`release` after the batch's last
+        block_until_ready) — `device_put` may be zero-copy on CPU, so
+        overwriting a slot whose program hasn't executed yet would
+        corrupt an in-flight square;
+      * a slot whose square was RETAINED by the serve plane
+        (ForestCache.put -> eds.attach_forest -> `pin`) is never
+        overwritten while pinned: the next `acquire` of a pinned slot
+        swaps in a FRESH backing array (write-after-retain is a fresh
+        slot) and the old buffer lives exactly as long as the retained
+        square does.
+
+    Why the pin is belt-and-braces rather than load-bearing today: the
+    retained EDS holds program OUTPUTS, and XLA only aliases an input
+    buffer into an output via donation — which it refuses for buffers it
+    does not own.  A zero-copy `device_put` (CPU) leaves the buffer
+    externally owned, so donation is "not usable" there (the filtered
+    warning), and a copying `device_put` (TPU) means the device buffer
+    is jax-owned HBM that never references these staging bytes.  Either
+    way no current backend can make a retained EDS alias a ring slot.
+    The pin exists for a future unified-memory backend where that
+    reasoning breaks — and because retention (at commit) can land after
+    the drain already released the slot, `pin` takes the slot GENERATION
+    its square was staged under: a pin that arrives after the slot was
+    re-acquired is counted on `late_pins` (the fence fired after the
+    window on a hypothetical aliasing backend — observable, not silent)
+    and still pins forward.
+    """
+
+    def __init__(self, k: int, slots: int, batch: int):
+        self.k = k
+        self.batch = batch
+        self._cond = threading.Condition()
+        self._hosts = [
+            np.zeros((batch, k, k, SHARE_SIZE), dtype=np.uint8)
+            for _ in range(slots)
+        ]
+        self._free: list[int] = list(range(slots))
+        self._pinned: set[int] = set()
+        self._gen = [0] * slots  # bumped per acquire: late-pin detection
+        self.swaps = 0  # pinned slots replaced with a fresh buffer
+        self.late_pins = 0  # pins that arrived after the slot was reused
+
+    def acquire(self, timeout_s: float) -> int | None:
+        """A free slot id (its buffer safe to overwrite), or None on
+        timeout so the caller can re-check liveness.  A pinned slot is
+        swapped for a fresh buffer here — the retained square keeps the
+        old bytes for its own lifetime."""
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while not self._free:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._cond.wait(remaining)
+            sid = self._free.pop()
+            if sid in self._pinned:
+                self._hosts[sid] = np.zeros_like(self._hosts[sid])
+                self._pinned.discard(sid)
+                self.swaps += 1
+            self._gen[sid] += 1
+            return sid
+
+    def generation(self, sid: int) -> int:
+        with self._cond:
+            return self._gen[sid]
+
+    def host(self, sid: int) -> np.ndarray:
+        return self._hosts[sid]
+
+    def release(self, sid: int) -> None:
+        with self._cond:
+            self._free.append(sid)
+            self._cond.notify()
+
+    def pin(self, sid: int, gen: int | None = None) -> None:
+        """Mark a slot's current buffer as retained downstream: it will
+        be swapped, not overwritten, on its next acquire.  `gen` is the
+        generation the retained square was staged under (see the class
+        docstring): a pin landing after the slot was already re-acquired
+        is counted on `late_pins` — on every current backend that is
+        harmless (outputs never alias staging bytes), and counting it
+        keeps the fence's coverage observable instead of silently
+        assumed."""
+        with self._cond:
+            if gen is not None and self._gen[sid] != gen:
+                self.late_pins += 1
+            self._pinned.add(sid)
+
+    def states(self) -> dict[str, int]:
+        with self._cond:
+            free = len(self._free)
+            pinned = len(self._pinned)
+        return {
+            "free": free,
+            "in_use": len(self._hosts) - free,
+            "pinned": pinned,
+        }
+
+
 @dataclass
 class _InFlight:
     tag: object
     outputs: tuple  # (eds, row_roots, col_roots, droot) device arrays
     k: int
     meta: dict = field(default_factory=dict)  # stage timings for the journal
+    mode: str | None = None  # the lowering THIS square actually ran
+    slot: tuple | None = None  # (ring, sid, refcount-list, generation)
+
+    def release_slot(self) -> None:
+        if self.slot is None:
+            return
+        ring, sid, ref, _gen = self.slot
+        ref[0] -= 1
+        if ref[0] == 0:
+            ring.release(sid)
+        self.slot = None
 
 
 class BlockPipeline:
     """Bounded-depth asynchronous square pipeline with a transfer uploader
-    and a separate dispatcher (double-buffered upload/compute overlap)."""
+    and a separate dispatcher (double-buffered upload/compute overlap),
+    optionally coalescing queued same-k squares into one vmapped dispatch
+    (`batch` / $CELESTIA_PIPE_BATCH)."""
 
-    def __init__(self, k: int, depth: int = 2):
+    def __init__(self, k: int, depth: int = 2, batch: int | None = None):
         if depth < 1:
             raise ValueError("depth must be >= 1")
         self.k = k
         self.depth = depth
+        self.batch = max(1, batch if batch is not None else env_batch())
         # A pipeline is bound to the RS construction active at creation:
         # every block it streams uses this one generator, even if
         # $CELESTIA_RS_CONSTRUCTION flips while blocks are in flight.
@@ -128,17 +350,21 @@ class BlockPipeline:
         self._pipe = _pipeline_for_mode(
             self._mode, k, self.construction, owned=True
         )
-        # submit -> _tasks -> [uploader: device_put] -> _staged
+        # One persistent staging buffer per in-flight batch plus one being
+        # filled: the uploader writes height h+1 into a free slot while
+        # height h is still dispatching, and nothing allocates per block.
+        self._ring = _BufferRing(k, slots=depth + 1, batch=self.batch)
+        # submit -> _tasks -> [uploader: stage + device_put] -> _staged
         #        -> [dispatcher: program dispatch] -> _done
-        # _tasks/_done bounded by depth: at most `depth` squares in flight
-        # on the device and `depth` host buffers waiting to transfer.
+        # _tasks/_done bounded by depth: at most `depth` batches in flight
+        # on the device and `depth` host squares waiting to transfer.
         # _staged is a SINGLE-slot hand-off — dispatch is a cheap async
-        # enqueue, so one transferred-but-undispatched ODS is all the
+        # enqueue, so one transferred-but-undispatched batch is all the
         # overlap needs, and the device high-water mark stays at the
-        # documented `depth` squares instead of depth + staged uploads.
-        self._tasks: queue.Queue = queue.Queue(maxsize=depth)
+        # documented `depth` batches instead of depth + staged uploads.
+        self._tasks: queue.Queue = queue.Queue(maxsize=max(depth, self.batch))
         self._staged: queue.Queue = queue.Queue(maxsize=1)
-        self._done: queue.Queue = queue.Queue(maxsize=depth)
+        self._done: queue.Queue = queue.Queue(maxsize=depth * self.batch)
         self._error: BaseException | None = None
         self._stopping = False
         self._closed = False
@@ -163,6 +389,25 @@ class BlockPipeline:
             self._force_sentinel(self._staged)
             self._note_death("uploader", e)
 
+    def _coalesce(self, first) -> tuple[list, bool]:
+        """Greedy non-blocking batch fill: `first` plus up to batch-1 more
+        queued tasks — the moment the intake runs dry the batch closes
+        (the occupancy signal: coalescing trades nothing for latency, it
+        only merges dispatches that were ALREADY queued behind each
+        other).  Returns (items, sentinel_seen)."""
+        items = [first]
+        sentinel_seen = False
+        while len(items) < self.batch:
+            try:
+                nxt = self._tasks.get_nowait()
+            except queue.Empty:
+                break
+            if nxt is _SENTINEL:
+                sentinel_seen = True
+                break
+            items.append(nxt)
+        return items, sentinel_seen
+
     def _upload_loop(self) -> None:
         from celestia_app_tpu import chaos
         from celestia_app_tpu.chaos.degrade import recoveries
@@ -175,13 +420,38 @@ class BlockPipeline:
                 return
             if failed or self._stopping:
                 continue  # keep consuming so no producer blocks forever
-            ods, tag = item
+            items, sentinel_seen = self._coalesce(item)
             try:
                 t0 = time.perf_counter()
+                # A free persistent slot (recycled from a drained batch);
+                # bounded waits so a stopping/dying pipeline never parks
+                # this thread on a ring nobody will drain.  A close() in
+                # progress just discards the batch (dropping queued work
+                # is close()'s contract, not a death); a DEAD dispatcher
+                # is a real failure to propagate.
+                sid = None
+                while True:
+                    sid = self._ring.acquire(_POLL_S)
+                    if sid is not None or self._stopping:
+                        break
+                    if not self._dispatcher.is_alive():
+                        raise RuntimeError(
+                            "dispatcher died; no staging slot will free"
+                        )
+                if sid is None:  # stopping: discard, keep consuming
+                    if sentinel_seen:
+                        self._staged.put(_SENTINEL)
+                        return
+                    continue
+                host = self._ring.host(sid)
+                for i, (ods, _tag) in enumerate(items):
+                    np.copyto(host[i], ods)
                 for attempt in range(_UPLOAD_RETRIES + 1):
                     try:
                         chaos.device_upload()  # injected stall/failure
-                        x = jax.device_put(np.ascontiguousarray(ods))
+                        x = jax.device_put(
+                            host[0] if len(items) == 1 else host[: len(items)]
+                        )
                         break
                     except Exception:  # chaos-ok: bounded upload retry
                         if attempt == _UPLOAD_RETRIES:
@@ -197,18 +467,22 @@ class BlockPipeline:
                 failed = True
                 continue
             # Stage timings ride the hand-off in `meta`; the put-stall
-            # (uploader blocked because `depth` squares are already in
+            # (uploader blocked because `depth` batches are already in
             # flight downstream) is written the instant put() returns.
             # The consolidated journal row is built at drain time, a full
             # dispatch later, so the read always sees the value in
             # practice — and the row falls back to 0.0, never a missing
             # field, if this thread were descheduled that whole time.
-            # The host buffer rides along so a failed DONATED dispatch can
-            # re-upload (guarded_dispatch's refresh) — one extra reference
-            # per staged block, dropped the moment the dispatch lands.
+            # The slot id rides along so a failed DONATED dispatch can
+            # re-upload from the persistent staging bytes
+            # (guarded_dispatch's refresh) and the drain can recycle it.
             meta = {"upload_ms": (t1 - t0) * 1e3}
-            self._staged.put((x, tag, meta, ods))
+            tags = [tag for _ods, tag in items]
+            self._staged.put((x, tags, meta, sid))
             meta["upload_stall_ms"] = (time.perf_counter() - t1) * 1e3
+            if sentinel_seen:
+                self._staged.put(_SENTINEL)
+                return
 
     def _dispatch(self) -> None:
         try:
@@ -263,6 +537,45 @@ class BlockPipeline:
             self._pipe_mode = self._mode = mode
         return self._pipe
 
+    def _dispatch_batched(self, x, sid: int, n: int) -> list[tuple[str, tuple]]:
+        """One vmapped dispatch for n coalesced squares; any batched fault
+        falls down to n unbatched dispatches through the normal guarded
+        ladder (batched -> unbatched fused -> staged -> host), so a fault
+        in the batching machinery costs latency, never a block.  Returns
+        [(mode, (eds, rr, cr, droot)), ...] per square, in order."""
+        from celestia_app_tpu import chaos
+        from celestia_app_tpu.chaos.degrade import guarded_dispatch, recoveries
+        from celestia_app_tpu.kernels.fused import pipeline_mode
+
+        mode = pipeline_mode()
+        try:
+            chaos.device_dispatch(mode)
+            out = _batched_pipeline_for_mode(
+                mode, self.k, n, self.construction, owned=True
+            )(x)
+            ran = "fused" if mode == "fused_epi" else mode
+            return [
+                (ran, (out[0][b], out[1][b], out[2][b], out[3][b]))
+                for b in range(n)
+            ]
+        except Exception:  # chaos-ok: batched fault -> unbatched rung
+            recoveries().inc(seam="device.dispatch", outcome="unbatched")
+            host = self._ring.host(sid)
+            results = []
+            for b in range(n):
+                # The donated batch may be consumed; re-upload each square
+                # from the persistent staging bytes and ride the ladder.
+                xb = jax.device_put(host[b])
+                results.append(
+                    guarded_dispatch(
+                        self._resolve_pipe, xb,
+                        refresh=lambda b=b: jax.device_put(
+                            np.ascontiguousarray(host[b])
+                        ),
+                    )
+                )
+            return results
+
     def _dispatch_loop(self) -> None:
         from celestia_app_tpu.chaos.degrade import guarded_dispatch
 
@@ -275,26 +588,42 @@ class BlockPipeline:
                 self._done.put(_SENTINEL)
                 return
             if failed or self._stopping:
+                self._ring.release(item[3])  # keep the ring whole
                 continue
-            x, tag, meta, ods_host = item
+            x, tags, meta, sid = item
+            n = len(tags)
             try:
                 t1 = time.perf_counter()
                 # Async enqueue with retry + ladder fallback; no sync here.
-                _, out = guarded_dispatch(
-                    self._resolve_pipe, x,
-                    refresh=lambda: jax.device_put(
-                        np.ascontiguousarray(ods_host)
-                    ),
-                )
+                if n == 1:
+                    host = self._ring.host(sid)
+                    mode, out = guarded_dispatch(
+                        self._resolve_pipe, x,
+                        refresh=lambda: jax.device_put(
+                            np.ascontiguousarray(host[0])
+                        ),
+                    )
+                    per_square = [(mode, out)]
+                else:
+                    per_square = self._dispatch_batched(x, sid, n)
                 meta["dispatch_ms"] = (time.perf_counter() - t1) * 1e3
                 meta["dispatch_starve_ms"] = starve_ms
+                meta["batch_size"] = n
+                _batch_size_histogram().observe(float(n), k=str(self.k))
             except BaseException as e:  # chaos-ok: stored, surfaced on the next drain
                 self._error = e
+                self._ring.release(sid)
                 self._done.put(_SENTINEL)
                 self._note_death("dispatcher", e)
                 failed = True
                 continue
-            self._done.put(_InFlight(tag, out, self.k, meta))
+            ref = [n]  # the slot recycles when the whole batch drained
+            gen = self._ring.generation(sid)  # still held: stable here
+            for tag, (mode, out) in zip(tags, per_square):
+                self._done.put(_InFlight(
+                    tag, out, self.k, meta, mode=mode,
+                    slot=(self._ring, sid, ref, gen),
+                ))
 
     def _materialize(self, inflight: _InFlight) -> tuple[object, ExtendedDataSquare]:
         eds, rr, cr, droot = inflight.outputs
@@ -308,13 +637,15 @@ class BlockPipeline:
             # persistent fault steps the ladder for the blocks after it.
             from celestia_app_tpu.chaos.degrade import note_async_device_failure
 
+            inflight.release_slot()
             note_async_device_failure(self._mode)
             raise
         meta = inflight.meta
         journal.record(
-            "stream", inflight.k, mode=self._mode,
+            "stream", inflight.k, mode=inflight.mode or self._mode,
             compile=self._compile_state, tag=str(inflight.tag),
             depth=self.depth,
+            batch_size=meta.get("batch_size", 1),
             upload_ms=meta.get("upload_ms", 0.0),
             upload_stall_ms=meta.get("upload_stall_ms", 0.0),
             dispatch_ms=meta.get("dispatch_ms", 0.0),
@@ -322,11 +653,23 @@ class BlockPipeline:
             drain_ms=(time.perf_counter() - t0) * 1e3,
         )
         self._compile_state = "hit"  # paid (or confirmed) on the first row
+        result = ExtendedDataSquare(eds, rr, cr, droot, inflight.k)
+        if inflight.slot is not None:
+            # Serve-plane retention (ForestCache.put -> attach_forest)
+            # pins the feeding slot: its buffer is swapped, not recycled.
+            # The staged-under generation rides along so a pin landing
+            # after the slot's next acquire is detected (ring.late_pins).
+            ring, sid, _ref, gen = inflight.slot
+            result._retain_cb = lambda: ring.pin(sid, gen)
+        inflight.release_slot()
         gauge = _queue_depth_gauge()
         for name, q in (("tasks", self._tasks), ("staged", self._staged),
                         ("done", self._done)):
             gauge.set(q.qsize(), queue=name)
-        return inflight.tag, ExtendedDataSquare(eds, rr, cr, droot, inflight.k)
+        ring_gauge = _ring_occupancy_gauge()
+        for state, count in self._ring.states().items():
+            ring_gauge.set(count, state=state)
+        return inflight.tag, result
 
     def _raise_worker_death(self, stage: str) -> None:
         err = self._error
@@ -337,7 +680,7 @@ class BlockPipeline:
 
     def submit(self, ods: np.ndarray, tag: object = None,
                timeout_s: float | None = None) -> None:
-        """Enqueue one block; blocks the host only when `depth` squares are
+        """Enqueue one block; blocks the host only when `depth` batches are
         already in flight (back-pressure).
 
         Deadline-aware: the bounded put wakes periodically to check the
@@ -428,7 +771,7 @@ class BlockPipeline:
         Keyed on _finished, NOT _closed: abandoning a drain() mid-stream
         leaves _closed set with results still queued, and an early return
         there would strand the dispatcher blocked on a full _done holding
-        `depth` extended squares for the process lifetime.
+        `depth` extended batches for the process lifetime.
 
         Worker death is REPORTED, never swallowed: a stage that outlives
         its join timeout (a genuine wedge — the error-propagation paths
@@ -465,6 +808,8 @@ class BlockPipeline:
                 continue
             if item is _SENTINEL:
                 break
+            if isinstance(item, _InFlight):
+                item.release_slot()  # keep the ring whole for the workers
             deadline = time.monotonic() + _CLOSE_STALL_S  # progress: re-arm
         self._finished = True
         self._uploader.join(timeout=5)
@@ -479,22 +824,27 @@ class BlockPipeline:
                 _close_leak_counter().inc(stage=stage)
 
 
-def stream_blocks(ods_iter, k: int, depth: int = 2):
+def stream_blocks(ods_iter, k: int, depth: int = 2, batch: int | None = None):
     """Stream squares through the device with `depth`-deep overlap.
 
     Yields (tag, ExtendedDataSquare) in submission order; with depth=2 the
     uploader transfers block i+1 while the device computes block i and the
     caller consumes block i-1 (the v5e-4 double-buffering shape of
-    BASELINE config 5).  Abandoning the generator early stops the stages
-    and releases in-flight device buffers."""
-    pipe = BlockPipeline(k, depth)
+    BASELINE config 5).  `batch` (default $CELESTIA_PIPE_BATCH) lets the
+    dispatcher coalesce queued same-k squares into one vmapped dispatch.
+    Abandoning the generator early stops the stages and releases in-flight
+    device buffers."""
+    pipe = BlockPipeline(k, depth, batch=batch)
     finished = False
     try:
         submitted = drained = 0
+        window = max(depth, pipe.batch)
         for tag, ods in ods_iter:
             # Keep the intake primed without over-filling HBM: drain once
-            # we have more than `depth` submissions outstanding.
-            while submitted - drained > depth:
+            # we have more than a window of submissions outstanding (the
+            # window widens with the batch so coalescing has squares to
+            # merge).
+            while submitted - drained > window:
                 yield pipe._drain_one()
                 drained += 1
             pipe.submit(ods, tag)

@@ -182,7 +182,14 @@ class ForestCache:
         """Retain one height: build the forest (ONE extra dispatch) and
         admit it to the device tier, evicting oldest-first down the
         tiers.  Returns the entry, or None when retention is disabled
-        ($CELESTIA_SERVE_HEIGHTS=0)."""
+        ($CELESTIA_SERVE_HEIGHTS=0).
+
+        Retention is also the write-after-retain fence for the stream
+        pipeline's persistent buffer ring: admitting here runs
+        `eds.attach_forest`, which notifies the ring that fed this square
+        (parallel/pipeline._BufferRing.pin) so the staging slot behind it
+        is swapped — never overwritten — while this entry serves proofs
+        (donation may alias the upload into the retained EDS)."""
         cap, spill_cap = self._capacity()
         if cap <= 0:
             return None

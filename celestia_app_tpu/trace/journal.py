@@ -3,9 +3,14 @@
 The observability spine of the PR-1 device pipeline: every block that
 crosses `da/eds` (fused or staged), `parallel/pipeline.BlockPipeline`
 (stream mode), or `parallel/sharded_eds` (multi-chip) records one
-`block_journal` row — square size, pipeline mode, jit-cache hit/miss, and
+`block_journal` row — square size, pipeline mode, jit-cache hit/miss,
 the stage timings its path measured (upload ms, dispatch ms, queue-stall
-ms, drain latency).  Rows are written from whichever thread ran the stage
+ms, drain latency), and the continuous-batching facts: `batch_size`
+(squares coalesced into the row's dispatch; 1 = unbatched) on stream
+rows, and the `speculation` outcome (hit / discard) on compute rows when
+$CELESTIA_PIPE_SPECULATE is armed.  The batch-size distribution itself
+lands on `celestia_pipeline_batch_size` (observed once per dispatch by
+the pipeline, not once per row — a 4-square batch is ONE dispatch).  Rows are written from whichever thread ran the stage
 (the uploader/dispatcher threads in stream mode) into the thread-safe
 tracer tables and pulled node-side via GET /trace_tables — the
 test/e2e/testnet/node.go:52-74 analog.

@@ -48,8 +48,16 @@ import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# The continuous-batching stream rows (bench.py STREAM_BATCHES): B same-k
+# squares coalesced into one vmapped dispatch, rate-shaped like every
+# other mode.  Gated — the batch-B-vs-batch-1 margin is the feature under
+# regression watch — with the same same-platform comparability rule the
+# hw-gated parts candidates lean on (a CPU-fallback round's batching
+# margin is never compared against a chip round's, and vice versa;
+# _comparable_priors drops cross-platform priors for these series too).
+STREAM_BATCH_MODES = ("stream_b1", "stream_b2", "stream_b4")
 # Modes whose rate is device-resident and comparable across rounds.
-GATED_MODES = ("compute",)
+GATED_MODES = ("compute",) + STREAM_BATCH_MODES
 # Modes bound by the host<->device link; reported, not gated by default.
 LINK_BOUND_MODES = ("extend", "stream", "repair", "host")
 # Parts candidates only measured on TPU (the Pallas lowerings): their
@@ -63,7 +71,8 @@ HW_GATED_PARTS = (
     "rs_dense_pl", "rs_xor", "nmt_dah_pallas", "nmt_dah_plf",
 )
 
-_MODE_ROW_RE = re.compile(r'\{"mode":\s*"[a-z_]+",\s*"k":\s*\d+[^{}]*\}')
+# [a-z0-9_]: the stream_b<N> continuous-batching modes carry a digit.
+_MODE_ROW_RE = re.compile(r'\{"mode":\s*"[a-z0-9_]+",\s*"k":\s*\d+[^{}]*\}')
 _STABILITY_RE = re.compile(r'"stability_pct":\s*([0-9.]+)')
 _ERRORS_RE = re.compile(r'"errors":\s*(\[[^\]]*\])')
 

@@ -22,6 +22,16 @@ LATENCY but never CORRECTNESS.  Four drills, one process:
                        pure-host fallback with proof bytes BIT-IDENTICAL
                        to the chaos-off batched run, all verifying
                        against the committed DAH data root.
+  2c. speculation drill — speculative extends ($CELESTIA_PIPE_SPECULATE)
+                       under injected dispatch faults and forced round
+                       changes (the adopted square differs from the
+                       speculated one): every mismatched claim must
+                       DISCARD and recompute, with committed roots
+                       bit-identical to the speculation-off run.
+  2d. batched-fault drill — a persistent fault in the vmapped
+                       multi-square dispatch ($CELESTIA_PIPE_BATCH) must
+                       fall down the ladder (batched -> unbatched fused
+                       -> staged), roots bit-identical throughout.
   3. gossip drill    — a redundant flood over a lossy, duplicating,
                        transiently-failing link; the receiver-side
                        msg-id dedup must converge on exactly the unique
@@ -473,6 +483,144 @@ def run_sampling_drill(k: int = 8, samples: int = 64,
     }
 
 
+def run_speculation_drill(k: int = 4, blocks: int = 6,
+                          spec: str = "seed=3,dispatch_fail=0.3") -> dict:
+    """The speculative-extend leg of the 'latency, never correctness'
+    claim: with $CELESTIA_PIPE_SPECULATE=on, every block speculates the
+    NEXT block's square ahead of adoption, and every other adoption is a
+    ROUND CHANGE (the adopted square differs from the speculated one, so
+    the claim must discard and recompute) — all under injected dispatch
+    faults so a speculative dispatch also walks the retry/ladder path.
+    Every committed root must be bit-identical to the speculation-off
+    chaos-off run, and the discards must actually have fired."""
+    import os
+
+    from celestia_app_tpu import chaos
+    from celestia_app_tpu.chaos import degrade
+    from celestia_app_tpu.da.eds import ExtendedDataSquare, speculator
+    from celestia_app_tpu.trace.metrics import registry
+
+    pairs = _deterministic_blocks(2 * blocks, k, seed=777)
+    adopted = [ods for _tag, ods in pairs[:blocks]]
+    reproposed = [ods for _tag, ods in pairs[blocks:]]
+
+    chaos.install("")  # speculation-off, chaos-off baseline
+    degrade.reset_for_tests()
+    saved = os.environ.get("CELESTIA_PIPE_SPECULATE")
+    os.environ.pop("CELESTIA_PIPE_SPECULATE", None)
+    baseline = [ExtendedDataSquare.compute(o).data_root() for o in adopted]
+
+    def _outcomes() -> dict:
+        out = {"hit": 0.0, "discard": 0.0}
+        for labels, val in registry().counter(
+            "celestia_speculation_total", ""
+        ).samples():
+            out[labels.get("outcome", "?")] = val
+        return out
+
+    before = _outcomes()
+    os.environ["CELESTIA_PIPE_SPECULATE"] = "on"
+    chaos.install(spec)
+    t0_ns = time.time_ns()
+    try:
+        roots = []
+        sp = speculator()
+        for i, ods in enumerate(adopted):
+            if i % 2:
+                # Round change: what was speculated is NOT what adoption
+                # brings — the claim must discard and compute fresh.
+                sp.speculate(reproposed[i], height=i, round_=0)
+            else:
+                sp.speculate(ods, height=i, round_=0)
+            roots.append(ExtendedDataSquare.compute(ods).data_root())
+    finally:
+        chaos.uninstall()
+        degrade.reset_for_tests()
+        if saved is None:
+            os.environ.pop("CELESTIA_PIPE_SPECULATE", None)
+        else:
+            os.environ["CELESTIA_PIPE_SPECULATE"] = saved
+    after = _outcomes()
+    hits = after["hit"] - before["hit"]
+    discards = after["discard"] - before["discard"]
+    identical = roots == baseline
+    return {
+        "blocks": blocks,
+        "k": k,
+        "roots_identical": identical,
+        "hits": hits,
+        "discards": discards,
+        # Hits are best-effort under dispatch_fail (a failed speculative
+        # dispatch simply never parks an entry); discards are the drill's
+        # point and MUST have fired on every round change that resolved.
+        "ok": identical and discards >= 1,
+        "detection": _detection(t0_ns),
+    }
+
+
+def run_batched_fault_drill(k: int = 4, blocks: int = 6,
+                            batch: int = 2) -> dict:
+    """A persistent batched-dispatch fault must fall DOWN the ladder, not
+    lose blocks: dispatch_fail=1.0 (the fused family, batched program
+    included) forces every coalesced dispatch onto the per-square
+    fallback (celestia_recoveries_total{outcome=unbatched}), whose own
+    failures then walk fused -> staged via the breaker — with every root
+    bit-identical to the chaos-off unbatched run."""
+    from celestia_app_tpu import chaos
+    from celestia_app_tpu.chaos import degrade
+    from celestia_app_tpu.kernels.fused import pipeline_mode
+    from celestia_app_tpu.parallel.pipeline import stream_blocks
+    from celestia_app_tpu.trace.metrics import registry
+
+    pairs = _deterministic_blocks(blocks, k, seed=313)
+
+    chaos.install("")
+    degrade.reset_for_tests()
+    baseline = {
+        tag: eds.data_root()
+        for tag, eds in stream_blocks(iter(pairs), k, depth=2, batch=1)
+    }
+
+    def _unbatched_falls() -> float:
+        for labels, val in registry().counter(
+            "celestia_recoveries_total", ""
+        ).samples():
+            if (labels.get("seam") == "device.dispatch"
+                    and labels.get("outcome") == "unbatched"):
+                return val
+        return 0.0
+
+    before = _unbatched_falls()
+    chaos.install("seed=17,dispatch_fail=1.0")
+    t0_ns = time.time_ns()
+    try:
+        chaotic = {
+            tag: eds.data_root()
+            for tag, eds in stream_blocks(
+                iter(pairs), k, depth=max(2, batch), batch=batch
+            )
+        }
+        final_mode = pipeline_mode()
+    finally:
+        chaos.uninstall()
+        degrade.reset_for_tests()
+    falls = _unbatched_falls() - before
+    identical = chaotic == baseline
+    return {
+        "blocks": blocks,
+        "k": k,
+        "batch": batch,
+        "roots_identical": identical,
+        "unbatched_falls": falls,
+        "final_mode": final_mode,
+        # The fused family is fully failed, so the ladder must have
+        # landed on staged AND the batched rung must have stepped down
+        # through the unbatched fallback at least once on the way.
+        "ok": identical and falls >= 1 and final_mode == "staged",
+        "detection": _detection(t0_ns),
+    }
+
+
 def seam_table_lines(prefixes: tuple[str, ...]) -> list[str]:
     """Exposition lines for the given metric families, straight off the
     registry (the soak's summary-table reader)."""
@@ -548,6 +696,23 @@ def main(argv=None) -> int:
     if not smp["ok"]:
         failures.append(f"sampling drill failed: {smp}")
 
+    spc = run_speculation_drill(k=min(args.k, 8),
+                                blocks=min(args.blocks, 6))
+    print(f"speculation drill: {spc['blocks']} blocks @ k={spc['k']} -> "
+          f"roots_identical={spc['roots_identical']} hits={spc['hits']:.0f} "
+          f"discards={spc['discards']:.0f}", flush=True)
+    if not spc["ok"]:
+        failures.append(f"speculation drill failed: {spc}")
+
+    bat = run_batched_fault_drill(k=min(args.k, 8),
+                                  blocks=min(args.blocks, 6))
+    print(f"batched-fault drill: {bat['blocks']} blocks @ k={bat['k']} "
+          f"batch={bat['batch']} -> roots_identical={bat['roots_identical']} "
+          f"unbatched_falls={bat['unbatched_falls']:.0f} "
+          f"final_mode={bat['final_mode']}", flush=True)
+    if not bat["ok"]:
+        failures.append(f"batched-fault drill failed: {bat}")
+
     gos = run_gossip_drill(args.spec)
     print(f"gossip drill: {gos['sent_unique']} unique msgs converged in "
           f"{gos['rounds']} flood rounds -> {gos['deliveries']} deliveries, "
@@ -585,6 +750,8 @@ def main(argv=None) -> int:
         ("device soak", dev.get("detection")),
         ("WAL tear", wal.get("detection")),
         ("sampling", smp.get("detection")),  # healed by host fallback
+        ("speculation", spc.get("detection")),  # discards heal silently
+        ("batched fault", bat.get("detection")),
         ("gossip", None),  # healed by redundancy: no anomaly to page on
         ("breaker (epi seat)", brk_epi.get("detection")),
         ("breaker (fused)", brk.get("detection")),

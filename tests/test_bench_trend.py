@@ -29,12 +29,15 @@ def _checked_in_rounds():
     return sorted(glob.glob(os.path.join(REPO_ROOT, "BENCH_r*.json")))
 
 
-def _round_file(tmp_path, n, results, stability=None, errors=None):
+def _round_file(tmp_path, n, results, stability=None, errors=None,
+                platform=None):
     summary = {"metric": "x", "value": 1.0, "unit": "MB/s", "results": results}
     if stability is not None:
         summary["stability_pct"] = stability
     if errors is not None:
         summary["errors"] = errors
+    if platform is not None:
+        summary["platform"] = platform
     path = tmp_path / f"BENCH_r{n:02d}.json"
     path.write_text(json.dumps({
         "n": n, "cmd": "bench", "rc": 0,
@@ -282,6 +285,71 @@ class TestSeatChanges:
             "seat": "rs", "from": "rs_dense", "to": "rs_xor",
             "from_round": 1, "round": 2,
         }]
+
+
+class TestStreamBatchSeries:
+    """The continuous-batching stream_b{1,2,4} rows (bench.py stream
+    stage) are gated series with the same same-platform comparability
+    rule as the hw-gated parts candidates."""
+
+    def test_stream_batch_modes_are_gated(self, tmp_path, capsys):
+        bt = _load()
+        assert set(bt.STREAM_BATCH_MODES) <= set(bt.GATED_MODES)
+        _round_file(tmp_path, 1, [
+            {"mode": "stream_b1", "k": 128, "mb_per_s": 30.0},
+            {"mode": "stream_b4", "k": 128, "mb_per_s": 50.0},
+        ])
+        # batch-4 collapses to below batch-1: a real batching regression.
+        _round_file(tmp_path, 2, [
+            {"mode": "stream_b1", "k": 128, "mb_per_s": 30.0},
+            {"mode": "stream_b4", "k": 128, "mb_per_s": 20.0},
+        ])
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        out = capsys.readouterr().out
+        assert "stream_b4@128" in out and "regressions:" in out
+
+    def test_stream_batch_within_threshold_passes(self, tmp_path):
+        bt = _load()
+        _round_file(tmp_path, 1, [
+            {"mode": "stream_b2", "k": 128, "mb_per_s": 40.0},
+        ])
+        _round_file(tmp_path, 2, [
+            {"mode": "stream_b2", "k": 128, "mb_per_s": 38.0},
+        ])
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+
+    def test_stream_batch_cross_platform_prior_not_compared(self, tmp_path):
+        """A CPU-fallback round's batching margin is never gated against
+        a chip round's — the hw-gated-platform rule."""
+        bt = _load()
+        _round_file(tmp_path, 1, [
+            {"mode": "stream_b4", "k": 128, "mb_per_s": 400.0},
+        ], platform="tpu")
+        _round_file(tmp_path, 2, [
+            {"mode": "stream_b4", "k": 128, "mb_per_s": 25.0},
+        ], platform="cpu")
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+        # A genuine same-platform collapse still gates.
+        _round_file(tmp_path, 3, [
+            {"mode": "stream_b4", "k": 128, "mb_per_s": 2.0},
+        ], platform="cpu")
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+
+    def test_stream_batch_rows_salvage_from_truncated_tail(self, tmp_path):
+        """The salvage regex must keep digit-bearing modes (stream_b4):
+        a front-truncated tail that only holds the row fragments still
+        contributes the series."""
+        bt = _load()
+        tail = (
+            '... truncated ... {"mode": "stream_b4", "k": 128, '
+            '"mb_per_s": 44.0, "seconds_per_block": 0.19} trailing'
+        )
+        path = tmp_path / "BENCH_r01.json"
+        path.write_text(json.dumps({
+            "n": 1, "cmd": "bench", "rc": 0, "tail": tail, "parsed": None,
+        }))
+        rounds = bt.load_series([str(path)])
+        assert rounds[0]["modes"] == {("stream_b4", 128): [44.0]}
 
 
 class TestMalformedInputsFailFast:
