@@ -103,3 +103,12 @@ def proof_serve() -> None:
     inj = injector()
     if inj is not None:
         inj.proof_serve()
+
+
+def active_adversary():
+    """The active protocol adversary (chaos/adversary.Adversary), or
+    None — honest paths and specs with every adversary key at 0 both
+    land here.  (Named to avoid shadowing by the chaos.adversary
+    submodule attribute once that module is imported.)"""
+    inj = injector()
+    return inj.adversary() if inj is not None else None

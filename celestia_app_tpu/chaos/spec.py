@@ -40,6 +40,18 @@ gossip_drop=0.1,wal_torn_tail=1,rpc_slow_ms=100"
                           must answer bit-identically)
     proof_slow_ms=<ms>    [proof_slow=<p>] proof dispatch stalls
 
+Protocol ADVERSARIES (chaos/adversary.py — attack model, not fault
+model; deterministic per (seed, height) rather than per call ordinal):
+
+    withhold_frac=<f>     withholding proposer: hide a random fraction f
+                          of each height's EDS shares from the serve path
+                          (honest root committed; a DAS sample hitting a
+                          withheld share is the detection signal)
+    malform_shares=<n>    corrupt n served shares' bytes post-commit
+                          (sampler verification must detect)
+    wrong_root=1          served DAH data root does not match the square
+                          (sampler verification / repair RootMismatch)
+
 Determinism: every seam draws from its OWN `random.Random` seeded by
 (seed, seam name), so the injection sequence a seam sees depends only on
 the spec and that seam's call ordinals — never on how calls from
@@ -88,6 +100,7 @@ _KNOWN_KEYS = {
     "rpc_slow_ms", "rpc_slow", "rpc_fail",
     "mempool_drop", "mempool_slow_ms", "mempool_slow",
     "proof_fail", "proof_slow_ms", "proof_slow",
+    "withhold_frac", "malform_shares", "wrong_root",
 }
 
 
@@ -140,6 +153,21 @@ class ChaosInjector:
             for seam in SEAMS
         }
         self._torn_remaining = int(self.params.get("wal_torn_tail", 0))
+        # Lazily-built protocol adversary (chaos/adversary.py); None when
+        # no adversary key is set, so honest paths pay one attr read.
+        self._adversary = None
+        self._adversary_built = False
+
+    def adversary(self):
+        """The spec's protocol adversary, or None when every adversary
+        key is absent/zero (the honest fast path)."""
+        with self._lock:
+            if not self._adversary_built:
+                from celestia_app_tpu.chaos.adversary import Adversary
+
+                self._adversary = Adversary.from_params(self.params)
+                self._adversary_built = True
+            return self._adversary
 
     # --- plumbing -----------------------------------------------------------
     def _p(self, key: str) -> float:

@@ -646,17 +646,39 @@ def _handlers(node) -> dict:
             )
         return get()
 
-    def _das_payload(build) -> bytes:
+    def _das_payload(build, kind: str) -> bytes:
         from celestia_app_tpu.serve.api import UnknownHeight
+        from celestia_app_tpu.serve.sampler import (
+            BadProofDetected,
+            ShareWithheld,
+        )
 
         try:
             payload = build()
         except UnknownHeight as e:
             raise _Abort("NOT_FOUND", str(e)) from None
+        except ShareWithheld as e:
+            # The HTTP planes' 410 Gone: the share is committed but being
+            # withheld — the light client's detection signal, distinct
+            # from NOT_FOUND (height unknown) and from INVALID_ARGUMENT
+            # (ShareWithheld is a LookupError, so without this clause it
+            # would escape as an opaque UNKNOWN).
+            raise _Abort(
+                "FAILED_PRECONDITION", f"withholding detected: {e}"
+            ) from None
+        except BadProofDetected as e:
+            # The HTTP planes' 502: committed root and served square
+            # disagree — caught at the verification gate.  Must precede
+            # the ValueError clause (BadProofDetected subclasses it):
+            # a detected attack is not a malformed client request.
+            raise _Abort("DATA_LOSS", str(e)) from None
         except (TypeError, ValueError) as e:
             raise _Abort("INVALID_ARGUMENT", str(e)) from None
-        from celestia_app_tpu.serve.api import render
+        from celestia_app_tpu.serve.api import count_served, render
 
+        # Counted where the payload dict is in hand: the per-tenant
+        # (capped namespace) label rides the same counter on every plane.
+        count_served("grpc", kind, payload)
         return encode_bytes_field(1, render(payload))
 
     def das_share_proof(req: bytes) -> bytes:
@@ -665,29 +687,24 @@ def _handlers(node) -> dict:
         # the canonical serve/api.render bytes, so the gRPC answer is
         # byte-identical to the GET /das/share_proof body on the HTTP
         # planes.
-        from celestia_app_tpu.serve.api import count_served
-
         provider = _node_das_provider()
         height, row, col = (
             _field_int(req, 1), _field_int(req, 2), _field_int(req, 3)
         )
         axis = _field_str(req, 4) or "row"
-        out = _das_payload(
-            lambda: provider.share_proof_payload(height, row, col, axis=axis)
+        return _das_payload(
+            lambda: provider.share_proof_payload(height, row, col, axis=axis),
+            "share_proof",
         )
-        count_served("grpc", "share_proof")
-        return out
 
     def das_shares_by_namespace(req: bytes) -> bytes:
         # GetSharesByNamespaceRequest {height=1, namespace=2 (29-byte
         # hex string)} -> {payload=1 bytes}.
-        from celestia_app_tpu.serve.api import count_served
-
         provider = _node_das_provider()
         height, ns_hex = _field_int(req, 1), _field_str(req, 2)
-        out = _das_payload(lambda: provider.shares_payload(height, ns_hex))
-        count_served("grpc", "shares")
-        return out
+        return _das_payload(
+            lambda: provider.shares_payload(height, ns_hex), "shares"
+        )
 
     return {
         "cosmos.tx.v1beta1.Service": {

@@ -400,7 +400,7 @@ class ServingNode(TestNode):
         payload = self.das_provider().share_proof_payload(
             int(height), int(row), int(col), axis=axis
         )
-        count_served("jsonrpc", "share_proof")
+        count_served("jsonrpc", "share_proof", payload)
         return payload
 
     def rpc_get_shares_by_namespace(self, height: int, namespace: str) -> dict:
@@ -409,7 +409,7 @@ class ServingNode(TestNode):
         from celestia_app_tpu.serve.api import count_served
 
         payload = self.das_provider().shares_payload(int(height), namespace)
-        count_served("jsonrpc", "shares")
+        count_served("jsonrpc", "shares", payload)
         return payload
 
     # --- state-sync snapshots -------------------------------------------------

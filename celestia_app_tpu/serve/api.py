@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import json
 
-from celestia_app_tpu.constants import NAMESPACE_SIZE
+from celestia_app_tpu.constants import NAMESPACE_SIZE, PARITY_NAMESPACE_BYTES
 
 
 def render(payload: dict) -> bytes:
@@ -30,13 +30,50 @@ def render(payload: dict) -> bytes:
     return json.dumps(payload, sort_keys=True, separators=(",", ":")).encode()
 
 
-def count_served(plane: str, kind: str) -> None:
+def payload_namespace_label(payload) -> str:
+    """The CAPPED per-tenant label of a served payload (the PR 4
+    accounting plane's cardinality contract): the proved share's
+    namespace for share_proof payloads, the queried namespace for
+    shares payloads, the reserved `other` bucket when the payload
+    carries none (parity shares, errors, absent payloads)."""
+    from celestia_app_tpu.trace.square_journal import (
+        OTHER_LABEL,
+        capped_namespace_label,
+        namespace_label,
+    )
+
+    ns_hex = None
+    if isinstance(payload, dict):
+        ns_hex = payload.get("namespace")
+        if ns_hex is None and isinstance(payload.get("proof"), dict):
+            ns_hex = payload["proof"].get("namespace")
+    if not isinstance(ns_hex, str) or not ns_hex:
+        return OTHER_LABEL
+    try:
+        ns = bytes.fromhex(ns_hex)
+    except ValueError:
+        return OTHER_LABEL
+    if ns == PARITY_NAMESPACE_BYTES:
+        # Parity shares are not a tenant (the sampler's twin
+        # _proof_namespace_label applies the same fold): 3/4 of uniform
+        # DAS coordinates would otherwise burn a capped-cardinality slot
+        # on 0xff..ff and split this counter from the latency histogram.
+        return OTHER_LABEL
+    return capped_namespace_label(namespace_label(ns))
+
+
+def count_served(plane: str, kind: str, payload=None) -> None:
+    """One served DAS response: per-plane, per-kind, and — when the
+    payload names one — per-tenant (capped namespace label), so the read
+    path joins the per-namespace accounting the write path has had since
+    PR 4."""
     from celestia_app_tpu.trace.metrics import registry
 
     registry().counter(
         "celestia_proofs_served_total",
-        "DAS proofs served, by serving plane and query kind",
-    ).inc(plane=plane, kind=kind)
+        "DAS proofs served, by serving plane, query kind, and (capped) "
+        "namespace",
+    ).inc(plane=plane, kind=kind, namespace=payload_namespace_label(payload))
 
 
 class UnknownHeight(KeyError):
@@ -67,6 +104,19 @@ class DasProvider:
         self._rebuild_lock = threading.Lock()
 
     def entry(self, height: int):
+        entry = self._honest_entry(height)
+        # The adversary seam: a tampering proposer (malform_shares /
+        # wrong_root in $CELESTIA_CHAOS) serves a corrupted VIEW of the
+        # height — same object every request, honest cache untouched —
+        # which the sampler's verification gate then detects.
+        from celestia_app_tpu import chaos
+
+        adv = chaos.active_adversary()
+        if adv is not None and adv.tampers():
+            return adv.tamper_entry(entry)
+        return entry
+
+    def _honest_entry(self, height: int):
         entry, tier = self.cache.get(height)
         if entry is not None:
             return entry
@@ -134,6 +184,14 @@ class DasProvider:
         from celestia_app_tpu.proof.share_proof import new_share_inclusion_proof
 
         proof = new_share_inclusion_proof(entry.eds, rng[0], rng[1])
+        # The same verification gate the sampler applies to share_proof:
+        # under a tampering adversary (or $CELESTIA_SERVE_VERIFY=1) a
+        # namespace payload built from the served view must chain to the
+        # committed root before it leaves — BadProofDetected (502 /
+        # DATA_LOSS on the planes) instead of a 200 endorsing forged
+        # state.  The found=False branch serves no proof, so there is
+        # nothing to endorse there.
+        self.sampler._gate(entry, [proof])
         payload.update({
             "found": True,
             "start": rng[0],

@@ -29,6 +29,22 @@ first arrival becomes the batch leader, waits $CELESTIA_SERVE_BATCH_MS
 (default 0: drain whatever queued), and answers everyone in one
 dispatch.  Latency lands on celestia_proof_latency_seconds{phase}:
 queue_wait and total per sample, gather and assemble per batch.
+
+Adversary detection (chaos/adversary.py — the ISSUE-10 attack model):
+
+  * a sample landing on a share the WITHHOLDING PROPOSER hid raises
+    ShareWithheld — the failed sample IS the light client's detection
+    signal (celestia_da_detections_total{kind="withheld"} + the
+    `withholding_detected` flight trigger);
+  * when an adversary TAMPERS with the served square (malform_shares /
+    wrong_root), every assembled proof passes a VERIFICATION GATE
+    against the committed data root before leaving the sampler: a proof
+    that does not verify raises BadProofDetected
+    (kind="bad_proof" + the `root_mismatch` flight trigger) — a
+    malformed share or forged root is detected, never served as a valid
+    proof.  $CELESTIA_SERVE_VERIFY=1 arms the gate unconditionally
+    (paranoid mode); with no adversary configured the gate costs one
+    attr read per batch.
 """
 
 from __future__ import annotations
@@ -45,6 +61,26 @@ from celestia_app_tpu.nmt.proof import (
     prove_range_from_levels,
     range_proof_node_coords,
 )
+
+
+class ShareWithheld(LookupError):
+    """The sampled share is being withheld from the serve path (a
+    data-withholding attack detected by this very sample)."""
+
+    def __init__(self, height: int, row: int, col: int):
+        super().__init__(
+            f"share ({row},{col}) at height {height} is withheld "
+            "(data-availability attack detected)"
+        )
+        self.height = height
+        self.row = row
+        self.col = col
+
+
+class BadProofDetected(ValueError):
+    """An assembled proof failed verification against the committed data
+    root — a malformed square or wrong-root attack, detected at the
+    sampler before any client saw a "valid" proof."""
 
 
 def serve_mode() -> str:
@@ -80,9 +116,26 @@ def _latency():
     return registry().histogram(
         "celestia_proof_latency_seconds",
         "DAS proof serving latency by phase (queue_wait/gather/assemble "
-        "per the sampler; total is per served sample)",
+        "per the sampler; total is per served sample, labeled with the "
+        "served share's capped namespace)",
         buckets=DEVICE_SECONDS_BUCKETS,
     )
+
+
+def _proof_namespace_label(proof) -> str:
+    """Capped per-tenant label of one served proof — the PR 4 accounting
+    plane's cardinality contract applied to the read path (parity shares
+    and failed samples fold into the reserved `other` bucket)."""
+    from celestia_app_tpu.trace.square_journal import (
+        OTHER_LABEL,
+        capped_namespace_label,
+        namespace_label,
+    )
+
+    ns = getattr(proof, "namespace", None)
+    if not isinstance(ns, bytes) or ns == PARITY_NAMESPACE_BYTES:
+        return OTHER_LABEL
+    return capped_namespace_label(namespace_label(ns))
 
 
 class _Pending:
@@ -100,6 +153,46 @@ class _Pending:
         self.t_submit = time.perf_counter()
 
 
+def _check_withheld(entry, coords) -> None:
+    """The withholding intercept: raise ShareWithheld on the FIRST
+    sampled coordinate the adversary hides — ticking the detection
+    counter and black-boxing through the rate-limited
+    `withholding_detected` trigger.  No adversary configured = one
+    injector read, nothing else."""
+    from celestia_app_tpu import chaos
+
+    adv = chaos.active_adversary()
+    if adv is None or adv.withhold_frac <= 0:
+        return
+    height = getattr(entry, "height", 0)
+    n = 2 * entry.k
+    for row, col in coords:
+        if adv.withholds(height, n, row, col):
+            from celestia_app_tpu.chaos.adversary import detections
+            from celestia_app_tpu.trace.flight_recorder import note_trigger
+
+            adv.count_injection("adversary.withhold", "withhold_frac")
+            detections().inc(kind="withheld")
+            note_trigger(
+                "withholding_detected",
+                height=height, row=int(row), col=int(col),
+                withhold_frac=adv.withhold_frac,
+            )
+            raise ShareWithheld(height, int(row), int(col))
+
+
+def _verify_gate_armed(entry) -> bool:
+    """Proof verification before serving: armed when an adversary is
+    tampering with served state, or unconditionally via
+    $CELESTIA_SERVE_VERIFY=1."""
+    if os.environ.get("CELESTIA_SERVE_VERIFY", "") == "1":
+        return True
+    from celestia_app_tpu import chaos
+
+    adv = chaos.active_adversary()
+    return adv is not None and adv.tampers()
+
+
 class ProofSampler:
     """Batching sampler over ForestCache entries (serve/cache.py)."""
 
@@ -113,6 +206,10 @@ class ProofSampler:
                     timeout_s: float = 30.0) -> ShareProof:
         """One sample through the batch queue: enqueue, and either lead
         the next batch dispatch or park until a leader answers."""
+        # Per-sample withholding check BEFORE enqueue: one caller's
+        # withheld coordinate must fail that caller, never its
+        # batch-mates (a real server refuses one share, not the batch).
+        _check_withheld(entry, [(row, col)])
         p = _Pending(entry, row, col, axis)
         with self._lock:
             self._queue.append(p)
@@ -162,8 +259,13 @@ class ProofSampler:
                     p.error = e
             finally:
                 for p in group:
+                    # Per-sample total carries the served share's capped
+                    # namespace — the read path's per-tenant latency view
+                    # (batch-level gather/assemble stay unlabeled: one
+                    # dispatch serves many tenants).
                     lat.observe(
-                        time.perf_counter() - p.t_submit, phase="total"
+                        time.perf_counter() - p.t_submit, phase="total",
+                        namespace=_proof_namespace_label(p.proof),
                     )
                     p.event.set()
 
@@ -182,15 +284,46 @@ class ProofSampler:
         for row, col in coords:
             if not (0 <= row < n and 0 <= col < n):
                 raise ValueError(f"coordinate ({row},{col}) outside {n}x{n}")
+        # Direct callers (drills, loadgen) get the same withholding
+        # intercept the queued path applies per sample.
+        _check_withheld(entry, coords)
         if serve_mode() == "host":
-            return self._host_batch(entry, coords, axis)
+            return self._gate(entry, self._host_batch(entry, coords, axis))
         try:
             chaos.proof_serve()
-            return self._batched(entry, coords, axis)
+            proofs = self._batched(entry, coords, axis)
         except Exception:  # noqa: BLE001 — the host path is the answer
             proofs = self._host_batch(entry, coords, axis)
             recoveries().inc(seam="proof.serve", outcome="degraded")
+        return self._gate(entry, proofs)
+
+    @staticmethod
+    def _gate(entry, proofs: list[ShareProof]) -> list[ShareProof]:
+        """The verification gate: when armed (adversarial tampering or
+        $CELESTIA_SERVE_VERIFY=1), every proof must verify against the
+        entry's committed data root before it leaves the sampler.  A
+        failure is an attack detection (malformed square / wrong root):
+        counted, black-boxed, and raised — never served as valid."""
+        if not _verify_gate_armed(entry):
             return proofs
+        for p in proofs:
+            if p.verify(entry.data_root):
+                continue
+            from celestia_app_tpu.chaos.adversary import detections
+            from celestia_app_tpu.trace.flight_recorder import note_trigger
+
+            detections().inc(kind="bad_proof")
+            note_trigger(
+                "root_mismatch",
+                reason="serve_verification",
+                height=getattr(entry, "height", 0),
+            )
+            raise BadProofDetected(
+                "assembled proof does not verify against the committed "
+                f"data root at height {getattr(entry, 'height', 0)} "
+                "(malformed square or wrong root)"
+            )
+        return proofs
 
     def _batched(self, entry, coords, axis: str = "row") -> list[ShareProof]:
         lat = _latency()

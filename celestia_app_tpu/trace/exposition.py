@@ -160,6 +160,7 @@ def _das_response(kind: str, query: str, plane: str):
             {"error": "no DAS provider registered (serve/ plane not wired)"}
         ).encode()
     from celestia_app_tpu.serve.api import UnknownHeight, count_served, render
+    from celestia_app_tpu.serve.sampler import BadProofDetected, ShareWithheld
 
     params = _query_params(query)
     try:
@@ -177,13 +178,27 @@ def _das_response(kind: str, query: str, plane: str):
             )
     except UnknownHeight as e:
         return 404, "application/json", json.dumps({"error": str(e)}).encode()
+    except ShareWithheld as e:
+        # 410 Gone: the share exists in the commitment but is being
+        # withheld — the light client's detection signal, distinct from
+        # 404 (height unknown) and 400 (bad request).
+        return 410, "application/json", json.dumps(
+            {"error": str(e), "detected": "withholding"}
+        ).encode()
+    except BadProofDetected as e:
+        # 502: the committed root and the served square disagree — a
+        # malformed-square / wrong-root attack caught at the
+        # verification gate, never served as a valid proof.
+        return 502, "application/json", json.dumps(
+            {"error": str(e), "detected": "root_mismatch"}
+        ).encode()
     except (TypeError, ValueError) as e:
         return 400, "application/json", json.dumps({"error": str(e)}).encode()
     except Exception as e:  # noqa: BLE001 — a proof fault must not kill the probe port
         return 500, "application/json", json.dumps(
             {"error": f"{type(e).__name__}: {e}"}
         ).encode()
-    count_served(plane, kind)
+    count_served(plane, kind, payload)
     return 200, "application/json", render(payload)
 
 

@@ -10,6 +10,11 @@ box: when an anomaly TRIGGER fires —
     worker_death      parallel/pipeline.py: an uploader/dispatcher died
     wal_salvage       consensus/wal.py: replay dropped a torn tail
     slo_fast_burn     trace/slo.py: an SLO entered fast-burn (a page)
+    root_mismatch     da/repair.py: repair rejected an inconsistent
+                      survivor set or a square that contradicts its DAH
+                      (the wrong-root / malformed-square attack face)
+    withholding_detected  serve/sampler.py: a DAS sample hit a withheld
+                      share (the data-withholding attack face)
 
 — `note_trigger` atomically dumps one JSON bundle under
 $CELESTIA_FLIGHT_DIR: the last-N rows of EVERY trace table, the
@@ -41,6 +46,8 @@ TRIGGERS = (
     "worker_death",
     "wal_salvage",
     "slo_fast_burn",
+    "root_mismatch",
+    "withholding_detected",
 )
 
 #: Hard ceiling on per-table tail rows in a bundle.

@@ -17,13 +17,14 @@ The GATE (exit 1) is stability-aware and fires when the newest datapoint
 of a gated series drops more than `--threshold` percent (default 10)
 plus that round's measured `stability_pct` below the best earlier
 datapoint.  Gated by default: the device-resident `compute` rows (the
-ROADMAP headline) and the `parts` decomposition seconds.  The
-link-bound modes (extend / stream / repair / host) ride the tunnel
-between the host and the chip, whose quality varies between rounds
-(BENCH_r03's stream row collapsed 13x while compute improved 24x), so
-they are REPORTED but only gated under `--all-series`.  Malformed or
-empty inputs exit 2 — a bad bench JSON fails tier-1 fast instead of
-silently dropping out of the trajectory.
+ROADMAP headline), the batched `repair` rows (compute-bound since the
+ISSUE-10 rework; the same-platform prior rule applies), and the `parts`
+decomposition seconds.  The link-bound modes (extend / stream / host)
+ride the tunnel between the host and the chip, whose quality varies
+between rounds (BENCH_r03's stream row collapsed 13x while compute
+improved 24x), so they are REPORTED but only gated under
+`--all-series`.  Malformed or empty inputs exit 2 — a bad bench JSON
+fails tier-1 fast instead of silently dropping out of the trajectory.
 
 `--metrics-out <dir>` writes the same artifacts bench.py does — a
 `bench_trend.prom` Prometheus textfile and `bench_trend.jsonl` rows
@@ -35,6 +36,18 @@ records at the repo root (written by `scripts/das_loadgen.py
 --round-out`) contribute a proofs/sec series (gated like a rate, higher
 is better) and a proof-p99 series (gated like a parts time, lower is
 better), under the same same-platform comparability rule.
+
+The ADVERSARIAL-DRILL trajectory (`ADV_rNN.json`, written by
+`scripts/chaos_soak.py --adv-out`) gates differently — it records
+INVARIANTS first, latency second:
+
+  * every detection-probability curve must be monotone non-decreasing
+    in sample count and the honest leg byte-identical (a violated
+    invariant is a hard regression regardless of priors);
+  * the tampering adversaries (malform / wrong_root) must have been
+    detected on every probe;
+  * repair-to-recovery total_ms gates like a parts time (lower better)
+    against same-platform priors.
 """
 
 from __future__ import annotations
@@ -57,9 +70,15 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # _comparable_priors drops cross-platform priors for these series too).
 STREAM_BATCH_MODES = ("stream_b1", "stream_b2", "stream_b4")
 # Modes whose rate is device-resident and comparable across rounds.
-GATED_MODES = ("compute",) + STREAM_BATCH_MODES
+# `repair` joined the gated set with the ISSUE-10 batched-repair rework:
+# the damaged square ships once and every sweep + the re-extension run
+# device-resident, so the row is compute-bound like `compute`, no longer
+# dominated by link quality.  `repair_grouped` (the frozen per-pattern-
+# group baseline bench.py re-measures at k=128 for the speedup record)
+# stays ungated: it exists to be compared against, not to regress.
+GATED_MODES = ("compute", "repair") + STREAM_BATCH_MODES
 # Modes bound by the host<->device link; reported, not gated by default.
-LINK_BOUND_MODES = ("extend", "stream", "repair", "host")
+LINK_BOUND_MODES = ("extend", "stream", "host")
 # Parts candidates only measured on TPU (the Pallas lowerings): their
 # absence from a CPU-fallback round is a platform gap, not a stale series
 # — the trend gate must not cry STALE when a chip round simply didn't
@@ -269,6 +288,96 @@ def find_das_regressions(das_rounds: list[dict], threshold_pct: float) -> list[d
                 "worse_pct": round(worse_pct, 2),
                 "allowed_pct": round(threshold_pct, 2),
             })
+    return out
+
+
+# --- adversarial-drill rounds (scripts/chaos_soak.py --adv-out) --------------
+
+def load_adv_round(path: str) -> dict:
+    """One ADV_rNN.json: detection-probability table + repair-to-recovery
+    + adversary-detected verdicts.  Missing required keys exit 2 like any
+    other malformed round."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            raw = json.load(f)
+    except (OSError, ValueError) as e:
+        raise MalformedRound(f"{path}: not readable JSON: {e}") from e
+    for key in ("n", "detection", "repair", "honest_identical",
+                "adversaries_detected"):
+        if key not in raw:
+            raise MalformedRound(f"{path}: missing required key {key!r}")
+    return {
+        "round": int(raw["n"]),
+        "path": os.path.basename(path),
+        "platform": raw.get("platform"),
+        "k": raw.get("k"),
+        "detection": raw["detection"],
+        "repair": raw["repair"],
+        "honest_identical": bool(raw["honest_identical"]),
+        "all_monotone": bool(raw.get("all_monotone", False)),
+        "adversaries_detected": dict(raw["adversaries_detected"]),
+    }
+
+
+def load_adv_series(paths: list[str]) -> list[dict]:
+    """[] when no adversarial round exists yet (the series is additive)."""
+    return sorted((load_adv_round(p) for p in paths), key=lambda r: r["round"])
+
+
+def find_adv_regressions(adv_rounds: list[dict], threshold_pct: float) -> list[dict]:
+    """Invariants gate hard (no prior needed); repair-to-recovery
+    latency gates like a parts time against same-platform priors."""
+    out = []
+    if not adv_rounds:
+        return out
+    newest = adv_rounds[-1]
+    rnd = newest["round"]
+    if not newest["honest_identical"]:
+        out.append({
+            "series": "adv.honest_identical", "unit": "invariant",
+            "round": rnd, "value": False, "best_prior": True,
+            "worse_pct": 100.0, "allowed_pct": 0.0,
+        })
+    if not newest["all_monotone"]:
+        out.append({
+            "series": "adv.detection_monotone", "unit": "invariant",
+            "round": rnd, "value": False, "best_prior": True,
+            "worse_pct": 100.0, "allowed_pct": 0.0,
+        })
+    for name, ok in sorted(newest["adversaries_detected"].items()):
+        if not ok:
+            out.append({
+                "series": f"adv.detected.{name}", "unit": "invariant",
+                "round": rnd, "value": False, "best_prior": True,
+                "worse_pct": 100.0, "allowed_pct": 0.0,
+            })
+    if not newest["repair"].get("recovered"):
+        out.append({
+            "series": "adv.repair_recovered", "unit": "invariant",
+            "round": rnd, "value": False, "best_prior": True,
+            "worse_pct": 100.0, "allowed_pct": 0.0,
+        })
+    platforms = {r["round"]: r.get("platform") for r in adv_rounds}
+    pts = [
+        (r["round"], float(r["repair"]["total_ms"]))
+        for r in adv_rounds
+        if r["repair"].get("total_ms") is not None
+    ]
+    if len(pts) >= 2 and pts[-1][0] == rnd:
+        priors = _comparable_priors(pts, platforms)
+        if priors:
+            best_prior = min(priors)
+            last = pts[-1][1]
+            if best_prior > 0:
+                worse_pct = (last - best_prior) / best_prior * 100.0
+                if worse_pct > threshold_pct:
+                    out.append({
+                        "series": "adv.repair_total_ms", "unit": "ms",
+                        "round": rnd, "value": last,
+                        "best_prior": best_prior,
+                        "worse_pct": round(worse_pct, 2),
+                        "allowed_pct": round(threshold_pct, 2),
+                    })
     return out
 
 
@@ -578,9 +687,14 @@ def main(argv: list[str] | None = None) -> int:
         [] if args.files
         else sorted(glob.glob(os.path.join(args.dir, "DAS_r*.json")))
     )
+    adv_paths = (
+        [] if args.files
+        else sorted(glob.glob(os.path.join(args.dir, "ADV_r*.json")))
+    )
     try:
         rounds = load_series(paths)
         das_rounds = load_das_series(das_paths)
+        adv_rounds = load_adv_series(adv_paths)
     except MalformedRound as e:
         print(f"bench_trend: MALFORMED: {e}", file=sys.stderr)
         return 2
@@ -598,6 +712,7 @@ def main(argv: list[str] | None = None) -> int:
         rounds, args.threshold, gate_all=args.all_series
     )
     regressions += find_das_regressions(das_rounds, args.threshold)
+    regressions += find_adv_regressions(adv_rounds, args.threshold)
     stale = stale_gated_series(rounds, gate_all=args.all_series)
     seats = seat_changes(rounds)
     overrides = seat_overrides(rounds)
@@ -607,6 +722,7 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps({
             "rounds": [r["round"] for r in rounds],
             "das_rounds": [r["round"] for r in das_rounds],
+            "adv_rounds": [r["round"] for r in adv_rounds],
             "regressions": regressions,
             "stale": [s for s in stale if not s.get("hw_gated")],
             "hw_gated": [s for s in stale if s.get("hw_gated")],
@@ -620,6 +736,14 @@ def main(argv: list[str] | None = None) -> int:
             print(f"  das r{r['round']:02d}: "
                   f"{r['proofs_per_s']:9.2f} proofs/s  "
                   f"p99 {r['proof_p99_ms']:8.3f} ms"
+                  + (f"  [{r['platform']}]" if r.get("platform") else ""))
+        for r in adv_rounds:
+            rep = r["repair"]
+            print(f"  adv r{r['round']:02d}: monotone={r['all_monotone']} "
+                  f"honest={r['honest_identical']} "
+                  f"detected={r['adversaries_detected']} "
+                  f"repair {rep.get('total_ms')} ms "
+                  f"(recovered={rep.get('recovered')})"
                   + (f"  [{r['platform']}]" if r.get("platform") else ""))
         for c in seats:
             print(f"  SEAT CHANGE: {c['seat']} {c['from']} -> {c['to']} "

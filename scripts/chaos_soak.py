@@ -73,6 +73,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import re
 import sys
 import tempfile
 import time
@@ -95,6 +96,29 @@ def _arm_flight_recorder() -> str:
         d = tempfile.mkdtemp(prefix="chaos-flight-")
         os.environ["CELESTIA_FLIGHT_DIR"] = d
     return d
+
+
+def _pin_flight_interval(seconds: float = 3600.0):
+    """Pin the flight recorder's per-trigger rate limit to a
+    drill-spanning window, returning a restore callable.
+
+    The adversarial drills assert EXACTLY ONE bundle per trigger per
+    drill; that must hold because the first detection black-boxed and
+    the rest suppressed, not because the drill happened to finish inside
+    the default 30 s window on a fast host (200-trial runs on the CPU
+    fallback do not).  An operator-set interval is left alone."""
+    key = "CELESTIA_FLIGHT_MIN_INTERVAL_S"
+    prev = os.environ.get(key)
+    if not prev:
+        os.environ[key] = str(seconds)
+
+    def restore() -> None:
+        if not prev:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = prev
+
+    return restore
 
 
 def _first_dump_after(t0_ns: int, trigger: str | None = None) -> dict | None:
@@ -558,6 +582,339 @@ def run_speculation_drill(k: int = 4, blocks: int = 6,
     }
 
 
+#: DAS sample counts the withholding drill sweeps (the detection-
+#: probability curve's x axis, after the Polar Coded Merkle Tree papers'
+#: availability-attack benchmarks: P(detect | s samples) = 1 - (1-f)^s).
+DAS_SAMPLE_COUNTS = (2, 4, 8, 16, 32, 64)
+
+
+def _adv_square(k: int, seed: int = 515):
+    """One committed square + its serve-plane state (cache entry,
+    sampler, provider) — the fixture every adversary drill samples."""
+    from celestia_app_tpu.da.dah import DataAvailabilityHeader
+    from celestia_app_tpu.da.eds import ExtendedDataSquare
+    from celestia_app_tpu.serve.api import DasProvider
+    from celestia_app_tpu.serve.cache import ForestCache
+    from celestia_app_tpu.serve.sampler import ProofSampler
+
+    _, ods = _deterministic_blocks(1, k, seed=seed)[0]
+    eds = ExtendedDataSquare.compute(ods)
+    dah = DataAvailabilityHeader.from_eds(eds)
+    cache = ForestCache(heights=2, spill=2)
+    entry = cache.put(1, eds)
+    provider = DasProvider(cache=cache, sampler=ProofSampler())
+    return eds, dah, entry, provider
+
+
+def run_withholding_drill(
+    k: int = 8,
+    fracs: tuple[float, ...] = (0.05, 0.10, 0.25),
+    trials: int = 200,
+    sample_counts: tuple[int, ...] = DAS_SAMPLE_COUNTS,
+) -> dict:
+    """The detection-probability-vs-sample-count measurement (the ROADMAP
+    adversarial item, unblocked by PR 8's serve plane).
+
+    A withholding proposer commits the honest DAH but hides a random
+    `withhold_frac` of the EDS shares.  Light clients draw uniform DAS
+    samples THROUGH ProofSampler — the same plane `GET /das/share_proof`
+    serves — and a sample landing on a withheld share raises
+    ShareWithheld: that failed sample IS detection.  For each fraction
+    the drill runs `trials` independent clients, each drawing up to
+    max(sample_counts) samples, and reports P(detect within s) for every
+    s — NESTED sampling (s samples are the first s of the client's
+    draw), so the measured curve is monotone in s by construction, as
+    the analytic 1-(1-f)^s is.
+
+    Then the repair-to-recovery leg: after detection, a full node
+    gathers the surviving shares (everything the adversary did not
+    withhold) and runs the BATCHED repair; recovery = repaired roots
+    match the committed DAH.  The drill reports detect_ms (first
+    detecting sample) + repair_ms separately.
+
+    The honest leg pins the attack surface closed: a spec with every
+    adversary key AT ZERO must serve proofs byte-identical to no chaos
+    at all."""
+    from celestia_app_tpu import chaos
+    from celestia_app_tpu.da.repair import repair
+    from celestia_app_tpu.rpc.codec import to_jsonable
+    from celestia_app_tpu.serve.api import render
+    from celestia_app_tpu.serve.sampler import ShareWithheld
+    from celestia_app_tpu.trace import flight_recorder
+
+    _arm_flight_recorder()
+    eds, dah, entry, provider = _adv_square(k)
+    honest_root = eds.data_root()
+    n = 2 * k
+    s_max = max(sample_counts)
+
+    # Honest leg: adversary keys at 0 == no chaos, byte for byte.
+    probe = [(r, c) for r in range(n) for c in range(min(n, 4))]
+    chaos.install("")
+    baseline = [
+        render(to_jsonable(p))
+        for p in provider.sampler.sample_batch(provider.entry(1), probe)
+    ]
+    chaos.install("seed=21,withhold_frac=0,malform_shares=0,wrong_root=0")
+    keys_zero = [
+        render(to_jsonable(p))
+        for p in provider.sampler.sample_batch(provider.entry(1), probe)
+    ]
+    honest_identical = keys_zero == baseline
+
+    flight_recorder._reset_for_tests()
+    _restore_interval = _pin_flight_interval()
+    try:
+        t0_ns = time.time_ns()
+        curves = []
+        all_monotone = True
+        for frac in fracs:
+            chaos.install(f"seed=21,withhold_frac={frac}")
+            ent = provider.entry(1)
+            client = np.random.default_rng(4242)
+            first_detect = []
+            for _ in range(trials):
+                idx = s_max  # not detected within the client's budget
+                for i in range(s_max):
+                    r = int(client.integers(0, n))
+                    c = int(client.integers(0, n))
+                    try:
+                        proof = provider.sampler.share_proof(ent, r, c)
+                    except ShareWithheld:
+                        idx = i
+                        break
+                    # Served samples must still be honest, verifying proofs.
+                    if not proof.verify(honest_root):
+                        idx = -1  # invalid proof served: drill failure
+                        break
+                first_detect.append(idx)
+            if any(i < 0 for i in first_detect):
+                curves.append({"withhold_frac": frac, "p_detect": None,
+                               "invalid_proof_served": True})
+                all_monotone = False
+                continue
+            p_detect = {
+                str(s): round(
+                    sum(1 for i in first_detect if i < s) / trials, 4
+                )
+                for s in sample_counts
+            }
+            vals = [p_detect[str(s)] for s in sample_counts]
+            monotone = all(b >= a for a, b in zip(vals, vals[1:]))
+            all_monotone = all_monotone and monotone
+            curves.append({
+                "withhold_frac": frac,
+                "p_detect": p_detect,
+                "monotone": monotone,
+                "expected_at_max": round(1 - (1 - frac) ** s_max, 4),
+            })
+
+        # Repair-to-recovery at the heaviest fraction: detect -> gather
+        # survivors -> batched repair -> roots match the committed DAH.
+        frac = max(fracs)
+        chaos.install(f"seed=21,withhold_frac={frac}")
+        adv = chaos.active_adversary()
+        withheld = adv.withheld_set(1, n)
+        ent = provider.entry(1)
+        client = np.random.default_rng(777)
+        t_detect0 = time.perf_counter()
+        detect_ms = None
+        for _ in range(64 * 64):
+            r = int(client.integers(0, n))
+            c = int(client.integers(0, n))
+            try:
+                provider.sampler.share_proof(ent, r, c)
+            except ShareWithheld:
+                detect_ms = (time.perf_counter() - t_detect0) * 1e3
+                break
+        recovered = False
+        repair_ms = None
+        if detect_ms is not None:
+            present = np.ones((n, n), dtype=bool)
+            for (r, c) in withheld:
+                present[r, c] = False
+            full = np.asarray(eds.squared())
+            damaged = np.where(present[..., None], full, 0).astype(np.uint8)
+            # Warm the sweep + pipeline compiles for this erasure shape (the
+            # bench convention: a serving node's jit cache is warm; the
+            # latency recorded is the repair, not the first-ever compile).
+            try:
+                repair(damaged.copy(), present, dah)
+            except Exception:  # noqa: BLE001 — the timed leg reports it
+                pass
+            t_rep0 = time.perf_counter()
+            try:
+                out = repair(damaged, present, dah)
+                repair_ms = (time.perf_counter() - t_rep0) * 1e3
+                recovered = out.data_root() == honest_root
+            except Exception as e:  # noqa: BLE001 — recorded as drill failure
+                repair_ms = (time.perf_counter() - t_rep0) * 1e3
+                recovered = False
+                print(f"withholding drill: repair failed: {e}", file=sys.stderr)
+        chaos.uninstall()
+    finally:
+        _restore_interval()
+    wh_dumps = flight_recorder.recent_dumps(
+        since_ns=t0_ns, trigger="withholding_detected"
+    )
+    return {
+        "k": k,
+        "trials": trials,
+        "sample_counts": list(sample_counts),
+        "detection": curves,
+        "honest_identical": honest_identical,
+        "all_monotone": all_monotone,
+        "repair": {
+            "withhold_frac": frac,
+            "withheld_shares": len(withheld),
+            "detect_ms": round(detect_ms, 3) if detect_ms else None,
+            "repair_ms": round(repair_ms, 3) if repair_ms else None,
+            "total_ms": (
+                round(detect_ms + repair_ms, 3)
+                if detect_ms and repair_ms else None
+            ),
+            "recovered": recovered,
+        },
+        # The rate limit makes a drill-long storm of detections ONE
+        # bundle: the first detection black-boxes, the rest suppress.
+        "flight_dumps": len(wh_dumps),
+        "detection_signal": _detection(t0_ns, trigger="withholding_detected"),
+        "ok": (
+            honest_identical and all_monotone and recovered
+            and len(wh_dumps) == 1
+        ),
+    }
+
+
+def run_adversary_detection_drill(k: int = 8) -> dict:
+    """Malformed-square + wrong-root injections must ALWAYS be detected
+    (sampler verification or repair RootMismatch) and never served as
+    valid proofs — with each adversary event producing exactly ONE
+    flight bundle per drill under the rate limit.
+
+      malform leg   every coordinate of the tampered square is sampled;
+                    proofs over corrupted shares raise BadProofDetected,
+                    everything served must verify against the honest
+                    root; a corrupted SURVIVOR fed to repair raises
+                    RootMismatch (the full-node face of the detection).
+      wrong-root leg  the served root is forged: NO sample can produce
+                    a proof chaining to it (all raise), and a repair
+                    against a wrong commitment raises RootMismatch.
+    """
+    from celestia_app_tpu import chaos
+    from celestia_app_tpu.da.dah import DataAvailabilityHeader
+    from celestia_app_tpu.da.eds import ExtendedDataSquare
+    from celestia_app_tpu.da.repair import RootMismatch, repair
+    from celestia_app_tpu.serve.sampler import BadProofDetected
+    from celestia_app_tpu.trace import flight_recorder
+
+    _arm_flight_recorder()
+    eds, dah, entry, provider = _adv_square(k, seed=616)
+    honest_root = eds.data_root()
+    n = 2 * k
+    full = np.asarray(eds.squared())
+    flight_recorder._reset_for_tests()
+    _restore_interval = _pin_flight_interval()
+    try:
+        t0_ns = time.time_ns()
+
+        # --- malform leg -------------------------------------------------------
+        chaos.install("seed=13,malform_shares=4")
+        adv = chaos.active_adversary()
+        mal_entry = provider.entry(1)
+        corrupted = set(adv.malformed_coords(1, n))
+        detected, served_valid, served_invalid = 0, 0, 0
+        for r in range(n):
+            for c in range(n):
+                try:
+                    proof = provider.sampler.share_proof(mal_entry, r, c)
+                except BadProofDetected:
+                    detected += 1
+                    continue
+                if proof.verify(honest_root):
+                    served_valid += 1
+                else:
+                    served_invalid += 1
+        malform_ok = (
+            detected == len(corrupted)
+            and served_invalid == 0
+            and served_valid == n * n - len(corrupted)
+        )
+
+        # The full-node face: one corrupted SURVIVOR in a repair input must
+        # reject the whole reconstruction (RootMismatch), never pass.
+        present = np.ones((n, n), dtype=bool)
+        present[k:, k:] = False
+        damaged = np.where(present[..., None], full, 0).astype(np.uint8)
+        damaged = adv.corrupt_square(1, damaged)
+        try:
+            repair(damaged, present, dah)
+            repair_detected = False
+        except RootMismatch:
+            repair_detected = True
+
+        # --- wrong-root leg ----------------------------------------------------
+        chaos.install("seed=13,wrong_root=1")
+        wr_entry = provider.entry(1)
+        root_forged = wr_entry.data_root != honest_root
+        wr_detected = 0
+        probe = [(0, 0), (k, k), (n - 1, n - 1), (0, n - 1)]
+        for r, c in probe:
+            try:
+                provider.sampler.share_proof(wr_entry, r, c)
+            except BadProofDetected:
+                wr_detected += 1
+        # A light node repairing against a wrong commitment must refuse it.
+        other = _deterministic_blocks(1, k, seed=617)[0][1]
+        wrong_dah = DataAvailabilityHeader.from_eds(
+            ExtendedDataSquare.compute(other)
+        )
+        clean = np.where(present[..., None], full, 0).astype(np.uint8)
+        try:
+            repair(clean, present, wrong_dah)
+            wrong_root_repair_detected = False
+        except RootMismatch:
+            wrong_root_repair_detected = True
+        chaos.uninstall()
+    finally:
+        _restore_interval()
+
+    rm_dumps = flight_recorder.recent_dumps(
+        since_ns=t0_ns, trigger="root_mismatch"
+    )
+    return {
+        "k": k,
+        "malform": {
+            "corrupted_shares": len(corrupted),
+            "detected": detected,
+            "served_valid": served_valid,
+            "served_invalid": served_invalid,
+            "repair_detected": repair_detected,
+            "ok": malform_ok and repair_detected,
+        },
+        "wrong_root": {
+            "root_forged": root_forged,
+            "samples_detected": wr_detected,
+            "samples_probed": len(probe),
+            "repair_detected": wrong_root_repair_detected,
+            "ok": (
+                root_forged
+                and wr_detected == len(probe)
+                and wrong_root_repair_detected
+            ),
+        },
+        # One bundle per drill: every further root_mismatch suppresses
+        # against the first under the default rate limit.
+        "flight_dumps": len(rm_dumps),
+        "detection": _detection(t0_ns, trigger="root_mismatch"),
+        "ok": (
+            malform_ok and repair_detected and root_forged
+            and wr_detected == len(probe) and wrong_root_repair_detected
+            and len(rm_dumps) == 1
+        ),
+    }
+
+
 def run_batched_fault_drill(k: int = 4, blocks: int = 6,
                             batch: int = 2) -> dict:
     """A persistent batched-dispatch fault must fall DOWN the ladder, not
@@ -659,11 +1016,52 @@ def detection_table(rows: list[tuple[str, dict | None]]) -> str:
     return "\n".join(out)
 
 
+def write_adv_round(path: str, wd: dict, adv: dict, wall_s: float) -> None:
+    """The checked-in ADV_rNN.json shape (bench_trend gates it): the
+    measured detection-probability table, the repair-to-recovery
+    latency, and the always-detected verdicts for the tampering
+    adversaries."""
+    import json
+
+    import jax
+
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:  # chaos-ok: record the round even with no backend
+        platform = "unknown"
+    m = re.search(r"ADV_r(\d+)\.json$", os.path.basename(path))
+    rec = {
+        "n": int(m.group(1)) if m else 1,
+        "schema": "adv-v1",
+        "platform": platform,
+        "k": wd["k"],
+        "trials": wd["trials"],
+        "sample_counts": wd["sample_counts"],
+        "detection": wd["detection"],
+        "repair": wd["repair"],
+        "honest_identical": wd["honest_identical"],
+        "all_monotone": wd["all_monotone"],
+        "adversaries_detected": {
+            "malform": adv["malform"]["ok"],
+            "wrong_root": adv["wrong_root"]["ok"],
+        },
+        "wall_s": round(wall_s, 1),
+    }
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--blocks", type=int, default=20)
     ap.add_argument("--k", type=int, default=16)
     ap.add_argument("--spec", default=DEFAULT_SPEC)
+    ap.add_argument("--adv-out", metavar="ADV_rNN.json",
+                    help="write the withholding drill's detection-"
+                         "probability round record here")
+    ap.add_argument("--adv-trials", type=int, default=200,
+                    help="withholding drill clients per fraction")
     args = ap.parse_args(argv)
 
     flight_dir = _arm_flight_recorder()
@@ -713,6 +1111,36 @@ def main(argv=None) -> int:
     if not bat["ok"]:
         failures.append(f"batched-fault drill failed: {bat}")
 
+    t_adv0 = time.monotonic()
+    wd = run_withholding_drill(k=min(args.k, 8), trials=args.adv_trials)
+    print(f"withholding drill: {wd['trials']} clients x "
+          f"{max(wd['sample_counts'])} samples @ k={wd['k']} -> "
+          f"monotone={wd['all_monotone']} "
+          f"honest_identical={wd['honest_identical']} "
+          f"repair_recovered={wd['repair']['recovered']} "
+          f"(detect {wd['repair']['detect_ms']} ms + repair "
+          f"{wd['repair']['repair_ms']} ms)", flush=True)
+    for curve in wd["detection"]:
+        print(f"  withhold_frac={curve['withhold_frac']}: "
+              f"{curve['p_detect']}", flush=True)
+    if not wd["ok"]:
+        failures.append(f"withholding drill failed: {wd}")
+
+    adv = run_adversary_detection_drill(k=min(args.k, 8))
+    print(f"adversary drill: malform detected={adv['malform']['detected']}/"
+          f"{adv['malform']['corrupted_shares']} "
+          f"served_invalid={adv['malform']['served_invalid']} "
+          f"repair_detected={adv['malform']['repair_detected']}; "
+          f"wrong_root detected={adv['wrong_root']['samples_detected']}/"
+          f"{adv['wrong_root']['samples_probed']} "
+          f"repair_detected={adv['wrong_root']['repair_detected']} "
+          f"flight_dumps={adv['flight_dumps']}", flush=True)
+    if not adv["ok"]:
+        failures.append(f"adversary drill failed: {adv}")
+    if args.adv_out:
+        write_adv_round(args.adv_out, wd, adv, time.monotonic() - t_adv0)
+        print(f"adversary round record -> {args.adv_out}", flush=True)
+
     gos = run_gossip_drill(args.spec)
     print(f"gossip drill: {gos['sent_unique']} unique msgs converged in "
           f"{gos['rounds']} flood rounds -> {gos['deliveries']} deliveries, "
@@ -752,6 +1180,8 @@ def main(argv=None) -> int:
         ("sampling", smp.get("detection")),  # healed by host fallback
         ("speculation", spc.get("detection")),  # discards heal silently
         ("batched fault", bat.get("detection")),
+        ("withholding", wd.get("detection_signal")),
+        ("adversary", adv.get("detection")),
         ("gossip", None),  # healed by redundancy: no anomaly to page on
         ("breaker (epi seat)", brk_epi.get("detection")),
         ("breaker (fused)", brk.get("detection")),

@@ -476,3 +476,132 @@ class TestDasSeries:
         prom = (out_dir / "bench_trend.prom").read_text()
         assert "celestia_bench_trend_das" in prom
         assert 'series="proofs_per_s"' in prom
+
+def _adv_file(tmp_path, n, *, total_ms=30.0, recovered=True, monotone=True,
+              honest=True, malform=True, wrong_root=True, platform="cpu"):
+    p = ({"2": 0.5, "4": 0.7, "8": 0.9} if monotone
+         else {"2": 0.9, "4": 0.5, "8": 0.7})
+    path = tmp_path / f"ADV_r{n:02d}.json"
+    path.write_text(json.dumps({
+        "n": n, "schema": "adv-v1", "platform": platform, "k": 8,
+        "trials": 50, "sample_counts": [2, 4, 8],
+        "detection": [{"withhold_frac": 0.25, "p_detect": p,
+                       "monotone": monotone}],
+        "repair": {"withhold_frac": 0.25, "withheld_shares": 64,
+                   "detect_ms": 1.0, "repair_ms": total_ms - 1.0,
+                   "total_ms": total_ms, "recovered": recovered},
+        "honest_identical": honest, "all_monotone": monotone,
+        "adversaries_detected": {"malform": malform,
+                                 "wrong_root": wrong_root},
+    }))
+    return str(path)
+
+
+class TestAdvSeries:
+    """The adversarial-drill trajectory (scripts/chaos_soak.py --adv-out):
+    invariants gate hard, repair-to-recovery latency gates like a parts
+    time under the same-platform rule."""
+
+    def test_checked_in_adv_round_parses_and_renders(self, capsys):
+        bt = _load()
+        assert bt.main(["--check"]) == 0
+        out = capsys.readouterr().out
+        assert "adv r01" in out and "monotone=True" in out
+
+    def test_non_monotone_detection_is_flagged(self, tmp_path, capsys):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _adv_file(tmp_path, 1, monotone=False)
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        assert "adv.detection_monotone" in capsys.readouterr().out
+
+    def test_honest_divergence_is_flagged(self, tmp_path, capsys):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _adv_file(tmp_path, 1, honest=False)
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        assert "adv.honest_identical" in capsys.readouterr().out
+
+    def test_undetected_adversary_is_flagged(self, tmp_path, capsys):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _adv_file(tmp_path, 1, wrong_root=False)
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        assert "adv.detected.wrong_root" in capsys.readouterr().out
+
+    def test_failed_recovery_is_flagged(self, tmp_path, capsys):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _adv_file(tmp_path, 1, recovered=False)
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        assert "adv.repair_recovered" in capsys.readouterr().out
+
+    def test_repair_latency_regression_is_flagged(self, tmp_path, capsys):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _adv_file(tmp_path, 1, total_ms=30.0)
+        _adv_file(tmp_path, 2, total_ms=90.0)  # 3x slower recovery
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        assert "adv.repair_total_ms" in capsys.readouterr().out
+
+    def test_cross_platform_latency_prior_not_compared(self, tmp_path):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _adv_file(tmp_path, 1, total_ms=2.0, platform="tpu")
+        _adv_file(tmp_path, 2, total_ms=90.0, platform="cpu")
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+
+    def test_healthy_round_passes_and_lands_in_json(self, tmp_path, capsys):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _adv_file(tmp_path, 1)
+        assert bt.main(["--dir", str(tmp_path), "--json"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["adv_rounds"] == [1]
+
+    def test_malformed_adv_round_exits_2(self, tmp_path):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        (tmp_path / "ADV_r01.json").write_text(json.dumps({"n": 1}))
+        assert bt.main(["--dir", str(tmp_path)]) == 2
+
+
+class TestRepairGatedSeries:
+    """ISSUE-10 satellite: `repair` promoted from --all-series-only into
+    the default gated set (compute-bound after the batched rework);
+    `repair_grouped` (the bench's A/B baseline row) stays ungated."""
+
+    def test_repair_is_gated_by_default(self, tmp_path, capsys):
+        bt = _load()
+        assert "repair" in bt.GATED_MODES
+        assert "repair" not in bt.LINK_BOUND_MODES
+        _round_file(tmp_path, 1, [
+            {"mode": "repair", "k": 128, "mb_per_s": 60.0},
+        ], platform="cpu")
+        _round_file(tmp_path, 2, [
+            {"mode": "repair", "k": 128, "mb_per_s": 30.0},  # -50%
+        ], platform="cpu")
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        assert "repair@128" in capsys.readouterr().out
+
+    def test_repair_same_platform_prior_rule(self, tmp_path):
+        bt = _load()
+        # A chip repair number must not gate a CPU-fallback round.
+        _round_file(tmp_path, 1, [
+            {"mode": "repair", "k": 128, "mb_per_s": 400.0},
+        ], platform="tpu")
+        _round_file(tmp_path, 2, [
+            {"mode": "repair", "k": 128, "mb_per_s": 60.0},
+        ], platform="cpu")
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+
+    def test_repair_grouped_baseline_not_gated(self, tmp_path):
+        bt = _load()
+        assert "repair_grouped" not in bt.GATED_MODES
+        _round_file(tmp_path, 1, [
+            {"mode": "repair_grouped", "k": 128, "mb_per_s": 60.0},
+        ], platform="cpu")
+        _round_file(tmp_path, 2, [
+            {"mode": "repair_grouped", "k": 128, "mb_per_s": 10.0},
+        ], platform="cpu")
+        assert bt.main(["--dir", str(tmp_path)]) == 0

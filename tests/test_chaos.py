@@ -300,6 +300,41 @@ class TestChaosSmoke:
         assert result["unbatched_falls"] >= 1
         assert result["final_mode"] == "staged"
 
+    def test_withholding_drill_detection_curve(self, monkeypatch, tmp_path):
+        """The ISSUE-10 withholding drill at smoke scale: monotone
+        detection curve, honest leg bit-identical with every adversary
+        key at 0, repair-to-recovery lands on the committed DAH, and the
+        detection storm black-boxes exactly once."""
+        monkeypatch.setenv("CELESTIA_FLIGHT_DIR", str(tmp_path))
+        soak = _load_soak()
+        result = soak.run_withholding_drill(
+            k=4, fracs=(0.1, 0.25), trials=25
+        )
+        assert result["ok"], result
+        assert result["honest_identical"]
+        assert result["all_monotone"]
+        assert result["repair"]["recovered"]
+        assert result["flight_dumps"] == 1
+        # The measured curve ascends toward 1-(1-f)^s.
+        top = result["detection"][-1]["p_detect"]
+        assert top["64"] >= top["2"]
+
+    def test_adversary_detection_drill_always_detects(self, monkeypatch,
+                                                      tmp_path):
+        """Malformed-square and wrong-root injections: every corrupted
+        proof refused, nothing invalid served, repair rejects both, one
+        flight bundle per drill under the rate limit."""
+        monkeypatch.setenv("CELESTIA_FLIGHT_DIR", str(tmp_path))
+        soak = _load_soak()
+        result = soak.run_adversary_detection_drill(k=4)
+        assert result["ok"], result
+        assert result["malform"]["served_invalid"] == 0
+        assert result["malform"]["detected"] == result["malform"]["corrupted_shares"]
+        assert result["wrong_root"]["samples_detected"] == result["wrong_root"]["samples_probed"]
+        assert result["malform"]["repair_detected"]
+        assert result["wrong_root"]["repair_detected"]
+        assert result["flight_dumps"] == 1
+
     def test_soak_main_smoke(self, capsys, monkeypatch, tmp_path):
         """The script's own entry point end to end (tiny knobs).
 
@@ -310,6 +345,7 @@ class TestChaosSmoke:
         soak = _load_soak()
         rc = soak.main([
             "--blocks", "3", "--k", "4",
+            "--adv-trials", "20",
             "--spec", "seed=9,dispatch_fail=0.3,gossip_drop=0.2,"
                       "wal_torn_tail=1",
         ])
@@ -317,6 +353,9 @@ class TestChaosSmoke:
         assert rc == 0, out
         assert "chaos_soak: OK" in out
         assert "celestia_chaos_injections_total" in out
+        # The adversarial drills print their verdicts.
+        assert "withholding drill" in out
+        assert "adversary drill" in out
         # The per-drill detection-latency summary prints, and the
         # breaker drills page via the SLO engine.
         assert "time-to-detection per drill" in out
@@ -624,3 +663,211 @@ class TestTransportAndSeams:
         finally:
             degrade.reset_for_tests()
         assert health_payload()["status"] == "SERVING"
+
+
+class TestAdversary:
+    """chaos/adversary.py: the protocol-adversary layer (ISSUE 10) —
+    spec keys, determinism, tampering, and the serve-plane seams."""
+
+    @staticmethod
+    def _square(k=2, seed=41):
+        import numpy as np
+
+        from celestia_app_tpu.constants import NAMESPACE_SIZE, SHARE_SIZE
+        from celestia_app_tpu.da.eds import ExtendedDataSquare
+
+        rng = np.random.default_rng(seed)
+        n = k * k
+        ods = rng.integers(0, 256, (n, SHARE_SIZE), dtype=np.uint8)
+        ods[:, :NAMESPACE_SIZE] = 0
+        ods[:, NAMESPACE_SIZE - 1] = np.sort(
+            rng.integers(0, 200, n).astype(np.uint8)
+        )
+        return ExtendedDataSquare.compute(ods.reshape(k, k, SHARE_SIZE))
+
+    def test_adversary_keys_parse_and_zero_means_none(self):
+        chaos.install("seed=3,withhold_frac=0.25,malform_shares=2,wrong_root=1")
+        adv = chaos.active_adversary()
+        assert adv is not None
+        assert adv.withhold_frac == 0.25
+        assert adv.malform_shares == 2 and adv.wrong_root
+        # Every key at 0 = NO adversary (the honest fast path).
+        chaos.install("seed=3,withhold_frac=0,malform_shares=0,wrong_root=0")
+        assert chaos.active_adversary() is None
+        chaos.uninstall()
+        assert chaos.active_adversary() is None
+
+    def test_unknown_adversary_key_still_rejected(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            chaos.install("withold_frac=0.1")  # typo'd key must be loud
+
+    def test_withheld_set_deterministic_and_order_independent(self):
+        chaos.install("seed=7,withhold_frac=0.25")
+        a = chaos.active_adversary()
+        s1 = a.withheld_set(5, 8)
+        # A FRESH injector from the same spec draws the same set, and
+        # querying another height first must not perturb it (the
+        # per-(seed, seam, height, width) RNG contract).
+        chaos.install("seed=7,withhold_frac=0.25")
+        b = chaos.active_adversary()
+        b.withheld_set(9, 8)
+        assert b.withheld_set(5, 8) == s1
+        assert len(s1) == int(0.25 * 64)
+        # A different seed draws a different set.
+        chaos.install("seed=8,withhold_frac=0.25")
+        assert chaos.active_adversary().withheld_set(5, 8) != s1
+        chaos.uninstall()
+
+    def test_tampered_entry_is_memoized_and_cache_untouched(self):
+        import numpy as np
+
+        from celestia_app_tpu.serve.cache import ForestCache
+
+        eds = self._square(k=2)
+        cache = ForestCache(heights=1, spill=1)
+        entry = cache.put(7, eds)
+        honest = np.asarray(entry.eds._eds).copy()
+        chaos.install("seed=5,malform_shares=2,wrong_root=1")
+        try:
+            adv = chaos.active_adversary()
+            t1 = adv.tamper_entry(entry)
+            t2 = adv.tamper_entry(entry)
+            assert t1 is t2, "one corrupted square per height, not per call"
+            assert t1.data_root != entry.data_root
+            assert not np.array_equal(np.asarray(t1.eds._eds), honest)
+            # The honest cache entry is untouched (consensus state safe).
+            assert np.array_equal(np.asarray(entry.eds._eds), honest)
+        finally:
+            chaos.uninstall()
+
+    def test_withheld_sample_never_served_others_fine(self):
+        from celestia_app_tpu.serve.cache import ForestCache
+        from celestia_app_tpu.serve.sampler import ProofSampler, ShareWithheld
+
+        import pytest
+
+        eds = self._square(k=2)
+        root = eds.data_root()
+        cache = ForestCache(heights=1, spill=1)
+        entry = cache.put(2, eds)
+        sampler = ProofSampler()
+        chaos.install("seed=6,withhold_frac=0.3")
+        try:
+            adv = chaos.active_adversary()
+            withheld = adv.withheld_set(2, 4)
+            hit = next(iter(withheld))
+            ok = next(
+                (r, c) for r in range(4) for c in range(4)
+                if (r, c) not in withheld
+            )
+            with pytest.raises(ShareWithheld):
+                sampler.share_proof(entry, *hit)
+            proof = sampler.share_proof(entry, *ok)
+            assert proof.verify(root)
+        finally:
+            chaos.uninstall()
+
+    def test_verification_gate_refuses_tampered_proofs_both_lowerings(
+        self, monkeypatch
+    ):
+        from celestia_app_tpu.serve.api import DasProvider
+        from celestia_app_tpu.serve.cache import ForestCache
+        from celestia_app_tpu.serve.sampler import BadProofDetected, ProofSampler
+
+        import pytest
+
+        eds = self._square(k=2)
+        cache = ForestCache(heights=1, spill=1)
+        cache.put(4, eds)
+        provider = DasProvider(cache=cache, sampler=ProofSampler())
+        chaos.install("seed=9,wrong_root=1")
+        try:
+            entry = provider.entry(4)
+            with pytest.raises(BadProofDetected):
+                provider.sampler.sample_batch(entry, [(0, 0)])
+            monkeypatch.setenv("CELESTIA_SERVE_MODE", "host")
+            with pytest.raises(BadProofDetected):
+                provider.sampler.sample_batch(entry, [(1, 1)])
+        finally:
+            monkeypatch.delenv("CELESTIA_SERVE_MODE", raising=False)
+            chaos.uninstall()
+
+    def test_shares_by_namespace_rides_the_verification_gate(self):
+        """GetSharesByNamespace builds its proof outside the sampler's
+        batch queue, but under a tampering adversary it must hit the
+        SAME verification gate: a forged root (or corrupted shares)
+        raises BadProofDetected — never a 200 endorsing forged state —
+        while the honest path is untouched."""
+        import numpy as np
+
+        import pytest
+
+        from celestia_app_tpu.serve.api import DasProvider
+        from celestia_app_tpu.serve.cache import ForestCache
+        from celestia_app_tpu.serve.sampler import BadProofDetected, ProofSampler
+
+        eds = self._square(k=2)
+        cache = ForestCache(heights=1, spill=1)
+        cache.put(5, eds)
+        provider = DasProvider(cache=cache, sampler=ProofSampler())
+        from celestia_app_tpu.constants import NAMESPACE_SIZE
+
+        sq = np.asarray(eds.squared())
+        ns_hex = bytes(sq[0, 0][:NAMESPACE_SIZE].tobytes()).hex()
+        # Honest: the namespace payload serves and verifies.
+        payload = provider.shares_payload(5, ns_hex)
+        assert payload["found"]
+        # Wrong root: EVERY namespace payload is refused (the honest
+        # proof cannot chain to the forged root).
+        chaos.install("seed=9,wrong_root=1")
+        try:
+            with pytest.raises(BadProofDetected):
+                provider.shares_payload(5, ns_hex)
+        finally:
+            chaos.uninstall()
+        # Malform: seed=8 corrupts ODS shares (1,0) and (1,1) at this
+        # square size — a range containing a corrupted share is refused
+        # (honest committed structure, corrupted served bytes), while a
+        # range of untouched shares still serves honestly-verifying
+        # proofs (the malform detection model: you detect what you
+        # sample).
+        ns_hit = bytes(sq[1, 0][:NAMESPACE_SIZE].tobytes()).hex()
+        chaos.install("seed=8,malform_shares=2")
+        try:
+            adv = chaos.active_adversary()
+            assert {(1, 0), (1, 1)} <= set(adv.malformed_coords(5, 4))
+            with pytest.raises(BadProofDetected):
+                provider.shares_payload(5, ns_hit)
+        finally:
+            chaos.uninstall()
+
+    def test_repair_sweep_rides_the_ladder(self):
+        """An injected dispatch fault during a repair sweep steps the
+        fused-family batched rung down to the grouped (staged) sweep —
+        roots still exact."""
+        import numpy as np
+
+        from celestia_app_tpu.chaos import degrade
+        from celestia_app_tpu.da import DataAvailabilityHeader, repair
+        from celestia_app_tpu.kernels.fused import pipeline_mode
+
+        k = 2
+        eds = self._square(k=k, seed=43)
+        full = np.asarray(eds.squared())
+        dah = DataAvailabilityHeader.from_eds(eds)
+        present = np.zeros((2 * k, 2 * k), dtype=bool)
+        rng = np.random.default_rng(3)
+        for r in range(2 * k):
+            present[r, rng.choice(2 * k, size=k, replace=False)] = True
+        damaged = np.where(present[..., None], full, 0).astype(np.uint8)
+        degrade.reset_for_tests()
+        chaos.install("seed=2,dispatch_fail=1.0")
+        try:
+            out = repair(damaged, present, dah)
+            assert np.array_equal(out.squared(), full)
+            assert pipeline_mode() == "staged"
+        finally:
+            chaos.uninstall()
+            degrade.reset_for_tests()

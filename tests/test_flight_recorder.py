@@ -173,3 +173,98 @@ class TestSLOReport:
         report = self._load()
         assert report.main([str(tmp_path / "nope.json")]) == 2
         assert report.main([str(tmp_path)]) == 2  # empty dir
+
+
+class TestAdversaryTriggers:
+    """ISSUE-10 satellite: the adversary events black-box — repair's
+    RootMismatch fires `root_mismatch`, a withheld DAS sample fires
+    `withholding_detected`, both under the per-trigger rate limit."""
+
+    @staticmethod
+    def _square(k=2):
+        import numpy as np
+
+        from celestia_app_tpu.constants import NAMESPACE_SIZE, SHARE_SIZE
+        from celestia_app_tpu.da import DataAvailabilityHeader
+        from celestia_app_tpu.da.eds import ExtendedDataSquare
+
+        rng = np.random.default_rng(31)
+        n = k * k
+        ods = rng.integers(0, 256, (n, SHARE_SIZE), dtype=np.uint8)
+        ods[:, :NAMESPACE_SIZE] = 0
+        ods[:, NAMESPACE_SIZE - 1] = np.sort(
+            rng.integers(0, 200, n).astype(np.uint8)
+        )
+        eds = ExtendedDataSquare.compute(ods.reshape(k, k, SHARE_SIZE))
+        return eds, DataAvailabilityHeader.from_eds(eds)
+
+    def test_root_mismatch_trigger_from_repair(self, monkeypatch, tmp_path):
+        import numpy as np
+        import pytest
+
+        from celestia_app_tpu.da.repair import RootMismatch, repair
+
+        monkeypatch.setenv("CELESTIA_FLIGHT_DIR", str(tmp_path))
+        fr._reset_for_tests()
+        k = 2
+        eds, dah = self._square(k)
+        full = np.asarray(eds.squared())
+        present = np.ones((2 * k, 2 * k), dtype=bool)
+        present[k:, k:] = False
+        damaged = np.where(present[..., None], full, 0).astype(np.uint8)
+        damaged[0, 0, 100] ^= 0xFF  # corrupt a survivor
+        import time as _time
+
+        t0 = _time.time_ns()
+        with pytest.raises(RootMismatch):
+            repair(damaged, present, dah)
+        dumps = fr.recent_dumps(since_ns=t0, trigger="root_mismatch")
+        assert len(dumps) == 1
+        assert os.path.isfile(dumps[0]["path"])
+        # The rate limit holds: a second rejection in the same window
+        # suppresses instead of writing another bundle.
+        with pytest.raises(RootMismatch):
+            repair(damaged.copy(), present, dah)
+        assert len(fr.recent_dumps(since_ns=t0, trigger="root_mismatch")) == 1
+        assert _counter_value(
+            "celestia_flight_dumps_suppressed_total", trigger="root_mismatch"
+        ) >= 1.0
+
+    def test_withholding_trigger_from_sampler(self, monkeypatch, tmp_path):
+        import time as _time
+
+        import pytest
+
+        from celestia_app_tpu import chaos
+        from celestia_app_tpu.serve.cache import ForestCache
+        from celestia_app_tpu.serve.sampler import ProofSampler, ShareWithheld
+
+        monkeypatch.setenv("CELESTIA_FLIGHT_DIR", str(tmp_path))
+        fr._reset_for_tests()
+        k = 2
+        eds, _ = self._square(k)
+        cache = ForestCache(heights=1, spill=1)
+        entry = cache.put(3, eds)
+        sampler = ProofSampler()
+        chaos.install("seed=4,withhold_frac=0.5")
+        try:
+            adv = chaos.active_adversary()
+            hit = next(iter(adv.withheld_set(3, 2 * k)))
+            t0 = _time.time_ns()
+            with pytest.raises(ShareWithheld):
+                sampler.share_proof(entry, *hit)
+            dumps = fr.recent_dumps(
+                since_ns=t0, trigger="withholding_detected"
+            )
+            assert len(dumps) == 1
+            # A second withheld sample inside the window suppresses.
+            with pytest.raises(ShareWithheld):
+                sampler.share_proof(entry, *hit)
+            assert len(fr.recent_dumps(
+                since_ns=t0, trigger="withholding_detected"
+            )) == 1
+        finally:
+            chaos.uninstall()
+        assert _counter_value(
+            "celestia_da_detections_total", kind="withheld"
+        ) >= 1.0

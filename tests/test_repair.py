@@ -100,3 +100,126 @@ def test_quadrant_erasure_bigk_gf16():
     damaged[~present] = 0
     out = repair(damaged, present, dah)
     assert np.array_equal(out.squared(), full)
+
+
+def _damaged(full, present):
+    return np.where(present[..., None], full, 0).astype(np.uint8)
+
+
+class TestRepairEdgeCases:
+    """ISSUE-10 satellite: erasure patterns at / below the recoverability
+    threshold, axis-only erasures, and the batched-vs-grouped twin pin."""
+
+    def test_row_only_erasure(self):
+        """Entire rows gone (each surviving row complete): one column
+        sweep must restore everything."""
+        k = 4
+        eds, full = random_eds(k)
+        dah = DataAvailabilityHeader.from_eds(eds)
+        present = np.ones((2 * k, 2 * k), dtype=bool)
+        present[[1, 3, 5, 6], :] = False  # 4 of 8 rows gone (k survive per col)
+        out = repair(_damaged(full, present), present, dah)
+        assert np.array_equal(out.squared(), full)
+
+    def test_col_only_erasure(self):
+        k = 4
+        eds, full = random_eds(k)
+        dah = DataAvailabilityHeader.from_eds(eds)
+        present = np.ones((2 * k, 2 * k), dtype=bool)
+        present[:, [0, 2, 4, 7]] = False
+        out = repair(_damaged(full, present), present, dah)
+        assert np.array_equal(out.squared(), full)
+
+    def test_randomized_at_threshold(self):
+        """Exactly k survivors in every row — 75% of the square erased,
+        the edge of recoverability — across several random draws."""
+        k = 4
+        rng = np.random.default_rng(77)
+        eds, full = random_eds(k)
+        dah = DataAvailabilityHeader.from_eds(eds)
+        for _ in range(3):
+            present = np.zeros((2 * k, 2 * k), dtype=bool)
+            for r in range(2 * k):
+                present[r, rng.choice(2 * k, size=k, replace=False)] = True
+            out = repair(_damaged(full, present), present, dah)
+            assert np.array_equal(out.squared(), full)
+
+    def test_randomized_below_threshold_irrecoverable(self):
+        """k-1 survivors in every row AND every column has < k: no sweep
+        can start — IrrecoverableSquare, never a wrong square."""
+        k = 4
+        rng = np.random.default_rng(78)
+        _, full = random_eds(k)
+        for _ in range(3):
+            present = np.zeros((2 * k, 2 * k), dtype=bool)
+            # k-1 survivors per row, all packed into k-1 columns: every
+            # row AND every column is below k.
+            cols = rng.choice(2 * k, size=k - 1, replace=False)
+            present[:, cols] = True
+            with pytest.raises(IrrecoverableSquare):
+                repair(_damaged(full, present), present)
+
+    def test_ods_missing_data_crossword(self):
+        """Missing ODS data that needs the crossword (rows under k
+        survivors until columns restore them) — the batched solve's
+        data-first strategy must still converge."""
+        k = 4
+        eds, full = random_eds(k)
+        dah = DataAvailabilityHeader.from_eds(eds)
+        present = np.ones((2 * k, 2 * k), dtype=bool)
+        present[0, : k + 1] = False  # row 0: k-1 < k survivors, data gone
+        # Columns still have 2k-1 >= k survivors: the column sweep
+        # restores row 0's missing cells.
+        out = repair(_damaged(full, present), present, dah)
+        assert np.array_equal(out.squared(), full)
+
+
+class TestBatchedGroupedTwin:
+    """Regression pin: the batched sweep ($CELESTIA_REPAIR_SWEEP default)
+    and the frozen per-pattern-group baseline produce byte-identical
+    squares AND roots, randomized + quadrant erasures, both RS
+    constructions."""
+
+    @staticmethod
+    def _both(damaged, present, dah, monkeypatch):
+        monkeypatch.setenv("CELESTIA_REPAIR_SWEEP", "grouped")
+        grouped = repair(damaged.copy(), present, dah)
+        monkeypatch.delenv("CELESTIA_REPAIR_SWEEP")
+        batched = repair(damaged.copy(), present, dah)
+        assert np.array_equal(grouped.squared(), batched.squared())
+        assert grouped.data_root() == batched.data_root()
+        assert grouped.row_roots() == batched.row_roots()
+        assert grouped.col_roots() == batched.col_roots()
+        return batched
+
+    @pytest.mark.parametrize("construction", ["vandermonde", "leopard"])
+    @pytest.mark.parametrize("k", [2, 8])
+    def test_twin_quadrant_and_randomized(self, monkeypatch, k, construction):
+        monkeypatch.setenv("CELESTIA_RS_CONSTRUCTION", construction)
+        eds, full = random_eds(k)
+        dah = DataAvailabilityHeader.from_eds(eds)
+        rng = np.random.default_rng(500 + k)
+        # Quadrant erasure (pure parity: the batched path's zero-sweep case).
+        present = np.ones((2 * k, 2 * k), dtype=bool)
+        present[k:, k:] = False
+        out = self._both(_damaged(full, present), present, dah, monkeypatch)
+        assert np.array_equal(out.squared(), full)
+        # Randomized erasure touching the ODS (real batched sweeps).
+        present = np.zeros((2 * k, 2 * k), dtype=bool)
+        for r in range(2 * k):
+            present[r, rng.choice(2 * k, size=k, replace=False)] = True
+        out = self._both(_damaged(full, present), present, dah, monkeypatch)
+        assert np.array_equal(out.squared(), full)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("construction", ["vandermonde", "leopard"])
+    def test_twin_k32(self, monkeypatch, construction):
+        k = 32
+        monkeypatch.setenv("CELESTIA_RS_CONSTRUCTION", construction)
+        eds, full = random_eds(k)
+        dah = DataAvailabilityHeader.from_eds(eds)
+        present = np.ones((2 * k, 2 * k), dtype=bool)
+        present[k:, k:] = False
+        present[0, :k] = False  # mixed: parity quadrant + a data row
+        out = self._both(_damaged(full, present), present, dah, monkeypatch)
+        assert np.array_equal(out.squared(), full)

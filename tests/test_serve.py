@@ -101,13 +101,15 @@ class TestForestCache:
 
 
 def _counter_value(name: str, **labels) -> float:
+    """Sum over samples matching the label SUBSET (a family may carry
+    more labels than the query — e.g. proofs_served's capped namespace)."""
     metric = registry().get(name)
     if metric is None:
         return 0.0
-    for sample_labels, value in metric.samples():
-        if all(sample_labels.get(k) == v for k, v in labels.items()):
-            return value
-    return 0.0
+    return sum(
+        value for sample_labels, value in metric.samples()
+        if all(sample_labels.get(k) == v for k, v in labels.items())
+    )
 
 
 class TestSamplerQueue:
@@ -328,6 +330,45 @@ class TestDasPlanes:
             )
         assert exc3.value.code == 400
 
+    def test_adversary_detections_map_to_typed_grpc_statuses(self, planes):
+        """The gRPC plane must carry the same detection semantics the
+        HTTP planes express as 410/502: a withheld share answers
+        FAILED_PRECONDITION (ShareWithheld is a LookupError — without
+        the typed clause it escaped as an opaque UNKNOWN) and a
+        tampered square answers DATA_LOSS, never INVALID_ARGUMENT
+        (BadProofDetected subclasses ValueError)."""
+        import grpc
+
+        from celestia_app_tpu import chaos
+
+        node, gw, plane, client = planes
+        chaos.install("seed=11,withhold_frac=0.25")
+        try:
+            adv = chaos.active_adversary()
+            withheld = adv.withheld_set(1, 8)  # k=4 -> 8x8 EDS
+            hit = next(iter(withheld))
+            with pytest.raises(grpc.RpcError) as gexc:
+                client.share_proof_bytes(1, *hit)
+            assert gexc.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+            assert "withholding detected" in gexc.value.details()
+            # The HTTP twin of the same coordinate: 410 Gone.
+            with pytest.raises(urllib.error.HTTPError) as hexc:
+                urllib.request.urlopen(
+                    gw.url + "/das/share_proof?height=1"
+                    f"&row={hit[0]}&col={hit[1]}",
+                    timeout=10,
+                )
+            assert hexc.value.code == 410
+        finally:
+            chaos.uninstall()
+        chaos.install("seed=11,wrong_root=1")
+        try:
+            with pytest.raises(grpc.RpcError) as gexc2:
+                client.share_proof_bytes(1, 0, 0)
+            assert gexc2.value.code() == grpc.StatusCode.DATA_LOSS
+        finally:
+            chaos.uninstall()
+
     def test_no_provider_is_503(self):
         from celestia_app_tpu.trace.exposition import (
             handle_observability_get,
@@ -497,3 +538,88 @@ class TestServingNodeFlow:
         snap = node.health_snapshot()
         assert snap["serve"]["device_heights"] == stats["device_heights"]
         assert snap["serve"]["hit_ratio"] is not None
+
+
+class TestReadPathNamespaceAccounting:
+    """ISSUE-10 satellite: the read path joins the PR 4 per-tenant
+    accounting — celestia_proofs_served_total carries the payload's
+    capped namespace, celestia_proof_latency_seconds{phase=total} the
+    served share's."""
+
+    def test_share_proof_payload_namespace_label(self):
+        from celestia_app_tpu.serve.api import payload_namespace_label
+        from celestia_app_tpu.trace.square_journal import (
+            capped_namespace_label,
+        )
+
+        ns = bytes(28) + b"\x07"
+        # The label routes through the process-wide cap: whatever the cap
+        # says (admitted or folded to `other`) is what the payload gets.
+        want = capped_namespace_label("7")
+        assert payload_namespace_label(
+            {"proof": {"namespace": ns.hex()}}
+        ) == want
+        assert payload_namespace_label({"namespace": ns.hex()}) == want
+        # No namespace, absent payload, junk hex: the reserved bucket.
+        assert payload_namespace_label({}) == "other"
+        assert payload_namespace_label(None) == "other"
+        assert payload_namespace_label({"namespace": "zz"}) == "other"
+        # Parity shares are not a tenant: 0xff..ff folds to `other`,
+        # matching the sampler's _proof_namespace_label twin (a uniform
+        # DAS workload is 3/4 parity — it must not burn a capped slot or
+        # split this counter from the latency histogram).
+        from celestia_app_tpu.constants import PARITY_NAMESPACE_BYTES
+
+        parity_hex = PARITY_NAMESPACE_BYTES.hex()
+        assert payload_namespace_label(
+            {"namespace": parity_hex}
+        ) == "other"
+        assert payload_namespace_label(
+            {"proof": {"namespace": parity_hex}}
+        ) == "other"
+
+    def test_served_counter_carries_capped_namespace(self):
+        from celestia_app_tpu.serve.api import count_served
+        from celestia_app_tpu.trace.square_journal import (
+            capped_namespace_label,
+        )
+
+        ns = bytes(28) + b"\x2a"
+        want = capped_namespace_label("2a")
+        before = _counter_value(
+            "celestia_proofs_served_total",
+            plane="test", kind="share_proof", namespace=want,
+        )
+        count_served("test", "share_proof",
+                     {"proof": {"namespace": ns.hex()}})
+        assert _counter_value(
+            "celestia_proofs_served_total",
+            plane="test", kind="share_proof", namespace=want,
+        ) == before + 1
+
+    def test_latency_total_labeled_by_served_namespace(self):
+        cache = ForestCache(heights=1, spill=1)
+        entry = cache.put(11, make_eds(k=2))
+        sampler = ProofSampler()
+        hist = registry().get("celestia_proof_latency_seconds")
+        snap_before = hist.snapshot() if hist is not None else None
+        proof = sampler.share_proof(entry, 0, 0)
+        assert proof.verify(entry.eds.data_root())
+        from celestia_app_tpu.trace.square_journal import (
+            capped_namespace_label,
+            namespace_label,
+        )
+
+        label = capped_namespace_label(namespace_label(proof.namespace))
+        hist = registry().get("celestia_proof_latency_seconds")
+        snap = hist.snapshot()
+        if snap_before is not None:
+            snap = snap.delta(snap_before)
+        assert snap.count(phase="total", namespace=label) == 1
+        # A parity-quadrant sample folds into the reserved bucket.
+        other_before = snap.count(phase="total", namespace="other")
+        sampler.share_proof(entry, 3, 3)  # parity quadrant at k=2
+        snap2 = hist.snapshot()
+        if snap_before is not None:
+            snap2 = snap2.delta(snap_before)
+        assert snap2.count(phase="total", namespace="other") == other_before + 1
