@@ -1,15 +1,21 @@
 """Graceful degradation: the device-path circuit breaker and mode ladder.
 
 The device pipeline sits on the consensus hot path, so a dispatch failure
-must degrade LATENCY, never correctness.  All four lowerings of the
+must degrade LATENCY, never correctness.  All five lowerings of the
 extend+DAH pipeline are bit-identical (pinned on the golden vectors), so
 stepping down the ladder
 
-    fused_epi  ->  fused  ->  staged  ->  host
+    panel  ->  fused_epi  ->  fused  ->  staged  ->  host
 
 changes how a block's roots are computed, never what they are — a
 degraded validator keeps signing the same DAH roots as its healthy peers.
 
+  * panel:  the panel-streamed lowering for giant squares
+    (kernels/panel.py, $CELESTIA_PIPE_PANEL, selected PER square size
+    via kernels/fused.pipeline_mode_for_k) — a host-driven loop of small
+    jitted programs rather than one dispatch, so it is the rung with the
+    most moving parts and the first distrusted; a faulting mid-panel
+    dispatch falls to the materializing lowerings below;
   * fused_epi: the fused program with the leaf-hash epilogue (column
     extend feeds the bottom half's NMT leaf rounds from VMEM,
     kernels/rs_xor) — active only when the autotuner seats it
@@ -50,7 +56,7 @@ from __future__ import annotations
 import threading
 import time
 
-LADDER = ("fused_epi", "fused", "staged", "host")
+LADDER = ("panel", "fused_epi", "fused", "staged", "host")
 
 #: Consecutive same-rung dispatch failures before the breaker trips and
 #: the ladder steps down ($CELESTIA_BREAKER_THRESHOLD).
@@ -148,7 +154,16 @@ class DeviceDegradation:
                 return LADDER[cur]  # a concurrent trip already stepped
             if cur >= len(LADDER) - 1:
                 return None
-            self._floor = cur + 1
+            nxt = cur + 1
+            if LADDER[cur] == "panel":
+                # Stepping off the panel rung lands on the process's
+                # MATERIALIZING base — the rung warmup/autotuning seated
+                # (usually "fused") — never on a colder in-between
+                # variant nothing compiled: a giant-k fused_epi compile
+                # on the consensus hot path is exactly the stall the
+                # ladder exists to avoid.
+                nxt = max(LADDER.index(_env_base_mode()), nxt)
+            self._floor = nxt
             new = LADDER[self._floor]
         self._publish(new)
         _recoveries().inc(seam="device.dispatch", outcome="degraded")
@@ -206,7 +221,7 @@ def reset_for_tests() -> None:
     DEVICE_BREAKER.reset()
 
 
-def note_async_device_failure(observed: str) -> None:
+def note_async_device_failure(observed: str, base: str | None = None) -> None:
     """Feed a DEFERRED device-execution failure into the breaker.
 
     JAX dispatch is an async enqueue: a real execution fault often
@@ -215,10 +230,15 @@ def note_async_device_failure(observed: str) -> None:
     hit the fault is lost either way — its caller sees the error — but
     routing the failure through the breaker here means a PERSISTENT
     deferred fault still steps the ladder, so future blocks move off the
-    failing rung instead of dying one by one."""
+    failing rung instead of dying one by one.
+
+    `base` is the caller's base rung when it runs a per-k seat above the
+    env base (the panel lowering): degrade() steps relative to it, so a
+    persistent panel fault moves future giant blocks off the panel rung
+    instead of being mistaken for an already-handled concurrent trip."""
     if DEVICE_BREAKER.record_failure():
         if DEVICE_DEGRADATION.degrade(
-            _env_base_mode(), observed=observed
+            base or _env_base_mode(), observed=observed
         ) is not None:
             DEVICE_BREAKER.reset()
         else:
@@ -234,13 +254,21 @@ def note_async_device_failure(observed: str) -> None:
 
 def guarded_dispatch(resolve, x, *, refresh=None,
                      breaker: CircuitBreaker | None = None,
-                     sleep=time.sleep):
+                     sleep=time.sleep, k: int | None = None):
     """One extend+DAH dispatch with chaos injection, bounded retry, and
     ladder fallback.
 
     `resolve(mode)` returns the pipeline callable for that lowering (the
     caller owns cache policy and donation semantics).  Returns
     (mode, outputs) so the caller can journal the mode that actually ran.
+
+    `k` routes the dispatch through the PER-SQUARE-SIZE mode seam
+    (kernels/fused.pipeline_mode_for_k): the panel-streamed lowering only
+    engages for the square sizes $CELESTIA_PIPE_PANEL names, so the
+    active rung — and the base the ladder degrades from — depends on k.
+    Callers without a per-k seat (repair's re-extend, which wants the
+    materializing full-EDS path anyway) omit it and ride the process
+    mode as before.
 
     Each rung gets `threshold` attempts with exponential backoff; when a
     rung's streak trips the breaker the ladder steps down and the next
@@ -256,8 +284,17 @@ def guarded_dispatch(resolve, x, *, refresh=None,
     """
     from celestia_app_tpu import chaos
     from celestia_app_tpu.chaos.spec import ChaosInjected
-    from celestia_app_tpu.kernels.fused import pipeline_mode
+    from celestia_app_tpu.kernels.fused import (
+        env_base_mode_for_k,
+        pipeline_mode,
+        pipeline_mode_for_k,
+    )
 
+    if k is None:
+        mode_of, base_of = pipeline_mode, _env_base_mode
+    else:
+        def mode_of(): return pipeline_mode_for_k(k)
+        def base_of(): return env_base_mode_for_k(k)
     breaker = breaker or DEVICE_BREAKER
     attempt = 0
     # Per-CALL termination backstop, independent of the shared breaker:
@@ -269,7 +306,7 @@ def guarded_dispatch(resolve, x, *, refresh=None,
     total_attempts = 0
     attempt_cap = max(breaker.threshold, 1) * 2 * len(LADDER)
     while True:
-        mode = pipeline_mode()  # re-read: a degrade below moves it
+        mode = mode_of()  # re-read: a degrade below moves it
         try:
             chaos.device_dispatch(mode)
             out = resolve(mode)(x)
@@ -293,7 +330,7 @@ def guarded_dispatch(resolve, x, *, refresh=None,
                 raise  # this call alone has failed across the whole budget
             if breaker.record_failure():
                 if DEVICE_DEGRADATION.degrade(
-                    _env_base_mode(), observed=mode
+                    base_of(), observed=mode
                 ) is not None:
                     breaker.reset()
                     attempt = 0

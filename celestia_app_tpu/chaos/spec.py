@@ -20,9 +20,10 @@ Spec grammar — comma-separated `key=value` pairs, e.g.
 gossip_drop=0.1,wal_torn_tail=1,rpc_slow_ms=100"
 
     seed=<int>            per-seam RNG seed (default 0)
-    dispatch_fail=<p>     device.dispatch raises (FUSED lowering only, so
-                          the degradation ladder has somewhere to go;
-                          dispatch_fail_all=1 widens it to every rung)
+    dispatch_fail=<p>     device.dispatch raises (panel/fused lowerings
+                          only, so the degradation ladder has somewhere
+                          to go; dispatch_fail_all=1 widens it to every
+                          rung)
     dispatch_stall_ms=<ms> [dispatch_stall=<p>, default 1.0 when ms set]
     upload_fail=<p>       device.upload raises
     upload_stall_ms=<ms>  [upload_stall=<p>]
@@ -201,12 +202,14 @@ class ChaosInjector:
     # --- seams --------------------------------------------------------------
     def device_dispatch(self, mode: str) -> None:
         """Stall and/or fail one extend+DAH dispatch.  `dispatch_fail`
-        targets the fused-family lowerings only — "fused" and the
-        leaf-hash-epilogue "fused_epi" rung above it (modeling a
-        device-path fault the ladder can step away from) — unless
-        `dispatch_fail_all` widens it."""
+        targets the compiled-program family the ladder can step away
+        from — "fused", the leaf-hash-epilogue "fused_epi" rung above
+        it, and the panel-streamed "panel" rung above both (whose
+        host-driven loop passes this seam once per panel dispatch, so an
+        injection lands MID-panel) — unless `dispatch_fail_all` widens
+        it to every rung."""
         self._stall("device.dispatch", "dispatch_stall_ms", "dispatch_stall")
-        applies = (mode in ("fused", "fused_epi")
+        applies = (mode in ("panel", "fused", "fused_epi")
                    or self._p("dispatch_fail_all") > 0)
         if applies and self._fire("device.dispatch", "dispatch_fail"):
             self._count("device.dispatch", "dispatch_fail")

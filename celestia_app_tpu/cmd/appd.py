@@ -221,6 +221,17 @@ def cmd_start(args) -> int:
         t0 = time.time()
         warmed = warmup(square_sizes=sizes, upto=None if sizes else upto,
                         batches=batches)
+        # $CELESTIA_WARMUP_K: extra square sizes beyond the app's cap —
+        # the giant-square knob.  An operator serving k=1024 blocks with
+        # $CELESTIA_PIPE_PANEL set warms the panel lowering's programs
+        # here (warmup resolves the mode PER SIZE), so the first giant
+        # block never eats the compile; without it the panel compiles
+        # would land on the block path (reference TimeoutPropose is 10s).
+        from celestia_app_tpu.da.eds import extra_warmup_sizes
+
+        extra = sorted(set(extra_warmup_sizes()) - set(warmed))
+        if extra:
+            warmed += warmup(square_sizes=extra)
         print(f"warmed square sizes {warmed} in {time.time() - t0:.1f}s"
               + (f" (incl. batch sizes {list(batches)})" if batches else ""),
               flush=True)

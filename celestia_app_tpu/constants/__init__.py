@@ -52,8 +52,12 @@ SQUARE_SIZE_UPPER_BOUND = 128
 # Codec capability bound: the largest ODS the DA pipeline kernels support.
 # Wider than the versioned protocol cap (128) because the reference's own
 # e2e benchmarks push 512-class squares; app-level validation still enforces
-# square_size_upper_bound() per app version.
-MAX_CODEC_SQUARE_SIZE = 512
+# square_size_upper_bound() per app version.  Raised 512 -> 2048 with the
+# giant-square frontier (O(n log n) FFT encode + panel-streamed extend+DAH,
+# $CELESTIA_PIPE_PANEL): GF(2^16) covers codewords to 65536 symbols, so the
+# bound is memory discipline, not field arithmetic — and the panel pipeline
+# is that discipline.
+MAX_CODEC_SQUARE_SIZE = 2048
 SUBTREE_ROOT_THRESHOLD = 64
 # Exact decimal (consensus-critical): binary floats would diverge from peers
 # doing exact-decimal arithmetic on fee boundaries.

@@ -117,10 +117,14 @@ def pipeline_cache_state(
     """"hit" when the jit wrapper the active seam would dispatch for
     (k, construction) is already built this process, else "miss" — the
     block journal's compile column, readable without building anything."""
-    from celestia_app_tpu.kernels.fused import is_built, pipeline_mode
+    from celestia_app_tpu.kernels.fused import is_built, pipeline_mode_for_k
 
     construction = construction or active_construction()
-    mode = pipeline_mode()
+    mode = pipeline_mode_for_k(k)
+    if mode == "panel":
+        from celestia_app_tpu.kernels.panel import is_warm
+
+        return "hit" if is_warm(k, construction) else "miss"
     if mode in ("fused", "fused_epi"):
         return "hit" if is_built(
             k, construction, donate=owned, epilogue=(mode == "fused_epi")
@@ -141,11 +145,16 @@ def jit_pipeline(k: int, construction: str | None = None):
     is a perf detail, never a correctness hazard.  This entry never
     donates its argument — callers that own their upload use
     jit_extend_and_dah(..., donate=True) directly (compute(), the block
-    pipeline's feeder)."""
-    from celestia_app_tpu.kernels.fused import jit_extend_and_dah, pipeline_mode
+    pipeline's feeder).
+
+    Per-k: the panel-streamed lowering ($CELESTIA_PIPE_PANEL,
+    kernels/panel.py) engages only for the square sizes its seam names,
+    so the mode is resolved per square size (pipeline_mode_for_k)."""
+    from celestia_app_tpu.kernels.fused import pipeline_mode_for_k
 
     construction = construction or active_construction()
-    return _pipeline_for_mode(pipeline_mode(), k, construction, owned=False)
+    return _pipeline_for_mode(pipeline_mode_for_k(k), k, construction,
+                              owned=False)
 
 
 def _pipeline_for_mode(
@@ -157,6 +166,13 @@ def _pipeline_for_mode(
     from celestia_app_tpu.kernels.fused import jit_extend_and_dah
 
     construction = construction or active_construction()
+    if mode == "panel":
+        from celestia_app_tpu.kernels.panel import panel_pipeline
+
+        # Host-driven loop of small jitted programs: the panel runner
+        # never donates its input (only its internal accumulator), so
+        # the owned/unowned distinction collapses here.
+        return panel_pipeline(k, construction)
     if mode in ("fused", "fused_epi"):
         return jit_extend_and_dah(
             k, construction, donate=owned, epilogue=(mode == "fused_epi")
@@ -171,9 +187,22 @@ def _owned_input_pipeline(k: int, construction: str | None = None):
     upload): the donating fused program when the seam says fused, the
     staged jit otherwise.  compute() and warmup() both resolve through
     here so a server's warmed compile is exactly the one its blocks run."""
-    from celestia_app_tpu.kernels.fused import pipeline_mode
+    from celestia_app_tpu.kernels.fused import pipeline_mode_for_k
 
-    return _pipeline_for_mode(pipeline_mode(), k, construction, owned=True)
+    return _pipeline_for_mode(pipeline_mode_for_k(k), k, construction,
+                              owned=True)
+
+
+def _panel_fields(mode: str, k: int) -> dict:
+    """Journal extras for a panel-streamed dispatch: how many panels the
+    square streamed through (the per-dispatch panel-count instrument the
+    giant-square memory model is judged by, next to the peak-bytes gauge
+    journal.record refreshes)."""
+    if mode != "panel":
+        return {}
+    from celestia_app_tpu.kernels.panel import panel_count
+
+    return {"panels": panel_count(k)}
 
 
 # --- batched (multi-square) pipeline ----------------------------------------
@@ -327,11 +356,19 @@ class SpeculativeExtender:
         construction = construction or active_construction()
         digest = self._digest(ods)
         try:
-            x = jnp.asarray(ods, dtype=jnp.uint8)
+            from celestia_app_tpu.kernels.fused import pipeline_mode_for_k
+
+            if pipeline_mode_for_k(k) == "panel":
+                # Same panel-granular staging as compute(): the runner
+                # uploads one row panel at a time out of the host copy.
+                x = np.ascontiguousarray(ods, dtype=np.uint8)
+            else:
+                x = jnp.asarray(ods, dtype=jnp.uint8)
             mode, out = guarded_dispatch(
                 lambda m: _pipeline_for_mode(m, k, construction, owned=True),
                 x,
                 refresh=lambda: jnp.asarray(ods, dtype=jnp.uint8),
+                k=k,
             )
         except Exception:  # chaos-ok: speculation is best-effort by contract
             return False
@@ -416,6 +453,12 @@ def warmup(
     programs at those coalesced sizes — a server running with
     $CELESTIA_PIPE_BATCH=B should warm batches=tuple(range(2, B+1)) so
     the dispatcher's first coalesced dispatch never pays a compile.
+
+    Mode is resolved PER SIZE: a server configured with
+    $CELESTIA_PIPE_PANEL warms the panel-streamed lowering's programs
+    (row/column/roots pieces, incl. the short last panel) for exactly
+    the sizes the seam engages at, and the materializing programs for
+    the rest — the first giant block never eats the compile.
     """
     if square_sizes is None:
         assert upto is not None, "pass square_sizes or upto"
@@ -425,7 +468,7 @@ def warmup(
         constructions = (active_construction(),)
     import time
 
-    from celestia_app_tpu.kernels.fused import pipeline_mode
+    from celestia_app_tpu.kernels.fused import pipeline_mode_for_k
     from celestia_app_tpu.trace import journal
 
     for construction in constructions:
@@ -444,17 +487,26 @@ def warmup(
             if pipe is not owned:  # staged mode: both entries are one jit
                 jax.block_until_ready(pipe(jnp.asarray(ods)))
             journal.record(
-                "warmup", k, mode=pipeline_mode(), compile=state,
+                "warmup", k, mode=pipeline_mode_for_k(k), compile=state,
                 construction=construction,
+                **_panel_fields(pipeline_mode_for_k(k), k),
                 warm_ms=(time.perf_counter() - t0) * 1e3,
             )
             for batch in batches:
                 if batch < 2:
                     continue  # batch-1 dispatch rides the unbatched entry
+                if pipeline_mode_for_k(k) == "panel":
+                    # Panel squares never coalesce (BlockPipeline forces
+                    # batch=1 — a vmapped giant batch would materialize B
+                    # full EDSes), so a batched program warmed here could
+                    # never dispatch: skip the wasted compile.
+                    break
                 t0 = time.perf_counter()
                 stack = jnp.asarray(
                     np.zeros((batch, k, k, SHARE_SIZE), dtype=np.uint8)
                 )
+                from celestia_app_tpu.kernels.fused import pipeline_mode
+
                 jax.block_until_ready(
                     _batched_pipeline_for_mode(
                         pipeline_mode(), k, batch, construction, owned=True
@@ -466,6 +518,33 @@ def warmup(
                     warm_ms=(time.perf_counter() - t0) * 1e3,
                 )
     return list(square_sizes)
+
+
+def extra_warmup_sizes() -> list[int]:
+    """$CELESTIA_WARMUP_K: comma/space-separated extra square sizes to
+    AOT-warm at server startup, beyond the app's effective cap — the
+    giant-square operator knob (a node serving k=1024 panel-streamed
+    blocks must not compile on its first block).  Malformed or
+    non-power-of-two entries are skipped loudly rather than failing the
+    start; cmd/appd.py consumes this at --serve."""
+    import os
+    import sys
+
+    raw = os.environ.get("CELESTIA_WARMUP_K", "")
+    sizes: list[int] = []
+    for tok in raw.replace(",", " ").split():
+        try:
+            k = int(tok)
+        except ValueError:
+            print(f"ignoring malformed CELESTIA_WARMUP_K entry {tok!r}",
+                  file=sys.stderr)
+            continue
+        if 1 <= k <= MAX_CODEC_SQUARE_SIZE and k & (k - 1) == 0:
+            sizes.append(k)
+        else:
+            print(f"ignoring out-of-range CELESTIA_WARMUP_K entry {k}",
+                  file=sys.stderr)
+    return sizes
 
 
 # --- fused-vs-staged parity sentinel ---------------------------------------
@@ -500,9 +579,9 @@ def _maybe_parity_check(ods_host, k: int, construction: str, droot) -> None:
     every = parity_sentinel_every()
     if every <= 0:
         return
-    from celestia_app_tpu.kernels.fused import pipeline_mode
+    from celestia_app_tpu.kernels.fused import pipeline_mode_for_k
 
-    if pipeline_mode() not in ("fused", "fused_epi"):
+    if pipeline_mode_for_k(k) not in ("panel", "fused", "fused_epi"):
         # Staged mode (and its eager host twin) already IS the reference
         # lowering: re-running it against itself would burn a duplicate
         # dispatch to report a meaningless "match".
@@ -706,11 +785,12 @@ class ExtendedDataSquare:
             state = pipeline_cache_state(k, construction)
             t0 = time.perf_counter()
             mode, (eds, rr, cr, droot) = guarded_dispatch(
-                lambda m: _pipeline_for_mode(m, k, construction), ods
+                lambda m: _pipeline_for_mode(m, k, construction), ods, k=k
             )
             journal.record(
                 "compute", k, mode=mode, compile=state,
                 dispatch_ms=(time.perf_counter() - t0) * 1e3,
+                **_panel_fields(mode, k),
                 **({"speculation": spec_outcome} if spec_outcome else {}),
             )
             sentinel_input = ods  # undonated: still live and immutable
@@ -721,17 +801,30 @@ class ExtendedDataSquare:
             # the host copy, so donation never poisons the retry.
             state = pipeline_cache_state(k, construction, owned=True)
             t0 = time.perf_counter()
-            x = jnp.asarray(ods, dtype=jnp.uint8)
+            from celestia_app_tpu.kernels.fused import pipeline_mode_for_k
+
+            if pipeline_mode_for_k(k) == "panel":
+                # Panel mode streams panels out of the HOST copy one at a
+                # time — a whole-square upload here would stage the giant
+                # ODS device-resident next to the half-EDS accumulator,
+                # breaking the documented residency bound.  A mid-call
+                # ladder fall still works: the materializing jits accept
+                # the host array and upload at dispatch.
+                x = np.ascontiguousarray(ods, dtype=np.uint8)
+            else:
+                x = jnp.asarray(ods, dtype=jnp.uint8)
             t1 = time.perf_counter()
             mode, (eds, rr, cr, droot) = guarded_dispatch(
                 lambda m: _pipeline_for_mode(m, k, construction, owned=True),
                 x,
                 refresh=lambda: jnp.asarray(ods, dtype=jnp.uint8),
+                k=k,
             )
             journal.record(
                 "compute", k, mode=mode, compile=state,
                 upload_ms=(t1 - t0) * 1e3,
                 dispatch_ms=(time.perf_counter() - t1) * 1e3,
+                **_panel_fields(mode, k),
                 **({"speculation": spec_outcome} if spec_outcome else {}),
             )
             sentinel_input = ods  # the host copy (x may be donated away)

@@ -189,3 +189,26 @@ def encode_axis_fft(
         out = (pb * weights).sum(axis=2).astype(jnp.uint8)  # (n, bps, cols)
     by = jnp.moveaxis(out.reshape(n, bps, batch, nsym), 1, 3)  # (n,batch,nsym,bps)
     return jnp.moveaxis(by.reshape(n, batch, S), 0, contract_axis)
+
+
+def col_block_encode_fn(k: int, construction: str, md: bool | None = None):
+    """The panel-blocked staging of the column-phase butterflies
+    (kernels/panel.py's FFT leg): f(top_cols (k, c, S)) -> (k, c, S).
+
+    The butterfly network contracts over the ROW axis, so it cannot be
+    XOR-split across row panels the way the dense generator can — but
+    every COLUMN's butterfly chain is independent (columns are pure batch
+    in _apply_groups), so blocking the batch axis runs the identical
+    stage program on c columns at a time.  That bounds the 8x bit-plane
+    inflation (and the int32 dot accumulator) to one block instead of
+    the whole 2k-column top half: at k=2048 the full column phase would
+    stage ~34 GB of int32 accumulator; a 128-column block stages ~1 GB.
+    Bytes are identical to the unblocked call sliced at the same columns
+    — no butterfly, twiddle, or packing step changes.
+    """
+
+    def run(top_cols: jnp.ndarray) -> jnp.ndarray:
+        return encode_axis_fft(top_cols, k, construction, contract_axis=0,
+                               md=md)
+
+    return run

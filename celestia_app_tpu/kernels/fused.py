@@ -101,6 +101,27 @@ def env_base_mode() -> str:
     return "fused"
 
 
+def env_base_mode_for_k(k: int) -> str:
+    """The env-selected base lowering for square size k: "panel" when the
+    panel-streaming seam engages at this k ($CELESTIA_PIPE_PANEL —
+    kernels/panel.panel_rows), else the k-less env_base_mode().  The
+    degradation ladder steps relative to THIS, so a faulting panel
+    dispatch walks panel -> fused_epi/fused -> staged -> host."""
+    from celestia_app_tpu.kernels.panel import panel_rows
+
+    return "panel" if panel_rows(k) else env_base_mode()
+
+
+def pipeline_mode_for_k(k: int) -> str:
+    """The active extend+DAH lowering for square size k — pipeline_mode()
+    with the per-k panel-streaming seam applied above the fused rungs.
+    All five lowerings are bit-identical; the per-k selection is a
+    memory/perf choice, never a correctness hazard."""
+    from celestia_app_tpu.chaos.degrade import effective_device_mode
+
+    return effective_device_mode(env_base_mode_for_k(k))
+
+
 def extend_and_dah_fn(
     k: int,
     construction: str | None = None,

@@ -1,0 +1,423 @@
+"""Panel-streamed extend+DAH: giant squares without materializing the EDS.
+
+The fused one-dispatch pipeline (kernels/fused.py) holds the whole
+(2k, 2k, SHARE_SIZE) extended square — plus XLA's concatenate copies —
+live inside a single program.  At k=512 that is ~537 MB of shares; at
+k=2048 it is 8.6 GB before a single leaf digest, which is why square
+sizes past 512 were memory-blocked, not compute-blocked.  This module is
+the same layout-and-scheduling discipline that made the bitsliced XOR
+encode fast (arXiv 2108.02692): restructure the SCHEDULE, keep the math
+bit-for-bit identical.
+
+The lowering keeps the materializing pipeline's exact two-phase order
+(row extend, then one column contraction over all 2k top columns), but
+blocks it into host-driven panels of small jitted programs:
+
+  * ROW PHASE — each panel of `rows` ODS rows is row-extended and
+    leaf-hashed independently (`_jit_row_panel`: encode(panel, axis=1),
+    the per-leaf namespace rule, one batched SHA call — the
+    extend_leaf_digests epilogue shape).  Only the (p, 2k, 29) namespace
+    and (p, 2k, 32) hash slabs accumulate; roots_only callers drop the
+    share panel the moment it is hashed into the column accumulator.
+  * COLUMN PHASE — the contraction over the row axis streams as
+    XOR-accumulated partial products: mod-2 of a sum is the XOR of the
+    per-panel mod-2 partial sums, so `G_bits[:, panel] @ panel` is
+    scatter-added (bitwise XOR, accumulator donated) into the parity-row
+    accumulator as each top panel completes (`_jit_col_partial`).  On
+    platforms where the encode seam selects the additive FFT
+    (kernels/rs._fft_choice — CPU at k >= 512), the butterflies contract
+    over the row axis and cannot XOR-split, so the column phase is
+    staged panel-blocked over the BATCH (column) axis instead
+    (kernels/fft.col_block_encode_fn): every column's butterfly chain is
+    independent, so blocking the columns bounds the 8x bit-plane
+    inflation to one block without touching a single butterfly.
+  * ROOTS — row and column trees reduce from the accumulated digest
+    grids in one final program (`_jit_panel_roots`), identical to
+    da/eds.roots_fn's reduction over the same digests.
+
+Memory model (the honest one): peak device share residency is the
+parity-row accumulator (k, 2k, S) — half the EDS — plus ONE extended row
+panel, instead of the full square plus the fused program's intermediate
+copies; the digest grids accumulate at 61 B/leaf (ns + hash; min == max
+for every leaf).  The FFT leg holds the top half instead of the parity
+accumulator (the butterflies need whole columns) — the same half-square
+bound from the other side.  `roots_only=True` is the shape the
+proposer's DAH actually needs; full-EDS callers (ForestCache retention,
+repair's re-extend) get the EDS concatenated from panels at the very
+end, or simply stay on the materializing path.
+
+Selection seam: $CELESTIA_PIPE_PANEL = "<rows>" | "auto" (default off).
+An integer streams EVERY square in panels of that many ODS rows; "auto"
+engages only at k >= 512 with 64-row panels.  The mode rides the normal
+pipeline routing (da/eds.jit_pipeline / compute / warmup via
+kernels/fused.pipeline_mode_for_k) and sits ABOVE the fused rungs on the
+degradation ladder (chaos/degrade.LADDER): a faulting panel dispatch
+steps the process down to the materializing lowerings, which remain
+bit-identical — pinned by tests/test_panel_pipeline.py against the dense
+full-square goldens for both RS constructions and uneven panel sizes.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+
+from celestia_app_tpu.constants import (
+    NAMESPACE_SIZE,
+    PARITY_NAMESPACE_BYTES,
+    SHARE_SIZE,
+)
+from celestia_app_tpu.gf.rs import active_construction, codec_for_width
+from celestia_app_tpu.kernels.merkle import merkle_root_pow2
+from celestia_app_tpu.kernels.nmt import leaf_digests, tree_roots_from_digests
+from celestia_app_tpu.kernels.rs import _fft_choice, encode_axis, encode_fn
+
+#: "auto" panel height (ODS rows per panel) and the square size at which
+#: auto engages — below it the whole square is one panel anyway and the
+#: fused single-dispatch program wins on dispatch count.
+_AUTO_PANEL_ROWS = 64
+_AUTO_PANEL_K = 512
+
+
+def env_panel() -> str:
+    return os.environ.get("CELESTIA_PIPE_PANEL", "")
+
+
+def panel_rows(k: int) -> int:
+    """ODS rows per panel for square size k; 0 = panel mode off.
+
+    $CELESTIA_PIPE_PANEL: ""/unset/"off"/"0" disables; "auto" engages
+    64-row panels at k >= 512 (the sizes where the materializing
+    pipeline's share residency starts to dominate HBM); an integer N
+    streams every square in N-row panels (clamped to k — a single-panel
+    run degenerates to the materializing schedule through the panel
+    code, which the small-k identity tests lean on).
+    """
+    val = env_panel().strip().lower()
+    if val in ("", "0", "off"):
+        return 0
+    if val == "auto":
+        return _AUTO_PANEL_ROWS if k >= _AUTO_PANEL_K else 0
+    try:
+        rows = int(val)
+    except ValueError:
+        _warn_malformed(val)
+        return 0
+    if rows <= 0:
+        return 0
+    return min(rows, k)
+
+
+_WARNED_MALFORMED: set[str] = set()
+
+
+def _warn_malformed(val: str) -> None:
+    """A typo'd $CELESTIA_PIPE_PANEL silently falling back to the
+    materializing pipeline is exactly the OOM the knob exists to prevent
+    — say so, loudly, once per distinct value (the extra_warmup_sizes
+    convention)."""
+    if val in _WARNED_MALFORMED:
+        return
+    _WARNED_MALFORMED.add(val)
+    import sys
+
+    print(f"ignoring malformed CELESTIA_PIPE_PANEL value {val!r} "
+          "(want an integer row count or 'auto'); panel streaming is OFF",
+          file=sys.stderr)
+
+
+def panel_bounds(k: int, rows: int) -> tuple[tuple[int, int], ...]:
+    """The row-panel partition [(r0, r1), ...] covering [0, k); the last
+    panel is short when `rows` does not divide k."""
+    return tuple(
+        (r0, min(r0 + rows, k)) for r0 in range(0, k, max(1, rows))
+    )
+
+
+# Fully-resolved configurations (k, construction, rows, use_fft, md)
+# whose panel programs have completed one full run this process — the
+# journal's compile hit/miss signal for panel mode
+# (da/eds.pipeline_cache_state).  The key matches _panel_runner's cache
+# key exactly: a panel-height or encode-seam flip mid-process means the
+# NEW configuration's per-panel jits are cold, and the compile column
+# must say so.
+_PANEL_WARM: set[tuple] = set()
+
+
+def _resolved_config(k: int, construction: str) -> tuple:
+    """(rows, use_fft, md) the seam resolves to for k right now — the
+    part of _panel_runner's cache key beyond (k, construction)."""
+    rows = panel_rows(k) or k
+    use_fft, force_md = _fft_choice(k)
+    md = (os.environ.get("CELESTIA_RS_FFT_MD") == "1"
+          if force_md is None else bool(force_md))
+    return rows, use_fft, md
+
+
+def is_warm(k: int, construction: str | None = None) -> bool:
+    construction = construction or active_construction()
+    return (k, construction, *_resolved_config(k, construction)) \
+        in _PANEL_WARM
+
+
+def _parity_ns(shape) -> jnp.ndarray:
+    parity = jnp.frombuffer(PARITY_NAMESPACE_BYTES, dtype=jnp.uint8)
+    return jnp.broadcast_to(parity, (*shape, NAMESPACE_SIZE))
+
+
+def _note_build() -> None:
+    from celestia_app_tpu.trace.journal import note_jit_build
+
+    note_jit_build("panel_pipeline")
+
+
+@lru_cache(maxsize=None)
+def _jit_row_panel(k: int, p: int, construction: str):
+    """f(panel (p, k, S)) -> (ext (p, 2k, S), ns (p, 2k, 29),
+    hashes (p, 2k, 32)): row-extend one panel of ODS rows and hash its
+    leaves.  The encode rides encode_fn — the same dense/FFT/Pallas/XOR
+    selection every other lowering uses, bit-identical per row because
+    both phases batch independently over rows."""
+    _note_build()
+    encode = encode_fn(k, construction)
+
+    def run(panel: jnp.ndarray):
+        parity = jnp.frombuffer(PARITY_NAMESPACE_BYTES, dtype=jnp.uint8)
+        q1 = encode(panel, 1)  # (p, k, S)
+        ext = jnp.concatenate([panel, q1], axis=1)  # (p, 2k, S)
+        col = jnp.arange(2 * k)
+        ns = jnp.where(
+            (col < k)[None, :, None], ext[..., :NAMESPACE_SIZE], parity
+        )
+        _, _, hashes = leaf_digests(ns, ext)
+        return ext, ns, hashes
+
+    return jax.jit(run)
+
+
+@lru_cache(maxsize=None)
+def _jit_col_partial(k: int, p: int, construction: str):
+    """f(acc (k, 2k, S), panel (p, 2k, S), g_slice (k*m, p*m)) -> acc':
+    one panel's partial product of the column contraction, XOR-added into
+    the donated parity-row accumulator.  Exact: mod-2 of the full
+    contraction equals the XOR of per-panel mod-2 partial contractions,
+    and byte packing is per-bit, so accumulating packed bytes is
+    accumulating bits."""
+    _note_build()
+    from celestia_app_tpu.kernels.fused import (
+        _silence_unusable_donation_warning,
+    )
+
+    _silence_unusable_donation_warning()  # CPU ignores donation; expected
+    m = codec_for_width(k, construction).field.m
+
+    def step(acc, panel, g_slice):
+        part = encode_axis(panel, g_slice, m, contract_axis=0)  # (k, 2k, S)
+        return jnp.bitwise_xor(acc, part)
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+@lru_cache(maxsize=None)
+def _col_generator_slices(k: int, construction: str,
+                          bounds: tuple) -> tuple:
+    """Per-panel block-columns of the bit-expanded generator: the column
+    contraction's partial product for panel rows [r0, r1) reads exactly
+    G_bits[:, r0*m : r1*m].  Cached as device arrays — together they are
+    the same bytes the materializing dense path bakes into its program."""
+    codec = codec_for_width(k, construction)
+    g_bits = codec.generator_bits()
+    m = codec.field.m
+    return tuple(
+        jnp.asarray(g_bits[:, r0 * m: r1 * m]) for r0, r1 in bounds
+    )
+
+
+@lru_cache(maxsize=None)
+def _jit_fft_col_block(k: int, c: int, construction: str, md: bool):
+    """f(top_cols (k, c, S)) -> (k, c, S): the column-phase additive-FFT
+    encode over one block of columns (kernels/fft.col_block_encode_fn) —
+    the panel-blocked butterfly staging."""
+    _note_build()
+    from celestia_app_tpu.kernels.fft import col_block_encode_fn
+
+    return jax.jit(col_block_encode_fn(k, construction, md=md))
+
+
+@lru_cache(maxsize=None)
+def _jit_parity_leaves(rows: int, cols: int):
+    """f(block (rows, cols, S)) -> hashes (rows, cols, 32): leaf digests
+    for an all-parity-namespace block (every bottom-half leaf)."""
+    _note_build()
+
+    def run(block: jnp.ndarray):
+        ns = _parity_ns((rows, cols))
+        _, _, hashes = leaf_digests(ns, block)
+        return hashes
+
+    return jax.jit(run)
+
+
+@lru_cache(maxsize=None)
+def _jit_panel_roots(k: int):
+    """f(top_ns (k, 2k, 29), hashes (2k, 2k, 32)) -> (row_roots,
+    col_roots, droot): the tree reductions over the accumulated digest
+    grids — the same tree_roots_from_digests/merkle_root_pow2 composition
+    as da/eds.roots_fn, fed precomputed leaf digests (bottom namespaces
+    are the parity constant and never shipped)."""
+    _note_build()
+
+    def run(top_ns: jnp.ndarray, hashes: jnp.ndarray):
+        ns = jnp.concatenate([top_ns, _parity_ns((k, 2 * k))], axis=0)
+        row_roots = tree_roots_from_digests(ns, ns, hashes)  # (2k, 90)
+        nst = ns.transpose(1, 0, 2)
+        col_roots = tree_roots_from_digests(
+            nst, nst, hashes.transpose(1, 0, 2)
+        )
+        droot = merkle_root_pow2(
+            jnp.concatenate([row_roots, col_roots], axis=0)
+        )
+        return row_roots, col_roots, droot
+
+    return jax.jit(run)
+
+
+def _as_panels(x, k: int, bounds: tuple) -> list:
+    """Split the input into per-panel arrays.  Accepts the full
+    (k, k, S) ODS (host or device; sliced lazily so a host array uploads
+    one panel at a time) or an already-split list of panels matching
+    `bounds` (the BlockPipeline's panel-granular staging)."""
+    if isinstance(x, (list, tuple)):
+        if len(x) != len(bounds):
+            raise ValueError(
+                f"panel list length {len(x)} != plan {len(bounds)}"
+            )
+        for panel, (r0, r1) in zip(x, bounds):
+            if panel.shape[0] != r1 - r0:
+                raise ValueError(
+                    f"panel rows {panel.shape[0]} != plan rows {r1 - r0}"
+                )
+        return list(x)
+    if x.shape != (k, k, SHARE_SIZE):
+        raise ValueError(f"bad ODS shape {x.shape} for k={k}")
+    return [x[r0:r1] for r0, r1 in bounds]
+
+
+def panel_pipeline(k: int, construction: str | None = None,
+                   roots_only: bool = False):
+    """The panel-streamed pipeline callable for square size k.
+
+    Returns f(ods) -> (eds, row_roots, col_roots, droot), or the
+    roots_only twin f(ods) -> (row_roots, col_roots, droot) that never
+    assembles the square.  `ods` may be the (k, k, S) array (host numpy
+    uploads panel-at-a-time) or a list of per-panel arrays matching
+    panel_bounds(k, panel_rows(k)).
+
+    Host-driven: each panel is its own small jitted dispatch, so peak
+    device residency is bounded by the accumulator + one panel + the
+    digest grids instead of whatever one giant program holds live.  Each
+    per-panel dispatch passes the chaos device.dispatch seam under mode
+    "panel", so an injected mid-panel fault surfaces to guarded_dispatch
+    and walks the ladder down to the materializing lowerings.
+
+    The runner is cached per resolved configuration (panel height and
+    encode-leg selection included), so repeated resolution — warmup vs
+    compute vs the block pipeline — hands back the same callable while
+    the env is stable.
+    """
+    construction = construction or active_construction()
+    rows, use_fft, md = _resolved_config(k, construction)
+    return _panel_runner(k, construction, roots_only, rows, use_fft, md)
+
+
+@lru_cache(maxsize=None)
+def _panel_runner(k: int, construction: str, roots_only: bool, rows: int,
+                  use_fft: bool, md: bool):
+    bounds = panel_bounds(k, rows)
+
+    def run(x):
+        from celestia_app_tpu import chaos
+
+        panels = _as_panels(x, k, bounds)
+        ns_slabs: list = []
+        top_hash_slabs: list = []
+        top_panels: list = []
+        acc = None
+        g_slices = None
+        if not use_fft:
+            g_slices = _col_generator_slices(k, construction, bounds)
+            acc = jnp.zeros((k, 2 * k, SHARE_SIZE), dtype=jnp.uint8)
+        for i, (r0, r1) in enumerate(bounds):
+            chaos.device_dispatch("panel")
+            panel = jnp.asarray(panels[i], dtype=jnp.uint8)
+            ext, ns, hashes = _jit_row_panel(k, r1 - r0, construction)(panel)
+            ns_slabs.append(ns)
+            top_hash_slabs.append(hashes)
+            if use_fft:
+                # The butterflies need whole columns: the top half stays
+                # resident and the bottom streams out column-blocked.
+                top_panels.append(ext)
+            else:
+                acc = _jit_col_partial(k, r1 - r0, construction)(
+                    acc, ext, g_slices[i]
+                )
+                if not roots_only:
+                    top_panels.append(ext)
+        bot_hash_slabs: list = []
+        if use_fft:
+            top = (top_panels[0] if len(top_panels) == 1
+                   else jnp.concatenate(top_panels, axis=0))
+            blocks: list = []
+            cwidth = min(2 * rows, 2 * k)
+            for c0 in range(0, 2 * k, cwidth):
+                c1 = min(c0 + cwidth, 2 * k)
+                chaos.device_dispatch("panel")
+                blk = _jit_fft_col_block(k, c1 - c0, construction, md)(
+                    top[:, c0:c1]
+                )
+                bot_hash_slabs.append(_jit_parity_leaves(k, c1 - c0)(blk))
+                if not roots_only:
+                    blocks.append(blk)
+            bottom = (None if roots_only else
+                      (blocks[0] if len(blocks) == 1
+                       else jnp.concatenate(blocks, axis=1)))
+            bot_hashes = (bot_hash_slabs[0] if len(bot_hash_slabs) == 1
+                          else jnp.concatenate(bot_hash_slabs, axis=1))
+        else:
+            bottom = acc
+            for r0, r1 in bounds:
+                chaos.device_dispatch("panel")
+                bot_hash_slabs.append(
+                    _jit_parity_leaves(r1 - r0, 2 * k)(bottom[r0:r1])
+                )
+            bot_hashes = (bot_hash_slabs[0] if len(bot_hash_slabs) == 1
+                          else jnp.concatenate(bot_hash_slabs, axis=0))
+        top_ns = (ns_slabs[0] if len(ns_slabs) == 1
+                  else jnp.concatenate(ns_slabs, axis=0))
+        top_hashes = (top_hash_slabs[0] if len(top_hash_slabs) == 1
+                      else jnp.concatenate(top_hash_slabs, axis=0))
+        hashes = jnp.concatenate([top_hashes, bot_hashes], axis=0)
+        chaos.device_dispatch("panel")
+        row_roots, col_roots, droot = _jit_panel_roots(k)(top_ns, hashes)
+        _PANEL_WARM.add((k, construction, rows, use_fft, md))
+        if roots_only:
+            return row_roots, col_roots, droot
+        if use_fft:
+            eds = jnp.concatenate([top, bottom], axis=0)
+        else:
+            top = (top_panels[0] if len(top_panels) == 1
+                   else jnp.concatenate(top_panels, axis=0))
+            eds = jnp.concatenate([top, bottom], axis=0)
+        return eds, row_roots, col_roots, droot
+
+    return run
+
+
+def panel_count(k: int) -> int:
+    """Panels the active seam would stream for square size k (the
+    journal's per-dispatch panel-count field); 0 when panel mode is off."""
+    rows = panel_rows(k)
+    return len(panel_bounds(k, rows)) if rows else 0

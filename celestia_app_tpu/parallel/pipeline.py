@@ -326,6 +326,20 @@ class BlockPipeline:
         self.k = k
         self.depth = depth
         self.batch = max(1, batch if batch is not None else env_batch())
+        # Panel streaming ($CELESTIA_PIPE_PANEL, kernels/panel.py): when
+        # the seam engages at this k, the staging slot is consumed
+        # PANEL-granularly — the uploader skips the whole-ODS device_put
+        # and the dispatcher's panel runner uploads one row panel at a
+        # time out of the persistent host slot, so the device never
+        # stages a giant square whole next to the pipeline's working
+        # set.  Panel squares are giant by definition and never coalesce
+        # (the vmapped batched program would materialize B full EDSes),
+        # so batching is forced off.
+        from celestia_app_tpu.kernels.panel import panel_rows
+
+        self._panel = panel_rows(k)
+        if self._panel:
+            self.batch = 1
         # A pipeline is bound to the RS construction active at creation:
         # every block it streams uses this one generator, even if
         # $CELESTIA_RS_CONSTRUCTION flips while blocks are in flight.
@@ -334,9 +348,9 @@ class BlockPipeline:
         # pays a jit build, both pinned before the wrapper is built.  The
         # first journaled block carries the init-time compile state; every
         # later row is by definition a hit.
-        from celestia_app_tpu.kernels.fused import pipeline_mode
+        from celestia_app_tpu.kernels.fused import pipeline_mode_for_k
 
-        self._mode = pipeline_mode()
+        self._mode = pipeline_mode_for_k(k)
         self._compile_state = pipeline_cache_state(
             k, self.construction, owned=True
         )
@@ -449,9 +463,18 @@ class BlockPipeline:
                 for attempt in range(_UPLOAD_RETRIES + 1):
                     try:
                         chaos.device_upload()  # injected stall/failure
-                        x = jax.device_put(
-                            host[0] if len(items) == 1 else host[: len(items)]
-                        )
+                        if self._panel:
+                            # Panel-granular staging: hand the host slot
+                            # through whole — the dispatcher's panel
+                            # runner uploads one row panel at a time out
+                            # of it, so device staging residency is one
+                            # panel, never the giant square.
+                            x = host[0]
+                        else:
+                            x = jax.device_put(
+                                host[0] if len(items) == 1
+                                else host[: len(items)]
+                            )
                         break
                     except Exception:  # chaos-ok: bounded upload retry
                         if attempt == _UPLOAD_RETRIES:
@@ -572,6 +595,7 @@ class BlockPipeline:
                         refresh=lambda b=b: jax.device_put(
                             np.ascontiguousarray(host[b])
                         ),
+                        k=self.k,
                     )
                 )
             return results
@@ -602,8 +626,13 @@ class BlockPipeline:
                         refresh=lambda: jax.device_put(
                             np.ascontiguousarray(host[0])
                         ),
+                        k=self.k,
                     )
                     per_square = [(mode, out)]
+                    if mode == "panel":
+                        from celestia_app_tpu.kernels.panel import panel_count
+
+                        meta["panels"] = panel_count(self.k)
                 else:
                     per_square = self._dispatch_batched(x, sid, n)
                 meta["dispatch_ms"] = (time.perf_counter() - t1) * 1e3
@@ -636,9 +665,11 @@ class BlockPipeline:
             # caller sees the error), but the breaker still learns, so a
             # persistent fault steps the ladder for the blocks after it.
             from celestia_app_tpu.chaos.degrade import note_async_device_failure
+            from celestia_app_tpu.kernels.fused import env_base_mode_for_k
 
             inflight.release_slot()
-            note_async_device_failure(self._mode)
+            note_async_device_failure(self._mode,
+                                      base=env_base_mode_for_k(self.k))
             raise
         meta = inflight.meta
         journal.record(
@@ -646,6 +677,7 @@ class BlockPipeline:
             compile=self._compile_state, tag=str(inflight.tag),
             depth=self.depth,
             batch_size=meta.get("batch_size", 1),
+            **({"panels": meta["panels"]} if "panels" in meta else {}),
             upload_ms=meta.get("upload_ms", 0.0),
             upload_stall_ms=meta.get("upload_stall_ms", 0.0),
             dispatch_ms=meta.get("dispatch_ms", 0.0),

@@ -8,15 +8,22 @@ Two device-side instruments the journal funnel drives per block:
     trace under $CELESTIA_PROFILE_DIR (default /tmp/celestia_jax_trace).
     One window per process — profiling is a measurement run, not a
     steady-state cost;
-  * an HBM high-water gauge from `device.memory_stats()`:
-    celestia_hbm_peak_bytes{point=...,k=...}, refreshed per journaled
-    dispatch.  CPU backends return no stats — the gauge simply never
-    appears there (guarded None, never an exception on the block path).
+  * a memory high-water gauge:
+    celestia_hbm_peak_bytes{point=...,k=...,source=...}, refreshed per
+    journaled dispatch.  `source="device"` is the allocator's
+    peak_bytes_in_use from `device.memory_stats()`; backends that keep
+    no stats (this image's CPU) fall back to `source="rss"` — the
+    process peak RSS from resource.getrusage — so the giant-square
+    memory-high-water claims stay MEASURABLE off-chip.  The label keeps
+    the two sources from ever being compared as one series: RSS is a
+    process-lifetime peak (it never goes down, and it includes the host
+    heap), device stats are the allocator's own.
 
 This is the instrument for the ROADMAP TODO "measure whether donation
 moves the k=512 HBM high-water mark enough to deepen the stream pipeline
-past depth 2": run the stream bench once with $CELESTIA_PIPE_FUSED=auto
-and once =off, diff the gauge.
+past depth 2" and for the panel-vs-materializing residency comparison
+(README "Giant squares"): run the bench once per seam setting, diff the
+gauge (or, on CPU, one process per setting — RSS peaks are per-process).
 """
 
 from __future__ import annotations
@@ -120,22 +127,47 @@ def hbm_high_water(device=None) -> int | None:
     return int(peak) if peak else None
 
 
+def rss_high_water() -> int | None:
+    """Process peak RSS in bytes (resource.getrusage ru_maxrss) — the
+    CPU-fallback memory high-water.  A lifetime peak, never a per-phase
+    one: comparing two pipeline configurations needs one process each."""
+    try:
+        import resource
+
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    except Exception:  # noqa: BLE001 — absent module/odd platform: no sample
+        return None
+    if not peak:
+        return None
+    import sys
+
+    # Linux reports KiB; macOS bytes.
+    return int(peak) * (1 if sys.platform == "darwin" else 1024)
+
+
 def record_hbm_high_water(point: str = "dispatch",
                           k: int | None = None) -> int | None:
-    """Refresh celestia_hbm_peak_bytes{point,k} and journal the sample;
-    returns the peak (None on CPU, where the gauge never appears)."""
-    peak = hbm_high_water()
+    """Refresh celestia_hbm_peak_bytes{point,k,source} and journal the
+    sample; returns the peak bytes.  Device allocator stats when the
+    backend keeps them (source="device"), else the process peak RSS
+    (source="rss") so the high-water stays measurable on CPU images;
+    None only when neither source can answer."""
+    peak, source = hbm_high_water(), "device"
+    if peak is None:
+        peak, source = rss_high_water(), "rss"
     if peak is None:
         return None
     from celestia_app_tpu.trace.metrics import registry
     from celestia_app_tpu.trace.tracer import traced
 
-    labels = {"point": point}
+    labels = {"point": point, "source": source}
     if k is not None:
         labels["k"] = str(k)
     registry().gauge(
         "celestia_hbm_peak_bytes",
-        "device memory high-water mark (allocator peak_bytes_in_use)",
+        "memory high-water mark (device allocator peak_bytes_in_use, or "
+        "process peak RSS on stat-less backends — see the source label)",
     ).set(peak, **labels)
-    traced().write("hbm_high_water", point=point, k=k, peak_bytes=peak)
+    traced().write("hbm_high_water", point=point, k=k, peak_bytes=peak,
+                   source=source)
     return peak

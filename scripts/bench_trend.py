@@ -79,6 +79,14 @@ STREAM_BATCH_MODES = ("stream_b1", "stream_b2", "stream_b4")
 GATED_MODES = ("compute", "repair") + STREAM_BATCH_MODES
 # Modes bound by the host<->device link; reported, not gated by default.
 LINK_BOUND_MODES = ("extend", "stream", "host")
+# The default bench plan stops at k=512 (the paper's north star); rows at
+# larger k exist only when a round was driven with BENCH_K=1024/2048 (the
+# giant-square frontier).  Such per-k series are LEARNED like any other
+# gated series — newest-vs-best-prior under the same-platform rule — but
+# their absence from a default-plan round is a plan gap, not staleness:
+# the gate must neither cry STALE about a row the plan cannot produce nor
+# treat compute@1024 as an unknown series.
+DEFAULT_PLAN_MAX_K = 512
 # Parts candidates only measured on TPU (the Pallas lowerings): their
 # absence from a CPU-fallback round is a platform gap, not a stale series
 # — the trend gate must not cry STALE when a chip round simply didn't
@@ -538,6 +546,13 @@ def stale_gated_series(
     newest round that did not run on the chip get `hw_gated: True`
     instead: a CPU-fallback round CANNOT measure them, so their absence
     is a platform gap, not a stale series the gate should shout about.
+
+    Giant-k mode rows (k > DEFAULT_PLAN_MAX_K — compute@1024 and
+    friends, measured only under an explicit BENCH_K) get `opt_in: True`
+    the same way: the default plan never produces them, so their absence
+    from a default round is a plan gap.  When two giant-k rounds DO
+    exist, find_regressions gates them like any other series under the
+    same-platform rule — the downgrade is only about absence.
     """
     newest = max(
         (r["round"] for r in rounds if r["modes"] or r["parts"]), default=None
@@ -557,8 +572,11 @@ def stale_gated_series(
         if not gate_all and mode not in gate_modes:
             continue
         if pts[-1][0] < newest:
-            out.append({"series": f"{mode}@{k}", "last_round": pts[-1][0],
-                        "newest_round": newest})
+            entry = {"series": f"{mode}@{k}", "last_round": pts[-1][0],
+                     "newest_round": newest}
+            if k > DEFAULT_PLAN_MAX_K:
+                entry["opt_in"] = True
+            out.append(entry)
     for name, pts in sorted(parts_series(rounds).items()):
         if pts[-1][0] < newest:
             entry = {"series": f"parts.{name}", "last_round": pts[-1][0],
@@ -724,8 +742,10 @@ def main(argv: list[str] | None = None) -> int:
             "das_rounds": [r["round"] for r in das_rounds],
             "adv_rounds": [r["round"] for r in adv_rounds],
             "regressions": regressions,
-            "stale": [s for s in stale if not s.get("hw_gated")],
+            "stale": [s for s in stale
+                      if not s.get("hw_gated") and not s.get("opt_in")],
             "hw_gated": [s for s in stale if s.get("hw_gated")],
+            "opt_in": [s for s in stale if s.get("opt_in")],
             "seat_changes": seats,
             "seat_overrides": overrides,
             "threshold_pct": args.threshold,
@@ -759,6 +779,11 @@ def main(argv: list[str] | None = None) -> int:
                 print(f"  hw-gated: {s['series']} not measurable in "
                       f"r{s['newest_round']:02d} (no chip; last chip value "
                       f"r{s['last_round']:02d}) — platform gap, not stale")
+            elif s.get("opt_in"):
+                print(f"  opt-in: {s['series']} is a giant-k row the "
+                      f"default plan never measures (last BENCH_K round "
+                      f"r{s['last_round']:02d}) — plan gap, not stale; "
+                      "same-platform gating applies when it is measured")
             else:
                 print(f"  STALE: gated series {s['series']} last measured in "
                       f"r{s['last_round']:02d} (newest data is "

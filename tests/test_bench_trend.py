@@ -48,17 +48,18 @@ def _round_file(tmp_path, n, results, stability=None, errors=None,
 
 
 class TestCheckedInTrajectory:
-    def test_check_mode_reproduces_r01_to_r05_and_passes(self, capsys):
+    def test_check_mode_reproduces_checked_in_rounds_and_passes(self, capsys):
         bt = _load()
         assert bt.main(["--check"]) == 0
         out = capsys.readouterr().out
-        # The r02/r03 full summaries and the r04/r05 salvaged parts all
-        # land in one table.
+        # The r02/r03 full summaries, the r04/r05 salvaged parts, and the
+        # r06 giant-k opt-in row all land in one table.
         assert "compute@512" in out
         assert "parts.rs_dense" in out
         assert "trend gate OK" in out
-        # Compute rows stop at r03 while parts data reaches r05: the gate
-        # must SAY it is comparing stale numbers, not stay silent.
+        # Chip compute rows stop at r03 while later rounds keep moving:
+        # the gate must SAY it is comparing stale numbers, not stay
+        # silent.
         assert "STALE" in out and "compute@512" in out
 
     def test_check_fails_on_clean_exit_round_with_no_recoverable_data(
@@ -350,6 +351,75 @@ class TestStreamBatchSeries:
         }))
         rounds = bt.load_series([str(path)])
         assert rounds[0]["modes"] == {("stream_b4", 128): [44.0]}
+
+
+class TestGiantKSeries:
+    """compute rows at new giant sizes (BENCH_K=1024/2048) are LEARNED —
+    gated under the same-platform rule like every compute row — and their
+    absence from a default-plan round is an opt-in plan gap, never STALE
+    or an unknown series."""
+
+    def test_giant_k_round_learned_and_gated_same_platform(self, tmp_path,
+                                                           capsys):
+        bt = _load()
+        _round_file(tmp_path, 1, [
+            {"mode": "compute", "k": 1024, "mb_per_s": 2.0},
+        ], platform="cpu")
+        _round_file(tmp_path, 2, [
+            {"mode": "compute", "k": 1024, "mb_per_s": 1.9},
+        ], platform="cpu")
+        assert bt.main(["--dir", str(tmp_path)]) == 0  # within threshold
+        out = capsys.readouterr().out
+        assert "compute@1024" in out  # rendered as a gated series
+        assert "not gated" not in out.split("compute@1024")[1].splitlines()[0]
+        # A real same-platform collapse gates like any compute row.
+        _round_file(tmp_path, 3, [
+            {"mode": "compute", "k": 1024, "mb_per_s": 0.5},
+        ], platform="cpu")
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        assert "compute@1024" in capsys.readouterr().out
+
+    def test_giant_k_cross_platform_prior_not_compared(self, tmp_path):
+        bt = _load()
+        _round_file(tmp_path, 1, [
+            {"mode": "compute", "k": 1024, "mb_per_s": 900.0},
+        ], platform="tpu")
+        _round_file(tmp_path, 2, [
+            {"mode": "compute", "k": 1024, "mb_per_s": 2.0},
+        ], platform="cpu")
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+
+    def test_giant_k_absent_from_default_round_is_opt_in_not_stale(
+        self, tmp_path, capsys
+    ):
+        bt = _load()
+        _round_file(tmp_path, 1, [
+            {"mode": "compute", "k": 1024, "mb_per_s": 2.0},
+            {"mode": "compute", "k": 128, "mb_per_s": 50.0},
+        ], platform="cpu")
+        # Default plan next round: no BENCH_K row.
+        _round_file(tmp_path, 2, [
+            {"mode": "compute", "k": 128, "mb_per_s": 51.0},
+        ], platform="cpu")
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "opt-in: compute@1024" in out
+        assert "STALE" not in out
+
+    def test_giant_k_opt_in_lands_in_json_not_stale(self, tmp_path, capsys):
+        import json as _json
+
+        bt = _load()
+        _round_file(tmp_path, 1, [
+            {"mode": "compute", "k": 2048, "mb_per_s": 1.0},
+        ], platform="cpu")
+        _round_file(tmp_path, 2, [
+            {"mode": "compute", "k": 128, "mb_per_s": 50.0},
+        ], platform="cpu")
+        bt.main(["--dir", str(tmp_path), "--json"])
+        payload = _json.loads(capsys.readouterr().out)
+        assert [s["series"] for s in payload["opt_in"]] == ["compute@2048"]
+        assert payload["stale"] == []
 
 
 class TestMalformedInputsFailFast:

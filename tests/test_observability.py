@@ -318,11 +318,22 @@ class TestBlockJournal:
 
 
 class TestProfilerHooks:
-    def test_hbm_gauge_is_none_on_cpu(self):
+    def test_hbm_gauge_falls_back_to_rss_on_cpu(self):
+        """CPU keeps no allocator stats, so the device reader stays None
+        — but the recorded high-water falls back to peak RSS (labeled
+        source="rss") so the giant-square memory claims are measurable
+        on this image."""
         from celestia_app_tpu.trace import profiler
+        from celestia_app_tpu.trace.metrics import registry
 
         assert profiler.hbm_high_water() is None
-        assert profiler.record_hbm_high_water(point="test") is None
+        peak = profiler.record_hbm_high_water(point="test", k=4)
+        assert peak is not None and peak > 0
+        assert profiler.rss_high_water() == peak
+        gauge = registry().get("celestia_hbm_peak_bytes")
+        assert gauge is not None
+        rendered = "\n".join(gauge.render())
+        assert 'source="rss"' in rendered and 'point="test"' in rendered
 
     def test_profiler_window_gated_and_bounded(self, monkeypatch, tmp_path):
         from celestia_app_tpu.trace.profiler import BlockProfiler

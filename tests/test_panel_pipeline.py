@@ -1,0 +1,280 @@
+"""Panel-streamed extend+DAH == the dense full-square pipeline, bit for bit.
+
+The giant-square lowering (kernels/panel.py, $CELESTIA_PIPE_PANEL) must
+reproduce the materializing staged composition exactly — EDS bytes, row
+and column roots, data root — for both RS constructions, for panel sizes
+that do and do not divide k, through both column-phase legs (dense
+XOR-accumulated partial products and the panel-blocked FFT butterflies),
+and through every routing surface (compute(), warmup(), the
+BlockPipeline's panel-granular staging).  A chaos drill faults a
+mid-panel dispatch and confirms the ladder falls to the materializing
+path with roots unchanged.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from celestia_app_tpu.constants import SHARE_SIZE
+from celestia_app_tpu.da.eds import ExtendedDataSquare, _pipeline
+from celestia_app_tpu.kernels.panel import (
+    panel_bounds,
+    panel_count,
+    panel_pipeline,
+    panel_rows,
+)
+
+
+def random_ods(k: int, seed: int) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    ods = rng.integers(0, 256, size=(k, k, SHARE_SIZE), dtype=np.uint8)
+    ods[..., 0] = 0  # namespaces below the parity namespace
+    return ods
+
+
+def _staged(k: int, ods: np.ndarray, construction: str):
+    fn = jax.jit(_pipeline(k, construction))
+    return [np.asarray(x) for x in fn(jnp.asarray(ods, dtype=jnp.uint8))]
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_panel(monkeypatch):
+    """Each test sets the seam explicitly; none inherits it."""
+    monkeypatch.delenv("CELESTIA_PIPE_PANEL", raising=False)
+    yield
+
+
+class TestPanelSeam:
+    def test_env_parse(self, monkeypatch):
+        assert panel_rows(512) == 0  # unset: off
+        monkeypatch.setenv("CELESTIA_PIPE_PANEL", "off")
+        assert panel_rows(512) == 0
+        monkeypatch.setenv("CELESTIA_PIPE_PANEL", "auto")
+        assert panel_rows(256) == 0  # auto engages at k >= 512 only
+        assert panel_rows(512) == 64
+        assert panel_rows(2048) == 64
+        monkeypatch.setenv("CELESTIA_PIPE_PANEL", "16")
+        assert panel_rows(8) == 8  # clamped to k
+        assert panel_rows(64) == 16
+        monkeypatch.setenv("CELESTIA_PIPE_PANEL", "bogus")
+        assert panel_rows(64) == 0
+
+    def test_bounds_cover_uneven(self):
+        assert panel_bounds(8, 3) == ((0, 3), (3, 6), (6, 8))
+        assert panel_bounds(8, 4) == ((0, 4), (4, 8))
+        assert panel_bounds(2, 2) == ((0, 2),)
+
+    def test_mode_routing_is_per_k(self, monkeypatch):
+        from celestia_app_tpu.kernels.fused import (
+            pipeline_mode,
+            pipeline_mode_for_k,
+        )
+
+        monkeypatch.setenv("CELESTIA_PIPE_PANEL", "auto")
+        assert pipeline_mode() == "fused"  # k-less callers unchanged
+        assert pipeline_mode_for_k(8) == "fused"
+        assert pipeline_mode_for_k(512) == "panel"
+        assert panel_count(512) == 8
+
+
+class TestPanelParity:
+    """Golden-pinned bit-identity vs the dense full-square pipeline:
+    k in {2, 8, 32} x both RS constructions x panel sizes that do and do
+    not divide k evenly."""
+
+    CASES = [
+        (2, 1),   # divides
+        (8, 4),   # divides
+        (8, 3),   # does not divide: short last panel
+        (32, 8),  # divides
+        (32, 5),  # does not divide
+    ]
+
+    @pytest.mark.parametrize("construction", ["vandermonde", "leopard"])
+    @pytest.mark.parametrize("k,rows", CASES)
+    def test_panel_matches_dense_full_square(self, k, rows, construction,
+                                             monkeypatch):
+        monkeypatch.setenv("CELESTIA_PIPE_PANEL", str(rows))
+        ods = random_ods(k, seed=k * 31 + rows)
+        ref = _staged(k, ods, construction)
+        got = panel_pipeline(k, construction)(ods)
+        for name, a, b in zip(("eds", "row_roots", "col_roots", "droot"),
+                              ref, got):
+            assert np.array_equal(a, np.asarray(b)), (k, rows, name)
+
+    @pytest.mark.parametrize("construction", ["vandermonde", "leopard"])
+    @pytest.mark.parametrize("k,rows", [(8, 4), (8, 3)])
+    def test_roots_only_twin(self, k, rows, construction, monkeypatch):
+        """The DAH-only variant (what the proposer needs) produces the
+        same roots without ever assembling the square."""
+        monkeypatch.setenv("CELESTIA_PIPE_PANEL", str(rows))
+        ods = random_ods(k, seed=k * 37 + rows)
+        _, rr, cr, droot = _staged(k, ods, construction)
+        got = panel_pipeline(k, construction, roots_only=True)(ods)
+        assert len(got) == 3
+        assert np.array_equal(rr, np.asarray(got[0]))
+        assert np.array_equal(cr, np.asarray(got[1]))
+        assert np.array_equal(droot, np.asarray(got[2]))
+
+    @pytest.mark.parametrize("construction", ["vandermonde", "leopard"])
+    def test_fft_leg_panel_blocked_columns(self, construction, monkeypatch):
+        """CELESTIA_RS_FFT=on routes the column phase through the
+        panel-blocked butterfly staging (kernels/fft.col_block_encode_fn)
+        — bytes identical to the dense full-square reference."""
+        k, rows = 8, 3
+        ods = random_ods(k, seed=1105)
+        ref = _staged(k, ods, construction)  # dense, unpanelled
+        monkeypatch.setenv("CELESTIA_PIPE_PANEL", str(rows))
+        monkeypatch.setenv("CELESTIA_RS_FFT", "on")
+        got = panel_pipeline(k, construction)(ods)
+        for name, a, b in zip(("eds", "row_roots", "col_roots", "droot"),
+                              ref, got):
+            assert np.array_equal(a, np.asarray(b)), name
+
+    def test_golden_vectors_through_panel(self, monkeypatch):
+        """The reference golden DAH hash (k=2) via the panel lowering."""
+        from celestia_app_tpu.constants import NAMESPACE_SIZE
+        from celestia_app_tpu.da.dah import DataAvailabilityHeader
+        from tests.test_fused_pipeline import K2_HASH, _golden_share
+
+        monkeypatch.setenv("CELESTIA_PIPE_PANEL", "1")
+        k = 2
+        ods = np.frombuffer(
+            b"".join([_golden_share()] * (k * k)), dtype=np.uint8
+        ).reshape(k, k, SHARE_SIZE)
+        _, rr, cr, _ = panel_pipeline(k)(ods)
+        dah = DataAvailabilityHeader(
+            row_roots=[bytes(r) for r in np.asarray(rr)],
+            column_roots=[bytes(r) for r in np.asarray(cr)],
+        )
+        assert dah.hash() == K2_HASH
+        assert NAMESPACE_SIZE == 29
+
+
+class TestPanelRouting:
+    def test_compute_routes_and_journals_panels(self, monkeypatch):
+        from celestia_app_tpu.trace import journal
+        from celestia_app_tpu.trace.tracer import traced
+
+        k = 8
+        ods = random_ods(k, seed=7)
+        ref_root = ExtendedDataSquare.compute(ods).data_root()
+        monkeypatch.setenv("CELESTIA_PIPE_PANEL", "4")
+        before = len(traced().table(journal.TABLE))
+        eds = ExtendedDataSquare.compute(ods)
+        assert eds.data_root() == ref_root
+        rows = [
+            r for r in traced().table(journal.TABLE)[before:]
+            if r["source"] == "compute" and r["k"] == k
+        ]
+        assert rows and rows[-1]["mode"] == "panel"
+        assert rows[-1]["panels"] == 2
+
+    def test_device_array_input_slices_on_device(self, monkeypatch):
+        monkeypatch.setenv("CELESTIA_PIPE_PANEL", "3")
+        k = 8
+        ods = random_ods(k, seed=8)
+        ref = ExtendedDataSquare.compute(jnp.asarray(ods)).data_root()
+        monkeypatch.delenv("CELESTIA_PIPE_PANEL")
+        assert ref == ExtendedDataSquare.compute(ods).data_root()
+
+    def test_warmup_warms_panel_lowering(self, monkeypatch):
+        from celestia_app_tpu.da.eds import pipeline_cache_state, warmup
+        from celestia_app_tpu.trace import journal
+        from celestia_app_tpu.trace.tracer import traced
+
+        monkeypatch.setenv("CELESTIA_PIPE_PANEL", "2")
+        k = 4
+        warmup([k])
+        assert pipeline_cache_state(k) == "hit"
+        rows = [
+            r for r in traced().table(journal.TABLE)
+            if r["source"] == "warmup" and r["k"] == k
+        ]
+        assert rows and rows[-1]["mode"] == "panel"
+        assert rows[-1]["panels"] == 2
+
+    def test_extra_warmup_sizes_env(self, monkeypatch):
+        from celestia_app_tpu.da.eds import extra_warmup_sizes
+
+        monkeypatch.setenv("CELESTIA_WARMUP_K", "1024, 2048 junk 96")
+        assert extra_warmup_sizes() == [1024, 2048]
+        monkeypatch.delenv("CELESTIA_WARMUP_K")
+        assert extra_warmup_sizes() == []
+
+    def test_stream_pipeline_panel_granular(self, monkeypatch):
+        """BlockPipeline under the panel seam: batching forced off, the
+        slot consumed panel-at-a-time, every streamed root bit-identical
+        to the materializing path, journal rows carry the panel count."""
+        from celestia_app_tpu.parallel.pipeline import BlockPipeline, stream_blocks
+        from celestia_app_tpu.trace import journal
+        from celestia_app_tpu.trace.tracer import traced
+
+        k = 8
+        odss = [(i, random_ods(k, seed=100 + i)) for i in range(3)]
+        refs = {t: ExtendedDataSquare.compute(o).data_root() for t, o in odss}
+        monkeypatch.setenv("CELESTIA_PIPE_PANEL", "4")
+        pipe = BlockPipeline(k, depth=2, batch=4)
+        assert pipe.batch == 1  # panel squares never coalesce
+        pipe.close()
+        before = len(traced().table(journal.TABLE))
+        for tag, eds in stream_blocks(iter(odss), k, depth=2):
+            assert eds.data_root() == refs[tag], tag
+        rows = [
+            r for r in traced().table(journal.TABLE)[before:]
+            if r["source"] == "stream" and r["k"] == k
+        ]
+        assert rows and all(r["mode"] == "panel" for r in rows)
+        assert all(r.get("panels") == 2 for r in rows)
+
+
+class TestPanelChaosDrill:
+    def test_mid_panel_fault_falls_to_materializing_path(self, monkeypatch):
+        """Fault a mid-panel dispatch: the ladder must walk down from the
+        panel rung and serve the SAME roots from a materializing rung."""
+        from celestia_app_tpu import chaos
+        from celestia_app_tpu.chaos import degrade
+
+        k = 8
+        ods = random_ods(k, seed=55)
+        ref_root = ExtendedDataSquare.compute(ods).data_root()
+        monkeypatch.setenv("CELESTIA_PIPE_PANEL", "2")
+        degrade.reset_for_tests()
+        # p=0.45: the seeded per-seam RNG passes some panel dispatches
+        # and fails a LATER one — a genuinely mid-panel fault, not a
+        # front-door rejection — until the breaker walks the ladder.
+        chaos.install("seed=11,dispatch_fail=0.45")
+        try:
+            eds = ExtendedDataSquare.compute(ods)
+        finally:
+            chaos.install("")
+            chaos.uninstall()
+        try:
+            assert eds.data_root() == ref_root
+            state = degrade.degraded_state()
+            assert state is not None and state["device"] != "panel"
+        finally:
+            degrade.reset_for_tests()
+
+    def test_panel_is_top_ladder_rung(self, monkeypatch):
+        from celestia_app_tpu.chaos import degrade
+
+        assert degrade.LADDER[0] == "panel"
+        # Stepping off the panel rung lands on the MATERIALIZING base the
+        # process warmed (default "fused"), never on a colder in-between
+        # variant nothing compiled: a giant-k fused_epi compile on the
+        # consensus hot path is the stall the ladder exists to avoid.
+        monkeypatch.delenv("CELESTIA_PIPE_FUSED", raising=False)
+        ladder = degrade.DeviceDegradation()
+        assert ladder.degrade("panel", observed="panel") == "fused"
+        # A k without the panel seat is unaffected by the panel trip:
+        assert ladder.effective_mode("fused") == "fused"
+        assert ladder.effective_mode("panel") == "fused"
+        # With the epi seat tuned in, that IS the warmed base — land there.
+        monkeypatch.setenv("CELESTIA_PIPE_FUSED", "epi")
+        ladder2 = degrade.DeviceDegradation()
+        assert ladder2.degrade("panel", observed="panel") == "fused_epi"
