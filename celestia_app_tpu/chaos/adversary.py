@@ -203,6 +203,20 @@ class Adversary:
             self._tampered[entry.height] = tampered
         return tampered
 
+    def invalidate_tampered(self, height: int) -> None:
+        """Drop the memoized tampered view of one height.
+
+        The memo's contract is "one attack serves ONE corrupted square",
+        which holds only while the underlying height is the same state:
+        after a repair-driven re-admission (serve/cache.ForestCache.put /
+        readmit call this) the stale tampered copy would keep serving the
+        PRE-heal bytes and hide the recovery until a restart.  The
+        withheld/malformed coordinate SETS stay memoized — they are pure
+        functions of the spec, and a still-active adversary re-tampers a
+        freshly fetched square with exactly the same coordinates."""
+        with self._lock:
+            self._tampered.pop(height, None)
+
     def count_injection(self, seam: str, fault: str) -> None:
         """Adversary events ride the same injection accounting as the
         infrastructure seams (celestia_chaos_injections_total + the

@@ -87,14 +87,23 @@ def repair_sweep_mode() -> str:
     )
 
 
-def _root_mismatch_detected(reason: str, **context) -> None:
+def _root_mismatch_detected(reason: str, height: int | None = None,
+                            **context) -> None:
     """Every repair rejection is an adversary-detection event: tick the
     detection counter and black-box the moment (the survivor set and the
-    DAH that disagreed are in the trace tables right now)."""
+    DAH that disagreed are in the trace tables right now).  When the
+    caller knows WHICH height's repair was rejected, the signal also
+    feeds the healing loop (serve/heal.py) — an engine already healing
+    that height ignores its own rejection, so the wire cannot recurse."""
     from celestia_app_tpu.chaos.adversary import detections
     from celestia_app_tpu.trace.flight_recorder import note_trigger
 
     detections().inc(kind="root_mismatch")
+    if height is not None:
+        context["height"] = height
+        from celestia_app_tpu.serve import heal
+
+        heal.note_detection("root_mismatch", height)
     note_trigger("root_mismatch", reason=reason, **context)
 
 
@@ -441,13 +450,17 @@ def repair(
     shares: np.ndarray,
     present: np.ndarray,
     dah: DataAvailabilityHeader | None = None,
+    *,
+    height: int | None = None,
 ) -> ExtendedDataSquare:
     """Reconstruct the full EDS.
 
     shares: (2k, 2k, SHARE_SIZE) uint8 with arbitrary bytes at missing
     positions; present: (2k, 2k) bool availability mask.  If `dah` is given,
     the repaired square's roots must match it (the Repair contract: a light
-    node verifies what it reconstructs).
+    node verifies what it reconstructs).  `height`, when the caller knows
+    it, stamps rejection events with the chain coordinate so the healing
+    loop can subscribe to them.
     """
     from celestia_app_tpu.chaos.degrade import guarded_dispatch
 
@@ -485,12 +498,12 @@ def repair(
     # one bool crosses back to the host).
     consistent = jnp.all((eds == damaged) | ~present_orig[..., None])
     if not bool(consistent):
-        _root_mismatch_detected("inconsistent_survivors", k=k)
+        _root_mismatch_detected("inconsistent_survivors", height=height, k=k)
         raise RootMismatch("recovered shares are not a consistent codeword")
     out = ExtendedDataSquare(eds, rr, cr, droot, k)
     if dah is not None:
         got = DataAvailabilityHeader.from_eds(out)
         if not got.equals(dah):
-            _root_mismatch_detected("dah_mismatch", k=k)
+            _root_mismatch_detected("dah_mismatch", height=height, k=k)
             raise RootMismatch("repaired square does not match the DAH")
     return out

@@ -648,6 +648,7 @@ def _handlers(node) -> dict:
 
     def _das_payload(build, kind: str) -> bytes:
         from celestia_app_tpu.serve.api import UnknownHeight
+        from celestia_app_tpu.serve.heal import HealingInProgress
         from celestia_app_tpu.serve.sampler import (
             BadProofDetected,
             ShareWithheld,
@@ -657,6 +658,13 @@ def _handlers(node) -> dict:
             payload = build()
         except UnknownHeight as e:
             raise _Abort("NOT_FOUND", str(e)) from None
+        except HealingInProgress as e:
+            # The HTTP planes' 503 + Retry-After: the height is mid-heal
+            # (serve/heal.py) — RETRYABLE, never the terminal
+            # FAILED_PRECONDITION/DATA_LOSS the detections answer.  A
+            # client that backs off and retries lands on the healed
+            # height.
+            raise _Abort("UNAVAILABLE", str(e)) from None
         except ShareWithheld as e:
             # The HTTP planes' 410 Gone: the share is committed but being
             # withheld — the light client's detection signal, distinct

@@ -1385,11 +1385,22 @@ class NodeServer:
         # Mount the node's DAS surface behind GET /das/* on every plane
         # (the shared handler; last-registered node answers).
         self._das_provider = None
+        self._healer = None
         if hasattr(node, "das_provider"):
             from celestia_app_tpu.trace.exposition import register_das_provider
 
             self._das_provider = node.das_provider()
             register_das_provider(self._das_provider)
+            # $CELESTIA_HEAL=1: close the detect->repair->re-serve loop
+            # (serve/heal.py) — detections on this node's sampler trigger
+            # batched repair + root-verified re-admission on a worker
+            # thread instead of ending at a 410/502.
+            from celestia_app_tpu.serve import heal
+
+            if heal.heal_enabled():
+                self._healer = heal.HealingEngine(
+                    self._das_provider, name=f"node:{self.port}"
+                ).start()
 
     def start(self, block_interval_s: float | None = None):
         t = threading.Thread(target=self.httpd.serve_forever, daemon=True)
@@ -1419,6 +1430,8 @@ class NodeServer:
             from celestia_app_tpu.trace.exposition import unregister_health_provider
 
             unregister_health_provider(self._health_name, self._health_provider)
+        if self._healer is not None:
+            self._healer.close()
         if self._das_provider is not None:
             from celestia_app_tpu.trace.exposition import unregister_das_provider
 

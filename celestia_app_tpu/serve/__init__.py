@@ -17,6 +17,13 @@ users" in ROADMAP terms; ACeD's scalable DA-oracle read path):
               serve, so GetShareProof / GetSharesByNamespace responses
               are byte-identical across JSON-RPC, REST, and gRPC by
               construction (the /metrics exposition pattern).
+  heal.py     HealingEngine: the detect -> repair -> re-serve loop — a
+              ShareWithheld / BadProofDetected / RootMismatch detection
+              triggers batched repair from verified survivors, the
+              recovered square is root-verified against the committed
+              DAH, re-admitted (ForestCache.readmit), and the withheld
+              coordinates serve again; failures land in per-height
+              quarantine ($CELESTIA_HEAL=1 wires one automatically).
 
 Wire-up: ServingNode retains each committed height's EDS into its cache
 (rpc/server.py) and registers a DasProvider on the shared exposition

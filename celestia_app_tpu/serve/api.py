@@ -99,12 +99,38 @@ class DasProvider:
         self.cache = cache if cache is not None else ForestCache()
         self.sampler = sampler if sampler is not None else ProofSampler()
         self.rebuild = rebuild
+        # The node's HealingEngine (serve/heal.py), when one is wired:
+        # heights mid-heal answer retryable statuses instead of the
+        # terminal detection errors.
+        self.healer = None
         # Serializes the miss path: N concurrent requests for one evicted
         # height must cost ONE square rebuild + forest build, not N.
         self._rebuild_lock = threading.Lock()
 
     def entry(self, height: int):
+        healer = self.healer
+        if healer is not None and healer.healing(height):
+            from celestia_app_tpu.serve.heal import HealingInProgress
+
+            # Mid-heal is RETRYABLE (503 + Retry-After / UNAVAILABLE),
+            # never the terminal 410/502: the detection that started the
+            # heal already got its terminal status, and the client that
+            # backs off lands on the healed height.
+            raise HealingInProgress(height, healer.retry_after_s)
+        return self.serve_view(height)
+
+    def serve_view(self, height: int):
+        """The (possibly adversary-filtered) view of a height, WITHOUT
+        the mid-heal gate — what the network answers this node.  The
+        healing engine gathers from this view (and trusts none of it
+        unverified); `entry()` adds the gate for external samplers."""
         entry = self._honest_entry(height)
+        if getattr(entry, "healed", False):
+            # A height recovered by repair and root-verified locally is
+            # served from this node's own store: the withholding /
+            # tampering proposer sits between the node and the network,
+            # not between the node and its verified bytes.
+            return entry
         # The adversary seam: a tampering proposer (malform_shares /
         # wrong_root in $CELESTIA_CHAOS) serves a corrupted VIEW of the
         # height — same object every request, honest cache untouched —

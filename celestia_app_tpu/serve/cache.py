@@ -73,6 +73,15 @@ class CachedForest:
         self.k = eds.k
         self.eds = eds
         self.device_resident = True
+        # Provenance for the healing loop (serve/heal.py): `owner` is the
+        # admitting ForestCache (detection signals route to the engine
+        # whose cache owns the sampled entry); `healed` marks a height
+        # recovered by repair and ROOT-VERIFIED locally — the adversary
+        # sits between this node and the network, not between this node
+        # and its own verified store, so healed entries are served
+        # without the withholding/tampering intercepts.
+        self.owner = None
+        self.healed = False
         self.row_flat = row_flat  # (N, 90) — all row-tree levels, flat
         self.col_flat = col_flat
         self.widths, self.offsets = forest_level_layout(self.k)
@@ -214,31 +223,120 @@ class ForestCache:
 
             row_flat, col_flat = jit_forest(eds.k)(jnp.asarray(eds._eds))
             entry = CachedForest(height, eds, row_flat, col_flat)
+            entry.owner = self
             # Admission happens INSIDE the gate: a concurrent put that
             # passes the gate next must find the entry resident, or the
             # single-flight promise ("one forest dispatch per height")
             # would leak through the build->admit window.
-            evicted: list[CachedForest] = []
-            with self._lock:
-                self._host.pop(height, None)  # re-admission promotes
-                self._device[height] = entry
-                self._device.move_to_end(height)
-                while len(self._device) > cap:
-                    h, old = self._device.popitem(last=False)
-                    evicted.append(old)
-                    self._last_eviction = h
-                for old in evicted:
-                    old.spill()
-                    self._host[old.height] = old
-                    self._host.move_to_end(old.height)
-                dropped = 0
-                while len(self._host) > spill_cap:
-                    self._host.popitem(last=False)
-                    dropped += 1
+            spilled, dropped = self._admit(entry, cap, spill_cap)
         self._building.pop(height, None)
-        self._count_evictions(len(evicted), dropped)
+        self._count_evictions(spilled, dropped)
         self._publish_residency()
+        self._invalidate_tamper_memo(height)
         return entry
+
+    def _admit(self, entry: CachedForest, cap: int, spill_cap: int
+               ) -> tuple[int, int]:
+        """Insert `entry` at the device tier's MRU end (REPLACING any
+        resident same-height entry), spill device overflow to host, drop
+        host overflow; returns (spilled, dropped).  Caller holds the
+        height's build gate."""
+        evicted: list[CachedForest] = []
+        with self._lock:
+            self._host.pop(entry.height, None)  # re-admission promotes
+            self._device[entry.height] = entry
+            self._device.move_to_end(entry.height)
+            while len(self._device) > cap:
+                h, old = self._device.popitem(last=False)
+                evicted.append(old)
+                self._last_eviction = h
+            for old in evicted:
+                old.spill()
+                self._host[old.height] = old
+                self._host.move_to_end(old.height)
+            dropped = 0
+            while len(self._host) > spill_cap:
+                self._host.popitem(last=False)
+                dropped += 1
+        return len(evicted), dropped
+
+    def readmit(self, height: int, eds, *, healed: bool = True
+                ) -> CachedForest | None:
+        """Repair-driven re-admission: install the RECOVERED (already
+        root-verified — serve/heal.py's verify phase gates this call)
+        square for a height, replacing whatever is resident.
+
+        Rides the same per-height single-flight gate as `put`, so a
+        heal racing a rebuild-on-miss coalesces: when the gate opens on
+        an entry already serving the same data root (the rebuild won the
+        race with identical bytes), that entry is KEPT — one forest
+        build total, and its retention pins (eds._retain_cb, the PR 9
+        write-after-retain fence) are left untouched — and only marked
+        healed.  Either way the adversary's per-height tamper memo is
+        evicted, so recovery is visible on the very next request, with
+        no process restart."""
+        cap, spill_cap = self._capacity()
+        if cap <= 0:  # retention disabled: nothing to re-admit into
+            self._invalidate_tamper_memo(height)
+            return None
+        with self._lock:
+            gate = self._building.get(height)
+            if gate is None:
+                gate = self._building[height] = threading.Lock()
+        root = eds.data_root()
+        with gate:
+            with self._lock:
+                existing = self._device.get(height) or self._host.get(height)
+            if existing is not None and existing.data_root == root:
+                # Keep the resident entry on whichever tier it lives on
+                # (its gathers already serve these exact bytes); only
+                # freshen its LRU slot and mark it healed.
+                entry = existing
+                entry.healed = entry.healed or healed
+                spilled = dropped = 0
+                with self._lock:
+                    if height in self._device:
+                        self._device.move_to_end(height)
+                    elif height in self._host:
+                        self._host.move_to_end(height)
+            else:
+                import jax.numpy as jnp
+
+                from celestia_app_tpu.kernels.fused import jit_forest
+
+                row_flat, col_flat = jit_forest(eds.k)(jnp.asarray(eds._eds))
+                entry = CachedForest(height, eds, row_flat, col_flat)
+                entry.owner = self
+                entry.healed = healed
+                spilled, dropped = self._admit(entry, cap, spill_cap)
+        self._building.pop(height, None)
+        self._count_evictions(spilled, dropped)
+        self._publish_residency()
+        self._invalidate_tamper_memo(height)
+        return entry
+
+    @staticmethod
+    def _invalidate_tamper_memo(height: int) -> None:
+        """Every (re-)admission drops the adversary's memoized tampered
+        view of the height: the memo exists so one attack serves ONE
+        corrupted square, but a square that was re-admitted (healed,
+        rebuilt) is new state — serving the stale tampered copy would
+        hide the recovery until a process restart.  One injector read
+        when no chaos is configured; never raises."""
+        try:
+            from celestia_app_tpu import chaos
+
+            adv = chaos.active_adversary()
+            if adv is not None:
+                adv.invalidate_tampered(height)
+        except Exception:  # chaos-ok: admission must not depend on chaos state
+            pass
+
+    def contains(self, height: int) -> bool:
+        """Counter-free residency probe (any tier) — the healing engine's
+        "is this height mine" check must not skew hit/miss accounting."""
+        with self._lock:
+            return height in self._device or height in self._host
 
     def _count_evictions(self, spilled: int, dropped: int) -> None:
         if not (spilled or dropped):

@@ -1,0 +1,519 @@
+"""HealingEngine: the detect -> repair -> re-serve control loop.
+
+Every leg existed in isolation before this module — the sampling plane
+DETECTS (ShareWithheld / BadProofDetected, PR 10), `da/repair` rebuilds a
+square from >= 25% survivors at device speed, and ForestCache re-admits —
+but a detection ended at an HTTP 410/502 and a flight bundle.  This is
+the ACeD-style availability-oracle loop (arXiv 2011.00102): the node that
+notices a gap CLOSES it, so downstream consumers never see one.
+
+One heal, five measured phases (`celestia_heal_seconds{phase}`):
+
+  detect    detection-signal latency: first detection note -> heal start
+            (the queue wait; the sampling-side time-to-first-detection is
+            the drill's separate detect_ms, per arXiv 2201.07287's
+            P(detect | s samples) model)
+  gather    collect the surviving shares for the height: withheld
+            coordinates never answer, and every fetched share is
+            verified against the node's COMMITTED NMT leaf digests (the
+            retained forest's level-0 nodes chain to the DAH this node
+            signed) — tampered bytes can never enter the repair as
+            "survivors"
+  repair    batched device repair (da/repair.py), riding
+            chaos/degrade.guarded_dispatch: an injected dispatch fault
+            mid-repair walks the ladder, never wedges the node
+  verify    the recovered square's roots are re-derived and compared
+            bit-for-bit against the committed DAH BEFORE anything else
+            can see the bytes — a heal that cannot prove itself is a
+            failed attempt, never a served square
+  readmit   re-admission into ForestCache through the single-flight
+            gate (ForestCache.readmit: coalesces with a concurrent
+            rebuild, evicts any adversary-tampered per-height memo) and
+            the entry is marked `healed`, so the previously-withheld
+            coordinates serve from the node's own verified store
+
+plus `total` (detection note -> re-admitted).  Outcomes land on
+`celestia_heal_total{outcome}`:
+
+  healed        the height serves again, root-verified
+  irrecoverable the survivor set is below the k-survivor threshold
+                (da/repair.IrrecoverableSquare) — no retry can help
+  quarantined   bounded retry/backoff exhausted without a verified
+                recovery
+
+Failed heights enter QUARANTINE: their detections stay terminal
+(410/502), no heal storm re-enqueues them, and the state is visible in
+the /healthz "heal" block and `GET /heal`.  Heights mid-heal are
+RETRYABLE: `DasProvider.entry` raises HealingInProgress, which the HTTP
+planes map to 503 + Retry-After and the gRPC Das service to UNAVAILABLE
+— a client that backs off lands on the healed height.
+
+Both terminal transitions black-box: `heal_completed` /
+`heal_quarantined` flight-recorder triggers carry the node name, height,
+outcome, per-phase latencies, and attempt count.
+
+Wiring: construct a HealingEngine over a DasProvider (it registers
+itself module-wide and as `provider.healer`); the detection sites
+(serve/sampler, da/repair) publish through `note_detection`, which is
+one registry walk and never raises.  `$CELESTIA_HEAL=1` makes a
+ServingNode wire and start one automatically (rpc/server.NodeServer).
+scripts/chaos_soak.py drills the loop single-node and as a multi-node
+quorum; the measured rounds land in ADV_rNN.json under bench_trend's
+`heal` gate.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+#: Heal outcomes (the `celestia_heal_total{outcome}` label values).
+HEAL_OUTCOMES = ("healed", "quarantined", "irrecoverable")
+
+#: Measured phases of one heal (`celestia_heal_seconds{phase}`).
+HEAL_PHASES = ("detect", "gather", "repair", "verify", "readmit", "total")
+
+
+class HealingInProgress(RuntimeError):
+    """The height is being healed right now: retryable (HTTP 503 +
+    Retry-After / gRPC UNAVAILABLE), never the terminal 410/502 — the
+    client that backs off and retries lands on the healed height."""
+
+    def __init__(self, height: int, retry_after_s: float):
+        super().__init__(
+            f"height {height} is being healed (detected attack under "
+            f"repair); retry in {retry_after_s:g}s"
+        )
+        self.height = height
+        self.retry_after_s = retry_after_s
+
+
+def heal_enabled() -> bool:
+    """$CELESTIA_HEAL=1: a ServingNode wires and starts a HealingEngine
+    over its DasProvider automatically (default off: detection without
+    reaction, the pre-PR-12 behavior)."""
+    import os
+
+    return os.environ.get("CELESTIA_HEAL", "") == "1"
+
+
+def heal_seconds():
+    from celestia_app_tpu.trace.metrics import DEVICE_SECONDS_BUCKETS, registry
+
+    return registry().histogram(
+        "celestia_heal_seconds",
+        "self-healing loop latency by phase (detect = detection note to "
+        "heal start; gather/repair/verify/readmit per attempt; total = "
+        "detection note to re-admitted)",
+        buckets=DEVICE_SECONDS_BUCKETS,
+    )
+
+
+def heal_total():
+    from celestia_app_tpu.trace.metrics import registry
+
+    return registry().counter(
+        "celestia_heal_total",
+        "heal attempts resolved, by outcome "
+        "(healed / quarantined / irrecoverable)",
+    )
+
+
+# --- the engine registry (how detection sites find their engine) ------------
+
+_REG_LOCK = threading.Lock()
+_ENGINES: list["HealingEngine"] = []
+
+
+def register(engine: "HealingEngine") -> None:
+    with _REG_LOCK:
+        if engine not in _ENGINES:
+            _ENGINES.append(engine)
+
+
+def unregister(engine: "HealingEngine") -> None:
+    with _REG_LOCK:
+        if engine in _ENGINES:
+            _ENGINES.remove(engine)
+
+
+def engines() -> tuple["HealingEngine", ...]:
+    with _REG_LOCK:
+        return tuple(_ENGINES)
+
+
+def _reset_for_tests() -> None:
+    with _REG_LOCK:
+        _ENGINES.clear()
+
+
+def note_detection(kind: str, height, entry=None) -> None:
+    """Publish one detection signal (withheld / bad_proof / root_mismatch)
+    to whichever registered engine owns the height.  The hot-path face of
+    the subscription: no engine registered = one tuple read; NEVER raises
+    (a heal trigger that takes down the detection path is worse than no
+    healing at all)."""
+    if height is None:
+        return
+    for eng in engines():
+        try:
+            eng.note(kind, int(height), entry=entry)
+        except Exception:  # chaos-ok: healing must never break detection
+            pass
+
+
+def heal_health_block():
+    """The /healthz "heal" block: None when no engine is registered, one
+    engine's state directly, or {name: state} for a multi-node process."""
+    engs = engines()
+    if not engs:
+        return None
+    if len(engs) == 1:
+        return engs[0].state()
+    return {e.name: e.state() for e in engs}
+
+
+def heal_payload() -> dict:
+    """GET /heal: every registered engine's state, keyed by engine name —
+    a pure function of engine state, so all planes serve identical
+    bytes."""
+    return {"engines": {e.name: e.state() for e in engines()}}
+
+
+def default_survivors(height: int, view, honest):
+    """The default gather: (shares (n,n,S) uint8, present (n,n) bool).
+
+    `view` is the adversary-filtered serve view (what the network answers
+    this node); `honest` is the node's retained proof state, whose forest
+    level-0 leaf digests chain to the DAH the node committed.  Two rules:
+
+      * a coordinate the adversary withholds never answers — the
+        simulation's fetch failure (chaos.active_adversary's withheld
+        set IS the model's ground truth of "nobody served this");
+      * every share that DOES answer is verified against the committed
+        leaf digest before it may count as a survivor — a malformed
+        share hashes to the wrong leaf and is excluded, so tampered
+        bytes cannot poison the repair (the survivors stay authoritative
+        inside da/repair, so this gate must hold at the door).
+    """
+    import numpy as np
+
+    from celestia_app_tpu import chaos
+    from celestia_app_tpu.constants import (
+        NAMESPACE_SIZE,
+        PARITY_NAMESPACE_BYTES,
+    )
+    from celestia_app_tpu.nmt.hasher import NmtHasher
+
+    k = view.k
+    n = 2 * k
+    shares = np.array(np.asarray(view.eds._eds), dtype=np.uint8, copy=True)
+    present = np.ones((n, n), dtype=bool)
+    adv = chaos.active_adversary()
+    if adv is not None and adv.withhold_frac > 0:
+        for (r, c) in adv.withheld_set(height, n):
+            present[r, c] = False
+    # ONE gather for every committed level-0 digest (the whole height
+    # answers only the retryable status while this runs, so the gather
+    # phase must not pay n round trips where one take suffices), then
+    # per-share digest checks only for coordinates that answered.
+    expect = honest.gather("row", [
+        honest.flat_index(r, 0, c) for r in range(n) for c in range(n)
+    ]).reshape(n, n, -1)
+    for r in range(n):
+        for c in range(n):
+            if not present[r, c]:
+                continue
+            ns = (
+                bytes(shares[r, c, :NAMESPACE_SIZE].tobytes())
+                if r < k and c < k
+                else PARITY_NAMESPACE_BYTES
+            )
+            leaf = NmtHasher.hash_leaf(ns + bytes(shares[r, c].tobytes()))
+            if leaf != bytes(expect[r, c].tobytes()):
+                present[r, c] = False
+    return shares, present
+
+
+class HealingEngine:
+    """The per-node heal loop over one DasProvider.
+
+    Detection notes enqueue a height and mark it mid-heal (samples get
+    the retryable status immediately); `start()` runs a worker thread,
+    `process_pending()` drains synchronously (drills, tests).  Bounded
+    retry with exponential backoff per height; terminal failures land in
+    quarantine, never in a retry storm.
+    """
+
+    def __init__(self, provider, *, name: str = "node",
+                 committed_dah=None, survivors=None,
+                 max_attempts: int = 3, backoff_s: float = 0.02,
+                 retry_after_s: float = 1.0, sleep=time.sleep):
+        self.provider = provider
+        self.name = name
+        self.max_attempts = max(int(max_attempts), 1)
+        self.backoff_s = backoff_s
+        self.retry_after_s = retry_after_s
+        self._committed = committed_dah  # callable(height) -> DAH override
+        self._survivors = survivors or default_survivors
+        self._sleep = sleep
+        self._cv = threading.Condition()
+        self._queue: collections.deque = collections.deque()
+        self._healing: dict[int, dict] = {}
+        # Bounded (oldest evicted): a long-lived node under sustained
+        # attack must not grow its health payload or RSS with chain
+        # height.  An ancient evicted quarantine record means that
+        # height would be re-attempted on a fresh detection — by then
+        # the world has usually changed; terminal-forever is not worth
+        # an unbounded map.
+        self._quarantined: collections.OrderedDict = collections.OrderedDict()
+        self._healed: collections.OrderedDict = collections.OrderedDict()
+        self._healed_count = 0
+        self._last: dict | None = None
+        self._thread: threading.Thread | None = None
+        self._stop_flag = False
+        provider.healer = self
+        register(self)
+
+    #: Retained terminal records (memory bound, not a semantic window).
+    MAX_RECORDS = 1024
+    #: Quarantined heights serialized into state() (the health payload
+    #: must stay bounded like /namespaces' top-N cap).
+    STATE_QUARANTINED = 16
+
+    # --- subscription -------------------------------------------------------
+    def note(self, kind: str, height: int, entry=None) -> bool:
+        """One detection signal.  Returns True when the height was
+        enqueued for healing; False when it is not this engine's (the
+        entry's owning cache is another node's), already mid-heal (the
+        healer's own repair hitting RootMismatch must not recurse), or
+        quarantined (terminal: no heal storm)."""
+        if entry is not None:
+            if getattr(entry, "owner", None) is not self.provider.cache:
+                return False
+        elif not self.provider.cache.contains(height):
+            return False
+        with self._cv:
+            if height in self._healing or height in self._quarantined:
+                return False
+            self._healing[height] = {
+                "kind": kind,
+                "t0": time.perf_counter(),
+                "t0_ns": time.time_ns(),
+            }
+            self._queue.append(height)
+            self._cv.notify()
+        return True
+
+    def healing(self, height: int) -> bool:
+        with self._cv:
+            return height in self._healing
+
+    def is_quarantined(self, height: int) -> bool:
+        with self._cv:
+            return height in self._quarantined
+
+    # --- processing ---------------------------------------------------------
+    def start(self) -> "HealingEngine":
+        with self._cv:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._stop_flag = False
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"healer-{self.name}"
+        )
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._stop_flag:
+                    self._cv.wait()
+                if not self._queue and self._stop_flag:
+                    return
+                height = self._queue.popleft()
+            try:
+                self._heal_one(height)
+            except Exception:  # chaos-ok: a dead worker = permanent 503s
+                # _heal_one guards its own bookkeeping; this is the
+                # belt-and-braces floor — whatever slipped through must
+                # not kill the drain loop, or every later detection
+                # would mark its height mid-heal forever with nobody
+                # left to heal it.
+                pass
+
+    def process_pending(self) -> list[tuple[int, str]]:
+        """Drain the queue synchronously (drills / tests / a node with no
+        worker thread); returns [(height, outcome)]."""
+        out: list[tuple[int, str]] = []
+        while True:
+            with self._cv:
+                if not self._queue:
+                    return out
+                height = self._queue.popleft()
+            out.append((height, self._heal_one(height)))
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        with self._cv:
+            self._stop_flag = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout_s)
+        unregister(self)
+        if getattr(self.provider, "healer", None) is self:
+            self.provider.healer = None
+
+    # --- one heal -----------------------------------------------------------
+    def _heal_one(self, height: int) -> str:
+        from celestia_app_tpu.da.repair import IrrecoverableSquare
+        from celestia_app_tpu.trace.flight_recorder import note_trigger
+        from celestia_app_tpu.trace.tracer import traced
+
+        with self._cv:
+            info = self._healing.get(height)
+        if info is None:  # raced a concurrent resolution
+            return "skipped"
+        outcome = "quarantined"
+        detail = None
+        phases_ms: dict[str, float] = {}
+        attempt = 0
+        try:
+            lat = heal_seconds()
+            lat.observe(time.perf_counter() - info["t0"], phase="detect")
+            for attempt in range(1, self.max_attempts + 1):
+                try:
+                    phases_ms = self._attempt(height, lat)
+                    outcome = "healed"
+                    break
+                except IrrecoverableSquare as e:
+                    # Below the k-survivor threshold: retrying cannot
+                    # mint shares that do not exist.
+                    outcome, detail = "irrecoverable", f"{e}"
+                    break
+                except Exception as e:  # chaos-ok: bounded retry, then quarantine
+                    detail = f"{type(e).__name__}: {e}"
+                    if attempt < self.max_attempts:
+                        self._sleep(
+                            min(self.backoff_s * 2 ** (attempt - 1), 1.0)
+                        )
+        finally:
+            # The height LEAVES the mid-heal state no matter what raised
+            # above — a stranded _healing entry would answer 503 forever
+            # with nobody left to heal it.
+            total_s = time.perf_counter() - info["t0"]
+            rec = {
+                "height": height,
+                "kind": info["kind"],
+                "outcome": outcome,
+                "attempts": attempt,
+                "total_ms": round(total_s * 1e3, 3),
+                "phases_ms": phases_ms,
+                "detail": detail,
+            }
+            with self._cv:
+                self._healing.pop(height, None)
+                self._last = rec
+                if outcome == "healed":
+                    self._healed[height] = rec
+                    self._healed_count += 1
+                    while len(self._healed) > self.MAX_RECORDS:
+                        self._healed.popitem(last=False)
+                else:
+                    self._quarantined[height] = rec
+                    while len(self._quarantined) > self.MAX_RECORDS:
+                        self._quarantined.popitem(last=False)
+        lat.observe(total_s, phase="total")
+        heal_total().inc(outcome=outcome)
+        traced().write(
+            "heal", node=self.name, height=height, kind=info["kind"],
+            outcome=outcome, attempts=attempt, total_ms=rec["total_ms"],
+        )
+        note_trigger(
+            "heal_completed" if outcome == "healed" else "heal_quarantined",
+            node=self.name, height=height, kind=info["kind"],
+            outcome=outcome, attempts=attempt, total_ms=rec["total_ms"],
+            phases_ms=phases_ms, detail=detail,
+        )
+        return outcome
+
+    def _attempt(self, height: int, lat) -> dict[str, float]:
+        """gather -> repair -> verify -> readmit, each timed; raises on
+        any failed leg (the retry/quarantine policy lives in the
+        caller)."""
+        from celestia_app_tpu.da.dah import DataAvailabilityHeader
+        from celestia_app_tpu.da.repair import RootMismatch, repair
+
+        provider = self.provider
+        t = time.perf_counter()
+        honest = provider._honest_entry(height)
+        view = provider.serve_view(height)
+        shares, present = self._survivors(height, view, honest)
+        gather_s = time.perf_counter() - t
+        lat.observe(gather_s, phase="gather")
+
+        committed = (
+            self._committed(height)
+            if self._committed is not None
+            else DataAvailabilityHeader(
+                row_roots=list(honest.row_roots),
+                column_roots=list(honest.col_roots),
+            )
+        )
+        t = time.perf_counter()
+        # The sweep and re-extension ride guarded_dispatch inside repair:
+        # a chaos dispatch_fail here walks the ladder, never wedges us.
+        recovered = repair(shares, present, height=height)
+        repair_s = time.perf_counter() - t
+        lat.observe(repair_s, phase="repair")
+
+        t = time.perf_counter()
+        got = DataAvailabilityHeader.from_eds(recovered)
+        if not got.equals(committed) or (
+            recovered.data_root() != committed.hash()
+        ):
+            # Root-verify BEFORE anything can see the bytes: a recovery
+            # that cannot prove itself is a failed attempt, not a served
+            # square.
+            raise RootMismatch(
+                f"healed square at height {height} does not reproduce "
+                "the committed DAH"
+            )
+        verify_s = time.perf_counter() - t
+        lat.observe(verify_s, phase="verify")
+
+        t = time.perf_counter()
+        provider.cache.readmit(height, recovered, healed=True)
+        readmit_s = time.perf_counter() - t
+        lat.observe(readmit_s, phase="readmit")
+        return {
+            "gather": round(gather_s * 1e3, 3),
+            "repair": round(repair_s * 1e3, 3),
+            "verify": round(verify_s * 1e3, 3),
+            "readmit": round(readmit_s * 1e3, 3),
+        }
+
+    # --- introspection ------------------------------------------------------
+    def state(self) -> dict:
+        """The /healthz "heal" block / GET /heal unit: bounded, JSON-safe
+        (only the newest STATE_QUARANTINED quarantine records serialize —
+        the /namespaces top-N discipline; `quarantined_total` keeps the
+        full count honest)."""
+        with self._cv:
+            newest = sorted(self._quarantined)[-self.STATE_QUARANTINED:]
+            return {
+                "healing": sorted(self._healing),
+                "quarantined": {
+                    str(h): {
+                        "outcome": self._quarantined[h]["outcome"],
+                        "kind": self._quarantined[h]["kind"],
+                        "attempts": self._quarantined[h]["attempts"],
+                        "detail": self._quarantined[h]["detail"],
+                    }
+                    for h in newest
+                },
+                "quarantined_total": len(self._quarantined),
+                "healed": self._healed_count,
+                "last": dict(self._last) if self._last else None,
+            }

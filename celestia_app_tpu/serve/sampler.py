@@ -164,11 +164,17 @@ def _check_withheld(entry, coords) -> None:
     adv = chaos.active_adversary()
     if adv is None or adv.withhold_frac <= 0:
         return
+    if getattr(entry, "healed", False):
+        # A healed height serves from this node's own recovered,
+        # root-verified store — the withholding proposer no longer sits
+        # between the node and these bytes (serve/heal.py).
+        return
     height = getattr(entry, "height", 0)
     n = 2 * entry.k
     for row, col in coords:
         if adv.withholds(height, n, row, col):
             from celestia_app_tpu.chaos.adversary import detections
+            from celestia_app_tpu.serve import heal
             from celestia_app_tpu.trace.flight_recorder import note_trigger
 
             adv.count_injection("adversary.withhold", "withhold_frac")
@@ -178,6 +184,10 @@ def _check_withheld(entry, coords) -> None:
                 height=height, row=int(row), col=int(col),
                 withhold_frac=adv.withhold_frac,
             )
+            # The detect -> act wire: a registered HealingEngine turns
+            # this very detection into a repair + re-admit; the failed
+            # sample itself still answers the terminal 410.
+            heal.note_detection("withheld", height, entry=entry)
             raise ShareWithheld(height, int(row), int(col))
 
 
@@ -310,6 +320,7 @@ class ProofSampler:
             if p.verify(entry.data_root):
                 continue
             from celestia_app_tpu.chaos.adversary import detections
+            from celestia_app_tpu.serve import heal
             from celestia_app_tpu.trace.flight_recorder import note_trigger
 
             detections().inc(kind="bad_proof")
@@ -317,6 +328,9 @@ class ProofSampler:
                 "root_mismatch",
                 reason="serve_verification",
                 height=getattr(entry, "height", 0),
+            )
+            heal.note_detection(
+                "bad_proof", getattr(entry, "height", None), entry=entry
             )
             raise BadProofDetected(
                 "assembled proof does not verify against the committed "

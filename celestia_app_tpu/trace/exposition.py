@@ -21,6 +21,9 @@ hits:
                                  (serve/, the batched proof plane)
     GET /das/shares              namespace-ranged query: ?height=&namespace=
                                  (29-byte hex) -> shares + multi-row proof
+    GET /heal                    the self-healing loop's state: heights
+                                 mid-heal, quarantined heights, last heal
+                                 outcome per engine (serve/heal.py)
 
 /healthz is the SLO face: beyond {"status": "SERVING"}, any registered
 health providers (a ServingNode registers its own snapshot: last block
@@ -103,6 +106,14 @@ def health_payload() -> dict:
     from celestia_app_tpu.trace.slo import engine
 
     payload["slo"] = engine().health_block()
+    # The self-healing face (serve/heal.py): which heights are mid-heal,
+    # which are quarantined, and the last heal's outcome — absent when no
+    # HealingEngine is registered (detection without reaction).
+    from celestia_app_tpu.serve.heal import heal_health_block
+
+    heal = heal_health_block()
+    if heal is not None:
+        payload["heal"] = heal
     if providers:
         layers = {}
         for name, provider in sorted(providers.items()):
@@ -160,6 +171,7 @@ def _das_response(kind: str, query: str, plane: str):
             {"error": "no DAS provider registered (serve/ plane not wired)"}
         ).encode()
     from celestia_app_tpu.serve.api import UnknownHeight, count_served, render
+    from celestia_app_tpu.serve.heal import HealingInProgress
     from celestia_app_tpu.serve.sampler import BadProofDetected, ShareWithheld
 
     params = _query_params(query)
@@ -178,6 +190,22 @@ def _das_response(kind: str, query: str, plane: str):
             )
     except UnknownHeight as e:
         return 404, "application/json", json.dumps({"error": str(e)}).encode()
+    except HealingInProgress as e:
+        # 503 + Retry-After: the height is mid-heal (serve/heal.py) — a
+        # RETRYABLE gap, never the terminal 410/502.  The body is a pure
+        # function of the exception, so the JSON-RPC and REST twins stay
+        # byte-identical; the gRPC Das service maps the same condition
+        # to UNAVAILABLE.
+        return (
+            503,
+            "application/json",
+            json.dumps({
+                "error": str(e),
+                "healing": True,
+                "retry_after_s": e.retry_after_s,
+            }).encode(),
+            {"Retry-After": str(max(1, int(-(-e.retry_after_s // 1))))},
+        )
     except ShareWithheld as e:
         # 410 Gone: the share exists in the commitment but is being
         # withheld — the light client's detection signal, distinct from
@@ -221,6 +249,12 @@ def handle_observability_get(path: str, plane: str = "shared"):
         return 200, METRICS_CONTENT_TYPE, metrics_payload()
     if p == "/healthz":
         return 200, "application/json", json.dumps(health_payload()).encode()
+    if p == "/heal":
+        from celestia_app_tpu.serve.heal import heal_payload
+
+        # A pure function of registered-engine state: all planes serve
+        # identical bytes (the /metrics pattern).
+        return 200, "application/json", json.dumps(heal_payload()).encode()
     if p == "/namespaces":
         from celestia_app_tpu.trace import square_journal
 
@@ -259,10 +293,15 @@ def handle_observability_get(path: str, plane: str = "shared"):
 
 def send_observability_response(handler, resp) -> None:
     """Write a handle_observability_get result through a
-    BaseHTTPRequestHandler (the shape all three planes' handlers share)."""
-    status, content_type, body = resp
+    BaseHTTPRequestHandler (the shape all three planes' handlers share).
+    A result may carry an optional 4th element of extra headers (the
+    healing-in-progress 503's Retry-After)."""
+    status, content_type, body = resp[0], resp[1], resp[2]
+    extra = resp[3] if len(resp) > 3 else {}
     handler.send_response(status)
     handler.send_header("Content-Type", content_type)
     handler.send_header("Content-Length", str(len(body)))
+    for name, value in extra.items():
+        handler.send_header(name, value)
     handler.end_headers()
     handler.wfile.write(body)

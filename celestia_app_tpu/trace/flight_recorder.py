@@ -15,6 +15,12 @@ box: when an anomaly TRIGGER fires —
                       (the wrong-root / malformed-square attack face)
     withholding_detected  serve/sampler.py: a DAS sample hit a withheld
                       share (the data-withholding attack face)
+    heal_completed    serve/heal.py: the detect->repair->re-serve loop
+                      recovered a height (context carries the per-phase
+                      latencies — the moment the node healed itself)
+    heal_quarantined  serve/heal.py: a heal exhausted its retry budget
+                      or the height is below the k-survivor threshold —
+                      the height is quarantined, operator input needed
 
 — `note_trigger` atomically dumps one JSON bundle under
 $CELESTIA_FLIGHT_DIR: the last-N rows of EVERY trace table, the
@@ -48,6 +54,8 @@ TRIGGERS = (
     "slo_fast_burn",
     "root_mismatch",
     "withholding_detected",
+    "heal_completed",
+    "heal_quarantined",
 )
 
 #: Hard ceiling on per-table tail rows in a bundle.

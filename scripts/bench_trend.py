@@ -48,6 +48,18 @@ INVARIANTS first, latency second:
     detected on every probe;
   * repair-to-recovery total_ms gates like a parts time (lower better)
     against same-platform priors.
+
+The HEAL series (schema adv-v2, rounds carrying a "heal" block from the
+chaos_soak healing drills) extends the same shape: the detect -> repair
+-> re-serve loop's invariants gate hard — the heal must complete
+(`healed`), the previously-withheld coordinate must serve post-heal
+(`served_after_heal`), recovered roots must be bit-identical to the
+committed DAH (`root_identical`), tampered state must never have been
+served in the heal window (`tampered_never_served`), and the quorum leg
+must heal every node — while the single-node and quorum detect-to-
+restored latencies (`heal_total_ms` / `total_ms`) gate lower-better
+against same-platform priors that also carry a heal block (older
+adv-v1 rounds simply predate the loop: additive, never STALE).
 """
 
 from __future__ import annotations
@@ -324,6 +336,9 @@ def load_adv_round(path: str) -> dict:
         "honest_identical": bool(raw["honest_identical"]),
         "all_monotone": bool(raw.get("all_monotone", False)),
         "adversaries_detected": dict(raw["adversaries_detected"]),
+        # adv-v2: the healing drill's single-node + quorum legs; None on
+        # rounds that predate the detect->act loop (additive series).
+        "heal": raw.get("heal"),
     }
 
 
@@ -366,26 +381,68 @@ def find_adv_regressions(adv_rounds: list[dict], threshold_pct: float) -> list[d
             "worse_pct": 100.0, "allowed_pct": 0.0,
         })
     platforms = {r["round"]: r.get("platform") for r in adv_rounds}
-    pts = [
+
+    def _gate_lower_better(series: str, pts: list[tuple[int, float]]) -> None:
+        if len(pts) < 2 or pts[-1][0] != rnd:
+            return
+        priors = _comparable_priors(pts, platforms)
+        if not priors:
+            return
+        best_prior = min(priors)
+        last = pts[-1][1]
+        if best_prior > 0:
+            worse_pct = (last - best_prior) / best_prior * 100.0
+            if worse_pct > threshold_pct:
+                out.append({
+                    "series": series, "unit": "ms",
+                    "round": rnd, "value": last,
+                    "best_prior": best_prior,
+                    "worse_pct": round(worse_pct, 2),
+                    "allowed_pct": round(threshold_pct, 2),
+                })
+
+    _gate_lower_better("adv.repair_total_ms", [
         (r["round"], float(r["repair"]["total_ms"]))
         for r in adv_rounds
         if r["repair"].get("total_ms") is not None
-    ]
-    if len(pts) >= 2 and pts[-1][0] == rnd:
-        priors = _comparable_priors(pts, platforms)
-        if priors:
-            best_prior = min(priors)
-            last = pts[-1][1]
-            if best_prior > 0:
-                worse_pct = (last - best_prior) / best_prior * 100.0
-                if worse_pct > threshold_pct:
+    ])
+
+    # --- the heal series (schema adv-v2; additive — rounds without a
+    # heal block predate the detect->act loop and are neither gated nor
+    # STALE) ----------------------------------------------------------------
+    heal = newest.get("heal")
+    if heal is not None:
+        single = heal.get("single") or {}
+        for inv in ("healed", "served_after_heal", "root_identical",
+                    "tampered_never_served"):
+            if not single.get(inv):
+                out.append({
+                    "series": f"heal.single.{inv}", "unit": "invariant",
+                    "round": rnd, "value": False, "best_prior": True,
+                    "worse_pct": 100.0, "allowed_pct": 0.0,
+                })
+        quorum = heal.get("quorum")
+        if quorum is not None:
+            for inv in ("healed", "served_after_heal", "root_identical"):
+                if not quorum.get(inv):
                     out.append({
-                        "series": "adv.repair_total_ms", "unit": "ms",
-                        "round": rnd, "value": last,
-                        "best_prior": best_prior,
-                        "worse_pct": round(worse_pct, 2),
-                        "allowed_pct": round(threshold_pct, 2),
+                        "series": f"heal.quorum.{inv}", "unit": "invariant",
+                        "round": rnd, "value": False, "best_prior": True,
+                        "worse_pct": 100.0, "allowed_pct": 0.0,
                     })
+        _gate_lower_better("heal.single.total_ms", [
+            (r["round"], float(r["heal"]["single"]["heal_total_ms"]))
+            for r in adv_rounds
+            if r.get("heal")
+            and (r["heal"].get("single") or {}).get("heal_total_ms")
+            is not None
+        ])
+        _gate_lower_better("heal.quorum.total_ms", [
+            (r["round"], float(r["heal"]["quorum"]["total_ms"]))
+            for r in adv_rounds
+            if r.get("heal")
+            and (r["heal"].get("quorum") or {}).get("total_ms") is not None
+        ])
     return out
 
 
@@ -765,6 +822,19 @@ def main(argv: list[str] | None = None) -> int:
                   f"repair {rep.get('total_ms')} ms "
                   f"(recovered={rep.get('recovered')})"
                   + (f"  [{r['platform']}]" if r.get("platform") else ""))
+            heal = r.get("heal")
+            if heal:
+                single = heal.get("single") or {}
+                quorum = heal.get("quorum") or {}
+                print(f"    heal: single detect {single.get('detect_ms')} ms"
+                      f" + heal {single.get('heal_total_ms')} ms -> restored"
+                      f" {single.get('restored_ms')} ms "
+                      f"(healed={single.get('healed')}, served="
+                      f"{single.get('served_after_heal')})"
+                      + (f"; quorum {quorum.get('nodes')} nodes "
+                         f"{quorum.get('total_ms')} ms "
+                         f"(healed={quorum.get('healed')})"
+                         if quorum else ""))
         for c in seats:
             print(f"  SEAT CHANGE: {c['seat']} {c['from']} -> {c['to']} "
                   f"(r{c['from_round']:02d} -> r{c['round']:02d}; the >3% "

@@ -32,6 +32,20 @@ LATENCY but never CORRECTNESS.  Four drills, one process:
                        multi-square dispatch ($CELESTIA_PIPE_BATCH) must
                        fall down the ladder (batched -> unbatched fused
                        -> staged), roots bit-identical throughout.
+  2e. healing drill  — the detect -> repair -> re-serve loop
+                       (serve/heal.py): a ShareWithheld / BadProofDetected
+                       detection must TRIGGER batched repair, the
+                       recovered square must root-verify against the
+                       committed DAH before re-admission, the previously
+                       withheld coordinate must serve a verifying proof,
+                       mid-heal samples get the retryable 503-face, and
+                       an irrecoverable height lands in quarantine.
+  2f. quorum heal    — N serve-nodes with partial local share sets under
+                       one withholding proposer: each detects through its
+                       own sampling plane, repairs from the quorum's
+                       UNION of surviving shares, and re-serves — with
+                       per-node flight bundles proving who detected what
+                       when (the ACeD oracle-committee story).
   3. gossip drill    — a redundant flood over a lossy, duplicating,
                        transiently-failing link; the receiver-side
                        msg-id dedup must converge on exactly the unique
@@ -915,6 +929,359 @@ def run_adversary_detection_drill(k: int = 8) -> dict:
     }
 
 
+def _wait_until(predicate, timeout_s: float = 120.0,
+                poll_s: float = 0.005) -> bool:
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < timeout_s:
+        if predicate():
+            return True
+        time.sleep(poll_s)
+    return False
+
+
+def run_healing_drill(k: int = 8, frac: float = 0.25,
+                      quarantine_frac: float = 0.95) -> dict:
+    """The detect -> repair -> re-serve loop, measured end to end through
+    the real sampling plane (the ISSUE-12 tentpole; ACeD's oracle loop).
+
+    Three legs on one node, healing on a live worker thread:
+
+      withhold leg   a DAS client samples the adversarial serve view
+                     until ShareWithheld fires; that detection TRIGGERS
+                     the HealingEngine, samples arriving mid-heal get the
+                     retryable HealingInProgress (the 503/UNAVAILABLE
+                     face), and the drill measures detect-to-restored-
+                     service: the previously-withheld coordinate must
+                     serve a verifying proof from the healed height.
+      wrong-root leg the tampered root is detected at the verification
+                     gate, healed, and the recovered root must be
+                     BIT-IDENTICAL to the committed DAH — with NOTHING
+                     tampered served as valid at any point in the window.
+      quarantine leg withholding beyond the k-survivor threshold: the
+                     heal must land in quarantine (irrecoverable), stay
+                     terminal (no heal storm, no retry of the impossible)
+                     and black-box through `heal_quarantined`.
+
+    Hard invariants (bench_trend gates these from the ADV round record):
+    served_after_heal, root_identical, tampered_never_served, healed.
+    The repair jit cache is warmed for the measured erasure shape first
+    (the bench convention: a serving node's cache is warm; the number is
+    the heal, not the first-ever compile)."""
+    from celestia_app_tpu import chaos
+    from celestia_app_tpu.da.repair import repair
+    from celestia_app_tpu.serve import heal as heal_mod
+    from celestia_app_tpu.serve.heal import HealingEngine, HealingInProgress
+    from celestia_app_tpu.serve.sampler import BadProofDetected, ShareWithheld
+    from celestia_app_tpu.trace import flight_recorder
+
+    _arm_flight_recorder()
+    chaos.install("")
+    eds, dah, entry, provider = _adv_square(k, seed=909)
+    honest_root = eds.data_root()
+    # Second + third heights for the wrong-root and quarantine legs.
+    extra = {}
+    for h, seed in ((2, 910), (3, 911)):
+        _, ods_h = _deterministic_blocks(1, k, seed=seed)[0]
+        from celestia_app_tpu.da.eds import ExtendedDataSquare
+
+        eds_h = ExtendedDataSquare.compute(ods_h)
+        provider.cache.put(h, eds_h)
+        extra[h] = eds_h.data_root()
+    n = 2 * k
+    engine = HealingEngine(provider, name="drill", retry_after_s=0.2).start()
+    flight_recorder._reset_for_tests()
+    # Rate limit OPEN (not drill-spanning): every terminal heal
+    # transition must black-box — this drill asserts one bundle per
+    # healed height plus the quarantine bundle.
+    _restore_interval = _pin_flight_interval(0.0)
+    tampered_served = False
+    try:
+        t0_ns = time.time_ns()
+        # --- withhold leg --------------------------------------------------
+        chaos.install(f"seed=41,withhold_frac={frac}")
+        adv = chaos.active_adversary()
+        withheld = sorted(adv.withheld_set(1, n))
+        # Warm the repair compiles for this exact erasure shape so the
+        # measured heal is the heal, not the first-ever jit build.
+        view = provider.serve_view(1)
+        honest = provider._honest_entry(1)
+        w_shares, w_present = heal_mod.default_survivors(1, view, honest)
+        try:
+            repair(w_shares, w_present)
+        except Exception:  # noqa: BLE001 — warmup only; the heal re-runs it
+            pass
+        client = np.random.default_rng(4321)
+        detect_samples, hit = 0, None
+        t_attack = time.perf_counter()
+        while hit is None and detect_samples < n * n * 4:
+            r, c = int(client.integers(0, n)), int(client.integers(0, n))
+            detect_samples += 1
+            try:
+                ent = provider.entry(1)
+                proof = provider.sampler.share_proof(ent, r, c)
+                if not proof.verify(honest_root):
+                    tampered_served = True
+            except ShareWithheld:
+                hit = (r, c)
+        detect_ms = (time.perf_counter() - t_attack) * 1e3
+        # Mid-heal: the worker is repairing right now — a sample must see
+        # the RETRYABLE status, not a terminal detection.
+        midheal_retryable = None
+        try:
+            provider.entry(1)
+            midheal_retryable = False  # heal already done: can't observe
+        except HealingInProgress:
+            midheal_retryable = True
+        healed = _wait_until(lambda: not engine.healing(1))
+        restored = False
+        if healed and hit is not None:
+            ent = provider.entry(1)
+            proof = provider.sampler.share_proof(ent, *hit)
+            restored = proof.verify(honest_root)
+        restored_ms = (time.perf_counter() - t_attack) * 1e3
+        # Every previously-withheld coordinate serves now (spot cap 32).
+        served_after_heal = restored
+        ent = provider.entry(1)
+        for r, c in withheld[:32]:
+            p = provider.sampler.share_proof(ent, r, c)
+            served_after_heal = served_after_heal and p.verify(honest_root)
+        root_identical = (
+            ent.data_root == honest_root
+            and ent.eds.data_root() == honest_root
+        )
+        with engine._cv:
+            single_rec = dict(engine._healed.get(1) or {})
+
+        # --- wrong-root leg ------------------------------------------------
+        chaos.install("seed=41,wrong_root=1")
+        wr_detected = False
+        try:
+            ent2 = provider.entry(2)
+            proof = provider.sampler.share_proof(ent2, 0, 0)
+            if not proof.verify(extra[2]):
+                tampered_served = True
+        except BadProofDetected:
+            wr_detected = True
+        wr_healed = _wait_until(lambda: not engine.healing(2))
+        ent2 = provider.entry(2)
+        wr_root_identical = ent2.data_root == extra[2]
+        wr_serves = provider.sampler.share_proof(ent2, 0, 0).verify(extra[2])
+
+        # --- quarantine leg ------------------------------------------------
+        chaos.install(f"seed=41,withhold_frac={quarantine_frac}")
+        q_detected = False
+        try:
+            ent3 = provider.entry(3)
+            provider.sampler.share_proof(ent3, 0, 0)
+        except ShareWithheld:
+            q_detected = True
+        except BadProofDetected:
+            pass
+        _wait_until(lambda: not engine.healing(3))
+        quarantined = engine.is_quarantined(3)
+        # Terminal: the next detection answers 410 again (no heal storm).
+        q_terminal = False
+        try:
+            ent3 = provider.entry(3)
+            provider.sampler.share_proof(ent3, 0, 0)
+        except ShareWithheld:
+            q_terminal = True
+        q_state = engine.state()["quarantined"].get("3") or {}
+    finally:
+        chaos.uninstall()
+        _restore_interval()
+        engine.close()
+    completed = flight_recorder.recent_dumps(
+        since_ns=t0_ns, trigger="heal_completed"
+    )
+    quarantined_dumps = flight_recorder.recent_dumps(
+        since_ns=t0_ns, trigger="heal_quarantined"
+    )
+    return {
+        "k": k,
+        "withhold_frac": frac,
+        "detect": {"samples": detect_samples, "ms": round(detect_ms, 3)},
+        "midheal_retryable": midheal_retryable,
+        "heal": single_rec,
+        "restored_ms": round(restored_ms, 3),
+        "served_after_heal": served_after_heal,
+        "root_identical": root_identical,
+        "tampered_never_served": not tampered_served,
+        "wrong_root": {
+            "detected": wr_detected,
+            "healed": wr_healed,
+            "root_identical": wr_root_identical,
+            "serves": wr_serves,
+        },
+        "quarantine": {
+            "frac": quarantine_frac,
+            "detected": q_detected,
+            "quarantined": quarantined,
+            "terminal_after": q_terminal,
+            "outcome": q_state.get("outcome"),
+            "bundle": len(quarantined_dumps) >= 1,
+        },
+        "heal_bundles": len(completed),
+        "detection": _detection(t0_ns, trigger="heal_completed"),
+        "ok": (
+            hit is not None
+            and single_rec.get("outcome") == "healed"
+            and served_after_heal
+            and root_identical
+            and not tampered_served
+            and wr_detected and wr_healed and wr_root_identical and wr_serves
+            and q_detected and quarantined and q_terminal
+            and q_state.get("outcome") == "irrecoverable"
+            and len(quarantined_dumps) >= 1
+            and len(completed) >= 2
+        ),
+    }
+
+
+def run_quorum_heal_drill(nodes: int = 3, k: int = 8,
+                          frac: float = 0.25,
+                          hold_p: float = 0.75) -> dict:
+    """Scale the heal past one process: N honest serve-nodes, each
+    retaining a PARTIAL local share set (every share held with
+    probability `hold_p`, per-node seeded), under one withholding
+    proposer.  Each node detects through its own sampling plane; each
+    node's engine repairs from the UNION of the quorum's surviving
+    shares (what peers can answer, minus what the adversary withholds,
+    every gathered share leaf-digest-verified against the committed
+    forest) and re-serves.  Per-node flight bundles (heal_completed
+    carries node/height/phase latencies; the rate limit is opened so
+    every node's detection black-boxes) prove who detected what when.
+
+    Invariants: every node serves the previously-withheld coordinate
+    with a proof verifying the committed root post-heal, and every
+    node's recovered root is bit-identical to the committed DAH."""
+    from celestia_app_tpu import chaos
+    from celestia_app_tpu.da.eds import ExtendedDataSquare
+    from celestia_app_tpu.da.repair import repair
+    from celestia_app_tpu.serve import heal as heal_mod
+    from celestia_app_tpu.serve.api import DasProvider
+    from celestia_app_tpu.serve.cache import ForestCache
+    from celestia_app_tpu.serve.heal import HealingEngine
+    from celestia_app_tpu.serve.sampler import ProofSampler, ShareWithheld
+    from celestia_app_tpu.trace import flight_recorder
+
+    _arm_flight_recorder()
+    chaos.install("")
+    _, ods = _deterministic_blocks(1, k, seed=515)[0]
+    n = 2 * k
+    # Per-node partial retention + the quorum union every healer gathers
+    # from.  Seeded so the drill (and its ADV round record) reproduces.
+    mask_rng = np.random.default_rng(2718)
+    local = [mask_rng.random((n, n)) < hold_p for _ in range(nodes)]
+    union = np.logical_or.reduce(local)
+
+    def union_gather(height, view, honest):
+        shares, present = heal_mod.default_survivors(height, view, honest)
+        return shares, present & union
+
+    providers, engines, roots = [], [], []
+    for i in range(nodes):
+        eds_i = ExtendedDataSquare.compute(ods)  # own handle per node
+        cache_i = ForestCache(heights=2, spill=2)
+        cache_i.put(1, eds_i)
+        provider_i = DasProvider(cache=cache_i, sampler=ProofSampler())
+        providers.append(provider_i)
+        roots.append(eds_i.data_root())
+        engines.append(HealingEngine(
+            provider_i, name=f"node{i}", survivors=union_gather,
+            retry_after_s=0.2,
+        ))
+    honest_root = roots[0]
+    flight_recorder._reset_for_tests()
+    _restore_interval = _pin_flight_interval(0.0)  # one bundle per NODE
+    try:
+        t0_ns = time.time_ns()
+        chaos.install(f"seed=51,withhold_frac={frac}")
+        adv = chaos.active_adversary()
+        withheld = sorted(adv.withheld_set(1, n))
+        # Warm the union erasure shape once (shared jit cache).
+        view = providers[0].serve_view(1)
+        honest = providers[0]._honest_entry(1)
+        w_shares, w_present = union_gather(1, view, honest)
+        try:
+            repair(w_shares, w_present)
+        except Exception:  # noqa: BLE001 — warmup only
+            pass
+        t_attack = time.perf_counter()
+        detections_per_node = []
+        for i, provider_i in enumerate(providers):
+            client = np.random.default_rng(7000 + i)
+            hit, samples = None, 0
+            t_n0 = time.perf_counter()
+            while hit is None and samples < n * n * 4:
+                r, c = int(client.integers(0, n)), int(client.integers(0, n))
+                samples += 1
+                try:
+                    ent = provider_i.entry(1)
+                    provider_i.sampler.share_proof(ent, r, c)
+                except ShareWithheld:
+                    hit = (r, c)
+            detections_per_node.append({
+                "node": f"node{i}",
+                "samples": samples,
+                "ms": round((time.perf_counter() - t_n0) * 1e3, 3),
+                "coord": list(hit) if hit else None,
+            })
+        # Collective recovery: every detecting node heals from the union.
+        heal_records = []
+        for i, engine in enumerate(engines):
+            engine.process_pending()
+            with engine._cv:
+                heal_records.append(dict(engine._healed.get(1) or {}))
+        # Restored service: the first detector's previously-withheld
+        # coordinate serves on EVERY node, proofs verifying the
+        # committed root.
+        first_hit = tuple(detections_per_node[0]["coord"])
+        served, roots_ok = True, True
+        for provider_i in providers:
+            ent = provider_i.entry(1)
+            p = provider_i.sampler.share_proof(ent, *first_hit)
+            served = served and p.verify(honest_root)
+            roots_ok = roots_ok and (
+                ent.data_root == honest_root
+                and ent.eds.data_root() == honest_root
+            )
+        total_ms = (time.perf_counter() - t_attack) * 1e3
+    finally:
+        chaos.uninstall()
+        _restore_interval()
+        for engine in engines:
+            engine.close()
+    completed = flight_recorder.recent_dumps(
+        since_ns=t0_ns, trigger="heal_completed"
+    )
+    healed_nodes = sum(
+        1 for rec in heal_records if rec.get("outcome") == "healed"
+    )
+    return {
+        "nodes": nodes,
+        "k": k,
+        "withhold_frac": frac,
+        "hold_p": hold_p,
+        "withheld_shares": len(withheld),
+        "union_coverage": round(float(union.mean()), 4),
+        "detections": detections_per_node,
+        "heals": heal_records,
+        "healed_nodes": healed_nodes,
+        "served_after_heal": served,
+        "root_identical": roots_ok,
+        "total_ms": round(total_ms, 3),
+        "heal_bundles": len(completed),
+        "detection": _detection(t0_ns, trigger="heal_completed"),
+        "ok": (
+            healed_nodes == nodes
+            and served and roots_ok
+            and all(d["coord"] for d in detections_per_node)
+            and len(completed) == nodes
+        ),
+    }
+
+
 def run_batched_fault_drill(k: int = 4, blocks: int = 6,
                             batch: int = 2) -> dict:
     """A persistent batched-dispatch fault must fall DOWN the ladder, not
@@ -1016,11 +1383,15 @@ def detection_table(rows: list[tuple[str, dict | None]]) -> str:
     return "\n".join(out)
 
 
-def write_adv_round(path: str, wd: dict, adv: dict, wall_s: float) -> None:
+def write_adv_round(path: str, wd: dict, adv: dict, wall_s: float,
+                    heal: dict | None = None,
+                    quorum: dict | None = None) -> None:
     """The checked-in ADV_rNN.json shape (bench_trend gates it): the
     measured detection-probability table, the repair-to-recovery
-    latency, and the always-detected verdicts for the tampering
-    adversaries."""
+    latency, the always-detected verdicts for the tampering adversaries,
+    and — schema adv-v2 — the healing drill's detect-to-restored-service
+    legs (single node + quorum), whose invariants bench_trend hard-fails
+    and whose total_ms gates lower-better under the same-platform rule."""
     import json
 
     import jax
@@ -1032,7 +1403,7 @@ def write_adv_round(path: str, wd: dict, adv: dict, wall_s: float) -> None:
     m = re.search(r"ADV_r(\d+)\.json$", os.path.basename(path))
     rec = {
         "n": int(m.group(1)) if m else 1,
-        "schema": "adv-v1",
+        "schema": "adv-v2" if heal is not None else "adv-v1",
         "platform": platform,
         "k": wd["k"],
         "trials": wd["trials"],
@@ -1047,6 +1418,36 @@ def write_adv_round(path: str, wd: dict, adv: dict, wall_s: float) -> None:
         },
         "wall_s": round(wall_s, 1),
     }
+    if heal is not None:
+        rec["heal"] = {
+            "single": {
+                "k": heal["k"],
+                "withhold_frac": heal["withhold_frac"],
+                "detect_ms": heal["detect"]["ms"],
+                "detect_samples": heal["detect"]["samples"],
+                "phases_ms": heal["heal"].get("phases_ms"),
+                "heal_total_ms": heal["heal"].get("total_ms"),
+                "restored_ms": heal["restored_ms"],
+                "healed": heal["heal"].get("outcome") == "healed",
+                "served_after_heal": heal["served_after_heal"],
+                "root_identical": heal["root_identical"],
+                "tampered_never_served": heal["tampered_never_served"],
+                "quarantine_outcome": heal["quarantine"].get("outcome"),
+            },
+        }
+        if quorum is not None:
+            rec["heal"]["quorum"] = {
+                "nodes": quorum["nodes"],
+                "k": quorum["k"],
+                "withhold_frac": quorum["withhold_frac"],
+                "hold_p": quorum["hold_p"],
+                "union_coverage": quorum["union_coverage"],
+                "detect_ms": [d["ms"] for d in quorum["detections"]],
+                "total_ms": quorum["total_ms"],
+                "healed": quorum["healed_nodes"] == quorum["nodes"],
+                "served_after_heal": quorum["served_after_heal"],
+                "root_identical": quorum["root_identical"],
+            }
     with open(path, "w") as f:
         json.dump(rec, f, indent=1, sort_keys=True)
         f.write("\n")
@@ -1137,8 +1538,33 @@ def main(argv=None) -> int:
           f"flight_dumps={adv['flight_dumps']}", flush=True)
     if not adv["ok"]:
         failures.append(f"adversary drill failed: {adv}")
+
+    hd = run_healing_drill(k=min(args.k, 8))
+    print(f"healing drill: detect {hd['detect']['samples']} samples / "
+          f"{hd['detect']['ms']} ms -> heal "
+          f"{hd['heal'].get('total_ms')} ms "
+          f"(phases {hd['heal'].get('phases_ms')}) -> restored "
+          f"{hd['restored_ms']} ms; served_after_heal="
+          f"{hd['served_after_heal']} root_identical={hd['root_identical']} "
+          f"tampered_never_served={hd['tampered_never_served']} "
+          f"quarantine={hd['quarantine']['outcome']}", flush=True)
+    if not hd["ok"]:
+        failures.append(f"healing drill failed: {hd}")
+
+    qd = run_quorum_heal_drill(nodes=3, k=min(args.k, 8))
+    print(f"quorum heal drill: {qd['nodes']} nodes @ k={qd['k']} "
+          f"union={qd['union_coverage']} -> healed_nodes="
+          f"{qd['healed_nodes']}/{qd['nodes']} "
+          f"detect_ms={[d['ms'] for d in qd['detections']]} "
+          f"total={qd['total_ms']} ms served={qd['served_after_heal']} "
+          f"roots_identical={qd['root_identical']} "
+          f"bundles={qd['heal_bundles']}", flush=True)
+    if not qd["ok"]:
+        failures.append(f"quorum heal drill failed: {qd}")
+
     if args.adv_out:
-        write_adv_round(args.adv_out, wd, adv, time.monotonic() - t_adv0)
+        write_adv_round(args.adv_out, wd, adv, time.monotonic() - t_adv0,
+                        heal=hd, quorum=qd)
         print(f"adversary round record -> {args.adv_out}", flush=True)
 
     gos = run_gossip_drill(args.spec)
@@ -1182,6 +1608,8 @@ def main(argv=None) -> int:
         ("batched fault", bat.get("detection")),
         ("withholding", wd.get("detection_signal")),
         ("adversary", adv.get("detection")),
+        ("healing", hd.get("detection")),
+        ("quorum heal", qd.get("detection")),
         ("gossip", None),  # healed by redundancy: no anomaly to page on
         ("breaker (epi seat)", brk_epi.get("detection")),
         ("breaker (fused)", brk.get("detection")),

@@ -69,28 +69,18 @@ def build_cache(heights: int, k: int, seed: int):
     return cache, roots
 
 
-def run_local(args) -> dict:
-    """Drive the in-process sampler queue with `threads` workers."""
-    from celestia_app_tpu.serve.sampler import ProofSampler
+def _run_plan(sampler, cache, plan, threads, verify_every, roots):
+    """One threaded pass over the sampling plan; returns
+    (lat_ms sorted, failures, withheld [(height, row, col)], wall_s).
+    A ShareWithheld is NOT a failure — it is the adversarial 410 path
+    the run exists to exercise — and it never kills a worker."""
+    from celestia_app_tpu.serve.sampler import ShareWithheld
 
-    cache, roots = build_cache(args.heights, args.k, args.seed)
-    sampler = ProofSampler()
-    n = 2 * args.k
-    rng = np.random.default_rng(args.seed)
-    axes = (
-        ("row", "col") if args.axes == "both" else (args.axes,)
-    )
-    plan = [
-        (int(rng.integers(1, args.heights + 1)),
-         int(rng.integers(0, n)), int(rng.integers(0, n)),
-         axes[int(rng.integers(0, len(axes)))])
-        for _ in range(args.samples)
-    ]
-    verify_every = max(1, args.samples // max(args.verify, 1))
     latencies: list[float] = []
     failures: list[str] = []
+    withheld: list[tuple[int, int, int]] = []
     lock = threading.Lock()
-    cursor = iter(range(args.samples))
+    cursor = iter(range(len(plan)))
 
     def worker():
         while True:
@@ -103,6 +93,10 @@ def run_local(args) -> dict:
             t0 = time.perf_counter()
             try:
                 proof = sampler.share_proof(entry, r, c, axis=axis)
+            except ShareWithheld:
+                with lock:
+                    withheld.append((h, r, c))
+                continue
             except Exception as e:  # noqa: BLE001 — a drop IS the measurement
                 with lock:
                     failures.append(f"({h},{r},{c}): {type(e).__name__}: {e}")
@@ -117,43 +111,135 @@ def run_local(args) -> dict:
                     failures.append(f"({h},{r},{c}): proof failed verify")
 
     t_start = time.perf_counter()
-    threads = [
-        threading.Thread(target=worker, daemon=True)
-        for _ in range(args.threads)
+    workers = [
+        threading.Thread(target=worker, daemon=True) for _ in range(threads)
     ]
-    for t in threads:
+    for t in workers:
         t.start()
-    for t in threads:
+    for t in workers:
         t.join()
     wall_s = time.perf_counter() - t_start
+    return sorted(v * 1e3 for v in latencies), failures, withheld, wall_s
 
-    lat_ms = sorted(v * 1e3 for v in latencies)
 
+def _pass_stats(lat_ms, wall_s) -> dict:
     def pct(p):
         if not lat_ms:
             return None
         return round(lat_ms[min(len(lat_ms) - 1, int(p * len(lat_ms)))], 3)
 
+    return {
+        "samples": len(lat_ms),
+        "wall_s": round(wall_s, 3),
+        "proofs_per_s": round(len(lat_ms) / wall_s, 2) if wall_s else None,
+        "proof_p50_ms": pct(0.50),
+        "proof_p99_ms": pct(0.99),
+    }
+
+
+def run_local(args) -> dict:
+    """Drive the in-process sampler queue with `threads` workers.
+
+    With `--withhold-frac` the run becomes ADVERSARIAL: a withholding
+    proposer (chaos/adversary.py, seeded by `--adv-seed`) hides that
+    fraction of every height's shares, so workers exercise the 410
+    detection path under load.  With `--heal` on top, every detected
+    height is healed (serve/heal.py: gather survivors -> batched repair
+    -> root-verify -> re-admit) and the SAME plan re-runs post-heal —
+    the summary then reports pre-heal vs post-heal proofs/sec and the
+    time from heal trigger to the first healed proof served."""
+    from celestia_app_tpu import chaos
+    from celestia_app_tpu.serve.sampler import ProofSampler
+
+    adversarial = args.withhold_frac > 0
+    if adversarial:
+        chaos.install(
+            f"seed={args.adv_seed},withhold_frac={args.withhold_frac}"
+        )
+    try:
+        cache, roots = build_cache(args.heights, args.k, args.seed)
+        sampler = ProofSampler()
+        n = 2 * args.k
+        rng = np.random.default_rng(args.seed)
+        axes = (
+            ("row", "col") if args.axes == "both" else (args.axes,)
+        )
+        plan = [
+            (int(rng.integers(1, args.heights + 1)),
+             int(rng.integers(0, n)), int(rng.integers(0, n)),
+             axes[int(rng.integers(0, len(axes)))])
+            for _ in range(args.samples)
+        ]
+        verify_every = max(1, args.samples // max(args.verify, 1))
+        lat_ms, failures, withheld, wall_s = _run_plan(
+            sampler, cache, plan, args.threads, verify_every, roots
+        )
+
+        heal_block = None
+        if args.heal and withheld:
+            from celestia_app_tpu.serve.api import DasProvider
+            from celestia_app_tpu.serve.heal import HealingEngine
+
+            provider = DasProvider(cache=cache, sampler=sampler)
+            engine = HealingEngine(provider, name="loadgen")
+            t_heal0 = time.perf_counter()
+            hit_heights = sorted({h for h, _, _ in withheld})
+            for h in hit_heights:
+                engine.note("withheld", h)
+            outcomes = dict(engine.process_pending())
+            # Time to FIRST healed proof: the earliest previously-
+            # withheld coordinate that now serves a verifying proof.
+            first_healed_ms = None
+            for h, r, c in withheld:
+                if outcomes.get(h) != "healed":
+                    continue
+                proof = sampler.share_proof(provider.entry(h), r, c)
+                if proof.verify(roots[h]):
+                    first_healed_ms = round(
+                        (time.perf_counter() - t_heal0) * 1e3, 3
+                    )
+                break
+            post_lat, post_fail, post_withheld, post_wall = _run_plan(
+                sampler, cache, plan, args.threads, verify_every, roots
+            )
+            failures.extend(post_fail)
+            engine.close()
+            heal_block = {
+                "heights_healed": [
+                    h for h in hit_heights if outcomes.get(h) == "healed"
+                ],
+                "outcomes": {str(h): o for h, o in outcomes.items()},
+                "time_to_first_healed_proof_ms": first_healed_ms,
+                "post_heal": _pass_stats(post_lat, post_wall),
+                "post_heal_withheld_hits": len(post_withheld),
+            }
+    finally:
+        if adversarial:
+            chaos.uninstall()
+
     import jax
 
-    return {
+    summary = {
         "metric": "das_loadgen",
         "mode": os.environ.get("CELESTIA_SERVE_MODE", "") or "batched",
-        "samples": len(lat_ms),
         "requested": args.samples,
         "heights": args.heights,
         "k": args.k,
         "threads": args.threads,
         "axes": args.axes,
-        "wall_s": round(wall_s, 3),
-        "proofs_per_s": round(len(lat_ms) / wall_s, 2) if wall_s else None,
-        "proof_p50_ms": pct(0.50),
-        "proof_p99_ms": pct(0.99),
+        **_pass_stats(lat_ms, wall_s),
         "verified": (len(lat_ms) + verify_every - 1) // verify_every,
         "failures": failures[:5],
         "platform": jax.default_backend(),
         "cache": cache.stats(),
     }
+    if adversarial:
+        summary["withhold_frac"] = args.withhold_frac
+        summary["adv_seed"] = args.adv_seed
+        summary["withheld_hits"] = len(withheld)
+    if heal_block is not None:
+        summary["heal"] = heal_block
+    return summary
 
 
 def run_url(args) -> dict:
@@ -238,6 +324,18 @@ def main(argv=None) -> int:
                     help="how many sampled proofs to verify against the root")
     ap.add_argument("--mode", choices=("batched", "host"), default=None,
                     help="pin $CELESTIA_SERVE_MODE for the run")
+    ap.add_argument("--withhold-frac", type=float, default=0.0,
+                    help="adversarial mix: a withholding proposer hides "
+                         "this fraction of every height's shares "
+                         "(exercises the 410 detection path under load)")
+    ap.add_argument("--adv-seed", type=int, default=21,
+                    help="seed for the adversary's withheld coordinate "
+                         "sets (deterministic per height)")
+    ap.add_argument("--heal", action="store_true",
+                    help="with --withhold-frac: heal every detected "
+                         "height (serve/heal.py) and re-run the plan, "
+                         "reporting pre- vs post-heal proofs/sec and "
+                         "time-to-first-healed-proof")
     ap.add_argument("--axes", choices=("row", "col", "both"), default="both",
                     help="sampling axis mix (light clients draw both)")
     ap.add_argument("--url", default=None,
@@ -284,9 +382,20 @@ def main(argv=None) -> int:
         for fail in summary["failures"]:
             print(f"FAIL: {fail}", file=sys.stderr)
         return 1
-    if summary["samples"] < args.samples:
-        print("FAIL: not every requested sample was served", file=sys.stderr)
+    expected = args.samples - summary.get("withheld_hits", 0)
+    if summary["samples"] < expected:
+        print("FAIL: not every serveable sample was served", file=sys.stderr)
         return 1
+    if summary.get("heal") is not None:
+        post = summary["heal"]
+        # With healing on, the post-heal pass must serve the FULL plan:
+        # a previously-withheld coordinate that still 410s means the
+        # heal did not restore service.
+        if (post["post_heal"]["samples"] < args.samples
+                or post["post_heal_withheld_hits"] > 0):
+            print("FAIL: post-heal pass still hit withheld shares",
+                  file=sys.stderr)
+            return 1
     return 0
 
 

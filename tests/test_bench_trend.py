@@ -548,12 +548,14 @@ class TestDasSeries:
         assert 'series="proofs_per_s"' in prom
 
 def _adv_file(tmp_path, n, *, total_ms=30.0, recovered=True, monotone=True,
-              honest=True, malform=True, wrong_root=True, platform="cpu"):
+              honest=True, malform=True, wrong_root=True, platform="cpu",
+              heal=None):
     p = ({"2": 0.5, "4": 0.7, "8": 0.9} if monotone
          else {"2": 0.9, "4": 0.5, "8": 0.7})
     path = tmp_path / f"ADV_r{n:02d}.json"
-    path.write_text(json.dumps({
-        "n": n, "schema": "adv-v1", "platform": platform, "k": 8,
+    rec = {
+        "n": n, "schema": "adv-v2" if heal else "adv-v1",
+        "platform": platform, "k": 8,
         "trials": 50, "sample_counts": [2, 4, 8],
         "detection": [{"withhold_frac": 0.25, "p_detect": p,
                        "monotone": monotone}],
@@ -563,8 +565,33 @@ def _adv_file(tmp_path, n, *, total_ms=30.0, recovered=True, monotone=True,
         "honest_identical": honest, "all_monotone": monotone,
         "adversaries_detected": {"malform": malform,
                                  "wrong_root": wrong_root},
-    }))
+    }
+    if heal:
+        rec["heal"] = heal
+    path.write_text(json.dumps(rec))
     return str(path)
+
+
+def _heal_block(*, heal_total_ms=18.0, quorum_total_ms=120.0, healed=True,
+                served=True, root_identical=True, never_tampered=True,
+                quorum_healed=True):
+    return {
+        "single": {
+            "k": 8, "withhold_frac": 0.25, "detect_ms": 7.0,
+            "detect_samples": 6, "phases_ms": {"gather": 1.0},
+            "heal_total_ms": heal_total_ms, "restored_ms": 26.0,
+            "healed": healed, "served_after_heal": served,
+            "root_identical": root_identical,
+            "tampered_never_served": never_tampered,
+            "quarantine_outcome": "irrecoverable",
+        },
+        "quorum": {
+            "nodes": 3, "k": 8, "withhold_frac": 0.25, "hold_p": 0.75,
+            "union_coverage": 0.98, "detect_ms": [9.0, 5.0, 6.0],
+            "total_ms": quorum_total_ms, "healed": quorum_healed,
+            "served_after_heal": served, "root_identical": root_identical,
+        },
+    }
 
 
 class TestAdvSeries:
@@ -634,6 +661,83 @@ class TestAdvSeries:
         _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
         (tmp_path / "ADV_r01.json").write_text(json.dumps({"n": 1}))
         assert bt.main(["--dir", str(tmp_path)]) == 2
+
+
+class TestHealSeries:
+    """ISSUE-12: the heal block (schema adv-v2) rides the adversarial
+    gate — invariants (healed / served_after_heal / root_identical /
+    tampered_never_served, plus the quorum leg) hard-fail, the detect-
+    to-restored latencies gate lower-better under the same-platform
+    rule, and adv-v1 rounds without a heal block stay additive (never
+    gated, never STALE)."""
+
+    def test_checked_in_round_renders_heal_line(self, capsys):
+        bt = _load()
+        assert bt.main(["--check"]) == 0
+        out = capsys.readouterr().out
+        assert "heal: single detect" in out
+        assert "quorum 3 nodes" in out
+
+    def test_heal_invariants_hard_fail(self, tmp_path, capsys):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _adv_file(tmp_path, 1, heal=_heal_block(served=False))
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        out = capsys.readouterr().out
+        assert "heal.single.served_after_heal" in out
+        assert "heal.quorum.served_after_heal" in out
+
+    def test_tampered_served_hard_fails(self, tmp_path, capsys):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _adv_file(tmp_path, 1, heal=_heal_block(never_tampered=False))
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        assert "heal.single.tampered_never_served" in capsys.readouterr().out
+
+    def test_unhealed_quorum_node_hard_fails(self, tmp_path, capsys):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _adv_file(tmp_path, 1, heal=_heal_block(quorum_healed=False))
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        assert "heal.quorum.healed" in capsys.readouterr().out
+
+    def test_heal_latency_regression_is_flagged(self, tmp_path, capsys):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _adv_file(tmp_path, 1, heal=_heal_block(heal_total_ms=18.0))
+        _adv_file(tmp_path, 2, heal=_heal_block(heal_total_ms=60.0))
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        assert "heal.single.total_ms" in capsys.readouterr().out
+
+    def test_quorum_latency_regression_is_flagged(self, tmp_path, capsys):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _adv_file(tmp_path, 1, heal=_heal_block(quorum_total_ms=100.0))
+        _adv_file(tmp_path, 2, heal=_heal_block(quorum_total_ms=400.0))
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        assert "heal.quorum.total_ms" in capsys.readouterr().out
+
+    def test_pre_heal_rounds_are_additive_not_gated(self, tmp_path):
+        """An adv-v1 prior (no heal block) never gates the heal series,
+        and a newest round WITHOUT a heal block is not penalized (the
+        loop may simply not have been drilled that round)."""
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _adv_file(tmp_path, 1)  # adv-v1, no heal block
+        _adv_file(tmp_path, 2, heal=_heal_block())
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+        _adv_file(tmp_path, 3)  # newest drops the block: still fine
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+
+    def test_cross_platform_heal_prior_not_compared(self, tmp_path):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _adv_file(tmp_path, 1, platform="tpu",
+                  heal=_heal_block(heal_total_ms=2.0, quorum_total_ms=10.0))
+        _adv_file(tmp_path, 2, platform="cpu",
+                  heal=_heal_block(heal_total_ms=60.0,
+                                   quorum_total_ms=500.0))
+        assert bt.main(["--dir", str(tmp_path)]) == 0
 
 
 class TestRepairGatedSeries:

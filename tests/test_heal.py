@@ -1,0 +1,630 @@
+"""The self-healing availability loop (serve/heal.py): detection wiring,
+the heal state machine, quarantine, re-admit semantics, the retryable
+mid-heal statuses, and the bench_trend heal gate.
+
+Crypto-free: squares are deterministic synthetic blocks admitted straight
+into ForestCaches (the test_serve.py fixture shape).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu import chaos
+from celestia_app_tpu.chaos import degrade
+from celestia_app_tpu.constants import NAMESPACE_SIZE, SHARE_SIZE
+from celestia_app_tpu.da.eds import ExtendedDataSquare
+from celestia_app_tpu.serve import heal as heal_mod
+from celestia_app_tpu.serve.api import DasProvider
+from celestia_app_tpu.serve.cache import ForestCache
+from celestia_app_tpu.serve.heal import HealingEngine, HealingInProgress
+from celestia_app_tpu.serve.sampler import (
+    BadProofDetected,
+    ProofSampler,
+    ShareWithheld,
+)
+from celestia_app_tpu.trace.metrics import registry
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def det_square(k: int, seed: int = 1) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    ns = np.sort(rng.integers(0, 128, k * k).astype(np.uint8))
+    ods = rng.integers(0, 256, (k * k, SHARE_SIZE), dtype=np.uint8)
+    ods[:, :NAMESPACE_SIZE] = 0
+    ods[:, NAMESPACE_SIZE - 1] = ns
+    return ods.reshape(k, k, SHARE_SIZE)
+
+
+def make_eds(k: int = 4, seed: int = 1) -> ExtendedDataSquare:
+    return ExtendedDataSquare.compute(det_square(k, seed))
+
+
+@pytest.fixture(autouse=True)
+def _clean_engines():
+    heal_mod._reset_for_tests()
+    yield
+    heal_mod._reset_for_tests()
+    chaos.uninstall()
+    degrade.reset_for_tests()
+
+
+def _provider(k=4, heights=(1,), seeds=None):
+    cache = ForestCache(heights=max(len(heights), 2), spill=2)
+    roots = {}
+    for i, h in enumerate(heights):
+        eds = make_eds(k, seed=(seeds or {}).get(h, h))
+        roots[h] = eds.data_root()
+        cache.put(h, eds)
+    return DasProvider(cache=cache, sampler=ProofSampler()), roots
+
+
+def _counter_value(name: str, **labels) -> float:
+    metric = registry().get(name)
+    if metric is None:
+        return 0.0
+    return sum(
+        value for sample_labels, value in metric.samples()
+        if all(sample_labels.get(k) == v for k, v in labels.items())
+    )
+
+
+class TestHealingEngine:
+    def test_withhold_detect_heal_reserve(self):
+        """The tentpole loop: ShareWithheld triggers a heal; the
+        previously-withheld coordinate then serves a verifying proof
+        from the node's own root-verified store."""
+        provider, roots = _provider(k=4)
+        engine = HealingEngine(provider, name="t1")
+        healed_before = _counter_value("celestia_heal_total",
+                                       outcome="healed")
+        chaos.install("seed=31,withhold_frac=0.25")
+        adv = chaos.active_adversary()
+        hit = sorted(adv.withheld_set(1, 8))[0]
+        with pytest.raises(ShareWithheld):
+            provider.sampler.share_proof(provider.entry(1), *hit)
+        # The detection marked the height healing: mid-heal requests are
+        # retryable, never the terminal 410.
+        with pytest.raises(HealingInProgress):
+            provider.entry(1)
+        assert engine.process_pending() == [(1, "healed")]
+        ent = provider.entry(1)
+        assert ent.healed
+        proof = provider.sampler.share_proof(ent, *hit)
+        assert proof.verify(roots[1])
+        assert ent.data_root == roots[1]
+        assert ent.eds.data_root() == roots[1]
+        assert _counter_value(
+            "celestia_heal_total", outcome="healed"
+        ) == healed_before + 1
+        # Every phase landed on the histogram.
+        snap = registry().get("celestia_heal_seconds").snapshot()
+        for phase in ("detect", "gather", "repair", "verify", "readmit",
+                      "total"):
+            assert snap.count(phase=phase) >= 1, phase
+        engine.close()
+
+    def test_bad_proof_detection_triggers_heal(self):
+        """A tampering proposer (malform): the verification gate's
+        BadProofDetected enqueues the heal; post-heal the corrupted
+        coordinate serves honest bytes."""
+        provider, roots = _provider(k=4)
+        engine = HealingEngine(provider, name="t2")
+        chaos.install("seed=13,malform_shares=2")
+        adv = chaos.active_adversary()
+        bad = adv.malformed_coords(1, 8)[0]
+        with pytest.raises(BadProofDetected):
+            provider.sampler.share_proof(provider.entry(1), *bad)
+        assert engine.process_pending() == [(1, "healed")]
+        proof = provider.sampler.share_proof(provider.entry(1), *bad)
+        assert proof.verify(roots[1])
+        engine.close()
+
+    def test_wrong_root_heal_restores_committed_root(self):
+        provider, roots = _provider(k=4)
+        engine = HealingEngine(provider, name="t3")
+        chaos.install("seed=13,wrong_root=1")
+        assert provider.entry(1).data_root != roots[1]  # forged view
+        with pytest.raises(BadProofDetected):
+            provider.sampler.share_proof(provider.entry(1), 0, 0)
+        assert engine.process_pending() == [(1, "healed")]
+        ent = provider.entry(1)
+        assert ent.data_root == roots[1]
+        assert provider.sampler.share_proof(ent, 0, 0).verify(roots[1])
+        engine.close()
+
+    def test_gather_excludes_tampered_survivors(self):
+        """The gather's leaf-digest gate: corrupted shares are excluded
+        from the survivor set (present=False), withheld ones too."""
+        provider, roots = _provider(k=4)
+        chaos.install("seed=13,malform_shares=3,withhold_frac=0.1")
+        adv = chaos.active_adversary()
+        view = provider.serve_view(1)
+        honest = provider._honest_entry(1)
+        shares, present = heal_mod.default_survivors(1, view, honest)
+        for coord in adv.malformed_coords(1, 8):
+            assert not present[coord]
+        for coord in adv.withheld_set(1, 8):
+            assert not present[coord]
+        # Everything still present carries honest bytes.
+        honest_bytes = np.asarray(honest.eds._eds)
+        assert (shares[present] == honest_bytes[present]).all()
+
+    def test_irrecoverable_quarantine_is_terminal(self):
+        """Below the k-survivor threshold: outcome=irrecoverable, the
+        height is quarantined, further detections stay terminal (no heal
+        storm), and the state shows on /healthz + GET /heal."""
+        provider, roots = _provider(k=4)
+        engine = HealingEngine(provider, name="t4")
+        irrec_before = _counter_value("celestia_heal_total",
+                                      outcome="irrecoverable")
+        chaos.install("seed=7,withhold_frac=0.95")
+        with pytest.raises(ShareWithheld):
+            provider.sampler.share_proof(provider.entry(1), 0, 0)
+        assert engine.process_pending() == [(1, "irrecoverable")]
+        assert engine.is_quarantined(1)
+        assert _counter_value(
+            "celestia_heal_total", outcome="irrecoverable"
+        ) == irrec_before + 1
+        # Terminal again — and nothing re-enqueues.
+        with pytest.raises(ShareWithheld):
+            provider.sampler.share_proof(provider.entry(1), 0, 0)
+        assert engine.process_pending() == []
+        state = engine.state()
+        assert state["quarantined"]["1"]["outcome"] == "irrecoverable"
+        from celestia_app_tpu.trace.exposition import (
+            handle_observability_get,
+            health_payload,
+        )
+
+        assert health_payload()["heal"]["quarantined"]["1"]["outcome"] == \
+            "irrecoverable"
+        status, _, body = handle_observability_get("/heal")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["engines"]["t4"]["quarantined"]["1"]["outcome"] == \
+            "irrecoverable"
+        engine.close()
+
+    def test_failing_heal_retries_then_quarantines(self):
+        """Bounded retry/backoff: a heal whose repair keeps failing is
+        retried max_attempts times and then quarantined — never an
+        unbounded loop."""
+        provider, roots = _provider(k=4)
+        attempts = []
+
+        def broken_gather(height, view, honest):
+            attempts.append(height)
+            raise RuntimeError("gather source down")
+
+        engine = HealingEngine(
+            provider, name="t5", survivors=broken_gather,
+            max_attempts=3, backoff_s=0.0,
+        )
+        chaos.install("seed=31,withhold_frac=0.25")
+        hit = sorted(chaos.active_adversary().withheld_set(1, 8))[0]
+        with pytest.raises(ShareWithheld):
+            provider.sampler.share_proof(provider.entry(1), *hit)
+        assert engine.process_pending() == [(1, "quarantined")]
+        assert attempts == [1, 1, 1]
+        assert engine.is_quarantined(1)
+        engine.close()
+
+    def test_chaos_dispatch_fail_during_repair_walks_ladder(self):
+        """The acceptance drill: healing rides guarded_dispatch — a
+        chaos dispatch_fail=1.0 during the heal walks the ladder (the
+        process degrades) but the heal COMPLETES with the committed
+        root, never wedges."""
+        provider, roots = _provider(k=4)
+        engine = HealingEngine(provider, name="t6")
+        degrade.reset_for_tests()
+        chaos.install("seed=31,withhold_frac=0.25,dispatch_fail=1.0")
+        hit = sorted(chaos.active_adversary().withheld_set(1, 8))[0]
+        with pytest.raises(ShareWithheld):
+            provider.sampler.share_proof(provider.entry(1), *hit)
+        assert engine.process_pending() == [(1, "healed")]
+        from celestia_app_tpu.kernels.fused import pipeline_mode
+
+        # The fused family is fully failed: the ladder must have stepped.
+        assert pipeline_mode() in ("staged", "host")
+        ent = provider.entry(1)
+        assert ent.data_root == roots[1]
+        assert provider.sampler.share_proof(ent, *hit).verify(roots[1])
+        engine.close()
+
+    def test_root_mismatch_from_repair_routes_to_owner(self):
+        """da/repair's RootMismatch with height= lands on the engine
+        that owns the height; a height mid-heal never re-enqueues (the
+        healer's own rejection must not recurse)."""
+        provider, roots = _provider(k=4)
+        engine = HealingEngine(provider, name="t7")
+        heal_mod.note_detection("root_mismatch", 1)
+        assert engine.healing(1)
+        # A second signal for the same height is a no-op.
+        heal_mod.note_detection("root_mismatch", 1)
+        with engine._cv:
+            assert list(engine._queue) == [1]
+        # A height this cache does not hold is not ours.
+        heal_mod.note_detection("root_mismatch", 99)
+        assert not engine.healing(99)
+        engine.close()
+
+    def test_worker_thread_heals_asynchronously(self):
+        provider, roots = _provider(k=4)
+        engine = HealingEngine(provider, name="t8").start()
+        chaos.install("seed=31,withhold_frac=0.25")
+        hit = sorted(chaos.active_adversary().withheld_set(1, 8))[0]
+        with pytest.raises(ShareWithheld):
+            provider.sampler.share_proof(provider.entry(1), *hit)
+        deadline = time.perf_counter() + 120
+        while engine.healing(1) and time.perf_counter() < deadline:
+            time.sleep(0.01)
+        assert not engine.healing(1)
+        assert provider.sampler.share_proof(
+            provider.entry(1), *hit
+        ).verify(roots[1])
+        engine.close()
+        assert heal_mod.engines() == ()
+        assert provider.healer is None
+
+
+class TestTamperMemoInvalidation:
+    def test_readmit_invalidates_tamper_memo(self):
+        """ISSUE satellite regression: before this PR, DasProvider.entry
+        kept serving the adversary's memoized tampered copy after the
+        height was repaired and re-admitted — recovery was invisible
+        until a process restart."""
+        provider, roots = _provider(k=4)
+        chaos.install("seed=13,malform_shares=2")
+        tampered = provider.entry(1)
+        assert provider.entry(1) is tampered  # memoized attack view
+        honest_eds = provider._honest_entry(1).eds
+        recovered = ExtendedDataSquare.compute(
+            np.asarray(honest_eds._eds)[:4, :4]
+        )
+        entry = provider.cache.readmit(1, recovered, healed=True)
+        served = provider.entry(1)
+        assert served is entry
+        assert served is not tampered
+        assert served.data_root == roots[1]
+
+    def test_plain_put_readmission_also_invalidates(self):
+        """ANY re-admission (the rebuild-on-miss path uses put) must
+        drop the stale tampered memo: the memo's 'one attack, one
+        square' contract only holds while the height is the same state.
+        (A put that finds the height still resident changes nothing and
+        keeps the memo — that IS the same state.)"""
+        cache = ForestCache(heights=1, spill=0)
+        cache.put(1, make_eds(4, seed=1))
+        provider = DasProvider(cache=cache, sampler=ProofSampler())
+        chaos.install("seed=13,malform_shares=2")
+        adv = chaos.active_adversary()
+        provider.entry(1)
+        with adv._lock:
+            assert 1 in adv._tampered
+        cache.put(2, make_eds(4, seed=2))  # evicts 1 entirely (spill=0)
+        assert not cache.contains(1)
+        cache.put(1, make_eds(4, seed=1))  # the rebuild-style re-admission
+        with adv._lock:
+            assert 1 not in adv._tampered
+
+
+class TestForestCacheReadmit:
+    def test_readmit_replaces_resident_entry(self):
+        cache = ForestCache(heights=2, spill=2)
+        old = cache.put(1, make_eds(4, seed=1))
+        recovered = make_eds(4, seed=2)  # different bytes
+        entry = cache.readmit(1, recovered)
+        assert entry is not old
+        assert entry.healed
+        assert cache.get(1)[0] is entry
+
+    def test_readmit_same_root_reuses_entry_one_build(self, monkeypatch):
+        """A heal racing a rebuild that already admitted the same bytes
+        coalesces: the resident entry is kept (no second forest build)
+        and only marked healed."""
+        import celestia_app_tpu.kernels.fused as fused
+
+        cache = ForestCache(heights=2, spill=2)
+        eds = make_eds(4, seed=3)
+        entry = cache.put(1, eds)
+        builds = []
+        real = fused.jit_forest
+
+        def counting(k):
+            builds.append(k)
+            return real(k)
+
+        monkeypatch.setattr(fused, "jit_forest", counting)
+        same = ExtendedDataSquare.compute(det_square(4, seed=3))
+        out = cache.readmit(1, same)
+        assert out is entry
+        assert out.healed
+        assert builds == []  # reused — zero forest dispatches
+
+    def test_readmit_races_rebuild_single_flight(self, monkeypatch):
+        """Repair-driven re-admit racing a rebuild-on-miss must ride one
+        single-flight gate: the loser of the race coalesces (same root)
+        instead of paying a second forest build, and the served entry is
+        never a resurrected stale one."""
+        import celestia_app_tpu.kernels.fused as fused
+
+        eds = make_eds(4, seed=5)
+        root = eds.data_root()
+        cache = ForestCache(heights=2, spill=2)
+        rebuilt = ExtendedDataSquare.compute(det_square(4, seed=5))
+        provider = DasProvider(
+            cache=cache, sampler=ProofSampler(),
+            rebuild=lambda h: rebuilt if h == 1 else None,
+        )
+        recovered = ExtendedDataSquare.compute(det_square(4, seed=5))
+        builds = []
+        real = fused.jit_forest
+
+        def counting(k):
+            builds.append(k)
+            return real(k)
+
+        monkeypatch.setattr(fused, "jit_forest", counting)
+        results = {}
+
+        def miss_path():
+            results["miss"] = provider.entry(1)
+
+        def heal_path():
+            results["heal"] = cache.readmit(1, recovered)
+
+        threads = [threading.Thread(target=miss_path),
+                   threading.Thread(target=heal_path)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # Exactly one forest build between the two racers...
+        assert len(builds) == 1
+        # ...and both observers see one entry serving the committed root.
+        assert results["miss"].data_root == root
+        assert results["heal"].data_root == root
+        served, _tier = cache.get(1)
+        assert served.data_root == root
+        assert served.healed
+
+    def test_readmit_keeps_retention_pins(self):
+        """The PR 9 _retain_cb fence: a coalescing readmit must not
+        re-fire (or drop) the original entry's retention pin, and a
+        replacing readmit pins the RECOVERED square's handle."""
+        cache = ForestCache(heights=2, spill=2)
+        eds = make_eds(4, seed=6)
+        pins = []
+        eds._retain_cb = lambda: pins.append("orig")
+        entry = cache.put(1, eds)
+        assert pins == ["orig"]  # admission pinned the feeding slot
+        same = ExtendedDataSquare.compute(det_square(4, seed=6))
+        out = cache.readmit(1, same)
+        assert out is entry
+        assert pins == ["orig"]  # coalesce: no second fire, pin intact
+        different = make_eds(4, seed=7)
+        different._retain_cb = lambda: pins.append("recovered")
+        cache.readmit(1, different)
+        assert pins == ["orig", "recovered"]
+
+    def test_contains_does_not_tick_counters(self):
+        cache = ForestCache(heights=2, spill=2)
+        cache.put(1, make_eds(4, seed=1))
+        before_h = _counter_value("celestia_serve_cache_hits_total")
+        before_m = _counter_value("celestia_serve_cache_misses_total")
+        assert cache.contains(1)
+        assert not cache.contains(2)
+        assert _counter_value("celestia_serve_cache_hits_total") == before_h
+        assert _counter_value("celestia_serve_cache_misses_total") == before_m
+
+    def test_retention_disabled_readmit_returns_none(self, monkeypatch):
+        monkeypatch.setenv("CELESTIA_SERVE_HEIGHTS", "0")
+        cache = ForestCache()
+        assert cache.readmit(1, make_eds(4, seed=1)) is None
+
+
+class TestMidHealStatuses:
+    def _healing_provider(self):
+        provider, roots = _provider(k=4)
+        engine = HealingEngine(provider, name="midheal",
+                               retry_after_s=2.5)
+        assert engine.note("withheld", 1)  # mark mid-heal, don't process
+        return provider, engine
+
+    def test_http_503_with_retry_after_byte_identical(self):
+        """The GET /das/* twins answer 503 + Retry-After with one body
+        (the shared-handler identity contract), retryable, never 410."""
+        from celestia_app_tpu.trace.exposition import (
+            handle_observability_get,
+            register_das_provider,
+            unregister_das_provider,
+        )
+
+        provider, engine = self._healing_provider()
+        register_das_provider(provider)
+        try:
+            bodies = []
+            for plane in ("jsonrpc", "rest"):
+                resp = handle_observability_get(
+                    "/das/share_proof?height=1&row=0&col=0", plane=plane
+                )
+                assert resp[0] == 503
+                assert resp[3] == {"Retry-After": "3"}  # ceil(2.5)
+                bodies.append(resp[2])
+            assert bodies[0] == bodies[1]
+            payload = json.loads(bodies[0])
+            assert payload["healing"] is True
+            assert payload["retry_after_s"] == 2.5
+            # The shares twin rides the same clause.
+            resp = handle_observability_get(
+                f"/das/shares?height=1&namespace={'00' * NAMESPACE_SIZE}"
+            )
+            assert resp[0] == 503 and resp[3]["Retry-After"] == "3"
+        finally:
+            unregister_das_provider()
+            engine.close()
+
+    def test_send_response_carries_extra_headers(self):
+        from celestia_app_tpu.trace.exposition import (
+            send_observability_response,
+        )
+
+        class FakeHandler:
+            def __init__(self):
+                self.headers = []
+                self.status = None
+
+                class W:
+                    def __init__(self):
+                        self.data = b""
+
+                    def write(self, b):
+                        self.data += b
+
+                self.wfile = W()
+
+            def send_response(self, status):
+                self.status = status
+
+            def send_header(self, k, v):
+                self.headers.append((k, v))
+
+            def end_headers(self):
+                pass
+
+        h = FakeHandler()
+        send_observability_response(
+            h, (503, "application/json", b"{}", {"Retry-After": "1"})
+        )
+        assert h.status == 503
+        assert ("Retry-After", "1") in h.headers
+        # The 3-tuple shape every other route returns still works.
+        h2 = FakeHandler()
+        send_observability_response(h2, (200, "text/plain", b"ok"))
+        assert h2.status == 200 and h2.wfile.data == b"ok"
+
+    def test_heal_endpoint_and_healthz_absent_without_engine(self):
+        from celestia_app_tpu.trace.exposition import (
+            handle_observability_get,
+            health_payload,
+        )
+
+        assert "heal" not in health_payload()
+        status, _, body = handle_observability_get("/heal")
+        assert status == 200
+        assert json.loads(body) == {"engines": {}}
+
+
+class TestHealDrillSmoke:
+    """Tier-1 smoke of the chaos_soak healing drills (small-k,
+    crypto-free, chaos-seeded) — the CI face of the ISSUE-12 acceptance
+    criteria."""
+
+    @pytest.fixture()
+    def soak(self):
+        spec = importlib.util.spec_from_file_location(
+            "chaos_soak", os.path.join(REPO_ROOT, "scripts", "chaos_soak.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_healing_drill_smoke(self, soak):
+        result = soak.run_healing_drill(k=4)
+        assert result["ok"], result
+        assert result["served_after_heal"]
+        assert result["root_identical"]
+        assert result["tampered_never_served"]
+        assert result["quarantine"]["outcome"] == "irrecoverable"
+        assert result["quarantine"]["terminal_after"]
+        assert result["heal"]["phases_ms"].keys() == {
+            "gather", "repair", "verify", "readmit"
+        }
+
+    def test_quorum_heal_drill_smoke(self, soak):
+        result = soak.run_quorum_heal_drill(nodes=2, k=4)
+        assert result["ok"], result
+        assert result["healed_nodes"] == 2
+        assert result["served_after_heal"] and result["root_identical"]
+        assert result["heal_bundles"] == 2  # one bundle per node
+
+    def test_adv_round_record_carries_heal_block(self, soak, tmp_path):
+        hd = {
+            "k": 4, "withhold_frac": 0.25,
+            "detect": {"ms": 1.0, "samples": 3},
+            "heal": {"phases_ms": {"gather": 1.0}, "total_ms": 10.0,
+                     "outcome": "healed"},
+            "restored_ms": 12.0, "served_after_heal": True,
+            "root_identical": True, "tampered_never_served": True,
+            "quarantine": {"outcome": "irrecoverable"},
+        }
+        qd = {
+            "nodes": 2, "k": 4, "withhold_frac": 0.25, "hold_p": 0.75,
+            "union_coverage": 0.95,
+            "detections": [{"ms": 1.0}, {"ms": 2.0}],
+            "total_ms": 20.0, "healed_nodes": 2,
+            "served_after_heal": True, "root_identical": True,
+        }
+        wd = {
+            "k": 4, "trials": 1, "sample_counts": [2],
+            "detection": [], "repair": {"total_ms": 1.0},
+            "honest_identical": True, "all_monotone": True,
+        }
+        adv = {"malform": {"ok": True}, "wrong_root": {"ok": True}}
+        path = str(tmp_path / "ADV_r09.json")
+        soak.write_adv_round(path, wd, adv, 1.0, heal=hd, quorum=qd)
+        rec = json.loads(open(path).read())
+        assert rec["schema"] == "adv-v2"
+        assert rec["heal"]["single"]["healed"] is True
+        assert rec["heal"]["single"]["heal_total_ms"] == 10.0
+        assert rec["heal"]["quorum"]["nodes"] == 2
+        assert rec["heal"]["quorum"]["healed"] is True
+
+
+class TestLoadgenAdversarialMix:
+    def test_withhold_heal_round_trip(self, capsys):
+        spec = importlib.util.spec_from_file_location(
+            "das_loadgen",
+            os.path.join(REPO_ROOT, "scripts", "das_loadgen.py"),
+        )
+        loadgen = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(loadgen)
+        rc = loadgen.main([
+            "--heights", "2", "--k", "4", "--samples", "80",
+            "--threads", "2", "--withhold-frac", "0.2", "--heal",
+        ])
+        out = capsys.readouterr().out
+        summary = json.loads(out.splitlines()[-1])
+        assert rc == 0
+        assert summary["withheld_hits"] > 0
+        assert summary["samples"] == 80 - summary["withheld_hits"]
+        block = summary["heal"]
+        assert block["post_heal"]["samples"] == 80
+        assert block["post_heal_withheld_hits"] == 0
+        assert block["time_to_first_healed_proof_ms"] is not None
+        assert set(block["outcomes"].values()) == {"healed"}
+
+    def test_honest_run_shape_unchanged(self, capsys):
+        spec = importlib.util.spec_from_file_location(
+            "das_loadgen",
+            os.path.join(REPO_ROOT, "scripts", "das_loadgen.py"),
+        )
+        loadgen = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(loadgen)
+        rc = loadgen.main([
+            "--heights", "1", "--k", "4", "--samples", "20",
+            "--threads", "2",
+        ])
+        summary = json.loads(capsys.readouterr().out.splitlines()[-1])
+        assert rc == 0
+        assert "withheld_hits" not in summary and "heal" not in summary
+        assert summary["samples"] == 20

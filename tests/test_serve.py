@@ -369,6 +369,41 @@ class TestDasPlanes:
         finally:
             chaos.uninstall()
 
+    def test_healing_in_progress_is_retryable_on_every_plane(self, planes):
+        """ISSUE-12 satellite: a sample arriving mid-heal answers a
+        RETRYABLE status — 503 + Retry-After on the HTTP twins
+        (byte-identical bodies) and UNAVAILABLE on the gRPC Das service
+        — never the terminal 410/502 the detections answer."""
+        import grpc
+
+        from celestia_app_tpu.serve.heal import HealingEngine
+
+        node, gw, plane, client = planes
+        engine = HealingEngine(
+            node.das_provider(), name="planes", retry_after_s=2.0
+        )
+        try:
+            assert engine.note("withheld", 1)  # mark mid-heal, no worker
+            bodies = []
+            for url in (gw.url, plane.debug_url):
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    urllib.request.urlopen(
+                        url + "/das/share_proof?height=1&row=0&col=0",
+                        timeout=10,
+                    )
+                assert exc.value.code == 503
+                assert exc.value.headers.get("Retry-After") == "2"
+                bodies.append(exc.value.read())
+            assert bodies[0] == bodies[1]
+            payload = json.loads(bodies[0])
+            assert payload["healing"] is True
+            with pytest.raises(grpc.RpcError) as gexc:
+                client.share_proof_bytes(1, 0, 0)
+            assert gexc.value.code() == grpc.StatusCode.UNAVAILABLE
+            assert "healed" in gexc.value.details()
+        finally:
+            engine.close()
+
     def test_no_provider_is_503(self):
         from celestia_app_tpu.trace.exposition import (
             handle_observability_get,
