@@ -105,6 +105,12 @@ def proof_serve() -> None:
         inj.proof_serve()
 
 
+def proof_shard() -> None:
+    inj = injector()
+    if inj is not None:
+        inj.proof_shard()
+
+
 def active_adversary():
     """The active protocol adversary (chaos/adversary.Adversary), or
     None — honest paths and specs with every adversary key at 0 both

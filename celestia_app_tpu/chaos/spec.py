@@ -40,6 +40,11 @@ gossip_drop=0.1,wal_torn_tail=1,rpc_slow_ms=100"
     proof_fail=<p>        batched proof dispatch raises (host fallback
                           must answer bit-identically)
     proof_slow_ms=<ms>    [proof_slow=<p>] proof dispatch stalls
+    shard_fail=<p>        SHARDED forest gather raises (serve/shard):
+                          the gather degrades to the single-device
+                          batched path, then — compounded with
+                          proof_fail — to the host rung, every rung
+                          bit-identical
 
 Protocol ADVERSARIES (chaos/adversary.py — attack model, not fault
 model; deterministic per (seed, height) rather than per call ordinal):
@@ -89,6 +94,7 @@ SEAMS = (
     "rpc.handle",
     "mempool.insert",
     "proof.serve",
+    "proof.shard",
 )
 
 _KNOWN_KEYS = {
@@ -101,6 +107,7 @@ _KNOWN_KEYS = {
     "rpc_slow_ms", "rpc_slow", "rpc_fail",
     "mempool_drop", "mempool_slow_ms", "mempool_slow",
     "proof_fail", "proof_slow_ms", "proof_slow",
+    "shard_fail",
     "withhold_frac", "malform_shares", "wrong_root",
 }
 
@@ -275,3 +282,13 @@ class ChaosInjector:
         if self._fire("proof.serve", "proof_fail"):
             self._count("proof.serve", "proof_fail")
             raise ChaosInjected("proof.serve", "proof_fail")
+
+    def proof_shard(self) -> None:
+        """Fail one SHARDED forest gather (serve/shard): the gather must
+        degrade to the single-device batched path — and, when proof_fail
+        compounds the injection, on down to the host rung — with
+        bit-identical proof bytes at every rung (the read-side ladder's
+        top seam)."""
+        if self._fire("proof.shard", "shard_fail"):
+            self._count("proof.shard", "shard_fail")
+            raise ChaosInjected("proof.shard", "shard_fail")

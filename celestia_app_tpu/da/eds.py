@@ -431,6 +431,13 @@ def speculator() -> SpeculativeExtender:
     return _SPECULATOR
 
 
+def warmup_sizes(upto: int) -> list[int]:
+    """The upto=N expansion: every power of two 1..upto (pure, so the
+    contract is testable without paying the compiles)."""
+    sizes = [1 << i for i in range(upto.bit_length())]
+    return [k for k in sizes if k <= upto]
+
+
 def warmup(
     square_sizes: list[int] | None = None,
     upto: int | None = None,
@@ -462,8 +469,7 @@ def warmup(
     """
     if square_sizes is None:
         assert upto is not None, "pass square_sizes or upto"
-        square_sizes = [1 << i for i in range((upto).bit_length())]
-        square_sizes = [k for k in square_sizes if k <= upto]
+        square_sizes = warmup_sizes(upto)
     if constructions is None:
         constructions = (active_construction(),)
     import time

@@ -433,3 +433,38 @@ def jit_forest(k: int):
 
     note_jit_build("forest")
     return jax.jit(forest_fn(k))
+
+
+@lru_cache(maxsize=None)
+def jit_forest_sharded(k: int, mesh, axis: str):
+    """Forest builder whose OUTPUT layout is the serve plane's committed
+    row-wise shard partition (parallel/mesh.row_sharding).
+
+    The flat (N, 90) forests are padded to a shard multiple inside the
+    program and land already partitioned via committed `out_shardings`
+    — the resident forest is laid out exactly once, at admission, and
+    the gather program's matching `in_shardings`
+    (parallel/mesh.sharded_gather_fn) means it is never resharded
+    between retention and gather: the SNIPPETS pjit contract, applied
+    to the read side the way parallel/sharded_eds.py applies it to the
+    write side.
+    """
+    from celestia_app_tpu.parallel.mesh import padded_rows, row_sharding
+    from celestia_app_tpu.trace.journal import note_jit_build
+
+    shards = mesh.shape[axis]
+    base = forest_fn(k)
+    n = 2 * k
+    rows = n * (2 * n - 1)  # sum of n*w over widths n, n/2, ..., 1
+    pad = padded_rows(rows, shards) - rows
+
+    def run(eds: jnp.ndarray):
+        row_flat, col_flat = base(eds)
+        if pad:
+            row_flat = jnp.pad(row_flat, ((0, pad), (0, 0)))
+            col_flat = jnp.pad(col_flat, ((0, pad), (0, 0)))
+        return row_flat, col_flat
+
+    out_sh = row_sharding(mesh, axis)
+    note_jit_build("forest_sharded")
+    return jax.jit(run, out_shardings=(out_sh, out_sh))

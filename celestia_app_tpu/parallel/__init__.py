@@ -1,5 +1,12 @@
-"""Multi-chip parallelism: sharded EDS construction over a device mesh."""
+"""Multi-chip parallelism: sharded EDS construction over a device mesh,
+plus the shared mesh / committed-sharding helpers (parallel/mesh.py) the
+sharded serve plane builds on."""
 
+from celestia_app_tpu.parallel.mesh import (
+    device_mesh,
+    row_sharding,
+    sharded_gather_fn,
+)
 from celestia_app_tpu.parallel.sharded_eds import (
     default_mesh,
     make_sharded_dah_pipeline,
@@ -9,7 +16,10 @@ from celestia_app_tpu.parallel.sharded_eds import (
 
 __all__ = [
     "default_mesh",
+    "device_mesh",
     "make_sharded_dah_pipeline",
     "make_sharded_pipeline",
+    "row_sharding",
     "sharded_extend_and_dah",
+    "sharded_gather_fn",
 ]

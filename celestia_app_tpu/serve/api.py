@@ -62,18 +62,40 @@ def payload_namespace_label(payload) -> str:
     return capped_namespace_label(namespace_label(ns))
 
 
+def payload_shard_label(payload) -> str:
+    """Bounded `shard` label of one served payload: the serve shard
+    owning the sampled coordinate's leaf node (serve/shard.py's routing
+    math on the payload's own row/col/square_size), "0" whenever the
+    plane is unsharded or the payload carries no coordinate (namespace
+    queries, errors).  One env read on the single-device plane."""
+    from celestia_app_tpu.serve.shard import leaf_shard_of, serve_shards
+
+    shards = serve_shards()
+    if shards <= 1 or not isinstance(payload, dict):
+        return "0"
+    k, row, col = (
+        payload.get("square_size"), payload.get("row"), payload.get("col")
+    )
+    if not all(isinstance(v, int) for v in (k, row, col)):
+        return "0"
+    return str(leaf_shard_of(k, shards, row, col, payload.get("axis", "row")))
+
+
 def count_served(plane: str, kind: str, payload=None) -> None:
-    """One served DAS response: per-plane, per-kind, and — when the
-    payload names one — per-tenant (capped namespace label), so the read
-    path joins the per-namespace accounting the write path has had since
-    PR 4."""
+    """One served DAS response: per-plane, per-kind, per-tenant (capped
+    namespace label, the PR 4 accounting plane), and — when the serve
+    plane is sharded — per owning shard (bounded by the shard count)."""
     from celestia_app_tpu.trace.metrics import registry
 
     registry().counter(
         "celestia_proofs_served_total",
-        "DAS proofs served, by serving plane, query kind, and (capped) "
-        "namespace",
-    ).inc(plane=plane, kind=kind, namespace=payload_namespace_label(payload))
+        "DAS proofs served, by serving plane, query kind, (capped) "
+        "namespace, and owning serve shard",
+    ).inc(
+        plane=plane, kind=kind,
+        namespace=payload_namespace_label(payload),
+        shard=payload_shard_label(payload),
+    )
 
 
 class UnknownHeight(KeyError):
@@ -155,14 +177,9 @@ class DasProvider:
                 raise UnknownHeight(f"no square known at height {height}")
             entry = self.cache.put(height, eds)
         if entry is None:  # retention disabled: serve without admitting
-            from celestia_app_tpu.serve.cache import CachedForest
+            from celestia_app_tpu.serve.shard import build_entry
 
-            import jax.numpy as jnp
-
-            from celestia_app_tpu.kernels.fused import jit_forest
-
-            row_flat, col_flat = jit_forest(eds.k)(jnp.asarray(eds._eds))
-            entry = CachedForest(height, eds, row_flat, col_flat)
+            entry = build_entry(height, eds)
         return entry
 
     # --- payload builders ---------------------------------------------------

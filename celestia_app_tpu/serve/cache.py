@@ -217,12 +217,9 @@ class ForestCache:
                     self._device.move_to_end(height)
                     self._building.pop(height, None)
                     return existing
-            import jax.numpy as jnp
+            from celestia_app_tpu.serve.shard import build_entry
 
-            from celestia_app_tpu.kernels.fused import jit_forest
-
-            row_flat, col_flat = jit_forest(eds.k)(jnp.asarray(eds._eds))
-            entry = CachedForest(height, eds, row_flat, col_flat)
+            entry = build_entry(height, eds)
             entry.owner = self
             # Admission happens INSIDE the gate: a concurrent put that
             # passes the gate next must find the entry resident, or the
@@ -300,12 +297,9 @@ class ForestCache:
                     elif height in self._host:
                         self._host.move_to_end(height)
             else:
-                import jax.numpy as jnp
+                from celestia_app_tpu.serve.shard import build_entry
 
-                from celestia_app_tpu.kernels.fused import jit_forest
-
-                row_flat, col_flat = jit_forest(eds.k)(jnp.asarray(eds._eds))
-                entry = CachedForest(height, eds, row_flat, col_flat)
+                entry = build_entry(height, eds)
                 entry.owner = self
                 entry.healed = healed
                 spilled, dropped = self._admit(entry, cap, spill_cap)
@@ -400,12 +394,18 @@ class ForestCache:
     def stats(self) -> dict:
         """The /healthz "serve" block: residency, hit ratio, last
         eviction — a stuck-at-cold cache (all misses, nothing resident)
-        is one probe away."""
+        is one probe away.  When the plane is sharded
+        ($CELESTIA_SERVE_SHARDS > 1, serve/shard.py) the "mesh" key
+        reports the shard count, axis, and per-shard resident forest
+        bytes; None on the single-device plane."""
+        from celestia_app_tpu.serve.shard import mesh_stats
+
         with self._lock:
             hits = dict(self._hits)
             misses = self._misses
             total = hits["device"] + hits["host"] + misses
-            return {
+            entries = list(self._device.values())
+            out = {
                 "device_heights": sorted(self._device),
                 "host_heights": sorted(self._host),
                 "hits": hits,
@@ -416,6 +416,8 @@ class ForestCache:
                 ),
                 "last_eviction": self._last_eviction,
             }
+        out["mesh"] = mesh_stats(self, entries)
+        return out
 
     def reset_for_tests(self) -> None:
         with self._lock:

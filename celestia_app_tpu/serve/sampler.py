@@ -122,6 +122,16 @@ def _latency():
     )
 
 
+def _shard_label(p: "_Pending") -> str:
+    """Bounded `shard` label of one sample: the serve shard owning the
+    sampled coordinate's leaf node (serve/shard.py routing math) — "0"
+    on the single-device plane (one getattr, no layout math)."""
+    leaf_shard = getattr(p.entry, "leaf_shard", None)
+    if leaf_shard is None:
+        return "0"
+    return str(leaf_shard(p.row, p.col, p.axis))
+
+
 def _proof_namespace_label(proof) -> str:
     """Capped per-tenant label of one served proof — the PR 4 accounting
     plane's cardinality contract applied to the read path (parity shares
@@ -256,6 +266,9 @@ class ProofSampler:
         traced().write(
             "proof_serve", batch=len(batch), heights=len(by_entry),
             mode=serve_mode(),
+            shards=max(
+                (getattr(p.entry, "shards", 0) for p in batch), default=0
+            ),
         )
         for group in by_entry.values():
             entry = group[0].entry
@@ -276,6 +289,7 @@ class ProofSampler:
                     lat.observe(
                         time.perf_counter() - p.t_submit, phase="total",
                         namespace=_proof_namespace_label(p.proof),
+                        shard=_shard_label(p),
                     )
                     p.event.set()
 

@@ -254,7 +254,16 @@ def load_series(paths: list[str]) -> list[dict]:
 def load_das_round(path: str) -> dict:
     """One DAS_rNN.json: {n, proofs_per_s, proof_p99_ms, [platform, ...]}.
     Malformed files exit 2 like a bad bench round — a broken loadgen
-    record must not silently drop out of the trajectory."""
+    record must not silently drop out of the trajectory.
+
+    Swarm rounds (schema "das-v2", das_loadgen --clients) additionally
+    carry the shard-count SWEEP (the scaling curve: one row per
+    $CELESTIA_SERVE_SHARDS setting over an identical open-loop plan)
+    and per-tenant p99/SLO-burn columns; both are validated here so a
+    half-written swarm record exits 2 instead of gating on garbage.
+    Pre-swarm rounds carry neither — they stay valid as the closed-loop
+    workload (see find_das_regressions: workloads never gate each
+    other)."""
     try:
         with open(path, encoding="utf-8") as f:
             raw = json.load(f)
@@ -263,13 +272,57 @@ def load_das_round(path: str) -> dict:
     for key in ("n", "proofs_per_s", "proof_p99_ms"):
         if key not in raw or raw[key] is None:
             raise MalformedRound(f"{path}: missing required key {key!r}")
-    return {
+    rec = {
         "round": int(raw["n"]),
         "path": os.path.basename(path),
         "proofs_per_s": float(raw["proofs_per_s"]),
         "proof_p99_ms": float(raw["proof_p99_ms"]),
         "platform": raw.get("platform"),
+        "workload": raw.get("workload", "closed"),
+        # Which sweep leg produced the headline numbers (swarm rounds):
+        # top-level gating is only meaningful between rounds whose
+        # headline came from the same mesh width.
+        "headline_shards": raw.get("headline_shards"),
+        "sweep": {},
+        "tenants": {},
     }
+    for row in raw.get("sweep") or []:
+        for key in ("shards", "proofs_per_s", "proof_p99_ms"):
+            if not isinstance(row, dict) or row.get(key) is None:
+                raise MalformedRound(
+                    f"{path}: sweep row missing {key!r}: {row!r}"
+                )
+        rec["sweep"][int(row["shards"])] = {
+            "proofs_per_s": float(row["proofs_per_s"]),
+            "proof_p99_ms": float(row["proof_p99_ms"]),
+        }
+    for tenant, cols in (raw.get("tenants") or {}).items():
+        if not isinstance(cols, dict) or cols.get("slo_burn") is None:
+            raise MalformedRound(
+                f"{path}: tenant {tenant!r} missing 'slo_burn'"
+            )
+        # A tenant whose every request FAILED has no latency percentiles
+        # (samples==0, failed>0, burn maxed) — that is a valid, honest
+        # column; a served tenant without a p99 is malformed.
+        all_failed = (
+            cols.get("samples") == 0 and (cols.get("failed") or 0) > 0
+        )
+        if cols.get("p99_ms") is None and not all_failed:
+            raise MalformedRound(
+                f"{path}: tenant {tenant!r} missing 'p99_ms'"
+            )
+        if float(cols["slo_burn"]) < 0:
+            raise MalformedRound(
+                f"{path}: tenant {tenant!r} slo_burn negative"
+            )
+        rec["tenants"][str(tenant)] = {
+            "p99_ms": (
+                float(cols["p99_ms"]) if cols.get("p99_ms") is not None
+                else None
+            ),
+            "slo_burn": float(cols["slo_burn"]),
+        }
+    return rec
 
 
 def load_das_series(paths: list[str]) -> list[dict]:
@@ -278,37 +331,127 @@ def load_das_series(paths: list[str]) -> list[dict]:
     return sorted((load_das_round(p) for p in paths), key=lambda r: r["round"])
 
 
+def _gate_das_points(pts, platforms, key, better, threshold_pct,
+                     series: str) -> dict | None:
+    """One higher/lower-better gate over a das point list under the
+    same-platform rule; None when nothing regressed."""
+    if len(pts) < 2:
+        return None
+    priors = _comparable_priors(pts, platforms)
+    if not priors:
+        return None
+    last_round, last = pts[-1]
+    best_prior = max(priors) if better == "higher" else min(priors)
+    if best_prior <= 0:
+        return None
+    worse_pct = (
+        (best_prior - last) / best_prior * 100.0
+        if better == "higher"
+        else (last - best_prior) / best_prior * 100.0
+    )
+    if worse_pct > threshold_pct:
+        return {
+            "series": series, "unit": key,
+            "round": last_round, "value": last, "best_prior": best_prior,
+            "worse_pct": round(worse_pct, 2),
+            "allowed_pct": round(threshold_pct, 2),
+        }
+    return None
+
+
 def find_das_regressions(das_rounds: list[dict], threshold_pct: float) -> list[dict]:
     """proofs/sec gates like a rate (higher better), proof-p99 like a
     parts time (lower better); same-platform comparability rule as the
     bench series (a CPU loadgen number is not a regression against a
-    chip round's)."""
+    chip round's).
+
+    Two extra comparability rules for the swarm era:
+
+      * the top-level numbers gate only WITHIN one workload — a swarm
+        round's open-loop rate-capped proofs/sec is not a regression
+        against a closed-loop round's saturation number (see
+        das_plan_gaps: cross-workload absence is a plan gap, not STALE);
+      * each SWEEP shard count gates against prior rounds carrying the
+        SAME shard count — the scaling curve's rows are their own
+        series, and a shard count no prior round measured is a plan
+        gap, never a phantom regression.
+    """
     platforms = {r["round"]: r.get("platform") for r in das_rounds}
     out = []
-    for key, better in (("proofs_per_s", "higher"), ("proof_p99_ms", "lower")):
-        pts = [(r["round"], r[key]) for r in das_rounds]
-        if len(pts) < 2:
-            continue
-        priors = _comparable_priors(pts, platforms)
-        if not priors:
-            continue
-        last_round, last = pts[-1]
-        best_prior = max(priors) if better == "higher" else min(priors)
-        if best_prior <= 0:
-            continue
-        worse_pct = (
-            (best_prior - last) / best_prior * 100.0
-            if better == "higher"
-            else (last - best_prior) / best_prior * 100.0
+    if das_rounds:
+        # Top-level comparability key: workload AND the mesh width that
+        # produced the headline leg — a 1-shard headline is not a
+        # regression against an 8-shard headline any more than a swarm
+        # number is against a closed-loop one (the sweep rows below
+        # carry the per-shard-count trajectories either way).
+        newest_key = (
+            das_rounds[-1].get("workload", "closed"),
+            das_rounds[-1].get("headline_shards"),
         )
-        if worse_pct > threshold_pct:
-            out.append({
-                "series": f"das.{key}", "unit": key,
-                "round": last_round, "value": last, "best_prior": best_prior,
-                "worse_pct": round(worse_pct, 2),
-                "allowed_pct": round(threshold_pct, 2),
-            })
+        same = [
+            r for r in das_rounds
+            if (r.get("workload", "closed"),
+                r.get("headline_shards")) == newest_key
+        ]
+        for key, better in (
+            ("proofs_per_s", "higher"), ("proof_p99_ms", "lower")
+        ):
+            hit = _gate_das_points(
+                [(r["round"], r[key]) for r in same], platforms,
+                key, better, threshold_pct, f"das.{key}",
+            )
+            if hit:
+                out.append(hit)
+        for shards in sorted((das_rounds[-1].get("sweep") or {})):
+            comparable = [
+                r for r in das_rounds if shards in (r.get("sweep") or {})
+            ]
+            for key, better in (
+                ("proofs_per_s", "higher"), ("proof_p99_ms", "lower")
+            ):
+                hit = _gate_das_points(
+                    [(r["round"], r["sweep"][shards][key])
+                     for r in comparable],
+                    platforms, key, better, threshold_pct,
+                    f"das.sweep{shards}.{key}",
+                )
+                if hit:
+                    out.append(hit)
     return out
+
+
+def das_plan_gaps(das_rounds: list[dict]) -> list[str]:
+    """Classify what the newest das round does NOT share with its
+    priors — workload shapes and sweep shard counts absent from older
+    rounds are PLAN GAPS (the plan grew; nothing went stale), mirroring
+    the bench series' opt-in/hw-gated classification."""
+    if len(das_rounds) < 2:
+        return []
+    newest = das_rounds[-1]
+    priors = das_rounds[:-1]
+    gaps = []
+    workload = newest.get("workload", "closed")
+    if all(r.get("workload", "closed") != workload for r in priors):
+        gaps.append(
+            f"das workload {workload!r} first measured in "
+            f"r{newest['round']:02d} (plan gap, not STALE)"
+        )
+    elif all(
+        (r.get("workload", "closed"), r.get("headline_shards"))
+        != (workload, newest.get("headline_shards"))
+        for r in priors
+    ):
+        gaps.append(
+            f"das headline shards={newest.get('headline_shards')} first "
+            f"measured in r{newest['round']:02d} (plan gap, not STALE)"
+        )
+    for shards in sorted(newest.get("sweep") or {}):
+        if all(shards not in (r.get("sweep") or {}) for r in priors):
+            gaps.append(
+                f"das sweep shards={shards} first measured in "
+                f"r{newest['round']:02d} (plan gap, not STALE)"
+            )
+    return gaps
 
 
 # --- adversarial-drill rounds (scripts/chaos_soak.py --adv-out) --------------
@@ -719,7 +862,8 @@ def write_metrics_out(out_dir: str, rounds: list[dict],
             tracer.write("bench_trend", round=rnd, part=name, seconds=v)
     if das_rounds:
         das = reg.gauge("celestia_bench_trend_das",
-                        "per-round DAS loadgen series (proofs/sec, p99 ms)")
+                        "per-round DAS loadgen series (proofs/sec, p99 ms; "
+                        "swarm sweep rows per shard count)")
         for r in das_rounds:
             das.set(r["proofs_per_s"], series="proofs_per_s",
                     round=f"r{r['round']:02d}")
@@ -728,6 +872,13 @@ def write_metrics_out(out_dir: str, rounds: list[dict],
             tracer.write("bench_trend", round=r["round"],
                          proofs_per_s=r["proofs_per_s"],
                          proof_p99_ms=r["proof_p99_ms"])
+            for shards, row in sorted((r.get("sweep") or {}).items()):
+                das.set(row["proofs_per_s"], series="proofs_per_s",
+                        shards=str(shards), round=f"r{r['round']:02d}")
+                tracer.write("bench_trend", round=r["round"],
+                             shards=shards,
+                             proofs_per_s=row["proofs_per_s"],
+                             proof_p99_ms=row["proof_p99_ms"])
     for reg_row in regressions:
         tracer.write("bench_trend", regression=True, **reg_row)
     with open(os.path.join(out_dir, "bench_trend.prom"), "w") as f:
@@ -788,6 +939,7 @@ def main(argv: list[str] | None = None) -> int:
     )
     regressions += find_das_regressions(das_rounds, args.threshold)
     regressions += find_adv_regressions(adv_rounds, args.threshold)
+    das_gaps = das_plan_gaps(das_rounds)
     stale = stale_gated_series(rounds, gate_all=args.all_series)
     seats = seat_changes(rounds)
     overrides = seat_overrides(rounds)
@@ -805,6 +957,7 @@ def main(argv: list[str] | None = None) -> int:
             "opt_in": [s for s in stale if s.get("opt_in")],
             "seat_changes": seats,
             "seat_overrides": overrides,
+            "das_plan_gaps": das_gaps,
             "threshold_pct": args.threshold,
         }))
     else:
@@ -813,7 +966,21 @@ def main(argv: list[str] | None = None) -> int:
             print(f"  das r{r['round']:02d}: "
                   f"{r['proofs_per_s']:9.2f} proofs/s  "
                   f"p99 {r['proof_p99_ms']:8.3f} ms"
+                  + (f"  [{r.get('workload', 'closed')}]")
                   + (f"  [{r['platform']}]" if r.get("platform") else ""))
+            for shards, row in sorted((r.get("sweep") or {}).items()):
+                print(f"    shards={shards}: "
+                      f"{row['proofs_per_s']:9.2f} proofs/s  "
+                      f"p99 {row['proof_p99_ms']:8.3f} ms")
+            if r.get("tenants"):
+                worst = max(
+                    r["tenants"].items(), key=lambda kv: kv[1]["slo_burn"]
+                )
+                print(f"    tenants: {len(r['tenants'])}, worst burn "
+                      f"{worst[0]}={worst[1]['slo_burn']} "
+                      f"(p99 {worst[1]['p99_ms']} ms)")
+        for gap in das_gaps:
+            print(f"  NOTE: {gap}")
         for r in adv_rounds:
             rep = r["repair"]
             print(f"  adv r{r['round']:02d}: monotone={r['all_monotone']} "

@@ -40,6 +40,12 @@ LATENCY but never CORRECTNESS.  Four drills, one process:
                        withheld coordinate must serve a verifying proof,
                        mid-heal samples get the retryable 503-face, and
                        an irrecoverable height lands in quarantine.
+  2g. shard-fault drill — the SHARDED serve plane's rung ladder
+                       ($CELESTIA_SERVE_SHARDS, serve/shard.py): under
+                       `shard_fail=1.0` every sharded gather degrades to
+                       the single-device batched path, and compounded
+                       with `proof_fail=1.0` on down to the host rung —
+                       proof bytes bit-identical at every rung.
   2f. quorum heal    — N serve-nodes with partial local share sets under
                        one withholding proposer: each detects through its
                        own sampling plane, repairs from the quorum's
@@ -93,6 +99,15 @@ import tempfile
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The shard-fault drill (2g) exercises the SHARDED serve plane; on a
+# host-only image that needs forced virtual devices, exactly like
+# tests/conftest.py.  Harmless for every other drill (they ignore the
+# extra devices), and an operator-set XLA_FLAGS is left alone.
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
 
 import numpy as np  # noqa: E402
 
@@ -519,6 +534,104 @@ def run_sampling_drill(k: int = 8, samples: int = 64,
         "ok": identical and verified,
         "detection": _detection(t0_ns),
     }
+
+
+def run_shard_fault_drill(k: int = 8, samples: int = 48,
+                          shards: int = 8) -> dict:
+    """The SHARDED serve plane's rung-ladder drill (serve/shard.py).
+
+    Baseline: the same DAS plan answered by a sharded cache
+    ($CELESTIA_SERVE_SHARDS) with no chaos.  Leg 1: `shard_fail=1.0`
+    fails every sharded gather dispatch — the gather must degrade to the
+    single-device batched path (celestia_recoveries_total
+    {seam="proof.shard"}) with BIT-IDENTICAL proof bytes.  Leg 2:
+    `shard_fail=1.0,proof_fail=1.0` compounds a batched-path fault on
+    top — the sampler's host rung answers, still bit-identical.  The
+    read-side ladder's full walk: sharded -> single-device -> host.
+    """
+    import jax
+
+    from celestia_app_tpu import chaos
+    from celestia_app_tpu.da.eds import ExtendedDataSquare
+    from celestia_app_tpu.rpc.codec import to_jsonable
+    from celestia_app_tpu.serve.api import render
+    from celestia_app_tpu.serve.cache import ForestCache
+    from celestia_app_tpu.serve.sampler import ProofSampler
+    from celestia_app_tpu.trace.metrics import registry
+
+    shards = min(shards, len(jax.devices()))
+    _, ods = _deterministic_blocks(1, k, seed=717)[0]
+    saved = os.environ.get("CELESTIA_SERVE_SHARDS")
+    os.environ["CELESTIA_SERVE_SHARDS"] = str(shards)
+
+    def _recoveries(seam: str) -> float:
+        for labels, val in registry().counter(
+            "celestia_recoveries_total", ""
+        ).samples():
+            if labels.get("seam") == seam:
+                return val
+        return 0.0
+
+    try:
+        chaos.install("")  # baseline leg: no injection even with env chaos
+        eds = ExtendedDataSquare.compute(ods)
+        root = eds.data_root()
+        cache = ForestCache(heights=2, spill=2)
+        entry = cache.put(1, eds)
+        sharded = bool(getattr(entry, "shards", 0))
+        sampler = ProofSampler()
+        rng = np.random.default_rng(727)
+        n = 2 * k
+        coords = [
+            (int(rng.integers(0, n)), int(rng.integers(0, n)))
+            for _ in range(samples)
+        ]
+        baseline = [
+            render(to_jsonable(p))
+            for p in sampler.sample_batch(entry, coords)
+        ]
+        legs = {}
+        for name, spec_str, seam in (
+            ("single_device", "seed=11,shard_fail=1.0", "proof.shard"),
+            ("host", "seed=11,shard_fail=1.0,proof_fail=1.0",
+             "proof.serve"),
+        ):
+            before = _recoveries(seam)
+            chaos.install(spec_str)
+            try:
+                got = []
+                for i in range(0, samples, 8):
+                    got.extend(
+                        sampler.sample_batch(entry, coords[i:i + 8])
+                    )
+            finally:
+                chaos.install("")
+            legs[name] = {
+                "bit_identical": [
+                    render(to_jsonable(p)) for p in got
+                ] == baseline,
+                "all_verify": all(p.verify(root) for p in got),
+                "recoveries": _recoveries(seam) - before,
+            }
+        ok = sharded and all(
+            leg["bit_identical"] and leg["all_verify"]
+            and leg["recoveries"] > 0
+            for leg in legs.values()
+        )
+        return {
+            "samples": samples,
+            "k": k,
+            "shards": shards,
+            "sharded": sharded,
+            "legs": legs,
+            "ok": ok,
+        }
+    finally:
+        chaos.uninstall()
+        if saved is None:
+            os.environ.pop("CELESTIA_SERVE_SHARDS", None)
+        else:
+            os.environ["CELESTIA_SERVE_SHARDS"] = saved
 
 
 def run_speculation_drill(k: int = 4, blocks: int = 6,
@@ -1494,6 +1607,17 @@ def main(argv=None) -> int:
           f"injections={smp['injections']:.0f}", flush=True)
     if not smp["ok"]:
         failures.append(f"sampling drill failed: {smp}")
+
+    shd = run_shard_fault_drill(k=min(args.k, 8))
+    print(f"shard-fault drill: {shd['samples']} DAS samples @ k={shd['k']} "
+          f"shards={shd['shards']} -> "
+          + " ".join(
+              f"{name}: identical={leg['bit_identical']} "
+              f"recoveries={leg['recoveries']:.0f}"
+              for name, leg in shd["legs"].items()
+          ), flush=True)
+    if not shd["ok"]:
+        failures.append(f"shard-fault drill failed: {shd}")
 
     spc = run_speculation_drill(k=min(args.k, 8),
                                 blocks=min(args.blocks, 6))

@@ -20,10 +20,30 @@ GET /das/share_proof endpoint over HTTP.
       --heights 4 --k 16 --samples 2000 --threads 8 \
       --metrics-out /tmp/das --round-out DAS_r01.json
 
+SWARM mode (`--clients N`, N >= 1): instead of a few closed-loop
+threads, the run simulates a light-client SWARM — 10^4..10^6 clients,
+each bound to a tenant namespace by zipf popularity (`--zipf-a`),
+arriving OPEN-LOOP as a Poisson process at `--rate` samples/sec (an
+arrival is enqueued at its scheduled instant whether or not the plane
+has caught up, so latency includes queue delay — the honest saturation
+measurement a closed loop cannot make).  Heights skew hot
+(`--hot-frac` on the newest height) with a cache-busting historical
+tail (`--historical-frac` hits heights beyond retention, forcing the
+rebuild path), and coordinates mix tenant-targeted reads with uniform
+DAS sampling.  `--shard-sweep 1,8` re-runs the identical plan per
+$CELESTIA_SERVE_SHARDS setting (serve/shard.py) so the proofs/sec
+scaling curve lands in one DAS_rNN round, per shard count, next to
+per-tenant p50/p99/SLO-burn columns (`--slo-ms`, 99% objective):
+
+  JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/das_loadgen.py \
+      --clients 20000 --tenants 8 --rate 300 --samples 2400 --k 16 \
+      --shard-sweep 1,8 --round-out DAS_r02.json
+
 Prints a one-line JSON summary; --metrics-out writes das_loadgen.prom
 (the celestia_proof_* / celestia_serve_* families) + das_loadgen.jsonl;
 --round-out writes the DAS_rNN.json record scripts/bench_trend.py reads
-into its proofs/sec + proof-p99 trend series and regression gate.
+into its proofs/sec + proof-p99 trend series and regression gate (swarm
+rounds carry schema "das-v2": workload, sweep rows, tenant columns).
 """
 
 from __future__ import annotations
@@ -122,18 +142,22 @@ def _run_plan(sampler, cache, plan, threads, verify_every, roots):
     return sorted(v * 1e3 for v in latencies), failures, withheld, wall_s
 
 
-def _pass_stats(lat_ms, wall_s) -> dict:
-    def pct(p):
-        if not lat_ms:
-            return None
-        return round(lat_ms[min(len(lat_ms) - 1, int(p * len(lat_ms)))], 3)
+def _percentile(sorted_ms, p):
+    """Nearest-rank percentile over an ascending ms list — the ONE
+    formula every round record (closed-loop and swarm) feeds into
+    bench_trend, so the two workloads can never drift apart."""
+    if not sorted_ms:
+        return None
+    return round(sorted_ms[min(len(sorted_ms) - 1, int(p * len(sorted_ms)))], 3)
 
+
+def _pass_stats(lat_ms, wall_s) -> dict:
     return {
         "samples": len(lat_ms),
         "wall_s": round(wall_s, 3),
         "proofs_per_s": round(len(lat_ms) / wall_s, 2) if wall_s else None,
-        "proof_p50_ms": pct(0.50),
-        "proof_p99_ms": pct(0.99),
+        "proof_p50_ms": _percentile(lat_ms, 0.50),
+        "proof_p99_ms": _percentile(lat_ms, 0.99),
     }
 
 
@@ -242,6 +266,308 @@ def run_local(args) -> dict:
     return summary
 
 
+# --- the swarm harness (open-loop light-client fleet) ------------------------
+
+def tenant_square(k: int, seed: int, tenants: int):
+    """One synthetic namespace-ordered ODS with exactly `tenants`
+    namespaces; returns (ods, ranges) where ranges[t] = (start, end)
+    share-index range of tenant t (contiguous — the square is
+    namespace-sorted, like every real square)."""
+    from celestia_app_tpu.constants import NAMESPACE_SIZE, SHARE_SIZE
+
+    if not 1 <= tenants <= 255:
+        # Tenant ids map onto one namespace byte (value 1..255; 0 stays
+        # reserved) — more would silently wrap uint8 and alias tenants.
+        raise ValueError(f"tenants must be 1..255, got {tenants}")
+    rng = np.random.default_rng(seed)
+    n = k * k
+    vals = np.sort(rng.integers(0, tenants, n).astype(np.uint8))
+    ods = rng.integers(0, 256, (n, SHARE_SIZE), dtype=np.uint8)
+    ods[:, :NAMESPACE_SIZE] = 0
+    ods[:, NAMESPACE_SIZE - 1] = vals + 1  # 1..tenants; 0 stays reserved
+    ranges = {}
+    for t in range(tenants):
+        idx = np.nonzero(vals == t)[0]
+        if len(idx):
+            ranges[int(t)] = (int(idx[0]), int(idx[-1]) + 1)
+    return ods.reshape(k, k, SHARE_SIZE), ranges
+
+
+def build_swarm_plan(args, squares, client_tenant):
+    """The deterministic arrival schedule every sweep leg replays:
+    [(t_arrival_s, client, tenant, height, row, col, axis), ...].
+
+    Poisson arrivals at --rate; height mix: --hot-frac on the newest
+    retained height, --historical-frac on beyond-retention heights (the
+    cache-busting rebuild path), the rest uniform over the retained
+    tail; coordinates: 3/4 inside the client's tenant namespace range
+    (the tenant-targeted read), 1/4 uniform over the full EDS (the DAS
+    mix, parity quadrants included)."""
+    rng = np.random.default_rng(args.seed + 7)
+    k, n = args.k, 2 * args.k
+    hot_h = args.heights
+    plan = []
+    t = 0.0
+    for _ in range(args.samples):
+        t += float(rng.exponential(1.0 / args.rate))
+        client = int(rng.integers(0, args.clients))
+        tenant = int(client_tenant[client])
+        u = rng.random()
+        if u < args.hot_frac:
+            height = hot_h
+        elif u < args.hot_frac + args.historical_frac and args.historical:
+            height = hot_h + 1 + int(rng.integers(0, args.historical))
+        else:
+            height = 1 + int(rng.integers(0, hot_h))
+        ranges = squares[height][1]
+        if rng.random() < 0.75 and tenant in ranges:
+            start, end = ranges[tenant]
+            share = start + int(rng.integers(0, end - start))
+            row, col = share // k, share % k
+        else:
+            row, col = int(rng.integers(0, n)), int(rng.integers(0, n))
+        axis = "col" if rng.random() < 0.5 else "row"
+        plan.append((t, client, tenant, height, row, col, axis))
+    return plan
+
+
+def _tenant_stats(results, slo_ms: float) -> dict:
+    """Per-tenant p50/p99 + SLO burn (99% of samples under --slo-ms;
+    burn = violation fraction / the 1% error budget, so burn > 1 means
+    the tenant is eating budget faster than the objective allows).
+    A FAILED sample is a violation too — a tenant whose requests mostly
+    error must burn budget, not report a rosy number built from its few
+    fast successes (percentiles still cover served samples only; the
+    `failed` column carries the drop count)."""
+    served: dict[int, list[float]] = {}
+    failed: dict[int, int] = {}
+    for tenant, lat_s, err in results:
+        if err is None:
+            served.setdefault(tenant, []).append(lat_s * 1e3)
+        else:
+            failed[tenant] = failed.get(tenant, 0) + 1
+    out = {}
+    for tenant in sorted(set(served) | set(failed)):
+        lats = sorted(served.get(tenant, []))
+        drops = failed.get(tenant, 0)
+        total = len(lats) + drops
+        over = sum(1 for v in lats if v > slo_ms) + drops
+        out[f"t{tenant:02d}"] = {
+            "samples": len(lats),
+            "failed": drops,
+            "p50_ms": _percentile(lats, 0.50),
+            "p99_ms": _percentile(lats, 0.99),
+            "slo_burn": round((over / total) / 0.01, 3),
+        }
+    return out
+
+
+def _run_swarm_leg(args, shards: int, squares, plan, eds_by_height
+                   ) -> tuple[dict, list]:
+    """One shard-count leg: identical plan, fresh cache admitted under
+    $CELESTIA_SERVE_SHARDS=<shards>, open-loop replay.  Returns the leg
+    summary (whose "shards" is the count the plane ACTUALLY ran with —
+    serve_shards clamps to the device count) + raw results."""
+    import queue
+
+    from celestia_app_tpu.da.eds import ExtendedDataSquare
+    from celestia_app_tpu.serve.api import DasProvider
+    from celestia_app_tpu.serve.cache import ForestCache
+    from celestia_app_tpu.serve.sampler import ProofSampler
+
+    roots = {h: eds.data_root() for h, eds in eds_by_height.items()}
+
+    def leg_handle(h: int) -> ExtendedDataSquare:
+        """A fresh per-leg handle over the shared device buffer: legs
+        must not share the MUTABLE handle, because an earlier leg's
+        spill converts eds._eds to numpy IN PLACE and a later leg would
+        then serve that height's shares from host memory — biasing the
+        very scaling curve the sweep measures.  The device buffer
+        itself is read-only and shared; only the handle state (spill
+        tier, forest attachment, tree memo) is per leg."""
+        base = eds_by_height[h]
+        return ExtendedDataSquare(
+            base._eds, list(base.row_roots()), list(base.col_roots()),
+            base.data_root(), base.k,
+        )
+
+    saved = os.environ.get("CELESTIA_SERVE_SHARDS")
+    os.environ["CELESTIA_SERVE_SHARDS"] = str(shards)
+    try:
+        cache = ForestCache(heights=args.heights, spill=args.heights)
+        rebuild = lambda h: (  # noqa: E731 — the cache-busting path
+            ExtendedDataSquare.compute(squares[h][0])
+            if h in squares else None
+        )
+        provider = DasProvider(cache=cache, rebuild=rebuild)
+        sampler = provider.sampler
+        for h in range(1, args.heights + 1):
+            # One extension per height for the whole sweep; historical
+            # rebuilds still pay the full recompute — that cost is the
+            # point of the tail.
+            cache.put(h, leg_handle(h))
+        # Warm the gather programs (sharded or not) off the clock: the
+        # sharded program is compiled per pow-2 slot bucket, so warm
+        # every bucket a realistic micro-batch can land on.
+        entry, _ = cache.get(args.heights)
+        sampler.sample_batch(entry, [(0, 0), (1, 1)])
+        # The shard count the plane ACTUALLY admitted under (serve_shards
+        # clamps to the device count): sweep rows must record the mesh
+        # that ran, or bench_trend gates the wrong scaling-curve series.
+        shards = getattr(entry, "shards", 0) or 1
+        if shards > 1 and hasattr(entry, "_sharded_gather"):
+            for b in (1, 2, 4, 8, 16, 32, 64, 128):
+                entry.gather("row", list(range(min(b, entry.forest_rows))))
+
+        q: queue.Queue = queue.Queue()
+        results: list[tuple[int, float, str | None]] = []
+        lock = threading.Lock()
+        verify_every = max(1, args.samples // max(args.verify, 1))
+        t0 = time.perf_counter()
+
+        def producer():
+            for i, item in enumerate(plan):
+                delay = item[0] - (time.perf_counter() - t0)
+                if delay > 0:
+                    time.sleep(delay)
+                q.put((i, item))
+            for _ in range(args.threads):
+                q.put(None)
+
+        def worker():
+            while True:
+                got = q.get()
+                if got is None:
+                    return
+                i, (t_sched, _client, tenant, h, r, c, axis) = got
+                err = None
+                try:
+                    entry = provider.entry(h)
+                    proof = sampler.share_proof(entry, r, c, axis=axis)
+                    if i % verify_every == 0 and not proof.verify(roots[h]):
+                        err = "proof failed verify"
+                except Exception as e:  # noqa: BLE001 — a drop IS the measurement
+                    err = f"({h},{r},{c}): {type(e).__name__}: {e}"
+                lat = (time.perf_counter() - t0) - t_sched
+                with lock:
+                    results.append((tenant, lat, err))
+
+        threads = [threading.Thread(target=producer, daemon=True)] + [
+            threading.Thread(target=worker, daemon=True)
+            for _ in range(args.threads)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall_s = time.perf_counter() - t0
+        served = sorted(
+            lat * 1e3 for _, lat, err in results if err is None
+        )
+        failures = [err for _, _, err in results if err is not None]
+        leg = {
+            "shards": shards,
+            "samples": len(served),
+            "wall_s": round(wall_s, 3),
+            "offered_rate": args.rate,
+            "proofs_per_s": (
+                round(len(served) / wall_s, 2) if wall_s else None
+            ),
+            "proof_p50_ms": _percentile(served, 0.50),
+            "proof_p99_ms": _percentile(served, 0.99),
+            "failures": failures[:5],
+            "cache": cache.stats(),
+        }
+        return leg, results
+    finally:
+        if saved is None:
+            os.environ.pop("CELESTIA_SERVE_SHARDS", None)
+        else:
+            os.environ["CELESTIA_SERVE_SHARDS"] = saved
+
+
+def run_swarm(args) -> dict:
+    """The light-client swarm: one deterministic open-loop plan replayed
+    per --shard-sweep leg, so the shard-count scaling curve is measured
+    on an identical workload."""
+    from celestia_app_tpu.da.eds import ExtendedDataSquare
+
+    import jax
+
+    # Dedup the sweep on the counts the plane will ACTUALLY run with
+    # (serve_shards clamps to the device count): `--shard-sweep 1,8,16`
+    # on an 8-device host must not spend a whole open-loop leg on a
+    # second 8-shard run only for its rows to overwrite the first's.
+    have = len(jax.devices())
+    sweep = sorted({
+        min(int(s), have) if int(s) > 1 else 1
+        for s in str(args.shard_sweep).split(",") if s.strip()
+    }) or [1]
+    total_heights = args.heights + args.historical
+    squares = {
+        h: tenant_square(args.k, args.seed + h, args.tenants)
+        for h in range(1, total_heights + 1)
+    }
+    # One extension per height, shared by every leg (bit-identical
+    # squares; only the historical rebuild path recomputes, on purpose).
+    eds_by_height = {
+        h: ExtendedDataSquare.compute(squares[h][0])
+        for h in range(1, total_heights + 1)
+    }
+    crng = np.random.default_rng(args.seed)
+    # Zipf over exactly the tenant set (p(rank) ~ rank^-a, tenant 0 the
+    # most popular): clipping an unbounded zipf draw would pile the
+    # whole tail onto the LAST tenant and invert the skew.
+    ranks = np.arange(1, args.tenants + 1, dtype=np.float64)
+    popularity = ranks ** -args.zipf_a
+    popularity /= popularity.sum()
+    client_tenant = crng.choice(args.tenants, size=args.clients, p=popularity)
+    plan = build_swarm_plan(args, squares, client_tenant)
+
+    legs, tenant_blocks = [], {}
+    for shards in sweep:
+        leg, results = _run_swarm_leg(
+            args, shards, squares, plan, eds_by_height
+        )
+        legs.append(leg)
+        # Keyed by the ACTUAL shard count the leg ran with (clamping
+        # may fold a requested count onto a narrower mesh) — the
+        # primary lookup below uses the same key.
+        tenant_blocks[leg["shards"]] = _tenant_stats(results, args.slo_ms)
+
+    import jax
+
+    primary = legs[-1]  # the widest mesh is the round's headline leg
+    return {
+        "metric": "das_swarm",
+        "workload": "swarm",
+        "mode": os.environ.get("CELESTIA_SERVE_MODE", "") or "batched",
+        "clients": args.clients,
+        "tenants": args.tenants,
+        "zipf_a": args.zipf_a,
+        "arrival": "poisson",
+        "rate": args.rate,
+        "hot_frac": args.hot_frac,
+        "historical_frac": args.historical_frac,
+        "requested": args.samples,
+        "heights": args.heights,
+        "historical": args.historical,
+        "k": args.k,
+        "threads": args.threads,
+        "slo_ms": args.slo_ms,
+        "samples": primary["samples"],
+        "wall_s": primary["wall_s"],
+        "proofs_per_s": primary["proofs_per_s"],
+        "proof_p50_ms": primary["proof_p50_ms"],
+        "proof_p99_ms": primary["proof_p99_ms"],
+        "headline_shards": primary["shards"],
+        "sweep": legs,
+        "tenant_stats": tenant_blocks[primary["shards"]],
+        "failures": [f for leg in legs for f in leg["failures"]][:5],
+        "platform": jax.default_backend(),
+    }
+
+
 def run_url(args) -> dict:
     """Sample a live node's GET /das/share_proof over HTTP."""
     import urllib.request
@@ -270,21 +596,11 @@ def run_url(args) -> dict:
             failures.append(f"({r},{c}): {type(e).__name__}: {e}")
     wall_s = time.perf_counter() - t_start
     lat_ms.sort()
-
-    def pct(p):
-        if not lat_ms:
-            return None
-        return round(lat_ms[min(len(lat_ms) - 1, int(p * len(lat_ms)))], 3)
-
     return {
         "metric": "das_loadgen",
         "mode": "url",
         "url": args.url,
-        "samples": len(lat_ms),
-        "wall_s": round(wall_s, 3),
-        "proofs_per_s": round(len(lat_ms) / wall_s, 2) if wall_s else None,
-        "proof_p50_ms": pct(0.50),
-        "proof_p99_ms": pct(0.99),
+        **_pass_stats(lat_ms, wall_s),
         "failures": failures[:5],
         "platform": None,
     }
@@ -338,6 +654,33 @@ def main(argv=None) -> int:
                          "time-to-first-healed-proof")
     ap.add_argument("--axes", choices=("row", "col", "both"), default="both",
                     help="sampling axis mix (light clients draw both)")
+    ap.add_argument("--clients", type=int, default=0,
+                    help="SWARM mode: simulate this many light clients "
+                         "(10^4..10^6) with zipf tenant popularity and "
+                         "open-loop Poisson arrivals")
+    ap.add_argument("--tenants", type=int, default=8,
+                    help="swarm: number of tenant namespaces per square")
+    ap.add_argument("--zipf-a", type=float, default=1.2,
+                    help="swarm: zipf exponent of client->tenant "
+                         "popularity (bigger = more skew)")
+    ap.add_argument("--rate", type=float, default=300.0,
+                    help="swarm: offered load, samples/sec (open-loop "
+                         "Poisson arrivals; latency includes queue delay)")
+    ap.add_argument("--hot-frac", type=float, default=0.7,
+                    help="swarm: fraction of arrivals on the newest "
+                         "retained height")
+    ap.add_argument("--historical-frac", type=float, default=0.02,
+                    help="swarm: fraction hitting beyond-retention "
+                         "heights (cache-busting rebuild path)")
+    ap.add_argument("--historical", type=int, default=2,
+                    help="swarm: how many beyond-retention heights exist")
+    ap.add_argument("--slo-ms", type=float, default=250.0,
+                    help="swarm: per-tenant latency SLO target (99%% "
+                         "objective; burn = violations / the 1%% budget)")
+    ap.add_argument("--shard-sweep", default="1",
+                    help="swarm: comma list of $CELESTIA_SERVE_SHARDS "
+                         "settings to replay the identical plan under "
+                         "(e.g. 1,8 — the scaling-curve sweep)")
     ap.add_argument("--url", default=None,
                     help="sample a live node's /das/share_proof instead")
     ap.add_argument("--height", type=int, default=1,
@@ -347,11 +690,30 @@ def main(argv=None) -> int:
                     help="write the bench_trend round record here")
     args = ap.parse_args(argv)
 
+    if args.clients:
+        # The sweep needs that many host devices BEFORE jax first
+        # initializes (all celestia jax imports are lazy; only numpy is
+        # imported at module scope, so this is early enough).
+        need = max(
+            (int(s) for s in str(args.shard_sweep).split(",") if s.strip()),
+            default=1,
+        )
+        flags = os.environ.get("XLA_FLAGS", "")
+        if need > 1 and "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={need}"
+            ).strip()
+
     saved = os.environ.get("CELESTIA_SERVE_MODE")
     if args.mode:
         os.environ["CELESTIA_SERVE_MODE"] = args.mode
     try:
-        summary = run_url(args) if args.url else run_local(args)
+        if args.url:
+            summary = run_url(args)
+        elif args.clients:
+            summary = run_swarm(args)
+        else:
+            summary = run_local(args)
     finally:
         if args.mode:
             if saved is None:
@@ -376,6 +738,29 @@ def main(argv=None) -> int:
             "mode": summary["mode"],
             "platform": summary.get("platform"),
         }
+        if summary.get("workload") == "swarm":
+            # das-v2: the swarm round shape bench_trend learns — sweep
+            # rows are the scaling curve, tenant columns the SLO story.
+            record.update({
+                "schema": "das-v2",
+                "workload": "swarm",
+                "clients": summary["clients"],
+                "arrival": summary["arrival"],
+                "rate": summary["rate"],
+                "slo_ms": summary["slo_ms"],
+                "headline_shards": summary["headline_shards"],
+                "sweep": [
+                    {
+                        "shards": leg["shards"],
+                        "proofs_per_s": leg["proofs_per_s"],
+                        "proof_p50_ms": leg["proof_p50_ms"],
+                        "proof_p99_ms": leg["proof_p99_ms"],
+                        "samples": leg["samples"],
+                    }
+                    for leg in summary["sweep"]
+                ],
+                "tenants": summary["tenant_stats"],
+            })
         with open(args.round_out, "w") as f:
             json.dump(record, f, indent=1)
     if summary.get("failures"):

@@ -468,14 +468,35 @@ class TestMetricsOut:
         assert any(r.get("part") == "rs_dense" for r in rows)
 
 
-def _das_file(tmp_path, n, proofs_per_s, p99_ms, platform="cpu"):
+def _das_file(tmp_path, n, proofs_per_s, p99_ms, platform="cpu", **extra):
     path = tmp_path / f"DAS_r{n:02d}.json"
     path.write_text(json.dumps({
         "n": n, "proofs_per_s": proofs_per_s, "proof_p50_ms": p99_ms / 3,
         "proof_p99_ms": p99_ms, "samples": 100, "k": 8, "mode": "batched",
-        "platform": platform,
+        "platform": platform, **extra,
     }))
     return str(path)
+
+
+def _swarm_extra(sweeps: dict[int, float], burn: float = 0.1):
+    """The das-v2 swarm block: sweep rows per shard count + tenant
+    columns (scripts/das_loadgen.py swarm --round-out shape)."""
+    return {
+        "schema": "das-v2", "workload": "swarm", "clients": 1000,
+        "arrival": "poisson", "rate": 300.0, "slo_ms": 250.0,
+        "headline_shards": max(sweeps),
+        "sweep": [
+            {"shards": s, "proofs_per_s": v, "proof_p50_ms": 10.0,
+             "proof_p99_ms": 40.0, "samples": 100}
+            for s, v in sorted(sweeps.items())
+        ],
+        "tenants": {
+            "t00": {"samples": 60, "p50_ms": 9.0, "p99_ms": 38.0,
+                    "slo_burn": burn},
+            "t01": {"samples": 40, "p50_ms": 11.0, "p99_ms": 44.0,
+                    "slo_burn": burn},
+        },
+    }
 
 
 class TestDasSeries:
@@ -546,6 +567,133 @@ class TestDasSeries:
         prom = (out_dir / "bench_trend.prom").read_text()
         assert "celestia_bench_trend_das" in prom
         assert 'series="proofs_per_s"' in prom
+
+
+class TestSwarmRounds:
+    """The das-v2 swarm round shape (das_loadgen --clients): shard-count
+    sweep rows gate same-platform per shard count; a workload or shard
+    count no prior round measured is a PLAN GAP, never STALE or a
+    phantom regression; tenant columns are shape-validated at load."""
+
+    def test_swarm_round_parses_with_sweep_and_tenants(self, tmp_path, capsys):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _das_file(tmp_path, 1, proofs_per_s=300.0, p99_ms=60.0,
+                  **_swarm_extra({1: 300.0, 8: 900.0}))
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "shards=1" in out and "shards=8" in out
+        assert "worst burn" in out
+
+    def test_sweep_regression_same_shard_count_is_flagged(self, tmp_path, capsys):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _das_file(tmp_path, 1, proofs_per_s=300.0, p99_ms=60.0,
+                  **_swarm_extra({1: 300.0, 8: 900.0}))
+        _das_file(tmp_path, 2, proofs_per_s=300.0, p99_ms=60.0,
+                  **_swarm_extra({1: 300.0, 8: 450.0}))  # shards=8 -50%
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        assert "das.sweep8.proofs_per_s" in capsys.readouterr().out
+
+    def test_new_shard_count_is_plan_gap_not_regression(self, tmp_path, capsys):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _das_file(tmp_path, 1, proofs_per_s=300.0, p99_ms=60.0,
+                  **_swarm_extra({1: 300.0}))
+        _das_file(tmp_path, 2, proofs_per_s=300.0, p99_ms=60.0,
+                  **_swarm_extra({1: 300.0, 8: 10.0}))  # 8 is NEW
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+        assert "sweep shards=8 first measured in r02" in (
+            capsys.readouterr().out
+        )
+
+    def test_swarm_does_not_gate_against_closed_loop(self, tmp_path, capsys):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        # A rate-capped open-loop swarm number far below the closed-loop
+        # saturation number is a WORKLOAD change, not a regression.
+        _das_file(tmp_path, 1, proofs_per_s=900.0, p99_ms=20.0)
+        _das_file(tmp_path, 2, proofs_per_s=200.0, p99_ms=300.0,
+                  **_swarm_extra({1: 200.0, 8: 600.0}))
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+        assert "workload 'swarm' first measured in r02" in (
+            capsys.readouterr().out
+        )
+
+    def test_sweep_cross_platform_prior_not_compared(self, tmp_path):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _das_file(tmp_path, 1, proofs_per_s=9000.0, p99_ms=1.0,
+                  platform="tpu", **_swarm_extra({8: 90_000.0}))
+        _das_file(tmp_path, 2, proofs_per_s=300.0, p99_ms=60.0,
+                  platform="cpu", **_swarm_extra({8: 900.0}))
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+
+    def test_malformed_sweep_row_exits_2(self, tmp_path):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        extra = _swarm_extra({1: 300.0})
+        del extra["sweep"][0]["proofs_per_s"]
+        _das_file(tmp_path, 1, proofs_per_s=300.0, p99_ms=60.0, **extra)
+        assert bt.main(["--dir", str(tmp_path)]) == 2
+
+    def test_malformed_tenant_column_exits_2(self, tmp_path):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        extra = _swarm_extra({1: 300.0})
+        del extra["tenants"]["t00"]["slo_burn"]
+        _das_file(tmp_path, 1, proofs_per_s=300.0, p99_ms=60.0, **extra)
+        assert bt.main(["--dir", str(tmp_path)]) == 2
+
+    def test_all_failed_tenant_column_is_valid(self, tmp_path):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        extra = _swarm_extra({1: 300.0})
+        # A tenant whose every request failed: no percentiles, maxed
+        # burn — honest, not malformed.
+        extra["tenants"]["t00"] = {
+            "samples": 0, "failed": 40, "p50_ms": None, "p99_ms": None,
+            "slo_burn": 100.0,
+        }
+        _das_file(tmp_path, 1, proofs_per_s=300.0, p99_ms=60.0, **extra)
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+
+    def test_sweep_rows_land_in_metrics_out(self, tmp_path):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _das_file(tmp_path, 1, proofs_per_s=300.0, p99_ms=60.0,
+                  **_swarm_extra({1: 300.0, 8: 900.0}))
+        out_dir = tmp_path / "metrics"
+        assert bt.main([
+            "--dir", str(tmp_path), "--metrics-out", str(out_dir), "--json",
+        ]) == 0
+        prom = (out_dir / "bench_trend.prom").read_text()
+        assert 'shards="8"' in prom
+
+    def test_different_headline_shards_do_not_gate(self, tmp_path, capsys):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        # r01 headlines the 8-shard leg, r02 only swept shards=1: the
+        # much-lower 1-shard headline is a MESH-WIDTH change, not a
+        # regression (the shards=1 sweep row is flat and still gated).
+        _das_file(tmp_path, 1, proofs_per_s=900.0, p99_ms=20.0,
+                  **_swarm_extra({1: 300.0, 8: 900.0}))
+        _das_file(tmp_path, 2, proofs_per_s=300.0, p99_ms=60.0,
+                  **_swarm_extra({1: 300.0}))
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+        assert "headline shards=1 first measured in r02" in (
+            capsys.readouterr().out
+        )
+
+    def test_plan_gaps_in_json_output(self, tmp_path, capsys):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _das_file(tmp_path, 1, proofs_per_s=900.0, p99_ms=20.0)
+        _das_file(tmp_path, 2, proofs_per_s=200.0, p99_ms=300.0,
+                  **_swarm_extra({1: 200.0}))
+        assert bt.main(["--dir", str(tmp_path), "--json"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert any("workload 'swarm'" in g for g in out["das_plan_gaps"])
 
 def _adv_file(tmp_path, n, *, total_ms=30.0, recovered=True, monotone=True,
               honest=True, malform=True, wrong_root=True, platform="cpu",

@@ -1,0 +1,484 @@
+"""The sharded proof-serving plane (serve/shard.py) on the 8 forced
+host devices (tests/conftest.py):
+
+  * the sharded gather path is GOLDEN-pinned byte-identical to the
+    single-device batched path AND the host fallback — proof payload
+    digests, both RS constructions, data + parity coordinates;
+  * a resident forest NEVER reshards between admission and gather: the
+    committed shardings (the SNIPPETS pjit contract) are asserted
+    before and after gathers, down to the per-shard device buffers;
+  * the chaos key shard_fail degrades the sampler to the single-device
+    then host rung, bit-identically, ticking the existing recoveries
+    counters (drilled end-to-end via chaos_soak.run_shard_fault_drill);
+  * spill/readmit keep serving identical bytes; /healthz's serve block
+    reports the mesh shape + per-shard resident bytes; the bounded
+    `shard` labels ride the existing serving metrics;
+  * the swarm harness (das_loadgen --clients) replays one open-loop
+    plan per shard-count leg and reports per-tenant SLO burn.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.constants import NAMESPACE_SIZE, SHARE_SIZE
+from celestia_app_tpu.da.eds import ExtendedDataSquare
+from celestia_app_tpu.rpc.codec import to_jsonable
+from celestia_app_tpu.serve.api import render
+from celestia_app_tpu.serve.cache import ForestCache
+from celestia_app_tpu.serve.sampler import ProofSampler
+from celestia_app_tpu.serve.shard import (
+    ShardedCachedForest,
+    build_entry,
+    serve_shards,
+)
+
+CONSTRUCTIONS = ("vandermonde", "leopard")
+
+
+def det_square(k: int, seed: int = 1) -> np.ndarray:
+    """The deterministic namespace-ordered ODS every serve test shares
+    (same bytes as tests/test_das_proofs.det_square, so the golden pins
+    below are the SAME digests that file pins for the host path)."""
+    rng = np.random.default_rng(seed)
+    ns = np.sort(rng.integers(0, 128, k * k).astype(np.uint8))
+    ods = rng.integers(0, 256, (k * k, SHARE_SIZE), dtype=np.uint8)
+    ods[:, :NAMESPACE_SIZE] = 0
+    ods[:, NAMESPACE_SIZE - 1] = ns
+    return ods.reshape(k, k, SHARE_SIZE)
+
+
+_SQUARES: dict = {}
+
+
+@pytest.fixture(scope="module")
+def squares():
+    def get(k: int, construction: str):
+        key = (k, construction)
+        if key not in _SQUARES:
+            _SQUARES[key] = ExtendedDataSquare.compute(
+                det_square(k), construction
+            )
+        return _SQUARES[key]
+
+    return get
+
+
+@pytest.fixture
+def sharded_env(monkeypatch):
+    monkeypatch.setenv("CELESTIA_SERVE_SHARDS", "8")
+
+
+def _sharded_entry(cache_key, eds) -> ShardedCachedForest:
+    cache = ForestCache(heights=8, spill=8)
+    entry = cache.put(cache_key, eds)
+    assert isinstance(entry, ShardedCachedForest)
+    return entry
+
+
+class TestShardedGatherIdentity:
+    """Acceptance pin: sharded == single-device batched == host, byte
+    for byte, both constructions, all four quadrants."""
+
+    # The canonical k=8 vandermonde sample digest, copied from
+    # tests/test_das_proofs.TestGoldenPins (same deterministic square):
+    # the sharded path must land on the identical payload bytes.
+    SAMPLE_3_11_VANDERMONDE = (
+        "43147e47f167ac87c90e408127e212d601e856397dc673d2e265824194fcbd04"
+    )
+
+    @pytest.mark.parametrize("construction", CONSTRUCTIONS)
+    def test_sharded_equals_single_and_host(
+        self, squares, sharded_env, construction
+    ):
+        k = 8
+        eds = squares(k, construction)
+        entry = _sharded_entry((k, construction), eds)
+        # Single-device twin of the same square.
+        os.environ["CELESTIA_SERVE_SHARDS"] = "0"
+        single = ForestCache(heights=8, spill=8).put(0, eds)
+        assert type(single).__name__ == "CachedForest"
+        os.environ["CELESTIA_SERVE_SHARDS"] = "8"
+
+        sampler = ProofSampler()
+        n = 2 * k
+        # Every quadrant, corners included (data AND parity coordinates).
+        coords = sorted({
+            (0, 0), (k - 1, k - 1), (0, n - 1), (k - 1, k),
+            (n - 1, 0), (k, k - 1), (n - 1, n - 1), (k, k), (3, 11),
+        })
+        root = eds.data_root()
+        for axis in ("row", "col"):
+            got = sampler.sample_batch(entry, coords, axis=axis)
+            ref = sampler.sample_batch(single, coords, axis=axis)
+            for (r, c), a, b in zip(coords, got, ref):
+                assert a == b, (construction, axis, r, c)
+                assert render(to_jsonable(a)) == render(to_jsonable(b))
+                host = sampler.host_proof(entry, r, c, axis)
+                assert render(to_jsonable(a)) == render(to_jsonable(host))
+                assert a.verify(root)
+
+    def test_golden_digest_through_sharded_path(self, squares, sharded_env):
+        eds = squares(8, "vandermonde")
+        entry = _sharded_entry((8, "vandermonde"), eds)
+        proof = ProofSampler().sample_batch(entry, [(3, 11)])[0]
+        assert (
+            hashlib.sha256(render(to_jsonable(proof))).hexdigest()
+            == self.SAMPLE_3_11_VANDERMONDE
+        )
+
+    def test_spilled_sharded_entry_serves_identical_bytes(self, sharded_env):
+        eds = ExtendedDataSquare.compute(det_square(4, seed=9))
+        cache = ForestCache(heights=1, spill=2)
+        entry = cache.put(1, eds)
+        sampler = ProofSampler()
+        coords = [(0, 0), (5, 7), (7, 2)]
+        device_bytes = [
+            render(to_jsonable(p))
+            for p in sampler.sample_batch(entry, coords)
+        ]
+        cache.put(2, ExtendedDataSquare.compute(det_square(4, seed=10)))
+        spilled, tier = cache.get(1)
+        assert tier == "host" and spilled is entry
+        assert not entry.device_resident
+        assert [
+            render(to_jsonable(p))
+            for p in sampler.sample_batch(entry, coords)
+        ] == device_bytes
+
+
+class TestCommittedShardings:
+    """The SNIPPETS pjit contract: the forest is laid out ONCE at
+    admission (the build program's out_shardings) and the gather's
+    in_shardings name the same layout — no reshard, ever."""
+
+    def test_forest_never_reshards_between_admission_and_gather(
+        self, sharded_env
+    ):
+        from celestia_app_tpu.parallel.mesh import row_sharding
+
+        eds = ExtendedDataSquare.compute(det_square(4, seed=11))
+        entry = _sharded_entry(1, eds)
+        committed = row_sharding(entry.mesh, entry.axis)
+        assert entry.committed_sharding == committed
+        for flat in (entry.row_flat, entry.col_flat):
+            assert flat.sharding == committed  # laid out by the build
+            assert len(flat.addressable_shards) == 8
+        # Pin the physical buffers: a reshard (or any hidden copy)
+        # would re-materialize them at new addresses.
+        row_before = entry.row_flat
+        ptrs = [
+            s.data.unsafe_buffer_pointer()
+            for s in entry.row_flat.addressable_shards
+        ]
+        sampler = ProofSampler()
+        n = 2 * entry.k
+        rng = np.random.default_rng(3)
+        for axis in ("row", "col"):
+            coords = [
+                (int(rng.integers(0, n)), int(rng.integers(0, n)))
+                for _ in range(6)
+            ]
+            sampler.sample_batch(entry, coords, axis=axis)
+        assert entry.row_flat is row_before
+        assert entry.row_flat.sharding == committed
+        assert [
+            s.data.unsafe_buffer_pointer()
+            for s in entry.row_flat.addressable_shards
+        ] == ptrs
+
+    def test_forest_build_lands_sharded(self, sharded_env):
+        """The admission build program itself carries the committed
+        out_shardings — there is no second device_put."""
+        eds = ExtendedDataSquare.compute(det_square(2, seed=12))
+        entry = build_entry(7, eds)
+        assert isinstance(entry, ShardedCachedForest)
+        assert entry.row_flat.sharding == entry.committed_sharding
+        # Padded to a shard multiple of the true node count.
+        n = 2 * entry.k
+        assert entry.forest_rows == n * (2 * n - 1)
+        assert entry.row_flat.shape[0] % entry.shards == 0
+        assert entry.row_flat.shape[0] >= entry.forest_rows
+
+    def test_routing_is_pure_layout_math(self, sharded_env):
+        from celestia_app_tpu.parallel.mesh import route_to_shards
+
+        eds = ExtendedDataSquare.compute(det_square(2, seed=13))
+        entry = build_entry(8, eds)
+        idx = [0, 1, entry.rows_per_shard, entry.forest_rows - 1]
+        local, (shard, slot), counts = route_to_shards(
+            idx, entry.shards, entry.rows_per_shard
+        )
+        assert int(sum(counts)) == len(idx)
+        for i, flat in enumerate(idx):
+            s = int(shard[i])
+            assert s == flat // entry.rows_per_shard
+            assert local[s, slot[i]] == flat - s * entry.rows_per_shard
+
+
+class TestShardFailLadder:
+    """shard_fail degrades sharded -> single-device -> host, every rung
+    bit-identical, on the EXISTING recoveries counters."""
+
+    def _recoveries(self, seam: str) -> float:
+        from celestia_app_tpu.trace.metrics import registry
+
+        return sum(
+            val
+            for labels, val in registry().counter(
+                "celestia_recoveries_total", ""
+            ).samples()
+            if labels.get("seam") == seam
+        )
+
+    def test_shard_fail_walks_the_rungs(self, squares, sharded_env):
+        from celestia_app_tpu import chaos
+
+        eds = squares(8, "vandermonde")
+        entry = _sharded_entry((8, "vandermonde"), eds)
+        sampler = ProofSampler()
+        coords = [(0, 0), (3, 11), (15, 15), (8, 0)]
+        baseline = [
+            render(to_jsonable(p))
+            for p in sampler.sample_batch(entry, coords)
+        ]
+        try:
+            before = self._recoveries("proof.shard")
+            chaos.install("seed=5,shard_fail=1.0")
+            single = [
+                render(to_jsonable(p))
+                for p in sampler.sample_batch(entry, coords)
+            ]
+            assert single == baseline
+            assert self._recoveries("proof.shard") > before
+
+            before_host = self._recoveries("proof.serve")
+            chaos.install("seed=5,shard_fail=1.0,proof_fail=1.0")
+            host = [
+                render(to_jsonable(p))
+                for p in sampler.sample_batch(entry, coords)
+            ]
+            assert host == baseline
+            assert self._recoveries("proof.serve") > before_host
+        finally:
+            chaos.uninstall()
+
+    def test_shard_fault_drill_smoke(self, sharded_env):
+        """The chaos_soak drill end-to-end (tier-1 smoke, small k)."""
+        import scripts.chaos_soak as chaos_soak
+
+        out = chaos_soak.run_shard_fault_drill(k=4, samples=16)
+        assert out["sharded"] and out["shards"] == 8
+        assert out["ok"], out
+
+    def test_shard_fail_is_a_known_chaos_key(self):
+        from celestia_app_tpu.chaos.spec import parse_spec
+
+        assert parse_spec("shard_fail=0.5") == {"shard_fail": 0.5}
+        with pytest.raises(ValueError):
+            parse_spec("shard_fial=0.5")
+
+
+class TestServeShardsKnob:
+    def test_default_is_single_device(self, monkeypatch):
+        monkeypatch.delenv("CELESTIA_SERVE_SHARDS", raising=False)
+        assert serve_shards() == 0
+
+    def test_clamped_to_device_count(self, monkeypatch):
+        monkeypatch.setenv("CELESTIA_SERVE_SHARDS", "64")
+        with pytest.warns(UserWarning, match="only 8 devices"):
+            assert serve_shards() == 8
+
+    def test_one_means_unsharded(self, monkeypatch):
+        monkeypatch.setenv("CELESTIA_SERVE_SHARDS", "1")
+        assert serve_shards() == 0
+
+    def test_malformed_means_unsharded(self, monkeypatch):
+        monkeypatch.setenv("CELESTIA_SERVE_SHARDS", "banana")
+        assert serve_shards() == 0
+
+
+class TestMeshObservability:
+    def test_stats_mesh_block_and_resident_bytes(self, sharded_env):
+        eds = ExtendedDataSquare.compute(det_square(2, seed=21))
+        cache = ForestCache(heights=2, spill=2)
+        cache.put(1, eds)
+        mesh = cache.stats()["mesh"]
+        assert mesh["shards"] == 8 and mesh["axis"] == "serve"
+        assert len(mesh["per_shard_resident_bytes"]) == 8
+        per = set(mesh["per_shard_resident_bytes"].values())
+        assert len(per) == 1 and per.pop() > 0
+        from celestia_app_tpu.trace.metrics import registry
+
+        gauge = registry().get("celestia_serve_shard_resident_bytes")
+        assert gauge is not None
+        assert 'shard="7"' in "\n".join(gauge.render())
+
+    def test_unsharded_stats_mesh_is_none(self, monkeypatch):
+        monkeypatch.delenv("CELESTIA_SERVE_SHARDS", raising=False)
+        eds = ExtendedDataSquare.compute(det_square(2, seed=22))
+        cache = ForestCache(heights=2, spill=2)
+        cache.put(1, eds)
+        assert cache.stats()["mesh"] is None
+
+    def test_resident_bytes_gauge_zeroes_when_shards_leave(
+        self, monkeypatch
+    ):
+        """A published shard label must drop to 0 when its forest bytes
+        leave the device tier — never linger at the last value — while
+        ANOTHER cache's stats() refresh must not zero a live cache's
+        contribution (the gauge aggregates across caches)."""
+        from celestia_app_tpu.serve import shard as shard_mod
+        from celestia_app_tpu.trace.metrics import registry
+
+        shard_mod._CACHE_SHARD_BYTES.clear()
+        monkeypatch.setenv("CELESTIA_SERVE_SHARDS", "8")
+        eds = ExtendedDataSquare.compute(det_square(2, seed=24))
+        sharded_cache = ForestCache(heights=1, spill=1)
+        sharded_cache.put(1, eds)
+        sharded_cache.stats()  # publishes nonzero per-shard bytes
+
+        def shard0_value():
+            gauge = registry().get("celestia_serve_shard_resident_bytes")
+            for line in gauge.render():
+                if 'shard="0"' in line:
+                    return float(line.rsplit(" ", 1)[1])
+            return None
+
+        resident = shard0_value()
+        assert resident > 0
+        # A DIFFERENT (unsharded) cache refreshing its stats must not
+        # zero the sharded cache's live contribution.
+        monkeypatch.setenv("CELESTIA_SERVE_SHARDS", "0")
+        other = ForestCache(heights=1, spill=1)
+        other.put(2, ExtendedDataSquare.compute(det_square(2, seed=25)))
+        assert other.stats()["mesh"] is None
+        assert shard0_value() == resident
+        # Spilling the sharded cache's only entry off the device tier
+        # (a second put evicts height 1 to host) must drop it to 0.
+        monkeypatch.setenv("CELESTIA_SERVE_SHARDS", "8")
+        sharded_cache.put(
+            3, ExtendedDataSquare.compute(det_square(2, seed=26))
+        )
+        _, tier = sharded_cache.get(1)
+        assert tier == "host"
+        sharded_cache.stats()
+        assert shard0_value() == resident  # height 3 resident now
+        monkeypatch.setenv("CELESTIA_SERVE_SHARDS", "0")
+        sharded_cache.put(
+            4, ExtendedDataSquare.compute(det_square(2, seed=27))
+        )  # unsharded entry evicts height 3 -> no sharded device entries
+        mesh = sharded_cache.stats()["mesh"]
+        assert mesh is None
+        assert shard0_value() == 0.0
+
+    def test_shard_gather_counter_ticks(self, squares, sharded_env):
+        from celestia_app_tpu.trace.metrics import registry
+
+        eds = squares(8, "vandermonde")
+        entry = _sharded_entry((8, "vandermonde"), eds)
+        ProofSampler().sample_batch(entry, [(0, 0), (15, 15)])
+        ctr = registry().get("celestia_serve_shard_gathers_total")
+        assert ctr is not None
+        assert sum(v for _, v in ctr.samples()) > 0
+
+    def test_payload_shard_label_bounded(self, sharded_env):
+        from celestia_app_tpu.serve.api import payload_shard_label
+
+        label = payload_shard_label(
+            {"square_size": 8, "row": 3, "col": 11, "axis": "row"}
+        )
+        assert label.isdigit() and 0 <= int(label) < 8
+        # Unsharded plane / coordinate-free payloads fold to "0".
+        os.environ["CELESTIA_SERVE_SHARDS"] = "0"
+        assert payload_shard_label(
+            {"square_size": 8, "row": 3, "col": 11}
+        ) == "0"
+        os.environ["CELESTIA_SERVE_SHARDS"] = "8"
+        assert payload_shard_label({"namespace": "00"}) == "0"
+
+    def test_leaf_shard_matches_payload_label(self, sharded_env):
+        from celestia_app_tpu.serve.api import payload_shard_label
+
+        eds = ExtendedDataSquare.compute(det_square(4, seed=23))
+        entry = build_entry(9, eds)
+        for row, col, axis in ((0, 0, "row"), (5, 7, "col"), (7, 1, "row")):
+            assert str(entry.leaf_shard(row, col, axis)) == (
+                payload_shard_label({
+                    "square_size": 4, "row": row, "col": col, "axis": axis,
+                })
+            )
+
+
+class TestSwarmHarness:
+    def test_swarm_replays_one_plan_per_shard_leg(self, tmp_path):
+        import json
+
+        from scripts import das_loadgen
+
+        rc = das_loadgen.main([
+            "--clients", "500", "--tenants", "4", "--rate", "800",
+            "--samples", "60", "--k", "2", "--heights", "2",
+            "--historical", "1", "--threads", "4", "--seed", "6",
+            "--shard-sweep", "1,8",
+            "--round-out", str(tmp_path / "DAS_r99.json"),
+        ])
+        assert rc == 0
+        rec = json.loads((tmp_path / "DAS_r99.json").read_text())
+        assert rec["schema"] == "das-v2" and rec["workload"] == "swarm"
+        assert [row["shards"] for row in rec["sweep"]] == [1, 8]
+        for row in rec["sweep"]:
+            assert row["samples"] == 60
+            assert row["proofs_per_s"] > 0
+        assert rec["tenants"], "per-tenant columns must be present"
+        for cols in rec["tenants"].values():
+            assert cols["slo_burn"] >= 0
+            assert cols["p99_ms"] > 0
+
+    def test_tenant_square_ranges_are_contiguous(self):
+        from scripts.das_loadgen import tenant_square
+
+        ods, ranges = tenant_square(4, seed=3, tenants=4)
+        assert ods.shape == (4, 4, SHARE_SIZE)
+        spans = sorted(ranges.values())
+        for (s0, e0), (s1, e1) in zip(spans, spans[1:]):
+            assert e0 <= s1  # namespace-sorted, non-overlapping
+        assert all(e > s for s, e in spans)
+
+    def test_failed_samples_burn_tenant_slo(self):
+        from scripts.das_loadgen import _tenant_stats
+
+        # Tenant 0: 9 fast successes + 1 failure -> 10% violations
+        # against a 1% budget = burn 10; the failure must count.
+        results = [(0, 0.001, None)] * 9 + [(0, 0.001, "boom")]
+        stats = _tenant_stats(results, slo_ms=250.0)
+        assert stats["t00"]["samples"] == 9
+        assert stats["t00"]["failed"] == 1
+        assert stats["t00"]["slo_burn"] == 10.0
+        # All-failed tenant: no percentiles, burn maxed, still reported.
+        stats = _tenant_stats([(1, 0.0, "x"), (1, 0.0, "x")], slo_ms=250.0)
+        assert stats["t01"]["samples"] == 0
+        assert stats["t01"]["p99_ms"] is None
+        assert stats["t01"]["slo_burn"] == 100.0
+
+    def test_tenant_square_rejects_more_than_one_byte_of_tenants(self):
+        from scripts.das_loadgen import tenant_square
+
+        with pytest.raises(ValueError, match="1..255"):
+            tenant_square(4, seed=1, tenants=256)
+        with pytest.raises(ValueError, match="1..255"):
+            tenant_square(4, seed=1, tenants=0)
+
+    def test_zipf_popularity_skews_to_tenant_zero(self):
+        rng = np.random.default_rng(1)
+        ranks = np.arange(1, 9, dtype=np.float64)
+        p = ranks ** -1.2
+        p /= p.sum()
+        draws = rng.choice(8, size=4000, p=p)
+        counts = np.bincount(draws, minlength=8)
+        assert counts[0] == counts.max()
+        assert counts[0] > 2 * counts[7]

@@ -22,7 +22,9 @@ from celestia_app_tpu.da.eds import _jit_pipeline, jit_pipeline, warmup
 
 class TestWarmupBudget:
     def test_warmup_compiles_all_sizes_and_dispatch_is_cheap(self):
-        sizes = [1, 2, 4]
+        # k in {2, 4} only: the fast tier dispatches both anyway, and
+        # k=1 was a compile nothing else in tier-1 uses (budget).
+        sizes = [2, 4]
         compile_s: dict[int, float] = {}
         for k in sizes:
             t0 = time.perf_counter()
