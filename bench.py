@@ -21,10 +21,15 @@ Robustness (round-1 failure was an unusable accelerator tunnel):
 
 Env knobs:
   BENCH_K            run only this square size (default: 128, 256, 512;
-                     giant sizes 1024/2048 are accepted here — the
+                     giant sizes 1024/2048/4096 are accepted here — the
                      default k-list is unchanged — and scale their own
-                     iteration counts / host-RAM prebuild down)
-  BENCH_MODE         run only this mode: extend | compute | repair | stream
+                     iteration counts / host-RAM prebuild down; a comma
+                     list runs a multi-k sweep in one record)
+  BENCH_MODE         run only this mode: extend | compute | repair |
+                     stream | compute_sharded (the multi-chip extend
+                     sweep: one row per BENCH_SHARDS count over an
+                     identical sharded-panel plan, kernels/panel_sharded)
+  BENCH_SHARDS       compute_sharded sweep shard counts (default "1,8")
   BENCH_ITERS        timed iterations (default 5; 2 at k>=256)
   BENCH_BASELINE_S   skip the host-baseline run, use the given seconds/block
   BENCH_TOTAL_BUDGET wall-clock budget in seconds (default 1500)
@@ -172,6 +177,65 @@ def _compute_seconds(ods: np.ndarray, iters: int) -> float:
         np.asarray(pipe(xs[i])[3])
         times.append(time.perf_counter() - t0)
     return _median(times)
+
+
+def _sharded_shard_counts() -> list[int]:
+    """$BENCH_SHARDS: the compute_sharded sweep's shard counts (default
+    "1,8" — the forced-host 1-vs-N machinery curve; real-chip rounds pick
+    the mesh widths the hardware has)."""
+    raw = os.environ.get("BENCH_SHARDS", "1,8")
+    counts = []
+    for tok in raw.replace(",", " ").split():
+        try:
+            n = int(tok)
+        except ValueError:
+            # Loud, not silent (the CELESTIA_EXTEND_SHARDS convention):
+            # a typo'd sweep collapsing to the 1-shard control would
+            # read downstream as an opt-in plan gap, hiding the loss.
+            print(f"bench: ignoring malformed BENCH_SHARDS entry {tok!r}",
+                  file=sys.stderr)
+            continue
+        if n >= 1:
+            counts.append(n)
+    return counts or [1]
+
+
+def _compute_sharded_seconds(ods: np.ndarray, iters: int, shards: int
+                             ) -> tuple[float, int]:
+    """One compute_sharded sweep leg: seconds/block through the sharded
+    panel pipeline at `shards` devices (shards=1 = the single-device
+    panel runner, the control every wider leg is judged against).
+
+    The PLAN is identical per shard count — same panel height, same
+    DISTINCT per-iteration inputs, same host-driven compute() entry (the
+    PR 13 das-v2 sweep pattern applied to the write side) — so the curve
+    measures the mesh, not a workload difference.  Returns the ACTUAL
+    shard count the seam engaged with (clamped like the serve plane's),
+    so rows are keyed by what ran, not what was asked."""
+    from celestia_app_tpu.da.eds import ExtendedDataSquare
+    from celestia_app_tpu.kernels.fused import pipeline_mode_for_k
+    from celestia_app_tpu.kernels.panel_sharded import shards_for_k
+
+    k = ods.shape[0]
+    os.environ["CELESTIA_EXTEND_SHARDS"] = (
+        str(shards) if shards > 1 else "0"
+    )
+    actual = shards_for_k(k) or 1
+    expect = "sharded_panel" if actual > 1 else "panel"
+    mode = pipeline_mode_for_k(k)
+    if mode != expect:
+        raise RuntimeError(
+            f"compute_sharded leg resolved mode {mode!r}, want {expect!r} "
+            f"(shards={shards}, actual={actual})"
+        )
+    variants = [_variant(ods, i) for i in range(iters)]
+    ExtendedDataSquare.compute(ods).data_root()  # warmup / compile
+    times = []
+    for i in range(iters):
+        t0 = time.perf_counter()
+        ExtendedDataSquare.compute(variants[i]).data_root()
+        times.append(time.perf_counter() - t0)
+    return _median(times), actual
 
 
 def _host_seconds_per_block(ods: np.ndarray) -> float:
@@ -670,11 +734,14 @@ def _stage_plan() -> list[dict]:
     only_k = os.environ.get("BENCH_K")
     only_mode = os.environ.get("BENCH_MODE")
     if only_k or only_mode:
-        k = int(only_k or "128")
+        # BENCH_K accepts a comma-separated list so one round can carry a
+        # multi-k sweep (the compute_sharded 1-vs-N recipe runs k=256 and
+        # k=512 in one record); a single value stays a single stage.
+        ks = [int(tok) for tok in (only_k or "128").replace(",", " ").split()]
         mode = only_mode or "extend"
-        plan = [{"mode": mode, "k": k}]
+        plan = [{"mode": mode, "k": k} for k in ks]
         if mode != "host" and not os.environ.get("BENCH_BASELINE_S"):
-            plan.append({"mode": "host", "k": min(k, 128)})
+            plan.append({"mode": "host", "k": min(min(ks), 128)})
         return plan
     # Device rows run FIRST and the CPU-heavy host baseline LAST: round 2's
     # driver bench showed device timings collapse ~25x under concurrent
@@ -831,6 +898,61 @@ def _run_child() -> None:
                         "stage": "tuned-applied",
                         "applied": _applied_from_env(),
                     })
+                gc.collect()
+                continue
+            if mode == "compute_sharded":
+                # The multi-chip extend sweep: one row per ACTUAL shard
+                # count over an identical plan (kernels/panel_sharded).
+                # The panel seam must be on for the sharded rung to
+                # engage; an operator-set height wins, otherwise the
+                # recipe's 64-row default applies for the stage.
+                saved_env = {
+                    key: os.environ.get(key)
+                    for key in ("CELESTIA_PIPE_PANEL",
+                                "CELESTIA_EXTEND_SHARDS")
+                }
+                if not os.environ.get("CELESTIA_PIPE_PANEL"):
+                    os.environ["CELESTIA_PIPE_PANEL"] = "64"
+                measured: set[int] = set()
+                try:
+                    from celestia_app_tpu.kernels.panel_sharded import (
+                        shards_for_k,
+                    )
+
+                    for want in _sharded_shard_counts():
+                        # Dedupe on the POST-CLAMP actual count BEFORE
+                        # burning the leg (the das-v2 sweep lesson): a
+                        # clamped duplicate must cost a note, not a run.
+                        os.environ["CELESTIA_EXTEND_SHARDS"] = (
+                            str(want) if want > 1 else "0"
+                        )
+                        probe = shards_for_k(k) or 1
+                        if probe in measured:
+                            emit({"stage": f"compute_sharded{probe}@{k}",
+                                  "skipped": "duplicate post-clamp shard "
+                                             f"count (asked {want})"})
+                            continue
+                        t_leg = time.monotonic()
+                        secs, actual = _compute_sharded_seconds(
+                            ods, max(iters, 1), want
+                        )
+                        measured.add(actual)
+                        emit({
+                            "stage": f"compute_sharded{actual}@{k}",
+                            "mode": f"compute_sharded{actual}", "k": k,
+                            "shards": actual,
+                            "seconds_per_block": secs, "mb": ods_mb,
+                            "mb_per_s": round(ods_mb / secs, 3),
+                            "wall_s": round(time.monotonic() - t_leg, 1),
+                            "loadavg": round(la, 2),
+                            "platform": platform,
+                        })
+                finally:
+                    for key, val in saved_env.items():
+                        if val is None:
+                            os.environ.pop(key, None)
+                        else:
+                            os.environ[key] = val
                 gc.collect()
                 continue
             if mode == "host":

@@ -111,6 +111,12 @@ def proof_shard() -> None:
         inj.proof_shard()
 
 
+def extend_shard() -> None:
+    inj = injector()
+    if inj is not None:
+        inj.extend_shard()
+
+
 def active_adversary():
     """The active protocol adversary (chaos/adversary.Adversary), or
     None — honest paths and specs with every adversary key at 0 both

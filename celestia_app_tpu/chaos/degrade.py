@@ -1,15 +1,22 @@
 """Graceful degradation: the device-path circuit breaker and mode ladder.
 
 The device pipeline sits on the consensus hot path, so a dispatch failure
-must degrade LATENCY, never correctness.  All five lowerings of the
+must degrade LATENCY, never correctness.  All six lowerings of the
 extend+DAH pipeline are bit-identical (pinned on the golden vectors), so
 stepping down the ladder
 
-    panel  ->  fused_epi  ->  fused  ->  staged  ->  host
+    sharded_panel  ->  panel  ->  fused_epi  ->  fused  ->  staged  ->  host
 
 changes how a block's roots are computed, never what they are — a
 degraded validator keeps signing the same DAH roots as its healthy peers.
 
+  * sharded_panel: the multi-chip panel partition for giant squares
+    (kernels/panel_sharded.py, $CELESTIA_EXTEND_SHARDS on top of the
+    panel seam) — collective programs over a device mesh, so it has the
+    most infrastructure under it (ICI links, every chip in the mesh) and
+    is the very first rung distrusted; a faulting collective (the chaos
+    seam device.extend_shard, or any real mesh fault) falls to the
+    single-device panel runner below, roots unchanged;
   * panel:  the panel-streamed lowering for giant squares
     (kernels/panel.py, $CELESTIA_PIPE_PANEL, selected PER square size
     via kernels/fused.pipeline_mode_for_k) — a host-driven loop of small
@@ -56,7 +63,7 @@ from __future__ import annotations
 import threading
 import time
 
-LADDER = ("panel", "fused_epi", "fused", "staged", "host")
+LADDER = ("sharded_panel", "panel", "fused_epi", "fused", "staged", "host")
 
 #: Consecutive same-rung dispatch failures before the breaker trips and
 #: the ladder steps down ($CELESTIA_BREAKER_THRESHOLD).

@@ -45,6 +45,10 @@ gossip_drop=0.1,wal_torn_tail=1,rpc_slow_ms=100"
                           batched path, then — compounded with
                           proof_fail — to the host rung, every rung
                           bit-identical
+    extend_shard_fail=<p> SHARDED extend+DAH dispatch raises mid-
+                          collective (kernels/panel_sharded): the
+                          ladder walks sharded_panel -> panel (the
+                          single-device runner) with roots unchanged
 
 Protocol ADVERSARIES (chaos/adversary.py — attack model, not fault
 model; deterministic per (seed, height) rather than per call ordinal):
@@ -95,6 +99,7 @@ SEAMS = (
     "mempool.insert",
     "proof.serve",
     "proof.shard",
+    "device.extend_shard",
 )
 
 _KNOWN_KEYS = {
@@ -108,6 +113,7 @@ _KNOWN_KEYS = {
     "mempool_drop", "mempool_slow_ms", "mempool_slow",
     "proof_fail", "proof_slow_ms", "proof_slow",
     "shard_fail",
+    "extend_shard_fail",
     "withhold_frac", "malform_shares", "wrong_root",
 }
 
@@ -216,7 +222,7 @@ class ChaosInjector:
         injection lands MID-panel) — unless `dispatch_fail_all` widens
         it to every rung."""
         self._stall("device.dispatch", "dispatch_stall_ms", "dispatch_stall")
-        applies = (mode in ("panel", "fused", "fused_epi")
+        applies = (mode in ("sharded_panel", "panel", "fused", "fused_epi")
                    or self._p("dispatch_fail_all") > 0)
         if applies and self._fire("device.dispatch", "dispatch_fail"):
             self._count("device.dispatch", "dispatch_fail")
@@ -292,3 +298,14 @@ class ChaosInjector:
         if self._fire("proof.shard", "shard_fail"):
             self._count("proof.shard", "shard_fail")
             raise ChaosInjected("proof.shard", "shard_fail")
+
+    def extend_shard(self) -> None:
+        """Fail one SHARDED extend+DAH dispatch (kernels/panel_sharded:
+        the seam fires between the host-driven collective programs, so
+        an injection lands MID-collective-schedule).  guarded_dispatch
+        must walk the ladder sharded_panel -> panel — the single-device
+        runner — with bit-identical roots (the write-side ladder's top
+        seam)."""
+        if self._fire("device.extend_shard", "extend_shard_fail"):
+            self._count("device.extend_shard", "extend_shard_fail")
+            raise ChaosInjected("device.extend_shard", "extend_shard_fail")

@@ -224,9 +224,12 @@ def cmd_start(args) -> int:
         # $CELESTIA_WARMUP_K: extra square sizes beyond the app's cap —
         # the giant-square knob.  An operator serving k=1024 blocks with
         # $CELESTIA_PIPE_PANEL set warms the panel lowering's programs
-        # here (warmup resolves the mode PER SIZE), so the first giant
-        # block never eats the compile; without it the panel compiles
-        # would land on the block path (reference TimeoutPropose is 10s).
+        # here (warmup resolves the mode PER SIZE) — and with
+        # $CELESTIA_EXTEND_SHARDS on top, the SHARDED panel partition's
+        # collective programs (kernels/panel_sharded.py) — so the first
+        # giant block never eats the compile; without it the panel (or
+        # collective) compiles would land on the block path (reference
+        # TimeoutPropose is 10s).
         from celestia_app_tpu.da.eds import extra_warmup_sizes
 
         extra = sorted(set(extra_warmup_sizes()) - set(warmed))

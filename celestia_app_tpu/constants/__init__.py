@@ -56,8 +56,12 @@ SQUARE_SIZE_UPPER_BOUND = 128
 # giant-square frontier (O(n log n) FFT encode + panel-streamed extend+DAH,
 # $CELESTIA_PIPE_PANEL): GF(2^16) covers codewords to 65536 symbols, so the
 # bound is memory discipline, not field arithmetic — and the panel pipeline
-# is that discipline.
-MAX_CODEC_SQUARE_SIZE = 2048
+# is that discipline.  Raised 2048 -> 4096 with the multi-chip sharded
+# extend ($CELESTIA_EXTEND_SHARDS, kernels/panel_sharded.py): per-device
+# share residency is half-EDS/N + one panel, so the square a mesh can hold
+# scales with the mesh — 2*4096 = 8192-symbol codewords remain far inside
+# GF(2^16)'s 65536-symbol reach.
+MAX_CODEC_SQUARE_SIZE = 4096
 SUBTREE_ROOT_THRESHOLD = 64
 # Exact decimal (consensus-critical): binary floats would diverge from peers
 # doing exact-decimal arithmetic on fee boundaries.

@@ -121,6 +121,10 @@ def pipeline_cache_state(
 
     construction = construction or active_construction()
     mode = pipeline_mode_for_k(k)
+    if mode == "sharded_panel":
+        from celestia_app_tpu.kernels.panel_sharded import is_sharded_warm
+
+        return "hit" if is_sharded_warm(k, construction) else "miss"
     if mode == "panel":
         from celestia_app_tpu.kernels.panel import is_warm
 
@@ -166,6 +170,15 @@ def _pipeline_for_mode(
     from celestia_app_tpu.kernels.fused import jit_extend_and_dah
 
     construction = construction or active_construction()
+    if mode == "sharded_panel":
+        from celestia_app_tpu.kernels.panel_sharded import (
+            sharded_panel_pipeline,
+        )
+
+        # Host-driven like the panel runner (input never donated), with
+        # each step dispatched as ONE mesh-wide program; the EDS output
+        # stays row-sharded under the committed extend-mesh layout.
+        return sharded_panel_pipeline(k, construction)
     if mode == "panel":
         from celestia_app_tpu.kernels.panel import panel_pipeline
 
@@ -197,7 +210,15 @@ def _panel_fields(mode: str, k: int) -> dict:
     """Journal extras for a panel-streamed dispatch: how many panels the
     square streamed through (the per-dispatch panel-count instrument the
     giant-square memory model is judged by, next to the peak-bytes gauge
-    journal.record refreshes)."""
+    journal.record refreshes).  Sharded dispatches additionally carry
+    the mesh width (`shards`) and report their per-device step count."""
+    if mode == "sharded_panel":
+        from celestia_app_tpu.kernels.panel_sharded import (
+            shards_for_k,
+            sharded_panel_count,
+        )
+
+        return {"panels": sharded_panel_count(k), "shards": shards_for_k(k)}
     if mode != "panel":
         return {}
     from celestia_app_tpu.kernels.panel import panel_count
@@ -358,9 +379,10 @@ class SpeculativeExtender:
         try:
             from celestia_app_tpu.kernels.fused import pipeline_mode_for_k
 
-            if pipeline_mode_for_k(k) == "panel":
+            if pipeline_mode_for_k(k) in ("panel", "sharded_panel"):
                 # Same panel-granular staging as compute(): the runner
-                # uploads one row panel at a time out of the host copy.
+                # uploads one row panel (or one mesh-wide panel step) at
+                # a time out of the host copy.
                 x = np.ascontiguousarray(ods, dtype=np.uint8)
             else:
                 x = jnp.asarray(ods, dtype=jnp.uint8)
@@ -501,7 +523,7 @@ def warmup(
             for batch in batches:
                 if batch < 2:
                     continue  # batch-1 dispatch rides the unbatched entry
-                if pipeline_mode_for_k(k) == "panel":
+                if pipeline_mode_for_k(k) in ("panel", "sharded_panel"):
                     # Panel squares never coalesce (BlockPipeline forces
                     # batch=1 — a vmapped giant batch would materialize B
                     # full EDSes), so a batched program warmed here could
@@ -587,7 +609,8 @@ def _maybe_parity_check(ods_host, k: int, construction: str, droot) -> None:
         return
     from celestia_app_tpu.kernels.fused import pipeline_mode_for_k
 
-    if pipeline_mode_for_k(k) not in ("panel", "fused", "fused_epi"):
+    if pipeline_mode_for_k(k) not in ("sharded_panel", "panel", "fused",
+                                      "fused_epi"):
         # Staged mode (and its eager host twin) already IS the reference
         # lowering: re-running it against itself would burn a duplicate
         # dispatch to report a meaningless "match".
@@ -809,13 +832,15 @@ class ExtendedDataSquare:
             t0 = time.perf_counter()
             from celestia_app_tpu.kernels.fused import pipeline_mode_for_k
 
-            if pipeline_mode_for_k(k) == "panel":
+            if pipeline_mode_for_k(k) in ("panel", "sharded_panel"):
                 # Panel mode streams panels out of the HOST copy one at a
-                # time — a whole-square upload here would stage the giant
-                # ODS device-resident next to the half-EDS accumulator,
-                # breaking the documented residency bound.  A mid-call
-                # ladder fall still works: the materializing jits accept
-                # the host array and upload at dispatch.
+                # time (the sharded runner additionally lays each step
+                # out row-sharded across the mesh) — a whole-square
+                # upload here would stage the giant ODS device-resident
+                # next to the half-EDS accumulator, breaking the
+                # documented residency bound.  A mid-call ladder fall
+                # still works: the materializing jits accept the host
+                # array and upload at dispatch.
                 x = np.ascontiguousarray(ods, dtype=np.uint8)
             else:
                 x = jnp.asarray(ods, dtype=jnp.uint8)

@@ -102,21 +102,30 @@ def env_base_mode() -> str:
 
 
 def env_base_mode_for_k(k: int) -> str:
-    """The env-selected base lowering for square size k: "panel" when the
-    panel-streaming seam engages at this k ($CELESTIA_PIPE_PANEL —
+    """The env-selected base lowering for square size k: "sharded_panel"
+    when the multi-chip extend partition engages at this k
+    ($CELESTIA_EXTEND_SHARDS on top of the panel seam —
+    kernels/panel_sharded.shards_for_k), "panel" when only the
+    single-device panel-streaming seam engages ($CELESTIA_PIPE_PANEL —
     kernels/panel.panel_rows), else the k-less env_base_mode().  The
-    degradation ladder steps relative to THIS, so a faulting panel
-    dispatch walks panel -> fused_epi/fused -> staged -> host."""
+    degradation ladder steps relative to THIS, so a faulting sharded
+    collective walks sharded_panel -> panel -> fused_epi/fused ->
+    staged -> host."""
     from celestia_app_tpu.kernels.panel import panel_rows
 
-    return "panel" if panel_rows(k) else env_base_mode()
+    if not panel_rows(k):
+        return env_base_mode()
+    from celestia_app_tpu.kernels.panel_sharded import shards_for_k
+
+    return "sharded_panel" if shards_for_k(k) else "panel"
 
 
 def pipeline_mode_for_k(k: int) -> str:
     """The active extend+DAH lowering for square size k — pipeline_mode()
-    with the per-k panel-streaming seam applied above the fused rungs.
-    All five lowerings are bit-identical; the per-k selection is a
-    memory/perf choice, never a correctness hazard."""
+    with the per-k panel-streaming (and multi-chip panel-partition)
+    seams applied above the fused rungs.  All six lowerings are
+    bit-identical; the per-k selection is a memory/perf choice, never a
+    correctness hazard."""
     from celestia_app_tpu.chaos.degrade import effective_device_mode
 
     return effective_device_mode(env_base_mode_for_k(k))

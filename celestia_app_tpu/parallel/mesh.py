@@ -29,6 +29,12 @@ from functools import lru_cache
 import numpy as np
 
 SERVE_AXIS = "serve"
+#: The extend plane's mesh axis (kernels/panel_sharded.py): the sharded
+#: extend+DAH pipeline partitions row panels over it, and the retained
+#: EDS keeps that layout all the way into the serve gather — a separate
+#: name from "serve" so the share mesh and the forest mesh can coexist
+#: (and differ in width) in one process.
+EXTEND_AXIS = "extend"
 
 
 @lru_cache(maxsize=None)
@@ -121,6 +127,87 @@ def sharded_gather_fn(mesh, axis: str, rows_per_shard: int, width: int,
         body,
         in_shardings=(fsh, fsh),
         out_shardings=row_sharding(mesh, axis),
+    )
+
+
+def row_sharding3(mesh, axis: str = SERVE_AXIS):
+    """NamedSharding partitioning axis 0 of a RANK-3 array across the
+    mesh — the committed layout of the sharded extend plane's share
+    buffers ((rows, cols, SHARE_SIZE); the rank-2 row_sharding is the
+    forests').  One producer commits it (the sharded panel pipeline's
+    output programs), every consumer names it back (the serve plane's
+    share gather), so the EDS never moves between extend, retention,
+    and gather."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(axis, None, None))
+
+
+def xor_allreduce(x, axis: str, n: int):
+    """Bitwise-XOR all-reduce over a mesh axis: recursive doubling via
+    lax.ppermute (log2 n exchanges, each the full working set).
+
+    lax.psum adds integers — and a sum of packed GF(2) BYTES is not
+    their XOR — so the mod-2 collective the sharded column phase needs
+    is built from pairwise exchanges: at distance d every device XORs
+    its partial with device (i ^ d)'s, and after log2(n) doublings every
+    device holds the XOR of all n partials.  Exactness is the panel
+    pipeline's own argument (mod-2 of a sum == XOR of per-part mod-2
+    partials), applied across devices instead of across panels.
+    Requires n to be a power of two (i ^ d must stay inside the mesh).
+    """
+    from jax import lax
+
+    if n & (n - 1):
+        raise ValueError(f"xor_allreduce needs a power-of-two axis, got {n}")
+    d = 1
+    while d < n:
+        perm = [(i, i ^ d) for i in range(n)]
+        x = x ^ lax.ppermute(x, axis, perm)
+        d *= 2
+    return x
+
+
+@lru_cache(maxsize=None)
+def sharded_share_gather_fn(mesh, axis: str, rows_local: int, n_cols: int,
+                            width: int, batch: int):
+    """The sharded EDS share gather: ONE program per dispatch.
+
+    f(eds (shards*rows_local, n_cols, width) row-sharded,
+      idx (shards, batch) int32 row-sharded, LOCAL FLAT share offsets)
+        -> (shards, batch, width) row-sharded
+
+    The share at (r, c) lives at flat offset r*n_cols + c of the
+    row-major square; contiguous row blocks flatten to contiguous flat
+    blocks, so shard-of-share is the same one-divide routing the forest
+    gather uses (route_to_shards with rows_per_shard = rows_local *
+    n_cols).  in_shardings name the extend pipeline's committed layout
+    (row_sharding3): a retained EDS is never resharded by the serve
+    plane's share reads — the PR 13 contract extended from the 90-byte
+    forests to the shares themselves.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from celestia_app_tpu.parallel._compat import shard_map
+    from celestia_app_tpu.trace.journal import note_jit_build
+
+    def local(eds_local, idx_local):
+        flat = eds_local.reshape(rows_local * n_cols, width)
+        return jnp.take(flat, idx_local[0], axis=0)[None]
+
+    body = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis, None, None), P(axis, None)),
+        out_specs=P(axis, None, None),
+    )
+    note_jit_build("serve_share_gather")
+    return jax.jit(
+        body,
+        in_shardings=(row_sharding3(mesh, axis), row_sharding(mesh, axis)),
+        out_shardings=row_sharding3(mesh, axis),
     )
 
 

@@ -334,7 +334,11 @@ class BlockPipeline:
         # stages a giant square whole next to the pipeline's working
         # set.  Panel squares are giant by definition and never coalesce
         # (the vmapped batched program would materialize B full EDSes),
-        # so batching is forced off.
+        # so batching is forced off.  The multi-chip sharded rung
+        # ($CELESTIA_EXTEND_SHARDS, kernels/panel_sharded.py) rides the
+        # same staging: it engages only where the panel seam does, and
+        # its runner consumes the host slot one mesh-wide panel step at
+        # a time.
         from celestia_app_tpu.kernels.panel import panel_rows
 
         self._panel = panel_rows(k)
@@ -629,10 +633,12 @@ class BlockPipeline:
                         k=self.k,
                     )
                     per_square = [(mode, out)]
-                    if mode == "panel":
-                        from celestia_app_tpu.kernels.panel import panel_count
+                    # One owner for the panel/sharded journal extras —
+                    # da/eds._panel_fields — so this row can never
+                    # disagree with compute()'s for the same dispatch.
+                    from celestia_app_tpu.da.eds import _panel_fields
 
-                        meta["panels"] = panel_count(self.k)
+                    meta.update(_panel_fields(mode, self.k))
                 else:
                     per_square = self._dispatch_batched(x, sid, n)
                 meta["dispatch_ms"] = (time.perf_counter() - t1) * 1e3
@@ -678,6 +684,7 @@ class BlockPipeline:
             depth=self.depth,
             batch_size=meta.get("batch_size", 1),
             **({"panels": meta["panels"]} if "panels" in meta else {}),
+            **({"shards": meta["shards"]} if "shards" in meta else {}),
             upload_ms=meta.get("upload_ms", 0.0),
             upload_stall_ms=meta.get("upload_stall_ms", 0.0),
             dispatch_ms=meta.get("dispatch_ms", 0.0),

@@ -127,18 +127,37 @@ def new_share_inclusion_proof(
     k = eds.k
     if not 0 <= start < end <= k * k:
         raise ValueError(f"invalid ODS share range [{start},{end})")
-    eds_np = eds.squared()
-    namespace = bytes(eds_np[start // k, start % k, :NAMESPACE_SIZE].tobytes())
-
     start_row, end_row = start // k, (end - 1) // k + 1
+    spans = [
+        (r,
+         start % k if r == start_row else 0,
+         (end - 1) % k + 1 if r == end_row - 1 else k)
+        for r in range(start_row, end_row)
+    ]
+    coords = [(r, c) for r, lo, hi in spans for c in range(lo, hi)]
+    forest = getattr(eds, "_forest", None)
+    if forest is not None and forest.eds is eds:
+        # Serve-plane path: the whole range in ONE gather, each share
+        # fetched from its owning buffer — a share-sharded retained EDS
+        # (kernels/panel_sharded) routes every coordinate to its shard
+        # instead of materializing the square on the host.  Only when
+        # the forest is backed by THIS handle: a detached view (the
+        # adversary's tampered copy carries the honest entry's forest)
+        # must serve its own bytes, or tampering would be silently
+        # masked instead of detected.
+        mat = forest.gather_shares(coords)
+    else:
+        eds_np = eds.squared()
+        mat = eds_np[tuple(np.transpose(coords))]
+    namespace = bytes(mat[0, :NAMESPACE_SIZE].tobytes())
+
     shares: list[bytes] = []
     nmt_proofs: list[NmtRangeProof] = []
-    for r in range(start_row, end_row):
-        lo = start % k if r == start_row else 0
-        hi = (end - 1) % k + 1 if r == end_row - 1 else k
-        row = eds_np[r]
+    pos = 0
+    for r, lo, hi in spans:
         for c in range(lo, hi):
-            raw = bytes(row[c].tobytes())
+            raw = bytes(mat[pos].tobytes())
+            pos += 1
             if raw[:NAMESPACE_SIZE] != namespace:
                 raise ValueError(
                     f"share ({r},{c}) namespace differs from range start"

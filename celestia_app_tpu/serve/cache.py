@@ -84,6 +84,17 @@ class CachedForest:
         self.healed = False
         self.row_flat = row_flat  # (N, 90) — all row-tree levels, flat
         self.col_flat = col_flat
+        # Share sharding (the multi-chip extend plane, kernels/
+        # panel_sharded.py): when the retained EDS buffer arrived
+        # row-partitioned across an extend mesh, admission keeps it
+        # AS-IS — no copy, no reshard — and share reads route each
+        # coordinate to its owning shard (gather_shares below).
+        # Discovered from the buffer, not an env knob, so a process can
+        # serve sharded and unsharded heights side by side.
+        from celestia_app_tpu.serve.shard import eds_share_layout
+
+        layout = eds_share_layout(eds._eds)
+        self.share_shards = layout[2] if layout is not None else 0
         self.widths, self.offsets = forest_level_layout(self.k)
         self.row_roots = eds.row_roots()
         self.col_roots = eds.col_roots()
@@ -115,10 +126,22 @@ class CachedForest:
         )
 
     def gather_shares(self, coords) -> np.ndarray:
-        """(B, SHARE_SIZE) shares for [(row, col), ...] in one take."""
+        """(B, SHARE_SIZE) shares for [(row, col), ...] in one take.
+
+        A share-sharded EDS (the multi-chip extend plane's committed
+        row partition) answers as ONE sharded program with each
+        coordinate routed to its owning shard's buffer — no reshard,
+        ever (serve/shard.sharded_share_gather); a fault there degrades
+        to the single-device take below, bit-identically."""
         n = 2 * self.k
-        idx = [r * n + c for r, c in coords]
         buf = self.eds._eds
+        if self.share_shards and not isinstance(buf, np.ndarray):
+            from celestia_app_tpu.serve.shard import sharded_share_gather
+
+            out = sharded_share_gather(buf, coords)
+            if out is not None:
+                return out
+        idx = [r * n + c for r, c in coords]
         if isinstance(buf, np.ndarray):
             flat = buf.reshape(n * n, buf.shape[-1])
             return flat[np.asarray(idx, dtype=np.int64)]
@@ -159,6 +182,7 @@ class CachedForest:
         self.col_flat = np.asarray(self.col_flat)
         self.eds._eds = np.asarray(self.eds._eds)
         self.device_resident = False
+        self.share_shards = 0  # the host copy is one buffer, unsharded
 
 
 class ForestCache:

@@ -269,6 +269,14 @@ class ProofSampler:
             shards=max(
                 (getattr(p.entry, "shards", 0) for p in batch), default=0
             ),
+            # The extend plane's share partition (kernels/panel_sharded):
+            # independent of the forest mesh above, so the row carries
+            # both — a sharded-forest/unsharded-share plane and its
+            # inverse are distinguishable from one trace table.
+            share_shards=max(
+                (getattr(p.entry, "share_shards", 0) for p in batch),
+                default=0,
+            ),
         )
         for group in by_entry.values():
             entry = group[0].entry

@@ -52,6 +52,7 @@ from celestia_app_tpu.parallel.mesh import (
     row_sharding,
     shard_of_row,
     sharded_gather_fn,
+    sharded_share_gather_fn,
 )
 from celestia_app_tpu.serve.cache import CachedForest
 
@@ -112,6 +113,92 @@ def leaf_shard_of(k: int, shards: int, row: int, col: int,
     rows_per_shard = padded_rows(n * (2 * n - 1), shards) // shards
     tree, leaf = (col, row) if axis == "col" else (row, col)
     return shard_of_row(tree * n + leaf, rows_per_shard)
+
+
+def eds_share_layout(buf):
+    """(mesh, axis, shards) when `buf` is a device array row-partitioned
+    across >1 devices on a named mesh axis — the committed layout the
+    sharded extend pipeline (kernels/panel_sharded.py) retains its EDS
+    under — else None.  Pure introspection: the serve plane discovers
+    share sharding from the buffer it was handed, so the extend knob and
+    the serve knob never have to agree."""
+    try:
+        from jax.sharding import NamedSharding
+    except Exception:  # chaos-ok: no jax — host tier only
+        return None
+    sh = getattr(buf, "sharding", None)
+    if not isinstance(sh, NamedSharding):
+        return None
+    spec = tuple(sh.spec)
+    if not spec or spec[0] is None or any(s is not None for s in spec[1:]):
+        return None
+    axis = spec[0]
+    if isinstance(axis, (tuple, list)):
+        if len(axis) != 1:
+            return None
+        axis = axis[0]
+    shards = int(sh.mesh.shape[axis])
+    if shards < 2:
+        return None
+    return sh.mesh, str(axis), shards
+
+
+def sharded_share_gather(buf, coords) -> np.ndarray | None:
+    """Gather [(row, col), ...] shares from a row-sharded EDS buffer as
+    ONE sharded program, each coordinate routed host-side to its owning
+    shard (flat share offset r*n + c; contiguous row blocks flatten to
+    contiguous flat blocks, so it is the same one-divide routing the
+    forest gather uses).  Returns None when `buf` is not share-sharded
+    (the caller's single-device take answers); falls back the same way —
+    ticking celestia_recoveries_total{seam="proof.shard"} — on an
+    injected (chaos shard_fail) or real fault, so the read-side rung
+    ladder covers shares exactly as it covers forests.  in_shardings
+    name the extend pipeline's committed layout: a retained EDS is
+    NEVER resharded by a serve read (pinned to buffer pointers in
+    tests/test_panel_sharded.py)."""
+    layout = eds_share_layout(buf)
+    if layout is None:
+        return None
+    mesh, axis, shards = layout
+    rows, n_cols, width = (int(x) for x in buf.shape)
+    rows_local = rows // shards
+    flat_idx = np.asarray(
+        [r * n_cols + c for r, c in coords], dtype=np.int64
+    )
+    try:
+        from celestia_app_tpu import chaos
+
+        chaos.proof_shard()
+        import jax
+
+        local, (shard, slot), counts = route_to_shards(
+            flat_idx, shards, rows_local * n_cols
+        )
+        fn = sharded_share_gather_fn(
+            mesh, axis, rows_local, n_cols, width, int(local.shape[1])
+        )
+        idx = jax.device_put(local, row_sharding(mesh, axis))
+        out = np.asarray(fn(buf, idx))  # (shards, bucket, width)
+        _count_share_rows(counts)
+        return out[shard, slot]
+    except Exception:  # noqa: BLE001 — single-device rung answers
+        from celestia_app_tpu.chaos.degrade import recoveries
+
+        recoveries().inc(seam="proof.shard", outcome="degraded")
+        return None
+
+
+def _count_share_rows(counts) -> None:
+    from celestia_app_tpu.trace.metrics import registry
+
+    ctr = registry().counter(
+        "celestia_serve_share_gathers_total",
+        "EDS shares gathered per extend shard (one sharded program per "
+        "share read; bounded: one label per shard)",
+    )
+    for s, n in enumerate(counts):
+        if n:
+            ctr.inc(int(n), shard=str(s))
 
 
 class ShardedCachedForest(CachedForest):

@@ -18,7 +18,10 @@ of a gated series drops more than `--threshold` percent (default 10)
 plus that round's measured `stability_pct` below the best earlier
 datapoint.  Gated by default: the device-resident `compute` rows (the
 ROADMAP headline), the batched `repair` rows (compute-bound since the
-ISSUE-10 rework; the same-platform prior rule applies), and the `parts`
+ISSUE-10 rework; the same-platform prior rule applies), the multi-chip
+`compute_sharded<N>` sweep rows (one series PER SHARD COUNT — bench.py
+BENCH_MODE=compute_sharded; opt-in like the giant-k rows, so absence
+from a default-plan round is a plan gap, never STALE), and the `parts`
 decomposition seconds.  The link-bound modes (extend / stream / host)
 ride the tunnel between the host and the chip, whose quality varies
 between rounds (BENCH_r03's stream row collapsed 13x while compute
@@ -89,6 +92,22 @@ STREAM_BATCH_MODES = ("stream_b1", "stream_b2", "stream_b4")
 # group baseline bench.py re-measures at k=128 for the speedup record)
 # stays ungated: it exists to be compared against, not to regress.
 GATED_MODES = ("compute", "repair") + STREAM_BATCH_MODES
+# The multi-chip extend sweep rows (bench.py BENCH_MODE=compute_sharded,
+# kernels/panel_sharded): mode compute_sharded<N>, one series PER SHARD
+# COUNT — each N gates against prior rounds carrying the same N under
+# the same-platform rule (the das-v2 sweep pattern applied to the write
+# side: a 1-shard leg is never a regression against an 8-shard leg).
+# Like giant-k rows they are opt-in (only BENCH_MODE=compute_sharded
+# produces them), so their absence from a default-plan round is a plan
+# gap, never STALE; a shard count no prior round measured is likewise a
+# plan gap, not an unknown series.
+SHARDED_COMPUTE_RE = re.compile(r"^compute_sharded\d+$")
+
+
+def is_gated_mode(mode: str) -> bool:
+    return mode in GATED_MODES or bool(SHARDED_COMPUTE_RE.match(mode))
+
+
 # Modes bound by the host<->device link; reported, not gated by default.
 LINK_BOUND_MODES = ("extend", "stream", "host")
 # The default bench plan stops at k=512 (the paper's north star); rows at
@@ -654,7 +673,8 @@ def find_regressions(
     platforms = {r["round"]: r.get("platform") for r in rounds}
     out = []
     for (mode, k), pts in sorted(mode_series(rounds).items()):
-        if not gate_all and mode not in gate_modes:
+        if not gate_all and not (mode in gate_modes
+                                 or SHARDED_COMPUTE_RE.match(mode)):
             continue
         if len(pts) < 2:
             continue
@@ -769,12 +789,16 @@ def stale_gated_series(
     newest_known_off_chip = plat is not None and plat != "tpu"
     out = []
     for (mode, k), pts in sorted(mode_series(rounds).items()):
-        if not gate_all and mode not in gate_modes:
+        sharded = bool(SHARDED_COMPUTE_RE.match(mode))
+        if not gate_all and not (mode in gate_modes or sharded):
             continue
         if pts[-1][0] < newest:
             entry = {"series": f"{mode}@{k}", "last_round": pts[-1][0],
                      "newest_round": newest}
-            if k > DEFAULT_PLAN_MAX_K:
+            if k > DEFAULT_PLAN_MAX_K or sharded:
+                # Opt-in series (explicit BENCH_K / BENCH_MODE=
+                # compute_sharded): absence from a default-plan round is
+                # a plan gap, never STALE.
                 entry["opt_in"] = True
             out.append(entry)
     for name, pts in sorted(parts_series(rounds).items()):
@@ -810,7 +834,8 @@ def render_table(rounds: list[dict]) -> str:
                 lines.append(fmt_row(f"{m}@{k}", pts, f"MB/s{gated}"))
     for (m, k), pts in sorted(modes.items()):
         if m not in GATED_MODES + LINK_BOUND_MODES:
-            lines.append(fmt_row(f"{m}@{k}", pts, "MB/s (not gated)"))
+            gated = "" if is_gated_mode(m) else " (not gated)"
+            lines.append(fmt_row(f"{m}@{k}", pts, f"MB/s{gated}"))
     for name, pts in sorted(parts_series(rounds).items()):
         lines.append(fmt_row(f"parts.{name}", pts, "s"))
     notes = []

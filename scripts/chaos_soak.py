@@ -46,6 +46,15 @@ LATENCY but never CORRECTNESS.  Four drills, one process:
                        the single-device batched path, and compounded
                        with `proof_fail=1.0` on down to the host rung —
                        proof bytes bit-identical at every rung.
+  2h. extend-shard drill — the SHARDED extend+DAH plane's rung ladder
+                       ($CELESTIA_EXTEND_SHARDS, kernels/
+                       panel_sharded.py): the committed-sharding
+                       multi-chip pipeline must produce bit-identical
+                       roots and a row-sharded EDS, and under
+                       `extend_shard_fail=1.0` every collective dispatch
+                       faults MID-schedule and the ladder walks
+                       sharded_panel -> panel (the single-device
+                       runner), roots unchanged.
   2f. quorum heal    — N serve-nodes with partial local share sets under
                        one withholding proposer: each detects through its
                        own sampling plane, repairs from the quorum's
@@ -632,6 +641,115 @@ def run_shard_fault_drill(k: int = 8, samples: int = 48,
             os.environ.pop("CELESTIA_SERVE_SHARDS", None)
         else:
             os.environ["CELESTIA_SERVE_SHARDS"] = saved
+
+
+def run_extend_shard_drill(k: int = 8, shards: int = 8,
+                           panel_rows: int = 2) -> dict:
+    """The SHARDED extend+DAH plane's rung-ladder drill
+    (kernels/panel_sharded.py, $CELESTIA_EXTEND_SHARDS).
+
+    Baseline: one square extended on the single-device materializing
+    path (no chaos, no sharding), its DAH roots the reference.  Leg 1:
+    the sharded-panel seam engaged with no chaos — the committed-
+    sharding multi-chip pipeline must produce bit-identical roots AND a
+    row-sharded EDS.  Leg 2: `extend_shard_fail=1.0` fails every
+    sharded collective dispatch MID-schedule — guarded_dispatch must
+    walk the ladder sharded_panel -> panel (the single-device runner),
+    roots unchanged, ticking the dispatch-seam recoveries and leaving
+    /healthz's degraded map on the panel rung.  The write-side ladder's
+    top seam, drilled end-to-end.
+    """
+    import jax
+
+    from celestia_app_tpu import chaos
+    from celestia_app_tpu.chaos import degrade
+    from celestia_app_tpu.da.eds import ExtendedDataSquare
+    from celestia_app_tpu.trace.metrics import registry
+
+    # Clamp to the devices AND the square — then pow2-floor, exactly as
+    # extend_shards() will (each device owns at least one ODS row; the
+    # XOR butterfly needs a power of two): the drill's expectation must
+    # match what the seam actually engages with, or a 6-device host
+    # would fail the drill despite a healthy ladder.
+    from celestia_app_tpu.kernels.panel_sharded import _pow2_floor
+
+    shards = _pow2_floor(min(shards, len(jax.devices()), k))
+    _, ods = _deterministic_blocks(1, k, seed=4242)[0]
+    saved = {
+        key: os.environ.get(key)
+        for key in ("CELESTIA_EXTEND_SHARDS", "CELESTIA_PIPE_PANEL")
+    }
+
+    def _recoveries() -> float:
+        total = 0.0
+        for labels, val in registry().counter(
+            "celestia_recoveries_total", ""
+        ).samples():
+            if labels.get("seam") == "device.dispatch":
+                total += val
+        return total
+
+    try:
+        chaos.install("")  # baseline leg: no injection even with env chaos
+        degrade.reset_for_tests()
+        os.environ.pop("CELESTIA_EXTEND_SHARDS", None)
+        os.environ.pop("CELESTIA_PIPE_PANEL", None)
+        root = ExtendedDataSquare.compute(ods).data_root()
+
+        os.environ["CELESTIA_PIPE_PANEL"] = str(panel_rows)
+        os.environ["CELESTIA_EXTEND_SHARDS"] = str(shards)
+        from celestia_app_tpu.kernels.fused import pipeline_mode_for_k
+        from celestia_app_tpu.kernels.panel_sharded import shards_for_k
+
+        engaged = pipeline_mode_for_k(k) == "sharded_panel"
+        eds_sharded = ExtendedDataSquare.compute(ods)
+        sharded_identical = eds_sharded.data_root() == root
+        n_shards = len(
+            getattr(eds_sharded._eds, "addressable_shards", [])
+        ) or 1
+
+        before = _recoveries()
+        t0_ns = time.time_ns()
+        chaos.install("seed=13,extend_shard_fail=1.0")
+        try:
+            eds_faulted = ExtendedDataSquare.compute(ods)
+        finally:
+            chaos.install("")
+        fault_identical = eds_faulted.data_root() == root
+        state = degrade.degraded_state() or {}
+        walked_to = state.get("device")
+        recovered = _recoveries() - before
+        ok = (
+            engaged
+            and shards_for_k(k) == shards
+            and sharded_identical
+            and n_shards == shards
+            and fault_identical
+            and walked_to == "panel"
+            and recovered > 0
+        )
+        return {
+            "k": k,
+            "shards": shards,
+            "engaged": engaged,
+            "sharded_identical": sharded_identical,
+            "eds_device_shards": n_shards,
+            "fault_identical": fault_identical,
+            "walked_to": walked_to,
+            "recoveries": recovered,
+            # Time-to-detection for the summary table: the breaker trip
+            # black-boxes via the flight recorder when armed.
+            "detection": _detection(t0_ns, trigger="breaker_trip"),
+            "ok": ok,
+        }
+    finally:
+        chaos.uninstall()
+        degrade.reset_for_tests()
+        for key, val in saved.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
 
 
 def run_speculation_drill(k: int = 4, blocks: int = 6,
@@ -1619,6 +1737,16 @@ def main(argv=None) -> int:
     if not shd["ok"]:
         failures.append(f"shard-fault drill failed: {shd}")
 
+    esd = run_extend_shard_drill(k=min(args.k, 8))
+    print(f"extend-shard drill: k={esd['k']} shards={esd['shards']} -> "
+          f"sharded_identical={esd['sharded_identical']} "
+          f"eds_device_shards={esd['eds_device_shards']} "
+          f"fault walked_to={esd['walked_to']} "
+          f"identical={esd['fault_identical']} "
+          f"recoveries={esd['recoveries']:.0f}", flush=True)
+    if not esd["ok"]:
+        failures.append(f"extend-shard drill failed: {esd}")
+
     spc = run_speculation_drill(k=min(args.k, 8),
                                 blocks=min(args.blocks, 6))
     print(f"speculation drill: {spc['blocks']} blocks @ k={spc['k']} -> "
@@ -1728,6 +1856,7 @@ def main(argv=None) -> int:
         ("device soak", dev.get("detection")),
         ("WAL tear", wal.get("detection")),
         ("sampling", smp.get("detection")),  # healed by host fallback
+        ("extend shard", esd.get("detection")),  # healed by the ladder
         ("speculation", spc.get("detection")),  # discards heal silently
         ("batched fault", bat.get("detection")),
         ("withholding", wd.get("detection_signal")),

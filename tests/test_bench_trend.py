@@ -422,6 +422,90 @@ class TestGiantKSeries:
         assert payload["stale"] == []
 
 
+class TestShardedComputeSeries:
+    """compute_sharded<N> sweep rows (BENCH_MODE=compute_sharded,
+    kernels/panel_sharded): gated PER SHARD COUNT under the
+    same-platform rule; a shard count (or the whole sweep) absent from
+    a round is an opt-in plan gap, never STALE."""
+
+    def test_sweep_rows_gate_per_shard_count(self, tmp_path, capsys):
+        bt = _load()
+        assert bt.is_gated_mode("compute_sharded8")
+        assert bt.is_gated_mode("compute_sharded1")
+        assert not bt.is_gated_mode("compute_shardedx")
+        _round_file(tmp_path, 1, [
+            {"mode": "compute_sharded1", "k": 256, "mb_per_s": 2.0},
+            {"mode": "compute_sharded8", "k": 256, "mb_per_s": 1.0},
+        ], platform="cpu")
+        _round_file(tmp_path, 2, [
+            {"mode": "compute_sharded1", "k": 256, "mb_per_s": 2.1},
+            {"mode": "compute_sharded8", "k": 256, "mb_per_s": 0.98},
+        ], platform="cpu")
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "compute_sharded8@256" in out
+        line = next(
+            ln for ln in out.splitlines() if "compute_sharded8@256" in ln
+        )
+        assert "not gated" not in line
+        # A same-platform collapse of ONE shard count gates; the other
+        # series' stability does not mask it.
+        _round_file(tmp_path, 3, [
+            {"mode": "compute_sharded1", "k": 256, "mb_per_s": 2.1},
+            {"mode": "compute_sharded8", "k": 256, "mb_per_s": 0.2},
+        ], platform="cpu")
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        assert "compute_sharded8@256" in capsys.readouterr().out
+
+    def test_shard_counts_never_gate_each_other(self, tmp_path):
+        """An 8-shard leg slower than the 1-shard leg (the CPU
+        machinery curve) is NOT a regression — the series are keyed per
+        shard count."""
+        bt = _load()
+        _round_file(tmp_path, 1, [
+            {"mode": "compute_sharded1", "k": 256, "mb_per_s": 5.0},
+        ], platform="cpu")
+        _round_file(tmp_path, 2, [
+            {"mode": "compute_sharded1", "k": 256, "mb_per_s": 5.0},
+            {"mode": "compute_sharded8", "k": 256, "mb_per_s": 0.5},
+        ], platform="cpu")
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+
+    def test_cross_platform_prior_not_compared(self, tmp_path):
+        bt = _load()
+        _round_file(tmp_path, 1, [
+            {"mode": "compute_sharded8", "k": 512, "mb_per_s": 900.0},
+        ], platform="tpu")
+        _round_file(tmp_path, 2, [
+            {"mode": "compute_sharded8", "k": 512, "mb_per_s": 1.0},
+        ], platform="cpu")
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+
+    def test_absent_sweep_is_opt_in_plan_gap_not_stale(self, tmp_path,
+                                                       capsys):
+        import json as _json
+
+        bt = _load()
+        _round_file(tmp_path, 1, [
+            {"mode": "compute_sharded8", "k": 256, "mb_per_s": 1.0},
+            {"mode": "compute", "k": 128, "mb_per_s": 50.0},
+        ], platform="cpu")
+        # Default plan next round: no compute_sharded rows.
+        _round_file(tmp_path, 2, [
+            {"mode": "compute", "k": 128, "mb_per_s": 51.0},
+        ], platform="cpu")
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "opt-in: compute_sharded8@256" in out
+        assert "STALE" not in out
+        bt.main(["--dir", str(tmp_path), "--json"])
+        payload = _json.loads(capsys.readouterr().out)
+        assert [s["series"] for s in payload["opt_in"]] == [
+            "compute_sharded8@256"
+        ]
+        assert payload["stale"] == []
+
+
 class TestMalformedInputsFailFast:
     def test_unreadable_json_exits_2(self, tmp_path):
         bt = _load()

@@ -36,8 +36,25 @@ def random_ods(k: int, seed: int) -> np.ndarray:
     return ods
 
 
+# One staged-reference jit per (k, construction) for the whole module
+# (and for tests/test_panel_sharded.py, which imports this): a fresh
+# jax.jit around a fresh _pipeline closure per call recompiled the SAME
+# program for every parity test — the test_fused_pipeline relief
+# pattern, extended here (tens of seconds of tier-1 budget at k=32).
+_STAGED_JITS: dict = {}
+
+
+def _staged_fn(k: int, construction: str):
+    fn = _STAGED_JITS.get((k, construction))
+    if fn is None:
+        fn = _STAGED_JITS[(k, construction)] = jax.jit(
+            _pipeline(k, construction)
+        )
+    return fn
+
+
 def _staged(k: int, ods: np.ndarray, construction: str):
-    fn = jax.jit(_pipeline(k, construction))
+    fn = _staged_fn(k, construction)
     return [np.asarray(x) for x in fn(jnp.asarray(ods, dtype=jnp.uint8))]
 
 
@@ -263,7 +280,11 @@ class TestPanelChaosDrill:
     def test_panel_is_top_ladder_rung(self, monkeypatch):
         from celestia_app_tpu.chaos import degrade
 
-        assert degrade.LADDER[0] == "panel"
+        # The multi-chip sharded rung sits above even the panel runner
+        # (most infrastructure under it, first distrusted); the
+        # single-device panel rung is next.
+        assert degrade.LADDER[0] == "sharded_panel"
+        assert degrade.LADDER[1] == "panel"
         # Stepping off the panel rung lands on the MATERIALIZING base the
         # process warmed (default "fused"), never on a colder in-between
         # variant nothing compiled: a giant-k fused_epi compile on the

@@ -1,0 +1,573 @@
+"""Multi-chip sharded extend+DAH (kernels/panel_sharded.py) on the 8
+forced host devices (tests/conftest.py):
+
+  * the sharded panel partition is bit-identical to the dense
+    full-square pipeline — EDS bytes, row/col roots, data root — for
+    both RS constructions, shard counts that do and do not divide the
+    panel count (short last per-device panel), and both column-phase
+    legs (XOR all-reduce dense partials; all_to_all'd column-blocked
+    FFT butterflies);
+  * the output EDS carries THE committed row sharding
+    (parallel/mesh.row_sharding3) and is retained AS-IS: ForestCache
+    admission keeps the sharded buffers and serve-plane share reads
+    (parity quadrants included) gather from the owning shard with no
+    reshard — pinned down to per-shard buffer pointers;
+  * the chaos seam device.extend_shard (extend_shard_fail) walks the
+    ladder sharded_panel -> panel with roots unchanged, drilled
+    end-to-end via chaos_soak.run_extend_shard_drill;
+  * warmup warms the sharded programs per configured k, so a server's
+    first giant sharded block never eats the collective's compile.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from celestia_app_tpu.constants import NAMESPACE_SIZE, SHARE_SIZE
+from celestia_app_tpu.da.eds import ExtendedDataSquare
+from celestia_app_tpu.kernels.panel_sharded import (
+    extend_shards,
+    local_panel_bounds,
+    sharded_panel_count,
+    sharded_panel_pipeline,
+    shards_for_k,
+)
+
+CONSTRUCTIONS = ("vandermonde", "leopard")
+
+
+def random_ods(k: int, seed: int) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    ods = rng.integers(0, 256, size=(k, k, SHARE_SIZE), dtype=np.uint8)
+    ods[..., 0] = 0  # namespaces below the parity namespace
+    return ods
+
+
+def det_square(k: int, seed: int = 1) -> np.ndarray:
+    """The namespace-ordered square the serve tests share (same bytes as
+    tests/test_das_proofs.det_square, so golden digests transfer)."""
+    rng = np.random.default_rng(seed)
+    ns = np.sort(rng.integers(0, 128, k * k).astype(np.uint8))
+    ods = rng.integers(0, 256, (k * k, SHARE_SIZE), dtype=np.uint8)
+    ods[:, :NAMESPACE_SIZE] = 0
+    ods[:, NAMESPACE_SIZE - 1] = ns
+    return ods.reshape(k, k, SHARE_SIZE)
+
+
+def _staged(k: int, ods: np.ndarray, construction: str):
+    # The staged-reference jit is memoized per (k, construction) and
+    # SHARED with test_panel_pipeline (tier-1 budget: a fresh jit per
+    # call recompiled the same program for every parity test).
+    from tests.test_panel_pipeline import _staged_fn
+
+    return [np.asarray(x)
+            for x in _staged_fn(k, construction)(
+                jnp.asarray(ods, dtype=jnp.uint8))]
+
+
+@pytest.fixture(autouse=True)
+def _clean_seams(monkeypatch):
+    """Every test sets the sharding + panel seams explicitly."""
+    monkeypatch.delenv("CELESTIA_EXTEND_SHARDS", raising=False)
+    monkeypatch.delenv("CELESTIA_PIPE_PANEL", raising=False)
+    yield
+
+
+def _engage(monkeypatch, shards: int, rows: int):
+    monkeypatch.setenv("CELESTIA_PIPE_PANEL", str(rows))
+    monkeypatch.setenv("CELESTIA_EXTEND_SHARDS", str(shards))
+
+
+class TestShardSeam:
+    def test_env_parse(self, monkeypatch):
+        assert extend_shards() == 0  # unset: off
+        monkeypatch.setenv("CELESTIA_EXTEND_SHARDS", "off")
+        assert extend_shards() == 0
+        monkeypatch.setenv("CELESTIA_EXTEND_SHARDS", "1")
+        assert extend_shards() == 0  # one shard = the unsharded runner
+        monkeypatch.setenv("CELESTIA_EXTEND_SHARDS", "8")
+        assert extend_shards() == 8
+        monkeypatch.setenv("CELESTIA_EXTEND_SHARDS", "auto")
+        assert extend_shards() == 8  # pow2 floor of the 8 forced devices
+        monkeypatch.setenv("CELESTIA_EXTEND_SHARDS", "64")
+        assert extend_shards() == 8  # clamped to the device count
+        monkeypatch.setenv("CELESTIA_EXTEND_SHARDS", "6")
+        assert extend_shards() == 4  # pow2 floor (butterfly + equal slabs)
+        monkeypatch.setenv("CELESTIA_EXTEND_SHARDS", "banana")
+        assert extend_shards() == 0  # malformed: off, loudly
+
+    def test_engagement_requires_panel_seam_and_enough_rows(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv("CELESTIA_EXTEND_SHARDS", "8")
+        assert shards_for_k(64) == 0  # panel seam off: nothing to shard
+        monkeypatch.setenv("CELESTIA_PIPE_PANEL", "4")
+        assert shards_for_k(64) == 8
+        assert shards_for_k(8) == 8
+        assert shards_for_k(4) == 0  # k < mesh: no rows for most devices
+        monkeypatch.setenv("CELESTIA_EXTEND_SHARDS", "2")
+        assert shards_for_k(4) == 2
+
+    def test_mode_routing_is_per_k(self, monkeypatch):
+        from celestia_app_tpu.kernels.fused import (
+            env_base_mode_for_k,
+            pipeline_mode,
+            pipeline_mode_for_k,
+        )
+
+        _engage(monkeypatch, 8, 2)
+        assert pipeline_mode() == "fused"  # k-less callers unchanged
+        assert pipeline_mode_for_k(8) == "sharded_panel"
+        assert env_base_mode_for_k(8) == "sharded_panel"
+        assert pipeline_mode_for_k(4) == "panel"  # k < mesh: panel rung
+        monkeypatch.setenv("CELESTIA_EXTEND_SHARDS", "0")
+        assert pipeline_mode_for_k(8) == "panel"
+
+    def test_local_bounds_short_last_panel(self, monkeypatch):
+        _engage(monkeypatch, 2, 3)
+        # k=8 over 2 shards: 4-row slabs; 3-row panels leave a short
+        # last per-device panel — identical schedule on every device,
+        # no padding anywhere.
+        assert local_panel_bounds(8, 2) == ((0, 3), (3, 4))
+        assert sharded_panel_count(8) == 2
+        _engage(monkeypatch, 4, 2)
+        assert local_panel_bounds(8, 4) == ((0, 2),)
+
+
+class TestShardedParity:
+    """Golden-pinned bit-identity vs the dense full-square pipeline:
+    both RS constructions, shard counts that do and do not divide the
+    panel count, dense and FFT column legs."""
+
+    # The fast tier pins one config per distinctive shape, sized so its
+    # compiled programs are REUSED by the routing/serve/chaos tests
+    # below (the PR 13 budget discipline: every new shard_map config is
+    # ~6 compiles on this image); the slow twin widens the matrix.
+    CASES = [
+        (4, 2, 2, "vandermonde"),   # panels divide evenly (warmup reuses)
+        (8, 2, 3, "vandermonde"),   # short last per-device panel
+        (8, 2, 3, "leopard"),       # same, other construction
+        (8, 8, 2, "vandermonde"),   # one ODS row per device (serve reuses)
+    ]
+    SLOW_CASES = [
+        (8, 4, 2, "leopard"),       # wider mesh, other construction
+        (8, 4, 4, "vandermonde"),   # one panel per slab
+        (16, 4, 3, "leopard"),      # bigger square, uneven panels
+    ]
+
+    @pytest.mark.parametrize("k,shards,rows,construction", CASES)
+    def test_sharded_matches_dense_full_square(self, k, shards, rows,
+                                               construction, monkeypatch):
+        self._pin(k, shards, rows, construction, monkeypatch)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("k,shards,rows,construction", SLOW_CASES)
+    def test_sharded_matches_dense_wide_matrix(self, k, shards, rows,
+                                               construction, monkeypatch):
+        self._pin(k, shards, rows, construction, monkeypatch)
+
+    def _pin(self, k, shards, rows, construction, monkeypatch):
+        _engage(monkeypatch, shards, rows)
+        ods = random_ods(k, seed=k * 31 + shards * 7 + rows)
+        ref = _staged(k, ods, construction)
+        got = sharded_panel_pipeline(k, construction)(ods)
+        for name, a, b in zip(("eds", "row_roots", "col_roots", "droot"),
+                              ref, got):
+            assert np.array_equal(a, np.asarray(b)), \
+                (k, shards, rows, construction, name)
+
+    @pytest.mark.parametrize("construction", [
+        "vandermonde",
+        pytest.param("leopard", marks=pytest.mark.slow),
+    ])
+    def test_fft_leg_all_to_all_columns(self, construction, monkeypatch):
+        """CELESTIA_RS_FFT=on routes the column phase through the
+        all_to_all'd column-blocked butterflies — bytes identical to the
+        dense full-square reference."""
+        k, shards, rows = 8, 4, 3
+        ods = random_ods(k, seed=1207)
+        ref = _staged(k, ods, construction)  # dense, unsharded
+        _engage(monkeypatch, shards, rows)
+        monkeypatch.setenv("CELESTIA_RS_FFT", "on")
+        got = sharded_panel_pipeline(k, construction)(ods)
+        for name, a, b in zip(("eds", "row_roots", "col_roots", "droot"),
+                              ref, got):
+            assert np.array_equal(a, np.asarray(b)), name
+
+    def test_roots_only_twin(self, monkeypatch):
+        _engage(monkeypatch, 2, 3)
+        k = 8
+        ods = random_ods(k, seed=1301)
+        _, rr, cr, droot = _staged(k, ods, "vandermonde")
+        got = sharded_panel_pipeline(k, "vandermonde", roots_only=True)(ods)
+        assert len(got) == 3
+        assert np.array_equal(rr, np.asarray(got[0]))
+        assert np.array_equal(cr, np.asarray(got[1]))
+        assert np.array_equal(droot, np.asarray(got[2]))
+
+    def test_golden_vectors_through_sharded_path(self, monkeypatch):
+        """The reference golden DAH hash (k=2) via the sharded lowering."""
+        from celestia_app_tpu.da.dah import DataAvailabilityHeader
+        from tests.test_fused_pipeline import K2_HASH, _golden_share
+
+        _engage(monkeypatch, 2, 1)
+        k = 2
+        ods = np.frombuffer(
+            b"".join([_golden_share()] * (k * k)), dtype=np.uint8
+        ).reshape(k, k, SHARE_SIZE)
+        _, rr, cr, _ = sharded_panel_pipeline(k)(ods)
+        dah = DataAvailabilityHeader(
+            row_roots=[bytes(r) for r in np.asarray(rr)],
+            column_roots=[bytes(r) for r in np.asarray(cr)],
+        )
+        assert dah.hash() == K2_HASH
+
+
+class TestShardedRouting:
+    def test_compute_routes_and_journals_shards(self, monkeypatch):
+        from celestia_app_tpu.trace import journal
+        from celestia_app_tpu.trace.tracer import traced
+
+        k = 8
+        ods = random_ods(k, seed=77)
+        ref_root = ExtendedDataSquare.compute(ods).data_root()
+        _engage(monkeypatch, 8, 2)
+        before = len(traced().table(journal.TABLE))
+        eds = ExtendedDataSquare.compute(ods)
+        assert eds.data_root() == ref_root
+        rows = [
+            r for r in traced().table(journal.TABLE)[before:]
+            if r["source"] == "compute" and r["k"] == k
+        ]
+        assert rows and rows[-1]["mode"] == "sharded_panel"
+        assert rows[-1]["shards"] == 8
+        assert rows[-1]["panels"] == 1  # one step per 1-row slab
+
+    def test_eds_output_carries_committed_sharding(self, monkeypatch):
+        from celestia_app_tpu.kernels.panel_sharded import extend_mesh
+        from celestia_app_tpu.parallel.mesh import EXTEND_AXIS, row_sharding3
+
+        _engage(monkeypatch, 8, 2)  # the (8, 8, 2) programs, reused
+        k = 8
+        eds = ExtendedDataSquare.compute(random_ods(k, seed=78))
+        committed = row_sharding3(extend_mesh(8), EXTEND_AXIS)
+        assert eds._eds.sharding == committed
+        assert len(eds._eds.addressable_shards) == 8
+
+    def test_warmup_warms_sharded_lowering(self, monkeypatch):
+        from celestia_app_tpu.da.eds import pipeline_cache_state, warmup
+        from celestia_app_tpu.trace import journal
+        from celestia_app_tpu.trace.tracer import traced
+
+        _engage(monkeypatch, 2, 2)
+        k = 4
+        warmup([k])
+        assert pipeline_cache_state(k) == "hit"
+        rows = [
+            r for r in traced().table(journal.TABLE)
+            if r["source"] == "warmup" and r["k"] == k
+        ]
+        assert rows and rows[-1]["mode"] == "sharded_panel"
+        assert rows[-1]["shards"] == 2
+
+    def test_extra_warmup_accepts_k4096(self, monkeypatch):
+        from celestia_app_tpu.da.eds import extra_warmup_sizes
+
+        monkeypatch.setenv("CELESTIA_WARMUP_K", "4096 8192")
+        assert extra_warmup_sizes() == [4096]  # the raised codec ceiling
+
+    def test_stream_pipeline_journals_shards(self, monkeypatch):
+        """BlockPipeline under the sharded seam: batching forced off,
+        the host slot handed through whole, journal rows carry the mesh
+        width, roots bit-identical to the materializing path."""
+        from celestia_app_tpu.parallel.pipeline import (
+            BlockPipeline,
+            stream_blocks,
+        )
+        from celestia_app_tpu.trace import journal
+        from celestia_app_tpu.trace.tracer import traced
+
+        k = 8
+        odss = [(i, random_ods(k, seed=300 + i)) for i in range(2)]
+        refs = {t: ExtendedDataSquare.compute(o).data_root()
+                for t, o in odss}
+        _engage(monkeypatch, 8, 4)
+        pipe = BlockPipeline(k, depth=2, batch=4)
+        assert pipe.batch == 1  # sharded squares never coalesce
+        pipe.close()
+        before = len(traced().table(journal.TABLE))
+        for tag, eds in stream_blocks(iter(odss), k, depth=2):
+            assert eds.data_root() == refs[tag], tag
+        rows = [
+            r for r in traced().table(journal.TABLE)[before:]
+            if r["source"] == "stream" and r["k"] == k
+        ]
+        assert rows and all(r["mode"] == "sharded_panel" for r in rows)
+        assert all(r.get("shards") == 8 for r in rows)
+
+
+class TestShardedServe:
+    """The retained sharded EDS serves proofs from the owning shard's
+    buffer — no reshard (pointer-pinned), parity quadrants included."""
+
+    def _entries(self, monkeypatch, k=8, seed=1):
+        from celestia_app_tpu.serve.cache import ForestCache
+
+        ods = det_square(k, seed=seed)
+        monkeypatch.setenv("CELESTIA_EXTEND_SHARDS", "0")
+        monkeypatch.delenv("CELESTIA_PIPE_PANEL", raising=False)
+        ref = ExtendedDataSquare.compute(ods, "vandermonde")
+        single = ForestCache(heights=4, spill=4).put(0, ref)
+        _engage(monkeypatch, 8, 2)
+        eds = ExtendedDataSquare.compute(ods, "vandermonde")
+        entry = ForestCache(heights=4, spill=4).put(1, eds)
+        return entry, single, eds
+
+    def test_share_reads_from_owning_shard_pointer_pinned(
+        self, monkeypatch
+    ):
+        from celestia_app_tpu.rpc.codec import to_jsonable
+        from celestia_app_tpu.serve.api import render
+        from celestia_app_tpu.serve.sampler import ProofSampler
+
+        entry, single, eds = self._entries(monkeypatch)
+        assert entry.share_shards == 8
+        assert single.share_shards == 0
+        buf = entry.eds._eds
+        ptrs = [s.data.unsafe_buffer_pointer()
+                for s in buf.addressable_shards]
+        sampler = ProofSampler()
+        k = entry.k
+        n = 2 * k
+        # Every quadrant, corners included (data AND parity coordinates).
+        coords = sorted({
+            (0, 0), (k - 1, k - 1), (0, n - 1), (k - 1, k),
+            (n - 1, 0), (k, k - 1), (n - 1, n - 1), (k, k), (3, 11),
+        })
+        root = eds.data_root()
+        for axis in ("row", "col"):
+            got = sampler.sample_batch(entry, coords, axis=axis)
+            ref = sampler.sample_batch(single, coords, axis=axis)
+            for (r, c), a, b in zip(coords, got, ref):
+                assert render(to_jsonable(a)) == render(to_jsonable(b)), \
+                    (axis, r, c)
+                assert a.verify(root)
+        # The committed layout never moved: same buffer object, same
+        # per-shard device pointers — the no-reshard pin, on SHARES.
+        assert entry.eds._eds is buf
+        assert [s.data.unsafe_buffer_pointer()
+                for s in buf.addressable_shards] == ptrs
+        from celestia_app_tpu.trace.metrics import registry
+
+        ctr = registry().get("celestia_serve_share_gathers_total")
+        assert ctr is not None
+        assert sum(v for _, v in ctr.samples()) > 0
+
+    def test_golden_digest_through_sharded_share_path(self, monkeypatch):
+        """The canonical k=8 vandermonde sample digest (the same golden
+        tests/test_serve_sharded pins for the forest-sharded plane) —
+        reproduced with the SHARES sharded too."""
+        from celestia_app_tpu.rpc.codec import to_jsonable
+        from celestia_app_tpu.serve.api import render
+        from celestia_app_tpu.serve.sampler import ProofSampler
+
+        entry, _, _ = self._entries(monkeypatch)
+        proof = ProofSampler().sample_batch(entry, [(3, 11)])[0]
+        assert hashlib.sha256(
+            render(to_jsonable(proof))
+        ).hexdigest() == (
+            "43147e47f167ac87c90e408127e212d601e856397dc673d2e265824194fcbd04"
+        )
+
+    def test_spilled_sharded_eds_serves_identical_bytes(self, monkeypatch):
+        from celestia_app_tpu.rpc.codec import to_jsonable
+        from celestia_app_tpu.serve.api import render
+        from celestia_app_tpu.serve.cache import ForestCache
+        from celestia_app_tpu.serve.sampler import ProofSampler
+
+        _engage(monkeypatch, 2, 2)  # the (4, 2, 2) programs, reused
+        k = 4
+        cache = ForestCache(heights=1, spill=2)
+        eds = ExtendedDataSquare.compute(det_square(k, seed=9))
+        entry = cache.put(1, eds)
+        assert entry.share_shards == 2
+        sampler = ProofSampler()
+        coords = [(0, 0), (5, 7), (7, 2)]
+        device_bytes = [
+            render(to_jsonable(p))
+            for p in sampler.sample_batch(entry, coords)
+        ]
+        cache.put(2, ExtendedDataSquare.compute(det_square(k, seed=10)))
+        spilled, tier = cache.get(1)
+        assert tier == "host" and spilled is entry
+        assert entry.share_shards == 0  # one host buffer now
+        assert [
+            render(to_jsonable(p))
+            for p in sampler.sample_batch(entry, coords)
+        ] == device_bytes
+
+    def test_namespace_range_routed_through_sharded_shares(
+        self, monkeypatch
+    ):
+        """GetSharesByNamespace's range fetch rides the same routed
+        share gather: one dispatch, no whole-square host
+        materialization, bytes identical to the unsharded plane."""
+        from celestia_app_tpu.proof.share_proof import (
+            new_namespace_proof,
+            ods_namespace_range,
+        )
+
+        entry, single, eds = self._entries(monkeypatch, seed=2)
+        ns_grid = eds.ods_namespaces()
+        namespace = bytes(ns_grid[ns_grid.shape[0] // 2].tobytes())
+        assert ods_namespace_range(eds, namespace) is not None
+        buf = entry.eds._eds
+        ptrs = [s.data.unsafe_buffer_pointer()
+                for s in buf.addressable_shards]
+        got = new_namespace_proof(entry.eds, namespace)
+        ref = new_namespace_proof(single.eds, namespace)
+        assert got is not None and ref is not None
+        assert got == ref
+        assert got.verify(eds.data_root())
+        assert entry.eds._eds is buf
+        assert [s.data.unsafe_buffer_pointer()
+                for s in buf.addressable_shards] == ptrs
+
+    def test_share_gather_fault_degrades_bit_identically(self, monkeypatch):
+        from celestia_app_tpu import chaos
+        from celestia_app_tpu.rpc.codec import to_jsonable
+        from celestia_app_tpu.serve.api import render
+        from celestia_app_tpu.serve.sampler import ProofSampler
+
+        entry, _, _ = self._entries(monkeypatch, seed=3)
+        sampler = ProofSampler()
+        coords = [(0, 0), (3, 11), (15, 15), (8, 0)]
+        baseline = [
+            render(to_jsonable(p))
+            for p in sampler.sample_batch(entry, coords)
+        ]
+        try:
+            chaos.install("seed=5,shard_fail=1.0")
+            got = [
+                render(to_jsonable(p))
+                for p in sampler.sample_batch(entry, coords)
+            ]
+        finally:
+            chaos.uninstall()
+        assert got == baseline
+
+
+class TestBothMeshes:
+    def test_serve_sharded_forests_over_extend_sharded_shares(
+        self, monkeypatch
+    ):
+        """$CELESTIA_SERVE_SHARDS (forest mesh, axis "serve") on top of
+        $CELESTIA_EXTEND_SHARDS (share mesh, axis "extend"): the forest
+        build consumes the extend-sharded EDS and commits its own
+        layout, proofs stay byte-identical to the fully-unsharded
+        plane."""
+        from celestia_app_tpu.rpc.codec import to_jsonable
+        from celestia_app_tpu.serve.api import render
+        from celestia_app_tpu.serve.cache import ForestCache
+        from celestia_app_tpu.serve.sampler import ProofSampler
+        from celestia_app_tpu.serve.shard import ShardedCachedForest
+
+        ods = det_square(8, seed=5)
+        ref = ExtendedDataSquare.compute(ods, "vandermonde")
+        single = ForestCache(heights=2, spill=2).put(0, ref)
+        _engage(monkeypatch, 8, 2)
+        monkeypatch.setenv("CELESTIA_SERVE_SHARDS", "8")
+        eds = ExtendedDataSquare.compute(ods, "vandermonde")
+        entry = ForestCache(heights=2, spill=2).put(1, eds)
+        assert isinstance(entry, ShardedCachedForest)
+        assert entry.share_shards == 8  # shares on the extend mesh
+        assert entry.shards == 8        # forests on the serve mesh
+        sampler = ProofSampler()
+        coords = [(0, 0), (3, 11), (15, 15), (8, 8)]
+        got = [render(to_jsonable(p))
+               for p in sampler.sample_batch(entry, coords)]
+        want = [render(to_jsonable(p))
+                for p in sampler.sample_batch(single, coords)]
+        assert got == want
+
+
+class TestExtendShardChaos:
+    def test_extend_shard_fail_is_a_known_chaos_key(self):
+        from celestia_app_tpu.chaos.spec import parse_spec
+
+        assert parse_spec("extend_shard_fail=0.5") == {
+            "extend_shard_fail": 0.5
+        }
+        with pytest.raises(ValueError):
+            parse_spec("extend_shard_fial=0.5")
+
+    def test_mid_collective_fault_walks_to_panel(self, monkeypatch):
+        """A fault injected between the sharded collective dispatches:
+        the ladder must land on the single-device panel rung with the
+        SAME roots."""
+        from celestia_app_tpu import chaos
+        from celestia_app_tpu.chaos import degrade
+
+        k = 8
+        ods = random_ods(k, seed=550)
+        ref_root = ExtendedDataSquare.compute(ods).data_root()
+        _engage(monkeypatch, 8, 2)
+        degrade.reset_for_tests()
+        # p=0.45 at seed=18: the seeded seam RNG passes the first
+        # sharded dispatches of each attempt and fails the THIRD —
+        # genuinely mid-schedule, not a front-door rejection — on three
+        # consecutive attempts, so the breaker walks the ladder.
+        chaos.install("seed=18,extend_shard_fail=0.45")
+        try:
+            eds = ExtendedDataSquare.compute(ods)
+        finally:
+            chaos.install("")
+            chaos.uninstall()
+        try:
+            assert eds.data_root() == ref_root
+            state = degrade.degraded_state()
+            assert state is not None
+            assert state["device"] != "sharded_panel"
+        finally:
+            degrade.reset_for_tests()
+
+    def test_extend_shard_drill_smoke(self):
+        """The chaos_soak drill end-to-end (tier-1 smoke, forced 8 host
+        devices like test_serve_sharded)."""
+        import scripts.chaos_soak as chaos_soak
+
+        out = chaos_soak.run_extend_shard_drill(k=8, shards=8,
+                                                panel_rows=2)
+        assert out["engaged"] and out["shards"] == 8
+        assert out["ok"], out
+
+
+@pytest.mark.slow
+def test_k4096_roots_only_smoke():
+    """The giant-square smoke at the raised codec ceiling: k=4096
+    roots_only through the sharded panel partition (8 forced host
+    devices; per-device residency = half-EDS/8 + one panel).  Slow-
+    marked from day one — this is the recipe a real chip round runs;
+    on the 1-core CPU fallback it takes hours, not seconds."""
+    os.environ["CELESTIA_PIPE_PANEL"] = "auto"
+    os.environ["CELESTIA_EXTEND_SHARDS"] = "8"
+    try:
+        k = 4096
+        assert shards_for_k(k) == 8
+        ods = np.zeros((k, k, SHARE_SIZE), dtype=np.uint8)
+        ods[..., 0] = 0
+        rr, cr, droot = sharded_panel_pipeline(k, "vandermonde",
+                                               roots_only=True)(ods)
+        assert np.asarray(rr).shape == (2 * k, 90)
+        assert np.asarray(cr).shape == (2 * k, 90)
+        assert np.asarray(droot).shape == (32,)
+    finally:
+        os.environ.pop("CELESTIA_PIPE_PANEL", None)
+        os.environ.pop("CELESTIA_EXTEND_SHARDS", None)
