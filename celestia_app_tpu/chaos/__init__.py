@@ -94,9 +94,9 @@ def rpc_handle() -> None:
         inj.rpc_handle()
 
 
-def mempool_insert() -> bool:
+def mempool_insert(shard: int | None = None) -> bool:
     inj = injector()
-    return inj.mempool_insert() if inj is not None else False
+    return inj.mempool_insert(shard=shard) if inj is not None else False
 
 
 def proof_serve() -> None:

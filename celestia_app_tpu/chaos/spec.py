@@ -11,7 +11,12 @@ to something that can fail:
     gossip.send       one consensus message to one peer (rpc/gossip)
     wal.append        one consensus WAL record append+fsync (consensus/wal)
     rpc.handle        one JSON-RPC request (rpc/server)
-    mempool.insert    one tx admission (mempool)
+    mempool.insert    one tx admission (mempool; fires PER SHARD — each
+                      namespace shard draws from its own seeded RNG
+                      stream, so the injection set a shard sees depends
+                      only on the spec and that shard's admission
+                      ordinals, never on how admissions interleave
+                      across shards/threads)
     proof.serve       one batched DAS proof dispatch (serve/sampler)
 
 Spec grammar — comma-separated `key=value` pairs, e.g.
@@ -166,6 +171,11 @@ class ChaosInjector:
             seam: random.Random(f"celestia-chaos:{self.seed}:{seam}")
             for seam in SEAMS
         }
+        # Per-SHARD streams of the sharded seams (today: mempool.insert),
+        # created lazily per shard index; keyed like the adversary's
+        # per-height streams so each shard's injection sequence is a pure
+        # function of (seed, seam, shard, ordinal).
+        self._shard_rngs: dict[tuple[str, int], random.Random] = {}
         self._torn_remaining = int(self.params.get("wal_torn_tail", 0))
         # Lazily-built protocol adversary (chaos/adversary.py); None when
         # no adversary key is set, so honest paths pay one attr read.
@@ -187,12 +197,20 @@ class ChaosInjector:
     def _p(self, key: str) -> float:
         return float(self.params.get(key, 0.0))
 
-    def _fire(self, seam: str, key: str, default: float = 0.0) -> bool:
+    def _fire(self, seam: str, key: str, default: float = 0.0,
+              shard: int | None = None) -> bool:
         p = float(self.params.get(key, default))
         if p <= 0.0:
             return False
         with self._lock:
-            return p >= 1.0 or self._rngs[seam].random() < p
+            if shard is None:
+                return p >= 1.0 or self._rngs[seam].random() < p
+            rng = self._shard_rngs.get((seam, shard))
+            if rng is None:
+                rng = self._shard_rngs[(seam, shard)] = random.Random(
+                    f"celestia-chaos:{self.seed}:{seam}#{shard}"
+                )
+            return p >= 1.0 or rng.random() < p
 
     def _count(self, seam: str, fault: str) -> None:
         from celestia_app_tpu.trace.metrics import registry
@@ -271,10 +289,13 @@ class ChaosInjector:
             self._count("rpc.handle", "rpc_fail")
             raise ChaosInjected("rpc.handle", "rpc_fail")
 
-    def mempool_insert(self) -> bool:
-        """True when this admission should be transiently rejected."""
+    def mempool_insert(self, shard: int | None = None) -> bool:
+        """True when this admission should be transiently rejected.
+        `shard` selects that namespace shard's OWN seeded RNG stream
+        (None keeps the legacy per-seam stream), so a sharded pool's
+        injection sets are interleaving-independent across shards."""
         self._stall("mempool.insert", "mempool_slow_ms", "mempool_slow")
-        if self._fire("mempool.insert", "mempool_drop"):
+        if self._fire("mempool.insert", "mempool_drop", shard=shard):
             self._count("mempool.insert", "mempool_drop")
             return True
         return False

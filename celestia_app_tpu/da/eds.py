@@ -889,15 +889,28 @@ class ExtendedDataSquare:
             ).reshape(self.k * self.k, NAMESPACE_SIZE)
         return cached
 
-    def row_roots(self) -> list[bytes]:
-        rr = np.asarray(self._row_roots)
+    @staticmethod
+    def _roots_list(roots) -> list[bytes]:
+        """Roots as a list of bytes, WITHOUT a numpy S-dtype round trip:
+        `np.asarray([...bytes...])` infers a fixed-width 'S' dtype whose
+        scalars STRIP trailing 0x00 bytes, so any root ending in a zero
+        byte (1 in 256) came back one byte short on handles constructed
+        from Python lists — the swarm harness's per-leg handles served
+        proofs that could never verify on exactly those lines."""
+        if isinstance(roots, (list, tuple)):
+            return [bytes(r) for r in roots]
+        rr = np.asarray(roots)
         return [rr[i].tobytes() for i in range(rr.shape[0])]
 
+    def row_roots(self) -> list[bytes]:
+        return self._roots_list(self._row_roots)
+
     def col_roots(self) -> list[bytes]:
-        cr = np.asarray(self._col_roots)
-        return [cr[i].tobytes() for i in range(cr.shape[0])]
+        return self._roots_list(self._col_roots)
 
     def data_root(self) -> bytes:
+        if isinstance(self._data_root, (bytes, bytearray)):
+            return bytes(self._data_root)  # no S-dtype trailing-NUL strip
         return np.asarray(self._data_root).tobytes()
 
 

@@ -356,6 +356,25 @@ class _ApiHandler(BaseHTTPRequestHandler):
             except _BadRequest as e:
                 self._respond(400, {"code": 3, "message": str(e)})
             except Exception as e:  # noqa: BLE001 — gateway internal error
+                from celestia_app_tpu.qos import (
+                    QosThrottled,
+                    retry_after_header,
+                    throttle_body,
+                )
+
+                if isinstance(e, QosThrottled):
+                    # Per-tenant QoS refusal: 429 + qos.py's ONE
+                    # canonical body — the same bytes the JSON-RPC plane
+                    # serves and the gRPC plane carries as its
+                    # RESOURCE_EXHAUSTED detail.
+                    raw = throttle_body(e)
+                    self.send_response(429)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(raw)))
+                    self.send_header("Retry-After", retry_after_header(e))
+                    self.end_headers()
+                    self.wfile.write(raw)
+                    return
                 self._respond(500, {"code": 13,
                                     "message": f"{type(e).__name__}: {e}"})
             return

@@ -204,6 +204,19 @@ class _Abort(Exception):
         super().__init__(details)
 
 
+def _qos_abort(e: Exception) -> Exception:
+    """Map a per-tenant QoS refusal (qos.QosThrottled) onto the typed
+    RESOURCE_EXHAUSTED abort whose detail string is qos.py's ONE
+    canonical payload — the very bytes the HTTP planes serve as their
+    429 bodies, so the three planes stay byte-identical.  Any other
+    exception passes through unchanged."""
+    from celestia_app_tpu.qos import QosThrottled, throttle_body
+
+    if isinstance(e, QosThrottled):
+        return _Abort("RESOURCE_EXHAUSTED", throttle_body(e).decode())
+    return e
+
+
 def _tx_hash_bytes(txhash: str) -> bytes:
     """Validate and decode a client-supplied hex tx hash, stripping
     whitespace and accepting either case; INVALID_ARGUMENT on anything
@@ -244,7 +257,10 @@ def _handlers(node) -> dict:
         # commits it (trace/context.py; resolvable via /trace_tables/spans
         # on the debug sidecar).
         with use_context(new_context(layer="rpc", plane="grpc")):
-            res = node.broadcast(tx_bytes)
+            try:
+                res = node.broadcast(tx_bytes)
+            except Exception as e:
+                raise _qos_abort(e) from None
         import hashlib
 
         txhash = hashlib.sha256(tx_bytes).hexdigest().upper()
@@ -682,6 +698,11 @@ def _handlers(node) -> dict:
             raise _Abort("DATA_LOSS", str(e)) from None
         except (TypeError, ValueError) as e:
             raise _Abort("INVALID_ARGUMENT", str(e)) from None
+        except Exception as e:
+            # The HTTP planes' 429: a per-tenant proof-rate limit refused
+            # this read (qos.py) — RESOURCE_EXHAUSTED carrying the same
+            # canonical bytes.  Anything else keeps propagating.
+            raise _qos_abort(e) from None
         from celestia_app_tpu.serve.api import count_served, render
 
         # Counted where the payload dict is in hand: the per-tenant

@@ -132,11 +132,19 @@ class ServingNode(TestNode):
         (reference: mempool v1 gossip, app/default_overrides.go:258-284).
         `ctx` is the request's TraceContext (threaded into the mempool
         entry; see trace/context.py).
+
+        Locking: the node lock is held only around CheckTx (inside
+        super().broadcast — app check state is the remaining serial
+        section); the mempool admission runs under the pool's own
+        per-shard locks, so concurrent broadcasts of DIFFERENT tenants
+        no longer serialize end-to-end.  The newly-admitted probe is a
+        before/after residency read: a same-tx race can at worst relay
+        twice (the flood's dedup absorbs it) or skip one relay hop (the
+        re-offer path recovers it) — both documented best-effort.
         """
-        with self.lock:
-            known = self.mempool.has_tx(raw_tx)
-            res = super().broadcast(raw_tx, ctx=ctx)
-            inserted = not known and res.code == 0 and self.mempool.has_tx(raw_tx)
+        known = self.mempool.has_tx(raw_tx)
+        res = super().broadcast(raw_tx, ctx=ctx)
+        inserted = not known and res.code == 0 and self.mempool.has_tx(raw_tx)
         if inserted and relay:
             def _relay():
                 for peer in self.peers():
@@ -1346,6 +1354,25 @@ class _Handler(BaseHTTPRequestHandler):
             body = {"jsonrpc": "2.0", "id": req.get("id"), "result": result}
             status = 200
         except Exception as e:  # noqa: BLE001 — every fault becomes an RPC error
+            from celestia_app_tpu.qos import (
+                QosThrottled,
+                retry_after_header,
+                throttle_body,
+            )
+
+            if isinstance(e, QosThrottled):
+                # Per-tenant QoS refusal: HTTP 429 carrying qos.py's ONE
+                # canonical payload (the /das route discipline — the REST
+                # twin serves the very same bytes, the gRPC plane the same
+                # string as its RESOURCE_EXHAUSTED detail).
+                payload = throttle_body(e)
+                self.send_response(429)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.send_header("Retry-After", retry_after_header(e))
+                self.end_headers()
+                self.wfile.write(payload)
+                return
             body = {
                 "jsonrpc": "2.0",
                 "id": None,

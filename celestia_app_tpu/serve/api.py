@@ -213,6 +213,18 @@ class DasProvider:
                 f"namespace must be {NAMESPACE_SIZE} bytes, "
                 f"got {len(namespace)}"
             )
+        # Read-path QoS: a namespace query names its tenant up front, so
+        # the proof-rate gate runs BEFORE any gather work (the sampler's
+        # share_proof twin charges the served share's label instead).
+        from celestia_app_tpu import qos
+        from celestia_app_tpu.trace.square_journal import (
+            capped_namespace_label,
+            namespace_label,
+        )
+
+        enf = qos.enforcer()
+        if enf is not None:
+            enf.admit_proof(capped_namespace_label(namespace_label(namespace)))
         entry = self.entry(height)
         rng = ods_namespace_range(entry.eds, namespace)
         payload: dict = {

@@ -201,6 +201,30 @@ def _check_withheld(entry, coords) -> None:
             raise ShareWithheld(height, int(row), int(col))
 
 
+def _qos_gate_sample(entry, row: int, col: int) -> None:
+    """The read-path per-tenant proof-rate gate (qos.py), resolved from
+    the sampled coordinate's OWN namespace bytes pre-gather.  One cached
+    env compare when enforcement is off; parity quadrants never carry a
+    tenant."""
+    from celestia_app_tpu import qos
+
+    enf = qos.enforcer()
+    if enf is None or row >= entry.k or col >= entry.k:
+        return
+    # One memoized device read per HANDLE (ods_namespaces), then a pure
+    # host index per request: refusing an over-limit tenant must cost
+    # less than the gather it sheds, or throttling is no protection.
+    ns = bytes(entry.eds.ods_namespaces()[row * entry.k + col].tobytes())
+    if ns == PARITY_NAMESPACE_BYTES:
+        return
+    from celestia_app_tpu.trace.square_journal import (
+        capped_namespace_label,
+        namespace_label,
+    )
+
+    enf.admit_proof(capped_namespace_label(namespace_label(ns)))
+
+
 def _verify_gate_armed(entry) -> bool:
     """Proof verification before serving: armed when an adversary is
     tampering with served state, or unconditionally via
@@ -230,6 +254,14 @@ class ProofSampler:
         # withheld coordinate must fail that caller, never its
         # batch-mates (a real server refuses one share, not the batch).
         _check_withheld(entry, [(row, col)])
+        # Read-path QoS ($CELESTIA_QOS <tenant>.proof_rate) BEFORE the
+        # gather: the tenant is the sampled share's own namespace (one
+        # 29-byte read off the entry — the PR 10 label, resolved early),
+        # so an over-limit spammer is refused at share-read cost instead
+        # of after a full proof build it would make everyone else queue
+        # behind.  Parity-quadrant coordinates carry no tenant and are
+        # never throttled (uniform DAS sampling is protocol traffic).
+        _qos_gate_sample(entry, row, col)
         p = _Pending(entry, row, col, axis)
         with self._lock:
             self._queue.append(p)

@@ -122,17 +122,28 @@ class TestNode:
         ns_lbl = tx_namespace_label(raw_tx)
         if ns_lbl is not None and ctx.baggage.get("namespace") != ns_lbl:
             ctx = ctx.child(namespace=ns_lbl)
+        # CheckTx still serializes on the app's check state (a node lock
+        # when the subclass has one), but the mempool admission below runs
+        # under the pool's OWN per-shard locks (mempool.py) — concurrent
+        # BroadcastTx admission no longer holds the node lock end-to-end.
+        from contextlib import nullcontext
+
+        check_lock = getattr(self, "lock", None) or nullcontext()
         with use_context(ctx), trace_span(
             "tx_submit", layer="rpc", e2e="submit", tx_bytes=len(raw_tx),
         ) as sp:
-            res = self.app.check_tx(raw_tx)
+            with check_lock:
+                res = self.app.check_tx(raw_tx)
+                height = self.app.height
             sp["result"] = str(res.code)
             if res.code == 0:
                 priority = next(
                     (e[1] for e in res.events if e[0] == "priority"), 0
                 )
+                # May raise qos.QosThrottled ($CELESTIA_QOS): the planes
+                # render it 429 / RESOURCE_EXHAUSTED byte-identically.
                 self.mempool.insert(
-                    raw_tx, priority, self.app.height, ctx=current_context(),
+                    raw_tx, priority, height, ctx=current_context(),
                     ns=ns_lbl or "tx",  # already parsed above; don't re-parse
                 )
         return res

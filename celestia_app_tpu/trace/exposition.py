@@ -114,6 +114,14 @@ def health_payload() -> dict:
     heal = heal_health_block()
     if heal is not None:
         payload["heal"] = heal
+    # The enforcement face (qos.py): configured per-tenant limits, tokens
+    # remaining, throttle counts — absent when no $CELESTIA_QOS policy is
+    # installed (presence means enforcement, like the heal block).
+    from celestia_app_tpu import qos
+
+    qos_block = qos.health_block()
+    if qos_block is not None:
+        payload["qos"] = qos_block
     if providers:
         layers = {}
         for name, provider in sorted(providers.items()):
@@ -223,6 +231,24 @@ def _das_response(kind: str, query: str, plane: str):
     except (TypeError, ValueError) as e:
         return 400, "application/json", json.dumps({"error": str(e)}).encode()
     except Exception as e:  # noqa: BLE001 — a proof fault must not kill the probe port
+        from celestia_app_tpu.qos import (
+            QosThrottled,
+            retry_after_header,
+            throttle_body,
+        )
+
+        if isinstance(e, QosThrottled):
+            # 429 + Retry-After: a per-tenant proof-rate limit (qos.py)
+            # refused this read.  The body is qos.py's ONE canonical
+            # payload, so the JSON-RPC and REST GET /das twins stay
+            # byte-identical; the gRPC Das service maps the same
+            # condition to RESOURCE_EXHAUSTED carrying the same string.
+            return (
+                429,
+                "application/json",
+                throttle_body(e),
+                {"Retry-After": retry_after_header(e)},
+            )
         return 500, "application/json", json.dumps(
             {"error": f"{type(e).__name__}: {e}"}
         ).encode()

@@ -423,15 +423,39 @@ class SLOEngine:
 
 _ENGINE = SLOEngine()
 _ENGINE_LOCK = threading.Lock()
+#: Per-tenant SLOSpecs installed by the QoS layer (qos.py
+#: `<tenant>.slo_p99_ms`): evaluated NEXT TO the shipped defaults.
+_TENANT_SPECS: tuple[SLOSpec, ...] = ()
 
 
 def engine() -> SLOEngine:
     return _ENGINE
 
 
+def tenant_specs() -> tuple[SLOSpec, ...]:
+    return _TENANT_SPECS
+
+
+def set_tenant_specs(specs: tuple[SLOSpec, ...]) -> SLOEngine:
+    """Swap the per-tenant SLO tier (the observe -> enforce wire from
+    qos.py): rebuilds the engine over default_slos() + the tenant specs.
+    Config changes drop the rolling windows — a tenant objective
+    evaluated over windows collected under a different spec set would
+    page on stale arithmetic."""
+    global _ENGINE, _TENANT_SPECS
+    specs = tuple(specs)
+    with _ENGINE_LOCK:
+        if specs == _TENANT_SPECS:
+            return _ENGINE
+        _TENANT_SPECS = specs
+        _ENGINE = SLOEngine(default_slos() + specs)
+    return _ENGINE
+
+
 def _reset_for_tests(specs: tuple[SLOSpec, ...] | None = None) -> SLOEngine:
     """Swap in a fresh engine (drops windows, page state, results)."""
-    global _ENGINE
+    global _ENGINE, _TENANT_SPECS
     with _ENGINE_LOCK:
+        _TENANT_SPECS = ()
         _ENGINE = SLOEngine(specs)
     return _ENGINE

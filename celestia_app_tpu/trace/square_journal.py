@@ -235,12 +235,22 @@ def namespaces_payload() -> dict:
         }
         last = dict(_LAST) if _LAST is not None else None
         admitted = len(_ADMITTED)
-    return {
+    payload = {
         "top_n": namespace_top_n(),
         "admitted": admitted,
         "namespaces": totals,
         "last_square": last,
     }
+    # Enforcement fields (qos.py): per-tenant limits / tokens remaining /
+    # throttle counts, present only when a $CELESTIA_QOS policy is
+    # installed — the /namespaces page then answers both "who is using
+    # the square" AND "who is being held to what".
+    from celestia_app_tpu import qos
+
+    enf = qos.enforcer()
+    if enf is not None:
+        payload["qos"] = enf.health_block()
+    return payload
 
 
 def _reset_for_tests() -> None:

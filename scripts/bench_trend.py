@@ -91,7 +91,16 @@ STREAM_BATCH_MODES = ("stream_b1", "stream_b2", "stream_b4")
 # dominated by link quality.  `repair_grouped` (the frozen per-pattern-
 # group baseline bench.py re-measures at k=128 for the speedup record)
 # stays ungated: it exists to be compared against, not to regress.
-GATED_MODES = ("compute", "repair") + STREAM_BATCH_MODES
+#
+# `mempool_sharded` (bench.py BENCH_MODE=mempool, the concurrent-
+# broadcast admission A/B at k=<threads>) gates like a rate under the
+# same-platform rule; `mempool_global` — the frozen single-lock baseline
+# rung the A/B measures against — stays ungated like repair_grouped: it
+# exists to be compared against, not to regress.  Both are opt-in rows
+# (only BENCH_MODE=mempool produces them), so absence from a default-
+# plan round is a plan gap, never STALE.
+GATED_MODES = ("compute", "repair", "mempool_sharded") + STREAM_BATCH_MODES
+MEMPOOL_MODES = ("mempool_sharded", "mempool_global")
 # The multi-chip extend sweep rows (bench.py BENCH_MODE=compute_sharded,
 # kernels/panel_sharded): mode compute_sharded<N>, one series PER SHARD
 # COUNT — each N gates against prior rounds carrying the same N under
@@ -608,6 +617,125 @@ def find_adv_regressions(adv_rounds: list[dict], threshold_pct: float) -> list[d
     return out
 
 
+# --- QoS enforcement rounds (scripts/das_loadgen.py --qos-out) ---------------
+
+def load_qos_round(path: str) -> dict:
+    """One QOS_rNN.json (schema qos-v1): the swarm harness's whale +
+    small-tenants + spammer run under a $CELESTIA_QOS policy — a
+    `baseline` leg (no spammer) and a `spam` leg (spammer at a multiple
+    of its proof-rate limit) over the SAME open-loop plan, each with
+    per-tenant served/throttled/p99/slo_burn columns.  Malformed files
+    exit 2 like any other round — a half-written enforcement record must
+    not gate on garbage."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            raw = json.load(f)
+    except (OSError, ValueError) as e:
+        raise MalformedRound(f"{path}: not readable JSON: {e}") from e
+    for key in ("n", "schema", "legs", "spam_tenant"):
+        if key not in raw:
+            raise MalformedRound(f"{path}: missing required key {key!r}")
+    legs = raw["legs"]
+    for leg in ("baseline", "spam"):
+        if not isinstance(legs.get(leg), dict):
+            raise MalformedRound(f"{path}: missing leg {leg!r}")
+        tenants = legs[leg].get("tenants")
+        if not isinstance(tenants, dict) or not tenants:
+            raise MalformedRound(f"{path}: leg {leg!r} has no tenants")
+        for tenant, cols in tenants.items():
+            for col in ("served", "throttled", "slo_burn"):
+                if not isinstance(cols, dict) or cols.get(col) is None:
+                    raise MalformedRound(
+                        f"{path}: leg {leg!r} tenant {tenant!r} missing "
+                        f"{col!r}"
+                    )
+    if raw["spam_tenant"] not in legs["spam"]["tenants"]:
+        raise MalformedRound(
+            f"{path}: spam_tenant {raw['spam_tenant']!r} absent from the "
+            "spam leg's tenant columns"
+        )
+    return {
+        "round": int(raw["n"]),
+        "path": os.path.basename(path),
+        "platform": raw.get("platform"),
+        "k": raw.get("k"),
+        "spam_tenant": str(raw["spam_tenant"]),
+        "legs": legs,
+    }
+
+
+def load_qos_series(paths: list[str]) -> list[dict]:
+    """[] when no QoS round exists yet (the series is additive)."""
+    return sorted((load_qos_round(p) for p in paths), key=lambda r: r["round"])
+
+
+def find_qos_regressions(qos_rounds: list[dict],
+                         threshold_pct: float) -> list[dict]:
+    """QoS rounds gate on INVARIANTS of the newest round (no priors
+    needed — the enforcement story must hold per round):
+
+      * the spammer was actually throttled (an enforcement record where
+        nothing got enforced recorded nothing);
+      * every HONEST tenant's SLO burn in the spam leg is no worse than
+        its baseline-leg burn (small absolute slack for quantization:
+        one violation in a small sample moves burn in steps);
+      * every honest tenant's p99 in the spam leg is no worse than
+        baseline + the gate threshold (+ a 5 ms absolute floor for
+        clock noise on fast samples).
+    """
+    out = []
+    if not qos_rounds:
+        return out
+    newest = qos_rounds[-1]
+    rnd = newest["round"]
+    spam_cols = newest["legs"]["spam"]["tenants"][newest["spam_tenant"]]
+    if not spam_cols.get("throttled"):
+        out.append({
+            "series": "qos.spammer_throttled", "unit": "invariant",
+            "round": rnd, "value": 0, "best_prior": ">0",
+            "worse_pct": 100.0, "allowed_pct": 0.0,
+        })
+    base = newest["legs"]["baseline"]["tenants"]
+    spam = newest["legs"]["spam"]["tenants"]
+    for tenant in sorted(set(base) & set(spam)):
+        if tenant == newest["spam_tenant"]:
+            continue  # the spammer's own numbers are the enforcement
+        b, s = base[tenant], spam[tenant]
+        burn_ceiling = max(float(b["slo_burn"]) * (1 + threshold_pct / 100),
+                           float(b["slo_burn"]) + 0.5)
+        if float(s["slo_burn"]) > burn_ceiling:
+            out.append({
+                "series": f"qos.{tenant}.slo_burn", "unit": "burn",
+                "round": rnd, "value": float(s["slo_burn"]),
+                "best_prior": float(b["slo_burn"]),
+                "worse_pct": round(
+                    (float(s["slo_burn"]) - float(b["slo_burn"]))
+                    / max(float(b["slo_burn"]), 1e-9) * 100.0, 2),
+                "allowed_pct": round(threshold_pct, 2),
+            })
+        bp, sp = b.get("p99_ms"), s.get("p99_ms")
+        if bp is not None and sp is not None:
+            # Per-tenant p99 over ~10^2 samples is the single worst
+            # observation; the small-sample allowance (2x + 20 ms
+            # scheduler-noise floor) keeps the gate about enforcement
+            # failures, not about which sample drew the worst timeslice.
+            p99_ceiling = max(
+                float(bp) * (1 + threshold_pct / 100) + 5.0,
+                float(bp) * 2.0 + 20.0,
+            )
+            if float(sp) > p99_ceiling:
+                out.append({
+                    "series": f"qos.{tenant}.p99_ms", "unit": "ms",
+                    "round": rnd, "value": float(sp),
+                    "best_prior": float(bp),
+                    "worse_pct": round(
+                        (float(sp) - float(bp)) / max(float(bp), 1e-9)
+                        * 100.0, 2),
+                    "allowed_pct": round(threshold_pct, 2),
+                })
+    return out
+
+
 # --- trend assembly ---------------------------------------------------------
 
 def mode_series(rounds: list[dict]) -> dict[tuple[str, int], list[tuple[int, float]]]:
@@ -795,10 +923,11 @@ def stale_gated_series(
         if pts[-1][0] < newest:
             entry = {"series": f"{mode}@{k}", "last_round": pts[-1][0],
                      "newest_round": newest}
-            if k > DEFAULT_PLAN_MAX_K or sharded:
+            if (k > DEFAULT_PLAN_MAX_K or sharded
+                    or mode in MEMPOOL_MODES):
                 # Opt-in series (explicit BENCH_K / BENCH_MODE=
-                # compute_sharded): absence from a default-plan round is
-                # a plan gap, never STALE.
+                # compute_sharded / BENCH_MODE=mempool): absence from a
+                # default-plan round is a plan gap, never STALE.
                 entry["opt_in"] = True
             out.append(entry)
     for name, pts in sorted(parts_series(rounds).items()):
@@ -942,10 +1071,15 @@ def main(argv: list[str] | None = None) -> int:
         [] if args.files
         else sorted(glob.glob(os.path.join(args.dir, "ADV_r*.json")))
     )
+    qos_paths = (
+        [] if args.files
+        else sorted(glob.glob(os.path.join(args.dir, "QOS_r*.json")))
+    )
     try:
         rounds = load_series(paths)
         das_rounds = load_das_series(das_paths)
         adv_rounds = load_adv_series(adv_paths)
+        qos_rounds = load_qos_series(qos_paths)
     except MalformedRound as e:
         print(f"bench_trend: MALFORMED: {e}", file=sys.stderr)
         return 2
@@ -964,6 +1098,7 @@ def main(argv: list[str] | None = None) -> int:
     )
     regressions += find_das_regressions(das_rounds, args.threshold)
     regressions += find_adv_regressions(adv_rounds, args.threshold)
+    regressions += find_qos_regressions(qos_rounds, args.threshold)
     das_gaps = das_plan_gaps(das_rounds)
     stale = stale_gated_series(rounds, gate_all=args.all_series)
     seats = seat_changes(rounds)
@@ -975,6 +1110,7 @@ def main(argv: list[str] | None = None) -> int:
             "rounds": [r["round"] for r in rounds],
             "das_rounds": [r["round"] for r in das_rounds],
             "adv_rounds": [r["round"] for r in adv_rounds],
+            "qos_rounds": [r["round"] for r in qos_rounds],
             "regressions": regressions,
             "stale": [s for s in stale
                       if not s.get("hw_gated") and not s.get("opt_in")],
@@ -1006,6 +1142,22 @@ def main(argv: list[str] | None = None) -> int:
                       f"(p99 {worst[1]['p99_ms']} ms)")
         for gap in das_gaps:
             print(f"  NOTE: {gap}")
+        for r in qos_rounds:
+            spam = r["legs"]["spam"]["tenants"][r["spam_tenant"]]
+            honest = [
+                t for t in r["legs"]["spam"]["tenants"]
+                if t != r["spam_tenant"]
+            ]
+            worst = max(
+                (float(r["legs"]["spam"]["tenants"][t]["slo_burn"])
+                 for t in honest),
+                default=0.0,
+            )
+            print(f"  qos r{r['round']:02d}: spammer {r['spam_tenant']} "
+                  f"throttled={spam.get('throttled')} "
+                  f"served={spam.get('served')}; honest tenants "
+                  f"{len(honest)}, worst spam-leg burn {worst}"
+                  + (f"  [{r['platform']}]" if r.get("platform") else ""))
         for r in adv_rounds:
             rep = r["repair"]
             print(f"  adv r{r['round']:02d}: monotone={r['all_monotone']} "

@@ -1576,6 +1576,142 @@ def run_batched_fault_drill(k: int = 4, blocks: int = 6,
     }
 
 
+def run_qos_drill(budget: int = 40_960, quantum: int = 1024,
+                  shards: int = 8) -> dict:
+    """QoS enforcement drill — the observe -> enforce loop's write path.
+
+    One sharded mempool under a $CELESTIA_QOS policy: a spammer
+    namespace fires admissions at 10x its rate limit while a whale and
+    a small honest tenant submit under theirs (the PR 13 swarm's
+    whale + small-tenants + spammer mix, mempool-level).  Invariants:
+
+      * the spammer is throttled (QosThrottled — the refusal every
+        plane renders 429 / RESOURCE_EXHAUSTED), honest tenants never;
+      * honest tenants' DRR reap share is unchanged by the spam leg:
+        the small tenant's reaped set is IDENTICAL, the whale's count
+        moves by no more than the spammer's admitted budget share;
+      * the per-namespace mempool gauges reconcile EXACTLY across
+        shards after every insert / reap / committed-drop / TTL path.
+    """
+    from celestia_app_tpu import qos
+    from celestia_app_tpu.mempool import PriorityMempool
+    from celestia_app_tpu.qos import QosThrottled
+    from celestia_app_tpu.trace.metrics import registry
+
+    WHALE, SMALL, SPAM = "aa", "bb", "ee"
+    # The drill's tenants must OWN their labels: in-suite (the tier-1
+    # smoke) the process-level top-N admission set may already be full,
+    # which would fold every tenant into `other` and collapse the very
+    # fairness arbitration under drill.
+    from celestia_app_tpu.trace import square_journal
+
+    square_journal._reset_for_tests()
+    saved_q = os.environ.get("CELESTIA_MEMPOOL_QUANTUM")
+    os.environ["CELESTIA_MEMPOOL_QUANTUM"] = str(quantum)
+    qos.install(f"{SPAM}.tx_rate=5,{SPAM}.tx_burst=10")
+
+    def gauges_reconcile(mp) -> bool:
+        """Registry per-namespace gauges == the pool's cross-shard sums
+        (drained tenants must read 0, never a stale positive)."""
+        truth: dict[str, list[int]] = {}
+        for s in mp._shards:
+            for lbl, (n, b) in s.ns_depth.items():
+                agg = truth.setdefault(lbl, [0, 0])
+                agg[0] += n
+                agg[1] += b
+        for name, col in (("celestia_mempool_namespace_txs", 0),
+                          ("celestia_mempool_namespace_size_bytes", 1)):
+            fam = registry().get(name)
+            if fam is None:
+                return False
+            for labels, value in fam.samples():
+                lbl = labels.get("namespace")
+                if lbl in (WHALE, SMALL, SPAM):
+                    if value != truth.get(lbl, [0, 0])[col]:
+                        return False
+        return True
+
+    def leg(spam: bool) -> dict:
+        mp = PriorityMempool(ttl_num_blocks=1, shards=shards)
+        throttled = {WHALE: 0, SMALL: 0, SPAM: 0}
+
+        def ins(ns, i, size, prio):
+            tx = f"{ns}:{i}".encode().ljust(size, b".")
+            try:
+                mp.insert(tx, prio, 0, ns=ns)
+            except QosThrottled:
+                throttled[ns] += 1
+
+        # The whale outranks everyone on priority AND oversubscribes the
+        # reap budget alone — exactly the mix pure-priority reaping
+        # starves small tenants under.
+        for i in range(30):
+            ins(WHALE, i, 2048, 100)
+        for i in range(10):
+            ins(SMALL, i, 1024, 1)
+        if spam:
+            for i in range(100):  # 10x the spammer's burst, immediately
+                ins(SPAM, i, 256, 50)
+        ok_gauges = gauges_reconcile(mp)
+        reaped = mp.reap(budget)
+        by_ns = {WHALE: [], SMALL: [], SPAM: []}
+        for tx in reaped:
+            by_ns[tx.split(b":", 1)[0].decode()].append(tx)
+        # Commit the reaped set, then age everything else out (TTL=1):
+        # both removal paths must leave the gauges reconciled.
+        mp.update(1, reaped)
+        ok_gauges = ok_gauges and gauges_reconcile(mp)
+        mp.update(2, [])
+        ok_gauges = ok_gauges and len(mp) == 0 and gauges_reconcile(mp)
+        return {"throttled": throttled, "by_ns": by_ns,
+                "gauges_reconcile": ok_gauges}
+
+    try:
+        honest = leg(spam=False)
+        spammed = leg(spam=True)
+    finally:
+        qos.uninstall()
+        if saved_q is None:
+            os.environ.pop("CELESTIA_MEMPOOL_QUANTUM", None)
+        else:
+            os.environ["CELESTIA_MEMPOOL_QUANTUM"] = saved_q
+
+    spam_admitted_bytes = 100 * 256 - spammed["throttled"][SPAM] * 256
+    whale_slack = -(-spam_admitted_bytes // 2048)  # ceil, in whale txs
+    small_identical = (
+        honest["by_ns"][SMALL] == spammed["by_ns"][SMALL]
+    )
+    whale_share_held = (
+        len(spammed["by_ns"][WHALE])
+        >= len(honest["by_ns"][WHALE]) - whale_slack
+    )
+    out = {
+        "spam_throttled": spammed["throttled"][SPAM],
+        "honest_throttled": (
+            spammed["throttled"][WHALE] + spammed["throttled"][SMALL]
+            + honest["throttled"][WHALE] + honest["throttled"][SMALL]
+        ),
+        "small_reaped": len(spammed["by_ns"][SMALL]),
+        "whale_reaped_honest": len(honest["by_ns"][WHALE]),
+        "whale_reaped_spam": len(spammed["by_ns"][WHALE]),
+        "spam_reaped": len(spammed["by_ns"][SPAM]),
+        "small_identical": small_identical,
+        "whale_share_held": whale_share_held,
+        "gauges_reconcile": (
+            honest["gauges_reconcile"] and spammed["gauges_reconcile"]
+        ),
+    }
+    out["ok"] = (
+        out["spam_throttled"] >= 80  # ~10x over a 10-token burst
+        and out["honest_throttled"] == 0
+        and small_identical
+        and whale_share_held
+        and out["gauges_reconcile"]
+        and out["small_reaped"] > 0
+    )
+    return out
+
+
 def seam_table_lines(prefixes: tuple[str, ...]) -> list[str]:
     """Exposition lines for the given metric families, straight off the
     registry (the soak's summary-table reader)."""
@@ -1763,6 +1899,16 @@ def main(argv=None) -> int:
           f"final_mode={bat['final_mode']}", flush=True)
     if not bat["ok"]:
         failures.append(f"batched-fault drill failed: {bat}")
+
+    qd = run_qos_drill()
+    print(f"QoS drill: spam_throttled={qd['spam_throttled']} "
+          f"honest_throttled={qd['honest_throttled']} "
+          f"small_reaped={qd['small_reaped']} "
+          f"(identical={qd['small_identical']}) "
+          f"whale {qd['whale_reaped_honest']}->{qd['whale_reaped_spam']} "
+          f"gauges_reconcile={qd['gauges_reconcile']}", flush=True)
+    if not qd["ok"]:
+        failures.append(f"QoS drill failed: {qd}")
 
     t_adv0 = time.monotonic()
     wd = run_withholding_drill(k=min(args.k, 8), trials=args.adv_trials)

@@ -39,6 +39,16 @@ per-tenant p50/p99/SLO-burn columns (`--slo-ms`, 99% objective):
       --clients 20000 --tenants 8 --rate 300 --samples 2400 --k 16 \
       --shard-sweep 1,8 --round-out DAS_r02.json
 
+QOS mode (`--qos-out QOS_rNN.json`, needs --clients): two swarm legs
+under one $CELESTIA_QOS policy over the IDENTICAL honest plan —
+`baseline` (no spammer) then `spam` (a spammer tenant firing
+tenant-targeted reads at `--spam-mult` x its `--proof-rate-limit`).
+Throttled samples are POLICY, not failures: they land in their own
+per-tenant column and burn no SLO budget.  scripts/bench_trend.py
+validates the round shape (malformed exits 2) and gates the
+enforcement invariants: spammer throttled, honest tenants' p99 and
+SLO burn no worse than the no-spammer leg.
+
 Prints a one-line JSON summary; --metrics-out writes das_loadgen.prom
 (the celestia_proof_* / celestia_serve_* families) + das_loadgen.jsonl;
 --round-out writes the DAS_rNN.json record scripts/bench_trend.py reads
@@ -331,6 +341,9 @@ def build_swarm_plan(args, squares, client_tenant):
     return plan
 
 
+_THROTTLED = "__throttled__"  # the worker's QosThrottled sentinel
+
+
 def _tenant_stats(results, slo_ms: float) -> dict:
     """Per-tenant p50/p99 + SLO burn (99% of samples under --slo-ms;
     burn = violation fraction / the 1% error budget, so burn > 1 means
@@ -338,26 +351,37 @@ def _tenant_stats(results, slo_ms: float) -> dict:
     A FAILED sample is a violation too — a tenant whose requests mostly
     error must burn budget, not report a rosy number built from its few
     fast successes (percentiles still cover served samples only; the
-    `failed` column carries the drop count)."""
+    `failed` column carries the drop count).  A THROTTLED sample
+    ($CELESTIA_QOS proof-rate refusal) is POLICY, not failure: it lands
+    in its own column and burns no SLO budget — the spammer being over
+    its limit is the enforcement working, and honest tenants are never
+    throttled in a correctly-sized policy."""
     served: dict[int, list[float]] = {}
     failed: dict[int, int] = {}
+    throttled: dict[int, int] = {}
     for tenant, lat_s, err in results:
         if err is None:
             served.setdefault(tenant, []).append(lat_s * 1e3)
+        elif err == _THROTTLED:
+            throttled[tenant] = throttled.get(tenant, 0) + 1
         else:
             failed[tenant] = failed.get(tenant, 0) + 1
     out = {}
-    for tenant in sorted(set(served) | set(failed)):
+    for tenant in sorted(set(served) | set(failed) | set(throttled)):
         lats = sorted(served.get(tenant, []))
         drops = failed.get(tenant, 0)
         total = len(lats) + drops
         over = sum(1 for v in lats if v > slo_ms) + drops
         out[f"t{tenant:02d}"] = {
             "samples": len(lats),
+            "served": len(lats),
             "failed": drops,
+            "throttled": throttled.get(tenant, 0),
             "p50_ms": _percentile(lats, 0.50),
             "p99_ms": _percentile(lats, 0.99),
-            "slo_burn": round((over / total) / 0.01, 3),
+            "slo_burn": (
+                round((over / total) / 0.01, 3) if total else 0.0
+            ),
         }
     return out
 
@@ -434,6 +458,8 @@ def _run_swarm_leg(args, shards: int, squares, plan, eds_by_height
             for _ in range(args.threads):
                 q.put(None)
 
+        from celestia_app_tpu.qos import QosThrottled
+
         def worker():
             while True:
                 got = q.get()
@@ -446,6 +472,10 @@ def _run_swarm_leg(args, shards: int, squares, plan, eds_by_height
                     proof = sampler.share_proof(entry, r, c, axis=axis)
                     if i % verify_every == 0 and not proof.verify(roots[h]):
                         err = "proof failed verify"
+                except QosThrottled:
+                    # The 429 path: a refusal is the ENFORCEMENT being
+                    # measured, never a drop (and never a dead worker).
+                    err = _THROTTLED
                 except Exception as e:  # noqa: BLE001 — a drop IS the measurement
                     err = f"({h},{r},{c}): {type(e).__name__}: {e}"
                 lat = (time.perf_counter() - t0) - t_sched
@@ -464,9 +494,14 @@ def _run_swarm_leg(args, shards: int, squares, plan, eds_by_height
         served = sorted(
             lat * 1e3 for _, lat, err in results if err is None
         )
-        failures = [err for _, _, err in results if err is not None]
+        failures = [
+            err for _, _, err in results
+            if err is not None and err != _THROTTLED
+        ]
+        throttled = sum(1 for _, _, err in results if err == _THROTTLED)
         leg = {
             "shards": shards,
+            "throttled": throttled,
             "samples": len(served),
             "wall_s": round(wall_s, 3),
             "offered_rate": args.rate,
@@ -564,6 +599,123 @@ def run_swarm(args) -> dict:
         "sweep": legs,
         "tenant_stats": tenant_blocks[primary["shards"]],
         "failures": [f for leg in legs for f in leg["failures"]][:5],
+        "platform": jax.default_backend(),
+    }
+
+
+# --- the QoS enforcement run (whale + small tenants + spammer) ---------------
+
+def run_qos(args) -> dict:
+    """Two swarm legs under one $CELESTIA_QOS policy, identical honest
+    plan: `baseline` (no spammer) then `spam` (a spammer tenant firing
+    tenant-targeted reads at --spam-mult x its per-tenant proof-rate
+    limit).  The record (schema qos-v1, QOS_rNN.json via --qos-out) is
+    what bench_trend gates: spammer throttled, every honest tenant's
+    p99/SLO burn no worse than its no-spammer leg."""
+    from celestia_app_tpu import qos
+    from celestia_app_tpu.da.eds import ExtendedDataSquare
+
+    import jax
+
+    if args.clients <= 0:
+        raise SystemExit("--qos-out needs swarm mode (--clients N)")
+    if args.tenants < 3:
+        raise SystemExit("--qos-out needs >= 3 tenants (whale+small+spam)")
+    spam_t = (
+        args.tenants - 1 if args.spam_tenant is None else args.spam_tenant
+    )
+    # tenant_square writes tenant t as namespace byte t+1; the serve
+    # plane's capped label is the hex with leading zeros stripped —
+    # the SAME label the sampler charges, so the policy binds exactly
+    # the spammer's reads.
+    spam_label = format(spam_t + 1, "x")
+    limit = args.proof_rate_limit
+    total_heights = args.heights + args.historical
+    squares = {
+        h: tenant_square(args.k, args.seed + h, args.tenants)
+        for h in range(1, total_heights + 1)
+    }
+    eds_by_height = {
+        h: ExtendedDataSquare.compute(squares[h][0])
+        for h in range(1, total_heights + 1)
+    }
+    crng = np.random.default_rng(args.seed)
+    honest_ids = [t for t in range(args.tenants) if t != spam_t]
+    honest = len(honest_ids)
+    ranks = np.arange(1, len(honest_ids) + 1, dtype=np.float64)
+    popularity = ranks ** -args.zipf_a
+    popularity /= popularity.sum()
+    client_tenant = crng.choice(
+        np.array(honest_ids), size=args.clients, p=popularity
+    )
+    plan = build_swarm_plan(args, squares, client_tenant)
+    # The spammer: open-loop Poisson at spam_mult x its limit, every
+    # arrival a tenant-targeted read inside its own namespace range on
+    # the hot height (the read the proof-rate bucket charges).
+    srng = np.random.default_rng(args.seed + 99)
+    spam_rate = args.spam_mult * limit
+    duration = plan[-1][0] if plan else 1.0
+    k, hot_h = args.k, args.heights
+    spam_plan = []
+    t = float(srng.exponential(1.0 / spam_rate))
+    while t < duration:
+        ranges = squares[hot_h][1]
+        start, end = ranges.get(spam_t, (0, 1))
+        share = start + int(srng.integers(0, max(end - start, 1)))
+        spam_plan.append((
+            t, -1, spam_t, hot_h, share // k, share % k,
+            "col" if srng.random() < 0.5 else "row",
+        ))
+        t += float(srng.exponential(1.0 / spam_rate))
+    merged = sorted(plan + spam_plan)
+
+    qos.install(
+        f"{spam_label}.proof_rate={limit},{spam_label}.proof_burst={limit}"
+    )
+    try:
+        # A discarded warm leg pays the gather-program compiles: the
+        # baseline-vs-spam comparison must measure the POLICY, not which
+        # leg ran first against a cold jit cache.
+        _run_swarm_leg(
+            args, 1, squares, plan[:min(60, len(plan))], eds_by_height
+        )
+        base_leg, base_results = _run_swarm_leg(
+            args, 1, squares, plan, eds_by_height
+        )
+        spam_leg, spam_results = _run_swarm_leg(
+            args, 1, squares, merged, eds_by_height
+        )
+    finally:
+        qos.uninstall()
+    tenants_base = _tenant_stats(base_results, args.slo_ms)
+    tenants_spam = _tenant_stats(spam_results, args.slo_ms)
+    spam_key = f"t{spam_t:02d}"
+    return {
+        "metric": "das_qos",
+        "schema": "qos-v1",
+        "workload": "qos",
+        "clients": args.clients,
+        "tenants": args.tenants,
+        "honest_tenants": honest,
+        "spam_tenant": spam_key,
+        "spam_namespace": spam_label,
+        "proof_rate_limit": limit,
+        "spam_mult": args.spam_mult,
+        "rate": args.rate,
+        "slo_ms": args.slo_ms,
+        "k": args.k,
+        "heights": args.heights,
+        "samples": base_leg["samples"],
+        "spam_arrivals": len(spam_plan),
+        "legs": {
+            "baseline": {**{k_: base_leg[k_] for k_ in (
+                "samples", "wall_s", "proofs_per_s", "proof_p50_ms",
+                "proof_p99_ms", "throttled")}, "tenants": tenants_base},
+            "spam": {**{k_: spam_leg[k_] for k_ in (
+                "samples", "wall_s", "proofs_per_s", "proof_p50_ms",
+                "proof_p99_ms", "throttled")}, "tenants": tenants_spam},
+        },
+        "failures": (base_leg["failures"] + spam_leg["failures"])[:5],
         "platform": jax.default_backend(),
     }
 
@@ -681,6 +833,19 @@ def main(argv=None) -> int:
                     help="swarm: comma list of $CELESTIA_SERVE_SHARDS "
                          "settings to replay the identical plan under "
                          "(e.g. 1,8 — the scaling-curve sweep)")
+    ap.add_argument("--spam-tenant", type=int, default=None,
+                    help="qos: the spammer tenant id (default: the last "
+                         "tenant — the least zipf-popular)")
+    ap.add_argument("--proof-rate-limit", type=float, default=50.0,
+                    help="qos: the spammer's per-tenant proof-rate limit "
+                         "(proofs/sec; $CELESTIA_QOS <ns>.proof_rate)")
+    ap.add_argument("--spam-mult", type=float, default=10.0,
+                    help="qos: the spammer's offered rate as a multiple "
+                         "of its limit")
+    ap.add_argument("--qos-out", metavar="QOS_rNN.json",
+                    help="run the QoS enforcement legs (baseline vs "
+                         "spam under one $CELESTIA_QOS policy) and "
+                         "write the bench_trend round record here")
     ap.add_argument("--url", default=None,
                     help="sample a live node's /das/share_proof instead")
     ap.add_argument("--height", type=int, default=1,
@@ -708,7 +873,9 @@ def main(argv=None) -> int:
     if args.mode:
         os.environ["CELESTIA_SERVE_MODE"] = args.mode
     try:
-        if args.url:
+        if args.qos_out:
+            summary = run_qos(args)
+        elif args.url:
             summary = run_url(args)
         elif args.clients:
             summary = run_swarm(args)
@@ -724,6 +891,40 @@ def main(argv=None) -> int:
     print(json.dumps(summary), flush=True)
     if args.metrics_out:
         write_metrics_out(args.metrics_out)
+    if args.qos_out:
+        import re
+
+        m = re.search(r"QOS_r(\d+)\.json$", os.path.basename(args.qos_out))
+        record = {
+            "n": int(m.group(1)) if m else 0,
+            "schema": "qos-v1",
+            "k": summary["k"],
+            "clients": summary["clients"],
+            "tenants": summary["tenants"],
+            "rate": summary["rate"],
+            "slo_ms": summary["slo_ms"],
+            "spam_tenant": summary["spam_tenant"],
+            "spam_namespace": summary["spam_namespace"],
+            "proof_rate_limit": summary["proof_rate_limit"],
+            "spam_mult": summary["spam_mult"],
+            "spam_arrivals": summary["spam_arrivals"],
+            "legs": summary["legs"],
+            "platform": summary["platform"],
+        }
+        with open(args.qos_out, "w") as f:
+            json.dump(record, f, indent=1)
+        if summary["failures"]:
+            for fail in summary["failures"]:
+                print(f"FAIL: {fail}", file=sys.stderr)
+            return 1
+        spam_cols = summary["legs"]["spam"]["tenants"][
+            summary["spam_tenant"]
+        ]
+        if not spam_cols["throttled"]:
+            print("FAIL: the spammer was never throttled — the policy "
+                  "enforced nothing", file=sys.stderr)
+            return 1
+        return 0
     if args.round_out:
         import re
 

@@ -1011,3 +1011,194 @@ class TestRepairGatedSeries:
             {"mode": "repair_grouped", "k": 128, "mb_per_s": 10.0},
         ], platform="cpu")
         assert bt.main(["--dir", str(tmp_path)]) == 0
+
+
+class TestMempoolSeries:
+    """ISSUE-15: the BENCH_MODE=mempool concurrent-admission A/B —
+    `mempool_sharded` gates like a rate under the same-platform rule,
+    `mempool_global` (the frozen single-lock baseline rung) stays
+    ungated like repair_grouped, and absence from a default-plan round
+    is a plan gap, never STALE."""
+
+    def test_sharded_is_gated_global_is_not(self, tmp_path, capsys):
+        bt = _load()
+        assert "mempool_sharded" in bt.GATED_MODES
+        assert "mempool_global" not in bt.GATED_MODES
+        _round_file(tmp_path, 1, [
+            {"mode": "mempool_sharded", "k": 8, "mb_per_s": 900.0},
+            {"mode": "mempool_global", "k": 8, "mb_per_s": 450.0},
+        ], platform="cpu")
+        _round_file(tmp_path, 2, [
+            {"mode": "mempool_sharded", "k": 8, "mb_per_s": 400.0},  # -55%
+            {"mode": "mempool_global", "k": 8, "mb_per_s": 100.0},  # ungated
+        ], platform="cpu")
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        out = capsys.readouterr().out
+        assert "mempool_sharded@8" in out
+        assert "mempool_global@8" not in out.split("regressions:")[-1]
+
+    def test_same_platform_prior_rule(self, tmp_path):
+        bt = _load()
+        _round_file(tmp_path, 1, [
+            {"mode": "mempool_sharded", "k": 8, "mb_per_s": 9000.0},
+        ], platform="tpu")
+        _round_file(tmp_path, 2, [
+            {"mode": "mempool_sharded", "k": 8, "mb_per_s": 900.0},
+        ], platform="cpu")
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+
+    def test_absence_from_default_round_is_plan_gap(self, tmp_path, capsys):
+        bt = _load()
+        _round_file(tmp_path, 1, [
+            {"mode": "mempool_sharded", "k": 8, "mb_per_s": 900.0},
+        ], platform="cpu")
+        _round_file(tmp_path, 2, [
+            {"mode": "compute", "k": 128, "mb_per_s": 10.0},
+        ], platform="cpu")
+        assert bt.main(["--dir", str(tmp_path), "--json"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert all(s["series"] != "mempool_sharded@8" for s in out["stale"])
+        assert any(s["series"] == "mempool_sharded@8" for s in out["opt_in"])
+
+
+def _qos_tenants(burns, throttled=None, p99=None):
+    throttled = throttled or {}
+    p99 = p99 or {}
+    return {
+        t: {
+            "served": 100, "samples": 100, "failed": 0,
+            "throttled": throttled.get(t, 0),
+            "p50_ms": 10.0, "p99_ms": p99.get(t, 50.0),
+            "slo_burn": burn,
+        }
+        for t, burn in burns.items()
+    }
+
+
+def _qos_round_file(tmp_path, n=1, *, spam_throttled=500,
+                    base_burns=None, spam_burns=None, spam_p99=None):
+    base_burns = base_burns or {"t00": 1.0, "t01": 2.0}
+    spam_burns = spam_burns or {"t00": 1.0, "t01": 2.0, "t07": 0.5}
+    rec = {
+        "n": n, "schema": "qos-v1", "k": 16, "platform": "cpu",
+        "clients": 100, "tenants": 8, "rate": 100.0, "slo_ms": 250.0,
+        "spam_tenant": "t07", "spam_namespace": "8",
+        "proof_rate_limit": 40.0, "spam_mult": 10.0, "spam_arrivals": 800,
+        "legs": {
+            "baseline": {
+                "samples": 200, "proofs_per_s": 100.0,
+                "proof_p99_ms": 60.0, "throttled": 0,
+                "tenants": _qos_tenants(base_burns),
+            },
+            "spam": {
+                "samples": 220, "proofs_per_s": 100.0,
+                "proof_p99_ms": 60.0, "throttled": spam_throttled,
+                "tenants": _qos_tenants(
+                    spam_burns, throttled={"t07": spam_throttled},
+                    p99=spam_p99,
+                ),
+            },
+        },
+    }
+    path = tmp_path / f"QOS_r{n:02d}.json"
+    path.write_text(json.dumps(rec))
+    return str(path)
+
+
+def _bench_seed_round(tmp_path):
+    # bench_trend needs at least one readable BENCH round in --dir.
+    _round_file(tmp_path, 1, [
+        {"mode": "compute", "k": 128, "mb_per_s": 10.0},
+    ], platform="cpu")
+
+
+class TestQosRounds:
+    """ISSUE-15: QOS_rNN.json (das_loadgen --qos-out) — per-tenant
+    throttled/served/burn columns validated, enforcement invariants
+    gated, malformed exits 2."""
+
+    def test_checked_in_qos_round_loads_and_gates_ok(self):
+        bt = _load()
+        import glob
+
+        paths = sorted(glob.glob(os.path.join(REPO_ROOT, "QOS_r*.json")))
+        assert paths, "QOS_r01.json must be checked in"
+        rounds = bt.load_qos_series(paths)
+        newest = rounds[-1]
+        spam = newest["legs"]["spam"]["tenants"][newest["spam_tenant"]]
+        assert spam["throttled"] > 0
+        assert bt.find_qos_regressions(rounds, 10.0) == []
+
+    def test_valid_round_passes(self, tmp_path):
+        bt = _load()
+        _bench_seed_round(tmp_path)
+        _qos_round_file(tmp_path)
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+
+    def test_unthrottled_spammer_is_a_regression(self, tmp_path, capsys):
+        bt = _load()
+        _bench_seed_round(tmp_path)
+        _qos_round_file(tmp_path, spam_throttled=0)
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        assert "qos.spammer_throttled" in capsys.readouterr().out
+
+    def test_honest_tenant_burn_regression_flagged(self, tmp_path, capsys):
+        bt = _load()
+        _bench_seed_round(tmp_path)
+        _qos_round_file(
+            tmp_path,
+            base_burns={"t00": 1.0, "t01": 2.0},
+            spam_burns={"t00": 1.0, "t01": 9.0, "t07": 0.5},  # t01 3x worse
+        )
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        assert "qos.t01.slo_burn" in capsys.readouterr().out
+
+    def test_honest_tenant_p99_regression_flagged(self, tmp_path, capsys):
+        bt = _load()
+        _bench_seed_round(tmp_path)
+        _qos_round_file(
+            tmp_path, spam_p99={"t00": 500.0},  # baseline p99 is 50 ms
+        )
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        assert "qos.t00.p99_ms" in capsys.readouterr().out
+
+    def test_spammer_own_columns_never_gate(self, tmp_path):
+        bt = _load()
+        _bench_seed_round(tmp_path)
+        # The spammer's burn is terrible in the spam leg — that IS the
+        # enforcement; only honest tenants gate.
+        _qos_round_file(
+            tmp_path,
+            base_burns={"t00": 1.0, "t07": 0.0},
+            spam_burns={"t00": 1.0, "t07": 99.0},
+        )
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+
+    def test_quantization_slack_small_burn_moves_pass(self, tmp_path):
+        bt = _load()
+        _bench_seed_round(tmp_path)
+        # 0.0 -> 0.4 burn is inside the absolute slack (one violation in
+        # a small sample moves burn in steps).
+        _qos_round_file(
+            tmp_path,
+            base_burns={"t00": 0.0, "t01": 2.0},
+            spam_burns={"t00": 0.4, "t01": 2.0, "t07": 0.5},
+        )
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+
+    @pytest.mark.parametrize("mutilate", [
+        lambda r: r.pop("spam_tenant"),
+        lambda r: r.pop("legs"),
+        lambda r: r["legs"].pop("baseline"),
+        lambda r: r["legs"]["spam"]["tenants"]["t00"].pop("slo_burn"),
+        lambda r: r["legs"]["spam"]["tenants"]["t00"].pop("throttled"),
+        lambda r: r.update(spam_tenant="t99"),
+    ])
+    def test_malformed_exits_2(self, tmp_path, mutilate):
+        bt = _load()
+        _bench_seed_round(tmp_path)
+        path = _qos_round_file(tmp_path)
+        rec = json.loads(open(path).read())
+        mutilate(rec)
+        open(path, "w").write(json.dumps(rec))
+        assert bt.main(["--dir", str(tmp_path)]) == 2
