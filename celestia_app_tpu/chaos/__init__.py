@@ -105,6 +105,12 @@ def proof_serve() -> None:
         inj.proof_serve()
 
 
+def proof_verify() -> None:
+    inj = injector()
+    if inj is not None:
+        inj.proof_verify()
+
+
 def proof_shard() -> None:
     inj = injector()
     if inj is not None:

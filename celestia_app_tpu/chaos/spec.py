@@ -18,6 +18,8 @@ to something that can fail:
                       ordinals, never on how admissions interleave
                       across shards/threads)
     proof.serve       one batched DAS proof dispatch (serve/sampler)
+    proof.verify      one batched proof VERIFICATION dispatch
+                      (serve/verify) — the read side's verify twin
 
 Spec grammar — comma-separated `key=value` pairs, e.g.
 
@@ -45,6 +47,9 @@ gossip_drop=0.1,wal_torn_tail=1,rpc_slow_ms=100"
     proof_fail=<p>        batched proof dispatch raises (host fallback
                           must answer bit-identically)
     proof_slow_ms=<ms>    [proof_slow=<p>] proof dispatch stalls
+    verify_fail=<p>       batched proof VERIFICATION raises (serve/verify
+                          must fall back to the per-proof host verify
+                          with an identical accept/reject vector)
     shard_fail=<p>        SHARDED forest gather raises (serve/shard):
                           the gather degrades to the single-device
                           batched path, then — compounded with
@@ -103,6 +108,7 @@ SEAMS = (
     "rpc.handle",
     "mempool.insert",
     "proof.serve",
+    "proof.verify",
     "proof.shard",
     "device.extend_shard",
 )
@@ -117,6 +123,7 @@ _KNOWN_KEYS = {
     "rpc_slow_ms", "rpc_slow", "rpc_fail",
     "mempool_drop", "mempool_slow_ms", "mempool_slow",
     "proof_fail", "proof_slow_ms", "proof_slow",
+    "verify_fail",
     "shard_fail",
     "extend_shard_fail",
     "withhold_frac", "malform_shares", "wrong_root",
@@ -309,6 +316,15 @@ class ChaosInjector:
         if self._fire("proof.serve", "proof_fail"):
             self._count("proof.serve", "proof_fail")
             raise ChaosInjected("proof.serve", "proof_fail")
+
+    def proof_verify(self) -> None:
+        """Fail one BATCHED proof-verification dispatch (serve/verify):
+        the verifier must absorb the failure by re-deciding the whole
+        queue on the per-proof host path with an IDENTICAL accept/reject
+        vector — the read side's verify twin of the proof.serve seam."""
+        if self._fire("proof.verify", "verify_fail"):
+            self._count("proof.verify", "verify_fail")
+            raise ChaosInjected("proof.verify", "verify_fail")
 
     def proof_shard(self) -> None:
         """Fail one SHARDED forest gather (serve/shard): the gather must

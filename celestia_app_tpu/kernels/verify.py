@@ -1,0 +1,132 @@
+"""Batched DAS proof verification on device — the verify twin of the
+batched sampler.
+
+The serve plane answers a micro-batch of samples in one gather
+(serve/sampler); this module closes the read side's last host loop by
+re-deciding a whole queue of `(coordinate, share, proof)` samples in one
+jitted program:
+
+    one leaf-hash dispatch            (B, 542) -> (B, 32)
+    one gathered path-fold per level  (B, 181) -> (B, 32)  NMT levels
+    one row-root fold per level       (B,  91) / (B, 65)   data-root path
+
+with the namespace min/max bookkeeping folded in as `where` lanes —
+exactly the kernels/nmt.py idiom, reusing the same batched SHA-256
+(kernels/sha256.py), so the accept/reject semantics are the host
+verifier's (nmt/proof._verify_digests + merkle.compute_root_from_path)
+bit for bit:
+
+    * sibling namespaces out of order (left.max > right.min at ANY
+      level) rejects — the device accumulates a violation mask instead
+      of raising, same final verdict;
+    * the ignore-max rule (right.min == 0xFF^29 => parent.max =
+      left.max) propagates identically;
+    * the computed 90-byte NMT root must equal the proof's claimed row
+      root AND that row root's audit path must land on the data root.
+
+Index plans (which proof node sits at which level, which side the
+running digest folds from) are host ints prepared by serve/verify.py
+from the SAME `range_proof_node_coords` plan the sampler serves proofs
+with — shared plan in, shared plan out, which is what makes batched and
+host verdicts identical by construction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from celestia_app_tpu.constants import PARITY_NAMESPACE_BYTES
+from celestia_app_tpu.kernels.sha256 import sha256
+
+_MAX_NS = np.frombuffer(PARITY_NAMESPACE_BYTES, dtype=np.uint8)
+
+
+def _lex_gt(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Bytewise-lexicographic a > b over (B, W) uint8 rows -> (B,) bool.
+
+    The verdict hangs on the first differing byte; argmax over the
+    inequality mask finds it without a scan (all-equal rows gate on
+    any_neq, so their arbitrary argmax never escapes).
+    """
+    neq = a != b
+    any_neq = jnp.any(neq, axis=1)
+    first = jnp.argmax(neq, axis=1)
+    av = jnp.take_along_axis(a, first[:, None], axis=1)[:, 0]
+    bv = jnp.take_along_axis(b, first[:, None], axis=1)[:, 0]
+    return any_neq & (av > bv)
+
+
+@jax.jit
+def nmt_leaf_digests(ns: jnp.ndarray, data: jnp.ndarray) -> jnp.ndarray:
+    """(N, 29) namespaces x (N, D) raw leaves -> (N, 90) NMT leaf digests
+    (ns || ns || sha256(0x00 || ns || data)) in one batched dispatch —
+    the heal engine's survivor check hashes every gathered coordinate
+    through this instead of a host loop."""
+    prefix = jnp.zeros((ns.shape[0], 1), dtype=jnp.uint8)
+    h = sha256(jnp.concatenate([prefix, ns, data], axis=1))
+    return jnp.concatenate([ns, ns, h], axis=1)
+
+
+@jax.jit
+def verify_nmt_samples(
+    ns: jnp.ndarray,           # (B, 29)  leaf namespaces
+    shares: jnp.ndarray,       # (B, D)   raw shares
+    sibs: jnp.ndarray,         # (B, Ln, 90) NMT siblings, leaf-to-root
+    sib_is_left: jnp.ndarray,  # (B, Ln)  sibling folds from the left
+    row_roots: jnp.ndarray,    # (B, 90)  claimed row/col roots
+) -> jnp.ndarray:
+    """(B,) bool: each sample's NMT fold lands on its claimed row root
+    with no namespace-order violation at any level.
+
+    Ln is static per compiled program (one specialization per tree
+    shape; serve/verify.py buckets the queue and pads B to a power of
+    two so recompilation is bounded)."""
+    b = ns.shape[0]
+    zeros = jnp.zeros((b, 1), dtype=jnp.uint8)
+    ones = jnp.ones((b, 1), dtype=jnp.uint8)
+    max_ns = jnp.asarray(_MAX_NS)
+
+    h = sha256(jnp.concatenate([zeros, ns, shares], axis=1))
+    mins, maxs = ns, ns
+    violated = jnp.zeros((b,), dtype=bool)
+    for lvl in range(sibs.shape[1]):
+        cur = jnp.concatenate([mins, maxs, h], axis=1)
+        sib = sibs[:, lvl]
+        isl = sib_is_left[:, lvl][:, None]
+        left = jnp.where(isl, sib, cur)
+        right = jnp.where(isl, cur, sib)
+        l_min, l_max = left[:, :29], left[:, 29:58]
+        r_min, r_max = right[:, :29], right[:, 29:58]
+        violated |= _lex_gt(l_max, r_min)
+        h = sha256(jnp.concatenate([ones, left, right], axis=1))
+        right_is_parity = jnp.all(r_min == max_ns, axis=1, keepdims=True)
+        mins = l_min
+        maxs = jnp.where(right_is_parity, l_max, r_max)
+    computed = jnp.concatenate([mins, maxs, h], axis=1)
+    return jnp.all(computed == row_roots, axis=1) & ~violated
+
+
+@jax.jit
+def fold_row_roots(
+    row_roots: jnp.ndarray,    # (U, 90)  row/col roots, deduped
+    row_paths: jnp.ndarray,    # (U, Lr, 32) data-root audit paths
+    path_is_left: jnp.ndarray,  # (U, Lr)
+    data_roots: jnp.ndarray,   # (U, 32)
+) -> jnp.ndarray:
+    """(U,) bool: each row root's audit path lands on its data root
+    (RFC-6962 fold by index bits).  Runs over the queue's UNIQUE
+    (row root, path) pairs — s samples of one height share a handful of
+    row roots, so this leg's cost is ~n, not ~s."""
+    u = row_roots.shape[0]
+    zeros = jnp.zeros((u, 1), dtype=jnp.uint8)
+    ones = jnp.ones((u, 1), dtype=jnp.uint8)
+    rh = sha256(jnp.concatenate([zeros, row_roots], axis=1))
+    for lvl in range(row_paths.shape[1]):
+        p = row_paths[:, lvl]
+        isl = path_is_left[:, lvl][:, None]
+        left = jnp.where(isl, p, rh)
+        right = jnp.where(isl, rh, p)
+        rh = sha256(jnp.concatenate([ones, left, right], axis=1))
+    return jnp.all(rh == data_roots, axis=1)

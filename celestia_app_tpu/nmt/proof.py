@@ -143,6 +143,94 @@ def verify_range(
     )
 
 
+# --- deduped multiproofs (the attestation plane's wire unit) ---------------
+
+
+@dataclass(frozen=True)
+class NmtMultiProof:
+    """Inclusion proof for a SET of leaf ranges of one NMT.
+
+    s ranges of one tree share most of their upper path nodes; here each
+    shared node is serialized ONCE (`nodes`, first-use order) and every
+    range consumes its nodes by index (`node_refs`, the exact DFS order
+    `prove_range` emits) — so reconstructing any range's NmtRangeProof
+    is pure indexing and byte-identical to proving it alone, while the
+    wire stops paying s x for shared interior nodes."""
+
+    ranges: tuple[tuple[int, int], ...]  # sorted, disjoint [start, end)
+    nodes: tuple[bytes, ...]  # unique 90-byte digests, first-use order
+    node_refs: tuple[tuple[int, ...], ...]  # per range, DFS order
+    total: int  # leaf count of the proven tree
+
+
+def multiproof_from_levels(
+    levels: list[list[bytes]], ranges
+) -> NmtMultiProof:
+    """Deduped proof for sorted disjoint `ranges` from precomputed digest
+    levels (leaf level first; power-of-two trees).  Deterministic: node
+    table order is first use, walking ranges in their sorted order and
+    each range's nodes in DFS order."""
+    total = len(levels[0])
+    rs = tuple((int(s), int(e)) for s, e in ranges)
+    prev_end = 0
+    for s, e in rs:
+        if not 0 <= s < e <= total:
+            raise ValueError(f"invalid range [{s},{e}) of {total} leaves")
+        if s < prev_end:
+            raise ValueError(
+                f"ranges must be sorted and disjoint (range [{s},{e}) "
+                f"overlaps or precedes end {prev_end})"
+            )
+        prev_end = e
+    if not rs:
+        raise ValueError("multiproof needs at least one range")
+    table: dict[tuple[int, int], int] = {}
+    nodes: list[bytes] = []
+    refs: list[tuple[int, ...]] = []
+    for s, e in rs:
+        rr: list[int] = []
+        for coord in range_proof_node_coords(total, s, e):
+            j = table.get(coord)
+            if j is None:
+                j = table[coord] = len(nodes)
+                lvl, idx = coord
+                nodes.append(levels[lvl][idx])
+            rr.append(j)
+        refs.append(tuple(rr))
+    return NmtMultiProof(rs, tuple(nodes), tuple(refs), total)
+
+
+def split_multiproof(mp: NmtMultiProof) -> list[NmtRangeProof]:
+    """Per-range NmtRangeProofs reconstructed from the deduped table —
+    byte-identical to `prove_range` of each range alone.  Raises
+    IndexError on out-of-table refs (attacker-shaped input)."""
+    return [
+        NmtRangeProof(s, e, tuple(mp.nodes[j] for j in refs), mp.total)
+        for (s, e), refs in zip(mp.ranges, mp.node_refs)
+    ]
+
+
+def verify_multiproof(
+    root: bytes, mp: NmtMultiProof, leaf_ndata_per_range: list[list[bytes]]
+) -> bool:
+    """Host verification: every range's leaves (ns-prefixed raw data)
+    verify against the 90-byte root.  The batched path reconstructs the
+    same per-range proofs and decides them in one device program
+    (serve/verify.py)."""
+    if len(leaf_ndata_per_range) != len(mp.ranges):
+        return False
+    if len(mp.node_refs) != len(mp.ranges):
+        return False
+    try:
+        parts = split_multiproof(mp)
+    except IndexError:
+        return False
+    return all(
+        verify_range(root, proof, leaves)
+        for proof, leaves in zip(parts, leaf_ndata_per_range)
+    )
+
+
 # --- namespace proofs (nmt ProveNamespace / VerifyNamespace parity) --------
 
 

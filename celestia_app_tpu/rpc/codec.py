@@ -57,6 +57,81 @@ def share_proof_from_json(d: dict):
     )
 
 
+def share_proofs_from_attestation(d: dict):
+    """Per-sample ShareProofs reconstructed from an attestation payload
+    (serve/api.DasProvider.attestation_payload) — pure indexing into the
+    deduped node tables, byte-identical to fetching each sample's
+    share_proof alone.  This is BOTH the light client's reconstructor
+    and the serve-side verification gate's input, so what the gate
+    decides is exactly what a client would verify.
+
+    Raises ValueError/KeyError/IndexError on malformed payloads
+    (attacker-shaped input maps to a 400-class refusal, never a crash).
+    """
+    from celestia_app_tpu.constants import (
+        NAMESPACE_SIZE,
+        PARITY_NAMESPACE_BYTES,
+    )
+    from celestia_app_tpu.nmt.proof import NmtRangeProof
+    from celestia_app_tpu.proof.share_proof import RowProof, ShareProof
+
+    k = d["square_size"]
+    samples, shares = d["samples"], d["shares"]
+    nodes = [bytes.fromhex(nd) for nd in d["nodes"]]
+    root_nodes = [bytes.fromhex(nd) for nd in d["root_nodes"]]
+    if len(samples) != len(shares):
+        raise ValueError(
+            f"{len(samples)} samples but {len(shares)} shares"
+        )
+    out: list[ShareProof] = []
+    pos = 0
+    for tree in d["trees"]:
+        axis, index = tree["axis"], tree["index"]
+        root = bytes.fromhex(tree["root"])
+        root_path = tuple(root_nodes[j] for j in tree["root_path_refs"])
+        row_proof = RowProof(
+            row_roots=(root,),
+            proofs=(root_path,),
+            start_row=tree["root_index"],
+            end_row=tree["root_index"] + 1,
+            total=tree["root_total"],
+        )
+        if len(tree["ranges"]) != len(tree["node_refs"]):
+            raise ValueError("ranges/node_refs length mismatch")
+        for (start, end), refs in zip(tree["ranges"], tree["node_refs"]):
+            if pos >= len(samples):
+                raise ValueError("more tree ranges than samples")
+            s = samples[pos]
+            row, col = s["row"], s["col"]
+            tree_of, leaf = (row, col) if s["axis"] == "row" else (col, row)
+            if s["axis"] != axis or tree_of != index or leaf != start:
+                raise ValueError(
+                    f"sample {pos} ({row},{col},{s['axis']}) does not "
+                    f"match tree {axis}:{index} range [{start},{end})"
+                )
+            share = bytes.fromhex(shares[pos])
+            ns = (
+                share[:NAMESPACE_SIZE]
+                if row < k and col < k
+                else PARITY_NAMESPACE_BYTES
+            )
+            out.append(ShareProof(
+                data=(share,),
+                share_proofs=(NmtRangeProof(
+                    start=start,
+                    end=end,
+                    nodes=tuple(nodes[j] for j in refs),
+                    total=tree["total"],
+                ),),
+                namespace=ns,
+                row_proof=row_proof,
+            ))
+            pos += 1
+    if pos != len(samples):
+        raise ValueError(f"{len(samples) - pos} samples not covered by trees")
+    return out
+
+
 def state_proof_from_json(d: dict):
     from celestia_app_tpu.state.smt import StateProof
 

@@ -23,10 +23,10 @@ layer, protoc-cross-validated by tests/test_proto_wire.py):
   celestia.tpu.subscription.v1.Subscription/WaitTx long-poll tx commit
       (this framework's analog of Tendermint's websocket /subscribe —
       the reference serves that from celestia-core RPC, not gRPC)
-  celestia.tpu.das.v1.Das/GetShareProof|GetSharesByNamespace  the DAS
-      sampling surface (serve/): responses carry the canonical
-      serve/api.render payload bytes, byte-identical to the HTTP planes'
-      GET /das/* bodies
+  celestia.tpu.das.v1.Das/GetShareProof|GetSharesByNamespace|
+      GetAttestation                              the DAS sampling surface
+      (serve/): responses carry the canonical serve/api.render payload
+      bytes, byte-identical to the HTTP planes' GET /das/* bodies
 
 List queries speak cosmos.base.query.v1beta1 PageRequest/PageResponse
 (offset/limit/count_total/reverse; next_key is an opaque offset cursor).
@@ -735,6 +735,19 @@ def _handlers(node) -> dict:
             lambda: provider.shares_payload(height, ns_hex), "shares"
         )
 
+    def das_attestation(req: bytes) -> bytes:
+        # GetAttestationRequest {height=1, samples=2 (comma-joined
+        # row:col[:axis] spec)} -> {payload=1 bytes}: the canonical
+        # serve/api.render bytes of the deduped multiproof attestation —
+        # byte-identical to the GET /das/attestation body on the HTTP
+        # planes.
+        provider = _node_das_provider()
+        height, samples = _field_int(req, 1), _field_str(req, 2)
+        return _das_payload(
+            lambda: provider.attestation_payload(height, samples),
+            "attestation",
+        )
+
     return {
         "cosmos.tx.v1beta1.Service": {
             "BroadcastTx": broadcast_tx,
@@ -775,6 +788,7 @@ def _handlers(node) -> dict:
         "celestia.tpu.das.v1.Das": {
             "GetShareProof": das_share_proof,
             "GetSharesByNamespace": das_shares_by_namespace,
+            "GetAttestation": das_attestation,
         },
     }
 
@@ -922,6 +936,8 @@ class GrpcNode:
                 "das_share_proof": "/celestia.tpu.das.v1.Das/GetShareProof",
                 "das_shares":
                     "/celestia.tpu.das.v1.Das/GetSharesByNamespace",
+                "das_attestation":
+                    "/celestia.tpu.das.v1.Das/GetAttestation",
             }.items()
         }
 
@@ -1245,6 +1261,24 @@ class GrpcNode:
         import json
 
         return json.loads(self.shares_by_namespace_bytes(height, namespace_hex))
+
+    def attestation_bytes(self, height: int, samples: str) -> bytes:
+        """Raw canonical payload bytes of GetAttestation — byte-identical
+        to the HTTP planes' GET /das/attestation body (the cross-plane
+        identity tests compare exactly this)."""
+        req = encode_varint_field(1, height) + encode_bytes_field(
+            2, samples.encode()
+        )
+        return _field_bytes(self._call["das_attestation"](req), 1)
+
+    def das_attestation(self, height: int, samples: str) -> dict:
+        """GetAttestation payload as a dict; per-sample proofs reconstruct
+        via rpc/codec.share_proofs_from_attestation for client-side
+        verification (host verify() or the batched verifier).  (Named
+        das_attestation: `attestation(nonce)` is the blobstream query.)"""
+        import json
+
+        return json.loads(self.attestation_bytes(height, samples))
 
     def slashing_params(self) -> dict:
         p = _field_bytes(self._call["slashing_params"](b""), 1)

@@ -420,6 +420,20 @@ class ServingNode(TestNode):
         count_served("jsonrpc", "shares", payload)
         return payload
 
+    def rpc_get_attestation(self, height: int, samples: str) -> dict:
+        """GetAttestation — a deduped multiproof for a SET of samples
+        (`samples` = comma-joined row:col[:axis]): shared NMT and root
+        nodes serialized once, per-sample proofs reconstructable by
+        indexing (rpc/codec.share_proofs_from_attestation).  Same payload
+        dict the GET /das/attestation route renders."""
+        from celestia_app_tpu.serve.api import count_served
+
+        payload = self.das_provider().attestation_payload(
+            int(height), samples
+        )
+        count_served("jsonrpc", "attestation", payload)
+        return payload
+
     # --- state-sync snapshots -------------------------------------------------
     SNAPSHOT_CHUNK_BYTES = 512 * 1024
 

@@ -102,6 +102,62 @@ class UnknownHeight(KeyError):
     """No cached, spilled, or rebuildable square at this height (a 404)."""
 
 
+#: Hard cap on samples per attestation request: bounds the gather, the
+#: multiproof assembly, and the response body a single query can demand.
+MAX_ATTESTATION_SAMPLES = 4096
+
+
+def parse_attestation_samples(spec: str) -> list[tuple[int, int, str]]:
+    """Parse an attestation sample spec — comma-joined `row:col[:axis]`
+    items (axis defaults to "row") — into the CANONICAL sample list:
+    sorted by (axis, tree, leaf), duplicates dropped.  Every plane parses
+    the same spec through this one function, so the canonical order (and
+    with it the payload bytes) is structural, not per-plane."""
+    out: set[tuple[int, int, str]] = set()
+    if not spec.strip():
+        raise ValueError("samples spec is empty (want row:col[:axis],...)")
+    for item in spec.split(","):
+        parts = item.strip().split(":")
+        if len(parts) not in (2, 3):
+            raise ValueError(
+                f"bad sample {item!r} (want row:col or row:col:axis)"
+            )
+        axis = parts[2] if len(parts) == 3 else "row"
+        if axis not in ("row", "col"):
+            raise ValueError(f"axis must be 'row' or 'col', got {axis!r}")
+        try:
+            row, col = int(parts[0]), int(parts[1])
+        except ValueError as e:
+            raise ValueError(f"bad sample {item!r}: {e}") from e
+        if row < 0 or col < 0:
+            raise ValueError(f"bad sample {item!r}: negative coordinate")
+        out.add((row, col, axis))
+    if len(out) > MAX_ATTESTATION_SAMPLES:
+        raise ValueError(
+            f"{len(out)} samples exceed the per-request cap "
+            f"{MAX_ATTESTATION_SAMPLES}"
+        )
+    # Canonical order: by (axis, tree index, leaf index) — the grouping
+    # the multiproof assembly walks, so tree order and range order in the
+    # payload are the sort order, never insertion order.
+    def key(s):
+        row, col, axis = s
+        tree, leaf = (row, col) if axis == "row" else (col, row)
+        return (axis, tree, leaf)
+
+    return sorted(out, key=key)
+
+
+def _attestation_latency():
+    from celestia_app_tpu.trace.metrics import DEVICE_SECONDS_BUCKETS, registry
+
+    return registry().histogram(
+        "celestia_attestation_latency_seconds",
+        "attestation build latency by phase (parse/gather/assemble/verify)",
+        buckets=DEVICE_SECONDS_BUCKETS,
+    )
+
+
 class DasProvider:
     """Binds a ForestCache + ProofSampler + an optional rebuild source.
 
@@ -254,4 +310,131 @@ class DasProvider:
             "shares": rng[1] - rng[0],
             "proof": to_jsonable(proof),
         })
+        return payload
+
+    def attestation_payload(self, height: int, samples: str) -> dict:
+        """One deduped multiproof attestation for a SET of samples.
+
+        s independent `share_proof` responses repeat the upper tree nodes
+        of every shared row/column; this payload serializes each NMT node
+        ONCE per tree (nmt/proof.multiproof) and each data-root audit
+        node once per (level, sibling) coordinate, so the wire cost grows
+        ~log instead of ~s x log.  Per-sample ShareProofs reconstruct
+        byte-identically from the tables (rpc/codec.
+        share_proofs_from_attestation), which is also how the verify gate
+        here decides the payload — the gate verifies EXACTLY the bytes a
+        client would.
+
+        Same refusal semantics as share_proof: withheld coordinates raise
+        ShareWithheld (410), a tampered view fails the verification gate
+        with BadProofDetected (502), mid-heal heights answer 503."""
+        import time
+
+        from celestia_app_tpu import merkle
+        from celestia_app_tpu.nmt.proof import multiproof_from_levels
+        from celestia_app_tpu.serve.sampler import (
+            _check_withheld,
+            _qos_gate_sample,
+        )
+        from celestia_app_tpu.trace.metrics import registry
+
+        lat = _attestation_latency()
+        t0 = time.perf_counter()
+        sample_list = parse_attestation_samples(samples)
+        entry = self.entry(height)
+        n = 2 * entry.k
+        for row, col, _axis in sample_list:
+            if not (row < n and col < n):
+                raise ValueError(f"coordinate ({row},{col}) outside {n}x{n}")
+        coords = [(row, col) for row, col, _axis in sample_list]
+        # The same per-sample refusals the share_proof path applies, in
+        # canonical order: the FIRST withheld coordinate fails the
+        # request (410); every data-quadrant sample pays its tenant's
+        # proof-rate token before any gather work.
+        _check_withheld(entry, coords)
+        for row, col, _axis in sample_list:
+            _qos_gate_sample(entry, row, col)
+        lat.observe(time.perf_counter() - t0, phase="parse")
+
+        t1 = time.perf_counter()
+        shares = entry.gather_shares(coords)  # ONE gather for the set
+        lat.observe(time.perf_counter() - t1, phase="gather")
+
+        t2 = time.perf_counter()
+        by_tree: dict = {}  # (axis, tree) -> [leaf, ...]  (sorted already)
+        for row, col, axis in sample_list:
+            tree, leaf = (row, col) if axis == "row" else (col, row)
+            by_tree.setdefault((axis, tree), []).append(leaf)
+        nodes: list[bytes] = []
+        root_nodes: list[bytes] = []
+        root_table: dict[tuple[int, int], int] = {}
+        trees: list[dict] = []
+        all_roots = entry.row_roots + entry.col_roots
+        for (axis, tree), leaves in by_tree.items():
+            mp = multiproof_from_levels(
+                entry.line_levels(axis, tree),
+                [(leaf, leaf + 1) for leaf in leaves],
+            )
+            offset = len(nodes)
+            nodes.extend(mp.nodes)
+            root_index = tree if axis == "row" else n + tree
+            path = merkle.path_from_levels(entry.root_levels, root_index)
+            refs: list[int] = []
+            for lvl, sib in enumerate(path):
+                coord = (lvl, (root_index >> lvl) ^ 1)
+                j = root_table.get(coord)
+                if j is None:
+                    j = root_table[coord] = len(root_nodes)
+                    root_nodes.append(sib)
+                refs.append(j)
+            trees.append({
+                "axis": axis,
+                "index": tree,
+                "total": mp.total,
+                "root": all_roots[root_index].hex(),
+                "ranges": [[s, e] for s, e in mp.ranges],
+                "node_refs": [
+                    [j + offset for j in rr] for rr in mp.node_refs
+                ],
+                "root_index": root_index,
+                "root_total": len(all_roots),
+                "root_path_refs": refs,
+            })
+        payload = {
+            "height": height,
+            "square_size": entry.k,
+            "data_root": entry.data_root.hex(),
+            "samples": [
+                {"row": row, "col": col, "axis": axis}
+                for row, col, axis in sample_list
+            ],
+            "shares": [bytes(s.tobytes()).hex() for s in shares],
+            "trees": trees,
+            "nodes": [nd.hex() for nd in nodes],
+            "root_nodes": [nd.hex() for nd in root_nodes],
+        }
+        lat.observe(time.perf_counter() - t2, phase="assemble")
+
+        # The verification gate decides the reconstructed per-sample
+        # proofs — the exact dataclasses a light client rebuilds from
+        # these bytes — through the batched verifier (sampler._gate ->
+        # serve/verify.verify_proofs): a tampered view or forged root is
+        # a BadProofDetected (502), never a served attestation.
+        t3 = time.perf_counter()
+        from celestia_app_tpu.rpc.codec import share_proofs_from_attestation
+        from celestia_app_tpu.serve.sampler import _verify_gate_armed
+
+        if _verify_gate_armed(entry):
+            self.sampler._gate(entry, share_proofs_from_attestation(payload))
+        lat.observe(time.perf_counter() - t3, phase="verify")
+
+        registry().counter(
+            "celestia_attestation_bytes_total",
+            "attestation response bytes built (canonical render), the "
+            "numerator of bytes-per-verified-sample",
+        ).inc(float(len(render(payload))))
+        registry().counter(
+            "celestia_attestation_samples_total",
+            "samples covered by built attestations",
+        ).inc(float(len(sample_list)))
         return payload

@@ -204,7 +204,6 @@ def default_survivors(height: int, view, honest):
         NAMESPACE_SIZE,
         PARITY_NAMESPACE_BYTES,
     )
-    from celestia_app_tpu.nmt.hasher import NmtHasher
 
     k = view.k
     n = 2 * k
@@ -217,22 +216,26 @@ def default_survivors(height: int, view, honest):
     # ONE gather for every committed level-0 digest (the whole height
     # answers only the retryable status while this runs, so the gather
     # phase must not pay n round trips where one take suffices), then
-    # per-share digest checks only for coordinates that answered.
+    # ONE batched leaf-hash dispatch over every coordinate that answered
+    # (serve/verify.leaf_digests — host NmtHasher fallback byte-identical
+    # via the proof.verify seam): a share that hashes to the wrong
+    # committed digest is excluded, so tampered bytes cannot poison the
+    # repair.
     expect = honest.gather("row", [
         honest.flat_index(r, 0, c) for r in range(n) for c in range(n)
     ]).reshape(n, n, -1)
-    for r in range(n):
-        for c in range(n):
-            if not present[r, c]:
-                continue
-            ns = (
-                bytes(shares[r, c, :NAMESPACE_SIZE].tobytes())
-                if r < k and c < k
-                else PARITY_NAMESPACE_BYTES
-            )
-            leaf = NmtHasher.hash_leaf(ns + bytes(shares[r, c].tobytes()))
-            if leaf != bytes(expect[r, c].tobytes()):
-                present[r, c] = False
+    from celestia_app_tpu.serve.verify import leaf_digests
+
+    coords = [(r, c) for r in range(n) for c in range(n) if present[r, c]]
+    if coords:
+        rows = np.array([r for r, _ in coords])
+        cols = np.array([c for _, c in coords])
+        ns = shares[rows, cols, :NAMESPACE_SIZE].copy()
+        parity = (rows >= k) | (cols >= k)
+        ns[parity] = np.frombuffer(PARITY_NAMESPACE_BYTES, dtype=np.uint8)
+        got = leaf_digests(ns, shares[rows, cols])
+        ok = np.all(got == expect[rows, cols], axis=1)
+        present[rows[~ok], cols[~ok]] = False
     return shares, present
 
 

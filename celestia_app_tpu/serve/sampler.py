@@ -370,8 +370,13 @@ class ProofSampler:
         counted, black-boxed, and raised — never served as valid."""
         if not _verify_gate_armed(entry):
             return proofs
-        for p in proofs:
-            if p.verify(entry.data_root):
+        # One batched device program decides the whole queue
+        # (serve/verify.py); bit-identical to per-proof host verify,
+        # host fallback on any batched fault via the proof.verify seam.
+        from celestia_app_tpu.serve.verify import verify_proofs
+
+        for ok in verify_proofs(proofs, entry.data_root):
+            if ok:
                 continue
             from celestia_app_tpu.chaos.adversary import detections
             from celestia_app_tpu.serve import heal

@@ -1,0 +1,316 @@
+"""Batched proof verification — the read side's verify twin of the sampler.
+
+Every consumer of DAS proofs (das_loadgen's swarm clients, the heal
+engine's survivor check, the sampler's $CELESTIA_SERVE_VERIFY gate)
+used to verify one proof at a time on host via `ShareProof.verify`.
+This module re-decides a whole queue in one jitted program
+(kernels/verify.py) behind the same batched<->host bit-identical seam
+discipline every other lowering uses:
+
+    * `verify_proofs(proofs, data_root)` -> accept/reject vector,
+      IDENTICAL to `[p.verify(root) for p in proofs]` on every input —
+      canonical single-share samples ride the device program (bucketed
+      by tree shape, batch padded to a power of two so recompilation is
+      bounded); anything else (multi-row inclusion proofs, malformed
+      shapes an attacker could hand us) routes to the host verifier,
+      whose verdict the batched path matches by definition.
+    * $CELESTIA_VERIFY_MODE=host pins the pure-host path.
+    * chaos key `verify_fail` (seam `proof.verify`) fails the batched
+      dispatch; the fallback re-decides the WHOLE queue on host and
+      ticks celestia_chaos_recoveries_total{seam="proof.verify"} — the
+      read-side analog of the sampler's proof.serve absorb.
+    * `leaf_digests(ns, shares)` batches the heal engine's survivor
+      check (one dispatch for all gathered coordinates) with the same
+      fallback discipline.
+
+Index plans are host ints derived from the SAME
+`range_proof_node_coords` DFS plan the sampler serves proofs with, so
+batched and host verdicts agree by construction, not by luck.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+
+from celestia_app_tpu import chaos
+from celestia_app_tpu.constants import (
+    NAMESPACE_SIZE,
+    NMT_NODE_SIZE,
+    SHARE_SIZE,
+)
+from celestia_app_tpu.nmt.hasher import NmtHasher
+from celestia_app_tpu.nmt.proof import range_proof_node_coords
+
+
+def verify_mode() -> str:
+    """$CELESTIA_VERIFY_MODE: "batched" (default) or "host"."""
+    mode = os.environ.get("CELESTIA_VERIFY_MODE", "batched").strip().lower()
+    return mode if mode in ("batched", "host") else "batched"
+
+
+def _verified_counter():
+    from celestia_app_tpu.trace.metrics import registry
+
+    return registry().counter(
+        "celestia_verified_samples_total",
+        "DAS samples verified, by verifier mode",
+    )
+
+
+@functools.lru_cache(maxsize=8192)
+def _sibling_perm(total: int, start: int) -> tuple[int, ...]:
+    """DFS-position -> level permutation for a single-leaf proof: entry
+    `lvl` is where `prove_range`'s DFS emitted the level-`lvl` sibling
+    (subtree index (start >> lvl) ^ 1).  Derived from the SAME
+    `range_proof_node_coords` plan the sampler serves with, so the
+    batched fold consumes exactly the node the host walk consumes."""
+    coords = range_proof_node_coords(total, start, start + 1)
+    pos = {c: j for j, c in enumerate(coords)}
+    ln = total.bit_length() - 1
+    return tuple(pos[(lvl, (start >> lvl) ^ 1)] for lvl in range(ln))
+
+
+class _Bucket:
+    """Assembly state for one (nmt levels, row levels) tree shape."""
+
+    __slots__ = ("idxs", "ns", "shares", "sibs", "starts", "row_roots",
+                 "slots", "row_slots", "row_parts", "row_paths",
+                 "row_indices", "row_data_roots")
+
+    def __init__(self):
+        self.idxs: list[int] = []
+        self.ns: list[bytes] = []
+        self.shares: list[bytes] = []
+        self.sibs: list[bytes] = []
+        self.starts: list[int] = []
+        self.row_roots: list[bytes] = []
+        self.slots: list[int] = []
+        self.row_slots: dict = {}
+        self.row_parts: list[bytes] = []
+        self.row_paths: list[bytes] = []
+        self.row_indices: list[int] = []
+        self.row_data_roots: list[bytes] = []
+
+
+def _pad_rows(raw: bytes, count: int, width: int, pad_to: int) -> np.ndarray:
+    """bytes of `count` rows -> (pad_to, width) uint8, padding by
+    repeating row 0 (batch padded to a power of two so the jit
+    specializations per tree shape stay bounded)."""
+    arr = np.frombuffer(raw, dtype=np.uint8).reshape(count, width)
+    if pad_to == count:
+        return arr
+    return np.concatenate(
+        [arr, np.broadcast_to(arr[0], (pad_to - count, width))]
+    )
+
+
+def _bit_flags(indices: list[int], levels: int, pad_to: int) -> np.ndarray:
+    """(pad_to, levels) bool: bit `lvl` of each index — fold step `lvl`
+    has the running digest on the RIGHT (sibling folds from the left)."""
+    arr = np.zeros(pad_to, dtype=np.int64)
+    arr[: len(indices)] = indices
+    arr[len(indices):] = indices[0]
+    return ((arr[:, None] >> np.arange(levels)) & 1).astype(bool)
+
+
+def _pow2(n: int) -> int:
+    return 1 << (n - 1).bit_length() if n > 1 else 1
+
+
+def _verify_canonical(proofs, roots, out: np.ndarray) -> list[int]:
+    """Batched verdicts for every CANONICAL sample in the queue, written
+    into `out`; returns the queue positions that are NOT canonical
+    (multi-row inclusion proofs, malformed shapes) for the caller to
+    route to the host verifier.
+
+    Canonical = the DAS-sample shape: single 512-byte share, single row,
+    power-of-two trees, exact node/path counts.  The shape checks are
+    exhaustive on purpose — the batched program assumes fixed sizes, and
+    a malformed proof is attacker input, not a bug.
+
+    One NMT dispatch per tree-shape bucket over all samples + one
+    row-root fold over the bucket's UNIQUE (row root, audit path, data
+    root) triples — s samples of one height share a handful of row
+    roots, so the row leg costs ~n, not ~s."""
+    from celestia_app_tpu.kernels.verify import (
+        fold_row_roots,
+        verify_nmt_samples,
+    )
+
+    rest: list[int] = []
+    buckets: dict[tuple[int, int], _Bucket] = {}
+    for i, proof in enumerate(proofs):
+        try:
+            data = proof.data
+            nmts = proof.share_proofs
+            rp = proof.row_proof
+            if len(data) != 1 or len(nmts) != 1:
+                raise ValueError
+            nmt = nmts[0]
+            share = data[0]
+            namespace = proof.namespace
+            total = nmt.total
+            start = nmt.start
+            nodes = nmt.nodes
+            ln = total.bit_length() - 1
+            if (
+                len(share) != SHARE_SIZE
+                or len(namespace) != NAMESPACE_SIZE
+                or total < 2
+                or total & (total - 1)
+                or nmt.end - start != 1
+                or not 0 <= start < total
+                or len(nodes) != ln
+                or any(len(nd) != NMT_NODE_SIZE for nd in nodes)
+            ):
+                raise ValueError
+            row_roots_f = rp.row_roots
+            paths = rp.proofs
+            rtotal = rp.total
+            row = rp.start_row
+            lr = rtotal.bit_length() - 1
+            if (
+                len(row_roots_f) != 1
+                or len(paths) != 1
+                or rp.end_row - row != 1
+                or len(row_roots_f[0]) != NMT_NODE_SIZE
+                or rtotal < 2
+                or rtotal & (rtotal - 1)
+                or not 0 <= row < rtotal
+                or len(paths[0]) != lr
+                or any(len(h) != 32 for h in paths[0])
+                or len(roots[i]) != 32
+            ):
+                raise ValueError
+        except (TypeError, AttributeError, ValueError):
+            rest.append(i)
+            continue
+        bucket = buckets.get((ln, lr))
+        if bucket is None:
+            bucket = buckets[(ln, lr)] = _Bucket()
+        bucket.idxs.append(i)
+        bucket.ns.append(namespace)
+        bucket.shares.append(share)
+        perm = _sibling_perm(total, start)
+        bucket.sibs.append(b"".join([nodes[j] for j in perm]))
+        bucket.starts.append(start)
+        row_root = row_roots_f[0]
+        bucket.row_roots.append(row_root)
+        key = (row_root, paths[0], row, roots[i])
+        slot = bucket.row_slots.get(key)
+        if slot is None:
+            slot = bucket.row_slots[key] = len(bucket.row_parts)
+            bucket.row_parts.append(row_root)
+            bucket.row_paths.append(b"".join(paths[0]))
+            bucket.row_indices.append(row)
+            bucket.row_data_roots.append(roots[i])
+        bucket.slots.append(slot)
+
+    for (ln, lr), bk in buckets.items():
+        b, u = len(bk.idxs), len(bk.row_parts)
+        bp, up = _pow2(b), _pow2(u)
+        nmt_ok = np.asarray(verify_nmt_samples(
+            _pad_rows(b"".join(bk.ns), b, NAMESPACE_SIZE, bp),
+            _pad_rows(b"".join(bk.shares), b, SHARE_SIZE, bp),
+            _pad_rows(b"".join(bk.sibs), b, ln * NMT_NODE_SIZE, bp).reshape(
+                bp, ln, NMT_NODE_SIZE
+            ),
+            _bit_flags(bk.starts, ln, bp),
+            _pad_rows(b"".join(bk.row_roots), b, NMT_NODE_SIZE, bp),
+        ))[:b]
+        row_ok = np.asarray(fold_row_roots(
+            _pad_rows(b"".join(bk.row_parts), u, NMT_NODE_SIZE, up),
+            _pad_rows(b"".join(bk.row_paths), u, lr * 32, up).reshape(
+                up, lr, 32
+            ),
+            _bit_flags(bk.row_indices, lr, up),
+            _pad_rows(b"".join(bk.row_data_roots), u, 32, up),
+        ))[:u]
+        out[bk.idxs] = nmt_ok & row_ok[bk.slots]
+    return rest
+
+
+def _verify_host(proofs, roots) -> list[bool]:
+    verdicts = [bool(p.verify(r)) for p, r in zip(proofs, roots)]
+    _verified_counter().inc(len(proofs), mode="host")
+    return verdicts
+
+
+def verify_proofs(proofs, data_root) -> list[bool]:
+    """Accept/reject vector for a queue of ShareProofs.
+
+    `data_root` is one 32-byte root for the whole queue or a per-proof
+    sequence (mixed-height queues).  Identical to
+    `[p.verify(root) for p in proofs]` on every input."""
+    proofs = list(proofs)
+    if not proofs:
+        return []
+    if isinstance(data_root, (bytes, bytearray)):
+        roots = [bytes(data_root)] * len(proofs)
+    else:
+        roots = [bytes(r) for r in data_root]
+    if len(roots) != len(proofs):
+        raise ValueError(
+            f"{len(roots)} data roots for {len(proofs)} proofs"
+        )
+    if verify_mode() == "host":
+        return _verify_host(proofs, roots)
+    try:
+        chaos.proof_verify()
+        accept = np.zeros(len(proofs), dtype=bool)
+        rest = _verify_canonical(proofs, roots, accept)
+        if len(rest) < len(proofs):
+            _verified_counter().inc(len(proofs) - len(rest), mode="batched")
+        verdicts = accept.tolist()
+        if rest:
+            host = _verify_host([proofs[i] for i in rest],
+                                [roots[i] for i in rest])
+            for j, i in enumerate(rest):
+                verdicts[i] = host[j]
+        return verdicts
+    except Exception:
+        from celestia_app_tpu.chaos.degrade import recoveries
+
+        recoveries().inc(seam="proof.verify", outcome="degraded")
+        return _verify_host(proofs, roots)
+
+
+def verify_share_proof(proof, data_root: bytes) -> bool:
+    """Single-proof convenience over `verify_proofs`."""
+    return verify_proofs([proof], data_root)[0]
+
+
+def _leaf_digests_host(ns: np.ndarray, shares: np.ndarray) -> np.ndarray:
+    digests = [
+        NmtHasher.hash_leaf(ns[i].tobytes() + shares[i].tobytes())
+        for i in range(len(ns))
+    ]
+    return np.frombuffer(b"".join(digests), dtype=np.uint8).reshape(
+        len(digests), NMT_NODE_SIZE
+    ) if digests else np.zeros((0, NMT_NODE_SIZE), dtype=np.uint8)
+
+
+def leaf_digests(ns: np.ndarray, shares: np.ndarray) -> np.ndarray:
+    """(N, 29) x (N, D) uint8 -> (N, 90) NMT leaf digests in ONE batched
+    dispatch — the heal engine's survivor check rides this instead of a
+    per-coordinate host loop.  Host fallback (NmtHasher.hash_leaf) is
+    byte-identical and reachable via the same `verify_fail` seam."""
+    ns = np.ascontiguousarray(ns, dtype=np.uint8)
+    shares = np.ascontiguousarray(shares, dtype=np.uint8)
+    if len(ns) == 0:
+        return np.zeros((0, NMT_NODE_SIZE), dtype=np.uint8)
+    if verify_mode() == "host":
+        return _leaf_digests_host(ns, shares)
+    try:
+        chaos.proof_verify()
+        from celestia_app_tpu.kernels.verify import nmt_leaf_digests
+
+        return np.asarray(nmt_leaf_digests(ns, shares))
+    except Exception:
+        from celestia_app_tpu.chaos.degrade import recoveries
+
+        recoveries().inc(seam="proof.verify", outcome="degraded")
+        return _leaf_digests_host(ns, shares)

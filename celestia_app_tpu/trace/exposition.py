@@ -21,6 +21,10 @@ hits:
                                  (serve/, the batched proof plane)
     GET /das/shares              namespace-ranged query: ?height=&namespace=
                                  (29-byte hex) -> shares + multi-row proof
+    GET /das/attestation         deduped multiproof for a SET of samples:
+                                 ?height=&samples=r:c[:axis],... -> shared
+                                 NMT/root node tables + per-tree ranges
+                                 (serve/api.attestation_payload)
     GET /heal                    the self-healing loop's state: heights
                                  mid-heal, quarantined heights, last heal
                                  outcome per engine (serve/heal.py)
@@ -191,6 +195,11 @@ def _das_response(kind: str, query: str, plane: str):
                 int(params.get("col", "")),
                 axis=params.get("axis", "row"),
             )
+        elif kind == "attestation":
+            payload = provider.attestation_payload(
+                int(params.get("height", "")),
+                params.get("samples", ""),
+            )
         else:
             payload = provider.shares_payload(
                 int(params.get("height", "")),
@@ -271,6 +280,8 @@ def handle_observability_get(path: str, plane: str = "shared"):
         return _das_response("share_proof", query, plane)
     if p == "/das/shares":
         return _das_response("shares", query, plane)
+    if p == "/das/attestation":
+        return _das_response("attestation", query, plane)
     if p == "/metrics":
         return 200, METRICS_CONTENT_TYPE, metrics_payload()
     if p == "/healthz":

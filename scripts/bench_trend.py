@@ -350,6 +350,23 @@ def load_das_round(path: str) -> dict:
             ),
             "slo_burn": float(cols["slo_burn"]),
         }
+    # The verify-plane block (das_loadgen --attest): batched vs host
+    # verified-samples/sec and attestation vs independent bytes-per-
+    # sample.  Optional — pre-verify rounds stay valid — but when
+    # present every gated column must be there, or the record is as
+    # broken as a missing proofs_per_s.
+    rec["verify"] = {}
+    if raw.get("verify") is not None:
+        ver = raw["verify"]
+        for key in (
+            "verified_per_s_batched", "verified_per_s_host",
+            "attest_bytes_per_sample", "independent_bytes_per_sample",
+        ):
+            if not isinstance(ver, dict) or ver.get(key) is None:
+                raise MalformedRound(
+                    f"{path}: verify block missing {key!r}"
+                )
+            rec["verify"][key] = float(ver[key])
     return rec
 
 
@@ -445,6 +462,24 @@ def find_das_regressions(das_rounds: list[dict], threshold_pct: float) -> list[d
                 )
                 if hit:
                     out.append(hit)
+        # The verify plane (rounds carrying a --attest block): batched
+        # verified-samples/sec gates like a rate, attestation bytes-per-
+        # sample like a parts time (lower better — the dedup is the
+        # point).  Rounds without the block are neither priors nor
+        # regressions (plan gap, see das_plan_gaps).
+        if das_rounds[-1].get("verify"):
+            with_verify = [r for r in das_rounds if r.get("verify")]
+            for key, better in (
+                ("verified_per_s_batched", "higher"),
+                ("attest_bytes_per_sample", "lower"),
+            ):
+                hit = _gate_das_points(
+                    [(r["round"], r["verify"][key]) for r in with_verify],
+                    platforms, key, better, threshold_pct,
+                    f"das.verify.{key}",
+                )
+                if hit:
+                    out.append(hit)
     return out
 
 
@@ -479,6 +514,11 @@ def das_plan_gaps(das_rounds: list[dict]) -> list[str]:
                 f"das sweep shards={shards} first measured in "
                 f"r{newest['round']:02d} (plan gap, not STALE)"
             )
+    if newest.get("verify") and all(not r.get("verify") for r in priors):
+        gaps.append(
+            f"das verify plane (--attest) first measured in "
+            f"r{newest['round']:02d} (plan gap, not STALE)"
+        )
     return gaps
 
 
@@ -1033,6 +1073,9 @@ def write_metrics_out(out_dir: str, rounds: list[dict],
                              shards=shards,
                              proofs_per_s=row["proofs_per_s"],
                              proof_p99_ms=row["proof_p99_ms"])
+            for key, value in sorted((r.get("verify") or {}).items()):
+                das.set(value, series=f"verify.{key}",
+                        round=f"r{r['round']:02d}")
     for reg_row in regressions:
         tracer.write("bench_trend", regression=True, **reg_row)
     with open(os.path.join(out_dir, "bench_trend.prom"), "w") as f:

@@ -1576,6 +1576,105 @@ def run_batched_fault_drill(k: int = 4, blocks: int = 6,
     }
 
 
+def run_attestation_drill(k: int = 4, samples: int = 12) -> dict:
+    """The verify plane's fault drill, attestation-shaped.
+
+    Leg 1 (verify_fail identity): one deduped multiproof attestation is
+    assembled, its proofs reconstructed, and ONE share tampered so the
+    accept/reject vector is non-trivial.  The batched verdict must not
+    tick celestia_recoveries_total{seam="proof.verify"} when healthy;
+    under `verify_fail=1.0` every batched dispatch fails onto the host
+    path, which must return the IDENTICAL vector (and the identical
+    attestation bytes) while the recovery counter ticks.
+
+    Leg 2 (tampered 502): a malform adversary corrupts shares under
+    honest forests — an attestation covering a corrupted coordinate
+    must REFUSE (BadProofDetected, the refusal every plane renders
+    502) rather than hand out bytes that cannot verify."""
+    from celestia_app_tpu import chaos
+    from celestia_app_tpu.rpc.codec import share_proofs_from_attestation
+    from celestia_app_tpu.serve.api import render
+    from celestia_app_tpu.serve.sampler import BadProofDetected
+    from celestia_app_tpu.serve.verify import verify_proofs
+    from celestia_app_tpu.trace.metrics import registry
+
+    def _verify_falls() -> float:
+        for labels, val in registry().counter(
+            "celestia_recoveries_total", ""
+        ).samples():
+            if (labels.get("seam") == "proof.verify"
+                    and labels.get("outcome") == "degraded"):
+                return val
+        return 0.0
+
+    def _tampered(payload: dict) -> list:
+        forged = dict(payload)
+        forged["shares"] = list(payload["shares"])
+        raw = bytearray(bytes.fromhex(forged["shares"][0]))
+        raw[100] ^= 0xFF  # past the namespace prefix: data corruption
+        forged["shares"][0] = raw.hex()
+        return share_proofs_from_attestation(forged)
+
+    eds, dah, entry, provider = _adv_square(k, seed=818)
+    root = eds.data_root()
+    n = 2 * k
+    rng = np.random.default_rng(828)
+    coords = set()
+    while len(coords) < min(samples, n * n):
+        r, c = int(rng.integers(0, n)), int(rng.integers(0, n))
+        axis = "row" if rng.integers(0, 2) else "col"
+        coords.add((r, c, axis))
+    spec = ",".join(f"{r}:{c}:{axis}" for r, c, axis in sorted(coords))
+
+    chaos.install("")  # baseline leg: no injection even with env chaos
+    t0_ns = time.time_ns()
+    try:
+        payload = provider.attestation_payload(1, spec)
+        base_bytes = render(payload)
+        before = _verify_falls()
+        base_verdicts = verify_proofs(_tampered(payload), root)
+        healthy_falls = _verify_falls() - before
+
+        chaos.install("seed=17,verify_fail=1.0")
+        drilled = provider.attestation_payload(1, spec)
+        drilled_bytes = render(drilled)
+        before = _verify_falls()
+        drilled_verdicts = verify_proofs(_tampered(drilled), root)
+        fallback_falls = _verify_falls() - before
+
+        chaos.install("seed=13,malform_shares=4")
+        adv = chaos.active_adversary()
+        bad_r, bad_c = sorted(adv.malformed_coords(1, n))[0]
+        try:
+            provider.attestation_payload(1, f"{bad_r}:{bad_c},0:0")
+            tampered_refused = False
+        except BadProofDetected:
+            tampered_refused = True
+    finally:
+        chaos.uninstall()
+
+    return {
+        "k": k,
+        "samples": len(base_verdicts),
+        "attest_bytes": len(base_bytes),
+        "bytes_identical": drilled_bytes == base_bytes,
+        "verdicts_identical": drilled_verdicts == base_verdicts,
+        "rejects": base_verdicts.count(False),
+        "healthy_falls": healthy_falls,
+        "fallback_falls": fallback_falls,
+        "tampered_refused": tampered_refused,
+        "ok": (
+            drilled_bytes == base_bytes
+            and drilled_verdicts == base_verdicts
+            and base_verdicts.count(False) == 1
+            and healthy_falls == 0
+            and fallback_falls >= 1
+            and tampered_refused
+        ),
+        "detection": _detection(t0_ns),
+    }
+
+
 def run_qos_drill(budget: int = 40_960, quantum: int = 1024,
                   shards: int = 8) -> dict:
     """QoS enforcement drill — the observe -> enforce loop's write path.
@@ -1899,6 +1998,17 @@ def main(argv=None) -> int:
           f"final_mode={bat['final_mode']}", flush=True)
     if not bat["ok"]:
         failures.append(f"batched-fault drill failed: {bat}")
+
+    att = run_attestation_drill(k=min(args.k, 8))
+    print(f"attestation drill: {att['samples']} samples @ k={att['k']} "
+          f"({att['attest_bytes']} attest bytes) -> "
+          f"bytes_identical={att['bytes_identical']} "
+          f"verdicts_identical={att['verdicts_identical']} "
+          f"rejects={att['rejects']} "
+          f"fallback_falls={att['fallback_falls']:.0f} "
+          f"tampered_refused={att['tampered_refused']}", flush=True)
+    if not att["ok"]:
+        failures.append(f"attestation drill failed: {att}")
 
     qd = run_qos_drill()
     print(f"QoS drill: spam_throttled={qd['spam_throttled']} "

@@ -105,6 +105,7 @@ def _run_plan(sampler, cache, plan, threads, verify_every, roots):
     A ShareWithheld is NOT a failure — it is the adversarial 410 path
     the run exists to exercise — and it never kills a worker."""
     from celestia_app_tpu.serve.sampler import ShareWithheld
+    from celestia_app_tpu.serve.verify import verify_share_proof
 
     latencies: list[float] = []
     failures: list[str] = []
@@ -134,7 +135,11 @@ def _run_plan(sampler, cache, plan, threads, verify_every, roots):
             dt = time.perf_counter() - t0
             ok = True
             if i % verify_every == 0:
-                ok = proof.verify(roots[h])
+                # The client-side check rides the batched verifier
+                # (serve/verify.py — host fallback bit-identical), the
+                # same program a light-client fleet amortizes queues
+                # through.
+                ok = verify_share_proof(proof, roots[h])
             with lock:
                 latencies.append(dt)
                 if not ok:
@@ -205,6 +210,18 @@ def run_local(args) -> dict:
             for _ in range(args.samples)
         ]
         verify_every = max(1, args.samples // max(args.verify, 1))
+        # Warm the serve AND verify programs off the clock (the swarm
+        # leg's gather-warm pattern): the first batched verify pays the
+        # jit compile — seconds on CPU — which must not land inside the
+        # measured pass.  One bucket covers both axes at a fixed k.
+        try:
+            from celestia_app_tpu.serve.verify import verify_share_proof
+
+            entry, _ = cache.get(1)
+            warm = sampler.sample_batch(entry, [(0, 0)])
+            verify_share_proof(warm[0], roots[1])
+        except Exception:  # noqa: BLE001 — warmup only (withheld (0,0) etc.)
+            pass
         lat_ms, failures, withheld, wall_s = _run_plan(
             sampler, cache, plan, args.threads, verify_every, roots
         )
@@ -434,7 +451,16 @@ def _run_swarm_leg(args, shards: int, squares, plan, eds_by_height
         # sharded program is compiled per pow-2 slot bucket, so warm
         # every bucket a realistic micro-batch can land on.
         entry, _ = cache.get(args.heights)
-        sampler.sample_batch(entry, [(0, 0), (1, 1)])
+        warm_proofs = sampler.sample_batch(entry, [(0, 0), (1, 1)])
+        # Verify-program warmup rides the same off-the-clock window: the
+        # workers' batched client-side check must never pay the compile
+        # inside the open-loop pass.
+        try:
+            from celestia_app_tpu.serve.verify import verify_share_proof
+
+            verify_share_proof(warm_proofs[0], roots[args.heights])
+        except Exception:  # noqa: BLE001 — warmup only
+            pass
         # The shard count the plane ACTUALLY admitted under (serve_shards
         # clamps to the device count): sweep rows must record the mesh
         # that ran, or bench_trend gates the wrong scaling-curve series.
@@ -459,6 +485,7 @@ def _run_swarm_leg(args, shards: int, squares, plan, eds_by_height
                 q.put(None)
 
         from celestia_app_tpu.qos import QosThrottled
+        from celestia_app_tpu.serve.verify import verify_share_proof
 
         def worker():
             while True:
@@ -470,7 +497,8 @@ def _run_swarm_leg(args, shards: int, squares, plan, eds_by_height
                 try:
                     entry = provider.entry(h)
                     proof = sampler.share_proof(entry, r, c, axis=axis)
-                    if i % verify_every == 0 and not proof.verify(roots[h]):
+                    if (i % verify_every == 0
+                            and not verify_share_proof(proof, roots[h])):
                         err = "proof failed verify"
                 except QosThrottled:
                     # The 429 path: a refusal is the ENFORCEMENT being
@@ -720,9 +748,103 @@ def run_qos(args) -> dict:
     }
 
 
+def attest_verify_block(args) -> dict:
+    """The --attest A/B legs: verified-samples/sec of the BATCHED device
+    verifier vs the per-proof host path on an identical reconstructed
+    proof queue, plus bytes-per-verified-sample of the deduped multiproof
+    attestation vs fetching the same samples as independent share_proof
+    responses.  Both paths must agree on every verdict (and every verdict
+    must be True — the squares are honest), or the block reports the
+    mismatch as a failure instead of a number."""
+    from celestia_app_tpu.rpc.codec import share_proofs_from_attestation
+    from celestia_app_tpu.serve.api import DasProvider, render
+    from celestia_app_tpu.serve.verify import verify_proofs
+
+    cache, roots = build_cache(args.heights, args.k, args.seed)
+    provider = DasProvider(cache=cache)
+    n = 2 * args.k
+    s = args.attest
+    rng = np.random.default_rng(args.seed + 7)
+    axes = ("row", "col") if args.axes == "both" else (args.axes,)
+    rounds = max(1, args.samples // s)
+
+    proofs, proof_roots = [], []
+    attest_bytes = independent_bytes = 0
+    failures: list[str] = []
+    for i in range(rounds):
+        h = 1 + i % args.heights
+        seen: set = set()
+        while len(seen) < s:
+            seen.add((
+                int(rng.integers(0, n)), int(rng.integers(0, n)),
+                axes[int(rng.integers(0, len(axes)))],
+            ))
+        spec = ",".join(f"{r}:{c}:{a}" for r, c, a in sorted(seen))
+        payload = provider.attestation_payload(h, spec)
+        attest_bytes += len(render(payload))
+        for sample in payload["samples"]:
+            independent_bytes += len(render(provider.share_proof_payload(
+                h, sample["row"], sample["col"], sample["axis"]
+            )))
+        for proof in share_proofs_from_attestation(payload):
+            proofs.append(proof)
+            proof_roots.append(roots[h])
+
+    total = len(proofs)
+    walls: dict[str, float] = {}
+    saved = os.environ.get("CELESTIA_VERIFY_MODE")
+    try:
+        for mode in ("batched", "host"):
+            os.environ["CELESTIA_VERIFY_MODE"] = mode
+            warm = min(64, total)
+            verify_proofs(proofs[:warm], proof_roots[:warm])
+            best = None
+            verdicts = None
+            for _ in range(3):
+                t0 = time.perf_counter()
+                verdicts = verify_proofs(proofs, proof_roots)
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            walls[mode] = best
+            if not all(verdicts):
+                failures.append(
+                    f"{mode} verify rejected "
+                    f"{sum(1 for v in verdicts if not v)}/{total} honest "
+                    "proofs"
+                )
+    finally:
+        if saved is None:
+            os.environ.pop("CELESTIA_VERIFY_MODE", None)
+        else:
+            os.environ["CELESTIA_VERIFY_MODE"] = saved
+
+    return {
+        "attest_samples": s,
+        "rounds": rounds,
+        "queue": total,
+        "verified_per_s_batched": round(total / walls["batched"], 2),
+        "verified_per_s_host": round(total / walls["host"], 2),
+        "verify_speedup": round(walls["host"] / walls["batched"], 3),
+        "attest_bytes_per_sample": round(attest_bytes / total, 2),
+        "independent_bytes_per_sample": round(
+            independent_bytes / total, 2
+        ),
+        "bytes_ratio": round(attest_bytes / independent_bytes, 4),
+        "failures": failures,
+    }
+
+
 def run_url(args) -> dict:
-    """Sample a live node's GET /das/share_proof over HTTP."""
+    """Sample a live node's GET /das/share_proof over HTTP, verifying
+    every --verify-th fetched proof client-side through the BATCHED
+    verifier (serve/verify.py — the light-client contract, decided by
+    the same program the serve side trusts).  A proof that fails to
+    verify is a failure AND an SLO violation: the run reports `slo_burn`
+    against --slo-ms with verify failures burning budget like drops."""
     import urllib.request
+
+    from celestia_app_tpu.rpc.codec import share_proof_from_json
+    from celestia_app_tpu.serve.verify import verify_share_proof
 
     # Probe the square size from a first sample at (0, 0).
     def get(h, r, c):
@@ -737,22 +859,37 @@ def run_url(args) -> dict:
     rng = np.random.default_rng(args.seed)
     lat_ms: list[float] = []
     failures: list[str] = []
+    verified = 0
+    verify_every = max(1, args.samples // max(args.verify, 1))
     t_start = time.perf_counter()
-    for _ in range(args.samples):
+    for i in range(args.samples):
         r, c = int(rng.integers(0, n)), int(rng.integers(0, n))
         t0 = time.perf_counter()
         try:
-            get(args.height, r, c)
+            payload = get(args.height, r, c)
+            if i % verify_every == 0:
+                proof = share_proof_from_json(payload["proof"])
+                root = bytes.fromhex(payload["data_root"])
+                verified += 1
+                if not verify_share_proof(proof, root):
+                    failures.append(f"({r},{c}): proof failed verify")
+                    continue
             lat_ms.append((time.perf_counter() - t0) * 1e3)
         except Exception as e:  # noqa: BLE001
             failures.append(f"({r},{c}): {type(e).__name__}: {e}")
     wall_s = time.perf_counter() - t_start
     lat_ms.sort()
+    over = sum(1 for v in lat_ms if v > args.slo_ms) + len(failures)
     return {
         "metric": "das_loadgen",
         "mode": "url",
         "url": args.url,
         **_pass_stats(lat_ms, wall_s),
+        "verified": verified,
+        "slo_ms": args.slo_ms,
+        "slo_burn": (
+            round((over / args.samples) / 0.01, 3) if args.samples else 0.0
+        ),
         "failures": failures[:5],
         "platform": None,
     }
@@ -846,6 +983,12 @@ def main(argv=None) -> int:
                     help="run the QoS enforcement legs (baseline vs "
                          "spam under one $CELESTIA_QOS policy) and "
                          "write the bench_trend round record here")
+    ap.add_argument("--attest", type=int, default=0, metavar="S",
+                    help="run the attestation verify legs on top of the "
+                         "closed-loop pass: S samples per GET "
+                         "/das/attestation multiproof; records batched- "
+                         "vs host-verified samples/sec and bytes-per-"
+                         "verified-sample vs S independent share_proofs")
     ap.add_argument("--url", default=None,
                     help="sample a live node's /das/share_proof instead")
     ap.add_argument("--height", type=int, default=1,
@@ -881,6 +1024,11 @@ def main(argv=None) -> int:
             summary = run_swarm(args)
         else:
             summary = run_local(args)
+            if args.attest:
+                summary["verify"] = attest_verify_block(args)
+                summary["failures"] = (
+                    summary["failures"] + summary["verify"]["failures"]
+                )
     finally:
         if args.mode:
             if saved is None:
@@ -939,6 +1087,14 @@ def main(argv=None) -> int:
             "mode": summary["mode"],
             "platform": summary.get("platform"),
         }
+        if summary.get("verify") is not None:
+            # The verify-plane A/B (--attest): batched vs host verified-
+            # samples/sec + attestation vs independent bytes-per-sample
+            # — the two series bench_trend rate-gates for this plane.
+            record["verify"] = {
+                k: v for k, v in summary["verify"].items()
+                if k != "failures"
+            }
         if summary.get("workload") == "swarm":
             # das-v2: the swarm round shape bench_trend learns — sweep
             # rows are the scaling curve, tenant columns the SLO story.

@@ -1,0 +1,397 @@
+"""Deduped multiproof attestations: the nmt multiproof table, the
+attestation payload (GET /das/attestation), per-sample reconstruction
+(rpc/codec.share_proofs_from_attestation), the batched/host verifier
+parity on reconstructed proofs, and the three-plane byte identity.
+
+Runs without the signing stack — squares are deterministic synthetic
+blocks admitted straight into a ForestCache (same fixture family as
+tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.constants import NAMESPACE_SIZE, SHARE_SIZE
+from celestia_app_tpu.da.eds import ExtendedDataSquare
+from celestia_app_tpu.nmt.proof import (
+    multiproof_from_levels,
+    prove_range,
+    split_multiproof,
+    verify_multiproof,
+)
+from celestia_app_tpu.nmt.tree import NamespacedMerkleTree
+from celestia_app_tpu.rpc.codec import (
+    share_proof_from_json,
+    share_proofs_from_attestation,
+)
+from celestia_app_tpu.serve.api import (
+    MAX_ATTESTATION_SAMPLES,
+    DasProvider,
+    UnknownHeight,
+    parse_attestation_samples,
+    render,
+)
+from celestia_app_tpu.serve.cache import ForestCache
+from celestia_app_tpu.serve.verify import verify_proofs
+from celestia_app_tpu.trace.metrics import registry
+
+
+def det_square(k: int, seed: int = 1) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    ns = np.sort(rng.integers(0, 128, k * k).astype(np.uint8))
+    ods = rng.integers(0, 256, (k * k, SHARE_SIZE), dtype=np.uint8)
+    ods[:, :NAMESPACE_SIZE] = 0
+    ods[:, NAMESPACE_SIZE - 1] = ns
+    return ods.reshape(k, k, SHARE_SIZE)
+
+
+def make_eds(k: int = 4, seed: int = 1) -> ExtendedDataSquare:
+    return ExtendedDataSquare.compute(det_square(k, seed))
+
+
+def _counter_value(name: str, **labels) -> float:
+    metric = registry().get(name)
+    if metric is None:
+        return 0.0
+    return sum(
+        value for sample_labels, value in metric.samples()
+        if all(sample_labels.get(k) == v for k, v in labels.items())
+    )
+
+
+def _nmt_tree(n: int, seed: int = 7):
+    rng = np.random.default_rng(seed)
+    leaves = [
+        bytes([0] * (NAMESPACE_SIZE - 1) + [i // 2])
+        + rng.integers(0, 256, 24, dtype=np.uint8).tobytes()
+        for i in range(n)
+    ]
+    tree = NamespacedMerkleTree()
+    for leaf in leaves:
+        tree.push(leaf)
+    return tree, leaves
+
+
+class TestNmtMultiproof:
+    def test_split_is_byte_identical_to_solo_prove_range(self):
+        """Reconstructing any range from the deduped table is pure
+        indexing — byte-identical to proving that range alone."""
+        tree, _ = _nmt_tree(16)
+        ranges = [(0, 1), (3, 5), (8, 9), (12, 16)]
+        mp = multiproof_from_levels(tree.levels(), ranges)
+        assert mp.total == 16
+        solo = [prove_range(tree, s, e) for s, e in ranges]
+        assert split_multiproof(mp) == solo
+
+    def test_shared_nodes_are_deduped_exactly(self):
+        """Sibling leaves 2 and 3 of an 8-leaf tree share their two
+        upper audit nodes: 6 refs, but only 4 unique table nodes."""
+        tree, _ = _nmt_tree(8)
+        mp = multiproof_from_levels(tree.levels(), [(2, 3), (3, 4)])
+        assert sum(len(r) for r in mp.node_refs) == 6
+        assert len(mp.nodes) == 4
+        # And the dedup is lossless: both ranges still reconstruct solo.
+        assert split_multiproof(mp) == [
+            prove_range(tree, 2, 3), prove_range(tree, 3, 4)
+        ]
+
+    def test_verify_multiproof_accepts_and_rejects(self):
+        tree, leaves = _nmt_tree(16)
+        root = tree.root()
+        ranges = [(1, 3), (9, 10)]
+        mp = multiproof_from_levels(tree.levels(), ranges)
+        good = [leaves[s:e] for s, e in ranges]
+        assert verify_multiproof(root, mp, good)
+        # Tampered leaf data.
+        bad = [list(part) for part in good]
+        bad[0][1] = bytes(NAMESPACE_SIZE) + b"evil"
+        assert not verify_multiproof(root, mp, bad)
+        # Wrong root.
+        assert not verify_multiproof(b"\xee" * len(root), mp, good)
+        # Range-count mismatch.
+        assert not verify_multiproof(root, mp, good[:1])
+
+    def test_non_contiguous_and_full_width_sets(self):
+        tree, leaves = _nmt_tree(16)
+        root = tree.root()
+        for ranges in ([(0, 1), (15, 16)], [(0, 16)],
+                       [(0, 2), (4, 6), (8, 10), (12, 14)]):
+            mp = multiproof_from_levels(tree.levels(), ranges)
+            assert verify_multiproof(
+                root, mp, [leaves[s:e] for s, e in ranges]
+            )
+
+    def test_malformed_range_sets_raise(self):
+        tree, _ = _nmt_tree(8)
+        levels = tree.levels()
+        with pytest.raises(ValueError):
+            multiproof_from_levels(levels, [])  # empty set
+        with pytest.raises(ValueError):
+            multiproof_from_levels(levels, [(2, 2)])  # empty range
+        with pytest.raises(ValueError):
+            multiproof_from_levels(levels, [(0, 9)])  # out of bounds
+        with pytest.raises(ValueError):
+            multiproof_from_levels(levels, [(0, 3), (2, 5)])  # overlap
+        with pytest.raises(ValueError):
+            multiproof_from_levels(levels, [(4, 6), (0, 2)])  # unsorted
+
+
+class TestParseAttestationSamples:
+    def test_canonical_order_and_dedup(self):
+        """Spec order never matters: parse sorts by (axis, tree, leaf)
+        and drops duplicates, so the payload bytes are structural."""
+        spec = "3:1,0:2,3:1,1:2:col,0:2:row"
+        out = parse_attestation_samples(spec)
+        # "col" sorts before "row"; within an axis, by (tree, leaf).
+        assert out == [(1, 2, "col"), (0, 2, "row"), (3, 1, "row")]
+        shuffled = parse_attestation_samples("1:2:col,3:1,0:2")
+        assert shuffled == out
+
+    def test_col_axis_sorts_by_column_tree(self):
+        out = parse_attestation_samples("5:0:col,2:0:col,9:3:col")
+        assert out == [(2, 0, "col"), (5, 0, "col"), (9, 3, "col")]
+
+    @pytest.mark.parametrize("bad", [
+        "", "   ", "1", "1:2:diag", "1:x", "-1:2", "2:-7", "1:2:3:4",
+    ])
+    def test_malformed_specs_raise(self, bad):
+        with pytest.raises(ValueError):
+            parse_attestation_samples(bad)
+
+    def test_sample_cap_enforced(self):
+        over = ",".join(
+            f"{i}:0" for i in range(MAX_ATTESTATION_SAMPLES + 1)
+        )
+        with pytest.raises(ValueError, match="cap"):
+            parse_attestation_samples(over)
+        # Duplicates don't count against the cap.
+        dup = ",".join("0:0" for _ in range(MAX_ATTESTATION_SAMPLES + 1))
+        assert parse_attestation_samples(dup) == [(0, 0, "row")]
+
+
+@pytest.fixture()
+def provider():
+    cache = ForestCache(heights=2, spill=2)
+    cache.put(1, make_eds(k=4, seed=11))
+    return DasProvider(cache=cache)
+
+
+# Mixed-axis spec over the k=4 square: shared rows/columns, parity
+# quadrant included — the dedup's best case and the codec's edge cases.
+SPEC = "0:0,0:1,0:5,2:3,5:5,7:2,1:1:col,3:1:col,6:1:col"
+
+
+class TestAttestationPayload:
+    def test_reconstructed_proofs_match_solo_share_proofs(self, provider):
+        """Every per-sample proof indexed out of the attestation tables
+        equals the solo GET /das/share_proof proof for that coordinate —
+        the whole dedup is wire-level only."""
+        payload = provider.attestation_payload(1, SPEC)
+        proofs = share_proofs_from_attestation(payload)
+        samples = payload["samples"]
+        assert len(proofs) == len(samples) == 9
+        root = bytes.fromhex(payload["data_root"])
+        for sample, proof in zip(samples, proofs):
+            solo = provider.share_proof_payload(
+                1, sample["row"], sample["col"], axis=sample["axis"]
+            )
+            assert proof == share_proof_from_json(solo["proof"])
+            assert proof.verify(root)
+
+    def test_batched_and_host_verifiers_agree_on_reconstruction(
+        self, provider, monkeypatch
+    ):
+        """The batched verifier decides reconstructed attestation proofs
+        exactly like per-proof host verify() — including a reject for a
+        tampered share (flipped data byte past the namespace prefix)."""
+        payload = provider.attestation_payload(1, SPEC)
+        forged = dict(payload)
+        forged["shares"] = list(payload["shares"])
+        raw = bytearray(bytes.fromhex(forged["shares"][2]))
+        raw[100] ^= 0xFF
+        forged["shares"][2] = raw.hex()
+        proofs = share_proofs_from_attestation(forged)
+        root = bytes.fromhex(payload["data_root"])
+        want = [i != 2 for i in range(len(proofs))]
+        monkeypatch.setenv("CELESTIA_VERIFY_MODE", "host")
+        assert verify_proofs(proofs, root) == want
+        monkeypatch.setenv("CELESTIA_VERIFY_MODE", "batched")
+        assert verify_proofs(proofs, root) == want
+
+    def test_dedup_beats_independent_share_proofs(self, provider):
+        """The attestation's reason to exist: one payload for s samples
+        is smaller than s independent share_proof payloads."""
+        payload = provider.attestation_payload(1, SPEC)
+        solo_bytes = sum(
+            len(render(provider.share_proof_payload(
+                1, s["row"], s["col"], axis=s["axis"]
+            )))
+            for s in payload["samples"]
+        )
+        assert len(render(payload)) < solo_bytes
+
+    def test_duplicate_samples_collapse(self, provider):
+        payload = provider.attestation_payload(1, "2:3,2:3,2:3,0:0")
+        assert payload["samples"] == [
+            {"row": 0, "col": 0, "axis": "row"},
+            {"row": 2, "col": 3, "axis": "row"},
+        ]
+
+    def test_refusals_and_errors(self, provider):
+        with pytest.raises(UnknownHeight):
+            provider.attestation_payload(9, "0:0")
+        with pytest.raises(ValueError):
+            provider.attestation_payload(1, "0:99")  # outside 8x8
+        with pytest.raises(ValueError):
+            provider.attestation_payload(1, "")  # empty spec
+
+    def test_withheld_refuses_410_tampered_refuses_502(self, provider):
+        from celestia_app_tpu import chaos
+        from celestia_app_tpu.serve.sampler import (
+            BadProofDetected,
+            ShareWithheld,
+        )
+
+        chaos.install("seed=11,withhold_frac=0.25")
+        try:
+            adv = chaos.active_adversary()
+            hit = next(iter(adv.withheld_set(1, 8)))
+            with pytest.raises(ShareWithheld):
+                provider.attestation_payload(1, f"{hit[0]}:{hit[1]}")
+        finally:
+            chaos.uninstall()
+        chaos.install("seed=11,wrong_root=1")
+        try:
+            with pytest.raises(BadProofDetected):
+                provider.attestation_payload(1, "0:0,1:1")
+        finally:
+            chaos.uninstall()
+
+    def test_byte_and_sample_counters_tick(self, provider):
+        before_b = _counter_value("celestia_attestation_bytes_total")
+        before_s = _counter_value("celestia_attestation_samples_total")
+        payload = provider.attestation_payload(1, "0:0,4:4")
+        assert _counter_value(
+            "celestia_attestation_bytes_total"
+        ) == before_b + len(render(payload))
+        assert _counter_value(
+            "celestia_attestation_samples_total"
+        ) == before_s + 2
+
+
+class _StubNode:
+    chain_id = "attest-test"
+
+    def __init__(self):
+        self.cache = ForestCache(heights=2, spill=2)
+        self.eds = make_eds(k=4, seed=11)
+        self.cache.put(1, self.eds)
+        self._provider = DasProvider(cache=self.cache)
+
+    def das_provider(self):
+        return self._provider
+
+
+class TestAttestationPlanes:
+    """GET /das/attestation on the shared handler + JSON-RPC
+    GetAttestation + gRPC Das/GetAttestation: one payload builder,
+    byte-identical everywhere."""
+
+    @pytest.fixture()
+    def planes(self):
+        pytest.importorskip("grpc")
+        from celestia_app_tpu.rpc.api_gateway import serve_api
+        from celestia_app_tpu.rpc.grpc_plane import GrpcNode, serve_grpc
+        from celestia_app_tpu.trace.exposition import (
+            register_das_provider,
+            unregister_das_provider,
+        )
+
+        node = _StubNode()
+        register_das_provider(node.das_provider())
+        gw = serve_api(node)
+        plane = serve_grpc(node)
+        client = GrpcNode(plane.target)
+        try:
+            yield node, gw, plane, client
+        finally:
+            client.close()
+            gw.stop()
+            plane.stop()
+            unregister_das_provider()
+
+    def test_three_planes_serve_identical_bytes(self, planes):
+        try:  # JSON-RPC leg is crypto-gated (rpc/server imports keys)
+            from celestia_app_tpu.rpc.server import ServingNode
+        except ModuleNotFoundError:
+            ServingNode = None
+
+        node, gw, plane, client = planes
+        spec = "0:0,0:1,2:3,1:1:col"
+        path = f"/das/attestation?height=1&samples={spec}"
+        bodies = []
+        for url in (gw.url, plane.debug_url):
+            with urllib.request.urlopen(url + path, timeout=10) as resp:
+                assert resp.status == 200
+                bodies.append(resp.read())
+        assert bodies[0] == bodies[1]
+        # The real gRPC service carries the SAME canonical bytes...
+        assert client.attestation_bytes(1, spec) == bodies[0]
+        # ...and so does the JSON-RPC method (the payload dict renders
+        # to the same canonical bytes on the wire).
+        if ServingNode is not None:
+            rpc_payload = ServingNode.rpc_get_attestation(node, 1, spec)
+            assert render(rpc_payload) == bodies[0]
+        # The body round-trips into verifying per-sample proofs.
+        payload = json.loads(bodies[0])
+        root = bytes.fromhex(payload["data_root"])
+        for proof in share_proofs_from_attestation(payload):
+            assert proof.verify(root)
+
+    def test_spec_order_does_not_change_the_bytes(self, planes):
+        node, gw, plane, client = planes
+        a = client.attestation_bytes(1, "0:0,2:3,1:1:col")
+        b = client.attestation_bytes(1, "1:1:col,2:3,0:0,2:3")
+        assert a == b
+
+    def test_error_statuses_on_http_and_grpc(self, planes):
+        import grpc
+
+        node, gw, plane, client = planes
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                gw.url + "/das/attestation?height=9&samples=0:0", timeout=10
+            )
+        assert exc.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as exc2:
+            urllib.request.urlopen(
+                gw.url + "/das/attestation?height=1&samples=zap", timeout=10
+            )
+        assert exc2.value.code == 400
+        with pytest.raises(grpc.RpcError) as gexc:
+            client.attestation_bytes(1, "zap")
+        assert gexc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    def test_withheld_is_410_on_http(self, planes):
+        from celestia_app_tpu import chaos
+
+        node, gw, plane, client = planes
+        chaos.install("seed=11,withhold_frac=0.25")
+        try:
+            adv = chaos.active_adversary()
+            hit = next(iter(adv.withheld_set(1, 8)))
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    gw.url + "/das/attestation?height=1"
+                    f"&samples={hit[0]}:{hit[1]}",
+                    timeout=10,
+                )
+            assert exc.value.code == 410
+        finally:
+            chaos.uninstall()

@@ -300,6 +300,18 @@ class TestChaosSmoke:
         assert result["unbatched_falls"] >= 1
         assert result["final_mode"] == "staged"
 
+    def test_attestation_drill_identity_and_refusal(self):
+        """verify_fail=1.0 forces the batched verifier onto the host
+        path: the accept/reject vector and attestation bytes stay
+        identical, recoveries tick only on the drilled leg, and a
+        malformed square's attestation refuses (BadProofDetected)."""
+        soak = _load_soak()
+        result = soak.run_attestation_drill(k=2, samples=6)
+        assert result["ok"], result
+        assert result["healthy_falls"] == 0
+        assert result["fallback_falls"] >= 1
+        assert result["tampered_refused"]
+
     def test_withholding_drill_detection_curve(self, monkeypatch, tmp_path):
         """The ISSUE-10 withholding drill at smoke scale: monotone
         detection curve, honest leg bit-identical with every adversary

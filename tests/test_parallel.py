@@ -21,7 +21,12 @@ def random_ods(k: int, seed: int) -> np.ndarray:
 # (8, 8) dropped from the sweep: (16, 8) covers the 8-device mesh and
 # (8, 4) covers k=8 — the row-per-device edge it added is exercised by
 # (2, 2), and dryrun_multichip certifies k=32/128 on 8 devices besides.
-@pytest.mark.parametrize("k,n", [(8, 4), (16, 8), (4, 2), (2, 2)])
+# (16, 8) slow-marked (PR 16 budget relief): the 8-device mesh stays
+# fast-tier via the serve/extend shard suites' forced-host meshes, and
+# the (8, 4)/(4, 2)/(2, 2) legs keep the extend parity seam pinned.
+@pytest.mark.parametrize("k,n", [
+    (8, 4), pytest.param(16, 8, marks=pytest.mark.slow), (4, 2), (2, 2),
+])
 def test_sharded_matches_single_chip(k, n):
     assert len(jax.devices()) >= n, "conftest must provide 8 virtual devices"
     mesh = default_mesh(n)
@@ -47,7 +52,12 @@ class TestShardedRepair:
     for bit (VERDICT r3 item 6's sharded variant: decode sweeps split
     line-wise across the mesh, verification on the sharded pipeline)."""
 
-    @pytest.mark.parametrize("k,n", [(8, 8), (8, 4), (4, 2)])
+    # (8, 8) slow-marked (PR 16 budget relief): (8, 4) keeps k=8 repair
+    # parity fast; the row-per-device edge stays via the extend sweep's
+    # (2, 2) and the full 8-device repair runs in the slow tier.
+    @pytest.mark.parametrize("k,n", [
+        pytest.param(8, 8, marks=pytest.mark.slow), (8, 4), (4, 2),
+    ])
     def test_quadrant_erasure_matches(self, k, n):
         from celestia_app_tpu.da.dah import DataAvailabilityHeader
         from celestia_app_tpu.parallel.sharded_repair import sharded_repair

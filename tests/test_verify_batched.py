@@ -1,0 +1,228 @@
+"""The batched device-side verifier (kernels/verify.py + serve/verify.py):
+whole-queue accept/reject vectors bit-identical to per-proof host
+verify(), the `verify_fail` chaos seam's host fallback, mixed-height
+queues, and the heal plane's batched leaf-digest leg.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.constants import (
+    NAMESPACE_SIZE,
+    PARITY_NAMESPACE_BYTES,
+    SHARE_SIZE,
+)
+from celestia_app_tpu.da.eds import ExtendedDataSquare
+from celestia_app_tpu.nmt.hasher import NmtHasher
+from celestia_app_tpu.serve.cache import ForestCache
+from celestia_app_tpu.serve.sampler import ProofSampler
+from celestia_app_tpu.serve.verify import (
+    leaf_digests,
+    verify_mode,
+    verify_proofs,
+    verify_share_proof,
+)
+from celestia_app_tpu.trace.metrics import registry
+
+
+def det_square(k: int, seed: int = 1) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    ns = np.sort(rng.integers(0, 128, k * k).astype(np.uint8))
+    ods = rng.integers(0, 256, (k * k, SHARE_SIZE), dtype=np.uint8)
+    ods[:, :NAMESPACE_SIZE] = 0
+    ods[:, NAMESPACE_SIZE - 1] = ns
+    return ods.reshape(k, k, SHARE_SIZE)
+
+
+def _queue(k: int, seed: int = 1, samples: int = 24,
+           construction: str | None = None):
+    """A deterministic proof queue over one square: every proof honest,
+    row and col axes interleaved, parity quadrant included."""
+    cache = ForestCache(heights=1, spill=1)
+    entry = cache.put(
+        1, ExtendedDataSquare.compute(det_square(k, seed), construction)
+    )
+    sampler = ProofSampler()
+    rng = np.random.default_rng(seed + 100)
+    n = 2 * k
+    coords = [
+        (int(rng.integers(0, n)), int(rng.integers(0, n)))
+        for _ in range(samples)
+    ]
+    half = len(coords) // 2
+    proofs = list(sampler.sample_batch(entry, coords[:half], axis="row"))
+    proofs += sampler.sample_batch(entry, coords[half:], axis="col")
+    return entry, proofs, entry.eds.data_root()
+
+
+def _tamper(proof, offset: int = 100):
+    """The proof with one share data byte flipped — must reject."""
+    import dataclasses
+
+    raw = bytearray(proof.data[0])
+    raw[offset] ^= 0xFF
+    return dataclasses.replace(proof, data=(bytes(raw),))
+
+
+def _counter_value(name: str, **labels) -> float:
+    metric = registry().get(name)
+    if metric is None:
+        return 0.0
+    return sum(
+        value for sample_labels, value in metric.samples()
+        if all(sample_labels.get(k) == v for k, v in labels.items())
+    )
+
+
+class TestBatchedHostIdentity:
+    @pytest.mark.parametrize("k,construction", [
+        (2, "vandermonde"), (8, "vandermonde"), (2, "leopard"),
+        pytest.param(8, "leopard", marks=pytest.mark.slow),
+        pytest.param(16, "vandermonde", marks=pytest.mark.slow),
+        pytest.param(32, "vandermonde", marks=pytest.mark.slow),
+    ])
+    def test_verdict_vector_identical_to_host(self, k, construction,
+                                              monkeypatch):
+        """The acceptance golden: for a row+col queue mixing honest and
+        tampered proofs (both RS constructions), the batched vector
+        equals per-proof host verify() bit for bit — accepts AND
+        rejects in the same slots."""
+        entry, proofs, root = _queue(k, seed=k, construction=construction)
+        queue = list(proofs)
+        queue[1] = _tamper(queue[1])
+        queue[5] = _tamper(queue[5], offset=200)
+        host = [p.verify(root) for p in queue]
+        assert host.count(False) == 2
+        monkeypatch.setenv("CELESTIA_VERIFY_MODE", "batched")
+        assert verify_proofs(queue, root) == host
+        monkeypatch.setenv("CELESTIA_VERIFY_MODE", "host")
+        assert verify_proofs(queue, root) == host
+
+    def test_wrong_root_rejects_everything(self):
+        _, proofs, root = _queue(2, seed=3, samples=8)
+        forged = bytes(32)
+        assert verify_proofs(proofs, forged) == [False] * len(proofs)
+
+    def test_mixed_height_queue_uses_per_proof_roots(self):
+        """`data_root` as a per-proof sequence: two squares' proofs in
+        one queue, each deciding against its own committed root."""
+        _, proofs_a, root_a = _queue(2, seed=5, samples=4)
+        _, proofs_b, root_b = _queue(2, seed=6, samples=4)
+        queue = list(proofs_a) + list(proofs_b)
+        roots = [root_a] * 4 + [root_b] * 4
+        assert verify_proofs(queue, roots) == [True] * 8
+        # Crossed roots reject exactly the crossed half.
+        crossed = [root_b] * 4 + [root_b] * 4
+        assert verify_proofs(queue, crossed) == [False] * 4 + [True] * 4
+        with pytest.raises(ValueError):
+            verify_proofs(queue, roots[:3])
+
+    def test_empty_queue_and_single_proof(self):
+        assert verify_proofs([], b"\x00" * 32) == []
+        _, proofs, root = _queue(2, seed=7, samples=1)
+        assert verify_share_proof(proofs[0], root)
+        assert not verify_share_proof(_tamper(proofs[0]), root)
+
+    def test_mode_env_selects_the_path(self, monkeypatch):
+        monkeypatch.setenv("CELESTIA_VERIFY_MODE", "host")
+        assert verify_mode() == "host"
+        monkeypatch.delenv("CELESTIA_VERIFY_MODE")
+        assert verify_mode() == "batched"
+
+
+class TestVerifyFailFallback:
+    def test_verify_fail_falls_back_bit_identical(self):
+        """verify_fail=1.0 (seam proof.verify) fails every batched
+        dispatch: the host path answers the IDENTICAL vector while
+        celestia_recoveries_total{seam="proof.verify"} ticks — and the
+        healthy batched leg never ticks it."""
+        from celestia_app_tpu import chaos
+
+        entry, proofs, root = _queue(2, seed=9, samples=8)
+        queue = list(proofs)
+        queue[3] = _tamper(queue[3])
+        before = _counter_value(
+            "celestia_recoveries_total", seam="proof.verify",
+            outcome="degraded",
+        )
+        baseline = verify_proofs(queue, root)
+        assert _counter_value(
+            "celestia_recoveries_total", seam="proof.verify",
+            outcome="degraded",
+        ) == before, "healthy batched verify must not tick recoveries"
+        chaos.install("seed=2,verify_fail=1.0")
+        try:
+            drilled = verify_proofs(queue, root)
+        finally:
+            chaos.uninstall()
+        assert drilled == baseline
+        assert _counter_value(
+            "celestia_recoveries_total", seam="proof.verify",
+            outcome="degraded",
+        ) == before + 1
+        assert _counter_value(
+            "celestia_chaos_injections_total", seam="proof.verify"
+        ) > 0
+
+    def test_verified_counter_carries_the_mode(self):
+        _, proofs, root = _queue(2, seed=10, samples=6)
+        before_b = _counter_value(
+            "celestia_verified_samples_total", mode="batched"
+        )
+        verify_proofs(proofs, root)
+        assert _counter_value(
+            "celestia_verified_samples_total", mode="batched"
+        ) >= before_b + len(proofs)
+        before_h = _counter_value(
+            "celestia_verified_samples_total", mode="host"
+        )
+        from celestia_app_tpu import chaos
+
+        chaos.install("seed=2,verify_fail=1.0")
+        try:
+            verify_proofs(proofs, root)
+        finally:
+            chaos.uninstall()
+        assert _counter_value(
+            "celestia_verified_samples_total", mode="host"
+        ) == before_h + len(proofs)
+
+
+class TestBatchedLeafDigests:
+    def test_matches_host_hasher_on_data_and_parity(self):
+        """The heal survivor leg's primitive: one batched dispatch over
+        (ns, share) rows equals per-leaf NmtHasher.hash_leaf."""
+        rng = np.random.default_rng(21)
+        shares = rng.integers(0, 256, (12, SHARE_SIZE), dtype=np.uint8)
+        ns = np.zeros((12, NAMESPACE_SIZE), dtype=np.uint8)
+        ns[:6, NAMESPACE_SIZE - 1] = np.arange(6)
+        ns[6:] = np.frombuffer(PARITY_NAMESPACE_BYTES, dtype=np.uint8)
+        got = leaf_digests(ns, shares)
+        want = np.stack([
+            np.frombuffer(
+                NmtHasher.hash_leaf(ns[i].tobytes() + shares[i].tobytes()),
+                dtype=np.uint8,
+            )
+            for i in range(12)
+        ])
+        assert np.array_equal(got, want)
+        assert leaf_digests(
+            np.zeros((0, NAMESPACE_SIZE), np.uint8),
+            np.zeros((0, SHARE_SIZE), np.uint8),
+        ).shape == (0, 90)
+
+    def test_verify_fail_host_fallback_identical(self):
+        from celestia_app_tpu import chaos
+
+        rng = np.random.default_rng(22)
+        shares = rng.integers(0, 256, (4, SHARE_SIZE), dtype=np.uint8)
+        ns = np.zeros((4, NAMESPACE_SIZE), dtype=np.uint8)
+        baseline = leaf_digests(ns, shares)
+        chaos.install("seed=3,verify_fail=1.0")
+        try:
+            drilled = leaf_digests(ns, shares)
+        finally:
+            chaos.uninstall()
+        assert np.array_equal(drilled, baseline)
