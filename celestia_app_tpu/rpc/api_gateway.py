@@ -285,12 +285,25 @@ def _routes(node):
             tx_bytes = base64.b64decode(body["tx_bytes"])
         except (KeyError, TypeError, ValueError) as e:
             raise _BadRequest(f"invalid tx_bytes: {e}") from e
-        from celestia_app_tpu.trace.context import new_context, use_context
+        from celestia_app_tpu.trace.context import (
+            current_context,
+            new_context,
+            use_context,
+        )
         from celestia_app_tpu.tx import tx_hash
 
         # Request entry: issue the trace the tx carries through the
         # mempool and into the block that commits it (trace/context.py).
-        with use_context(new_context(layer="rpc", plane="rest")):
+        # When the hop arrived with x-celestia-trace the ingress already
+        # ADOPTED it (do_POST) — child it rather than re-minting, so the
+        # submit stays one trace across nodes.
+        parent = current_context()
+        ctx = (
+            parent.child(layer="rpc", plane="rest")
+            if parent is not None
+            else new_context(layer="rpc", plane="rest")
+        )
+        with use_context(ctx):
             res = node.broadcast(tx_bytes)
         return {
             "tx_response": {
@@ -384,13 +397,15 @@ class _ApiHandler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 — http.server API
         # Observability first: /metrics must serve the SAME bytes as the
         # other planes (shared handler), and none of these paths collide
-        # with the cosmos route space.
+        # with the cosmos route space.  An x-celestia-trace header is
+        # ADOPTED (same trace_id, fresh span_id + this node's node_id)
+        # so remote fetches stitch into the caller's trace.
         from celestia_app_tpu.trace.exposition import (
-            handle_observability_get,
+            handle_observability_get_adopted,
             send_observability_response,
         )
 
-        resp = handle_observability_get(self.path, plane="rest")
+        resp = handle_observability_get_adopted(self, plane="rest")
         if resp is not None:
             send_observability_response(self, resp)
             return
@@ -403,7 +418,21 @@ class _ApiHandler(BaseHTTPRequestHandler):
         except (ValueError, json.JSONDecodeError):
             self._respond(400, {"code": 3, "message": "invalid JSON body"})
             return
-        self._dispatch("POST", body)
+        # Adopt the peer's trace context (if any) around route dispatch
+        # so broadcast_tx childs it instead of re-minting (adopt_context
+        # — see trace/context.py; adopt_or_new is the strict variant).
+        from celestia_app_tpu.trace.context import (
+            TRACE_HEADER,
+            adopt_context,
+            use_context,
+        )
+
+        ctx = adopt_context(self.headers.get(TRACE_HEADER))
+        if ctx is not None:
+            with use_context(ctx):
+                self._dispatch("POST", body)
+        else:
+            self._dispatch("POST", body)
 
 
 @dataclass

@@ -53,13 +53,21 @@ class RemoteNode:
 
     # --- transport ----------------------------------------------------------
     def call(self, method: str, **params):
+        from celestia_app_tpu.trace.context import TRACE_HEADER, serialize_context
+
         conn = http.client.HTTPConnection(self._host, self._port, timeout=self._timeout)
         try:
             payload = json.dumps(
                 {"jsonrpc": "2.0", "id": 1, "method": method, "params": params}
             )
-            conn.request("POST", "/", body=payload,
-                         headers={"Content-Type": "application/json"})
+            headers = {"Content-Type": "application/json"}
+            # Cross-node propagation: the active context rides every
+            # JSON-RPC hop so the receiving node ADOPTS the trace
+            # (adopt_or_new in rpc/server.py) instead of re-minting it.
+            wire_ctx = serialize_context()
+            if wire_ctx is not None:
+                headers[TRACE_HEADER] = wire_ctx
+            conn.request("POST", "/", body=payload, headers=headers)
             resp = conn.getresponse()
             body = json.loads(resp.read())
         finally:

@@ -397,8 +397,19 @@ class ConsensusDriver:
         # fresh id), so unverified bytes must never fan out mesh-wide.
         if self._wire_verify(msg):
             self.node.gossip_pool.submit(self._relay, msg)
+        # Cross-node propagation: ADOPT the sender's trace stamped on the
+        # envelope (rpc/transport.deliver) — same trace_id, fresh
+        # span_id, this node's node_id — so consensus spans on every hop
+        # of the flood stitch under the originator's trace.
+        from celestia_app_tpu.trace.context import adopt_context, use_context
+
+        trace_ctx = adopt_context(msg.get("trace"))
         try:
-            self._process(msg)
+            if trace_ctx is not None:
+                with use_context(trace_ctx):
+                    self._process(msg)
+            else:
+                self._process(msg)
         except ConsensusError:
             return {"ok": False}
         return {"ok": True}

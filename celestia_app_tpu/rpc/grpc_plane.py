@@ -250,13 +250,25 @@ def _handlers(node) -> dict:
         # BroadcastTxRequest {tx_bytes=1, mode=2}; mode BROADCAST_MODE_SYNC
         # semantics: CheckTx result, inclusion async (the only mode the
         # reference chain's clients rely on; pkg/user polls GetTx after).
-        from celestia_app_tpu.trace.context import new_context, use_context
+        from celestia_app_tpu.trace.context import (
+            current_context,
+            new_context,
+            use_context,
+        )
 
         tx_bytes = _field_bytes(req, 1)
         # Request entry: the trace the tx carries to the block that
         # commits it (trace/context.py; resolvable via /trace_tables/spans
-        # on the debug sidecar).
-        with use_context(new_context(layer="rpc", plane="grpc")):
+        # on the debug sidecar).  serve_grpc's wrapper has already ADOPTED
+        # an incoming x-celestia-trace metadata entry (adopt_context) —
+        # child it so the cross-node submit stays one trace.
+        parent = current_context()
+        ctx = (
+            parent.child(layer="rpc", plane="grpc")
+            if parent is not None
+            else new_context(layer="rpc", plane="grpc")
+        )
+        with use_context(ctx):
             try:
                 res = node.broadcast(tx_bytes)
             except Exception as e:
@@ -824,7 +836,8 @@ def _serve_debug_port(host: str, port: int):
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     from celestia_app_tpu.trace.exposition import (
-        handle_observability_get,
+        handle_observability_get_adopted,
+        send_observability_404,
         send_observability_response,
     )
 
@@ -833,10 +846,12 @@ def _serve_debug_port(host: str, port: int):
             pass
 
         def do_GET(self):  # noqa: N802 — http.server API
-            resp = handle_observability_get(self.path, plane="grpc")
+            # Adopts an incoming x-celestia-trace header, same as the
+            # other planes; 404s carry Content-Length so keep-alive
+            # scrapers do not stall on the connection.
+            resp = handle_observability_get_adopted(self, plane="grpc")
             if resp is None:
-                self.send_response(404)
-                self.end_headers()
+                send_observability_404(self)
                 return
             send_observability_response(self, resp)
 
@@ -857,8 +872,27 @@ def serve_grpc(node, port: int = 0, max_workers: int = 16,
     ident = lambda b: b  # byte-level (de)serialization; codecs above
 
     def wrap(fn):
+        from celestia_app_tpu.trace.context import (
+            TRACE_HEADER,
+            adopt_context,
+            use_context,
+        )
+
         def handler(req, ctx):
+            # Cross-node propagation: x-celestia-trace rides gRPC
+            # invocation metadata; ADOPT it (same trace_id, fresh
+            # span_id, this node's node_id) so handler spans stitch
+            # into the caller's trace.
+            wire = None
+            for key, value in ctx.invocation_metadata() or ():
+                if key == TRACE_HEADER:
+                    wire = value
+                    break
+            trace_ctx = adopt_context(wire)
             try:
+                if trace_ctx is not None:
+                    with use_context(trace_ctx):
+                        return fn(req)
                 return fn(req)
             except _Abort as e:  # typed handler failure -> proper status
                 ctx.abort(grpc.StatusCode[e.code], e.details)
@@ -904,10 +938,29 @@ class GrpcNode:
 
         self._channel = grpc.insecure_channel(target)
         ident = lambda b: b
+
+        def traced_call(call):
+            # Cross-node propagation: the active trace context rides as
+            # x-celestia-trace invocation metadata on every unary call,
+            # so the served node ADOPTS it (serve_grpc's wrapper) and
+            # its spans stitch under the caller's trace_id.
+            def invoke(req, **kwargs):
+                from celestia_app_tpu.trace.context import (
+                    TRACE_HEADER,
+                    serialize_context,
+                )
+
+                wire = serialize_context()
+                if wire is not None and "metadata" not in kwargs:
+                    kwargs["metadata"] = ((TRACE_HEADER, wire),)
+                return call(req, **kwargs)
+
+            return invoke
+
         self._call = {
-            name: self._channel.unary_unary(
+            name: traced_call(self._channel.unary_unary(
                 path, request_serializer=ident, response_deserializer=ident
-            )
+            ))
             for name, path in {
                 "broadcast": "/cosmos.tx.v1beta1.Service/BroadcastTx",
                 "get_tx": "/cosmos.tx.v1beta1.Service/GetTx",

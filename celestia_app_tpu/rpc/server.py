@@ -803,11 +803,22 @@ class ServingNode(TestNode):
         """Tx submission — the trace root.  The issued trace_id is
         returned to the client and follows the tx through the mempool,
         the square build, the device dispatch, and consensus
-        (GET /trace_tables/spans filters on it)."""
-        from celestia_app_tpu.trace.context import new_context, use_context
+        (GET /trace_tables/spans filters on it).  When the request
+        arrived with an x-celestia-trace header the ingress has already
+        ADOPTED it (do_POST) — child that context instead of re-minting,
+        so a relayed submit stays one trace across nodes."""
+        from celestia_app_tpu.trace.context import (
+            current_context,
+            new_context,
+            use_context,
+        )
 
         raw = bytes.fromhex(tx)
-        ctx = new_context(layer="rpc", plane="jsonrpc")
+        parent = current_context()
+        if parent is not None:
+            ctx = parent.child(layer="rpc", plane="jsonrpc")
+        else:
+            ctx = new_context(layer="rpc", plane="jsonrpc")
         with use_context(ctx):
             res = self.broadcast(raw, relay=relay, ctx=ctx)
         return {"code": res.code, "log": res.log,
@@ -1329,6 +1340,7 @@ def _method_table(node: ServingNode) -> dict:
 
 class _Handler(BaseHTTPRequestHandler):
     methods: dict = {}
+    node_id: str | None = None  # per-server identity (multi-node tests)
 
     def log_message(self, fmt, *args):  # quiet: tests parse stdout
         pass
@@ -1338,16 +1350,20 @@ class _Handler(BaseHTTPRequestHandler):
         observability surface (trace/exposition.py — the Tendermint
         instrumentation analog, test/e2e/testnet/setup.go:24, and the
         pkg/trace table puller, node.go:52-74).  All three serving planes
-        mount the same handler, so the exposition is byte-identical."""
+        mount the same handler, so the exposition is byte-identical.
+        An `x-celestia-trace` header is ADOPTED (same trace_id, fresh
+        span_id, this node's node_id) so remote DAS fetches stitch."""
         from celestia_app_tpu.trace.exposition import (
-            handle_observability_get,
+            handle_observability_get_adopted,
+            send_observability_404,
             send_observability_response,
         )
 
-        resp = handle_observability_get(self.path, plane="jsonrpc")
+        resp = handle_observability_get_adopted(
+            self, plane="jsonrpc", node_id=self.node_id
+        )
         if resp is None:
-            self.send_response(404)
-            self.end_headers()
+            send_observability_404(self)
             return
         send_observability_response(self, resp)
 
@@ -1364,7 +1380,27 @@ class _Handler(BaseHTTPRequestHandler):
             from celestia_app_tpu import chaos
 
             chaos.rpc_handle()
-            result = method(**req.get("params", {}))
+            # Cross-node propagation: a request carrying the peer's
+            # x-celestia-trace header runs under an ADOPTED context —
+            # same trace_id, fresh span_id, this node's node_id — so the
+            # method's own spans (broadcast_tx's mempool submit, the
+            # consensus hand-off) join the caller's trace instead of
+            # starting a new one.
+            from celestia_app_tpu.trace.context import (
+                TRACE_HEADER,
+                adopt_context,
+                use_context,
+            )
+
+            ctx = adopt_context(
+                self.headers.get(TRACE_HEADER),
+                **({"node_id": self.node_id} if self.node_id else {}),
+            )
+            if ctx is not None:
+                with use_context(ctx):
+                    result = method(**req.get("params", {}))
+            else:
+                result = method(**req.get("params", {}))
             body = {"jsonrpc": "2.0", "id": req.get("id"), "result": result}
             status = 200
         except Exception as e:  # noqa: BLE001 — every fault becomes an RPC error
@@ -1404,9 +1440,23 @@ class _Handler(BaseHTTPRequestHandler):
 class NodeServer:
     """Owns the HTTP server + optional proposer-loop thread."""
 
-    def __init__(self, node: ServingNode, host: str = "127.0.0.1", port: int = 0):
-        handler = type("BoundHandler", (_Handler,), {"methods": _method_table(node)})
+    def __init__(
+        self,
+        node: ServingNode,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        node_id: str | None = None,
+    ):
+        # node_id overrides the process-wide identity for this server's
+        # adopted spans — N in-process NodeServers (the standard test
+        # topology) then stitch as N distinct nodes under one trace_id.
+        handler = type(
+            "BoundHandler",
+            (_Handler,),
+            {"methods": _method_table(node), "node_id": node_id},
+        )
         self.node = node
+        self.node_id = node_id
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]
         self.url = f"http://{host}:{self.port}"

@@ -78,8 +78,20 @@ def deliver(send, msg: dict, *, streak: dict, key, retries: int = 2,
     delivery to a timer thread and returns immediately, so messages sent
     after it genuinely OVERTAKE it on the wire (an inline sleep would
     delay every successor equally — latency, not reordering).
+
+    Cross-node propagation: the active trace context rides as a `trace`
+    field on the envelope (`<trace_id>-<span_id>`, the x-celestia-trace
+    grammar) so the receiving driver ADOPTS the trace.  Safe to attach:
+    `msg_id` identity ignores top-level keys it does not name, and vote
+    signatures cover msg["vote"] alone — the stamp cannot dedup-split or
+    invalidate a relayed message.
     """
     from celestia_app_tpu import chaos
+    from celestia_app_tpu.trace.context import serialize_context
+
+    wire_ctx = serialize_context()
+    if wire_ctx is not None and "trace" not in msg:
+        msg = {**msg, "trace": wire_ctx}
 
     acts = chaos.gossip_send()
     if acts.get("drop"):

@@ -20,6 +20,8 @@ with rpc/codec.share_proof_from_json).
 from __future__ import annotations
 
 import json
+import threading
+from collections import OrderedDict
 
 from celestia_app_tpu.constants import NAMESPACE_SIZE, PARITY_NAMESPACE_BYTES
 
@@ -100,6 +102,157 @@ def count_served(plane: str, kind: str, payload=None) -> None:
 
 class UnknownHeight(KeyError):
     """No cached, spilled, or rebuildable square at this height (a 404)."""
+
+
+# --- DAS coverage map ---------------------------------------------------------
+#
+# Which coordinates of a retained height have actually been DECIDED by the
+# serving plane — the observable both PCMT papers' P(detect|s) curves are
+# a function of.  A cell is ticked where a payload is decided: a served
+# share_proof / namespace range / attestation set marks its coordinates
+# `sampled` (or `verified` when the verification gate was armed and the
+# proofs chained to the committed root), and the terminal refusals mark
+# them with DISTINCT states — `withheld` (410: the proposer hid the
+# share) and `tampered` (502: the served view contradicts the committed
+# root) — so the map separates "nobody asked" from "asked and refused".
+# Precedence is refusal > verified > sampled > unseen: a cell never
+# forgets the worst thing it proved.
+
+COVERAGE_STATES = ("sampled", "verified", "withheld", "tampered")
+_STATE_RANK = {"sampled": 1, "verified": 2, "withheld": 3, "tampered": 4}
+_RANK_NAME = ("unseen",) + COVERAGE_STATES
+_RANK_CHAR = ".svwt"
+#: Retained coverage maps (per height); oldest evicted — matches the
+#: serve cache's "last N heights" retention shape without coupling to it.
+COVERAGE_RETAIN = 64
+#: Bitmaps render inline on /das/coverage only up to this edge (cells =
+#: edge^2); larger squares serve counts + ratio with map_omitted=true.
+MAX_COVERAGE_MAP_EDGE = 64
+
+_COVERAGE_LOCK = threading.Lock()
+_COVERAGE: OrderedDict[int, "CoverageMap"] = OrderedDict()
+
+
+class CoverageMap:
+    """Per-height coordinate state grid over the EXTENDED square (2k x
+    2k), one byte per cell holding the state rank."""
+
+    def __init__(self, height: int, k: int):
+        self.height = height
+        self.k = k
+        self.cells = bytearray((2 * k) * (2 * k))
+
+    def tick(self, coords, state: str) -> None:
+        rank = _STATE_RANK[state]
+        n = 2 * self.k
+        for row, col in coords:
+            if 0 <= row < n and 0 <= col < n:
+                i = row * n + col
+                if rank > self.cells[i]:
+                    self.cells[i] = rank
+
+    def counts(self) -> dict[str, int]:
+        by_rank = [0] * len(_RANK_NAME)
+        for c in self.cells:
+            by_rank[c] += 1
+        return {name: by_rank[i] for i, name in enumerate(_RANK_NAME)}
+
+    def ratio(self) -> float:
+        """Fraction of coordinates with ANY decision (served or refused)
+        — refused cells count as covered: a refusal IS a detection
+        datapoint, not a gap in sampling."""
+        total = len(self.cells)
+        if not total:
+            return 0.0
+        return sum(1 for c in self.cells if c) / total
+
+    def payload(self) -> dict:
+        n = 2 * self.k
+        out: dict = {
+            "height": self.height,
+            "square_size": self.k,
+            "ratio": self.ratio(),
+            "counts": self.counts(),
+        }
+        if n <= MAX_COVERAGE_MAP_EDGE:
+            out["map"] = [
+                "".join(_RANK_CHAR[c] for c in self.cells[r * n:(r + 1) * n])
+                for r in range(n)
+            ]
+            out["map_omitted"] = False
+        else:
+            out["map_omitted"] = True
+        return out
+
+
+def coverage_tick(height: int, k: int, coords, state: str) -> None:
+    """Record one payload decision on the height's coverage map and
+    refresh `celestia_das_coverage_ratio{k}` (the gauge tracks the most
+    recently ticked height per square size; per-height detail lives on
+    GET /das/coverage)."""
+    from celestia_app_tpu.trace.metrics import registry
+
+    with _COVERAGE_LOCK:
+        cov = _COVERAGE.get(height)
+        if cov is None or cov.k != k:
+            cov = _COVERAGE[height] = CoverageMap(height, k)
+        _COVERAGE.move_to_end(height)
+        while len(_COVERAGE) > COVERAGE_RETAIN:
+            _COVERAGE.popitem(last=False)
+        cov.tick(coords, state)
+        ratio = cov.ratio()
+    registry().gauge(
+        "celestia_das_coverage_ratio",
+        "fraction of the most recently sampled height's extended-square "
+        "coordinates with a decided DAS payload (served or refused), "
+        "per square size",
+    ).set(ratio, k=str(k))
+
+
+def coverage_payload(height: int) -> dict | None:
+    with _COVERAGE_LOCK:
+        cov = _COVERAGE.get(height)
+        return cov.payload() if cov is not None else None
+
+
+def coverage_snapshot() -> dict:
+    """Summary of every retained height's coverage (no bitmaps) — the
+    flight-recorder bundle block and the /das/coverage height listing."""
+    with _COVERAGE_LOCK:
+        return {
+            str(h): {
+                "square_size": cov.k,
+                "ratio": cov.ratio(),
+                "counts": cov.counts(),
+            }
+            for h, cov in sorted(_COVERAGE.items())
+        }
+
+
+def coverage_response(query_params: dict):
+    """GET /das/coverage -> (status, content_type, bytes): per-height
+    bitmap with ?height=, the retained-heights summary without — a pure
+    function of coverage state, byte-identical on every plane."""
+    raw = query_params.get("height")
+    if raw is None:
+        return 200, "application/json", render({"heights": coverage_snapshot()})
+    try:
+        height = int(raw)
+    except ValueError:
+        return 400, "application/json", json.dumps(
+            {"error": f"height must be an integer, got {raw!r}"}
+        ).encode()
+    payload = coverage_payload(height)
+    if payload is None:
+        return 404, "application/json", json.dumps(
+            {"error": f"no coverage recorded at height {height}"}
+        ).encode()
+    return 200, "application/json", render(payload)
+
+
+def _reset_coverage_for_tests() -> None:
+    with _COVERAGE_LOCK:
+        _COVERAGE.clear()
 
 
 #: Hard cap on samples per attestation request: bounds the gather, the
@@ -243,9 +396,25 @@ class DasProvider:
         self, height: int, row: int, col: int, axis: str = "row"
     ) -> dict:
         from celestia_app_tpu.rpc.codec import to_jsonable
+        from celestia_app_tpu.serve.sampler import (
+            BadProofDetected,
+            ShareWithheld,
+            _verify_gate_armed,
+        )
 
         entry = self.entry(height)
-        proof = self.sampler.share_proof(entry, row, col, axis=axis)
+        try:
+            proof = self.sampler.share_proof(entry, row, col, axis=axis)
+        except ShareWithheld:
+            coverage_tick(height, entry.k, [(row, col)], "withheld")
+            raise
+        except BadProofDetected:
+            coverage_tick(height, entry.k, [(row, col)], "tampered")
+            raise
+        coverage_tick(
+            height, entry.k, [(row, col)],
+            "verified" if _verify_gate_armed(entry) else "sampled",
+        )
         return {
             "height": height,
             "row": row,
@@ -302,7 +471,21 @@ class DasProvider:
         # DATA_LOSS on the planes) instead of a 200 endorsing forged
         # state.  The found=False branch serves no proof, so there is
         # nothing to endorse there.
-        self.sampler._gate(entry, [proof])
+        from celestia_app_tpu.serve.sampler import (
+            BadProofDetected,
+            _verify_gate_armed,
+        )
+
+        coords = [(i // entry.k, i % entry.k) for i in range(rng[0], rng[1])]
+        try:
+            self.sampler._gate(entry, [proof])
+        except BadProofDetected:
+            coverage_tick(height, entry.k, coords, "tampered")
+            raise
+        coverage_tick(
+            height, entry.k, coords,
+            "verified" if _verify_gate_armed(entry) else "sampled",
+        )
         payload.update({
             "found": True,
             "start": rng[0],
@@ -333,6 +516,7 @@ class DasProvider:
         from celestia_app_tpu import merkle
         from celestia_app_tpu.nmt.proof import multiproof_from_levels
         from celestia_app_tpu.serve.sampler import (
+            ShareWithheld,
             _check_withheld,
             _qos_gate_sample,
         )
@@ -350,8 +534,14 @@ class DasProvider:
         # The same per-sample refusals the share_proof path applies, in
         # canonical order: the FIRST withheld coordinate fails the
         # request (410); every data-quadrant sample pays its tenant's
-        # proof-rate token before any gather work.
-        _check_withheld(entry, coords)
+        # proof-rate token before any gather work.  A withheld set is a
+        # DETECTION over the whole requested set — the coverage map
+        # records every asked coordinate under the refusal state.
+        try:
+            _check_withheld(entry, coords)
+        except ShareWithheld:
+            coverage_tick(height, entry.k, coords, "withheld")
+            raise
         for row, col, _axis in sample_list:
             _qos_gate_sample(entry, row, col)
         lat.observe(time.perf_counter() - t0, phase="parse")
@@ -422,10 +612,23 @@ class DasProvider:
         # a BadProofDetected (502), never a served attestation.
         t3 = time.perf_counter()
         from celestia_app_tpu.rpc.codec import share_proofs_from_attestation
-        from celestia_app_tpu.serve.sampler import _verify_gate_armed
+        from celestia_app_tpu.serve.sampler import (
+            BadProofDetected,
+            _verify_gate_armed,
+        )
 
-        if _verify_gate_armed(entry):
-            self.sampler._gate(entry, share_proofs_from_attestation(payload))
+        armed = _verify_gate_armed(entry)
+        if armed:
+            try:
+                self.sampler._gate(
+                    entry, share_proofs_from_attestation(payload)
+                )
+            except BadProofDetected:
+                coverage_tick(height, entry.k, coords, "tampered")
+                raise
+        coverage_tick(
+            height, entry.k, coords, "verified" if armed else "sampled"
+        )
         lat.observe(time.perf_counter() - t3, phase="verify")
 
         registry().counter(

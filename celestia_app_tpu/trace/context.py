@@ -30,15 +30,53 @@ $CELESTIA_TRACE=off mutes every export; context PROPAGATION still runs so
 explicit threading (mempool-entry contexts, block adoption) never breaks
 when tracing is muted.  No device syncs anywhere: spans time host calls
 the layers already make.
+
+Cross-NODE propagation (the fleet era): `serialize_context` renders the
+active identity as the `x-celestia-trace` header value
+(`<32-hex trace_id>-<16-hex span_id>`), and `adopt_context` /
+`adopt_or_new` rebuild it on the receiving process — SAME trace_id, fresh
+span_id, the sender's span as parent — so a request crossing the wire
+stays one trace.  Every root/adopted context stamps a `node_id` baggage
+entry (a stable per-process identity, `$CELESTIA_NODE_ID` override) so
+merged spans tables attribute each row to its emitting process.
 """
 
 from __future__ import annotations
 
 import os
+import re
+import socket
 import time
 from contextlib import contextmanager
 from contextvars import ContextVar
 from dataclasses import dataclass, field
+
+#: The one header name every inter-node hop uses (HTTP header, gRPC
+#: metadata key, and the gossip envelope's "trace" field all carry the
+#: same serialized value).
+TRACE_HEADER = "x-celestia-trace"
+
+_NODE_ID: str | None = None
+_HEADER_RE = re.compile(r"^([0-9a-f]{32})-([0-9a-f]{16})$")
+
+
+def node_id() -> str:
+    """Stable per-process node identity: `$CELESTIA_NODE_ID` when set,
+    else `<hostname>-<pid>` — computed once so every span, flight bundle,
+    and fleet row a process emits carries the same value.  Sanitized to
+    `[A-Za-z0-9._-]` (it lands in filenames and header values)."""
+    global _NODE_ID
+    if _NODE_ID is None:
+        raw = os.environ.get("CELESTIA_NODE_ID") or (
+            f"{socket.gethostname()}-{os.getpid()}"
+        )
+        _NODE_ID = re.sub(r"[^A-Za-z0-9._-]", "_", raw) or "node"
+    return _NODE_ID
+
+
+def _reset_node_id_for_tests() -> None:
+    global _NODE_ID
+    _NODE_ID = None
 
 
 @dataclass(frozen=True)
@@ -75,13 +113,56 @@ def _new_span_id() -> str:
 
 
 def new_context(**baggage) -> TraceContext:
-    """Issue a fresh root context (a new trace_id) — request entry."""
+    """Issue a fresh root context (a new trace_id) — request entry.  The
+    issuing process's `node_id` rides the baggage (explicit baggage wins,
+    so a per-server identity can override the process default)."""
     return TraceContext(
         trace_id=os.urandom(16).hex(),
         span_id=_new_span_id(),
-        baggage=baggage,
+        baggage={"node_id": node_id(), **baggage},
         start_unix_ns=time.time_ns(),
     )
+
+
+def serialize_context(ctx: TraceContext | None = None) -> str | None:
+    """The wire form of `ctx` (default: the current context) for the
+    `x-celestia-trace` header / gRPC metadata / gossip `trace` field:
+    `<trace_id>-<span_id>`, or None outside a trace (the hop then carries
+    no header and the receiver mints its own root)."""
+    ctx = ctx if ctx is not None else current_context()
+    if ctx is None:
+        return None
+    return f"{ctx.trace_id}-{ctx.span_id}"
+
+
+def adopt_context(header: str | None, **baggage) -> TraceContext | None:
+    """Rebuild an incoming wire context: SAME trace_id, fresh span_id,
+    the sender's span as parent — the receiving process JOINS the trace
+    instead of re-minting it, which is what stitches a multi-node drill
+    under one trace_id.  Returns None on an absent or malformed header
+    (a bad header must never fail the request — the caller falls back to
+    `new_context`).  This process's `node_id` is stamped into baggage
+    (explicit baggage wins, for per-server identities in one process)."""
+    if not header:
+        return None
+    m = _HEADER_RE.match(header.strip().lower())
+    if m is None:
+        return None
+    trace_id, parent_span = m.group(1), m.group(2)
+    return TraceContext(
+        trace_id=trace_id,
+        span_id=_new_span_id(),
+        parent_id=parent_span,
+        baggage={"node_id": node_id(), **baggage},
+        start_unix_ns=time.time_ns(),
+    )
+
+
+def adopt_or_new(header: str | None, **baggage) -> TraceContext:
+    """Request entry on a serving plane: adopt the peer's context when the
+    hop carried one, else issue a fresh root — the ONE pattern every rpc/
+    ingress threads (trace_lint rule 7 pins this)."""
+    return adopt_context(header, **baggage) or new_context(**baggage)
 
 
 _CURRENT: ContextVar[TraceContext | None] = ContextVar(

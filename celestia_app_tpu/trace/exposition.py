@@ -28,6 +28,13 @@ hits:
     GET /heal                    the self-healing loop's state: heights
                                  mid-heal, quarantined heights, last heal
                                  outcome per engine (serve/heal.py)
+    GET /das/coverage            the DAS coverage map: ?height= -> the
+                                 per-coordinate sampled/verified/refused
+                                 bitmap; no args -> per-height summary
+                                 (serve/api.py coverage registry)
+    GET /fleet                   merged cluster telemetry over the
+                                 configured peers (trace/fleet.py):
+                                 per-host rates + cross-host quantiles
 
 /healthz is the SLO face: beyond {"status": "SERVING"}, any registered
 health providers (a ServingNode registers its own snapshot: last block
@@ -137,12 +144,48 @@ def health_payload() -> dict:
     return payload
 
 
+_SCRAPE_TS_LOCK = threading.Lock()
+_LAST_SCRAPE_TS: float | None = None
+
+
+def _refresh_scrape_timestamp() -> None:
+    """Refresh `celestia_scrape_timestamp_seconds` — the render-time
+    wall clock a fleet aggregator uses to judge staleness of a cached or
+    proxied exposition.  $CELESTIA_SCRAPE_TS_S rate-limits the refresh
+    (default 0 = every render); byte-identity tests freeze it the same
+    way they freeze $CELESTIA_SLO_TICK_S, since a wall-clock gauge is
+    exactly the kind of state two sequential scrapes may disagree on."""
+    import os
+    import time
+
+    from celestia_app_tpu.trace.metrics import registry
+
+    global _LAST_SCRAPE_TS
+    try:
+        min_s = max(0.0, float(
+            os.environ.get("CELESTIA_SCRAPE_TS_S", "") or 0.0
+        ))
+    except ValueError:
+        min_s = 0.0
+    now = time.time()
+    with _SCRAPE_TS_LOCK:
+        if _LAST_SCRAPE_TS is not None and now - _LAST_SCRAPE_TS < min_s:
+            return
+        _LAST_SCRAPE_TS = now
+    registry().gauge(
+        "celestia_scrape_timestamp_seconds",
+        "unix time this exposition was rendered (scrape staleness "
+        "marker for fleet aggregation)",
+    ).set(now)
+
+
 def metrics_payload() -> bytes:
     """The Prometheus exposition bytes — THE single renderer every plane
     serves, which is what makes cross-plane byte-identity structural
     rather than a test invariant."""
     from celestia_app_tpu.trace.metrics import registry
 
+    _refresh_scrape_timestamp()
     return registry().render().encode()
 
 
@@ -282,6 +325,19 @@ def handle_observability_get(path: str, plane: str = "shared"):
         return _das_response("shares", query, plane)
     if p == "/das/attestation":
         return _das_response("attestation", query, plane)
+    if p == "/das/coverage":
+        from celestia_app_tpu.serve.api import coverage_response
+
+        # A pure function of the coverage-map state (serve/api.py) —
+        # byte-identical on every plane, like /heal.
+        return coverage_response(_query_params(query))
+    if p == "/fleet":
+        from celestia_app_tpu.trace.fleet import fleet_response
+
+        # The merged cluster view (trace/fleet.py); scrapes are
+        # rate-limited by the aggregator interval, so planes asked
+        # inside one round serve identical bytes.
+        return fleet_response()
     if p == "/metrics":
         return 200, METRICS_CONTENT_TYPE, metrics_payload()
     if p == "/healthz":
@@ -328,6 +384,39 @@ def handle_observability_get(path: str, plane: str = "shared"):
     return None
 
 
+def handle_observability_get_adopted(handler, plane: str,
+                                     node_id: str | None = None):
+    """Route `handler`'s GET with cross-node trace adoption: when the
+    request carries an `x-celestia-trace` header the serving process
+    JOINS that trace (same trace_id, fresh span_id) and answers inside
+    an `rpc_get` span — so a das_loadgen --url fetch or a peer's probe
+    leaves spans rows HERE that stitch to the caller's own under one
+    trace_id.  `node_id` overrides the process identity for multi-server
+    test processes.  Headerless requests route exactly as before (no
+    span minted for plain scrapes)."""
+    from celestia_app_tpu.trace.context import (
+        TRACE_HEADER,
+        adopt_context,
+        trace_span,
+        use_context,
+    )
+
+    ctx = adopt_context(
+        handler.headers.get(TRACE_HEADER),
+        **({"node_id": node_id} if node_id else {}),
+    )
+    if ctx is None:
+        return handle_observability_get(handler.path, plane=plane)
+    with use_context(ctx):
+        with trace_span(
+            "rpc_get", ctx=ctx,
+            path=handler.path.partition("?")[0], plane=plane,
+        ) as attrs:
+            resp = handle_observability_get(handler.path, plane=plane)
+            attrs["status"] = resp[0] if resp is not None else 404
+    return resp
+
+
 def send_observability_response(handler, resp) -> None:
     """Write a handle_observability_get result through a
     BaseHTTPRequestHandler (the shape all three planes' handlers share).
@@ -342,3 +431,59 @@ def send_observability_response(handler, resp) -> None:
         handler.send_header(name, value)
     handler.end_headers()
     handler.wfile.write(body)
+
+
+def send_observability_404(handler) -> None:
+    """The shared not-found response for paths neither the observability
+    surface nor the mounting plane routes.  Always carries
+    Content-Length: a keep-alive scraper must never block on a
+    length-less response waiting for a close that ThreadingHTTPServer
+    does not send."""
+    body = b'{"error":"not found"}'
+    handler.send_response(404)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def serve_observability(host: str = "127.0.0.1", port: int = 0,
+                        node_id: str | None = None, plane: str = "rest"):
+    """A standalone HTTP mount of the shared observability surface —
+    the das_loadgen --serve mini-node and the fleet tests' stub peers.
+    GET-only; adoption-aware (handle_observability_get_adopted), with an
+    optional per-SERVER `node_id` so several in-process servers emit
+    distinguishable spans.  Returns an object with .url and .stop()."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _ObsHandler(BaseHTTPRequestHandler):
+        _node_id = node_id
+        _plane = plane
+
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+        def do_GET(self):  # noqa: N802 — http.server API
+            resp = handle_observability_get_adopted(
+                self, plane=self._plane, node_id=self._node_id
+            )
+            if resp is None:
+                send_observability_404(self)
+                return
+            send_observability_response(self, resp)
+
+    httpd = ThreadingHTTPServer((host, port), _ObsHandler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+
+    class _Server:
+        def __init__(self):
+            self.httpd = httpd
+            self.port = httpd.server_address[1]
+            self.url = f"http://{host}:{self.port}"
+
+        def stop(self):
+            httpd.shutdown()
+            httpd.server_close()
+
+    return _Server()

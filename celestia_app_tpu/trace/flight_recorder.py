@@ -21,6 +21,10 @@ box: when an anomaly TRIGGER fires —
     heal_quarantined  serve/heal.py: a heal exhausted its retry budget
                       or the height is below the k-survivor threshold —
                       the height is quarantined, operator input needed
+    fleet_fast_burn   trace/fleet.py: the MERGED cross-host burn rate of
+                      an SLO crossed the paging threshold (context
+                      carries peers' recent bundle indexes so the fleet
+                      bundle points at the per-node black boxes)
 
 — `note_trigger` atomically dumps one JSON bundle under
 $CELESTIA_FLIGHT_DIR: the last-N rows of EVERY trace table, the
@@ -56,6 +60,7 @@ TRIGGERS = (
     "withholding_detected",
     "heal_completed",
     "heal_quarantined",
+    "fleet_fast_burn",
 )
 
 #: Hard ceiling on per-table tail rows in a bundle.
@@ -168,7 +173,11 @@ def _note_trigger(trigger: str, context: dict) -> str | None:
         bundle = capture(trigger, context)
         os.makedirs(out_dir, exist_ok=True)
         ts_ns = bundle["captured_unix_ns"]
-        name = f"flight-{trigger}-{ts_ns}-{seq}.json"
+        # node_id in the name: N nodes of one drill share a
+        # $CELESTIA_FLIGHT_DIR without colliding, and peer_bundle_index
+        # attributes bundles by filename alone.  ts_ns and seq stay the
+        # LAST two fields (slo_report sorts on split("-")[-2]).
+        name = f"flight-{trigger}-{bundle['node_id']}-{ts_ns}-{seq}.json"
         tmp = os.path.join(out_dir, f".tmp-{name}")
         path = os.path.join(out_dir, name)
         with open(tmp, "w", encoding="utf-8") as f:
@@ -220,7 +229,9 @@ def capture(trigger: str, context: dict | None = None) -> dict:
     slo_report can inspect the capture shape without touching disk)."""
     from celestia_app_tpu import chaos
     from celestia_app_tpu.chaos.degrade import degraded_state
+    from celestia_app_tpu.serve.api import coverage_snapshot
     from celestia_app_tpu.trace import slo, square_journal
+    from celestia_app_tpu.trace.context import node_id
     from celestia_app_tpu.trace.exposition import health_payload
     from celestia_app_tpu.trace.tracer import traced
 
@@ -233,11 +244,16 @@ def capture(trigger: str, context: dict | None = None) -> dict:
         "context": _jsonable(context or {}),
         "captured_unix_ns": time.time_ns(),
         "pid": os.getpid(),
+        "node_id": node_id(),
         "healthz": health_payload(),
         "slo": slo.engine().payload(),
         "degraded": degraded_state(),
         "chaos_spec": getattr(inj, "raw", "") if inj is not None else "",
         "namespaces": square_journal.namespaces_payload(),
+        # The DAS coverage summary (serve/api.py): which retained
+        # heights had how much of their square decided when the anomaly
+        # fired — the withholding drill's context in one block.
+        "coverage": coverage_snapshot(),
         "tail_rows": n,
         "tables": tables,
     }
@@ -254,6 +270,44 @@ def _jsonable(obj):
     if isinstance(obj, (str, int, float, bool)) or obj is None:
         return obj
     return repr(obj)
+
+
+def peer_bundle_index(limit_per_node: int = 8) -> dict:
+    """Recent bundles OTHER nodes dropped in this process's
+    $CELESTIA_FLIGHT_DIR, grouped by the node_id parsed from the
+    filename (`flight-<trigger>-<node_id>-<ts_ns>-<seq>.json`) — in a
+    local multi-node drill all nodes share one dir, so a fleet
+    fast-burn bundle can point at every peer's own black box without a
+    network fetch.  Newest `limit_per_node` per node; never raises
+    (unreadable dir -> empty index)."""
+    from celestia_app_tpu.trace.context import node_id as own_node_id
+
+    out_dir = flight_dir()
+    if out_dir is None:
+        return {}
+    own = own_node_id()
+    by_node: dict[str, list] = {}
+    try:
+        names = sorted(os.listdir(out_dir))
+    except OSError:
+        return {}
+    for name in names:
+        if not (name.startswith("flight-") and name.endswith(".json")):
+            continue
+        parts = name[:-len(".json")].split("-")
+        # flight / trigger / node_id (may itself contain dashes) / ts / seq
+        if len(parts) < 5:
+            continue  # pre-node_id bundle name: no node to attribute
+        node, ts_raw = "-".join(parts[2:-2]), parts[-2]
+        if not ts_raw.isdigit() or node == own:
+            continue
+        by_node.setdefault(node, []).append(
+            {"name": name, "trigger": parts[1], "ts_ns": int(ts_raw)}
+        )
+    return {
+        node: sorted(dumps, key=lambda d: d["ts_ns"])[-limit_per_node:]
+        for node, dumps in sorted(by_node.items())
+    }
 
 
 def _reset_for_tests() -> None:

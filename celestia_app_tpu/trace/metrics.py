@@ -218,6 +218,43 @@ class Histogram:
         through snapshot()/delta() instead."""
         return self.snapshot().quantile(q, **labels)
 
+    @staticmethod
+    def merge(snapshots) -> HistogramSnapshot:
+        """Bucket-wise merge of per-host snapshots into ONE fleet
+        snapshot: children with the same label set sum count-for-count,
+        disjoint label sets union — so a cross-host quantile off the
+        result is EXACT at bucket resolution (bucket counts are additive
+        across processes; no resampling, no quantile-of-quantiles bias).
+        Empty snapshots are identity elements; the +Inf tail sums like
+        any other bucket (quantile() still clamps tail ranks to the
+        largest finite bound).  All non-empty snapshots must share one
+        bucket layout — merging counts across different layouts would
+        silently misbucket, so that raises ValueError."""
+        buckets: tuple[float, ...] | None = None
+        merged: dict[tuple, list] = {}
+        for snap in snapshots:
+            if not snap.children:
+                continue
+            if buckets is None:
+                buckets = snap.buckets
+            elif snap.buckets != buckets:
+                raise ValueError(
+                    f"cannot merge histograms with bucket layouts "
+                    f"{buckets} and {snap.buckets}"
+                )
+            for key, (counts, total) in snap.children.items():
+                child = merged.get(key)
+                if child is None:
+                    merged[key] = [list(counts), total]
+                    continue
+                for i, c in enumerate(counts):
+                    child[0][i] += c
+                child[1] += total
+        return HistogramSnapshot(
+            buckets or (),
+            {key: (child[0], child[1]) for key, child in merged.items()},
+        )
+
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         with self._lock:

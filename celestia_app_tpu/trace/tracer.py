@@ -61,10 +61,16 @@ class Tracer:
     def write(self, table: str, **row) -> None:
         if not self._on():
             return
+        # Every row says WHICH node wrote it: in a shared-artifact
+        # multi-node drill (one $CELESTIA_FLIGHT_DIR, merged table pulls)
+        # provenance must ride the row, not the transport.  Lazy import:
+        # context.py imports from this module.
+        from celestia_app_tpu.trace.context import node_id
+
         dropped = 0
         with self._lock:
             rows = self._tables.setdefault(table, [])
-            rows.append({"ts_ns": time.time_ns(), **row})
+            rows.append({"ts_ns": time.time_ns(), "node_id": node_id(), **row})
             if len(rows) > self.buffer_size:
                 dropped = len(rows) - self.buffer_size
                 del rows[:dropped]

@@ -367,6 +367,43 @@ def load_das_round(path: str) -> dict:
                     f"{path}: verify block missing {key!r}"
                 )
             rec["verify"][key] = float(ver[key])
+    # The fleet block (das_loadgen --urls): the multi-node leg — per-host
+    # proofs/sec, the bucket-merged cross-host tail (the same
+    # Histogram.merge math GET /fleet serves), end-of-run coverage.
+    # Optional — pre-fleet rounds stay valid (das_plan_gaps classifies
+    # the first fleet round as a plan gap, never STALE) — but a
+    # half-written fleet block exits 2 like any other malformed round.
+    rec["fleet"] = None
+    if raw.get("fleet") is not None:
+        fl = raw["fleet"]
+        hosts = fl.get("hosts") if isinstance(fl, dict) else None
+        if not isinstance(hosts, list) or len(hosts) < 2:
+            raise MalformedRound(
+                f"{path}: fleet block needs a 'hosts' list of >= 2 rows"
+            )
+        for row in hosts:
+            for key in ("url", "proofs_per_s", "p99_ms"):
+                if not isinstance(row, dict) or row.get(key) is None:
+                    raise MalformedRound(
+                        f"{path}: fleet host row missing {key!r}: {row!r}"
+                    )
+        for key in ("cross_host_p50_ms", "cross_host_p99_ms",
+                    "coverage_ratio"):
+            if fl.get(key) is None:
+                raise MalformedRound(
+                    f"{path}: fleet block missing {key!r}"
+                )
+        rec["fleet"] = {
+            "hosts": len(hosts),
+            # The fleet's aggregate serve rate: hosts ran the identical
+            # plan, so the sum is the cluster's measured throughput.
+            "proofs_per_s": round(
+                sum(float(r["proofs_per_s"]) for r in hosts), 2
+            ),
+            "cross_host_p50_ms": float(fl["cross_host_p50_ms"]),
+            "cross_host_p99_ms": float(fl["cross_host_p99_ms"]),
+            "coverage_ratio": float(fl["coverage_ratio"]),
+        }
     return rec
 
 
@@ -480,6 +517,27 @@ def find_das_regressions(das_rounds: list[dict], threshold_pct: float) -> list[d
                 )
                 if hit:
                     out.append(hit)
+        # The fleet plane (rounds carrying a --urls block): aggregate
+        # cluster proofs/sec gates like a rate, the bucket-merged
+        # cross-host p99 like a parts time, and end-of-run coverage
+        # like a rate (a coverage collapse means the cluster stopped
+        # deciding its squares).  Rounds without the block are neither
+        # priors nor regressions (plan gap, see das_plan_gaps); the
+        # same-platform rule applies as everywhere else.
+        if das_rounds[-1].get("fleet"):
+            with_fleet = [r for r in das_rounds if r.get("fleet")]
+            for key, better in (
+                ("proofs_per_s", "higher"),
+                ("cross_host_p99_ms", "lower"),
+                ("coverage_ratio", "higher"),
+            ):
+                hit = _gate_das_points(
+                    [(r["round"], r["fleet"][key]) for r in with_fleet],
+                    platforms, key, better, threshold_pct,
+                    f"das.fleet.{key}",
+                )
+                if hit:
+                    out.append(hit)
     return out
 
 
@@ -518,6 +576,12 @@ def das_plan_gaps(das_rounds: list[dict]) -> list[str]:
         gaps.append(
             f"das verify plane (--attest) first measured in "
             f"r{newest['round']:02d} (plan gap, not STALE)"
+        )
+    if newest.get("fleet") and all(not r.get("fleet") for r in priors):
+        gaps.append(
+            f"das fleet leg (--urls, {newest['fleet']['hosts']} hosts) "
+            f"first measured in r{newest['round']:02d} "
+            "(plan gap, not STALE)"
         )
     return gaps
 
@@ -1076,6 +1140,9 @@ def write_metrics_out(out_dir: str, rounds: list[dict],
             for key, value in sorted((r.get("verify") or {}).items()):
                 das.set(value, series=f"verify.{key}",
                         round=f"r{r['round']:02d}")
+            for key, value in sorted((r.get("fleet") or {}).items()):
+                das.set(float(value), series=f"fleet.{key}",
+                        round=f"r{r['round']:02d}")
     for reg_row in regressions:
         tracer.write("bench_trend", regression=True, **reg_row)
     with open(os.path.join(out_dir, "bench_trend.prom"), "w") as f:
@@ -1183,6 +1250,12 @@ def main(argv: list[str] | None = None) -> int:
                 print(f"    tenants: {len(r['tenants'])}, worst burn "
                       f"{worst[0]}={worst[1]['slo_burn']} "
                       f"(p99 {worst[1]['p99_ms']} ms)")
+            if r.get("fleet"):
+                fl = r["fleet"]
+                print(f"    fleet: {fl['hosts']} hosts "
+                      f"{fl['proofs_per_s']:9.2f} proofs/s  "
+                      f"cross-host p99 {fl['cross_host_p99_ms']:8.3f} ms  "
+                      f"coverage {fl['coverage_ratio']:.4f}")
         for gap in das_gaps:
             print(f"  NOTE: {gap}")
         for r in qos_rounds:

@@ -840,18 +840,26 @@ def run_url(args) -> dict:
     verifier (serve/verify.py — the light-client contract, decided by
     the same program the serve side trusts).  A proof that fails to
     verify is a failure AND an SLO violation: the run reports `slo_burn`
-    against --slo-ms with verify failures burning budget like drops."""
+    against --slo-ms with verify failures burning budget like drops.
+
+    Every fetch carries the run's x-celestia-trace header, so the served
+    node ADOPTS the loadgen's trace (trace/context.py) and its span rows
+    stitch under one trace_id across both processes."""
     import urllib.request
 
     from celestia_app_tpu.rpc.codec import share_proof_from_json
     from celestia_app_tpu.serve.verify import verify_share_proof
+    from celestia_app_tpu.trace.context import new_context, serialize_context
+
+    wire = serialize_context(new_context(layer="loadgen", plane="url"))
 
     # Probe the square size from a first sample at (0, 0).
     def get(h, r, c):
-        with urllib.request.urlopen(
+        req = urllib.request.Request(
             f"{args.url}/das/share_proof?height={h}&row={r}&col={c}",
-            timeout=30,
-        ) as resp:
+            headers={"x-celestia-trace": wire},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
             return json.loads(resp.read())
 
     first = get(args.height, 0, 0)
@@ -892,6 +900,232 @@ def run_url(args) -> dict:
         ),
         "failures": failures[:5],
         "platform": None,
+    }
+
+
+def run_serve(args) -> int:
+    """`--serve`: stand up one mini DAS node — deterministic synthetic
+    squares admitted into a ForestCache behind a DasProvider, served on
+    the standalone observability HTTP server (trace/exposition.py:
+    /das/*, /metrics, /healthz, /das/coverage, /fleet) — and block until
+    killed.  The first stdout line is a JSON ready record carrying the
+    bound URL, so a parent process (the --urls fleet leg, tests) can
+    spawn N of these with distinct $CELESTIA_NODE_ID and drive them as a
+    local cluster."""
+    from celestia_app_tpu.serve.api import DasProvider
+    from celestia_app_tpu.trace.context import node_id
+    from celestia_app_tpu.trace.exposition import (
+        register_das_provider,
+        serve_observability,
+    )
+
+    cache, _roots = build_cache(args.heights, args.k, args.seed)
+    provider = DasProvider(cache=cache)
+    register_das_provider(provider)
+    # Warm the gather program off the clock so the first remote sample
+    # does not pay the jit compile inside its measured latency.
+    entry, _ = cache.get(1)
+    provider.sampler.sample_batch(entry, [(0, 0)])
+    srv = serve_observability("127.0.0.1", args.port)
+    print(json.dumps({
+        "serving": srv.url,
+        "node_id": node_id(),
+        "heights": args.heights,
+        "k": args.k,
+    }), flush=True)
+    try:
+        threading.Event().wait()  # parent kills the process when done
+    except KeyboardInterrupt:
+        pass
+    srv.stop()
+    return 0
+
+
+def run_fleet(args) -> dict:
+    """`--urls a,b,c`: replay ONE open-loop Poisson plan against every
+    host of a multi-node cluster — each host receives the identical
+    (arrival, row, col) schedule, so per-host proofs/sec are measured on
+    identical work.  Cross-host latency quantiles come from the hosts'
+    OWN /metrics: per-host celestia_proof_latency_seconds snapshots are
+    scraped before and after the pass, deltaed, and bucket-merged
+    (Histogram.merge — the same math GET /fleet serves), so the fleet
+    numbers in DAS_rNN.json and the live /fleet endpoint can never
+    drift apart.  Coverage at end of run is each host's
+    /das/coverage?height= ratio (the sampled/verified bitmap the run
+    itself ticked)."""
+    import queue
+    import urllib.request
+
+    from celestia_app_tpu.trace.context import new_context, serialize_context
+    from celestia_app_tpu.trace.fleet import parse_prometheus_text
+    from celestia_app_tpu.trace.metrics import Histogram
+
+    urls = [u.strip().rstrip("/") for u in args.urls.split(",") if u.strip()]
+    if len(urls) < 2:
+        raise SystemExit("--urls needs at least 2 comma-separated hosts")
+    wire = serialize_context(new_context(layer="loadgen", plane="fleet"))
+
+    def fetch(url: str, path: str) -> bytes:
+        req = urllib.request.Request(
+            url + path, headers={"x-celestia-trace": wire}
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.read()
+
+    probe = json.loads(fetch(
+        urls[0], f"/das/share_proof?height={args.height}&row=0&col=0"
+    ))
+    n = 2 * probe["square_size"]
+
+    def proof_snapshot(url: str):
+        _, _, hists = parse_prometheus_text(
+            fetch(url, "/metrics").decode()
+        )
+        return hists.get("celestia_proof_latency_seconds")
+
+    before = {u: proof_snapshot(u) for u in urls}
+
+    # ONE deterministic plan, replayed per host: Poisson arrivals at
+    # --rate (open-loop — latency includes queue delay), uniform DAS
+    # coordinates over the full EDS.
+    rng = np.random.default_rng(args.seed)
+    plan = []
+    t = 0.0
+    for _ in range(args.samples):
+        t += float(rng.exponential(1.0 / args.rate))
+        plan.append((t, int(rng.integers(0, n)), int(rng.integers(0, n))))
+
+    per_host: dict[str, list[float]] = {u: [] for u in urls}
+    failures: list[str] = []
+    walls: dict[str, float] = {}
+    lock = threading.Lock()
+
+    def drive(url: str):
+        q: queue.Queue = queue.Queue()
+        workers = max(1, min(args.threads, 8))
+        t0 = time.perf_counter()
+
+        def producer():
+            for item in plan:
+                delay = item[0] - (time.perf_counter() - t0)
+                if delay > 0:
+                    time.sleep(delay)
+                q.put(item)
+            for _ in range(workers):
+                q.put(None)
+
+        def worker():
+            while True:
+                got = q.get()
+                if got is None:
+                    return
+                t_sched, r, c = got
+                try:
+                    fetch(
+                        url,
+                        f"/das/share_proof?height={args.height}"
+                        f"&row={r}&col={c}",
+                    )
+                except Exception as e:  # noqa: BLE001 — a drop IS the measurement
+                    with lock:
+                        failures.append(
+                            f"{url} ({r},{c}): {type(e).__name__}: {e}"
+                        )
+                    continue
+                lat = (time.perf_counter() - t0) - t_sched
+                with lock:
+                    per_host[url].append(lat * 1e3)
+
+        threads = [threading.Thread(target=producer, daemon=True)] + [
+            threading.Thread(target=worker, daemon=True)
+            for _ in range(workers)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        with lock:
+            walls[url] = time.perf_counter() - t0
+
+    drivers = [
+        threading.Thread(target=drive, args=(u,), daemon=True) for u in urls
+    ]
+    for d in drivers:
+        d.start()
+    for d in drivers:
+        d.join()
+
+    # Server-side truth: delta each host's proof-latency histogram over
+    # the pass, then bucket-merge — the cross-host quantile IS the merge
+    # of the per-host snapshots (the /fleet invariant, pinned in
+    # tests/test_fleet.py).
+    deltas = []
+    host_rows = []
+    coverage_ratios = []
+    for u in urls:
+        after = proof_snapshot(u)
+        delta = None
+        if after is not None and before.get(u) is not None:
+            delta = after.delta(before[u])
+        elif after is not None:
+            delta = after
+        if delta is not None:
+            deltas.append(delta)
+        lats = sorted(per_host[u])
+        try:
+            cov = json.loads(fetch(
+                u, f"/das/coverage?height={args.height}"
+            ))["ratio"]
+        except Exception:  # noqa: BLE001 — a host without the map still reports
+            cov = None
+        if cov is not None:
+            coverage_ratios.append(cov)
+        host_rows.append({
+            "url": u,
+            "samples": len(lats),
+            "proofs_per_s": (
+                round(len(lats) / walls[u], 2) if walls.get(u) else None
+            ),
+            "p50_ms": _percentile(lats, 0.50),
+            "p99_ms": _percentile(lats, 0.99),
+            "coverage_ratio": cov,
+        })
+    merged = Histogram.merge(deltas) if deltas else None
+
+    def merged_ms(q):
+        if merged is None or not merged.count():
+            return None
+        v = merged.quantile(q, phase="total")
+        return round(v * 1e3, 3) if v is not None else None
+
+    all_lats = sorted(v for lats in per_host.values() for v in lats)
+    wall_s = max(walls.values()) if walls else 0.0
+    import jax
+
+    return {
+        "metric": "das_loadgen",
+        "mode": "fleet",
+        "urls": urls,
+        "requested": args.samples,
+        "k": probe["square_size"],
+        "samples": len(all_lats),
+        "wall_s": round(wall_s, 3),
+        "proofs_per_s": (
+            round(len(all_lats) / wall_s, 2) if wall_s else None
+        ),
+        "proof_p50_ms": _percentile(all_lats, 0.50),
+        "proof_p99_ms": _percentile(all_lats, 0.99),
+        "fleet": {
+            "hosts": host_rows,
+            "cross_host_p50_ms": merged_ms(0.50),
+            "cross_host_p99_ms": merged_ms(0.99),
+            "coverage_ratio": (
+                round(sum(coverage_ratios) / len(coverage_ratios), 6)
+                if coverage_ratios else None
+            ),
+        },
+        "failures": failures[:5],
+        "platform": jax.default_backend(),
     }
 
 
@@ -991,8 +1225,22 @@ def main(argv=None) -> int:
                          "verified-sample vs S independent share_proofs")
     ap.add_argument("--url", default=None,
                     help="sample a live node's /das/share_proof instead")
+    ap.add_argument("--urls", default=None,
+                    help="FLEET mode: comma list of >= 2 node URLs; the "
+                         "identical open-loop plan replays against every "
+                         "host, and the round record gains a `fleet` "
+                         "block (per-host proofs/sec, cross-host p50/p99 "
+                         "from bucket-merged /metrics histograms, "
+                         "end-of-run /das/coverage ratio)")
+    ap.add_argument("--serve", action="store_true",
+                    help="stand up one mini DAS node (synthetic squares "
+                         "behind the standalone observability server) "
+                         "and block; first stdout line is the JSON "
+                         "ready record with the bound URL")
+    ap.add_argument("--port", type=int, default=0,
+                    help="--serve: port to bind (default ephemeral)")
     ap.add_argument("--height", type=int, default=1,
-                    help="height to sample in --url mode")
+                    help="height to sample in --url/--urls mode")
     ap.add_argument("--metrics-out", metavar="DIR")
     ap.add_argument("--round-out", metavar="DAS_rNN.json",
                     help="write the bench_trend round record here")
@@ -1012,12 +1260,17 @@ def main(argv=None) -> int:
                 flags + f" --xla_force_host_platform_device_count={need}"
             ).strip()
 
+    if args.serve:
+        return run_serve(args)
+
     saved = os.environ.get("CELESTIA_SERVE_MODE")
     if args.mode:
         os.environ["CELESTIA_SERVE_MODE"] = args.mode
     try:
         if args.qos_out:
             summary = run_qos(args)
+        elif args.urls:
+            summary = run_fleet(args)
         elif args.url:
             summary = run_url(args)
         elif args.clients:
@@ -1095,6 +1348,15 @@ def main(argv=None) -> int:
                 k: v for k, v in summary["verify"].items()
                 if k != "failures"
             }
+        if summary.get("fleet") is not None:
+            # The multi-node leg (--urls): per-host proofs/sec, the
+            # bucket-merged cross-host tail, end-of-run coverage —
+            # bench_trend's fleet series (same-platform rule; absence
+            # from older rounds is a plan gap, not STALE).  The fleet
+            # workload tag keeps the open-loop rate-capped headline from
+            # gating against closed-loop saturation rounds.
+            record["workload"] = "fleet"
+            record["fleet"] = summary["fleet"]
         if summary.get("workload") == "swarm":
             # das-v2: the swarm round shape bench_trend learns — sweep
             # rows are the scaling curve, tenant columns the SLO story.

@@ -93,6 +93,7 @@ def render(bundle: dict, rows_per_table: int = 8) -> str:
     trigger = bundle.get("trigger", "?")
     out.append(f"flight bundle: trigger={trigger!r} "
                f"captured={_fmt_ns(bundle.get('captured_unix_ns'))} "
+               f"node={bundle.get('node_id', '-')} "
                f"pid={bundle.get('pid', '-')}")
     ctx = bundle.get("context") or {}
     if ctx:

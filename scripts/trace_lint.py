@@ -34,7 +34,17 @@ Walks every registry().counter/gauge/histogram registration in
      documented `GET /x/<placeholder>` row).  The shared handler is what
      makes the three planes' observability surface one surface; this
      rule closes the doc-drift loophole where a new endpoint ships on
-     every plane but no operator can discover it.
+     every plane but no operator can discover it; and
+  7. the fleet surface stays discoverable and the wire trace stays ONE
+     trace: (a) every route in trace/fleet.FLEET_ROUTES appears in the
+     README endpoint table (the aggregator scrapes peers by these paths,
+     so an undocumented fleet route is invisible to the operator wiring
+     the fleet up), and (b) any rpc/ module that calls
+     `new_context(...)` or `use_context(...)` must also reference
+     `adopt_context` or `adopt_or_new` — a serving plane that mints a
+     fresh root context on an inbound hop instead of adopting the
+     x-celestia-trace header splits the cross-node trace, which is
+     exactly the regression the propagation layer exists to prevent.
 
 Run standalone (exit 1 on problems) or via tests/test_trace_lint.py,
 which puts the check in tier-1.
@@ -74,6 +84,14 @@ CHAOS_OK_TAG = "chaos-ok:"
 EXPOSITION_REL = os.path.join("celestia_app_tpu", "trace", "exposition.py")
 ROUTER_FUNC = "handle_observability_get"
 README_ENDPOINT_RE = re.compile(r"GET\s+(/[A-Za-z0-9_/<>-]*)")
+
+# Rule 7: the fleet scrape surface + the adopt-don't-mint discipline on
+# the serving planes.
+FLEET_REL = os.path.join("celestia_app_tpu", "trace", "fleet.py")
+FLEET_ROUTES_NAME = "FLEET_ROUTES"
+RPC_PREFIX = "celestia_app_tpu/rpc/"
+MINT_FUNCS = {"new_context", "use_context"}
+ADOPT_FUNCS = {"adopt_context", "adopt_or_new"}
 
 
 def _parse_package(package_dir: str = PACKAGE_DIR):
@@ -239,6 +257,62 @@ def collect_routed_paths(package_dir: str = PACKAGE_DIR, trees=None):
     return out
 
 
+def collect_fleet_routes(package_dir: str = PACKAGE_DIR, trees=None):
+    """[(file, lineno, path)] for every string in the module-level
+    `FLEET_ROUTES` tuple of trace/fleet.py — the paths the aggregator
+    scrapes peers on and serves the merged view under."""
+    out = []
+    for rel, tree, _ in trees if trees is not None else _parse_package(package_dir):
+        if rel.replace(os.sep, "/") != FLEET_REL.replace(os.sep, "/"):
+            continue
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == FLEET_ROUTES_NAME
+                    for t in node.targets
+                )
+                and isinstance(node.value, (ast.Tuple, ast.List))
+            ):
+                continue
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    out.append((rel, node.lineno, elt.value))
+    return out
+
+
+def collect_rpc_context_mints(package_dir: str = PACKAGE_DIR, trees=None):
+    """[(file, lineno, func, adopts)] for every `new_context(...)` /
+    `use_context(...)` call in an rpc/ module.  `adopts` is whether the
+    MODULE references adopt_context or adopt_or_new anywhere (import,
+    name, or attribute) — minting a context on an inbound serving plane
+    is only legitimate alongside the adoption path (adopt when the
+    header is present, mint only as the no-header fallback)."""
+    out = []
+    for rel, tree, _ in trees if trees is not None else _parse_package(package_dir):
+        if not rel.replace(os.sep, "/").startswith(RPC_PREFIX):
+            continue
+        adopts = any(
+            (isinstance(n, ast.Name) and n.id in ADOPT_FUNCS)
+            or (isinstance(n, ast.Attribute) and n.attr in ADOPT_FUNCS)
+            or (isinstance(n, ast.ImportFrom)
+                and any(a.name in ADOPT_FUNCS for a in n.names))
+            for n in ast.walk(tree)
+        )
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = (
+                func.id if isinstance(func, ast.Name)
+                else func.attr if isinstance(func, ast.Attribute)
+                else None
+            )
+            if name in MINT_FUNCS:
+                out.append((rel, node.lineno, name, adopts))
+    return out
+
+
 def readme_metric_tokens(readme_path: str = README) -> set[str]:
     with open(readme_path, encoding="utf-8") as f:
         return set(README_TOKEN_RE.findall(f.read()))
@@ -325,6 +399,23 @@ def lint(package_dir: str = PACKAGE_DIR, readme_path: str = README) -> list[str]
                 "missing from the README endpoint table — every route on "
                 "the shared observability handler must be documented "
                 "(GET <path> in README.md)"
+            )
+    for rel, lineno, path in collect_fleet_routes(package_dir, trees):
+        if path not in endpoints:
+            problems.append(
+                f"{rel}:{lineno}: fleet route {path!r} missing from the "
+                "README endpoint table — every FLEET_ROUTES path must be "
+                "documented (GET <path> in README.md)"
+            )
+    for rel, lineno, func, adopts in collect_rpc_context_mints(
+        package_dir, trees
+    ):
+        if not adopts:
+            problems.append(
+                f"{rel}:{lineno}: rpc module calls {func}() but never "
+                "references adopt_context/adopt_or_new — an inbound "
+                "serving plane that mints instead of adopting the "
+                "x-celestia-trace header splits the cross-node trace"
             )
     return problems
 

@@ -1112,6 +1112,132 @@ def _bench_seed_round(tmp_path):
     ], platform="cpu")
 
 
+def _fleet_extra(host_rates, cross_p99_ms=120.0, coverage=0.6):
+    return {
+        "workload": "fleet",
+        "fleet": {
+            "hosts": [
+                {"url": f"http://h{i}", "samples": 100, "proofs_per_s": r,
+                 "p50_ms": 40.0, "p99_ms": 110.0, "coverage_ratio": coverage}
+                for i, r in enumerate(host_rates)
+            ],
+            "cross_host_p50_ms": cross_p99_ms / 3,
+            "cross_host_p99_ms": cross_p99_ms,
+            "coverage_ratio": coverage,
+        },
+    }
+
+
+class TestFleetSeries:
+    """The fleet block (das_loadgen --urls): aggregate cluster rate /
+    bucket-merged cross-host p99 / coverage gate same-platform among
+    fleet-bearing rounds only; the first fleet round is a plan gap."""
+
+    def test_checked_in_fleet_round_loads_and_gates_ok(self):
+        bt = _load()
+        import glob
+
+        paths = sorted(glob.glob(os.path.join(REPO_ROOT, "DAS_r*.json")))
+        rounds = bt.load_das_series(paths)
+        with_fleet = [r for r in rounds if r.get("fleet")]
+        assert with_fleet, "DAS_r04.json fleet block must be checked in"
+        newest = with_fleet[-1]
+        assert newest["fleet"]["hosts"] >= 2
+        assert newest["fleet"]["proofs_per_s"] > 0
+        assert newest["fleet"]["cross_host_p99_ms"] > 0
+        assert 0 < newest["fleet"]["coverage_ratio"] <= 1
+        assert newest["workload"] == "fleet"
+        assert bt.find_das_regressions(rounds, 10.0) == []
+
+    def test_first_fleet_round_is_plan_gap_not_stale(self, tmp_path, capsys):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _das_file(tmp_path, 1, proofs_per_s=900.0, p99_ms=20.0)
+        _das_file(tmp_path, 2, proofs_per_s=150.0, p99_ms=900.0,
+                  **_fleet_extra([50.0, 50.0, 50.0]))
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "das fleet leg (--urls, 3 hosts) first measured in r02" in out
+        assert "fleet: 3 hosts" in out
+
+    def test_fleet_rate_regression_is_flagged(self, tmp_path, capsys):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _das_file(tmp_path, 1, proofs_per_s=150.0, p99_ms=900.0,
+                  **_fleet_extra([50.0, 50.0, 50.0]))
+        _das_file(tmp_path, 2, proofs_per_s=150.0, p99_ms=900.0,
+                  **_fleet_extra([25.0, 25.0, 25.0]))  # cluster -50%
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        assert "das.fleet.proofs_per_s" in capsys.readouterr().out
+
+    def test_cross_host_p99_regression_is_flagged(self, tmp_path, capsys):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _das_file(tmp_path, 1, proofs_per_s=150.0, p99_ms=900.0,
+                  **_fleet_extra([50.0, 50.0], cross_p99_ms=100.0))
+        _das_file(tmp_path, 2, proofs_per_s=150.0, p99_ms=900.0,
+                  **_fleet_extra([50.0, 50.0], cross_p99_ms=300.0))
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        assert "das.fleet.cross_host_p99_ms" in capsys.readouterr().out
+
+    def test_coverage_collapse_is_flagged(self, tmp_path, capsys):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _das_file(tmp_path, 1, proofs_per_s=150.0, p99_ms=900.0,
+                  **_fleet_extra([50.0, 50.0], coverage=0.9))
+        _das_file(tmp_path, 2, proofs_per_s=150.0, p99_ms=900.0,
+                  **_fleet_extra([50.0, 50.0], coverage=0.2))
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        assert "das.fleet.coverage_ratio" in capsys.readouterr().out
+
+    def test_fleet_does_not_gate_against_closed_loop_headline(self, tmp_path):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        # A rate-capped 3-host open-loop round after a closed-loop
+        # saturation round: workload changed, top-level numbers must not
+        # gate across the pair.
+        _das_file(tmp_path, 1, proofs_per_s=2000.0, p99_ms=50.0)
+        _das_file(tmp_path, 2, proofs_per_s=170.0, p99_ms=1100.0,
+                  **_fleet_extra([57.0, 57.0, 57.0]))
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+
+    def test_cross_platform_fleet_prior_not_compared(self, tmp_path):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _das_file(tmp_path, 1, proofs_per_s=9000.0, p99_ms=1.0,
+                  platform="tpu", **_fleet_extra([3000.0, 3000.0]))
+        _das_file(tmp_path, 2, proofs_per_s=150.0, p99_ms=900.0,
+                  platform="cpu", **_fleet_extra([50.0, 50.0]))
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+
+    def test_fleet_series_lands_in_metrics_out(self, tmp_path):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        _das_file(tmp_path, 1, proofs_per_s=150.0, p99_ms=900.0,
+                  **_fleet_extra([50.0, 50.0, 50.0]))
+        out_dir = tmp_path / "metrics"
+        assert bt.main(["--dir", str(tmp_path),
+                        "--metrics-out", str(out_dir), "--json"]) == 0
+        text = (out_dir / "bench_trend.prom").read_text()
+        assert 'series="fleet.proofs_per_s"' in text
+        assert 'series="fleet.cross_host_p99_ms"' in text
+        assert 'series="fleet.coverage_ratio"' in text
+
+    @pytest.mark.parametrize("mutilate", [
+        lambda fl: fl["hosts"].pop(),              # < 2 hosts
+        lambda fl: fl["hosts"][0].pop("p99_ms"),   # host row incomplete
+        lambda fl: fl.pop("cross_host_p99_ms"),    # merged quantile gone
+        lambda fl: fl.pop("coverage_ratio"),
+    ])
+    def test_malformed_fleet_block_exits_2(self, tmp_path, mutilate):
+        bt = _load()
+        _round_file(tmp_path, 1, [{"mode": "compute", "k": 8, "mb_per_s": 5.0}])
+        extra = _fleet_extra([50.0, 50.0])
+        mutilate(extra["fleet"])
+        _das_file(tmp_path, 1, proofs_per_s=150.0, p99_ms=900.0, **extra)
+        assert bt.main(["--dir", str(tmp_path)]) == 2
+
+
 class TestQosRounds:
     """ISSUE-15: QOS_rNN.json (das_loadgen --qos-out) — per-tenant
     throttled/served/burn columns validated, enforcement invariants

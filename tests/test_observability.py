@@ -402,8 +402,11 @@ class TestUnifiedMetrics:
 
         # Freeze the SLO engine between the per-plane fetches: /slo is a
         # pure function of the retained evaluation, so with no tick in
-        # between the planes MUST serve identical bytes.
+        # between the planes MUST serve identical bytes.  The scrape
+        # timestamp gauge is frozen the same way (it refreshes per render
+        # by default, exactly to mark each scrape's wall clock).
         monkeypatch.setenv("CELESTIA_SLO_TICK_S", "3600")
+        monkeypatch.setenv("CELESTIA_SCRAPE_TS_S", "3600")
         slo.engine().maybe_tick()
         gw = serve_api(_StubNode())
         plane = serve_grpc(_StubNode())
@@ -459,6 +462,7 @@ class TestUnifiedMetrics:
         from celestia_app_tpu.trace import slo
 
         monkeypatch.setenv("CELESTIA_SLO_TICK_S", "3600")
+        monkeypatch.setenv("CELESTIA_SCRAPE_TS_S", "3600")
         keys = funded_keys(2)
         node = ServingNode(genesis=deterministic_genesis(keys), keys=keys)
         server = serve(node, port=0, block_interval_s=None)

@@ -273,4 +273,98 @@ def test_in_tree_routes_are_seen_and_documented():
     assert "/metrics" in paths
     assert "/trace_tables/" in paths  # the prefix route
     assert "/das/share_proof" in paths and "/das/shares" in paths
+    assert "/fleet" in paths and "/das/coverage" in paths
     assert "/" not in paths  # normalization compare is not a route
+
+
+def test_fleet_routes_must_be_documented(tmp_path):
+    """Rule 7a: every FLEET_ROUTES path must appear as GET /<path> in
+    the README — the aggregator scrapes peers by these paths, so an
+    undocumented one is invisible to whoever wires the fleet up."""
+    lint = _load()
+    pkg = tmp_path / "pkg"
+    (pkg / "trace").mkdir(parents=True)
+    (pkg / "trace" / "fleet.py").write_text(
+        "FLEET_ROUTES = ('/fleet', '/das/coverage', '/undocumented_fleet')\n"
+    )
+    readme = tmp_path / "README.md"
+    readme.write_text(
+        "| `GET /fleet` | merged view |\n"
+        "| `GET /das/coverage` | coverage map |\n"
+    )
+    saved = lint.FLEET_REL
+    lint.FLEET_REL = os.path.join("..", "..", str(pkg / "trace" / "fleet.py"))
+    try:
+        # collect_fleet_routes matches on the repo-relative path;
+        # re-key the tmp tree the way the rule-6 test does.
+        trees = [
+            (os.path.relpath(os.path.join(lint.REPO_ROOT, rel), str(pkg)),
+             tree, lines)
+            for rel, tree, lines in lint._parse_package(str(pkg))
+        ]
+        lint.FLEET_REL = "trace/fleet.py"
+        routes = lint.collect_fleet_routes(trees=trees)
+    finally:
+        lint.FLEET_REL = saved
+    paths = {p for _, _, p in routes}
+    assert paths == {"/fleet", "/das/coverage", "/undocumented_fleet"}
+    endpoints = lint.readme_endpoint_paths(str(readme))
+    undocumented = [p for p in paths if p not in endpoints]
+    assert undocumented == ["/undocumented_fleet"]
+
+
+def test_rpc_mint_without_adopt_is_flagged(tmp_path):
+    """Rule 7b: an rpc/ module calling new_context/use_context without
+    referencing adopt_context/adopt_or_new splits the cross-node trace
+    and must be flagged; one that adopts (or never mints) passes."""
+    lint = _load()
+    pkg = tmp_path / "pkg"
+    (pkg / "rpc").mkdir(parents=True)
+    (pkg / "trace").mkdir()
+    # Minter that never adopts: both call sites flagged.
+    (pkg / "rpc" / "rogue_plane.py").write_text(
+        "from celestia_app_tpu.trace.context import new_context, use_context\n"
+        "def handle(req):\n"
+        "    ctx = new_context(layer='rpc')\n"
+        "    with use_context(ctx):\n"
+        "        return req\n"
+    )
+    # Minter that adopts first: the fallback mint is legitimate.
+    (pkg / "rpc" / "good_plane.py").write_text(
+        "from celestia_app_tpu.trace.context import (\n"
+        "    adopt_context, new_context, use_context)\n"
+        "def handle(header, req):\n"
+        "    ctx = adopt_context(header) or new_context(layer='rpc')\n"
+        "    with use_context(ctx):\n"
+        "        return req\n"
+    )
+    # Same mint outside rpc/: not this rule's business.
+    (pkg / "trace" / "tool.py").write_text(
+        "from celestia_app_tpu.trace.context import new_context\n"
+        "def f():\n"
+        "    return new_context(layer='tool')\n"
+    )
+    trees = [
+        (os.path.relpath(os.path.join(lint.REPO_ROOT, rel), str(pkg)).replace(
+            os.sep, "/").replace("rpc/", "celestia_app_tpu/rpc/", 1),
+         tree, lines)
+        for rel, tree, lines in lint._parse_package(str(pkg))
+    ]
+    mints = lint.collect_rpc_context_mints(trees=trees)
+    rogue = [(f, fn) for f, _, fn, adopts in mints if not adopts]
+    good = [(f, fn) for f, _, fn, adopts in mints if adopts]
+    assert len(rogue) == 2 and all("rogue_plane" in f for f, _ in rogue)
+    assert {fn for _, fn in rogue} == {"new_context", "use_context"}
+    assert good and all("good_plane" in f for f, _ in good)
+    assert not any("tool" in f for f, _, fn, _ in mints)
+
+
+def test_in_tree_rpc_planes_all_adopt():
+    # The real rpc/ planes mint contexts (so rule 7b bites on something
+    # real) and every minting module references the adoption API.
+    lint = _load()
+    mints = lint.collect_rpc_context_mints()
+    assert mints, "expected in-tree rpc/ context mints"
+    assert all(adopts for _, _, _, adopts in mints), [
+        (f, ln, fn) for f, ln, fn, adopts in mints if not adopts
+    ]

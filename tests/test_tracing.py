@@ -21,6 +21,7 @@ from celestia_app_tpu.mempool import PriorityMempool
 from celestia_app_tpu.trace.context import (
     current_context,
     new_context,
+    node_id,
     trace_span,
     use_context,
 )
@@ -59,7 +60,11 @@ class TestTraceContext:
         assert child.trace_id == root.trace_id
         assert child.parent_id == root.span_id
         assert child.span_id != root.span_id
-        assert child.baggage == {"layer": "rpc", "plane": "jsonrpc", "height": 7}
+        # new_context stamps node_id so cross-node rows carry provenance;
+        # the caller's baggage must survive the merge untouched.
+        assert child.baggage["node_id"] == node_id()
+        assert {k: v for k, v in child.baggage.items() if k != "node_id"} == {
+            "layer": "rpc", "plane": "jsonrpc", "height": 7}
         assert child.start_unix_ns == root.start_unix_ns
 
     def test_use_context_and_nesting(self):
