@@ -91,10 +91,14 @@ _STAGED_BUILT: set[tuple] = set()
 @lru_cache(maxsize=None)
 def _jit_pipeline(k: int, construction: str):
     _STAGED_BUILT.add((k, construction))
+    from celestia_app_tpu.trace.device_ledger import track
     from celestia_app_tpu.trace.journal import note_jit_build
 
     note_jit_build("staged_pipeline")
-    return jax.jit(_pipeline(k, construction))
+    return track(
+        jax.jit(_pipeline(k, construction)),
+        "staged_pipeline", k=k, construction=construction, mode="staged",
+    )
 
 
 @lru_cache(maxsize=None)
@@ -234,10 +238,15 @@ def _jit_pipeline_batched(k: int, construction: str, batch: int):
     """vmap of the STAGED composition over a (batch, k, k, S) stack — the
     batched twin of _jit_pipeline, the ladder rung batched dispatch falls
     to when the fused family is degraded."""
+    from celestia_app_tpu.trace.device_ledger import track
     from celestia_app_tpu.trace.journal import note_jit_build
 
     note_jit_build("staged_pipeline_batched")
-    return jax.jit(jax.vmap(_pipeline(k, construction)))
+    return track(
+        jax.jit(jax.vmap(_pipeline(k, construction))),
+        "staged_pipeline_batched",
+        k=k, construction=construction, mode="staged", batch=batch,
+    )
 
 
 def _host_pipeline_batched(k: int, construction: str):
@@ -447,6 +456,24 @@ class SpeculativeExtender:
 _SPECULATOR = SpeculativeExtender()
 
 
+def _speculator_owned_bytes() -> int:
+    """Device bytes parked by the in-flight speculation (the outputs
+    claim() would adopt) — the ownership-ledger callback; 0 when no
+    speculation is pending."""
+    with _SPECULATOR._lock:
+        entry = _SPECULATOR._entry
+    if entry is None:
+        return 0
+    return sum(
+        int(getattr(arr, "nbytes", 0) or 0) for arr in entry["outputs"]
+    )
+
+
+from celestia_app_tpu.trace.device_ledger import register_owner as _register_owner  # noqa: E402
+
+_register_owner("speculative_extend", _speculator_owned_bytes)
+
+
 def speculator() -> SpeculativeExtender:
     """The process-wide speculative extender (one in-flight next-block
     speculation per process, like the consensus loop it serves)."""
@@ -520,6 +547,9 @@ def warmup(
                 **_panel_fields(pipeline_mode_for_k(k), k),
                 warm_ms=(time.perf_counter() - t0) * 1e3,
             )
+            from celestia_app_tpu.trace.device_ledger import note_warmup
+
+            note_warmup(k, construction, pipeline_mode_for_k(k))
             for batch in batches:
                 if batch < 2:
                     continue  # batch-1 dispatch rides the unbatched entry

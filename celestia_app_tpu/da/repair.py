@@ -54,6 +54,7 @@ fires the `root_mismatch` flight-recorder trigger before raising.
 from __future__ import annotations
 
 import os
+import weakref
 from functools import lru_cache
 
 import jax
@@ -122,6 +123,28 @@ def _put_private(x: np.ndarray, sharding=None):
     return jax.device_put(arr)
 
 
+#: Live recover-matrix arrays by cache key — a WEAK view over what the
+#: bounded lru caches above/below still hold, so the ownership ledger's
+#: figure falls when an entry evicts (trace/device_ledger.py).
+_RECOVER_CACHE_ARRAYS: "weakref.WeakValueDictionary" = (
+    weakref.WeakValueDictionary()
+)
+
+
+def _recover_cache_bytes() -> int:
+    """Bytes held by the recover-matrix caches (the ownership-ledger
+    callback): sums the bit-expanded matrices still alive."""
+    return sum(
+        int(getattr(a, "nbytes", 0) or 0)
+        for a in _RECOVER_CACHE_ARRAYS.values()
+    )
+
+
+from celestia_app_tpu.trace.device_ledger import register_owner as _register_owner  # noqa: E402
+
+_register_owner("repair_recover_cache", _recover_cache_bytes)
+
+
 @lru_cache(maxsize=64)
 def _recover_bits_device(k: int, pattern: bytes, construction: str):
     """Device-resident bit-expanded recover matrix for one erasure
@@ -134,6 +157,7 @@ def _recover_bits_device(k: int, pattern: bytes, construction: str):
     R = codec.recover_matrix(known_pos)
     R_bits = jax.device_put(jnp.asarray(codec.field.expand_bit_matrix(R)))
     known_idx = jax.device_put(jnp.asarray(known_pos, dtype=jnp.int32))
+    _RECOVER_CACHE_ARRAYS[(k, pattern, construction, "device")] = R_bits
     return R_bits, known_idx
 
 
@@ -154,6 +178,7 @@ def _recover_bits_missing(k: int, pattern: bytes, construction: str):
     miss_pos = np.nonzero(~mask)[0]
     R = codec.recover_matrix(known_pos)  # (2k, k) over GF
     R_miss_bits = codec.field.expand_bit_matrix(R[miss_pos])
+    _RECOVER_CACHE_ARRAYS[(k, pattern, construction, "missing")] = R_miss_bits
     return (
         R_miss_bits,
         known_pos.astype(np.int32),
@@ -246,7 +271,13 @@ def _jit_batched_sweep(k: int, axis: int, construction: str,
             miss_idx[:, :, None], line_idx[:, None, :]
         ].set(dec, mode="drop")
 
-    return jax.jit(sweep)
+    from celestia_app_tpu.trace.device_ledger import track
+
+    return track(
+        jax.jit(sweep),
+        "repair_batched_sweep",
+        k=k, construction=construction, mode="batched", batch=G,
+    )
 
 
 def _sweep_fn(k: int, axis: int, construction: str):
@@ -285,7 +316,12 @@ def _sweep_fn(k: int, axis: int, construction: str):
 @lru_cache(maxsize=None)
 def _jit_sweep(k: int, axis: int, construction: str):
     """The compiled legacy sweep (grouped baseline + staged ladder rung)."""
-    return jax.jit(_sweep_fn(k, axis, construction))
+    from celestia_app_tpu.trace.device_ledger import track
+
+    return track(
+        jax.jit(_sweep_fn(k, axis, construction)),
+        "repair_sweep", k=k, construction=construction, mode="staged",
+    )
 
 
 def _grouped_sweep_callable(

@@ -129,6 +129,14 @@ class GF:
         exp[n1:] = exp[:n1]
         self.exp = exp
         self.log = log
+        # The tables live for the process (lru-cached _field below) —
+        # report them to the memory-ownership ledger so the /device
+        # residual stays attributable even at GF(2^16) (768 KB each).
+        from celestia_app_tpu.trace.device_ledger import note_owned_bytes
+
+        note_owned_bytes(
+            "gf_tables", (m, self.poly), int(exp.nbytes) + int(log.nbytes)
+        )
 
     # --- scalar/array ops -------------------------------------------------
     def mul(self, a, b):

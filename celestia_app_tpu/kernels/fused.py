@@ -247,12 +247,17 @@ def _jit_extend_and_dah(
     # Body runs on cache miss only: note the build for the journal's
     # hit/miss column and the celestia_jit_builds_total counter.
     _BUILT_KEYS.add((k, construction, donate, roots_only, epilogue))
+    from celestia_app_tpu.trace.device_ledger import track
     from celestia_app_tpu.trace.journal import note_jit_build
 
     note_jit_build("extend_and_dah")
-    return jax.jit(
-        extend_and_dah_fn(k, construction, roots_only, epilogue=epilogue),
-        donate_argnums=(0,) if donate else (),
+    return track(
+        jax.jit(
+            extend_and_dah_fn(k, construction, roots_only, epilogue=epilogue),
+            donate_argnums=(0,) if donate else (),
+        ),
+        "extend_and_dah", k=k, construction=construction,
+        mode="fused_epi" if epilogue else "fused",
     )
 
 
@@ -327,12 +332,17 @@ def _jit_extend_and_dah_batched(
     if donate:
         _silence_unusable_donation_warning()
     _BATCHED_BUILT.add((k, construction, batch, donate, roots_only))
+    from celestia_app_tpu.trace.device_ledger import track
     from celestia_app_tpu.trace.journal import note_jit_build
 
     note_jit_build("extend_and_dah_batched")
-    return jax.jit(
-        jax.vmap(extend_and_dah_fn(k, construction, roots_only)),
-        donate_argnums=(0,) if donate else (),
+    return track(
+        jax.jit(
+            jax.vmap(extend_and_dah_fn(k, construction, roots_only)),
+            donate_argnums=(0,) if donate else (),
+        ),
+        "extend_and_dah_batched",
+        k=k, construction=construction, mode="fused", batch=batch,
     )
 
 
@@ -438,10 +448,11 @@ def forest_fn(k: int):
 @lru_cache(maxsize=None)
 def jit_forest(k: int):
     """Cached jitted forest builder — ONE dispatch per retained height."""
+    from celestia_app_tpu.trace.device_ledger import track
     from celestia_app_tpu.trace.journal import note_jit_build
 
     note_jit_build("forest")
-    return jax.jit(forest_fn(k))
+    return track(jax.jit(forest_fn(k)), "forest", k=k)
 
 
 @lru_cache(maxsize=None)
@@ -476,4 +487,9 @@ def jit_forest_sharded(k: int, mesh, axis: str):
 
     out_sh = row_sharding(mesh, axis)
     note_jit_build("forest_sharded")
-    return jax.jit(run, out_shardings=(out_sh, out_sh))
+    from celestia_app_tpu.trace.device_ledger import track
+
+    return track(
+        jax.jit(run, out_shardings=(out_sh, out_sh)),
+        "forest_sharded", k=k, mode="sharded", shards=shards,
+    )

@@ -174,6 +174,17 @@ def _note_build() -> None:
     note_jit_build("panel_pipeline")
 
 
+def _track(fn, k: int, construction: str | None = None, p: int | None = None):
+    """Register one panel sub-program with the device ledger (family
+    panel_pipeline; `p` — the panel height — rides the batch column)."""
+    from celestia_app_tpu.trace.device_ledger import track
+
+    return track(
+        fn, "panel_pipeline",
+        k=k, construction=construction, mode="panel", batch=p,
+    )
+
+
 @lru_cache(maxsize=None)
 def _jit_row_panel(k: int, p: int, construction: str):
     """f(panel (p, k, S)) -> (ext (p, 2k, S), ns (p, 2k, 29),
@@ -195,7 +206,7 @@ def _jit_row_panel(k: int, p: int, construction: str):
         _, _, hashes = leaf_digests(ns, ext)
         return ext, ns, hashes
 
-    return jax.jit(run)
+    return _track(jax.jit(run), k, construction, p)
 
 
 @lru_cache(maxsize=None)
@@ -218,7 +229,7 @@ def _jit_col_partial(k: int, p: int, construction: str):
         part = encode_axis(panel, g_slice, m, contract_axis=0)  # (k, 2k, S)
         return jnp.bitwise_xor(acc, part)
 
-    return jax.jit(step, donate_argnums=(0,))
+    return _track(jax.jit(step, donate_argnums=(0,)), k, construction, p)
 
 
 @lru_cache(maxsize=None)
@@ -231,9 +242,16 @@ def _col_generator_slices(k: int, construction: str,
     codec = codec_for_width(k, construction)
     g_bits = codec.generator_bits()
     m = codec.field.m
-    return tuple(
+    slices = tuple(
         jnp.asarray(g_bits[:, r0 * m: r1 * m]) for r0, r1 in bounds
     )
+    from celestia_app_tpu.trace.device_ledger import note_owned_bytes
+
+    note_owned_bytes(
+        "panel_generator_slices", (k, construction, bounds),
+        sum(int(s.nbytes) for s in slices),
+    )
+    return slices
 
 
 @lru_cache(maxsize=None)
@@ -244,7 +262,8 @@ def _jit_fft_col_block(k: int, c: int, construction: str, md: bool):
     _note_build()
     from celestia_app_tpu.kernels.fft import col_block_encode_fn
 
-    return jax.jit(col_block_encode_fn(k, construction, md=md))
+    return _track(jax.jit(col_block_encode_fn(k, construction, md=md)),
+                  k, construction, c)
 
 
 @lru_cache(maxsize=None)
@@ -258,7 +277,7 @@ def _jit_parity_leaves(rows: int, cols: int):
         _, _, hashes = leaf_digests(ns, block)
         return hashes
 
-    return jax.jit(run)
+    return _track(jax.jit(run), cols, None, rows)
 
 
 @lru_cache(maxsize=None)
@@ -282,7 +301,7 @@ def _jit_panel_roots(k: int):
         )
         return row_roots, col_roots, droot
 
-    return jax.jit(run)
+    return _track(jax.jit(run), k)
 
 
 def _as_panels(x, k: int, bounds: tuple) -> list:

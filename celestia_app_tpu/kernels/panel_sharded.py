@@ -194,6 +194,22 @@ def _note_build() -> None:
     note_jit_build("sharded_panel_pipeline")
 
 
+def _track(fn, k: int, shards: int, construction: str | None = None,
+           h: int | None = None, sub: str = ""):
+    """Register one sharded-panel sub-program with the device ledger
+    (family sharded_panel_pipeline; the step height rides batch, the
+    sub-program name rides the mode column so roots/assemble/leaves do
+    not merge into one ledger row)."""
+    from celestia_app_tpu.trace.device_ledger import track
+
+    mode = f"sharded_panel/{sub}" if sub else "sharded_panel"
+    return track(
+        fn, "sharded_panel_pipeline",
+        k=k, construction=construction, mode=mode,
+        batch=h, shards=shards,
+    )
+
+
 def _parity_ns(shape) -> jnp.ndarray:
     parity = jnp.frombuffer(PARITY_NAMESPACE_BYTES, dtype=jnp.uint8)
     return jnp.broadcast_to(parity, (*shape, NAMESPACE_SIZE))
@@ -239,7 +255,10 @@ def _jit_row_panel_sharded(k: int, h: int, shards: int, construction: str):
         out_specs=(P(EXTEND_AXIS, None, None),) * 3,
     )
     sh = row_sharding3(mesh, EXTEND_AXIS)
-    return jax.jit(body, in_shardings=sh, out_shardings=(sh, sh, sh))
+    return _track(
+        jax.jit(body, in_shardings=sh, out_shardings=(sh, sh, sh)),
+        k, shards, construction, h, sub="row",
+    )
 
 
 def _bounds_from_heights(heights: tuple) -> tuple:
@@ -274,6 +293,12 @@ def _step_generator_slices(k: int, construction: str, shards: int,
         out.append(jax.device_put(
             stacked, row_sharding3(extend_mesh(shards), EXTEND_AXIS)
         ))
+    from celestia_app_tpu.trace.device_ledger import note_owned_bytes
+
+    note_owned_bytes(
+        "sharded_generator_slices", (k, construction, shards, heights),
+        sum(int(s.nbytes) for s in out),
+    )
     return tuple(out)
 
 
@@ -284,9 +309,12 @@ def _jit_zero_acc(k: int, shards: int):
     means no host ever materializes the half-EDS zeros."""
     _note_build()
     sh = row_sharding3(extend_mesh(shards), EXTEND_AXIS)
-    return jax.jit(
-        lambda: jnp.zeros((k, 2 * k, SHARE_SIZE), dtype=jnp.uint8),
-        out_shardings=sh,
+    return _track(
+        jax.jit(
+            lambda: jnp.zeros((k, 2 * k, SHARE_SIZE), dtype=jnp.uint8),
+            out_shardings=sh,
+        ),
+        k, shards, sub="zero_acc",
     )
 
 
@@ -334,9 +362,12 @@ def _jit_col_partial_sharded(k: int, h: int, shards: int, construction: str):
         out_specs=P(EXTEND_AXIS, None, None),
     )
     sh = row_sharding3(mesh, EXTEND_AXIS)
-    return jax.jit(
-        body, donate_argnums=(0,),
-        in_shardings=(sh, sh, sh), out_shardings=sh,
+    return _track(
+        jax.jit(
+            body, donate_argnums=(0,),
+            in_shardings=(sh, sh, sh), out_shardings=sh,
+        ),
+        k, shards, construction, h, sub="col",
     )
 
 
@@ -381,8 +412,11 @@ def _jit_fft_col_sharded(k: int, shards: int, heights: tuple,
         out_specs=P(EXTEND_AXIS, None, None),
     )
     sh = row_sharding3(mesh, EXTEND_AXIS)
-    return jax.jit(
-        body, in_shardings=(sh,) * len(heights), out_shardings=sh
+    return _track(
+        jax.jit(
+            body, in_shardings=(sh,) * len(heights), out_shardings=sh
+        ),
+        k, shards, construction, sub="fft_col",
     )
 
 
@@ -407,7 +441,8 @@ def _jit_parity_leaves_sharded(k: int, shards: int):
         out_specs=P(EXTEND_AXIS, None, None),
     )
     sh = row_sharding3(mesh, EXTEND_AXIS)
-    return jax.jit(body, in_shardings=sh, out_shardings=sh)
+    return _track(jax.jit(body, in_shardings=sh, out_shardings=sh),
+                  k, shards, sub="parity_leaves")
 
 
 @lru_cache(maxsize=None)
@@ -475,10 +510,13 @@ def _jit_roots_sharded(k: int, shards: int, heights: tuple):
 
     sh = row_sharding3(mesh, EXTEND_AXIS)
     rep = NamedSharding(mesh, P())
-    return jax.jit(
-        run,
-        in_shardings=(sh,) * (2 * n_steps + 1),
-        out_shardings=(rep, rep, rep),
+    return _track(
+        jax.jit(
+            run,
+            in_shardings=(sh,) * (2 * n_steps + 1),
+            out_shardings=(rep, rep, rep),
+        ),
+        k, shards, sub="roots",
     )
 
 
@@ -502,8 +540,11 @@ def _jit_eds_assemble(k: int, shards: int, heights: tuple):
         return jnp.concatenate([top, bottom], axis=0)
 
     sh = row_sharding3(mesh, EXTEND_AXIS)
-    return jax.jit(
-        run, in_shardings=(sh,) * (n_steps + 1), out_shardings=sh
+    return _track(
+        jax.jit(
+            run, in_shardings=(sh,) * (n_steps + 1), out_shardings=sh
+        ),
+        k, shards, sub="assemble",
     )
 
 

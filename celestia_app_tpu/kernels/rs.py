@@ -238,7 +238,12 @@ def extend_square_fn(k: int, construction: str | None = None):
 
 @lru_cache(maxsize=None)
 def _jit_extend_square(k: int, construction: str):
-    return jax.jit(extend_square_fn(k, construction))
+    from celestia_app_tpu.trace.device_ledger import track
+
+    return track(
+        jax.jit(extend_square_fn(k, construction)),
+        "extend_square", k=k, construction=construction,
+    )
 
 
 def jit_extend_square(k: int):
@@ -267,4 +272,9 @@ def decode_axis_fn(k: int, construction: str | None = None):
     def decode(known: jnp.ndarray, R_bits: jnp.ndarray) -> jnp.ndarray:
         return encode_axis(known, R_bits, m, contract_axis=1)
 
-    return jax.jit(decode)
+    from celestia_app_tpu.trace.device_ledger import track
+
+    return track(
+        jax.jit(decode),
+        "rs_decode_axis", k=k, construction=construction,
+    )

@@ -130,3 +130,22 @@ def fold_row_roots(
         right = jnp.where(isl, rh, p)
         rh = sha256(jnp.concatenate([ones, left, right], axis=1))
     return jnp.all(rh == data_roots, axis=1)
+
+
+# Register the batched-verify programs with the device ledger
+# (trace/device_ledger.py).  These are module-level jits specializing per
+# input shape, so one ledger row covers every shape of a program: the
+# first dispatch bills compile_s, later shapes' recompiles accumulate
+# into dispatch_s — the family-level view /device needs, not a per-shape
+# census (serve/verify.py buckets shapes to keep that census bounded).
+from celestia_app_tpu.trace.device_ledger import track as _track_program  # noqa: E402
+
+nmt_leaf_digests = _track_program(
+    nmt_leaf_digests, "verify", mode="leaf_digests"
+)
+verify_nmt_samples = _track_program(
+    verify_nmt_samples, "verify", mode="nmt_samples"
+)
+fold_row_roots = _track_program(
+    fold_row_roots, "verify", mode="fold_row_roots"
+)

@@ -61,6 +61,7 @@ import os
 import sys
 import threading
 import time
+import weakref
 import zlib
 from dataclasses import dataclass, field
 
@@ -134,6 +135,24 @@ class _Entry:
         return self.ns if self.ns != "tx" else None
 
 
+def _pools_owned_bytes() -> int:
+    """Tx bytes resident across every live pool's shards — the mempool's
+    contribution to the /device memory-ownership ledger (host RAM on
+    every backend, but it is this process's biggest non-array holder)."""
+    return sum(
+        s.nbytes for pool in list(_ALL_POOLS) for s in pool._shards
+    )
+
+
+_ALL_POOLS: "weakref.WeakSet[PriorityMempool]" = weakref.WeakSet()
+
+from celestia_app_tpu.trace.device_ledger import (  # noqa: E402
+    register_owner as _register_owner,
+)
+
+_register_owner("mempool_shards", _pools_owned_bytes)
+
+
 class _Shard:
     """One namespace shard: its own lock, entry map, byte + per-tenant
     depth accounting.  All mutation happens under `lock`; cross-shard
@@ -202,6 +221,7 @@ class PriorityMempool:
         # while the full-refresh path iterates and replaces it.
         self._published_ns: set[str] = set()
         self._published_lock = threading.Lock()
+        _ALL_POOLS.add(self)
 
     # --- shard routing -------------------------------------------------------
     def _shard_index(self, ns: str) -> int:

@@ -123,10 +123,16 @@ def sharded_gather_fn(mesh, axis: str, rows_per_shard: int, width: int,
     )
     fsh = row_sharding(mesh, axis)
     note_jit_build("serve_shard_gather")
-    return jax.jit(
-        body,
-        in_shardings=(fsh, fsh),
-        out_shardings=row_sharding(mesh, axis),
+    from celestia_app_tpu.trace.device_ledger import track
+
+    return track(
+        jax.jit(
+            body,
+            in_shardings=(fsh, fsh),
+            out_shardings=row_sharding(mesh, axis),
+        ),
+        "serve_shard_gather",
+        mode="sharded", batch=batch, shards=mesh.shape[axis],
     )
 
 
@@ -204,10 +210,16 @@ def sharded_share_gather_fn(mesh, axis: str, rows_local: int, n_cols: int,
         out_specs=P(axis, None, None),
     )
     note_jit_build("serve_share_gather")
-    return jax.jit(
-        body,
-        in_shardings=(row_sharding3(mesh, axis), row_sharding(mesh, axis)),
-        out_shardings=row_sharding3(mesh, axis),
+    from celestia_app_tpu.trace.device_ledger import track
+
+    return track(
+        jax.jit(
+            body,
+            in_shardings=(row_sharding3(mesh, axis), row_sharding(mesh, axis)),
+            out_shardings=row_sharding3(mesh, axis),
+        ),
+        "serve_share_gather",
+        mode="sharded", batch=batch, shards=mesh.shape[axis],
     )
 
 

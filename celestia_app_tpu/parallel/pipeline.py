@@ -69,6 +69,7 @@ import os
 import queue
 import threading
 import time
+import weakref
 from dataclasses import dataclass, field
 
 import jax
@@ -184,6 +185,25 @@ def _batch_size_histogram():
     )
 
 
+def _rings_owned_bytes() -> int:
+    """Staging bytes held by every live buffer ring's CURRENT backing
+    arrays.  A pinned slot swapped out for a fresh buffer stops being
+    counted here — its old bytes live exactly as long as the retained
+    square, whose owner (serve_forest_cache) already reports them."""
+    return sum(
+        int(h.nbytes) for ring in list(_ALL_RINGS) for h in ring._hosts
+    )
+
+
+_ALL_RINGS: "weakref.WeakSet[_BufferRing]" = weakref.WeakSet()
+
+from celestia_app_tpu.trace.device_ledger import (  # noqa: E402
+    register_owner as _register_ring_owner,
+)
+
+_register_ring_owner("pipeline_buffer_ring", _rings_owned_bytes)
+
+
 class _BufferRing:
     """Persistent staging buffers recycled across blocks.
 
@@ -237,6 +257,7 @@ class _BufferRing:
         self._gen = [0] * slots  # bumped per acquire: late-pin detection
         self.swaps = 0  # pinned slots replaced with a fresh buffer
         self.late_pins = 0  # pins that arrived after the slot was reused
+        _ALL_RINGS.add(self)
 
     def acquire(self, timeout_s: float) -> int | None:
         """A free slot id (its buffer safe to overwrite), or None on

@@ -185,8 +185,14 @@ def make_sharded_pipeline(
 
     in_sh = NamedSharding(mesh, P(axis, None, None))
     rep = NamedSharding(mesh, P())
-    return jax.jit(
-        pipeline, in_shardings=in_sh, out_shardings=(in_sh, rep, rep, rep)
+    from celestia_app_tpu.trace.device_ledger import track
+
+    return track(
+        jax.jit(
+            pipeline, in_shardings=in_sh, out_shardings=(in_sh, rep, rep, rep)
+        ),
+        "sharded_pipeline",
+        k=k, construction=construction, mode="sharded", shards=n,
     )
 
 
@@ -231,8 +237,14 @@ def make_sharded_dah_pipeline(
 
     in_sh = NamedSharding(mesh, P(axis, None, None))
     rep = NamedSharding(mesh, P())
-    return jax.jit(
-        pipeline, in_shardings=in_sh, out_shardings=(rep, rep, rep)
+    from celestia_app_tpu.trace.device_ledger import track
+
+    return track(
+        jax.jit(
+            pipeline, in_shardings=in_sh, out_shardings=(rep, rep, rep)
+        ),
+        "sharded_dah_pipeline",
+        k=k, construction=construction, mode="sharded", shards=n,
     )
 
 

@@ -89,10 +89,17 @@ def _sharded_sweep(
         return data.at[:, line_idx].set(mixed.transpose(1, 0, 2), mode="drop")
 
     rep = NamedSharding(mesh, P())
-    return jax.jit(
-        sweep,
-        in_shardings=(rep, rep, NamedSharding(mesh, P(axis)), rep, rep),
-        out_shardings=rep,
+    from celestia_app_tpu.trace.device_ledger import track
+
+    return track(
+        jax.jit(
+            sweep,
+            in_shardings=(rep, rep, NamedSharding(mesh, P(axis)), rep, rep),
+            out_shardings=rep,
+        ),
+        "sharded_repair_sweep",
+        k=k, construction=construction, mode="sharded",
+        shards=mesh.shape[axis],
     )
 
 

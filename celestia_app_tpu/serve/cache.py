@@ -29,9 +29,36 @@ probe away.
 from __future__ import annotations
 
 import threading
+import weakref
 from collections import OrderedDict
 
 import numpy as np
+
+
+def _caches_owned_bytes() -> int:
+    """Device-tier bytes held by every live ForestCache: the retained
+    EDS buffer plus both flat forests per resident height.  Spilled
+    (host-tier) entries are host RAM the allocator reports elsewhere."""
+    total = 0
+    for cache in list(_ALL_CACHES):
+        with cache._lock:
+            entries = list(cache._device.values())
+        for e in entries:
+            try:
+                total += int(e.eds._eds.nbytes)
+                total += int(e.row_flat.nbytes) + int(e.col_flat.nbytes)
+            except Exception:  # chaos-ok: entry mid-spill/deleted
+                continue
+    return total
+
+
+_ALL_CACHES: "weakref.WeakSet[ForestCache]" = weakref.WeakSet()
+
+from celestia_app_tpu.trace.device_ledger import (  # noqa: E402
+    register_owner as _register_owner,
+)
+
+_register_owner("serve_forest_cache", _caches_owned_bytes)
 
 
 class _ForestLineTree:
@@ -201,6 +228,7 @@ class ForestCache:
         # not each pay a forest dispatch (and transiently hold N copies
         # of the EDS+forests) only for the last put to win.
         self._building: dict = {}
+        _ALL_CACHES.add(self)
 
     def _capacity(self) -> tuple[int, int]:
         from celestia_app_tpu.serve import serve_heights, spill_heights

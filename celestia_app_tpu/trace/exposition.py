@@ -35,6 +35,11 @@ hits:
     GET /fleet                   merged cluster telemetry over the
                                  configured peers (trace/fleet.py):
                                  per-host rates + cross-host quantiles
+    GET /device                  the device-attribution ledger
+                                 (trace/device_ledger.py): per-program
+                                 compile/dispatch stats, memory
+                                 ownership + unattributed residual,
+                                 applied autotuner seats, warmup state
 
 /healthz is the SLO face: beyond {"status": "SERVING"}, any registered
 health providers (a ServingNode registers its own snapshot: last block
@@ -338,6 +343,13 @@ def handle_observability_get(path: str, plane: str = "shared"):
         # rate-limited by the aggregator interval, so planes asked
         # inside one round serve identical bytes.
         return fleet_response()
+    if p == "/device":
+        from celestia_app_tpu.trace.device_ledger import device_response
+
+        # The device-attribution ledger (trace/device_ledger.py): a
+        # snapshot refreshed at most once per $CELESTIA_DEVICE_TICK_S,
+        # so planes asked inside one tick serve identical bytes.
+        return device_response()
     if p == "/metrics":
         return 200, METRICS_CONTENT_TYPE, metrics_payload()
     if p == "/healthz":

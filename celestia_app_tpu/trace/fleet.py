@@ -53,7 +53,7 @@ from celestia_app_tpu.trace.metrics import Histogram, HistogramSnapshot
 FLEET_ROUTES = ("/fleet", "/das/coverage")
 
 #: The peer paths one scrape round pulls.
-SCRAPE_PATHS = ("/metrics", "/healthz", "/slo", "/heal")
+SCRAPE_PATHS = ("/metrics", "/healthz", "/slo", "/heal", "/device")
 
 DEFAULT_INTERVAL_S = 5.0
 DEFAULT_TIMEOUT_S = 2.0
@@ -180,6 +180,12 @@ class FleetAggregator:
             heal = json.loads(self._fetch(url, "/heal"))
         except Exception as e:  # noqa: BLE001 — a dead peer is a DATUM
             return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        try:
+            # A peer predating the device ledger still merges — its host
+            # row just carries no device block (rolling-upgrade safety).
+            device = json.loads(self._fetch(url, "/device"))
+        except Exception:  # noqa: BLE001 — optional surface
+            device = None
         kinds, scalars, hists = parse_prometheus_text(metrics_text)
         return {
             "ok": True,
@@ -189,6 +195,7 @@ class FleetAggregator:
             "healthz": healthz,
             "slo": slo,
             "heal": heal,
+            "device": device,
         }
 
     def scrape(self) -> dict:
@@ -268,6 +275,18 @@ class FleetAggregator:
                     for name, s in d["slo"].get("slos", {}).items()
                 },
             }
+            dev = d.get("device")
+            if dev is not None:
+                own = dev.get("ownership") or {}
+                hosts[url]["device"] = {
+                    "programs": len(dev.get("programs") or []),
+                    "programs_resident": sum(
+                        (dev.get("programs_resident") or {}).values()
+                    ),
+                    "owned_bytes": own.get("owned_bytes"),
+                    "measured_bytes": own.get("measured_bytes"),
+                    "unattributed_residual": own.get("unattributed_residual"),
+                }
 
         def merged_hist(round_data, name):
             return Histogram.merge([
@@ -288,6 +307,26 @@ class FleetAggregator:
                 "p50_s": _round6(lat.quantile(0.5, phase="total")),
                 "p99_s": _round6(lat.quantile(0.99, phase="total")),
                 "samples": lat.count(phase="total"),
+            },
+            # Device-attribution rollup across hosts that serve /device:
+            # the cluster's resident-program count and claimed-vs-slack
+            # bytes in one block (per-host detail in hosts[url]["device"]).
+            "device": {
+                "programs_resident": sum(
+                    hosts[u]["device"]["programs_resident"]
+                    for u in ok_urls if "device" in hosts[u]
+                ),
+                "owned_bytes": sum(
+                    hosts[u]["device"]["owned_bytes"] or 0
+                    for u in ok_urls if "device" in hosts[u]
+                ),
+                "unattributed_residual": sum(
+                    hosts[u]["device"]["unattributed_residual"] or 0
+                    for u in ok_urls if "device" in hosts[u]
+                ),
+                "hosts_reporting": sum(
+                    1 for u in ok_urls if "device" in hosts[u]
+                ),
             },
         }
         # Fleet-level SLO burn: the per-node engine's own quantile specs

@@ -25,6 +25,10 @@ box: when an anomaly TRIGGER fires —
                       an SLO crossed the paging threshold (context
                       carries peers' recent bundle indexes so the fleet
                       bundle points at the per-node black boxes)
+    device_residual_growth  trace/device_ledger.py: the unattributed
+                      memory residual (measured high-water minus every
+                      claimed owner) grew for N consecutive
+                      reconciliations — the leak signature
 
 — `note_trigger` atomically dumps one JSON bundle under
 $CELESTIA_FLIGHT_DIR: the last-N rows of EVERY trace table, the
@@ -61,6 +65,7 @@ TRIGGERS = (
     "heal_completed",
     "heal_quarantined",
     "fleet_fast_burn",
+    "device_residual_growth",
 )
 
 #: Hard ceiling on per-table tail rows in a bundle.
@@ -230,6 +235,7 @@ def capture(trigger: str, context: dict | None = None) -> dict:
     from celestia_app_tpu import chaos
     from celestia_app_tpu.chaos.degrade import degraded_state
     from celestia_app_tpu.serve.api import coverage_snapshot
+    from celestia_app_tpu.trace.device_ledger import snapshot as device_snapshot
     from celestia_app_tpu.trace import slo, square_journal
     from celestia_app_tpu.trace.context import node_id
     from celestia_app_tpu.trace.exposition import health_payload
@@ -254,6 +260,10 @@ def capture(trigger: str, context: dict | None = None) -> dict:
         # heights had how much of their square decided when the anomaly
         # fired — the withholding drill's context in one block.
         "coverage": coverage_snapshot(),
+        # The device-attribution ledger (trace/device_ledger.py): what
+        # was compiled/resident and who owned the bytes at the moment of
+        # failure — a FRESH snapshot, not the rate-limited /device cache.
+        "device": device_snapshot(),
         "tail_rows": n,
         "tables": tables,
     }

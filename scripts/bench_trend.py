@@ -840,6 +840,85 @@ def find_qos_regressions(qos_rounds: list[dict],
     return out
 
 
+# --- chip-sweep rounds (scripts/chip_sweep.py) -------------------------------
+
+def load_sweep_round(path: str) -> dict:
+    """One SWEEP_rNN.json (schema sweep-v1): the push-button standing-
+    debt sitting — per-leg status + timing, each leg carrying the
+    child's /device snapshot (compile/dispatch ledger + ownership).
+    A half-written journal is resumable by chip_sweep --resume, but a
+    file this reader cannot parse at all exits 2 like any round."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            raw = json.load(f)
+    except (OSError, ValueError) as e:
+        raise MalformedRound(f"{path}: not readable JSON: {e}") from e
+    for key in ("schema", "round", "plan", "legs"):
+        if key not in raw:
+            raise MalformedRound(f"{path}: missing required key {key!r}")
+    if raw["schema"] != "sweep-v1":
+        raise MalformedRound(f"{path}: unknown schema {raw['schema']!r}")
+    legs = {
+        name: {
+            "status": rec.get("status", "missing"),
+            "seconds": float(rec.get("seconds", 0.0)),
+            "device_families": sorted({
+                row.get("family", "?")
+                for row in (rec.get("device") or {}).get("programs", [])
+            }),
+        }
+        for name, rec in raw["legs"].items()
+    }
+    return {
+        "path": path,
+        "round": int(raw["round"]),
+        "platform": raw.get("platform", "unprobed"),
+        "dryrun": bool(raw.get("dryrun", False)),
+        "plan": list(raw["plan"]),
+        "legs": legs,
+    }
+
+
+def load_sweep_series(paths: list[str]) -> list[dict]:
+    """[] until the first sitting lands (the series is additive)."""
+    return sorted(
+        (load_sweep_round(p) for p in paths), key=lambda r: r["round"]
+    )
+
+
+def sweep_plan_gaps(sweep_rounds: list[dict]) -> list[str]:
+    """What the newest sitting did NOT cover: planned legs that never
+    ran ok are COVERAGE GAPS (the debt is still standing for them), and
+    legs first appearing in this round are plan gaps like the das
+    series' — the plan grew, nothing went stale."""
+    if not sweep_rounds:
+        return []
+    newest = sweep_rounds[-1]
+    gaps = []
+    if newest["dryrun"]:
+        gaps.append(
+            f"sweep r{newest['round']:02d} is a dryrun plan — no leg has "
+            "paid the standing debt yet"
+        )
+        return gaps
+    for name in newest["plan"]:
+        status = newest["legs"].get(name, {}).get("status", "missing")
+        if status != "ok":
+            gaps.append(
+                f"sweep leg {name!r} is {status} in r{newest['round']:02d}"
+                " — its standing-debt item is still open"
+            )
+    priors = [r for r in sweep_rounds[:-1] if not r["dryrun"]]
+    if priors:
+        for name in newest["plan"]:
+            if all(name not in r["plan"] for r in priors):
+                gaps.append(
+                    f"sweep leg {name!r} first planned in "
+                    f"r{newest['round']:02d} (plan gap, not STALE)"
+                )
+    return gaps
+
+
 # --- trend assembly ---------------------------------------------------------
 
 def mode_series(rounds: list[dict]) -> dict[tuple[str, int], list[tuple[int, float]]]:
@@ -1185,11 +1264,16 @@ def main(argv: list[str] | None = None) -> int:
         [] if args.files
         else sorted(glob.glob(os.path.join(args.dir, "QOS_r*.json")))
     )
+    sweep_paths = (
+        [] if args.files
+        else sorted(glob.glob(os.path.join(args.dir, "SWEEP_r*.json")))
+    )
     try:
         rounds = load_series(paths)
         das_rounds = load_das_series(das_paths)
         adv_rounds = load_adv_series(adv_paths)
         qos_rounds = load_qos_series(qos_paths)
+        sweep_rounds = load_sweep_series(sweep_paths)
     except MalformedRound as e:
         print(f"bench_trend: MALFORMED: {e}", file=sys.stderr)
         return 2
@@ -1210,6 +1294,7 @@ def main(argv: list[str] | None = None) -> int:
     regressions += find_adv_regressions(adv_rounds, args.threshold)
     regressions += find_qos_regressions(qos_rounds, args.threshold)
     das_gaps = das_plan_gaps(das_rounds)
+    sweep_gaps = sweep_plan_gaps(sweep_rounds)
     stale = stale_gated_series(rounds, gate_all=args.all_series)
     seats = seat_changes(rounds)
     overrides = seat_overrides(rounds)
@@ -1221,6 +1306,8 @@ def main(argv: list[str] | None = None) -> int:
             "das_rounds": [r["round"] for r in das_rounds],
             "adv_rounds": [r["round"] for r in adv_rounds],
             "qos_rounds": [r["round"] for r in qos_rounds],
+            "sweep_rounds": [r["round"] for r in sweep_rounds],
+            "sweep_plan_gaps": sweep_gaps,
             "regressions": regressions,
             "stale": [s for s in stale
                       if not s.get("hw_gated") and not s.get("opt_in")],
@@ -1257,6 +1344,14 @@ def main(argv: list[str] | None = None) -> int:
                       f"cross-host p99 {fl['cross_host_p99_ms']:8.3f} ms  "
                       f"coverage {fl['coverage_ratio']:.4f}")
         for gap in das_gaps:
+            print(f"  NOTE: {gap}")
+        for r in sweep_rounds:
+            ok = sum(1 for leg in r["legs"].values()
+                     if leg["status"] == "ok")
+            print(f"  sweep r{r['round']:02d}: {ok}/{len(r['plan'])} legs ok"
+                  + ("  [dryrun]" if r["dryrun"] else "")
+                  + (f"  [{r['platform']}]" if r.get("platform") else ""))
+        for gap in sweep_gaps:
             print(f"  NOTE: {gap}")
         for r in qos_rounds:
             spam = r["legs"]["spam"]["tenants"][r["spam_tenant"]]

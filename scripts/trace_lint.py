@@ -45,6 +45,12 @@ Walks every registry().counter/gauge/histogram registration in
      fresh root context on an inbound hop instead of adopting the
      x-celestia-trace header splits the cross-node trace, which is
      exactly the regression the propagation layer exists to prevent.
+  8. every module under da/, kernels/, serve/, parallel/ that builds a
+     jit program (`jax.jit(...)` call or `@jax.jit` decorator) must
+     reference `celestia_app_tpu.trace.device_ledger` — a jit-cache
+     family that never registers with the device-attribution ledger is
+     invisible on GET /device: its compiles, dispatches, and residency
+     vanish from the exact surface built to account for them.
 
 Run standalone (exit 1 on problems) or via tests/test_trace_lint.py,
 which puts the check in tier-1.
@@ -313,6 +319,45 @@ def collect_rpc_context_mints(package_dir: str = PACKAGE_DIR, trees=None):
     return out
 
 
+def collect_unledgered_jits(package_dir: str = PACKAGE_DIR, trees=None):
+    """[(file, lineno)] for the FIRST `jax.jit` use in each device-plane
+    module (da/, kernels/, serve/, parallel/) that never references the
+    device ledger.  One finding per module: the fix is registering the
+    module's cache family, not annotating each jit site."""
+    out = []
+    for rel, tree, _ in trees if trees is not None else _parse_package(package_dir):
+        p = rel.replace(os.sep, "/")
+        if not any(
+            p.startswith(f"celestia_app_tpu/{d}/")
+            for d in ("da", "kernels", "serve", "parallel")
+        ):
+            continue
+        jit_line = None
+        references_ledger = False
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr == "jit"
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "jax"
+            ):
+                if jit_line is None:
+                    jit_line = node.lineno
+            elif (
+                isinstance(node, ast.ImportFrom)
+                and node.module is not None
+                and node.module.endswith("device_ledger")
+            ) or (
+                isinstance(node, (ast.Name, ast.Attribute))
+                and getattr(node, "id", getattr(node, "attr", None))
+                == "device_ledger"
+            ):
+                references_ledger = True
+        if jit_line is not None and not references_ledger:
+            out.append((rel, jit_line))
+    return out
+
+
 def readme_metric_tokens(readme_path: str = README) -> set[str]:
     with open(readme_path, encoding="utf-8") as f:
         return set(README_TOKEN_RE.findall(f.read()))
@@ -417,6 +462,13 @@ def lint(package_dir: str = PACKAGE_DIR, readme_path: str = README) -> list[str]
                 "serving plane that mints instead of adopting the "
                 "x-celestia-trace header splits the cross-node trace"
             )
+    for rel, lineno in collect_unledgered_jits(package_dir, trees):
+        problems.append(
+            f"{rel}:{lineno}: module builds jit programs but never "
+            "references trace/device_ledger — register the cache family "
+            "(device_ledger.track) so GET /device can attribute its "
+            "compiles, dispatches, and residency"
+        )
     return problems
 
 

@@ -1328,3 +1328,143 @@ class TestQosRounds:
         mutilate(rec)
         open(path, "w").write(json.dumps(rec))
         assert bt.main(["--dir", str(tmp_path)]) == 2
+
+
+def _sweep_round_file(tmp_path, n=1, dryrun=False, plan=None, legs=None,
+                      platform="tpu", schema="sweep-v1"):
+    plan = plan if plan is not None else ["parts", "mempool"]
+    if legs is None:
+        status = "planned" if dryrun else "ok"
+        legs = {name: {"status": status, "seconds": 0.0} for name in plan}
+    rec = {
+        "schema": schema,
+        "round": n,
+        "plan": plan,
+        "legs": legs,
+        "platform": "unprobed" if dryrun else platform,
+    }
+    if dryrun:
+        rec["dryrun"] = True
+    path = os.path.join(tmp_path, f"SWEEP_r{n:02d}.json")
+    with open(path, "w") as f:
+        json.dump(rec, f)
+    return path
+
+
+class TestSweepRounds:
+    """ISSUE-18: SWEEP_rNN.json (scripts/chip_sweep.py) — the chip
+    sitting's journal: per-leg status + /device families load, a dryrun
+    plan reads as wholly-open debt, never-ok legs stay open, plan
+    growth is a NOTE not a regression, malformed raises."""
+
+    def test_chip_sweep_dryrun_journal_round_trips(self, tmp_path):
+        # Cross-tool contract: the journal chip_sweep WRITES is the
+        # journal bench_trend READS — generate it with the real tool.
+        bt = _load()
+        spec = importlib.util.spec_from_file_location(
+            "chip_sweep",
+            os.path.join(REPO_ROOT, "scripts", "chip_sweep.py"),
+        )
+        cs = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(cs)
+        assert cs.main(["--dryrun", "--out-dir", str(tmp_path)]) == 0
+
+        r = bt.load_sweep_round(os.path.join(tmp_path, "SWEEP_r01.json"))
+        assert r["dryrun"] is True
+        assert r["platform"] == "unprobed"
+        assert len(r["plan"]) == 13
+        assert all(
+            leg["status"] == "planned" for leg in r["legs"].values()
+        )
+        gaps = bt.sweep_plan_gaps([r])
+        assert len(gaps) == 1
+        assert "dryrun plan" in gaps[0]
+        assert "no leg has paid the standing debt" in gaps[0]
+
+    def test_device_families_extracted_per_leg(self, tmp_path):
+        bt = _load()
+        path = _sweep_round_file(tmp_path, legs={
+            "parts": {
+                "status": "ok", "seconds": 41.5,
+                "device": {"programs": [
+                    {"family": "extend_and_dah", "k": 512},
+                    {"family": "forest", "k": 512},
+                    {"family": "extend_and_dah", "k": 512, "mode": "epi"},
+                ]},
+            },
+            "mempool": {"status": "timeout", "seconds": 1800.0},
+        })
+        r = bt.load_sweep_round(path)
+        assert r["legs"]["parts"]["device_families"] == [
+            "extend_and_dah", "forest",
+        ]
+        assert r["legs"]["parts"]["seconds"] == 41.5
+        assert r["legs"]["mempool"]["device_families"] == []
+
+    def test_never_ok_legs_stay_open_debt(self, tmp_path):
+        bt = _load()
+        path = _sweep_round_file(tmp_path, legs={
+            "parts": {"status": "ok", "seconds": 10.0},
+            "mempool": {"status": "timeout", "seconds": 1800.0},
+        })
+        gaps = bt.sweep_plan_gaps([bt.load_sweep_round(path)])
+        assert len(gaps) == 1
+        assert "'mempool'" in gaps[0] and "timeout" in gaps[0]
+        assert "still open" in gaps[0]
+
+    def test_planned_leg_that_never_ran_is_missing(self, tmp_path):
+        bt = _load()
+        path = _sweep_round_file(
+            tmp_path, plan=["parts", "repair"],
+            legs={"parts": {"status": "ok", "seconds": 10.0}},
+        )
+        gaps = bt.sweep_plan_gaps([bt.load_sweep_round(path)])
+        assert any("'repair'" in g and "missing" in g for g in gaps)
+
+    def test_new_leg_is_plan_gap_not_stale(self, tmp_path):
+        bt = _load()
+        p1 = _sweep_round_file(tmp_path, n=1, plan=["parts"])
+        p2 = _sweep_round_file(tmp_path, n=2, plan=["parts", "hbm_k512"])
+        rounds = bt.load_sweep_series([p1, p2])
+        assert [r["round"] for r in rounds] == [1, 2]
+        gaps = bt.sweep_plan_gaps(rounds)
+        assert any(
+            "'hbm_k512'" in g and "plan gap, not STALE" in g for g in gaps
+        )
+        # The ok legs themselves are NOT gaps.
+        assert not any("'parts'" in g for g in gaps)
+
+    def test_main_reports_sweep_series_without_gating(self, tmp_path, capsys):
+        bt = _load()
+        _bench_seed_round(tmp_path)
+        _sweep_round_file(tmp_path, legs={
+            "parts": {"status": "ok", "seconds": 10.0},
+            "mempool": {"status": "error", "seconds": 3.0},
+        })
+        assert bt.main(["--dir", str(tmp_path), "--json"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["sweep_rounds"] == [1]
+        assert any("'mempool'" in g for g in out["sweep_plan_gaps"])
+
+    @pytest.mark.parametrize("mutilate", [
+        lambda r: r.pop("schema"),
+        lambda r: r.pop("round"),
+        lambda r: r.pop("plan"),
+        lambda r: r.pop("legs"),
+        lambda r: r.update(schema="sweep-v9"),
+    ])
+    def test_malformed_sweep_raises(self, tmp_path, mutilate):
+        bt = _load()
+        path = _sweep_round_file(tmp_path)
+        rec = json.loads(open(path).read())
+        mutilate(rec)
+        open(path, "w").write(json.dumps(rec))
+        with pytest.raises(bt.MalformedRound):
+            bt.load_sweep_round(path)
+
+    def test_unreadable_sweep_exits_2_via_main(self, tmp_path, capsys):
+        bt = _load()
+        _bench_seed_round(tmp_path)
+        with open(os.path.join(tmp_path, "SWEEP_r01.json"), "w") as f:
+            f.write("{not json")
+        assert bt.main(["--dir", str(tmp_path)]) == 2

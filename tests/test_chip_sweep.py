@@ -1,0 +1,127 @@
+"""scripts/chip_sweep.py — the push-button chip sitting.  Tier-1 only
+exercises the spawn-free surfaces: the pure plan builder, the --dryrun
+journal (schema, round numbering, atomicity), and the --legs filter.
+The real legs need the hardware the sweep exists to reach.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+_SCRIPT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts", "chip_sweep.py",
+)
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("chip_sweep", _SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def cs():
+    return _load()
+
+
+def _args(cs, **over):
+    import argparse
+
+    ns = argparse.Namespace(
+        dryrun=False, resume=None, legs=None, out_dir=".",
+        leg_timeout_s=1800.0, probe_timeout_s=120.0,
+        require_device=False, shards="1,8", giant_ks=cs.GIANT_KS,
+        das_clients=1000, mempool_threads=8,
+    )
+    for k, v in over.items():
+        setattr(ns, k, v)
+    return ns
+
+
+class TestBuildPlan:
+    def test_plan_covers_the_standing_debt(self, cs):
+        plan = cs.build_plan(_args(cs))
+        names = [leg["name"] for leg in plan]
+        assert names == [
+            "parts", "stream", "repair",
+            "compute_sharded_k1024", "compute_sharded_k2048",
+            "compute_sharded_k4096",
+            "panel_k1024", "panel_k2048", "panel_k4096",
+            "das_shard_sweep", "mempool", "withhold_heal", "hbm_k512",
+        ]
+        # Pure function: no filesystem writes, no subprocess spawns —
+        # every leg is still argv + env, nothing executed.
+        for leg in plan:
+            assert leg["argv"][0]  # resolved interpreter path
+            assert isinstance(leg["env"], dict)
+            assert leg["timeout_s"] == 1800.0
+
+    def test_legs_filter_and_unknown_leg_rejected(self, cs):
+        plan = cs.build_plan(_args(cs, legs="parts,mempool"))
+        assert [leg["name"] for leg in plan] == ["parts", "mempool"]
+        with pytest.raises(SystemExit):
+            cs.build_plan(_args(cs, legs="parts,flux_capacitor"))
+
+    def test_giant_ks_parameterize_the_sharded_legs(self, cs):
+        plan = cs.build_plan(_args(cs, giant_ks=(64,)))
+        names = [leg["name"] for leg in plan]
+        assert "compute_sharded_k64" in names
+        assert "compute_sharded_k1024" not in names
+
+    def test_das_legs_write_round_artifacts_into_the_leg_dir(self, cs):
+        plan = cs.build_plan(_args(cs, legs="das_shard_sweep,withhold_heal"))
+        for leg in plan:
+            assert any("__LEGDIR__" in a for a in leg["argv"])
+
+
+class TestDryrun:
+    def test_dryrun_journals_every_leg_without_spawning(self, cs, tmp_path):
+        rc = cs.main(["--dryrun", "--out-dir", str(tmp_path)])
+        assert rc == 0
+        journal = json.loads((tmp_path / "SWEEP_r01.json").read_text())
+        assert journal["schema"] == cs.SWEEP_SCHEMA
+        assert journal["round"] == 1
+        assert journal["dryrun"] is True
+        assert journal["platform"] == "unprobed"
+        assert len(journal["legs"]) == 13
+        assert set(journal["plan"]) == set(journal["legs"])
+        for rec in journal["legs"].values():
+            assert rec["status"] == "planned"
+            assert rec["argv"] and rec["note"]
+        # Atomic write: no .tmp residue.
+        assert not list(tmp_path.glob("*.tmp"))
+
+    def test_round_numbering_increments(self, cs, tmp_path):
+        assert cs.main(["--dryrun", "--out-dir", str(tmp_path)]) == 0
+        assert cs.main(["--dryrun", "--out-dir", str(tmp_path)]) == 0
+        assert (tmp_path / "SWEEP_r01.json").exists()
+        assert (tmp_path / "SWEEP_r02.json").exists()
+
+    def test_dryrun_respects_legs_filter(self, cs, tmp_path):
+        rc = cs.main([
+            "--dryrun", "--out-dir", str(tmp_path), "--legs", "parts",
+        ])
+        assert rc == 0
+        journal = json.loads((tmp_path / "SWEEP_r01.json").read_text())
+        assert list(journal["legs"]) == ["parts"]
+
+
+class TestJournalHelpers:
+    def test_next_round_path_skips_to_max_plus_one(self, cs, tmp_path):
+        (tmp_path / "SWEEP_r07.json").write_text("{}")
+        (tmp_path / "SWEEP_r03.json").write_text("{}")
+        path = cs.next_round_path(str(tmp_path))
+        assert path.endswith("SWEEP_r08.json")
+
+    def test_write_journal_creates_parents_and_is_atomic(self, cs, tmp_path):
+        path = str(tmp_path / "deep" / "SWEEP_r01.json")
+        cs.write_journal(path, {"schema": cs.SWEEP_SCHEMA, "legs": {}})
+        data = json.loads(open(path).read())
+        assert data["schema"] == cs.SWEEP_SCHEMA
+        assert not os.path.exists(path + ".tmp")

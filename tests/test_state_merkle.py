@@ -203,4 +203,6 @@ class TestConsistentAppHash:
         return node.app.cms.last_app_hash.hex()
 
     def test_pinned_app_hash(self):
+        # testnode signs real txs — needs the secp256k1 backend.
+        pytest.importorskip("cryptography")
         assert self._run_chain() == self.PINNED

@@ -72,6 +72,10 @@ class TestWarmupBudget:
         exact failure the round-3 devnet hit before this ordering)."""
         import inspect
 
+        import pytest
+
+        # rpc.devnet pulls in the tx/crypto stack at import time.
+        pytest.importorskip("cryptography")
         from celestia_app_tpu.rpc import devnet
 
         src = inspect.getsource(devnet.run_validator)
