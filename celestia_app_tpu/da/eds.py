@@ -652,7 +652,8 @@ def _maybe_parity_check(ods_host, k: int, construction: str, droot) -> None:
             return
         _PARITY_THREADS[:] = [t for t in _PARITY_THREADS if t.is_alive()]
     t = _sentinel_threading.Thread(
-        target=_parity_check, args=(ods_host, k, construction, droot),
+        target=_parity_check, args=(ods_host, k, construction, droot,
+                                    _parity_provenance()),
         daemon=True, name="parity-sentinel",
     )
     with _PARITY_LOCK:
@@ -660,10 +661,26 @@ def _maybe_parity_check(ods_host, k: int, construction: str, droot) -> None:
     t.start()
 
 
-def _parity_check(ods_host, k: int, construction: str, droot) -> None:
+def _parity_provenance() -> dict:
+    """trace_id/height of the dispatch that armed this check, captured on
+    the HOT-PATH side — the checker thread runs after the context is
+    gone, and an unstamped mismatch row is unstitchable (trace_lint
+    rule 9, trace/timeline.py)."""
+    from celestia_app_tpu.trace.context import current_context
+
+    ctx = current_context()
+    return {
+        "trace_id": ctx.trace_id if ctx is not None else None,
+        "height": ctx.baggage.get("height") if ctx is not None else None,
+    }
+
+
+def _parity_check(ods_host, k: int, construction: str, droot,
+                  provenance: dict | None = None) -> None:
     from celestia_app_tpu.trace.metrics import registry
     from celestia_app_tpu.trace.tracer import traced
 
+    provenance = provenance or {"trace_id": None, "height": None}
     checks = registry().counter(
         "celestia_parity_checks_total",
         "fused-vs-staged DAH parity sentinel verdicts",
@@ -679,6 +696,7 @@ def _parity_check(ods_host, k: int, construction: str, droot) -> None:
         traced().write(
             "parity_mismatch", k=k, construction=construction,
             served=served_root.hex(), staged=staged_root.hex(),
+            **provenance,
         )
         # A root divergence between bit-identical-by-contract lowerings
         # is the most forensically urgent trigger there is: capture the
@@ -693,7 +711,7 @@ def _parity_check(ods_host, k: int, construction: str, droot) -> None:
         checks.inc(result="error")
         traced().write(
             "parity_mismatch", k=k, construction=construction,
-            error=f"{type(e).__name__}: {e}"[:200],
+            error=f"{type(e).__name__}: {e}"[:200], **provenance,
         )
 
 

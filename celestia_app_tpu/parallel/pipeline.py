@@ -483,7 +483,7 @@ class BlockPipeline:
                         return
                     continue
                 host = self._ring.host(sid)
-                for i, (ods, _tag) in enumerate(items):
+                for i, (ods, _tag, _t_enq) in enumerate(items):
                     np.copyto(host[i], ods)
                 for attempt in range(_UPLOAD_RETRIES + 1):
                     try:
@@ -524,8 +524,17 @@ class BlockPipeline:
             # The slot id rides along so a failed DONATED dispatch can
             # re-upload from the persistent staging bytes
             # (guarded_dispatch's refresh) and the drain can recycle it.
-            meta = {"upload_ms": (t1 - t0) * 1e3}
-            tags = [tag for _ods, tag in items]
+            meta = {
+                "upload_ms": (t1 - t0) * 1e3,
+                # Head-of-line intake wait: how long the batch's OLDEST
+                # block sat in _tasks before the uploader picked it up
+                # (back-pressure/occupancy queue time, a gap — not work).
+                "intake_wait_ms": max(
+                    0.0,
+                    (t0 - min(t_enq for _ods, _tag, t_enq in items)) * 1e3,
+                ),
+            }
+            tags = [tag for _ods, tag, _t_enq in items]
             self._staged.put((x, tags, meta, sid))
             meta["upload_stall_ms"] = (time.perf_counter() - t1) * 1e3
             if sentinel_seen:
@@ -706,6 +715,7 @@ class BlockPipeline:
             batch_size=meta.get("batch_size", 1),
             **({"panels": meta["panels"]} if "panels" in meta else {}),
             **({"shards": meta["shards"]} if "shards" in meta else {}),
+            intake_wait_ms=meta.get("intake_wait_ms", 0.0),
             upload_ms=meta.get("upload_ms", 0.0),
             upload_stall_ms=meta.get("upload_stall_ms", 0.0),
             dispatch_ms=meta.get("dispatch_ms", 0.0),
@@ -757,7 +767,11 @@ class BlockPipeline:
         )
         while True:
             try:
-                self._tasks.put((ods, tag), timeout=_POLL_S)
+                # The enqueue stamp rides the task so the uploader can
+                # report the head-of-line intake wait (time queued before
+                # any stage touched the block) — the timeline's first gap.
+                self._tasks.put((ods, tag, time.perf_counter()),
+                                timeout=_POLL_S)
                 return
             except queue.Full:
                 if self._error is not None:

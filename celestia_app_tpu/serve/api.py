@@ -98,6 +98,13 @@ def count_served(plane: str, kind: str, payload=None) -> None:
         namespace=payload_namespace_label(payload),
         shard=payload_shard_label(payload),
     )
+    # The height timeline's closing event: the FIRST served answer for a
+    # height finalizes its record and observes the critical-path
+    # histograms (trace/timeline.py); later serves just bump the count.
+    if isinstance(payload, dict) and payload.get("height") is not None:
+        from celestia_app_tpu.trace.timeline import timeline
+
+        timeline().note_first_serve(payload.get("height"), plane, kind)
 
 
 class UnknownHeight(KeyError):

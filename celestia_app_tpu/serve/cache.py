@@ -29,6 +29,7 @@ probe away.
 from __future__ import annotations
 
 import threading
+import time
 import weakref
 from collections import OrderedDict
 
@@ -271,7 +272,9 @@ class ForestCache:
                     return existing
             from celestia_app_tpu.serve.shard import build_entry
 
+            t0 = time.perf_counter()
             entry = build_entry(height, eds)
+            build_ms = (time.perf_counter() - t0) * 1e3
             entry.owner = self
             # Admission happens INSIDE the gate: a concurrent put that
             # passes the gate next must find the entry resident, or the
@@ -279,18 +282,20 @@ class ForestCache:
             # would leak through the build->admit window.
             spilled, dropped = self._admit(entry, cap, spill_cap)
         self._building.pop(height, None)
-        self._count_evictions(spilled, dropped)
+        self._trace_admission("admit", height, build_ms, spilled, dropped)
+        self._count_evictions(len(spilled), len(dropped))
         self._publish_residency()
         self._invalidate_tamper_memo(height)
         return entry
 
     def _admit(self, entry: CachedForest, cap: int, spill_cap: int
-               ) -> tuple[int, int]:
+               ) -> tuple[list[int], list[int]]:
         """Insert `entry` at the device tier's MRU end (REPLACING any
         resident same-height entry), spill device overflow to host, drop
-        host overflow; returns (spilled, dropped).  Caller holds the
-        height's build gate."""
+        host overflow; returns (spilled heights, dropped heights).
+        Caller holds the height's build gate."""
         evicted: list[CachedForest] = []
+        dropped: list[int] = []
         with self._lock:
             self._host.pop(entry.height, None)  # re-admission promotes
             self._device[entry.height] = entry
@@ -303,11 +308,10 @@ class ForestCache:
                 old.spill()
                 self._host[old.height] = old
                 self._host.move_to_end(old.height)
-            dropped = 0
             while len(self._host) > spill_cap:
-                self._host.popitem(last=False)
-                dropped += 1
-        return len(evicted), dropped
+                h, _old = self._host.popitem(last=False)
+                dropped.append(h)
+        return [e.height for e in evicted], dropped
 
     def readmit(self, height: int, eds, *, healed: bool = True
                 ) -> CachedForest | None:
@@ -342,7 +346,8 @@ class ForestCache:
                 # freshen its LRU slot and mark it healed.
                 entry = existing
                 entry.healed = entry.healed or healed
-                spilled = dropped = 0
+                spilled, dropped = [], []
+                build_ms = 0.0
                 with self._lock:
                     if height in self._device:
                         self._device.move_to_end(height)
@@ -351,12 +356,15 @@ class ForestCache:
             else:
                 from celestia_app_tpu.serve.shard import build_entry
 
+                t0 = time.perf_counter()
                 entry = build_entry(height, eds)
+                build_ms = (time.perf_counter() - t0) * 1e3
                 entry.owner = self
                 entry.healed = healed
                 spilled, dropped = self._admit(entry, cap, spill_cap)
         self._building.pop(height, None)
-        self._count_evictions(spilled, dropped)
+        self._trace_admission("readmit", height, build_ms, spilled, dropped)
+        self._count_evictions(len(spilled), len(dropped))
         self._publish_residency()
         self._invalidate_tamper_memo(height)
         return entry
@@ -383,6 +391,22 @@ class ForestCache:
         "is this height mine" check must not skew hit/miss accounting."""
         with self._lock:
             return height in self._device or height in self._host
+
+    @staticmethod
+    def _trace_admission(event: str, height: int, build_ms: float,
+                         spilled: list[int], dropped: list[int]) -> None:
+        """One `forest_cache` row per admission (with the forest-build
+        dispatch time) plus one per height it pushed down a tier — the
+        height timeline's retention-churn signal (trace/timeline.py)."""
+        from celestia_app_tpu.trace.tracer import traced
+
+        tracer = traced()
+        tracer.write("forest_cache", event=event, height=height,
+                     forest_build_ms=round(build_ms, 3))
+        for h in spilled:
+            tracer.write("forest_cache", event="spill", height=h)
+        for h in dropped:
+            tracer.write("forest_cache", event="drop", height=h)
 
     def _count_evictions(self, spilled: int, dropped: int) -> None:
         if not (spilled or dropped):

@@ -429,9 +429,17 @@ class HealingEngine:
                         self._quarantined.popitem(last=False)
         lat.observe(total_s, phase="total")
         heal_total().inc(outcome=outcome)
+        from celestia_app_tpu.trace.context import current_context
+
+        ctx = current_context()
         traced().write(
             "heal", node=self.name, height=height, kind=info["kind"],
             outcome=outcome, attempts=attempt, total_ms=rec["total_ms"],
+            # The per-phase split and (when the heal runs under a request
+            # trace, e.g. a detection on the serve path) the trace_id:
+            # the height timeline stitches this row's anatomy from them.
+            phases_ms=phases_ms,
+            trace_id=ctx.trace_id if ctx is not None else None,
         )
         note_trigger(
             "heal_completed" if outcome == "healed" else "heal_quarantined",

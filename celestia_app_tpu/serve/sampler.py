@@ -295,21 +295,26 @@ class ProofSampler:
             by_entry.setdefault((id(p.entry), p.axis), []).append(p)
         from celestia_app_tpu.trace.tracer import traced
 
-        traced().write(
-            "proof_serve", batch=len(batch), heights=len(by_entry),
-            mode=serve_mode(),
-            shards=max(
-                (getattr(p.entry, "shards", 0) for p in batch), default=0
-            ),
-            # The extend plane's share partition (kernels/panel_sharded):
-            # independent of the forest mesh above, so the row carries
-            # both — a sharded-forest/unsharded-share plane and its
-            # inverse are distinguishable from one trace table.
-            share_shards=max(
-                (getattr(p.entry, "share_shards", 0) for p in batch),
-                default=0,
-            ),
-        )
+        # One row per (entry, axis) group, each stamped with the group's
+        # height — a batched dispatch serving three heights writes three
+        # rows, so the height timeline (trace/timeline.py) never has to
+        # guess which heights a batch touched.  `heights` still carries
+        # the batch-wide group count on every row (the coalescing fact).
+        tracer = traced()
+        for group in by_entry.values():
+            entry = group[0].entry
+            tracer.write(
+                "proof_serve", batch=len(group), heights=len(by_entry),
+                height=getattr(entry, "height", None),
+                mode=serve_mode(),
+                shards=getattr(entry, "shards", 0),
+                # The extend plane's share partition
+                # (kernels/panel_sharded): independent of the forest mesh
+                # above, so the row carries both — a
+                # sharded-forest/unsharded-share plane and its inverse
+                # are distinguishable from one trace table.
+                share_shards=getattr(entry, "share_shards", 0),
+            )
         for group in by_entry.values():
             entry = group[0].entry
             coords = [(p.row, p.col) for p in group]

@@ -215,6 +215,20 @@ class _Tracked:
             rec["last_dispatch_unix"] = time.time()
         if first:
             _compile_seconds_counter().inc(dt, family=rec["family"])
+            # The compile bill as a TRACE ROW, stamped with whatever
+            # block/request context paid it: the height timeline
+            # (trace/timeline.py) attributes a first-dispatch
+            # trace+compile stall to the height that hit it.
+            from celestia_app_tpu.trace.context import current_context
+            from celestia_app_tpu.trace.tracer import traced
+
+            ctx = current_context()
+            traced().write(
+                "compile_bill", family=rec["family"], k=rec["k"],
+                mode=rec["mode"], compile_ms=dt * 1e3,
+                trace_id=ctx.trace_id if ctx is not None else None,
+                height=ctx.baggage.get("height") if ctx is not None else None,
+            )
         else:
             _dispatch_seconds_counter().inc(
                 dt, family=rec["family"], k=str(rec["k"]), mode=rec["mode"]

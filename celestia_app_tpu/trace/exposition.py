@@ -350,6 +350,13 @@ def handle_observability_get(path: str, plane: str = "shared"):
         # snapshot refreshed at most once per $CELESTIA_DEVICE_TICK_S,
         # so planes asked inside one tick serve identical bytes.
         return device_response()
+    if p == "/timeline":
+        from celestia_app_tpu.trace.timeline import timeline_response
+
+        # The per-height anatomy index (trace/timeline.py): a pure
+        # function of retained row state — no ticks, no clocks at
+        # render time — so every plane serves identical bytes.
+        return timeline_response(_query_params(query))
     if p == "/metrics":
         return 200, METRICS_CONTENT_TYPE, metrics_payload()
     if p == "/healthz":

@@ -53,7 +53,8 @@ from celestia_app_tpu.trace.metrics import Histogram, HistogramSnapshot
 FLEET_ROUTES = ("/fleet", "/das/coverage")
 
 #: The peer paths one scrape round pulls.
-SCRAPE_PATHS = ("/metrics", "/healthz", "/slo", "/heal", "/device")
+SCRAPE_PATHS = ("/metrics", "/healthz", "/slo", "/heal", "/device",
+                "/timeline")
 
 DEFAULT_INTERVAL_S = 5.0
 DEFAULT_TIMEOUT_S = 2.0
@@ -186,6 +187,11 @@ class FleetAggregator:
             device = json.loads(self._fetch(url, "/device"))
         except Exception:  # noqa: BLE001 — optional surface
             device = None
+        try:
+            # Same rolling-upgrade stance for the height timeline.
+            timeline = json.loads(self._fetch(url, "/timeline"))
+        except Exception:  # noqa: BLE001 — optional surface
+            timeline = None
         kinds, scalars, hists = parse_prometheus_text(metrics_text)
         return {
             "ok": True,
@@ -196,6 +202,7 @@ class FleetAggregator:
             "slo": slo,
             "heal": heal,
             "device": device,
+            "timeline": timeline,
         }
 
     def scrape(self) -> dict:
@@ -287,6 +294,11 @@ class FleetAggregator:
                     "measured_bytes": own.get("measured_bytes"),
                     "unattributed_residual": own.get("unattributed_residual"),
                 }
+            from celestia_app_tpu.trace.timeline import fleet_block
+
+            tl = fleet_block(d.get("timeline"))
+            if tl is not None:
+                hosts[url]["timeline"] = tl
 
         def merged_hist(round_data, name):
             return Histogram.merge([

@@ -239,6 +239,7 @@ def capture(trigger: str, context: dict | None = None) -> dict:
     from celestia_app_tpu.trace import slo, square_journal
     from celestia_app_tpu.trace.context import node_id
     from celestia_app_tpu.trace.exposition import health_payload
+    from celestia_app_tpu.trace.timeline import timeline
     from celestia_app_tpu.trace.tracer import traced
 
     tracer = traced()
@@ -264,6 +265,11 @@ def capture(trigger: str, context: dict | None = None) -> dict:
         # was compiled/resident and who owned the bytes at the moment of
         # failure — a FRESH snapshot, not the rate-limited /device cache.
         "device": device_snapshot(),
+        # The height-anatomy timeline (trace/timeline.py): the last-N
+        # per-height critical paths plus the latest full record — what
+        # phase the node was spending its height time on when the
+        # anomaly fired (slo_report renders this block).
+        "timeline": timeline().bundle_block(tail=8),
         "tail_rows": n,
         "tables": tables,
     }

@@ -82,13 +82,19 @@ def record(source: str, k: int, *, mode: str | None = None,
     if not tracer._on():
         return
     # A dispatch running under a request/block trace stamps its row with
-    # the trace_id, tying the device journal to the RPC-to-DAH span tree.
-    if "trace_id" not in fields:
+    # the trace_id — tying the device journal to the RPC-to-DAH span
+    # tree — and the height riding the context baggage (the block trace
+    # child minted in mempool reap), so the height timeline
+    # (trace/timeline.py) can stitch the row without a join table.
+    if "trace_id" not in fields or "height" not in fields:
         from celestia_app_tpu.trace.context import current_context
 
         ctx = current_context()
         if ctx is not None:
-            fields["trace_id"] = ctx.trace_id
+            fields.setdefault("trace_id", ctx.trace_id)
+            height = ctx.baggage.get("height")
+            if height is not None:
+                fields.setdefault("height", height)
     tracer.write(TABLE, source=source, k=k, mode=mode, compile=compile,
                  **fields)
     reg = registry()

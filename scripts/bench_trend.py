@@ -63,6 +63,15 @@ must heal every node — while the single-node and quorum detect-to-
 restored latencies (`heal_total_ms` / `total_ms`) gate lower-better
 against same-platform priors that also carry a heal block (older
 adv-v1 rounds simply predate the loop: additive, never STALE).
+
+The HEIGHT-ANATOMY trajectory (`TL_rNN.json`, written by
+`scripts/block_anatomy.py --round-out`) gates SHARES, not seconds: each
+`tl.<phase>.share` / `tl.<gap>.gap_share` series is the phase's fraction
+of all accounted height time over an N-block streamed run.  The newest
+round gates against the best (smallest) same-platform prior share with a
+0.05 absolute slack floor — a phase quietly growing its slice of the
+height critical path fails `--check` even when every absolute latency
+still looks healthy.  Phases a prior round never measured are additive.
 """
 
 from __future__ import annotations
@@ -840,6 +849,97 @@ def find_qos_regressions(qos_rounds: list[dict],
     return out
 
 
+# --- timeline rounds (scripts/block_anatomy.py) ------------------------------
+
+def load_tl_round(path: str) -> dict:
+    """One TL_rNN.json (schema tl-v1): the height-anatomy phase budget —
+    per-phase / per-gap mean, p95 and share-of-height-time over an
+    N-block streamed run, plus critical-phase counts.  The share columns
+    are the gated series: a phase quietly growing its slice of height
+    time is a regression even when absolute latency stays flat."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            raw = json.load(f)
+    except (OSError, ValueError) as e:
+        raise MalformedRound(f"{path}: not readable JSON: {e}") from e
+    for key in ("schema", "n", "phases"):
+        if key not in raw:
+            raise MalformedRound(f"{path}: missing required key {key!r}")
+    if raw["schema"] != "tl-v1":
+        raise MalformedRound(f"{path}: unknown schema {raw['schema']!r}")
+    phases = raw["phases"]
+    if not isinstance(phases, dict) or not phases:
+        raise MalformedRound(f"{path}: 'phases' must be a non-empty dict")
+    for name, d in phases.items():
+        if not isinstance(d, dict) or "share" not in d:
+            raise MalformedRound(
+                f"{path}: phase {name!r} carries no 'share' column"
+            )
+    return {
+        "round": int(raw["n"]),
+        "path": os.path.basename(path),
+        "platform": raw.get("platform"),
+        "k": raw.get("k"),
+        "blocks": raw.get("blocks"),
+        "phases": phases,
+        "gaps": raw.get("gaps") or {},
+        "critical_counts": raw.get("critical_counts") or {},
+        "total_ms": raw.get("total_ms"),
+    }
+
+
+def load_tl_series(paths: list[str]) -> list[dict]:
+    """Timeline rounds sorted by round number; [] when no timeline round
+    exists yet (the series is additive)."""
+    return sorted((load_tl_round(p) for p in paths),
+                  key=lambda r: r["round"])
+
+
+def find_tl_regressions(tl_rounds: list[dict],
+                        threshold_pct: float) -> list[dict]:
+    """Gate the newest timeline round's per-phase (and per-gap) share of
+    height time against the best same-platform prior.  Shares are
+    dimensionless fractions of the run's accounted time, so the gate is
+    platform-comparable in a way raw milliseconds are not — but a CPU
+    round still only gates against CPU priors, because the critical
+    phase itself changes across backends (compile-bound vs drain-bound).
+    The 0.05 absolute slack floor keeps sub-5%-share phases from tripping
+    the gate on scheduler noise."""
+    out: list[dict] = []
+    if len(tl_rounds) < 2:
+        return out
+    newest = tl_rounds[-1]
+    priors = [
+        r for r in tl_rounds[:-1]
+        if r.get("platform") == newest.get("platform")
+    ]
+    if not priors:
+        return out
+    rnd = newest["round"]
+    for section, label in (("phases", "share"), ("gaps", "gap_share")):
+        for name, d in sorted((newest.get(section) or {}).items()):
+            value = float(d["share"])
+            prior_shares = [
+                float(p[section][name]["share"])
+                for p in priors
+                if name in (p.get(section) or {})
+            ]
+            if not prior_shares:
+                continue  # a NEW phase is growth, not regression
+            best = min(prior_shares)
+            allowed = best + max(best * threshold_pct / 100.0, 0.05)
+            if value > allowed:
+                out.append({
+                    "series": f"tl.{name}.{label}", "unit": "share",
+                    "round": rnd, "value": value, "best_prior": best,
+                    "worse_pct": round(
+                        (value - best) / max(best, 1e-9) * 100.0, 2),
+                    "allowed_pct": round(
+                        (allowed - best) / max(best, 1e-9) * 100.0, 2),
+                })
+    return out
+
+
 # --- chip-sweep rounds (scripts/chip_sweep.py) -------------------------------
 
 def load_sweep_round(path: str) -> dict:
@@ -1268,12 +1368,17 @@ def main(argv: list[str] | None = None) -> int:
         [] if args.files
         else sorted(glob.glob(os.path.join(args.dir, "SWEEP_r*.json")))
     )
+    tl_paths = (
+        [] if args.files
+        else sorted(glob.glob(os.path.join(args.dir, "TL_r*.json")))
+    )
     try:
         rounds = load_series(paths)
         das_rounds = load_das_series(das_paths)
         adv_rounds = load_adv_series(adv_paths)
         qos_rounds = load_qos_series(qos_paths)
         sweep_rounds = load_sweep_series(sweep_paths)
+        tl_rounds = load_tl_series(tl_paths)
     except MalformedRound as e:
         print(f"bench_trend: MALFORMED: {e}", file=sys.stderr)
         return 2
@@ -1293,6 +1398,7 @@ def main(argv: list[str] | None = None) -> int:
     regressions += find_das_regressions(das_rounds, args.threshold)
     regressions += find_adv_regressions(adv_rounds, args.threshold)
     regressions += find_qos_regressions(qos_rounds, args.threshold)
+    regressions += find_tl_regressions(tl_rounds, args.threshold)
     das_gaps = das_plan_gaps(das_rounds)
     sweep_gaps = sweep_plan_gaps(sweep_rounds)
     stale = stale_gated_series(rounds, gate_all=args.all_series)
@@ -1307,6 +1413,7 @@ def main(argv: list[str] | None = None) -> int:
             "adv_rounds": [r["round"] for r in adv_rounds],
             "qos_rounds": [r["round"] for r in qos_rounds],
             "sweep_rounds": [r["round"] for r in sweep_rounds],
+            "tl_rounds": [r["round"] for r in tl_rounds],
             "sweep_plan_gaps": sweep_gaps,
             "regressions": regressions,
             "stale": [s for s in stale
@@ -1368,6 +1475,21 @@ def main(argv: list[str] | None = None) -> int:
                   f"throttled={spam.get('throttled')} "
                   f"served={spam.get('served')}; honest tenants "
                   f"{len(honest)}, worst spam-leg burn {worst}"
+                  + (f"  [{r['platform']}]" if r.get("platform") else ""))
+        for r in tl_rounds:
+            worst = max(
+                r["phases"].items(), key=lambda kv: kv[1]["share"],
+                default=None,
+            )
+            crit = r.get("critical_counts") or {}
+            crit_s = ", ".join(
+                f"{name}x{n}" for name, n in
+                sorted(crit.items(), key=lambda kv: -kv[1])
+            ) or "-"
+            print(f"  tl r{r['round']:02d}: {r.get('blocks', '?')} blocks "
+                  f"k={r.get('k', '?')}; top phase "
+                  f"{worst[0]}={worst[1]['share'] * 100:.1f}% "
+                  f"(mean {worst[1]['mean_ms']} ms); critical {crit_s}"
                   + (f"  [{r['platform']}]" if r.get("platform") else ""))
         for r in adv_rounds:
             rep = r["repair"]

@@ -15,8 +15,10 @@ serving stack, just the bundle:
 
 Sections: the trigger and its context, the health/degradation state at
 capture, the SLO table (state, fast/slow burn, objective — burning rows
-first), the per-tenant accounting snapshot, and the tail of the most
-forensically relevant trace tables (slo_page, flight_dump,
+first), the height-anatomy timeline block (which phase was critical for
+the last heights when the page fired, and the latest height's phase /
+gap budget), the per-tenant accounting snapshot, and the tail of the
+most forensically relevant trace tables (slo_page, flight_dump,
 block_journal, square_journal, chaos_injection, parity_mismatch,
 wal_salvage) around the moment of capture.
 """
@@ -88,6 +90,46 @@ def _slo_rows(slo_payload: dict) -> list[tuple[str, dict]]:
     )
 
 
+def render_timeline(block) -> list[str]:
+    """The bundle's height-anatomy block (trace/timeline.py
+    bundle_block): per-height critical phases, then the latest height's
+    phase/gap budget — what the node was spending its height time on
+    when the trigger fired.  Empty list when the bundle predates the
+    timeline plane."""
+    if not isinstance(block, dict):
+        return []
+    out = ["", "height anatomy (last "
+           f"{len(block.get('records') or [])} of "
+           f"{block.get('capacity', '-')} retained heights):"]
+    records = block.get("records") or []
+    if not records:
+        out.append("  (no heights retained at capture)")
+        return out
+    out.append(f"  {'height':>8} {'critical phase':<16} "
+               f"{'critical ms':>12} {'span ms':>10}  gaps")
+    for rec in records:
+        gaps = rec.get("gaps") or {}
+        gap_s = ", ".join(
+            f"{name}={ms}" for name, ms in sorted(gaps.items())
+        ) or "-"
+        out.append(
+            f"  {rec.get('height', '?'):>8} "
+            f"{rec.get('critical_phase') or '-':<16} "
+            f"{rec.get('critical_ms', 0.0):>12} "
+            f"{rec.get('span_ms', 0.0):>10}  {gap_s}"
+        )
+    latest = block.get("latest")
+    if isinstance(latest, dict):
+        out.append(f"  latest height {latest.get('height', '?')} "
+                   "phase budget (ms):")
+        phases = latest.get("phases") or {}
+        for name, ms in sorted(phases.items(), key=lambda kv: -kv[1]):
+            marker = (" <-- CRITICAL"
+                      if name == latest.get("critical_phase") else "")
+            out.append(f"    {name:<18} {ms:>10}{marker}")
+    return out
+
+
 def render(bundle: dict, rows_per_table: int = 8) -> str:
     out: list[str] = []
     trigger = bundle.get("trigger", "?")
@@ -127,6 +169,8 @@ def render(bundle: dict, rows_per_table: int = 8) -> str:
                 f"{burn.get('fast', '-'):>10} {burn.get('slow', '-'):>10}  "
                 f"{r.get('objective', '')}{marker}"
             )
+
+    out.extend(render_timeline(bundle.get("timeline")))
 
     ns_payload = bundle.get("namespaces") or {}
     tenants = ns_payload.get("namespaces") or {}

@@ -51,6 +51,17 @@ Walks every registry().counter/gauge/histogram registration in
      family that never registers with the device-attribution ledger is
      invisible on GET /device: its compiles, dispatches, and residency
      vanish from the exact surface built to account for them.
+  9. every trace-row write (`.write("table", ...)` with a resolvable
+     table name — a string literal or a module-level string constant)
+     must stamp `height=` or `trace_id=` (a `**splat` keyword counts:
+     the spread row carries the stamps), unless the table is in the
+     height-free allowlist (HEIGHT_FREE_TABLES — process-scoped events
+     like pages and WAL salvage that genuinely belong to no height).  An
+     unstamped row is invisible to the height-anatomy timeline
+     (trace/timeline.py): it can never be stitched into a per-height
+     critical path, which is exactly the observability gap this plane
+     exists to close.  Unresolvable first args (self.TABLE, a local) are
+     skipped — the literal-name sites are the enforcement surface.
 
 Run standalone (exit 1 on problems) or via tests/test_trace_lint.py,
 which puts the check in tier-1.
@@ -98,6 +109,21 @@ FLEET_ROUTES_NAME = "FLEET_ROUTES"
 RPC_PREFIX = "celestia_app_tpu/rpc/"
 MINT_FUNCS = {"new_context", "use_context"}
 ADOPT_FUNCS = {"adopt_context", "adopt_or_new"}
+
+# Rule 9: trace tables whose rows genuinely belong to no height — page
+# events, bundle dumps, WAL salvage, chaos injections are process-scoped.
+# Everything else written through the tracer must stamp height= or
+# trace_id= so the height-anatomy timeline can stitch it.
+TABLE_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+STITCH_KEYS = {"height", "trace_id"}
+HEIGHT_FREE_TABLES = {
+    "slo_page",
+    "flight_dump",
+    "wal_salvage",
+    "chaos_injection",
+    "profiler",        # one capture window per process, not per height
+    "hbm_high_water",  # lifetime allocator/RSS peaks, not per height
+}
 
 
 def _parse_package(package_dir: str = PACKAGE_DIR):
@@ -358,6 +384,57 @@ def collect_unledgered_jits(package_dir: str = PACKAGE_DIR, trees=None):
     return out
 
 
+def collect_unstitched_writes(package_dir: str = PACKAGE_DIR, trees=None):
+    """[(file, lineno, table)] for every `.write(<table>, ...)` call
+    whose table name resolves statically (string literal, or a Name
+    bound to a module-level string constant) to something shaped like a
+    trace table, but whose keywords carry neither `height=` nor
+    `trace_id=` nor a `**splat` — and whose table is not in the
+    height-free allowlist.
+
+    The table-name regex is what separates tracer writes from the
+    file/socket `.write(...)` calls that share the method name: a
+    payload like "\\n" or a bytes body never matches
+    `[a-z][a-z0-9_]*`."""
+    out = []
+    for rel, tree, _ in trees if trees is not None else _parse_package(package_dir):
+        consts = {
+            t.id: n.value.value
+            for n in ast.walk(tree)
+            if isinstance(n, ast.Assign)
+            and isinstance(n.value, ast.Constant)
+            and isinstance(n.value.value, str)
+            for t in n.targets
+            if isinstance(t, ast.Name)
+        }
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "write"
+                and node.args
+            ):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                table = arg.value
+            elif isinstance(arg, ast.Name) and arg.id in consts:
+                table = consts[arg.id]
+            else:
+                continue  # self.TABLE / locals: not statically resolvable
+            if not TABLE_NAME_RE.match(table):
+                continue  # a file payload, not a trace table name
+            if table in HEIGHT_FREE_TABLES:
+                continue
+            stamped = any(
+                kw.arg is None or kw.arg in STITCH_KEYS
+                for kw in node.keywords
+            )
+            if not stamped:
+                out.append((rel, node.lineno, table))
+    return out
+
+
 def readme_metric_tokens(readme_path: str = README) -> set[str]:
     with open(readme_path, encoding="utf-8") as f:
         return set(README_TOKEN_RE.findall(f.read()))
@@ -468,6 +545,14 @@ def lint(package_dir: str = PACKAGE_DIR, readme_path: str = README) -> list[str]
             "references trace/device_ledger — register the cache family "
             "(device_ledger.track) so GET /device can attribute its "
             "compiles, dispatches, and residency"
+        )
+    for rel, lineno, table in collect_unstitched_writes(package_dir, trees):
+        problems.append(
+            f"{rel}:{lineno}: trace table {table!r} written without "
+            "height= or trace_id= — the height-anatomy timeline "
+            "(trace/timeline.py) cannot stitch an unstamped row; stamp "
+            "it, or add the table to HEIGHT_FREE_TABLES if it genuinely "
+            "belongs to no height"
         )
     return problems
 

@@ -1468,3 +1468,129 @@ class TestSweepRounds:
         with open(os.path.join(tmp_path, "SWEEP_r01.json"), "w") as f:
             f.write("{not json")
         assert bt.main(["--dir", str(tmp_path)]) == 2
+
+
+def _tl_round_file(tmp_path, n, phases, gaps=None, platform="cpu",
+                   **overrides):
+    def dist(shares):
+        return {
+            name: {"mean_ms": 1.0, "p95_ms": 2.0, "share": share}
+            for name, share in (shares or {}).items()
+        }
+
+    payload = {
+        "schema": "tl-v1", "n": n, "platform": platform, "k": 16,
+        "blocks": 8, "phases": dist(phases), "gaps": dist(gaps),
+        "critical_counts": {}, "total_ms": 100.0,
+    }
+    payload.update(overrides)
+    path = tmp_path / f"TL_r{n:02d}.json"
+    path.write_text(json.dumps(payload))
+    return str(path)
+
+
+class TestTimelineSeries:
+    """TL_rNN.json height-anatomy rounds (scripts/block_anatomy.py
+    --round-out): per-phase SHARE of height time gated against the best
+    same-platform prior with a 0.05 absolute slack floor."""
+
+    def test_checked_in_tl_round_parses_and_passes_check(self, capsys):
+        import glob
+
+        bt = _load()
+        paths = sorted(glob.glob(os.path.join(REPO_ROOT, "TL_r*.json")))
+        assert paths, "expected the checked-in TL_r01.json at the repo root"
+        rounds = bt.load_tl_series(paths)
+        assert rounds[0]["round"] == 1
+        assert rounds[0]["platform"], "CPU-fallback rounds must say so"
+        for d in rounds[0]["phases"].values():
+            assert 0.0 <= d["share"] <= 1.0
+        assert bt.main(["--check"]) == 0
+        out = capsys.readouterr().out
+        assert "tl r01" in out
+
+    def test_phase_share_regression_is_flagged(self, tmp_path, capsys):
+        bt = _load()
+        _bench_seed_round(tmp_path)
+        _tl_round_file(tmp_path, 1, {"dispatch": 0.30, "drain": 0.10})
+        # drain quietly grows its slice 0.10 -> 0.45 while dispatch
+        # stays flat: only the grower is flagged.
+        _tl_round_file(tmp_path, 2, {"dispatch": 0.30, "drain": 0.45})
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        out = capsys.readouterr().out
+        assert "tl.drain.share" in out
+        assert "tl.dispatch.share" not in out
+        assert bt.main(["--dir", str(tmp_path), "--json"]) == 1
+        payload = json.loads(capsys.readouterr().out)
+        series = [r["series"] for r in payload["regressions"]]
+        assert series == ["tl.drain.share"]
+        assert payload["tl_rounds"] == [1, 2]
+
+    def test_small_share_growth_rides_the_absolute_floor(self, tmp_path):
+        # 1% -> 5% is inside the 0.05 absolute slack: sub-5%-share
+        # phases must not trip the gate on scheduler noise.
+        bt = _load()
+        _bench_seed_round(tmp_path)
+        _tl_round_file(tmp_path, 1, {"upload": 0.01, "dispatch": 0.60})
+        _tl_round_file(tmp_path, 2, {"upload": 0.05, "dispatch": 0.60})
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+
+    def test_gap_shares_gate_too(self, tmp_path, capsys):
+        bt = _load()
+        _bench_seed_round(tmp_path)
+        _tl_round_file(tmp_path, 1, {"dispatch": 0.50},
+                       gaps={"intake_wait": 0.10})
+        _tl_round_file(tmp_path, 2, {"dispatch": 0.50},
+                       gaps={"intake_wait": 0.40})
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        assert "tl.intake_wait.gap_share" in capsys.readouterr().out
+
+    def test_cross_platform_tl_prior_not_compared(self, tmp_path):
+        bt = _load()
+        _bench_seed_round(tmp_path)
+        _tl_round_file(tmp_path, 1, {"dispatch": 0.05}, platform="tpu")
+        _tl_round_file(tmp_path, 2, {"dispatch": 0.90}, platform="cpu")
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+
+    def test_new_phase_is_additive_never_a_regression(self, tmp_path):
+        bt = _load()
+        _bench_seed_round(tmp_path)
+        _tl_round_file(tmp_path, 1, {"dispatch": 0.50})
+        _tl_round_file(tmp_path, 2, {"dispatch": 0.50,
+                                     "forest_build": 0.40})
+        assert bt.main(["--dir", str(tmp_path)]) == 0
+
+    def test_best_prior_wins_not_the_latest(self, tmp_path, capsys):
+        # The gate compares against the BEST (smallest) prior share, so
+        # two already-degraded rounds cannot ratchet the baseline up.
+        bt = _load()
+        _bench_seed_round(tmp_path)
+        _tl_round_file(tmp_path, 1, {"drain": 0.10})
+        _tl_round_file(tmp_path, 2, {"drain": 0.40})
+        _tl_round_file(tmp_path, 3, {"drain": 0.41})
+        assert bt.main(["--dir", str(tmp_path)]) == 1
+        assert "tl.drain.share" in capsys.readouterr().out
+
+    @pytest.mark.parametrize("mutilate", [
+        lambda r: r.pop("schema"),
+        lambda r: r.pop("n"),
+        lambda r: r.pop("phases"),
+        lambda r: r.update(schema="tl-v9"),
+        lambda r: r.update(phases={}),
+        lambda r: r["phases"]["dispatch"].pop("share"),
+    ])
+    def test_malformed_tl_round_raises(self, tmp_path, mutilate):
+        bt = _load()
+        path = _tl_round_file(tmp_path, 1, {"dispatch": 0.5})
+        rec = json.loads(open(path).read())
+        mutilate(rec)
+        open(path, "w").write(json.dumps(rec))
+        with pytest.raises(bt.MalformedRound):
+            bt.load_tl_round(path)
+
+    def test_unreadable_tl_exits_2_via_main(self, tmp_path):
+        bt = _load()
+        _bench_seed_round(tmp_path)
+        with open(os.path.join(tmp_path, "TL_r01.json"), "w") as f:
+            f.write("{not json")
+        assert bt.main(["--dir", str(tmp_path)]) == 2

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import importlib.util
 import os
+import time
 
 import numpy as np
 import pytest
@@ -440,7 +441,10 @@ class TestPipelinePropagation:
             # nobody drains.
             for i in range(8):
                 try:
-                    pipe._tasks.put((self._blocks(1)[0][1], i), timeout=0.2)
+                    pipe._tasks.put(
+                        (self._blocks(1)[0][1], i, time.perf_counter()),
+                        timeout=0.2,
+                    )
                 except _q.Full:
                     break
             with pytest.raises(TimeoutError, match="back-pressure"):
@@ -457,7 +461,9 @@ class TestPipelinePropagation:
         monkeypatch.setattr(pl.threading.Thread, "start", lambda self: None)
         pipe = pl.BlockPipeline(4, depth=1)  # workers never actually run
         pipe._error = RuntimeError("hard death")
-        pipe._tasks.put((self._blocks(1)[0][1], 0))  # intake full
+        pipe._tasks.put(
+            (self._blocks(1)[0][1], 0, time.perf_counter())
+        )  # intake full
         pipe._done.put(pl._SENTINEL)  # what the death wrapper force-feeds
         with pytest.raises(RuntimeError, match="feeder failed"):
             for _ in pipe.drain():

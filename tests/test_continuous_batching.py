@@ -131,6 +131,9 @@ class TestBatchedParity:
         assert [t for t, _ in out] == [0, 1, 2, 3, 4]
         assert [e.data_root() for _, e in out] == ref
 
+    # Two extra whole-pipeline variants to compile (~24 s) for a parity
+    # that the fused leg already pins every run — slow tier.
+    @pytest.mark.slow
     def test_batched_staged_mode_matches(self, monkeypatch):
         """The staged rung's batched twin (what a degraded pipeline
         dispatches) is bit-identical too."""

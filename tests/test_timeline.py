@@ -1,0 +1,364 @@
+"""Height-anatomy timeline (trace/timeline.py): golden critical paths
+over synthetic multi-table fixtures, trace_id stitching of the
+height-free submit leg, bounded ring eviction, the GET /timeline
+surface (byte-identical across planes), bundle/fleet blocks, and the
+crypto-gated submit -> first-serve e2e leg pinning one trace_id."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+from celestia_app_tpu.trace import timeline as tl_mod
+from celestia_app_tpu.trace.tracer import traced
+
+MS = 1_000_000  # ns per ms
+BASE = 1_700_000_000_000_000_000
+
+
+def _w(table, at_ms, **fields):
+    """Write one trace row with a pinned timestamp (Tracer.write lets
+    explicit ts_ns= override the stamp, exactly for fixtures)."""
+    traced().write(table, ts_ns=BASE + int(at_ms * MS), **fields)
+
+
+class TestGoldenCriticalPath:
+    def test_compile_stall_height(self):
+        """A height whose jit compile dominates: submit span parks on
+        the trace until propose binds it; the hole before propose is
+        the mempool wait; the compile bill is the critical phase."""
+        tl_mod._reset_for_tests()
+        h = 4001
+        _w("tx_submit", 2, duration_ms=2.0, trace_id="T-cs")
+        # Height-free: parked on the trace, no record yet.
+        assert tl_mod.timeline().record_payload(h) is None
+        _w("block_propose", 10, duration_ms=5.0, trace_id="T-cs", height=h)
+        _w("compile_bill", 60, compile_ms=50.0, height=h,
+           family="square_pipeline")
+        _w("block_journal", 64, height=h, trace_id="T-cs", source="stream",
+           k=16, dispatch_ms=2.0, drain_ms=2.0)
+        _w("proof_serve", 70, height=h, batch=1)
+
+        rec = tl_mod.timeline().record_payload(h)
+        assert rec["finalized"] is True
+        assert rec["critical_phase"] == "jit_compile"
+        assert rec["critical_ms"] == 50.0
+        assert rec["phases"] == {
+            "tx_submit": 2.0, "propose": 5.0, "jit_compile": 50.0,
+            "dispatch": 2.0, "drain": 2.0,
+        }
+        # The implicit hole between submit end (2) and propose start (5)
+        # is the mempool wait, by name.
+        assert rec["gaps"] == {"mempool_wait": 3.0}
+        assert rec["span_ms"] == 70.0
+        assert rec["first_serve_ms"] == 70.0
+        assert rec["trace_ids"] == ["T-cs"]
+        assert rec["meta"]["source"] == "stream" and rec["meta"]["k"] == 16
+        # Intervals render relative to the height's first anchor.
+        first = rec["intervals"][0]
+        assert first["phase"] == "tx_submit" and first["start_ms"] == 0.0
+
+        # Finalization observed the metric reflections exactly once.
+        from celestia_app_tpu.trace.metrics import registry
+
+        text = registry().render()
+        assert 'celestia_height_critical_phase{phase="jit_compile"} 1' in text
+        assert 'celestia_height_critical_phase{phase="dispatch"} 0' in text
+        assert "celestia_height_critical_seconds" in text
+        assert "celestia_height_gap_seconds" in text
+
+    def test_gap_dominated_height(self):
+        """A height whose EXPLICIT queue waits (intake_wait /
+        upload_stall / dispatch_starve off the block journal's backward
+        unroll) dwarf the working phases: the gaps never enter the
+        critical path, and the walk bills them by name."""
+        tl_mod._reset_for_tests()
+        h = 4002
+        _w("block_journal", 30, height=h, source="stream", k=16,
+           intake_wait_ms=10.0, upload_ms=2.0, upload_stall_ms=8.0,
+           dispatch_starve_ms=5.0, dispatch_ms=3.0, drain_ms=2.0)
+        tl_mod.timeline().note_first_serve(h, "rest", "share_proof")
+
+        rec = tl_mod.timeline().record_payload(h)
+        assert rec["finalized"] is True
+        assert rec["phases"] == {"upload": 2.0, "dispatch": 3.0,
+                                 "drain": 2.0}
+        assert rec["gaps"] == {"intake_wait": 10.0, "upload_stall": 8.0,
+                               "dispatch_starve": 5.0}
+        # The gaps dominate but a gap is never the critical PHASE.
+        assert sum(rec["gaps"].values()) > sum(rec["phases"].values())
+        assert rec["critical_phase"] == "dispatch"
+        assert rec["meta"]["first_serve_kind"] == "share_proof"
+
+    def test_overlap_never_double_bills(self):
+        """Two phases covering the same wall time: the second is
+        credited only the time past the cursor, so the per-height sum
+        never exceeds the span."""
+        tl_mod._reset_for_tests()
+        h = 4003
+        _w("compile_bill", 50, compile_ms=50.0, height=h, family="f")
+        _w("block_journal", 51, height=h, dispatch_ms=50.0, drain_ms=1.0)
+        rec = tl_mod.timeline().record_payload(h)
+        # dispatch [0,50] and jit_compile [0,50] tie on interval sort;
+        # whichever walked first got the 50 ms, the other got zero.
+        assert sum(rec["phases"].values()) <= rec["span_ms"] + 1e-6
+        assert rec["phases"]["drain"] == 1.0
+
+    def test_round_journal_contributes_consensus_steps(self):
+        tl_mod._reset_for_tests()
+        h = 4004
+        _w("block_propose", 5, duration_ms=5.0, height=h)
+        _w("round_journal", 20, height=h, round=1, result="decided",
+           propose_ms=5.0, prevote_ms=9.0, precommit_ms=6.0,
+           wal_fsync_ms=2.0)
+        rec = tl_mod.timeline().record_payload(h)
+        # propose_ms is skipped (the span covers it); prevote/precommit
+        # unroll backwards from the row write; wal_fsync rides under
+        # precommit and is absorbed by the walk (overlap -> 0 extra).
+        assert rec["phases"]["prevote"] == 9.0
+        assert rec["phases"]["precommit"] == 6.0
+        assert "wal_fsync" not in rec["phases"] or (
+            rec["phases"]["wal_fsync"] == 0.0
+        )
+        _w("round_journal", 21, height=h, round=2, result="round_bump")
+        rec = tl_mod.timeline().record_payload(h)
+        assert rec["meta"]["round_bumps"] == 1
+
+
+class TestRingAndBounds:
+    def test_ring_evicts_oldest_and_finalizes_it(self):
+        tl_mod._reset_for_tests(capacity=2)
+        tl = tl_mod.timeline()
+        for i, h in enumerate((11, 12, 13)):
+            _w("block_journal", 10 * (i + 1), height=h, dispatch_ms=1.0)
+        assert tl.record_payload(11) is None  # evicted
+        assert tl.index_payload()["heights"] == [12, 13]
+        assert tl.index_payload()["latest"]["height"] == 13
+
+    def test_capacity_zero_disables(self):
+        tl_mod._reset_for_tests(capacity=0)
+        _w("block_journal", 10, height=21, dispatch_ms=1.0)
+        assert tl_mod.timeline().record_payload(21) is None
+        assert tl_mod.timeline().index_payload()["heights"] == []
+
+    def test_pending_traces_bounded(self):
+        tl_mod._reset_for_tests()
+        tl = tl_mod.timeline()
+        for i in range(tl_mod.MAX_PENDING_TRACES + 50):
+            _w("tx_submit", i, duration_ms=1.0, trace_id=f"T-{i}")
+        assert len(tl._pending) <= tl_mod.MAX_PENDING_TRACES
+
+    def test_env_knob_controls_capacity(self, monkeypatch):
+        monkeypatch.setenv(tl_mod.HEIGHTS_ENV, "3")
+        tl_mod._reset_for_tests()
+        assert tl_mod.timeline().capacity == 3
+        monkeypatch.setenv(tl_mod.HEIGHTS_ENV, "not-a-number")
+        tl_mod._reset_for_tests()
+        assert tl_mod.timeline().capacity == tl_mod.DEFAULT_HEIGHTS
+
+    def test_height_coercion(self):
+        assert tl_mod._as_height(7) == 7
+        assert tl_mod._as_height("7") == 7  # wire-adopted baggage
+        assert tl_mod._as_height(True) is None
+        assert tl_mod._as_height("x") is None
+        assert tl_mod._as_height(None) is None
+
+
+class TestTimelineEndpoint:
+    def _seed(self):
+        tl_mod._reset_for_tests()
+        for h in (31, 32):
+            _w("block_journal", 10 * h, height=h, dispatch_ms=2.0,
+               drain_ms=1.0)
+            _w("proof_serve", 10 * h + 5, height=h, batch=1)
+
+    def test_index_height_latest_tail_and_errors(self):
+        self._seed()
+        status, ctype, body = tl_mod.timeline_response({})
+        assert status == 200 and ctype == "application/json"
+        index = json.loads(body)
+        assert index["heights"] == [31, 32]
+        assert index["latest"]["height"] == 32
+
+        status, _, body = tl_mod.timeline_response({"height": "31"})
+        assert status == 200 and json.loads(body)["height"] == 31
+        status, _, latest = tl_mod.timeline_response({"height": "latest"})
+        assert status == 200 and json.loads(latest)["height"] == 32
+
+        status, _, body = tl_mod.timeline_response({"tail": "1"})
+        assert status == 200
+        tails = json.loads(body)["timelines"]
+        assert [t["height"] for t in tails] == [32]
+        # Summaries carry no intervals/meta (the full record does).
+        assert "intervals" not in tails[0]
+
+        assert tl_mod.timeline_response({"height": "zap"})[0] == 400
+        assert tl_mod.timeline_response({"height": "999"})[0] == 404
+        assert tl_mod.timeline_response({"tail": "0"})[0] == 400
+        assert tl_mod.timeline_response({"tail": "x"})[0] == 400
+
+    def test_response_is_a_pure_function_of_state(self):
+        self._seed()
+        assert tl_mod.timeline_response({}) == tl_mod.timeline_response({})
+        a = tl_mod.timeline_response({"height": "32"})
+        b = tl_mod.timeline_response({"height": "32"})
+        assert a == b
+
+    def test_routed_through_shared_handler(self):
+        from celestia_app_tpu.trace.exposition import (
+            handle_observability_get,
+        )
+
+        self._seed()
+        status, ctype, body = handle_observability_get("/timeline?height=31")
+        assert status == 200 and ctype == "application/json"
+        assert json.loads(body)["height"] == 31
+        assert handle_observability_get("/timeline?height=bad")[0] == 400
+
+    def test_rest_and_grpc_debug_serve_identical_bytes(self):
+        pytest.importorskip("grpc")
+        from celestia_app_tpu.rpc.api_gateway import serve_api
+        from celestia_app_tpu.rpc.grpc_plane import serve_grpc
+
+        class _StubNode:
+            chain_id = "tl-test"
+
+        self._seed()
+        gw = serve_api(_StubNode())
+        plane = serve_grpc(_StubNode())
+        try:
+            for path in ("/timeline", "/timeline?height=32",
+                         "/timeline?tail=2"):
+                bodies = []
+                for url in (gw.url, plane.debug_url):
+                    with urllib.request.urlopen(url + path,
+                                                timeout=10) as resp:
+                        assert resp.status == 200
+                        bodies.append(resp.read())
+                assert bodies[0] == bodies[1], path
+        finally:
+            gw.stop()
+            plane.stop()
+
+
+class TestBundleAndFleetBlocks:
+    def test_bundle_block_and_slo_report_render(self):
+        tl_mod._reset_for_tests()
+        for h in (41, 42):
+            _w("block_journal", 10 * h, height=h, dispatch_ms=2.0)
+            _w("proof_serve", 10 * h + 5, height=h, batch=1)
+        block = tl_mod.timeline().bundle_block(tail=8)
+        assert [r["height"] for r in block["records"]] == [41, 42]
+        assert block["latest"]["height"] == 42
+
+        import importlib.util
+        import os
+
+        spec = importlib.util.spec_from_file_location(
+            "slo_report", os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "scripts", "slo_report.py",
+            ),
+        )
+        slo_report = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(slo_report)
+        lines = slo_report.render_timeline(block)
+        joined = "\n".join(lines)
+        assert "height anatomy" in joined
+        assert "42" in joined and "CRITICAL" in joined
+        # Pre-timeline bundles render nothing, not a crash.
+        assert slo_report.render_timeline(None) == []
+
+    def test_flight_bundle_embeds_timeline(self, tmp_path, monkeypatch):
+        tl_mod._reset_for_tests()
+        _w("block_journal", 10, height=51, dispatch_ms=2.0)
+        monkeypatch.setenv("CELESTIA_FLIGHT_DIR", str(tmp_path))
+        from celestia_app_tpu.trace import flight_recorder
+
+        bundle = flight_recorder.capture("test_trigger")
+        assert bundle["timeline"]["records"][-1]["height"] == 51
+
+    def test_fleet_block_folds_peer_payload(self):
+        tl_mod._reset_for_tests()
+        _w("block_journal", 10, height=61, dispatch_ms=2.0)
+        payload = json.loads(tl_mod.timeline_response({})[2])
+        block = tl_mod.fleet_block(payload)
+        assert block == {
+            "retained": 1, "latest_height": 61,
+            "critical_phase": "dispatch",
+            "span_ms": payload["latest"]["span_ms"],
+        }
+        # A peer predating the surface folds to None, never a crash.
+        assert tl_mod.fleet_block(None) is None
+
+
+class TestEndToEnd:
+    def test_submit_to_first_serve_pins_one_trace(self):
+        """Acceptance: one trace_id issued at tx submission lands on
+        the height's timeline record, the record finalizes on the first
+        served DAS proof, and /timeline serves identical bytes on all
+        three planes."""
+        pytest.importorskip("cryptography")
+        pytest.importorskip("grpc")
+        from celestia_app_tpu.rpc.api_gateway import serve_api
+        from celestia_app_tpu.rpc.grpc_plane import serve_grpc
+        from celestia_app_tpu.rpc.server import ServingNode, serve
+        from celestia_app_tpu.state.accounts import AuthKeeper
+        from celestia_app_tpu.testutil.testnode import (
+            deterministic_genesis,
+            funded_keys,
+        )
+        from celestia_app_tpu.tx.messages import Coin, MsgSend
+        from celestia_app_tpu.tx.sign import Fee, build_and_sign
+
+        keys = funded_keys(2)
+        node = ServingNode(genesis=deterministic_genesis(keys), keys=keys)
+        tl_mod._reset_for_tests()
+        addr = keys[0].public_key().address()
+        acct = AuthKeeper(node.app.cms.working).get_account(addr)
+        raw = build_and_sign(
+            [MsgSend(addr, keys[1].public_key().address(),
+                     (Coin("utia", 100),))],
+            keys[0], node.chain_id, acct.account_number, acct.sequence,
+            Fee((Coin("utia", 20_000),), 100_000),
+        )
+        reply = node.rpc_broadcast_tx(raw.hex(), relay=False)
+        assert reply["code"] == 0
+        trace_id = reply["trace_id"]
+        node.produce_block()
+        h = node.app.height
+
+        rec = tl_mod.timeline().record_payload(h)
+        assert rec is not None
+        # The submit leg stitched onto the height via the trace binding.
+        assert trace_id in rec["trace_ids"]
+        assert rec["phases"], "expected stitched phases"
+        assert not rec["finalized"]
+
+        # First served proof finalizes the record with a serve latency.
+        node.rpc_get_share_proof(h, 0, 0)
+        rec = tl_mod.timeline().record_payload(h)
+        assert rec["finalized"] is True
+        assert rec["first_serve_ms"] is not None
+        assert rec["critical_phase"] is not None
+        assert "mempool_wait" in rec["gaps"]
+
+        server = serve(node, port=0, block_interval_s=None)
+        gw = serve_api(node)
+        plane = serve_grpc(node)
+        try:
+            bodies = []
+            for url in (server.url, gw.url, plane.debug_url):
+                with urllib.request.urlopen(
+                    url + f"/timeline?height={h}", timeout=10
+                ) as resp:
+                    assert resp.status == 200
+                    bodies.append(resp.read())
+            assert bodies[0] == bodies[1] == bodies[2]
+            assert json.loads(bodies[0])["height"] == h
+        finally:
+            server.stop()
+            gw.stop()
+            plane.stop()

@@ -368,3 +368,60 @@ def test_in_tree_rpc_planes_all_adopt():
     assert all(adopts for _, _, _, adopts in mints), [
         (f, ln, fn) for f, ln, fn, adopts in mints if not adopts
     ]
+
+
+def test_unstamped_trace_writes_are_flagged(tmp_path):
+    """Rule 9: a trace-table write with a resolvable name that stamps
+    neither height= nor trace_id= (and is off the allowlist) is flagged;
+    height=, trace_id=, a **splat, an allowlisted table, and file-like
+    `.write(...)` payloads all pass."""
+    lint = _load()
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "TABLE = 'const_table'\n"
+        "def f(tracer, fh, row):\n"
+        "    tracer.write('naked_table', batch=3)\n"          # flagged
+        "    tracer.write(TABLE, batch=3)\n"                  # flagged
+        "    tracer.write('stamped_h', height=7)\n"
+        "    tracer.write('stamped_t', trace_id='T')\n"
+        "    tracer.write('spread_table', **row)\n"
+        "    tracer.write('slo_page', slo='x')\n"             # allowlist
+        "    tracer.write(row['t'], batch=3)\n"               # unresolvable
+        "    fh.write('\\n')\n"                               # file payload
+        "    fh.write(b'bytes')\n"
+    )
+    readme = tmp_path / "README.md"
+    readme.write_text("")
+    sites = lint.collect_unstitched_writes(str(pkg))
+    tables = sorted(t for _, _, t in sites)
+    assert tables == ["const_table", "naked_table"]
+    problems = [p for p in lint.lint(str(pkg), str(readme))
+                if "without height= or trace_id=" in p]
+    assert len(problems) == 2
+
+
+def test_in_tree_trace_writes_all_stamped():
+    # The real package already passes rule 9 (lint() clean is asserted
+    # above); additionally pin that the allowlist is EARNED — every
+    # height-free table actually exists as a literal write site, so a
+    # renamed table can't leave a stale exemption behind.
+    lint = _load()
+    assert lint.collect_unstitched_writes() == []
+    import ast as _ast
+    import os as _os
+
+    literal_tables = set()
+    for _rel, tree, _ in lint._parse_package():
+        for node in _ast.walk(tree):
+            if (
+                isinstance(node, _ast.Call)
+                and isinstance(node.func, _ast.Attribute)
+                and node.func.attr == "write"
+                and node.args
+                and isinstance(node.args[0], _ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                literal_tables.add(node.args[0].value)
+    stale = lint.HEIGHT_FREE_TABLES - literal_tables
+    assert not stale, f"allowlisted tables never written: {sorted(stale)}"
